@@ -1,0 +1,35 @@
+"""go_libp2p_pubsub_tpu — a TPU-native pubsub protocol framework.
+
+A from-scratch rebuild of the capabilities of go-libp2p-pubsub (the canonical
+libp2p publish/subscribe library) as a *vectorized simulation framework* on
+TPU: the full FloodSub / RandomSub / GossipSub v1.0+v1.1 state machines
+(mesh maintenance, heartbeat, IHAVE/IWANT lazy gossip, peer scoring P1..P7,
+peer gating, backoff, PX) expressed as batched JAX/XLA array programs over N
+virtual peers, sharded over a TPU device mesh with `shard_map`.
+
+Design stance (NOT a port): the reference's goroutine/channel actor model
+(pubsub.go:499-612 processLoop) becomes a synchronous-round, struct-of-arrays
+simulation core — one jitted ``step()`` advances message delivery, control
+handling, scoring and (each tick) the heartbeat for *all* peers at once.
+Randomness is `jax.random` with per-peer folded keys; time is integer ticks
+(the reference already quantizes its maintenance to heartbeat ticks).
+
+Layout:
+  config    — validated parameter dataclasses (mirrors GossipSubParams,
+              PeerScoreParams/TopicScoreParams/PeerScoreThresholds,
+              PeerGaterParams incl. their validate() rules)
+  graph     — static topology builders (connectAll / sparse / dense /
+              random-regular / Eth2 attestation-subnet)
+  state     — SimState pytree: all protocol state as device arrays
+  models    — the routers: floodsub, randomsub, gossipsub (strategy layer,
+              mirrors the PubSubRouter plug point, pubsub.go:169-198)
+  ops       — kernel building blocks: packed bitsets, masked top-k,
+              random-k selection, segment counts
+  score     — batched peer-score engine + peer gater + promise tracking
+  trace     — trace event schema (trace.pb-compatible) + host drain
+  parallel  — device-mesh sharding of the peer axis
+  oracle    — scalar pure-Python reference node used as the golden oracle
+  runtime   — host-side simulator driver, snapshot/restore
+"""
+
+__version__ = "0.1.0"

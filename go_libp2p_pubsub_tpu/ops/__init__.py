@@ -1,0 +1,26 @@
+"""Kernel building blocks: packed bitsets, masked ranking/selection.
+
+These are the array primitives every router operation reduces to (survey
+§3.4 TPU mapping): prune/graft = top-k by score with boolean masks,
+emitGossip = random-k selection, seen-cache / mcache membership = packed
+bitset algebra.
+"""
+
+from .bitset import (  # noqa: F401
+    WORD,
+    n_words,
+    pack,
+    unpack,
+    bit_get,
+    bit_set,
+    word_or_reduce,
+    popcount,
+    make_mask_below,
+)
+from .select import (  # noqa: F401
+    rank_desc,
+    select_topk_mask,
+    select_random_mask,
+    count_true,
+    median_masked,
+)

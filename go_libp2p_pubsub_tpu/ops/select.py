@@ -1,0 +1,80 @@
+"""Masked ranking and selection.
+
+Every peer-selection in the reference is one of two shapes:
+
+  * score-ordered keep/drop with random tie-break — the over-subscription
+    prune shuffles then stable-sorts by score (gossipsub.go:1389-1399);
+  * uniform random-k over an eligibility filter — `getPeers` +
+    `shufflePeers` (gossipsub.go:1852-1909), emitGossip target choice
+    (gossipsub.go:1697-1708).
+
+Both reduce to `rank_desc`: a dense per-slot descending rank with masked
+slots pushed to the end and ties broken by fresh uniform noise. Selecting
+"the top k" (k may be a per-row traced array, e.g. ineed = D - |mesh|) is
+then just `rank < k`. This keeps all selection kernels O(K log K) sorts over
+the padded neighbor axis — XLA-friendly, no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_desc(values: jax.Array, mask: jax.Array, key: jax.Array | None = None) -> jax.Array:
+    """Dense descending rank along the last axis.
+
+    Returns int32 ranks: the highest masked value gets 0. Unmasked slots get
+    ranks after all masked ones. Ties are broken uniformly at random when
+    `key` is given (otherwise by slot index), matching the reference's
+    shuffle-before-sort idiom (gossipsub.go:1391-1395).
+    """
+    if key is not None:
+        noise = jax.random.uniform(key, values.shape)
+    else:
+        noise = jnp.zeros(values.shape)
+    neg = jnp.float32(-jnp.inf)
+    primary = jnp.where(mask, values.astype(jnp.float32), neg)
+    # two-key sort: primary desc, noise as tiebreak. jnp.lexsort sorts
+    # ascending with the LAST key primary.
+    order = jnp.lexsort((noise, -primary), axis=-1)
+    return jnp.argsort(order, axis=-1).astype(jnp.int32)
+
+
+def select_topk_mask(
+    values: jax.Array, mask: jax.Array, k, key: jax.Array | None = None
+) -> jax.Array:
+    """Bool mask choosing the (up to) k highest masked values per row.
+
+    `k` may be a scalar or an array broadcastable to values.shape[:-1]."""
+    ranks = rank_desc(values, mask, key)
+    k_arr = jnp.asarray(k)[..., None] if jnp.ndim(k) else jnp.asarray(k)
+    return (ranks < k_arr) & mask
+
+
+def select_random_mask(key: jax.Array, mask: jax.Array, k) -> jax.Array:
+    """Bool mask choosing (up to) k uniform-random masked slots per row —
+    `getPeers`/`shufflePeers` (gossipsub.go:1852-1909)."""
+    noise = jax.random.uniform(key, mask.shape)
+    return select_topk_mask(noise, mask, k)
+
+
+def count_true(mask: jax.Array, axis: int = -1) -> jax.Array:
+    return jnp.sum(mask.astype(jnp.int32), axis=axis)
+
+
+def median_masked(values: jax.Array, mask: jax.Array) -> jax.Array:
+    """Median over masked slots per row, computed as the reference does for
+    opportunistic grafting: sort ascending, take element at index
+    len(peers)/2 (gossipsub.go:1488-1493) — i.e. the upper median.
+
+    Rows with no masked slots return +inf (so a `median < threshold` guard
+    is never triggered for them).
+    """
+    big = jnp.float32(jnp.inf)
+    v = jnp.where(mask, values.astype(jnp.float32), big)
+    v_sorted = jnp.sort(v, axis=-1)
+    n = count_true(mask)
+    idx = jnp.clip(n // 2, 0, values.shape[-1] - 1)
+    med = jnp.take_along_axis(v_sorted, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(n > 0, med, big)

@@ -1,0 +1,22 @@
+"""Test harness config: force an 8-device virtual CPU platform so the
+multi-chip sharding path is exercised without TPU hardware (survey §7
+stage 7; the driver's dryrun uses the same mechanism).
+
+The image's sitecustomize registers the axon TPU plugin and imports jax at
+interpreter startup, so JAX_PLATFORMS env tweaks are too late — we must go
+through jax.config before any backend is initialized.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

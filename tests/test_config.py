@@ -1,0 +1,148 @@
+"""Config validation tests — mirrors the reference's rejection matrices
+(score_params_test.go, and the parameter constraints at gossipsub.go:84-90,
+mcache.go:23-28, peer_gater.go:57-88)."""
+
+import dataclasses
+
+import pytest
+
+from go_libp2p_pubsub_tpu.config import (
+    ConfigError,
+    GossipSubParams,
+    PeerGaterParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    score_parameter_decay,
+    ticks_for,
+)
+
+
+def test_gossipsub_defaults_valid():
+    GossipSubParams().validate()
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"Dout": 5},          # Dout >= Dlo (gossipsub.go:89)
+        {"Dout": 4},          # Dout > D/2
+        {"history_gossip": 6},  # gossip > history (mcache.go:23-28)
+        {"D": 20},            # D > Dhi
+        {"gossip_factor": 1.5},
+        {"heartbeat_interval": 0.0},
+    ],
+)
+def test_gossipsub_invalid(kw):
+    with pytest.raises(ConfigError):
+        dataclasses.replace(GossipSubParams(), **kw).validate()
+
+
+def test_topic_score_defaults_valid():
+    TopicScoreParams().validate()
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"topic_weight": -1.0},
+        {"time_in_mesh_quantum": 0.0},
+        {"time_in_mesh_weight": -1.0},
+        {"time_in_mesh_cap": 0.0},
+        {"first_message_deliveries_weight": -1.0},
+        {"first_message_deliveries_decay": 2.0},
+        {"first_message_deliveries_cap": 0.0},
+        {"mesh_message_deliveries_weight": 1.0},      # must be negative
+        {"mesh_message_deliveries_decay": 0.0},
+        {"mesh_message_deliveries_cap": -1.0},
+        {"mesh_message_deliveries_threshold": 0.0},
+        {"mesh_message_deliveries_window": -1.0},
+        {"mesh_message_deliveries_activation": 0.5},  # must be >= 1s
+        {"mesh_failure_penalty_weight": 1.0},
+        {"mesh_failure_penalty_decay": 1.0},
+        {"invalid_message_deliveries_weight": 1.0},
+        {"invalid_message_deliveries_decay": 1.0},
+    ],
+)
+def test_topic_score_invalid(kw):
+    with pytest.raises(ConfigError):
+        dataclasses.replace(TopicScoreParams(), **kw).validate()
+
+
+def test_peer_score_params():
+    p = PeerScoreParams(topics={0: TopicScoreParams()}, skip_app_specific=True)
+    p.validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(p, topic_score_cap=-1.0).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(p, ip_colocation_factor_weight=1.0).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(
+            p, ip_colocation_factor_weight=-1.0, ip_colocation_factor_threshold=0
+        ).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(p, behaviour_penalty_weight=1.0).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(
+            p, behaviour_penalty_weight=-1.0, behaviour_penalty_decay=0.0
+        ).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(p, decay_interval=0.5).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(p, decay_to_zero=1.5).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(p, skip_app_specific=False).validate()
+    # bad nested topic params surface with topic id
+    bad = dataclasses.replace(p, topics={3: dataclasses.replace(TopicScoreParams(), topic_weight=-1)})
+    with pytest.raises(ConfigError, match="topic 3"):
+        bad.validate()
+
+
+def test_thresholds():
+    PeerScoreThresholds().validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerScoreThresholds(), gossip_threshold=1.0).validate()
+    with pytest.raises(ConfigError):
+        # publish > gossip (score_params.go:38-40)
+        dataclasses.replace(
+            PeerScoreThresholds(), gossip_threshold=-10.0, publish_threshold=-5.0
+        ).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(
+            PeerScoreThresholds(), publish_threshold=-50.0, graylist_threshold=-20.0
+        ).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerScoreThresholds(), accept_px_threshold=-1.0).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerScoreThresholds(), opportunistic_graft_threshold=-1.0).validate()
+
+
+def test_gater_params():
+    PeerGaterParams().validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerGaterParams(), threshold=0.0).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerGaterParams(), global_decay=1.0).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerGaterParams(), duplicate_weight=0.0).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerGaterParams(), ignore_weight=0.5).validate()
+    with pytest.raises(ConfigError):
+        dataclasses.replace(PeerGaterParams(), reject_weight=0.5).validate()
+
+
+def test_score_parameter_decay():
+    # after `decay_seconds` of 1s intervals, counter reaches decay_to_zero
+    # (score_params.go:277-287)
+    f = score_parameter_decay(10.0)
+    assert abs(f**10 - 0.01) < 1e-9
+    # decay shorter than the base interval: Go's integer division gives
+    # ticks=0 -> pow(dtz, +Inf) = 0.0, which validators then reject
+    assert score_parameter_decay(0.5) == 0.0
+
+
+def test_ticks_for():
+    assert ticks_for(0.0, 1.0) == 0
+    assert ticks_for(0.5, 1.0) == 1   # round up
+    assert ticks_for(60.0, 1.0) == 60
+    assert ticks_for(60.0, 0.5) == 120

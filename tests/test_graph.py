@@ -1,0 +1,71 @@
+"""Topology builder invariants: symmetry, reverse-edge index, outbound
+direction, subscription slot compression."""
+
+import numpy as np
+
+from go_libp2p_pubsub_tpu import graph
+
+
+def _check_topology(topo: graph.Topology):
+    n, K = topo.nbr.shape
+    for i in range(n):
+        for k in range(K):
+            j = topo.nbr[i, k]
+            if j < 0:
+                assert not topo.nbr_ok[i, k]
+                continue
+            assert topo.nbr_ok[i, k]
+            # reverse edge points back
+            r = topo.rev[i, k]
+            assert topo.nbr[j, r] == i
+            # exactly one side is outbound (the dialer)
+            assert topo.outbound[i, k] != topo.outbound[j, r]
+
+
+def test_connect_all():
+    topo = graph.connect_all(8)
+    _check_topology(topo)
+    assert (topo.degree == 7).all()
+
+
+def test_random_connect():
+    topo = graph.random_connect(50, d=3, seed=7)
+    _check_topology(topo)
+    assert (topo.degree >= 3).all()  # everyone dialed 3
+
+
+def test_ring_lattice():
+    topo = graph.ring_lattice(10, d=2)
+    _check_topology(topo)
+    assert (topo.degree == 4).all()
+
+
+def test_subscribe_all():
+    subs = graph.subscribe_all(5, 3)
+    assert subs.subscribed.all()
+    assert (subs.my_topics == np.arange(3)[None, :]).all()
+    assert (subs.slot_of == np.arange(3)[None, :]).all()
+
+
+def test_subscribe_random_slots_consistent():
+    subs = graph.subscribe_random(40, n_topics=16, topics_per_peer=3, seed=1)
+    assert (subs.subscribed.sum(axis=1) == 3).all()
+    for i in range(40):
+        for s in range(subs.max_slots):
+            t = subs.my_topics[i, s]
+            if t >= 0:
+                assert subs.subscribed[i, t]
+                assert subs.slot_of[i, t] == s
+        for t in range(16):
+            if subs.subscribed[i, t]:
+                assert subs.my_topics[i, subs.slot_of[i, t]] == t
+            else:
+                assert subs.slot_of[i, t] == -1
+
+
+def test_ip_groups_with_sybils():
+    g = graph.ip_groups_with_sybils(100, n_sybil_groups=2, sybil_frac=0.2, seed=0)
+    honest = g[:80]
+    sybil = g[80:]
+    assert len(np.unique(honest)) == 80
+    assert len(np.unique(sybil)) <= 2

@@ -1,0 +1,121 @@
+"""Unit tests for the kernel building blocks (packed bitsets, masked
+selection) against numpy oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.ops import (
+    bit_get,
+    bit_set,
+    count_true,
+    make_mask_below,
+    median_masked,
+    n_words,
+    pack,
+    popcount,
+    rank_desc,
+    select_random_mask,
+    select_topk_mask,
+    unpack,
+)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    bits = rng.random((5, 70)) < 0.3
+    words = pack(jnp.asarray(bits))
+    assert words.shape == (5, n_words(70))
+    out = np.asarray(unpack(words, 70))
+    np.testing.assert_array_equal(out, bits)
+
+
+def test_popcount():
+    rng = np.random.default_rng(1)
+    bits = rng.random((4, 100)) < 0.5
+    words = pack(jnp.asarray(bits))
+    np.testing.assert_array_equal(np.asarray(popcount(words)), bits.sum(axis=1))
+
+
+def test_bit_get_set():
+    bits = np.zeros((3, 64), dtype=bool)
+    words = pack(jnp.asarray(bits))
+    idx = jnp.asarray([5, 33, 63])
+    on = jnp.asarray([True, False, True])
+    words2 = bit_set(words, idx, on)
+    got = np.asarray(bit_get(words2, idx))
+    np.testing.assert_array_equal(got, [True, False, True])
+    # untouched bits stay zero
+    assert int(popcount(words2).sum()) == 2
+
+
+def test_make_mask_below():
+    m = make_mask_below(jnp.int32(40), 64)
+    bits = np.asarray(unpack(m, 64))
+    np.testing.assert_array_equal(bits, np.arange(64) < 40)
+
+
+def test_rank_desc_basic():
+    v = jnp.asarray([[3.0, 1.0, 2.0, 9.0]])
+    mask = jnp.asarray([[True, True, True, False]])
+    r = np.asarray(rank_desc(v, mask))
+    # 3.0 is rank 0, 2.0 rank 1, 1.0 rank 2; masked-out 9.0 last
+    np.testing.assert_array_equal(r, [[0, 2, 1, 3]])
+
+
+def test_select_topk_mask_per_row_k():
+    v = jnp.asarray([[5.0, 4.0, 3.0, 2.0], [1.0, 2.0, 3.0, 4.0]])
+    mask = jnp.ones((2, 4), dtype=bool)
+    k = jnp.asarray([1, 2])
+    sel = np.asarray(select_topk_mask(v, mask, k))
+    np.testing.assert_array_equal(sel, [[True, False, False, False], [False, False, True, True]])
+
+
+def test_select_topk_respects_mask_and_short_rows():
+    v = jnp.asarray([[5.0, 4.0, 3.0, 2.0]])
+    mask = jnp.asarray([[False, True, False, True]])
+    sel = np.asarray(select_topk_mask(v, mask, 3))
+    # only 2 eligible; both selected, none outside mask
+    np.testing.assert_array_equal(sel, [[False, True, False, True]])
+
+
+def test_select_random_mask_uniformity():
+    key = jax.random.key(0)
+    mask = jnp.ones((2000, 8), dtype=bool)
+    sel = np.asarray(select_random_mask(key, mask, 3))
+    assert (sel.sum(axis=1) == 3).all()
+    freq = sel.mean(axis=0)
+    # each slot picked ~3/8 of the time
+    assert np.all(np.abs(freq - 3 / 8) < 0.05)
+
+
+def test_random_tiebreak_varies():
+    key = jax.random.key(1)
+    v = jnp.zeros((500, 6))
+    mask = jnp.ones((500, 6), dtype=bool)
+    keys = jax.random.split(key, 500)
+    sel = np.asarray(
+        jax.vmap(lambda k, vv, mm: select_topk_mask(vv, mm, 2, key=k))(keys, v, mask)
+    )
+    freq = sel.mean(axis=0)
+    assert np.all(np.abs(freq - 2 / 6) < 0.07)
+
+
+def test_count_true():
+    m = jnp.asarray([[True, False, True]])
+    assert int(count_true(m)[0]) == 2
+
+
+def test_median_masked_upper_median():
+    # reference uses plst[len/2] after ascending sort (gossipsub.go:1492)
+    v = jnp.asarray([[1.0, 2.0, 3.0, 4.0, 0.0]])
+    mask = jnp.asarray([[True, True, True, True, False]])
+    # n=4 -> index 2 -> value 3.0 (upper median)
+    assert float(median_masked(v, mask)[0]) == 3.0
+    # empty mask -> +inf
+    assert np.isinf(float(median_masked(v, jnp.zeros((1, 5), bool))[0]))
+
+
+def test_pytest_env_has_8_devices():
+    assert len(jax.devices()) == 8
