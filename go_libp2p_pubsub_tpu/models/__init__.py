@@ -1,0 +1,2 @@
+"""Routers — the strategy layer (`PubSubRouter`, pubsub.go:169-198),
+vectorized: floodsub, randomsub, gossipsub."""

@@ -1,0 +1,144 @@
+"""Shared delivery engine: one synchronous message-propagation round.
+
+This is the vectorized core of the reference's hot path (survey §3.2/3.3):
+router.Publish -> per-peer RPC queues -> reader -> validation -> forward.
+All routers share it; they differ only in *which edges carry* a message
+(flood: every topic edge, floodsub.go:76-100; gossipsub: mesh/fanout edges;
+randomsub: a random subset chosen at publish).
+
+Gather-only dataflow (no scatters in the hot loop): each receiver j reads
+its senders' forward sets at nbr[j,k] and applies edge/topic masks. The
+transmit tensor `trans[N, K, W]` (packed words) *is* the round's wire
+traffic; aggregate popcounts of it produce the SendRPC/RecvRPC trace
+counters, and the score engine later consumes it for delivery attribution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops import bitset
+from ..state import Delivery, MsgTable, Net
+from ..trace.events import EV
+
+
+@struct.dataclass
+class RoundInfo:
+    """Per-round delivery observables consumed by tracing + scoring."""
+
+    trans: jax.Array        # [N, K, W] u32 — words transmitted to j on edge k
+    new_words: jax.Array    # [N, W] u32 — first receipts this round
+    new_bits: jax.Array     # [N, M] bool — same, unpacked
+    n_deliver: jax.Array    # i64 — first receipts of valid messages
+    n_reject: jax.Array     # i64 — first receipts of invalid messages
+    n_duplicate: jax.Array  # i64 — arrivals beyond the first per (peer,msg)
+    n_rpc: jax.Array        # i64 — total (edge, msg) transmissions
+
+
+def subscribed_msg_words(net: Net, msgs: MsgTable) -> jax.Array:
+    """[N, W] packed mask: messages whose topic peer n subscribes to."""
+    t = msgs.topic  # [M]
+    sub = jnp.where(t[None, :] >= 0, net.subscribed[:, jnp.clip(t, 0)], False)
+    return bitset.pack(sub)
+
+
+def origin_msg_words(net: Net, msgs: MsgTable) -> jax.Array:
+    """[N, W] packed mask: messages peer n originated (never sent back to the
+    origin — the `pid == peer.ID(msg.GetFrom())` check, floodsub.go:87,
+    gossipsub.go:1007)."""
+    n = net.n_peers
+    onehot = msgs.origin[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
+    return bitset.pack(onehot)
+
+
+def delivery_round(
+    net: Net,
+    msgs: MsgTable,
+    dlv: Delivery,
+    edge_mask: jax.Array,  # [N, K, W] u32: words edge (j,k) may carry j-ward
+    tick: jax.Array,
+    forward_mask: jax.Array | None = None,  # [N, W] extra gate on what gets re-forwarded
+) -> tuple[Delivery, RoundInfo]:
+    """Advance one propagation round: transmit every sender's `fwd` set along
+    permitted edges, dedup against the seen-cache, record first receipts.
+
+    Semantics per receiver j, edge k (sender s = nbr[j,k]):
+      trans = fwd[s] & not-echo(s->j) & edge_mask & not-mine(j)
+    where echo excludes the single edge a message arrived on (the "source"
+    exclusion, floodsub.go:85-86) and not-mine excludes the origin.
+
+    Messages are marked seen whether valid or not (markSeen happens inside
+    validation, validation.go:285-293); only valid ones are re-forwarded
+    (honest behavior — Reject stops propagation, validation.go:309-351).
+    """
+    n, k_slots = net.nbr.shape
+    m = msgs.capacity
+
+    senders = jnp.clip(net.nbr, 0)  # [N,K]; masked below where ~nbr_ok
+
+    # what each sender is forwarding this round: [N, K, W]
+    fwd_gathered = dlv.fwd[senders]
+
+    # echo exclusion: sender s does not send m back on the edge it arrived on.
+    # first_edge[s, m] == rev[j, k] means edge (j,k) is where s got m from.
+    sender_first_edge = dlv.first_edge[senders]  # [N, K, M] i8
+    echo = sender_first_edge == net.rev[..., None].astype(jnp.int8)
+    echo_words = bitset.pack(echo)  # [N, K, W]
+
+    ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    not_mine = ~origin_msg_words(net, msgs)  # [N, W]
+
+    trans = fwd_gathered & ~echo_words & edge_mask & ok_words & not_mine[:, None, :]
+
+    recv_words = bitset.word_or_reduce(trans, axis=1)  # [N, W]
+    new_words = recv_words & ~dlv.have
+    new_bits = bitset.unpack(new_words, m)
+
+    # first-arrival edge: lowest edge slot carrying a new bit
+    trans_bits = bitset.unpack(trans, m)  # [N, K, M]
+    arrival_edge = jnp.argmax(trans_bits, axis=1).astype(jnp.int8)  # [N, M]
+    first_edge = jnp.where(new_bits, arrival_edge, dlv.first_edge)
+    first_round = jnp.where(new_bits, tick, dlv.first_round)
+
+    # forwarding: new receipts of valid messages (honest store-and-forward)
+    valid_words = bitset.pack(msgs.valid)  # [W]
+    fwd_next = new_words & valid_words[None, :]
+    if forward_mask is not None:
+        fwd_next = fwd_next & forward_mask
+
+    dlv = dlv.replace(
+        have=dlv.have | new_words,
+        fwd=fwd_next,
+        first_round=first_round,
+        first_edge=first_edge,
+    )
+
+    n_rpc = bitset.popcount(trans, axis=None).astype(jnp.int32).sum()
+    n_new = bitset.popcount(new_words, axis=None).astype(jnp.int32).sum()
+    n_deliver = bitset.popcount(new_words & valid_words[None, :], axis=None).astype(jnp.int32).sum()
+    info = RoundInfo(
+        trans=trans,
+        new_words=new_words,
+        new_bits=new_bits,
+        n_deliver=n_deliver,
+        n_reject=n_new - n_deliver,
+        n_duplicate=n_rpc - n_new,
+        n_rpc=n_rpc,
+    )
+    return dlv, info
+
+
+def accumulate_round_events(events: jax.Array, info: RoundInfo, n_publish) -> jax.Array:
+    """Fold a round's delivery observables into the cumulative event
+    counters (the EventTracer accounting that trace_test.go:26-195 checks:
+    publish/deliver/duplicate/reject totals plus RPC counts)."""
+    ev = events
+    ev = ev.at[EV.PUBLISH_MESSAGE].add(jnp.asarray(n_publish, jnp.int32))
+    ev = ev.at[EV.DELIVER_MESSAGE].add(info.n_deliver)
+    ev = ev.at[EV.REJECT_MESSAGE].add(info.n_reject)
+    ev = ev.at[EV.DUPLICATE_MESSAGE].add(info.n_duplicate)
+    ev = ev.at[EV.SEND_RPC].add(info.n_rpc)
+    ev = ev.at[EV.RECV_RPC].add(info.n_rpc)
+    return ev

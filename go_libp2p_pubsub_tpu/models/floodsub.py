@@ -1,0 +1,60 @@
+"""FloodSub router, vectorized (floodsub.go, proto /floodsub/1.0.0).
+
+Reference semantics (floodsub.go:76-100 Publish): forward each message to
+every connected peer subscribed to its topic, except the peer it came from
+and the origin. Dedup is the seen-cache. No mesh, no gossip, no scoring.
+
+Vector form: the edge-carry mask is simply "receiver subscribes to the
+topic" — one packed word-mask per receiver, broadcast over its edges; the
+shared delivery engine applies the source/origin exclusions and dedup.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..state import Net, SimState, allocate_publishes
+from .common import accumulate_round_events, delivery_round, subscribed_msg_words
+
+
+def flood_edge_mask(net: Net, msgs) -> jax.Array:
+    """[N, K, W]: every edge may carry everything its *receiver* subscribes
+    to (the sender-side topics-map check of floodsub.go:77-84 seen from the
+    receiving end)."""
+    sub_words = subscribed_msg_words(net, msgs)  # [N, W]
+    return jnp.broadcast_to(sub_words[:, None, :], (net.n_peers, net.max_degree, sub_words.shape[-1]))
+
+
+@functools.partial(jax.jit, donate_argnums=1)
+def floodsub_step(
+    net: Net,
+    state: SimState,
+    pub_origin: jax.Array,  # [P] i32, -1 pad
+    pub_topic: jax.Array,   # [P] i32
+    pub_valid: jax.Array,   # [P] bool
+) -> SimState:
+    """One synchronous round: deliver in-flight messages one hop, then
+    intern this round's publishes (they start propagating next round)."""
+    edge_mask = flood_edge_mask(net, state.msgs)
+    dlv, info = delivery_round(net, state.msgs, state.dlv, edge_mask, state.tick)
+
+    msgs, dlv, _slots, is_pub = allocate_publishes(
+        state.msgs, dlv, state.tick, pub_origin, pub_topic, pub_valid
+    )
+    events = accumulate_round_events(state.events, info, jnp.sum(is_pub.astype(jnp.int32)))
+
+    return state.replace(tick=state.tick + 1, msgs=msgs, dlv=dlv, events=events)
+
+
+def run_rounds(net: Net, state: SimState, n_rounds: int) -> SimState:
+    """Run delivery-only rounds (no new publishes) under lax.scan."""
+    p = jnp.full((1,), -1, jnp.int32)
+
+    def body(s, _):
+        return floodsub_step(net, s, p, p, jnp.zeros((1,), bool)), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_rounds)
+    return state

@@ -1,0 +1,4 @@
+"""Scalar pure-Python oracle nodes — a faithful per-node transcription of
+the reference call stacks (survey §3), used as the golden model for every
+vectorized kernel (survey §4 tier-1 strategy: golden-value equivalence
+tests against a scalar oracle)."""

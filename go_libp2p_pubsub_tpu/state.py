@@ -1,0 +1,223 @@
+"""Device-resident simulation state (struct-of-arrays).
+
+The reference's `PubSub` struct owns all mutable protocol state in Go maps
+(pubsub.go:42-166) mutated by a single event-loop goroutine. Here the same
+state is dense arrays over all N peers at once, advanced by pure jitted
+steps — the TPU-idiomatic equivalent of the single-writer actor (survey §7).
+
+Message identity: message ids are interned to slots in a rotating global
+table of capacity M (survey §7 hard-part (b)); per-peer message sets (the
+seen-cache, pubsub.go:30,146; forward sets) are packed uint32 bitsets over
+those slots. A slot is recycled when the cursor wraps; recycling clears the
+corresponding bit column everywhere, which emulates the reference's 120s
+seen-cache TTL — size M so that slot lifetime (M / publish-rate) exceeds
+both propagation time and the mcache window.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from . import graph as graphlib
+from .ops import bitset
+from .trace.events import zero_counters
+
+
+@struct.dataclass
+class Net:
+    """Static network: topology + subscriptions + identity (survey L0
+    collapsed into arrays; see graph.py for field semantics)."""
+
+    nbr: jax.Array         # [N, K] i32
+    nbr_ok: jax.Array      # [N, K] bool
+    rev: jax.Array         # [N, K] i32
+    outbound: jax.Array    # [N, K] bool
+    subscribed: jax.Array  # [N, T] bool
+    my_topics: jax.Array   # [N, S] i32
+    slot_of: jax.Array     # [N, T] i32
+    ip_group: jax.Array    # [N] i32 (P6 colocation key)
+
+    @classmethod
+    def build(
+        cls,
+        topo: graphlib.Topology,
+        subs: graphlib.Subscriptions,
+        ip_group: np.ndarray | None = None,
+    ) -> "Net":
+        n = topo.n_peers
+        if ip_group is None:
+            ip_group = np.arange(n, dtype=np.int32)  # unique IPs
+        return cls(
+            nbr=jnp.asarray(topo.nbr),
+            nbr_ok=jnp.asarray(topo.nbr_ok),
+            rev=jnp.asarray(topo.rev),
+            outbound=jnp.asarray(topo.outbound),
+            subscribed=jnp.asarray(subs.subscribed),
+            my_topics=jnp.asarray(subs.my_topics),
+            slot_of=jnp.asarray(subs.slot_of),
+            ip_group=jnp.asarray(ip_group),
+        )
+
+    @property
+    def n_peers(self) -> int:
+        return self.nbr.shape[0]
+
+    @property
+    def max_degree(self) -> int:
+        return self.nbr.shape[1]
+
+    @property
+    def n_topics(self) -> int:
+        return self.subscribed.shape[1]
+
+    @property
+    def n_slots(self) -> int:
+        return self.my_topics.shape[1]
+
+
+@struct.dataclass
+class MsgTable:
+    """Rotating global message table (the interned message-id space)."""
+
+    topic: jax.Array   # [M] i32, -1 = never used
+    origin: jax.Array  # [M] i32
+    birth: jax.Array   # [M] i32 round of publish, -1 = never used
+    valid: jax.Array   # [M] bool — validation verdict (adversary injection)
+    cursor: jax.Array  # i32 — next slot to allocate (monotonic, mod M)
+
+    @classmethod
+    def empty(cls, m: int) -> "MsgTable":
+        return cls(
+            topic=jnp.full((m,), -1, jnp.int32),
+            origin=jnp.full((m,), -1, jnp.int32),
+            birth=jnp.full((m,), -1, jnp.int32),
+            valid=jnp.zeros((m,), bool),
+            cursor=jnp.int32(0),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.topic.shape[0]
+
+
+@struct.dataclass
+class Delivery:
+    """Per-peer message-delivery state.
+
+    have        — the seen-cache (pubsub.go:30,146): marked on first receipt
+                  whether or not validation later rejects (markSeen happens
+                  inside validation, validation.go:285-293)
+    fwd         — messages this peer will transmit next round (receipts
+                  accepted for forwarding, or own publishes)
+    first_round — round of first receipt, -1 never (propagation CDF +
+                  delivery-window attribution)
+    first_edge  — neighbor slot the first copy arrived on, -1 = published
+                  locally (the "source" exclusion, floodsub.go:85-88)
+    """
+
+    have: jax.Array         # [N, W] u32
+    fwd: jax.Array          # [N, W] u32
+    first_round: jax.Array  # [N, M] i32
+    first_edge: jax.Array   # [N, M] i8
+
+    @classmethod
+    def empty(cls, n: int, m: int) -> "Delivery":
+        w = bitset.n_words(m)
+        return cls(
+            have=jnp.zeros((n, w), jnp.uint32),
+            fwd=jnp.zeros((n, w), jnp.uint32),
+            first_round=jnp.full((n, m), -1, jnp.int32),
+            first_edge=jnp.full((n, m), -1, jnp.int8),
+        )
+
+
+@struct.dataclass
+class SimState:
+    """Carry for the jitted step loop (router-agnostic core)."""
+
+    tick: jax.Array      # i32 current round
+    key: jax.Array       # PRNG key
+    msgs: MsgTable
+    dlv: Delivery
+    events: jax.Array    # [N_EVENTS] i64 cumulative trace counters
+
+    @classmethod
+    def init(cls, n_peers: int, msg_slots: int, seed: int = 0) -> "SimState":
+        return cls(
+            tick=jnp.int32(0),
+            key=jax.random.key(seed),
+            msgs=MsgTable.empty(msg_slots),
+            dlv=Delivery.empty(n_peers, msg_slots),
+            events=zero_counters(),
+        )
+
+
+# ---------------------------------------------------------------------------
+# publish-slot allocation
+
+
+def allocate_publishes(
+    msgs: MsgTable,
+    dlv: Delivery,
+    tick: jax.Array,
+    pub_origin: jax.Array,  # [P] i32, -1 pad
+    pub_topic: jax.Array,   # [P] i32
+    pub_valid: jax.Array,   # [P] bool
+):
+    """Intern this round's publishes into table slots (rotating cursor),
+    clearing recycled slots' bit columns everywhere.
+
+    Returns (msgs, dlv, slots, is_pub): `slots[P]` the assigned slot per
+    publish (undefined where ~is_pub).
+    """
+    m = msgs.capacity
+    is_pub = pub_origin >= 0
+    pos = jnp.cumsum(is_pub.astype(jnp.int32)) - 1
+    slots = (msgs.cursor + pos) % m
+    count = jnp.sum(is_pub.astype(jnp.int32))
+
+    # scatter index M (out of bounds, mode=drop) for padding entries
+    sidx = jnp.where(is_pub, slots, m)
+
+    # clear recycled slots: bit columns in have/fwd, rows in first_round/edge
+    reused = jnp.zeros((m,), bool).at[sidx].set(True, mode="drop")
+    reused_words = bitset.pack(reused)
+    keep = ~reused_words
+    dlv = dlv.replace(
+        have=dlv.have & keep[None, :],
+        fwd=dlv.fwd & keep[None, :],
+        first_round=jnp.where(reused[None, :], -1, dlv.first_round),
+        first_edge=jnp.where(reused[None, :], jnp.int8(-1), dlv.first_edge),
+    )
+
+    msgs = msgs.replace(
+        topic=msgs.topic.at[sidx].set(pub_topic, mode="drop"),
+        origin=msgs.origin.at[sidx].set(pub_origin, mode="drop"),
+        birth=msgs.birth.at[sidx].set(jnp.broadcast_to(tick, pub_topic.shape), mode="drop"),
+        valid=msgs.valid.at[sidx].set(pub_valid, mode="drop"),
+        cursor=msgs.cursor + count,
+    )
+
+    # origin peers: mark seen + schedule forwarding + record first_round
+    pub_bits = jnp.zeros((dlv.have.shape[0], m), bool).at[pub_origin, sidx].set(
+        True, mode="drop"
+    )
+    pub_words = bitset.pack(pub_bits)
+    dlv = dlv.replace(
+        have=dlv.have | pub_words,
+        fwd=dlv.fwd | pub_words,
+        first_round=jnp.where(pub_bits, jnp.broadcast_to(tick, pub_bits.shape), dlv.first_round),
+        # first_edge stays -1 for local publishes
+    )
+    return msgs, dlv, slots, is_pub
+
+
+def hops(msgs: MsgTable, dlv: Delivery) -> jax.Array:
+    """Propagation hop count per (peer, msg): 0 at the origin, k for a peer
+    first reached k hops later; -1 if never received. A message published at
+    round r reaches 1-hop neighbors in round r+1."""
+    h = dlv.first_round - msgs.birth[None, :]
+    return jnp.where((dlv.first_round >= 0) & (msgs.birth >= 0)[None, :], h, -1)

@@ -1,0 +1,1 @@
+from .events import EV, N_EVENTS, event_name, zero_counters  # noqa: F401

@@ -1,0 +1,47 @@
+"""Trace event schema — the 13 protocol event types of pb/trace.proto:5-150
+(dispatched by trace.go:63-530), as integer codes for on-device counting.
+
+The accelerated loop counts events in a dense int64 vector per round (and,
+for per-peer analysis, per-peer counters); the host drain (trace/drain.py)
+converts them to trace-schema records so tracestat-style accounting is
+unchanged (survey §5: "the TPU build must keep emitting this exact trace.pb
+schema").
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+
+class EV(enum.IntEnum):
+    # pb/trace.proto TraceEvent.Type (trace.proto:9-24)
+    PUBLISH_MESSAGE = 0
+    REJECT_MESSAGE = 1
+    DUPLICATE_MESSAGE = 2
+    DELIVER_MESSAGE = 3
+    ADD_PEER = 4
+    REMOVE_PEER = 5
+    RECV_RPC = 6
+    SEND_RPC = 7
+    DROP_RPC = 8
+    JOIN = 9
+    LEAVE = 10
+    GRAFT = 11
+    PRUNE = 12
+
+
+N_EVENTS = len(EV)
+
+_NAMES = {e: e.name for e in EV}
+
+
+def event_name(code: int) -> str:
+    return _NAMES[EV(code)]
+
+
+def zero_counters() -> jnp.ndarray:
+    # int32 on device (x64 is disabled by default in JAX); the host drain
+    # accumulates into Python ints — drain at least every ~1e9 events
+    return jnp.zeros((N_EVENTS,), dtype=jnp.int32)

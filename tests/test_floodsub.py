@@ -1,0 +1,170 @@
+"""FloodSub end-to-end slice tests.
+
+Tier-2 analogue of TestBasicFloodsub (floodsub_test.go:129-169): N hosts,
+publish, assert everyone subscribed receives. Tier-1 analogue: exact
+golden equivalence of the vectorized engine against the scalar oracle on
+random graphs (floodsub is deterministic, so bit-for-bit)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step, run_rounds
+from go_libp2p_pubsub_tpu.oracle.floodsub import OracleFloodSub
+from go_libp2p_pubsub_tpu.ops import bitset
+from go_libp2p_pubsub_tpu.state import Net, SimState, hops
+from go_libp2p_pubsub_tpu.trace.events import EV, N_EVENTS
+
+
+def _mk(n, d=None, n_topics=1, msg_slots=32, seed=0, all_topics=True):
+    topo = graph.connect_all(n) if d is None else graph.random_connect(n, d, seed=seed)
+    subs = (
+        graph.subscribe_all(n, n_topics)
+        if all_topics
+        else graph.subscribe_random(n, n_topics, 1, seed=seed)
+    )
+    net = Net.build(topo, subs)
+    state = SimState.init(n, msg_slots, seed=seed)
+    return topo, subs, net, state
+
+
+def _pub(origins, topics, valids, p=4):
+    po = np.full(p, -1, np.int32)
+    pt = np.full(p, -1, np.int32)
+    pv = np.zeros(p, bool)
+    for i, (o, t, v) in enumerate(zip(origins, topics, valids)):
+        po[i], pt[i], pv[i] = o, t, v
+    return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+
+def _no_pub(p=4):
+    return _pub([], [], [], p)
+
+
+def test_basic_floodsub_all_receive():
+    # 20 hosts, complete graph, one topic: publish from host 0 -> everyone
+    # has it after one transmit round (assertReceive, floodsub_test.go:117)
+    _, _, net, state = _mk(20)
+    state = floodsub_step(net, state, *_pub([0], [0], [True]))
+    state = floodsub_step(net, state, *_no_pub())
+    have = np.asarray(bitset.unpack(state.dlv.have, 32))
+    assert have[:, 0].all()
+    ev = np.asarray(state.events)
+    assert ev[EV.PUBLISH_MESSAGE] == 1
+    assert ev[EV.DELIVER_MESSAGE] == 19  # everyone but origin
+    assert ev[EV.REJECT_MESSAGE] == 0
+
+
+def test_sparse_propagation_multihop():
+    # sparse graph: message floods over multiple hops to every subscriber
+    topo, _, net, state = _mk(50, d=3, seed=2)
+    state = floodsub_step(net, state, *_pub([7], [0], [True]))
+    state = run_rounds(net, state, 12)
+    have = np.asarray(bitset.unpack(state.dlv.have, 32))
+    assert have[:, 0].all(), "flood must reach all peers on a connected graph"
+    h = np.asarray(hops(state.msgs, state.dlv))[:, 0]
+    assert h[7] == 0
+    assert (h[np.arange(50) != 7] >= 1).all()
+    # some peer needs >1 hop on a sparse graph
+    assert h.max() > 1
+
+
+def test_invalid_message_not_forwarded():
+    # invalid message: direct neighbors of origin see+reject it; it never
+    # propagates further (Reject stops the pipeline, validation.go:309-351)
+    topo, _, net, state = _mk(30, d=3, seed=4)
+    state = floodsub_step(net, state, *_pub([0], [0], [False]))
+    state = run_rounds(net, state, 8)
+    have = np.asarray(bitset.unpack(state.dlv.have, 32))[:, 0]
+    nbrs = set(topo.nbr[0][topo.nbr_ok[0]].tolist())
+    got = set(np.nonzero(have)[0].tolist()) - {0}
+    assert got == nbrs, "invalid msg must stop at first hop"
+    ev = np.asarray(state.events)
+    assert ev[EV.REJECT_MESSAGE] == len(nbrs)
+    assert ev[EV.DELIVER_MESSAGE] == 0
+
+
+def test_topic_isolation():
+    # peers not subscribed to the topic never receive it
+    n = 24
+    topo = graph.random_connect(n, 4, seed=5)
+    subs = graph.subscribe_random(n, n_topics=2, topics_per_peer=1, seed=5)
+    net = Net.build(topo, subs)
+    state = SimState.init(n, 32, seed=0)
+    origin = int(np.nonzero(subs.subscribed[:, 0])[0][0])
+    state = floodsub_step(net, state, *_pub([origin], [0], [True]))
+    state = run_rounds(net, state, 10)
+    have = np.asarray(bitset.unpack(state.dlv.have, 32))[:, 0]
+    non_subs = ~subs.subscribed[:, 0]
+    assert not have[non_subs].any()
+
+
+def _run_oracle_equivalence(n, d, n_topics, msg_slots, schedule, seed):
+    topo = graph.random_connect(n, d, seed=seed)
+    subs = graph.subscribe_random(n, n_topics, max(1, n_topics // 2), seed=seed)
+    net = Net.build(topo, subs)
+    state = SimState.init(n, msg_slots, seed=seed)
+    oracle = OracleFloodSub(topo, subs, msg_slots=msg_slots)
+
+    for pubs in schedule:
+        state = floodsub_step(net, state, *_pub(*zip(*pubs) if pubs else ([], [], [])))
+        oracle.step(pubs)
+
+    m = msg_slots
+    have = np.asarray(bitset.unpack(state.dlv.have, m))
+    fr = np.asarray(state.dlv.first_round)
+    fe = np.asarray(state.dlv.first_edge)
+    for i in range(n):
+        assert set(np.nonzero(have[i])[0].tolist()) == oracle.seen[i], f"seen mismatch peer {i}"
+        for slot in oracle.seen[i]:
+            assert fr[i, slot] == oracle.first_round[(i, slot)], (i, slot)
+            assert fe[i, slot] == oracle.first_edge[(i, slot)], (i, slot)
+    ev = np.asarray(state.events)
+    for e in range(N_EVENTS):
+        assert ev[e] == oracle.events[e], f"event {EV(e).name}: {ev[e]} vs {oracle.events[e]}"
+
+
+def test_oracle_equivalence_single_topic():
+    rng = np.random.default_rng(0)
+    n = 40
+    schedule = []
+    for r in range(15):
+        pubs = []
+        if r % 3 == 0:
+            pubs.append((int(rng.integers(n)), 0, True))
+        if r % 5 == 0:
+            pubs.append((int(rng.integers(n)), 0, bool(rng.random() < 0.5)))
+        schedule.append(pubs)
+    _run_oracle_equivalence(n, d=3, n_topics=1, msg_slots=64, schedule=schedule, seed=1)
+
+
+def test_oracle_equivalence_multi_topic_with_recycling():
+    # msg_slots=8 forces slot recycling mid-run; oracle and engine must
+    # stay bit-identical through recycles
+    rng = np.random.default_rng(7)
+    n = 25
+    schedule = []
+    for r in range(20):
+        pubs = [(int(rng.integers(n)), int(rng.integers(4)), bool(rng.random() < 0.8))]
+        schedule.append(pubs)
+    _run_oracle_equivalence(n, d=4, n_topics=4, msg_slots=8, schedule=schedule, seed=3)
+
+
+def test_hops_cdf_vs_oracle():
+    # propagation-latency (hops) distribution matches the oracle exactly
+    n = 60
+    topo = graph.random_connect(n, 3, seed=9)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    state = SimState.init(n, 32, seed=0)
+    oracle = OracleFloodSub(topo, subs, msg_slots=32)
+    pubs0 = [(5, 0, True)]
+    state = floodsub_step(net, state, *_pub(*zip(*pubs0)))
+    oracle.step(pubs0)
+    for _ in range(15):
+        state = floodsub_step(net, state, *_no_pub())
+        oracle.step([])
+    h = np.asarray(hops(state.msgs, state.dlv))[:, 0]
+    oh = np.array([oracle.first_round.get((i, 0), -1) for i in range(n)])
+    oh = np.where(oh >= 0, oh - 0, -1)  # birth = 0
+    np.testing.assert_array_equal(h, oh)
