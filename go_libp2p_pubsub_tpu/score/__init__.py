@@ -1,0 +1,14 @@
+"""The GossipSub v1.1 security plane, vectorized: peer-score engine
+(score.go / score_params.go), peer gater (peer_gater.go), IWANT-promise
+tracking (gossip_tracer.go)."""
+
+from .engine import (  # noqa: F401
+    ScoreState,
+    TopicParamsArrays,
+    compute_scores,
+    ip_colocation_surplus_sq,
+    on_deliveries,
+    on_graft,
+    on_prune,
+    refresh_scores,
+)

@@ -1,0 +1,339 @@
+"""Batched peer-score engine — the v1.1 security plane (score.go:1-1074).
+
+Every peer n scores each of its neighbor slots k; topic-local counters live
+at [N, S, K] (S = topic slots, survey topic-slot compression). The weighted
+P1..P7 sum (score.go:258-335), the decay pass (refreshScores,
+score.go:497-558) and the delivery-attribution updates (score.go:892-974)
+are all elementwise/batched-matmul passes — the "embarrassingly parallel
+elementwise pass" the survey §2 checklist names.
+
+Time is integer ticks; durations are converted with ticks_for at
+TopicParamsArrays build time. The P3 "mesh delivery window" becomes
+window_rounds (default 0: only same-round-as-validation duplicates count,
+matching the reference's 10ms window vs 1s heartbeat scale — survey §7
+hard-part (e)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import PeerScoreParams, ticks_for
+from ..state import Net
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicParamsArrays:
+    """Per-topic score params as dense [T] numpy arrays (row t zeroed when
+    topic t is unscored — unscored topics contribute nothing and track no
+    counters, score.go:269-273, 881-884)."""
+
+    scored: np.ndarray        # [T] bool
+    topic_weight: np.ndarray  # [T] f32
+    w1: np.ndarray
+    quantum_ticks: np.ndarray  # [T] f32 (>=1)
+    cap1: np.ndarray
+    w2: np.ndarray
+    decay2: np.ndarray
+    cap2: np.ndarray
+    w3: np.ndarray
+    decay3: np.ndarray
+    cap3: np.ndarray
+    thr3: np.ndarray
+    window_rounds: np.ndarray     # [T] i32
+    activation_ticks: np.ndarray  # [T] i32
+    w3b: np.ndarray
+    decay3b: np.ndarray
+    w4: np.ndarray
+    decay4: np.ndarray
+
+    @classmethod
+    def build(cls, params: PeerScoreParams, n_topics: int, heartbeat_interval: float = 1.0):
+        def arr(fn, dtype=np.float32):
+            out = np.zeros((n_topics,), dtype)
+            for t, tp in params.topics.items():
+                if 0 <= t < n_topics:
+                    out[t] = fn(tp)
+            return out
+
+        scored = np.zeros((n_topics,), bool)
+        for t in params.topics:
+            if 0 <= t < n_topics:
+                scored[t] = True
+        return cls(
+            scored=scored,
+            topic_weight=arr(lambda p: p.topic_weight),
+            w1=arr(lambda p: p.time_in_mesh_weight),
+            quantum_ticks=arr(lambda p: max(1, ticks_for(p.time_in_mesh_quantum, heartbeat_interval))),
+            cap1=arr(lambda p: p.time_in_mesh_cap),
+            w2=arr(lambda p: p.first_message_deliveries_weight),
+            decay2=arr(lambda p: p.first_message_deliveries_decay),
+            cap2=arr(lambda p: p.first_message_deliveries_cap),
+            w3=arr(lambda p: p.mesh_message_deliveries_weight),
+            decay3=arr(lambda p: p.mesh_message_deliveries_decay),
+            cap3=arr(lambda p: p.mesh_message_deliveries_cap),
+            thr3=arr(lambda p: p.mesh_message_deliveries_threshold),
+            window_rounds=arr(
+                lambda p: ticks_for(p.mesh_message_deliveries_window, heartbeat_interval) - 1
+                if p.mesh_message_deliveries_window >= heartbeat_interval
+                else 0,
+                np.int32,
+            ),
+            activation_ticks=arr(
+                lambda p: ticks_for(p.mesh_message_deliveries_activation, heartbeat_interval), np.int32
+            ),
+            w3b=arr(lambda p: p.mesh_failure_penalty_weight),
+            decay3b=arr(lambda p: p.mesh_failure_penalty_decay),
+            w4=arr(lambda p: p.invalid_message_deliveries_weight),
+            decay4=arr(lambda p: p.invalid_message_deliveries_decay),
+        )
+
+    def gather(self, my_topics: jax.Array):
+        """Gather all per-topic arrays to per-(peer, slot) [N, S] views;
+        slots with no topic (-1) come out zeroed/unscored."""
+        t = jnp.clip(my_topics, 0)
+        live = my_topics >= 0
+
+        def g(a, fill=0):
+            v = jnp.asarray(a)[t]
+            return jnp.where(live, v, jnp.asarray(fill, v.dtype))
+
+        return {f.name: g(getattr(self, f.name)) for f in dataclasses.fields(self)}
+
+
+@struct.dataclass
+class ScoreState:
+    """Counters the score is computed from (peerStats/topicStats,
+    score.go:17-62), per (peer, topic-slot, neighbor-slot)."""
+
+    fmd: jax.Array          # [N,S,K] f32 firstMessageDeliveries
+    mmd: jax.Array          # [N,S,K] f32 meshMessageDeliveries
+    mfp: jax.Array          # [N,S,K] f32 meshFailurePenalty (P3b, sticky)
+    imd: jax.Array          # [N,S,K] f32 invalidMessageDeliveries
+    graft_tick: jax.Array   # [N,S,K] i32 tick of last graft (-1 = never)
+    mesh_time: jax.Array    # [N,S,K] i32 ticks in mesh (updated on refresh)
+    mmd_active: jax.Array   # [N,S,K] bool P3 activation latch
+    bp: jax.Array           # [N,K]  f32 behaviourPenalty (P7)
+
+    @classmethod
+    def empty(cls, n: int, s: int, k: int) -> "ScoreState":
+        f = lambda: jnp.zeros((n, s, k), jnp.float32)
+        return cls(
+            fmd=f(), mmd=f(), mfp=f(), imd=f(),
+            graft_tick=jnp.full((n, s, k), -1, jnp.int32),
+            mesh_time=jnp.zeros((n, s, k), jnp.int32),
+            mmd_active=jnp.zeros((n, s, k), bool),
+            bp=jnp.zeros((n, k), jnp.float32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# P6: IP colocation
+
+
+def ip_colocation_surplus_sq(net: Net, threshold: int, whitelist=()) -> jax.Array:
+    """[N, K] f32: (peersInIP - threshold)^2 where the count of my connected
+    neighbors sharing neighbor k's ip-group exceeds the threshold
+    (score.go:337-381). Static for a static topology — precompute once."""
+    groups = net.ip_group[jnp.clip(net.nbr, 0)]  # [N,K]
+    same = (groups[:, :, None] == groups[:, None, :]) & net.nbr_ok[:, None, :]
+    count = jnp.sum(same.astype(jnp.int32), axis=-1)  # [N,K]
+    surplus = (count - threshold).astype(jnp.float32)
+    p6 = jnp.where(count > threshold, surplus * surplus, 0.0)
+    if len(whitelist):
+        wl = jnp.isin(groups, jnp.asarray(list(whitelist), dtype=groups.dtype))
+        p6 = jnp.where(wl, 0.0, p6)
+    return jnp.where(net.nbr_ok, p6, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# the score function (score.go:258-335)
+
+
+def compute_scores(
+    st: ScoreState,
+    in_mesh: jax.Array,   # [N,S,K] bool — router mesh membership
+    tp: dict,             # gathered TopicParamsArrays ([N,S] views)
+    params: PeerScoreParams,
+    p6: jax.Array,        # [N,K] precomputed colocation surplus^2
+    app_score: jax.Array,  # [N] per-peer P5 value (gathered at nbr)
+    net: Net,
+) -> jax.Array:
+    """[N, K] f32 — peer n's score of neighbor slot k."""
+    e = lambda a: a[..., None]  # [N,S] -> [N,S,1] broadcast over K
+
+    # P1: time in mesh (score.go:279-285)
+    p1 = jnp.minimum(st.mesh_time.astype(jnp.float32) / e(tp["quantum_ticks"]), e(tp["cap1"]))
+    topic = jnp.where(in_mesh, p1 * e(tp["w1"]), 0.0)
+
+    # P2 (score.go:288-289)
+    topic = topic + st.fmd * e(tp["w2"])
+
+    # P3: deficit^2 when active and below threshold (score.go:292-298)
+    deficit = e(tp["thr3"]) - st.mmd
+    p3 = jnp.where(st.mmd_active & (deficit > 0), deficit * deficit, 0.0)
+    topic = topic + p3 * e(tp["w3"])
+
+    # P3b + P4 (score.go:302-308)
+    topic = topic + st.mfp * e(tp["w3b"])
+    topic = topic + st.imd * st.imd * e(tp["w4"])
+
+    score = jnp.sum(topic * e(tp["topic_weight"]), axis=1)  # [N,K]
+
+    # topic score cap (score.go:315-317)
+    if params.topic_score_cap > 0:
+        score = jnp.minimum(score, params.topic_score_cap)
+
+    # P5 (score.go:320-321)
+    score = score + app_score[jnp.clip(net.nbr, 0)] * params.app_specific_weight
+
+    # P6 (score.go:324-325)
+    score = score + p6 * params.ip_colocation_factor_weight
+
+    # P7 (score.go:328-332)
+    excess = st.bp - params.behaviour_penalty_threshold
+    p7 = jnp.where(excess > 0, excess * excess, 0.0)
+    score = score + p7 * params.behaviour_penalty_weight
+
+    return jnp.where(net.nbr_ok, score, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# decay pass (refreshScores, score.go:497-558)
+
+
+def refresh_scores(st: ScoreState, in_mesh: jax.Array, tick, tp: dict, params: PeerScoreParams) -> ScoreState:
+    dtz = params.decay_to_zero
+    e = lambda a: a[..., None]
+
+    def dec(x, d):
+        y = x * d
+        return jnp.where(y < dtz, 0.0, y)
+
+    fmd = dec(st.fmd, e(tp["decay2"]))
+    mmd = dec(st.mmd, e(tp["decay3"]))
+    mfp = dec(st.mfp, e(tp["decay3b"]))
+    imd = dec(st.imd, e(tp["decay4"]))
+
+    # mesh time + P3 activation (score.go:543-549)
+    mesh_time = jnp.where(in_mesh, tick - st.graft_tick, st.mesh_time)
+    active = st.mmd_active | (in_mesh & (mesh_time > e(tp["activation_ticks"])))
+
+    bp = st.bp * params.behaviour_penalty_decay
+    bp = jnp.where(bp < dtz, 0.0, bp)
+
+    return st.replace(fmd=fmd, mmd=mmd, mfp=mfp, imd=imd, mesh_time=mesh_time, mmd_active=active, bp=bp)
+
+
+# ---------------------------------------------------------------------------
+# mesh membership transitions (Graft/Prune tracer hooks, score.go:642-684)
+
+
+def on_graft(st: ScoreState, graft_mask: jax.Array, tick) -> ScoreState:
+    """graft_mask [N,S,K]: newly grafted edges. Resets mesh time and the P3
+    activation latch (score.go:642-660)."""
+    return st.replace(
+        graft_tick=jnp.where(graft_mask, tick, st.graft_tick),
+        mesh_time=jnp.where(graft_mask, 0, st.mesh_time),
+        mmd_active=jnp.where(graft_mask, False, st.mmd_active),
+    )
+
+
+def on_prune(st: ScoreState, prune_mask: jax.Array, tp: dict) -> ScoreState:
+    """prune_mask [N,S,K]: edges leaving the mesh. Applies the sticky mesh
+    failure penalty when pruned while active and below threshold
+    (score.go:662-684)."""
+    e = lambda a: a[..., None]
+    deficit = e(tp["thr3"]) - st.mmd
+    add = jnp.where(prune_mask & st.mmd_active & (deficit > 0), deficit * deficit, 0.0)
+    return st.replace(mfp=st.mfp + add)
+
+
+# ---------------------------------------------------------------------------
+# delivery attribution (score.go:892-974), consuming the round's transmit
+# tensor
+
+
+def on_deliveries(
+    st: ScoreState,
+    net: Net,
+    in_mesh: jax.Array,       # [N,S,K] bool
+    tp: dict,
+    arrivals: jax.Array,      # [N,K,M] bool — this round's per-edge receipts
+    new_bits: jax.Array,      # [N,M] bool — first receipts this round
+    first_edge: jax.Array,    # [N,M] i8 — arrival edge of the first copy
+    first_round: jax.Array,   # [N,M] i32 — validation round of each msg
+    msg_topic: jax.Array,     # [M] i32
+    msg_valid: jax.Array,     # [M] bool
+    tick,
+    window_rounds_t: jax.Array,  # [T] i32 — per-topic P3 window (tpa.window_rounds)
+) -> ScoreState:
+    """Fold one delivery round into the counters.
+
+    * first receipt of a valid msg: firstMessageDeliveries +1 (capped) on the
+      first-arrival edge; meshMessageDeliveries +1 (capped) if that edge is
+      in the mesh (markFirstMessageDelivery, score.go:912-939)
+    * other same-round arrivals count as near-first mesh deliveries
+      (DeliverMessage's drec.peers loop, score.go:712-718), and later
+      duplicates within the window also count (markDuplicateMessageDelivery,
+      score.go:944-974)
+    * every arrival of an invalid msg: invalidMessageDeliveries +1
+      (markInvalidMessageDelivery via RejectMessage/DuplicateMessage,
+      score.go:776-782, 811-813)
+
+    All three are (K x M) @ (M x S) per-peer contractions: arrivals against
+    the per-peer message-topic-slot onehot — MXU work, not scatter work.
+    """
+    n, s_slots = net.my_topics.shape
+    m = msg_topic.shape[0]
+
+    # per-peer msg -> topic-slot onehot [N, M, S]
+    t = jnp.clip(msg_topic, 0)
+    slot = jnp.where(msg_topic[None, :] >= 0, net.slot_of[:, t], -1)  # [N,M]
+    onehot = (slot[:, :, None] == jnp.arange(s_slots)[None, None, :]) & (slot[:, :, None] >= 0)
+    onehot_f = onehot.astype(jnp.float32)
+
+    def contract(edge_msg_mask):  # [N,K,M] bool -> [N,S,K] f32 counts
+        return jnp.einsum("nkm,nms->nsk", edge_msg_mask.astype(jnp.float32), onehot_f)
+
+    valid_b = msg_valid[None, :]  # [1,M]
+
+    # -- P2/P3 credit for valid messages ------------------------------------
+    # first-arrival edge mask per (n,k,m)
+    is_first_edge = (
+        first_edge[:, None, :] == jnp.arange(net.max_degree, dtype=jnp.int8)[None, :, None]
+    )
+    first_arrival = arrivals & is_first_edge & new_bits[:, None, :] & valid_b[:, None, :]
+    fmd_inc = contract(first_arrival)
+    e = lambda a: a[..., None]
+    fmd = jnp.minimum(st.fmd + fmd_inc, e(tp["cap2"]))
+
+    # mesh delivery credit: first arrivals + near-first (same round) + later
+    # duplicates within the window; only on mesh edges, only valid msgs
+    msg_window = window_rounds_t[t]  # [M] per-message window in rounds
+    within = (tick - first_round) <= msg_window[None, :]  # [N,M]
+    mesh_credit = arrivals & valid_b[:, None, :] & within[:, None, :]
+    mmd_inc = contract(mesh_credit) * in_mesh.astype(jnp.float32)
+    mmd = jnp.minimum(st.mmd + mmd_inc, e(tp["cap3"]))
+
+    # -- P4 penalty for invalid messages ------------------------------------
+    invalid_arrival = arrivals & ~valid_b[:, None, :]
+    imd = st.imd + contract(invalid_arrival)
+
+    # unscored slots track nothing (getTopicStats, score.go:881-884)
+    scored = e(tp["scored"])
+    return st.replace(
+        fmd=jnp.where(scored, fmd, st.fmd),
+        mmd=jnp.where(scored, mmd, st.mmd),
+        imd=jnp.where(scored, imd, st.imd),
+    )
+
+
+def add_penalties(st: ScoreState, counts: jax.Array) -> ScoreState:
+    """behaviourPenalty += counts [N,K] (AddPenalty, score.go:384-398)."""
+    return st.replace(bp=st.bp + counts.astype(jnp.float32))

@@ -1,0 +1,391 @@
+"""Score engine golden tests — the score_test.go scenario matrix (P1..P7,
+caps, decay, activation, sticky failure) against the scalar oracle."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import PeerScoreParams, TopicScoreParams
+from go_libp2p_pubsub_tpu.oracle.score import OracleScore
+from go_libp2p_pubsub_tpu.score import (
+    ScoreState,
+    TopicParamsArrays,
+    compute_scores,
+    ip_colocation_surplus_sq,
+    on_deliveries,
+    on_graft,
+    on_prune,
+    refresh_scores,
+)
+from go_libp2p_pubsub_tpu.score.engine import add_penalties
+from go_libp2p_pubsub_tpu.state import Net
+
+
+def star_net(n_leaves=6, n_topics=1, ip_group=None):
+    """Node 0 connected to 1..n_leaves (observer pattern)."""
+    dialed = [set(range(1, n_leaves + 1))] + [set() for _ in range(n_leaves)]
+    topo = graph._from_edge_lists(n_leaves + 1, dialed, None)
+    subs = graph.subscribe_all(n_leaves + 1, n_topics)
+    return topo, Net.build(topo, subs, ip_group)
+
+
+def mk_params(n_topics=1, **topic_kw):
+    base = dict(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.0,
+        first_message_deliveries_weight=0.0,
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+        invalid_message_deliveries_weight=0.0,
+    )
+    base.update(topic_kw)
+    tp = TopicScoreParams(**base)
+    return PeerScoreParams(
+        topics={t: tp for t in range(n_topics)},
+        skip_app_specific=True,
+    )
+
+
+class Harness:
+    """Drives the vectorized engine and the scalar oracle in lockstep for
+    observer node 0 of a star topology."""
+
+    def __init__(self, params, n_leaves=6, n_topics=1, m=16, ip_group=None):
+        self.params = params
+        self.topo, self.net = star_net(n_leaves, n_topics, ip_group)
+        n = n_leaves + 1
+        s = self.net.n_slots
+        k = self.net.max_degree
+        self.n, self.s, self.k, self.m = n, s, k, m
+        self.tpa = TopicParamsArrays.build(params, n_topics)
+        self.tp = self.tpa.gather(self.net.my_topics)
+        self.st = ScoreState.empty(n, s, k)
+        self.in_mesh = jnp.zeros((n, s, k), bool)
+        self.oracle = OracleScore(params)
+        self.p6 = ip_colocation_surplus_sq(self.net, params.ip_colocation_factor_threshold)
+        self.msg_topic = np.full(m, -1, np.int32)
+        self.msg_valid = np.zeros(m, bool)
+        self.first_round = np.full((n, m), -1, np.int32)
+        self.first_edge = np.full((n, m), -1, np.int8)
+        self.next_slot = 0
+
+    def leaf_edge(self, leaf):
+        # observer 0's edge slot for leaf peer id
+        return int(np.nonzero(self.topo.nbr[0] == leaf)[0][0])
+
+    def graft(self, leaf, topic, tick):
+        k = self.leaf_edge(leaf)
+        mask = np.zeros((self.n, self.s, self.k), bool)
+        mask[0, topic, k] = True
+        self.in_mesh = self.in_mesh | jnp.asarray(mask)
+        self.st = on_graft(self.st, jnp.asarray(mask), tick)
+        self.oracle.graft(leaf, topic, tick)
+
+    def prune(self, leaf, topic):
+        k = self.leaf_edge(leaf)
+        mask = np.zeros((self.n, self.s, self.k), bool)
+        mask[0, topic, k] = True
+        self.st = on_prune(self.st, jnp.asarray(mask), self.tp)
+        self.in_mesh = self.in_mesh & ~jnp.asarray(mask)
+        self.oracle.prune(leaf, topic)
+
+    def deliver_round(self, tick, deliveries):
+        """deliveries: list of (leaf, topic, valid, is_new).
+        All listed arrivals happen this round at node 0."""
+        arrivals = np.zeros((self.n, self.k, self.m), bool)
+        new_bits = np.zeros((self.n, self.m), bool)
+        for leaf, topic, valid, is_new in deliveries:
+            slot = self.next_slot
+            self.next_slot = (self.next_slot + 1) % self.m
+            self.msg_topic[slot] = topic
+            self.msg_valid[slot] = valid
+            ke = self.leaf_edge(leaf)
+            arrivals[0, ke, slot] = True
+            if is_new:
+                new_bits[0, slot] = True
+                self.first_round[0, slot] = tick
+                self.first_edge[0, slot] = ke
+                if valid:
+                    self.oracle.first_delivery(leaf, topic)
+                else:
+                    self.oracle.invalid_delivery(leaf, topic)
+            else:
+                if valid:
+                    self.oracle.duplicate_delivery(leaf, topic, in_window=True)
+                else:
+                    self.oracle.invalid_delivery(leaf, topic)
+        self.st = on_deliveries(
+            self.st,
+            self.net,
+            self.in_mesh,
+            self.tp,
+            jnp.asarray(arrivals),
+            jnp.asarray(new_bits),
+            jnp.asarray(self.first_edge),
+            jnp.asarray(self.first_round),
+            jnp.asarray(self.msg_topic),
+            jnp.asarray(self.msg_valid),
+            tick,
+            jnp.asarray(self.tpa.window_rounds),
+        )
+
+    def refresh(self, tick):
+        self.st = refresh_scores(self.st, self.in_mesh, tick, self.tp, self.params)
+        self.oracle.refresh(tick)
+
+    def penalty(self, leaf, count):
+        inc = np.zeros((self.n, self.k), np.float32)
+        inc[0, self.leaf_edge(leaf)] = count
+        self.st = add_penalties(self.st, jnp.asarray(inc))
+        self.oracle.add_penalty(leaf, count)
+
+    def scores(self):
+        app = jnp.zeros((self.n,), jnp.float32)
+        return np.asarray(
+            compute_scores(self.st, self.in_mesh, self.tp, self.params, self.p6, app, self.net)
+        )
+
+    def check(self, leaf, ip_count=1, app=0.0, tol=1e-5):
+        got = self.scores()[0, self.leaf_edge(leaf)]
+        want = self.oracle.score(leaf, ip_count=ip_count, app_score=app)
+        assert abs(got - want) < tol, f"leaf {leaf}: engine {got} oracle {want}"
+        return got
+
+
+def test_p1_time_in_mesh():
+    # TestScoreTimeInMesh: score grows with mesh time up to the cap
+    params = mk_params(time_in_mesh_weight=1.0, time_in_mesh_quantum=1.0, time_in_mesh_cap=5.0)
+    h = Harness(params)
+    h.graft(1, 0, tick=0)
+    for tick in range(1, 10):
+        h.refresh(tick)
+        got = h.check(1)
+    assert got == pytest.approx(5.0)  # capped
+
+
+def test_p2_first_message_deliveries_cap_and_decay():
+    params = mk_params(
+        first_message_deliveries_weight=2.0,
+        first_message_deliveries_cap=10.0,
+        first_message_deliveries_decay=0.5,
+    )
+    h = Harness(params)
+    for i in range(15):
+        h.deliver_round(0, [(1, 0, True, True)])
+    got = h.check(1)
+    assert got == pytest.approx(20.0)  # capped at 10 * weight 2
+    h.refresh(1)
+    assert h.check(1) == pytest.approx(10.0)
+    for _ in range(20):
+        h.refresh(2)
+    assert h.check(1) == 0.0  # decayed to zero
+
+
+def test_p3_mesh_message_deliveries_deficit():
+    # TestScoreMeshMessageDeliveries: inactive until activation ticks; then
+    # deficit^2 penalty for under-delivering mesh peers
+    params = mk_params(
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_threshold=5.0,
+        mesh_message_deliveries_cap=10.0,
+        mesh_message_deliveries_decay=1.0 - 1e-9,  # ~no decay
+        mesh_message_deliveries_activation=2.0,
+    )
+    h = Harness(params)
+    h.graft(1, 0, tick=0)  # peer 1 delivers nothing
+    h.graft(2, 0, tick=0)  # peer 2 delivers well
+    assert h.check(1) == 0.0  # not active yet
+    for tick in range(1, 6):
+        h.deliver_round(tick, [(2, 0, True, True)])
+        h.refresh(tick)
+    # peer 1: active, 0 deliveries -> -(5^2); peer 2: 5 deliveries -> 0
+    assert h.check(1) == pytest.approx(-25.0, rel=1e-4)
+    assert h.check(2) == pytest.approx(0.0, abs=1e-4)
+
+
+def test_p3_near_first_duplicates_count():
+    params = mk_params(
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_cap=10.0,
+        mesh_message_deliveries_decay=1.0 - 1e-9,
+        mesh_message_deliveries_activation=1.0,
+    )
+    h = Harness(params)
+    h.graft(1, 0, tick=0)
+    h.graft(2, 0, tick=0)
+    # same-round arrival: peer1 first, peer2 duplicate -> both mesh credit
+    for tick in range(0, 4):
+        slot_pairs = [(1, 0, True, True), (2, 0, True, False)]
+        # mark peer2's duplicate arrival of the same message
+        arrivals = np.zeros((h.n, h.k, h.m), bool)
+        new_bits = np.zeros((h.n, h.m), bool)
+        slot = h.next_slot
+        h.next_slot += 1
+        h.msg_topic[slot] = 0
+        h.msg_valid[slot] = True
+        arrivals[0, h.leaf_edge(1), slot] = True
+        arrivals[0, h.leaf_edge(2), slot] = True
+        new_bits[0, slot] = True
+        h.first_round[0, slot] = tick
+        h.first_edge[0, slot] = h.leaf_edge(1)
+        h.oracle.first_delivery(1, 0)
+        h.oracle.duplicate_delivery(2, 0, in_window=True)
+        h.st = on_deliveries(
+            h.st, h.net, h.in_mesh, h.tp,
+            jnp.asarray(arrivals), jnp.asarray(new_bits),
+            jnp.asarray(h.first_edge), jnp.asarray(h.first_round),
+            jnp.asarray(h.msg_topic), jnp.asarray(h.msg_valid),
+            tick, jnp.asarray(h.tpa.window_rounds),
+        )
+    h.refresh(4)
+    # both peers hit the threshold -> no deficit for either
+    assert h.check(1) == pytest.approx(0.0, abs=1e-4)
+    assert h.check(2) == pytest.approx(0.0, abs=1e-4)
+
+
+def test_p3b_sticky_failure_on_prune():
+    params = mk_params(
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_threshold=3.0,
+        mesh_message_deliveries_cap=10.0,
+        mesh_message_deliveries_decay=1.0 - 1e-9,
+        mesh_message_deliveries_activation=1.0,
+        mesh_failure_penalty_weight=-2.0,
+        mesh_failure_penalty_decay=0.5,
+    )
+    h = Harness(params)
+    h.graft(1, 0, tick=0)
+    h.refresh(1)
+    h.refresh(2)  # mesh_time=2 > activation 1 -> active
+    h.prune(1, 0)
+    # deficit 3 -> mfp=9 -> P3b = -18; the P3 activation latch is NOT
+    # cleared by prune (score.go:662-684), so P3 = -9 still applies
+    assert h.check(1) == pytest.approx(-27.0, rel=1e-4)
+    h.refresh(3)
+    # mfp decayed 0.5 -> P3b=-9; mmd ~undecayed -> P3=-9
+    assert h.check(1) == pytest.approx(-18.0, rel=1e-4)
+
+
+def test_p4_invalid_squared():
+    params = mk_params(
+        invalid_message_deliveries_weight=-1.0, invalid_message_deliveries_decay=0.9
+    )
+    h = Harness(params)
+    for _ in range(3):
+        h.deliver_round(0, [(1, 0, False, True)])
+    assert h.check(1) == pytest.approx(-9.0)  # 3^2 * -1
+
+
+def test_p5_app_specific():
+    params = dataclasses.replace(mk_params(), app_specific_weight=0.5)
+    h = Harness(params)
+    h.oracle.params = params
+    h.params = params
+    app = jnp.zeros((h.n,), jnp.float32).at[1].set(-10.0)
+    got = np.asarray(
+        compute_scores(h.st, h.in_mesh, h.tp, params, h.p6, app, h.net)
+    )[0, h.leaf_edge(1)]
+    want = h.oracle.score(1, app_score=-10.0)
+    assert got == pytest.approx(want) == -5.0
+
+
+def test_p6_ip_colocation():
+    # leaves 1,2,3 share an ip group; threshold 1 -> surplus 2 -> -4 each
+    ip = np.arange(7, dtype=np.int32)
+    ip[[1, 2, 3]] = 100
+    params = dataclasses.replace(
+        mk_params(),
+        ip_colocation_factor_weight=-1.0,
+        ip_colocation_factor_threshold=1,
+    )
+    h = Harness(params, ip_group=ip)
+    assert h.check(1, ip_count=3) == pytest.approx(-4.0)
+    assert h.check(4, ip_count=1) == pytest.approx(0.0)
+
+
+def test_p7_behaviour_penalty():
+    params = dataclasses.replace(
+        mk_params(),
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=2.0,
+        behaviour_penalty_decay=0.5,
+    )
+    h = Harness(params)
+    h.penalty(1, 2)
+    assert h.check(1) == pytest.approx(0.0)  # at threshold, no excess
+    h.penalty(1, 4)
+    assert h.check(1) == pytest.approx(-16.0)  # (6-2)^2
+    h.refresh(1)
+    assert h.check(1) == pytest.approx(-1.0)  # bp 3 -> excess 1
+
+
+def test_topic_score_cap():
+    params = mk_params(first_message_deliveries_weight=1.0,
+                       first_message_deliveries_cap=100.0,
+                       first_message_deliveries_decay=0.9)
+    params = dataclasses.replace(params, topic_score_cap=5.0)
+    h = Harness(params)
+    for _ in range(20):
+        h.deliver_round(0, [(1, 0, True, True)])
+    assert h.check(1) == pytest.approx(5.0)
+
+
+def test_unscored_topic_ignored():
+    # deliveries on a topic with no params contribute nothing
+    params = mk_params(first_message_deliveries_weight=1.0,
+                       first_message_deliveries_cap=100.0,
+                       first_message_deliveries_decay=0.9)
+    h = Harness(params, n_topics=2)
+    # params only cover topic 0..0? mk_params(n_topics=1) -> topic 0 scored
+    h.deliver_round(0, [(1, 1, True, True)])
+    assert h.check(1) == pytest.approx(0.0)
+
+
+def test_random_scenario_equivalence():
+    # randomized multi-peer multi-topic scenario, engine == oracle
+    rng = np.random.default_rng(3)
+    params = mk_params(
+        n_topics=3,
+        time_in_mesh_weight=0.1,
+        time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=100.0,
+        first_message_deliveries_weight=1.5,
+        first_message_deliveries_cap=30.0,
+        first_message_deliveries_decay=0.7,
+        mesh_message_deliveries_weight=-0.5,
+        mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_cap=20.0,
+        mesh_message_deliveries_decay=0.8,
+        mesh_message_deliveries_activation=2.0,
+        mesh_failure_penalty_weight=-1.0,
+        mesh_failure_penalty_decay=0.6,
+        invalid_message_deliveries_weight=-2.0,
+        invalid_message_deliveries_decay=0.5,
+    )
+    params = dataclasses.replace(
+        params, behaviour_penalty_weight=-0.3, behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.5,
+    )
+    h = Harness(params, n_leaves=5, n_topics=3, m=64)
+    for tick in range(12):
+        for leaf in range(1, 6):
+            if rng.random() < 0.3:
+                t = int(rng.integers(3))
+                if rng.random() < 0.5:
+                    h.graft(leaf, t, tick)
+                else:
+                    h.prune(leaf, t)
+        dels = []
+        for leaf in range(1, 6):
+            if rng.random() < 0.6:
+                dels.append((leaf, int(rng.integers(3)), bool(rng.random() < 0.8), True))
+        h.deliver_round(tick, dels)
+        if rng.random() < 0.4:
+            h.penalty(int(rng.integers(1, 6)), int(rng.integers(1, 3)))
+        h.refresh(tick)
+        for leaf in range(1, 6):
+            h.check(leaf, tol=1e-3)
