@@ -41,7 +41,7 @@ def floodsub_step(
     edge_mask = flood_edge_mask(net, state.msgs)
     dlv, info = delivery_round(net, state.msgs, state.dlv, edge_mask, state.tick)
 
-    msgs, dlv, _slots, is_pub = allocate_publishes(
+    msgs, dlv, _slots, is_pub, _keep, _pub_words = allocate_publishes(
         state.msgs, dlv, state.tick, pub_origin, pub_topic, pub_valid
     )
     events = accumulate_round_events(state.events, info, jnp.sum(is_pub.astype(jnp.int32)))

@@ -1,0 +1,806 @@
+"""GossipSub v1.0/v1.1 router, vectorized (gossipsub.go, 1909 LoC in the
+reference) — the centerpiece of the framework (BASELINE.json north_star).
+
+The per-node state machine — mesh maintenance, heartbeat, IHAVE/IWANT lazy
+gossip, GRAFT/PRUNE control with backoff, scoring, graylisting — runs for
+all N virtual peers at once as masked array ops over the padded neighbor
+axis; peer selection is the rank/top-k primitive (ops/select.py).
+
+Round model (survey §7): one jitted `step()` = one network-hop round; the
+heartbeat runs every `heartbeat_every` rounds inside the same jit. Control
+written to per-edge outboxes in round r is read by the far end in round
+r+1 via the reverse-edge gather — the one-RTT control latency of the
+reference's wire layer.
+
+Approximations vs the reference (all distributional, per the north star's
+CDF comparison):
+  * control responses are delayed one round (reference replies in the same
+    RPC turn)
+  * per-heartbeat GRAFT processing is batched, so Dhi admission checks use
+    mesh sizes from the round start
+  * one outstanding IWANT promise slot per edge (reference keeps one per
+    IWANT batch; AddPromise gossip_tracer.go:48-75)
+  * IHAVE truncation to MaxIHaveLength keeps lowest slots (reference
+    shuffles; with msg_slots << 5000 the cap rarely binds)
+  * over-subscription outbound bubble-up displaces random-keep members only
+    (the reference's rotation can displace score-keep members in corner
+    cases, gossipsub.go:1409-1441)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    ticks_for,
+)
+from ..ops import bitset
+from ..ops.select import count_true, median_masked, select_random_mask, select_topk_mask
+from ..score.engine import (
+    ScoreState,
+    TopicParamsArrays,
+    add_penalties,
+    compute_scores,
+    ip_colocation_surplus_sq,
+    on_deliveries,
+    on_graft,
+    on_prune,
+    refresh_scores,
+)
+from ..state import Net, SimState, allocate_publishes
+from ..trace.events import EV
+from .common import accumulate_round_events, delivery_round
+
+
+# ---------------------------------------------------------------------------
+# configuration
+
+
+@dataclasses.dataclass(frozen=True)
+class GossipSubConfig:
+    """Static (jit-constant) configuration: GossipSubParams with durations
+    in ticks, plus the v1.1 thresholds and feature switches."""
+
+    D: int = 6
+    Dlo: int = 5
+    Dhi: int = 12
+    Dscore: int = 4
+    Dout: int = 2
+    Dlazy: int = 6
+    gossip_factor: float = 0.25
+    history_length: int = 5
+    history_gossip: int = 3
+    gossip_retransmission: int = 3
+    max_ihave_messages: int = 10
+    max_ihave_length: int = 5000
+    iwant_followup_ticks: int = 3
+    prune_backoff_ticks: int = 60
+    graft_flood_ticks: int = 10
+    opportunistic_graft_ticks: int = 60
+    opportunistic_graft_peers: int = 2
+    backoff_clear_ticks: int = 15   # gossipsub.go:1587
+    backoff_slack_ticks: int = 2    # gossipsub.go:1596
+    heartbeat_every: int = 1        # rounds per heartbeat tick
+    # v1.1 switches
+    score_enabled: bool = False
+    flood_publish: bool = False
+    do_px: bool = False
+    # thresholds (v1.1; zeros for v1.0)
+    gossip_threshold: float = 0.0
+    publish_threshold: float = 0.0
+    graylist_threshold: float = 0.0
+    accept_px_threshold: float = 0.0
+    opportunistic_graft_threshold: float = 0.0
+
+    @classmethod
+    def build(
+        cls,
+        params: GossipSubParams | None = None,
+        thresholds: PeerScoreThresholds | None = None,
+        score_enabled: bool = False,
+        heartbeat_every: int = 1,
+    ) -> "GossipSubConfig":
+        p = params or GossipSubParams()
+        p.validate()
+        hb = p.heartbeat_interval
+        kw = dict(
+            D=p.D, Dlo=p.Dlo, Dhi=p.Dhi, Dscore=p.Dscore, Dout=p.Dout,
+            Dlazy=p.Dlazy, gossip_factor=p.gossip_factor,
+            history_length=p.history_length, history_gossip=p.history_gossip,
+            gossip_retransmission=p.gossip_retransmission,
+            max_ihave_messages=p.max_ihave_messages,
+            max_ihave_length=p.max_ihave_length,
+            iwant_followup_ticks=ticks_for(p.iwant_followup_time, hb),
+            prune_backoff_ticks=ticks_for(p.prune_backoff, hb),
+            graft_flood_ticks=ticks_for(p.graft_flood_threshold, hb),
+            opportunistic_graft_ticks=p.opportunistic_graft_ticks,
+            opportunistic_graft_peers=p.opportunistic_graft_peers,
+            heartbeat_every=heartbeat_every,
+            score_enabled=score_enabled,
+            flood_publish=p.flood_publish,
+            do_px=p.do_px,
+        )
+        if thresholds is not None:
+            thresholds.validate()
+            kw.update(
+                gossip_threshold=thresholds.gossip_threshold,
+                publish_threshold=thresholds.publish_threshold,
+                graylist_threshold=thresholds.graylist_threshold,
+                accept_px_threshold=thresholds.accept_px_threshold,
+                opportunistic_graft_threshold=thresholds.opportunistic_graft_threshold,
+            )
+        return cls(**kw)
+
+
+# ---------------------------------------------------------------------------
+# state
+
+
+@struct.dataclass
+class GossipSubState:
+    core: SimState
+    # mesh overlay (gossipsub.go:441 mesh map)
+    mesh: jax.Array             # [N,S,K] bool
+    # prune backoff (gossipsub.go:449): expiry tick + presence (presence
+    # outlives expiry until the 15-tick clear — gossipsub.go:1585-1604; the
+    # heartbeat candidate filter tests presence, graft admission tests expiry)
+    backoff_expire: jax.Array   # [N,S,K] i32
+    backoff_present: jax.Array  # [N,S,K] bool
+    # message cache ring (mcache.go): window 0 = current heartbeat
+    mcache: jax.Array           # [N,H,W] u32
+    # control outboxes, read by the far end next round
+    ihave_out: jax.Array        # [N,K,W] u32
+    iwant_out: jax.Array        # [N,K,W] u32
+    graft_out: jax.Array        # [N,S,K] bool
+    prune_out: jax.Array        # [N,S,K] bool
+    # IHAVE flood protection (cleared each heartbeat, gossipsub.go:1566-1576)
+    peerhave: jax.Array         # [N,K] i32
+    iasked: jax.Array           # [N,K] i32
+    # IWANT retransmission 2-bit saturating counters (mcache.peertx,
+    # mcache.go:66-80, tracked at the requesting end of the edge)
+    served_lo: jax.Array        # [N,K,W] u32
+    served_hi: jax.Array        # [N,K,W] u32
+    # gossip promises (gossip_tracer.go): one slot per edge
+    promise_mid: jax.Array      # [N,K] i32 (-1 none)
+    promise_expire: jax.Array   # [N,K] i32
+    # v1.1 score plane
+    score: ScoreState
+    scores: jax.Array           # [N,K] f32 (memoized per heartbeat,
+                                # gossipsub.go:1333-1341)
+    p6: jax.Array               # [N,K] f32 colocation surplus^2 (static topo)
+    app_score: jax.Array        # [N] f32 (P5)
+
+    @classmethod
+    def init(
+        cls,
+        net: Net,
+        msg_slots: int,
+        cfg: GossipSubConfig,
+        score_params: PeerScoreParams | None = None,
+        seed: int = 0,
+        app_score: np.ndarray | None = None,
+    ) -> "GossipSubState":
+        n, k = net.nbr.shape
+        s = net.n_slots
+        w = bitset.n_words(msg_slots)
+        h = cfg.history_length
+        if score_params is not None and cfg.score_enabled:
+            p6 = ip_colocation_surplus_sq(
+                net,
+                score_params.ip_colocation_factor_threshold,
+                score_params.ip_colocation_factor_whitelist,
+            )
+        else:
+            p6 = jnp.zeros((n, k), jnp.float32)
+        return cls(
+            core=SimState.init(n, msg_slots, seed),
+            mesh=jnp.zeros((n, s, k), bool),
+            backoff_expire=jnp.zeros((n, s, k), jnp.int32),
+            backoff_present=jnp.zeros((n, s, k), bool),
+            mcache=jnp.zeros((n, h, w), jnp.uint32),
+            ihave_out=jnp.zeros((n, k, w), jnp.uint32),
+            iwant_out=jnp.zeros((n, k, w), jnp.uint32),
+            graft_out=jnp.zeros((n, s, k), bool),
+            prune_out=jnp.zeros((n, s, k), bool),
+            peerhave=jnp.zeros((n, k), jnp.int32),
+            iasked=jnp.zeros((n, k), jnp.int32),
+            served_lo=jnp.zeros((n, k, w), jnp.uint32),
+            served_hi=jnp.zeros((n, k, w), jnp.uint32),
+            promise_mid=jnp.full((n, k), -1, jnp.int32),
+            promise_expire=jnp.zeros((n, k), jnp.int32),
+            score=ScoreState.empty(n, s, k),
+            scores=jnp.zeros((n, k), jnp.float32),
+            p6=p6,
+            app_score=jnp.zeros((n,), jnp.float32)
+            if app_score is None
+            else jnp.asarray(app_score, jnp.float32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# edge-view gathers (receivers read sender outboxes through rev[])
+
+
+def gather_edge_slots(x: jax.Array, net: Net) -> jax.Array:
+    """x[N, S, K] (sender, sender-slot, sender-edge) -> [N, S', K] receiver
+    view: out[j, s', k] = x[nbr[j,k], slot_of[nbr[j,k], my_topics[j,s']],
+    rev[j,k]] — the topic-slot translation between two peers' compressed
+    topic axes, fused into the reverse-edge gather."""
+    n, s_dim = net.my_topics.shape
+    k_dim = net.nbr.shape[1]
+    snd = jnp.clip(net.nbr, 0)                        # [N,K]
+    t = jnp.clip(net.my_topics, 0)                    # [N,S]
+    snd_slot_of = net.slot_of[snd]                    # [N,K,T]
+    s_snd = jnp.take_along_axis(
+        snd_slot_of, jnp.broadcast_to(t[:, None, :], (n, k_dim, s_dim)), axis=2
+    )                                                 # [N,K,S]
+    ok = net.nbr_ok[:, :, None] & (net.my_topics[:, None, :] >= 0) & (s_snd >= 0)
+    val = x[snd[:, :, None], jnp.clip(s_snd, 0), net.rev[:, :, None]]  # [N,K,S]
+    return jnp.where(ok, val, False).transpose(0, 2, 1)  # [N,S,K]
+
+
+def gather_edge_words(x: jax.Array, net: Net) -> jax.Array:
+    """x[N, K, W] outbox -> inbox: in[j,k] = x[nbr[j,k], rev[j,k]]."""
+    ok = net.nbr_ok[:, :, None]
+    return jnp.where(ok, x[jnp.clip(net.nbr, 0), net.rev], jnp.uint32(0))
+
+
+def gather_peer_scores(scores: jax.Array, net: Net) -> jax.Array:
+    """[N,K]: the score neighbor k holds of ME (sender-side publish gates
+    seen from the receiving end)."""
+    return jnp.where(net.nbr_ok, scores[jnp.clip(net.nbr, 0), net.rev], 0.0)
+
+
+def topic_msg_words(msg_topic: jax.Array, n_topics: int) -> jax.Array:
+    """[T, W] packed per-topic message masks."""
+    onehot = msg_topic[None, :] == jnp.arange(n_topics, dtype=jnp.int32)[:, None]
+    return bitset.pack(onehot)
+
+
+def msg_slot_of(net: Net, msg_topic: jax.Array) -> jax.Array:
+    """[N, M] receiver topic-slot per message (-1 when not subscribed)."""
+    t = jnp.clip(msg_topic, 0)
+    s = net.slot_of[:, t]
+    return jnp.where(msg_topic[None, :] >= 0, s, -1)
+
+
+def joined_msg_words(net: Net, msgs) -> jax.Array:
+    """[N, W]: messages in topics peer n has joined (mesh exists <=>
+    subscribed in the sim)."""
+    t = jnp.clip(msgs.topic, 0)
+    joined = jnp.where(msgs.topic[None, :] >= 0, net.subscribed[:, t], False)
+    return bitset.pack(joined)
+
+
+# ---------------------------------------------------------------------------
+# control-plane handlers (per round)
+
+
+def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
+                       acc_ok: jax.Array):
+    """Process GRAFT/PRUNE received this round (handleGraft
+    gossipsub.go:718-809, handlePrune :811-843). Returns updated state plus
+    next round's PRUNE responses."""
+    tick = st.core.tick
+
+    graft_in = gather_edge_slots(st.graft_out, net) & acc_ok[:, None, :]
+    prune_in = gather_edge_slots(st.prune_out, net) & acc_ok[:, None, :]
+
+    # handlePrune: drop from mesh, obey backoff, sticky P3b
+    pruned = prune_in & st.mesh
+    score = on_prune(st.score, pruned, tp) if cfg.score_enabled else st.score
+    mesh = st.mesh & ~prune_in
+    backoff_expire = jnp.where(
+        prune_in, jnp.maximum(st.backoff_expire, tick + cfg.prune_backoff_ticks),
+        st.backoff_expire,
+    )
+    backoff_present = st.backoff_present | prune_in
+
+    # handleGraft
+    want = graft_in & ~mesh & net.nbr_ok[:, None, :]
+
+    rej_direct = want & net.direct[:, None, :]  # gossipsub.go:742-750
+
+    backoff_active = backoff_present & (tick < backoff_expire)
+    rej_backoff = want & backoff_active          # gossipsub.go:753-770
+    flood_cutoff = backoff_expire + (cfg.graft_flood_ticks - cfg.prune_backoff_ticks)
+    flood = rej_backoff & (tick < flood_cutoff)  # gossipsub.go:760-765
+    penalty_counts = jnp.sum(
+        rej_backoff.astype(jnp.float32) + flood.astype(jnp.float32), axis=1
+    )  # [N,K]
+
+    if cfg.score_enabled:
+        rej_score = want & (st.scores[:, None, :] < 0)  # gossipsub.go:772-783
+    else:
+        rej_score = jnp.zeros_like(want)
+
+    mesh_deg = count_true(mesh)  # [N,S]
+    rej_full = (
+        want & (mesh_deg[:, :, None] >= cfg.Dhi) & ~net.outbound[:, None, :]
+    )  # gossipsub.go:785-792
+
+    rejected = rej_direct | rej_backoff | rej_score | rej_full
+    accepted = want & ~rejected
+
+    mesh = mesh | accepted
+    if cfg.score_enabled:
+        score = on_graft(score, accepted, tick)
+        score = add_penalties(score, penalty_counts)
+
+    re_back = rej_backoff | rej_score | rej_full  # refresh/add backoff
+    backoff_expire = jnp.where(
+        re_back, jnp.maximum(backoff_expire, tick + cfg.prune_backoff_ticks), backoff_expire
+    )
+    backoff_present = backoff_present | re_back
+
+    st = st.replace(
+        mesh=mesh,
+        backoff_expire=backoff_expire,
+        backoff_present=backoff_present,
+        score=score,
+    )
+    n_graft = jnp.sum(accepted.astype(jnp.int32))
+    n_prune = jnp.sum(pruned.astype(jnp.int32))
+    return st, rejected, n_graft, n_prune
+
+
+def _prefix_cap_bits(words: jax.Array, cap: jax.Array, m: int) -> jax.Array:
+    """Keep only the first `cap` set bits (lowest slots) of each packed row."""
+    bits = bitset.unpack(words, m)
+    csum = jnp.cumsum(bits.astype(jnp.int32), axis=-1)
+    keep = bits & (csum <= cap[..., None])
+    return bitset.pack(keep)
+
+
+def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
+                 joined_words: jax.Array, acc_ok: jax.Array) -> GossipSubState:
+    """IHAVE received this round -> IWANT requests + a promise
+    (handleIHave gossipsub.go:615-677)."""
+    m = st.core.msgs.capacity
+    tick = st.core.tick
+    ihave_in = gather_edge_words(st.ihave_out, net)
+    ihave_in = jnp.where(acc_ok[:, :, None], ihave_in, jnp.uint32(0))
+
+    got = bitset.popcount(ihave_in, axis=-1) > 0  # [N,K] one batch per round
+    peerhave = st.peerhave + got.astype(jnp.int32)
+
+    ok = got
+    if cfg.score_enabled:
+        ok = ok & (st.scores >= cfg.gossip_threshold)  # gossipsub.go:616-621
+    ok = ok & (peerhave <= cfg.max_ihave_messages)     # gossipsub.go:624-628
+    ok = ok & (st.iasked < cfg.max_ihave_length)       # gossipsub.go:630-633
+
+    wants = ihave_in & ~st.core.dlv.have[:, None, :] & joined_words[:, None, :]
+    wants = jnp.where(ok[:, :, None], wants, jnp.uint32(0))
+
+    budget = jnp.maximum(cfg.max_ihave_length - st.iasked, 0)  # gossipsub.go:655-658
+    asks = _prefix_cap_bits(wants, budget, m)
+    n_asked = bitset.popcount(asks, axis=-1)
+    iasked = st.iasked + n_asked
+
+    # adopt one promised mid per edge when none is outstanding
+    first_ask = jnp.argmax(bitset.unpack(asks, m), axis=-1).astype(jnp.int32)
+    adopt = (n_asked > 0) & (st.promise_mid < 0)
+    promise_mid = jnp.where(adopt, first_ask, st.promise_mid)
+    promise_expire = jnp.where(adopt, tick + cfg.iwant_followup_ticks, st.promise_expire)
+
+    return st.replace(
+        peerhave=peerhave,
+        iasked=iasked,
+        iwant_out=asks,
+        promise_mid=promise_mid,
+        promise_expire=promise_expire,
+    )
+
+
+def _served_capped(cfg: GossipSubConfig, lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """Word-mask of slots whose 2-bit served count has reached the
+    retransmission cap (cap clamps to the counter range 0..3)."""
+    cap = min(max(cfg.gossip_retransmission, 0), 3)
+    if cap >= 3:
+        return hi & lo
+    if cap == 2:
+        return hi
+    if cap == 1:
+        return hi | lo
+    return jnp.full_like(lo, 0xFFFFFFFF)
+
+
+def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState):
+    """The IWANT-response carry for this round's delivery + retransmission
+    counter update (handleIWant gossipsub.go:679-716). `st.iwant_out` holds
+    what I asked each neighbor last round; the neighbor serves from its full
+    mcache history window subject to the per-(edge,msg) cap."""
+    asked = st.iwant_out
+    sender_window = bitset.word_or_reduce(st.mcache, axis=1)       # [N,W]
+    window_g = jnp.where(
+        net.nbr_ok[:, :, None],
+        sender_window[jnp.clip(net.nbr, 0)],                        # [N,K,W]
+        jnp.uint32(0),
+    )
+    capped = _served_capped(cfg, st.served_lo, st.served_hi)
+    resp = asked & window_g & ~capped
+
+    if cfg.score_enabled:
+        # responder ignores requesters below the gossip threshold
+        # (gossipsub.go:681-685): the score the neighbor holds of me
+        nbr_score_of_me = gather_peer_scores(st.scores, net)
+        resp = jnp.where(
+            (nbr_score_of_me >= cfg.gossip_threshold)[:, :, None], resp, jnp.uint32(0)
+        )
+
+    # 2-bit saturating increment on served slots
+    sat = st.served_hi & st.served_lo
+    inc = resp & ~sat
+    carry = st.served_lo & inc
+    lo = st.served_lo ^ inc
+    hi = st.served_hi | carry
+    return st.replace(served_lo=lo, served_hi=hi), resp
+
+
+# ---------------------------------------------------------------------------
+# delivery-edge selection
+
+
+def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
+                     joined_words: jax.Array, acc_ok: jax.Array) -> jax.Array:
+    """[N,K,W] edge-carry mask: mesh push (forwarding along the sender's
+    mesh, gossipsub.go:981-1002) + v1.1 flood-publish for origin-sent
+    messages (gossipsub.go:957-963), gated by the receiver's graylist."""
+    mesh_in = gather_edge_slots(st.mesh, net).transpose(0, 2, 1)  # [N,K,S]
+    mslot = msg_slot_of(net, st.core.msgs.topic)                  # [N,M]
+    n, k_dim = net.nbr.shape
+    m = mslot.shape[1]
+    idx = jnp.broadcast_to(jnp.clip(mslot, 0)[:, None, :], (n, k_dim, m))
+    carry_bits = jnp.take_along_axis(mesh_in, idx, axis=2) & (mslot >= 0)[:, None, :]
+
+    if cfg.flood_publish:
+        origin_is_sender = st.core.msgs.origin[None, :] == net.nbr[..., None]  # [N,K,M]
+        if cfg.score_enabled:
+            flood_ok = gather_peer_scores(st.scores, net) >= cfg.publish_threshold
+        else:
+            flood_ok = net.nbr_ok
+        carry_bits = carry_bits | (
+            origin_is_sender & flood_ok[:, :, None] & (mslot >= 0)[:, None, :]
+        )
+
+    mask = bitset.pack(carry_bits)
+    mask = jnp.where(acc_ok[:, :, None], mask, jnp.uint32(0))
+    return mask & joined_words[:, None, :]
+
+
+def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
+    """Fold IWANT-response transmissions (not part of senders' fwd sets)
+    into the round's delivery results."""
+    m = core.msgs.capacity
+    onehot = core.msgs.origin[None, :] == jnp.arange(net.n_peers, dtype=jnp.int32)[:, None]
+    extra = extra & ~bitset.pack(onehot)[:, None, :]
+
+    recv = bitset.word_or_reduce(extra, axis=1)
+    new_words = recv & ~dlv.have
+    new_bits = bitset.unpack(new_words, m)
+    extra_bits = bitset.unpack(extra, m)
+    arrival_edge = jnp.argmax(extra_bits, axis=1).astype(jnp.int8)
+    valid_words = bitset.pack(core.msgs.valid)
+
+    dlv = dlv.replace(
+        have=dlv.have | new_words,
+        fwd=dlv.fwd | (new_words & valid_words[None, :]),
+        first_edge=jnp.where(new_bits, arrival_edge, dlv.first_edge),
+        first_round=jnp.where(new_bits, tick, dlv.first_round),
+    )
+
+    n_extra = bitset.popcount(extra, axis=-1).sum().astype(jnp.int32)
+    n_new = bitset.popcount(new_words, axis=-1).sum().astype(jnp.int32)
+    n_deliver = bitset.popcount(new_words & valid_words[None, :], axis=-1).sum().astype(jnp.int32)
+    info = info.replace(
+        trans=info.trans | extra,
+        new_words=info.new_words | new_words,
+        new_bits=info.new_bits | new_bits,
+        n_deliver=info.n_deliver + n_deliver,
+        n_reject=info.n_reject + (n_new - n_deliver),
+        n_duplicate=info.n_duplicate + (n_extra - n_new),
+        n_rpc=info.n_rpc + n_extra,
+    )
+    return dlv, info
+
+
+# ---------------------------------------------------------------------------
+# the heartbeat (gossipsub.go:1303-1564)
+
+
+def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
+              score_params: PeerScoreParams | None) -> GossipSubState:
+    tick = st.core.tick
+    n, s_dim, k_dim = st.mesh.shape
+    m = st.core.msgs.capacity
+    key = jax.random.fold_in(st.core.key, tick)
+    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+    events = st.core.events
+
+    # applyIwantPenalties: broken promises -> P7 (gossipsub.go:1578-1583)
+    have_bits = bitset.unpack(st.core.dlv.have, m)  # [N,M]
+    promised_have = jnp.take_along_axis(
+        have_bits, jnp.clip(st.promise_mid, 0), axis=-1
+    )  # [N,K]
+    live = st.promise_mid >= 0
+    fulfilled = live & promised_have
+    broken = live & ~promised_have & (tick > st.promise_expire)
+    score = st.score
+    if cfg.score_enabled:
+        score = add_penalties(score, broken.astype(jnp.float32))
+    promise_mid = jnp.where(fulfilled | broken, -1, st.promise_mid)
+
+    # clearIHaveCounters (gossipsub.go:1566-1576)
+    peerhave = jnp.zeros_like(st.peerhave)
+    iasked = jnp.zeros_like(st.iasked)
+
+    # clearBackoff every 15 ticks with slack (gossipsub.go:1585-1604)
+    clear_now = (tick % cfg.backoff_clear_ticks) == 0
+    expired = (st.backoff_expire + cfg.backoff_slack_ticks) < tick
+    backoff_present = jnp.where(clear_now, st.backoff_present & ~expired, st.backoff_present)
+
+    # refreshScores + memoized score cache (gossipsub.go:1333-1341)
+    if cfg.score_enabled:
+        score = refresh_scores(score, st.mesh, tick, tp, score_params)
+        scores = compute_scores(score, st.mesh, tp, score_params, st.p6, st.app_score, net)
+    else:
+        scores = st.scores
+
+    # ---- mesh maintenance per (peer, topic-slot) ------------------------
+    mesh = st.mesh
+    slot_live = net.my_topics >= 0
+    nbr_sub = gather_nbr_subscribed(net)  # [N,S,K]
+    connected = net.nbr_ok[:, None, :] & slot_live[:, :, None]
+    scores_b = jnp.broadcast_to(scores[:, None, :], mesh.shape)
+
+    tograft = jnp.zeros_like(mesh)
+    toprune = jnp.zeros_like(mesh)
+
+    # drop negative-score mesh members, no PX (gossipsub.go:1361-1368)
+    if cfg.score_enabled:
+        bad = mesh & (scores_b < 0)
+        toprune = toprune | bad
+        mesh = mesh & ~bad
+
+    # candidate filter (gossipsub.go:1374-1380): backoff *presence*
+    cand = connected & nbr_sub & ~mesh & ~backoff_present & ~net.direct[:, None, :]
+    if cfg.score_enabled:
+        cand = cand & (scores_b >= 0)
+
+    # |mesh| < Dlo -> graft to D (gossipsub.go:1371-1385)
+    deg = count_true(mesh)
+    ineed = jnp.where(deg < cfg.Dlo, cfg.D - deg, 0)
+    grafts = select_random_mask(k1, cand, ineed)
+    mesh = mesh | grafts
+    tograft = tograft | grafts
+
+    # |mesh| > Dhi -> keep Dscore best + random to D, Dout outbound
+    # (gossipsub.go:1388-1448)
+    deg = count_true(mesh)
+    over = (deg > cfg.Dhi)[:, :, None]
+    noise = jax.random.uniform(k2, mesh.shape)
+    if cfg.score_enabled:
+        topscore = select_topk_mask(scores_b, mesh, cfg.Dscore, key=k3)
+    else:
+        topscore = select_random_mask(k3, mesh, cfg.Dscore)
+    rest_rand = select_topk_mask(noise, mesh & ~topscore, cfg.D - cfg.Dscore)
+    keep = topscore | rest_rand
+    outb = jnp.broadcast_to(net.outbound[:, None, :], mesh.shape)
+    x_need = jnp.maximum(cfg.Dout - count_true(keep & outb), 0)
+    bring = select_topk_mask(noise, mesh & outb & ~keep, x_need)
+    drop = select_topk_mask(-noise, keep & ~outb & ~topscore, count_true(bring))
+    keep = (keep & ~drop) | bring
+    pruned_over = mesh & ~keep & over
+    mesh = jnp.where(over, mesh & keep, mesh)
+    toprune = toprune | pruned_over
+
+    # outbound quota top-up at Dlo <= |mesh| (gossipsub.go:1451-1476)
+    deg = count_true(mesh)
+    need_out = jnp.where(
+        deg >= cfg.Dlo, jnp.maximum(cfg.Dout - count_true(mesh & outb), 0), 0
+    )
+    grafts2 = select_random_mask(k4, cand & outb & ~mesh, need_out)
+    mesh = mesh | grafts2
+    tograft = tograft | grafts2
+
+    # opportunistic grafting (gossipsub.go:1479-1510)
+    if cfg.score_enabled and cfg.opportunistic_graft_ticks > 0:
+        oppo = (tick % cfg.opportunistic_graft_ticks) == 0
+        med = median_masked(scores_b, mesh)  # [N,S]
+        low = oppo & (med < cfg.opportunistic_graft_threshold) & (count_true(mesh) > 1)
+        cand3 = cand & ~mesh & (scores_b > med[:, :, None])
+        grafts3 = select_random_mask(
+            k5, cand3, jnp.where(low, cfg.opportunistic_graft_peers, 0)
+        )
+        mesh = mesh | grafts3
+        tograft = tograft | grafts3
+
+    new_grafts = tograft & ~st.mesh
+    if cfg.score_enabled:
+        score = on_graft(score, new_grafts, tick)
+        score = on_prune(score, toprune, tp)
+    backoff_expire = jnp.where(
+        toprune, jnp.maximum(st.backoff_expire, tick + cfg.prune_backoff_ticks),
+        st.backoff_expire,
+    )
+    backoff_present = backoff_present | toprune
+
+    # ---- emitGossip (gossipsub.go:1669-1723) ----------------------------
+    gwin = bitset.word_or_reduce(st.mcache[:, : cfg.history_gossip, :], axis=1)  # [N,W]
+    gossip_cand = connected & nbr_sub & ~mesh & ~net.direct[:, None, :]
+    if cfg.score_enabled:
+        gossip_cand = gossip_cand & (scores_b >= cfg.gossip_threshold)
+    n_cand = count_true(gossip_cand)
+    target = jnp.maximum(cfg.Dlazy, (cfg.gossip_factor * n_cand).astype(jnp.int32))
+    chosen = select_random_mask(k6, gossip_cand, target)  # [N,S,K]
+
+    tw = topic_msg_words(st.core.msgs.topic, net.n_topics)  # [T,W]
+    slot_tw = tw[jnp.clip(net.my_topics, 0)]                # [N,S,W]
+    slot_tw = jnp.where(slot_live[:, :, None], slot_tw, jnp.uint32(0))
+    adv = jnp.where(
+        chosen[..., None], (gwin[:, None, :] & slot_tw)[:, :, None, :]
+        * jnp.uint32(1), jnp.uint32(0)
+    )  # [N,S,K,W]
+    ihave_out = bitset.word_or_reduce(adv, axis=1)  # [N,K,W]
+
+    # mcache.Shift (gossipsub.go:1563)
+    mcache = jnp.concatenate(
+        [jnp.zeros_like(st.mcache[:, :1, :]), st.mcache[:, :-1, :]], axis=1
+    )
+
+    events = (
+        events.at[EV.GRAFT].add(jnp.sum(new_grafts.astype(jnp.int32)))
+        .at[EV.PRUNE].add(jnp.sum(toprune.astype(jnp.int32)))
+    )
+
+    return st.replace(
+        core=st.core.replace(events=events),
+        mesh=mesh,
+        backoff_expire=backoff_expire,
+        backoff_present=backoff_present,
+        mcache=mcache,
+        ihave_out=ihave_out,
+        graft_out=new_grafts,
+        prune_out=st.prune_out | toprune,
+        peerhave=peerhave,
+        iasked=iasked,
+        promise_mid=promise_mid,
+        score=score,
+        scores=scores,
+    )
+
+
+def gather_nbr_subscribed(net: Net) -> jax.Array:
+    """[N,S,K]: neighbor k subscribes the topic of my slot s."""
+    n, s_dim = net.my_topics.shape
+    k_dim = net.nbr.shape[1]
+    sub_nbr = net.subscribed[jnp.clip(net.nbr, 0)]  # [N,K,T]
+    out = jnp.take_along_axis(
+        sub_nbr, jnp.broadcast_to(jnp.clip(net.my_topics, 0)[:, None, :], (n, k_dim, s_dim)),
+        axis=2,
+    ).transpose(0, 2, 1)
+    return out & net.nbr_ok[:, None, :] & (net.my_topics >= 0)[:, :, None]
+
+
+# ---------------------------------------------------------------------------
+# the full per-round step
+
+
+def make_gossipsub_step(
+    cfg: GossipSubConfig,
+    net: Net,
+    score_params: PeerScoreParams | None = None,
+    heartbeat_interval: float = 1.0,
+):
+    """Build the jitted per-round step for a fixed config + topology.
+
+    step(state, pub_origin[P], pub_topic[P], pub_valid[P]) -> state
+    """
+    if cfg.score_enabled:
+        assert score_params is not None
+        score_params.validate()
+        tpa = TopicParamsArrays.build(score_params, net.n_topics, heartbeat_interval)
+    else:
+        score_params = PeerScoreParams(topics={}, skip_app_specific=True)
+        tpa = TopicParamsArrays.build(score_params, net.n_topics)
+    tp = tpa.gather(net.my_topics)
+    window_rounds_t = jnp.asarray(tpa.window_rounds)
+
+    def step(st: GossipSubState, pub_origin, pub_topic, pub_valid) -> GossipSubState:
+        core = st.core
+        tick = core.tick
+        m = core.msgs.capacity
+
+        # AcceptFrom gate (gossipsub.go:583-594): direct always; graylisted
+        # never. (The gater's RED drop is stage-5 work.)
+        if cfg.score_enabled:
+            acc_ok = (st.scores >= cfg.graylist_threshold) | net.direct
+        else:
+            acc_ok = net.nbr_ok
+
+        # 1. GRAFT/PRUNE ingest
+        st2, prune_resp, n_graft, n_prune = handle_graft_prune(cfg, net, st, tp, acc_ok)
+        events = core.events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
+
+        # 2. IWANT service (requests sent to me last round -> delivery carry)
+        st2, iwant_resp = iwant_responses(cfg, net, st2)
+
+        # 3. IHAVE ingest (advertisements -> next round's requests)
+        joined_words = joined_msg_words(net, core.msgs)
+        st2 = handle_ihave(cfg, net, st2, joined_words, acc_ok)
+
+        # 4. delivery: mesh push + flood-publish + IWANT responses
+        edge_mask = gossip_edge_mask(cfg, net, st2, joined_words, acc_ok)
+        dlv, info = delivery_round(net, core.msgs, core.dlv, edge_mask, tick)
+        dlv, info = merge_extra_tx(net, core, dlv, info, iwant_resp, tick)
+
+        # 5. score delivery attribution
+        score = st2.score
+        if cfg.score_enabled:
+            arrivals = bitset.unpack(info.trans, m)
+            score = on_deliveries(
+                score, net, st2.mesh, tp, arrivals, info.new_bits,
+                dlv.first_edge, dlv.first_round,
+                core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
+            )
+
+        # 6. mcache put: validated new receipts in joined topics
+        valid_words = bitset.pack(core.msgs.valid)
+        put = info.new_words & valid_words[None, :] & joined_words
+        mcache = st2.mcache.at[:, 0, :].set(st2.mcache[:, 0, :] | put)
+
+        # 7. publishes + slot-recycle cleanup
+        msgs, dlv, _slots, is_pub, keep_words, pub_words = allocate_publishes(
+            core.msgs, dlv, tick, pub_origin, pub_topic, pub_valid
+        )
+        mcache = (mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)) & keep_words[None, None, :]
+        ihave_out = st2.ihave_out & keep_words[None, None, :]
+        iwant_out = st2.iwant_out & keep_words[None, None, :]
+        served_lo = st2.served_lo & keep_words[None, None, :]
+        served_hi = st2.served_hi & keep_words[None, None, :]
+        reused_bits = bitset.unpack(~keep_words, m)  # [M]
+        promise_reused = reused_bits[jnp.clip(st2.promise_mid, 0)]
+        promise_mid = jnp.where(
+            (st2.promise_mid >= 0) & promise_reused, -1, st2.promise_mid
+        )
+
+        events = accumulate_round_events(events, info, jnp.sum(is_pub.astype(jnp.int32)))
+        st2 = st2.replace(
+            core=core.replace(msgs=msgs, dlv=dlv, events=events),
+            mcache=mcache,
+            ihave_out=ihave_out,
+            iwant_out=iwant_out,
+            served_lo=served_lo,
+            served_hi=served_hi,
+            promise_mid=promise_mid,
+            graft_out=jnp.zeros_like(st2.graft_out),
+            prune_out=prune_resp,
+            score=score,
+        )
+
+        # 8. heartbeat
+        st2 = jax.lax.cond(
+            (tick % cfg.heartbeat_every) == 0,
+            lambda s: heartbeat(cfg, net, s, tp, score_params),
+            lambda s: s,
+            st2,
+        )
+
+        return st2.replace(core=st2.core.replace(tick=tick + 1))
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def no_publish(p: int = 4):
+    """Empty publish buffers."""
+    z = jnp.full((p,), -1, jnp.int32)
+    return z, z, jnp.zeros((p,), bool)

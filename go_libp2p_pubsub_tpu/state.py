@@ -39,6 +39,8 @@ class Net:
     my_topics: jax.Array   # [N, S] i32
     slot_of: jax.Array     # [N, T] i32
     ip_group: jax.Array    # [N] i32 (P6 colocation key)
+    direct: jax.Array      # [N, K] bool — direct (explicit) peering edges
+                           # (WithDirectPeers, gossipsub.go:332-345)
 
     @classmethod
     def build(
@@ -46,10 +48,13 @@ class Net:
         topo: graphlib.Topology,
         subs: graphlib.Subscriptions,
         ip_group: np.ndarray | None = None,
+        direct: np.ndarray | None = None,
     ) -> "Net":
         n = topo.n_peers
         if ip_group is None:
             ip_group = np.arange(n, dtype=np.int32)  # unique IPs
+        if direct is None:
+            direct = np.zeros(topo.nbr.shape, bool)
         return cls(
             nbr=jnp.asarray(topo.nbr),
             nbr_ok=jnp.asarray(topo.nbr_ok),
@@ -59,6 +64,7 @@ class Net:
             my_topics=jnp.asarray(subs.my_topics),
             slot_of=jnp.asarray(subs.slot_of),
             ip_group=jnp.asarray(ip_group),
+            direct=jnp.asarray(direct),
         )
 
     @property
@@ -212,7 +218,9 @@ def allocate_publishes(
         first_round=jnp.where(pub_bits, jnp.broadcast_to(tick, pub_bits.shape), dlv.first_round),
         # first_edge stays -1 for local publishes
     )
-    return msgs, dlv, slots, is_pub
+    # keep-mask for recycled slots so routers can clear their own per-slot
+    # state (mcache windows, gossip outboxes, promises)
+    return msgs, dlv, slots, is_pub, keep, pub_words
 
 
 def hops(msgs: MsgTable, dlv: Delivery) -> jax.Array:
