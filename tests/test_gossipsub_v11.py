@@ -1,0 +1,183 @@
+"""GossipSub v1.1 integration: scoring live in the router loop —
+honest-network health, invalid-message spammer punishment (P4 -> prune ->
+graylist), flood-publish. Tier-2/3 analogues of gossipsub_spam_test.go."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.ops import bitset
+from go_libp2p_pubsub_tpu.state import Net
+
+
+def benign_score_params(n_topics=1):
+    """Score params that don't penalize honest small-network behavior:
+    P3/P3b off (tiny meshes can't hit delivery thresholds), P4 on."""
+    tp = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.01,
+        time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=10.0,
+        first_message_deliveries_weight=1.0,
+        first_message_deliveries_cap=50.0,
+        first_message_deliveries_decay=0.9,
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+        invalid_message_deliveries_weight=-10.0,
+        invalid_message_deliveries_decay=0.9,
+    )
+    return PeerScoreParams(
+        topics={t: tp for t in range(n_topics)},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-10.0,
+        behaviour_penalty_threshold=0.0,
+        behaviour_penalty_decay=0.9,
+        ip_colocation_factor_weight=0.0,
+    )
+
+
+def build_v11(n=40, d=8, seed=0, flood_publish=False, score_params=None):
+    topo = graph.random_connect(n, d, seed=seed)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    params = dataclasses.replace(GossipSubParams(), flood_publish=flood_publish)
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0,
+        publish_threshold=-4.0,
+        graylist_threshold=-8.0,
+        accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(params, thr, score_enabled=True)
+    sp = score_params or benign_score_params()
+    st = GossipSubState.init(net, 32, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    return topo, net, cfg, st, step
+
+
+def pub(o, t, valid=True, p=4):
+    po = np.full(p, -1, np.int32)
+    pt = np.full(p, -1, np.int32)
+    pv = np.zeros(p, bool)
+    po[0], pt[0], pv[0] = o, t, valid
+    return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+
+def run(step, st, k):
+    a = no_publish()
+    for _ in range(k):
+        st = step(st, *a)
+    return st
+
+
+def test_honest_network_scores_nonnegative():
+    topo, net, cfg, st, step = build_v11(seed=3)
+    st = run(step, st, 10)
+    st = step(st, *pub(2, 0))
+    st = run(step, st, 15)
+    scores = np.asarray(st.scores)
+    ok = np.asarray(net.nbr_ok)
+    assert (scores[ok] >= 0).all()
+    deg = np.asarray(st.mesh.sum(axis=(1, 2)))
+    assert (deg >= 1).all() and (deg <= cfg.Dhi).all()
+    # delivery happened
+    have = np.asarray(bitset.unpack(st.core.dlv.have, 32))[:, 0]
+    assert have.all()
+
+
+def test_invalid_spammer_scored_negative_and_pruned():
+    topo, net, cfg, st, step = build_v11(seed=5)
+    spammer = 4
+    st = run(step, st, 8)  # mesh warmup
+    for i in range(12):
+        st = step(st, *pub(spammer, 0, valid=False))
+    # neighbors of the spammer hold strongly negative scores of it
+    scores = np.asarray(st.scores)
+    neg = []
+    for j in range(net.n_peers):
+        for k in range(topo.max_degree):
+            if topo.nbr_ok[j, k] and topo.nbr[j, k] == spammer:
+                neg.append(scores[j, k])
+    assert len(neg) > 0
+    # every neighbor that saw the spam (spammer's mesh members) is negative;
+    # a neighbor outside the spammer's mesh never received it and stays at 0
+    # (scores reflect observed behavior only)
+    assert min(neg) < -0.5
+    assert np.mean(np.asarray(neg) < 0) >= 0.7, neg
+    # peers with negative scores pruned the spammer (heartbeat drops
+    # score<0, gossipsub.go:1361-1368) and its own mesh empties via PRUNEs
+    mesh = np.asarray(st.mesh[:, 0, :])
+    for j in range(net.n_peers):
+        for k in range(topo.max_degree):
+            if topo.nbr_ok[j, k] and topo.nbr[j, k] == spammer and scores[j, k] < 0:
+                assert not mesh[j, k]
+    assert int(st.mesh[spammer].sum()) == 0
+
+
+def test_graylisted_peer_messages_ignored():
+    # flood-publish keeps the spam flowing even after mesh ejection, so the
+    # score keeps sinking past the graylist threshold
+    topo, net, cfg, st, step = build_v11(seed=7, flood_publish=True)
+    spammer = 1
+    st = run(step, st, 8)
+    for i in range(20):
+        st = step(st, *pub(spammer, 0, valid=False))
+    # drive the score below the graylist threshold
+    scores = np.asarray(st.scores)
+    sn = [
+        scores[j, k]
+        for j in range(net.n_peers)
+        for k in range(topo.max_degree)
+        if topo.nbr_ok[j, k] and topo.nbr[j, k] == spammer
+    ]
+    assert max(sn) < cfg.graylist_threshold
+    # now even VALID messages from the spammer are dropped at ingress
+    # (AcceptFrom -> AcceptNone, gossipsub.go:583-594)
+    before = np.asarray(bitset.unpack(st.core.dlv.have, 32)).sum()
+    st = step(st, *pub(spammer, 0, valid=True))
+    st = run(step, st, 6)
+    have = np.asarray(bitset.unpack(st.core.dlv.have, 32))
+    # the message lives only at the spammer itself
+    spread = have.sum() - before
+    assert spread <= 1, f"graylisted publish must not spread, spread={spread}"
+
+
+def test_flood_publish_reaches_direct_neighbors_first():
+    topo, net, cfg, st, step = build_v11(seed=9, flood_publish=True)
+    st = run(step, st, 8)
+    origin = 3
+    st = step(st, *pub(origin, 0))
+    st = step(st, *no_publish())
+    # after one transmit round, ALL topic neighbors of origin have it
+    # (flood-publish sends beyond the mesh, gossipsub.go:957-963)
+    have = np.asarray(bitset.unpack(st.core.dlv.have, 32))[:, 0]
+    for k in range(topo.max_degree):
+        if topo.nbr_ok[origin, k]:
+            assert have[topo.nbr[origin, k]]
+
+
+def test_first_deliverers_gain_score():
+    topo, net, cfg, st, step = build_v11(seed=11)
+    st = run(step, st, 8)
+    st = step(st, *pub(6, 0))
+    st = run(step, st, 10)
+    # peers that relayed first deliveries earn positive P2 — someone's
+    # score of some neighbor must exceed the pure time-in-mesh baseline
+    scores = np.asarray(st.scores)
+    ok = np.asarray(net.nbr_ok)
+    # (one delivery, P2 decayed ~0.9^10 plus P1 time-in-mesh)
+    assert scores[ok].max() > 0.3
