@@ -1,0 +1,135 @@
+"""Benchmark: GossipSub v1.1 heartbeat-tick throughput at scale on TPU.
+
+North-star metric (BASELINE.json): simulated heartbeat-ticks/sec for a
+100k-peer GossipSub v1.1 mesh with live scoring; target >= 10_000 ticks/s
+on a v5e-8. This runs on however many chips are visible (the driver runs
+it on one), with the peer axis sharded across them.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is value / 10_000 (the north-star target rate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_bench(n_peers: int, msg_slots: int, seed: int = 0):
+    import jax
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreParams,
+        PeerScoreThresholds,
+        TopicScoreParams,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.parallel import make_mesh, shard_state
+    from go_libp2p_pubsub_tpu.state import Net
+
+    # bounded-degree topology (K stays small and static for the compiler)
+    topo = graph.ring_lattice(n_peers, d=8)  # degree 16, K=16
+    subs = graph.subscribe_all(n_peers, 1)
+    net = Net.build(topo, subs)
+
+    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0,  # deficit penalties off: honest net
+        mesh_failure_penalty_weight=0.0,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
+    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+
+    n_dev = len(jax.devices())
+    if n_dev > 1 and n_peers % n_dev == 0:
+        mesh = make_mesh(n_dev)
+        st = shard_state(st, mesh, n_peers)
+
+    return st, step
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    n_peers = int(os.environ.get("BENCH_N", 100_000))
+    msg_slots = int(os.environ.get("BENCH_M", 64))
+    seg = int(os.environ.get("BENCH_ROUNDS", 50))
+    pubs_per_round = 4
+
+    sizes = [n_peers, n_peers // 2, n_peers // 4, 25_000, 10_000]
+    st = step = None
+    for n in sizes:
+        try:
+            st, step = build_bench(n, msg_slots)
+            # publish schedule [R, P]
+            rng = np.random.default_rng(0)
+            po = rng.integers(0, n, size=(seg, pubs_per_round)).astype(np.int32)
+            pt = np.zeros((seg, pubs_per_round), np.int32)
+            pv = np.ones((seg, pubs_per_round), bool)
+            po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+            def run_seg(s, po=po_j, pt=pt_j, pv=pv_j):
+                def body(carry, xs):
+                    return step(carry, *xs), None
+                s, _ = jax.lax.scan(body, s, (po, pt, pv))
+                return s
+
+            run_seg_j = jax.jit(run_seg, donate_argnums=0)
+            st = run_seg_j(st)  # compile + warmup
+            jax.block_until_ready(st)
+            n_peers = n
+            break
+        except Exception as e:  # noqa: BLE001 — fall back to smaller N on OOM
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "exceeds" in msg:
+                st = step = None
+                continue
+            raise
+    if st is None:
+        print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": 0}))
+        return
+
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st = run_seg_j(st)
+        jax.block_until_ready(st)
+        dt = time.perf_counter() - t0
+        rates.append(seg / dt)
+    value = max(rates)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"gossipsub_v1.1_heartbeat_ticks_per_sec_n{n_peers}",
+                "value": round(value, 2),
+                "unit": "ticks/s",
+                "vs_baseline": round(value / 10_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..ops import bitset
+from ..ops import bitset, edges
 from ..state import Delivery, MsgTable, Net
 from ..trace.events import EV
 
@@ -78,14 +78,14 @@ def delivery_round(
 
     senders = jnp.clip(net.nbr, 0)  # [N,K]; masked below where ~nbr_ok
 
-    # what each sender is forwarding this round: [N, K, W]
+    # what each sender is forwarding this round: [N, K, W] word gather
     fwd_gathered = dlv.fwd[senders]
 
-    # echo exclusion: sender s does not send m back on the edge it arrived on.
-    # first_edge[s, m] == rev[j, k] means edge (j,k) is where s got m from.
-    sender_first_edge = dlv.first_edge[senders]  # [N, K, M] i8
-    echo = sender_first_edge == net.rev[..., None].astype(jnp.int8)
-    echo_words = bitset.pack(echo)  # [N, K, W]
+    # echo exclusion: sender s does not send m back on the edge it arrived
+    # on. Sender-side packed compare (fused, no [N,K,M] gather), then a
+    # word gather: echo[j,k] = "messages s first-received on its edge to j"
+    echo_out = bitset.edge_eq_words(dlv.first_edge, k_slots)   # [N,K,W] at sender
+    echo_words = edges.edge_permute(echo_out, net.edge_perm)   # flat row gather
 
     ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     not_mine = ~origin_msg_words(net, msgs)  # [N, W]
@@ -96,10 +96,16 @@ def delivery_round(
     new_words = recv_words & ~dlv.have
     new_bits = bitset.unpack(new_words, m)
 
-    # first-arrival edge: lowest edge slot carrying a new bit
-    trans_bits = bitset.unpack(trans, m)  # [N, K, M]
-    arrival_edge = jnp.argmax(trans_bits, axis=1).astype(jnp.int8)  # [N, M]
-    first_edge = jnp.where(new_bits, arrival_edge, dlv.first_edge)
+    # first-arrival edge: lowest edge slot carrying each new bit, as a
+    # K-step word scan (no [N,K,M] transpose/argmax)
+    def fe_body(k, carry):
+        bits = bitset.unpack(trans[:, k, :], m)
+        return jnp.where(bits & (carry < 0), k.astype(jnp.int8), carry)
+
+    arrival_edge = jax.lax.fori_loop(
+        0, k_slots, fe_body, jnp.full((n, m), -1, jnp.int8)
+    )
+    first_edge = jnp.where(new_bits & (arrival_edge >= 0), arrival_edge, dlv.first_edge)
     first_round = jnp.where(new_bits, tick, dlv.first_round)
 
     # forwarding: new receipts of valid messages (honest store-and-forward)

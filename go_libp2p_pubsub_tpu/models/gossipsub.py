@@ -42,7 +42,7 @@ from ..config import (
     PeerScoreThresholds,
     ticks_for,
 )
-from ..ops import bitset
+from ..ops import bitset, edges
 from ..ops.select import count_true, median_masked, select_random_mask, select_topk_mask
 from ..score.engine import (
     ScoreState,
@@ -54,6 +54,7 @@ from ..score.engine import (
     on_graft,
     on_prune,
     refresh_scores,
+    slot_topic_words,
 )
 from ..state import Net, SimState, allocate_publishes
 from ..trace.events import EV
@@ -232,31 +233,28 @@ class GossipSubState:
 def gather_edge_slots(x: jax.Array, net: Net) -> jax.Array:
     """x[N, S, K] (sender, sender-slot, sender-edge) -> [N, S', K] receiver
     view: out[j, s', k] = x[nbr[j,k], slot_of[nbr[j,k], my_topics[j,s']],
-    rev[j,k]] — the topic-slot translation between two peers' compressed
-    topic axes, fused into the reverse-edge gather."""
-    n, s_dim = net.my_topics.shape
-    k_dim = net.nbr.shape[1]
-    snd = jnp.clip(net.nbr, 0)                        # [N,K]
-    t = jnp.clip(net.my_topics, 0)                    # [N,S]
-    snd_slot_of = net.slot_of[snd]                    # [N,K,T]
-    s_snd = jnp.take_along_axis(
-        snd_slot_of, jnp.broadcast_to(t[:, None, :], (n, k_dim, s_dim)), axis=2
-    )                                                 # [N,K,S]
-    ok = net.nbr_ok[:, :, None] & (net.my_topics[:, None, :] >= 0) & (s_snd >= 0)
-    val = x[snd[:, :, None], jnp.clip(s_snd, 0), net.rev[:, :, None]]  # [N,K,S]
-    return jnp.where(ok, val, False).transpose(0, 2, 1)  # [N,S,K]
+    rev[j,k]].
+
+    Topic-bit packing + the flat edge-permutation row gather (ops/edges.py)
+    — topic ids cross the wire as word bits, like the reference's per-topic
+    control messages; no multi-index gathers."""
+    words = edges.topic_pack(x, net.my_topics, net.n_topics)   # [N,K,Wt]
+    words_in = edges.edge_permute(words, net.edge_perm)
+    out = edges.topic_unpack(words_in, net.my_topics)          # [N,S,K]
+    return out & net.nbr_ok[:, None, :]
 
 
 def gather_edge_words(x: jax.Array, net: Net) -> jax.Array:
     """x[N, K, W] outbox -> inbox: in[j,k] = x[nbr[j,k], rev[j,k]]."""
-    ok = net.nbr_ok[:, :, None]
-    return jnp.where(ok, x[jnp.clip(net.nbr, 0), net.rev], jnp.uint32(0))
+    return jnp.where(
+        net.nbr_ok[:, :, None], edges.edge_permute(x, net.edge_perm), jnp.uint32(0)
+    )
 
 
 def gather_peer_scores(scores: jax.Array, net: Net) -> jax.Array:
     """[N,K]: the score neighbor k holds of ME (sender-side publish gates
     seen from the receiving end)."""
-    return jnp.where(net.nbr_ok, scores[jnp.clip(net.nbr, 0), net.rev], 0.0)
+    return jnp.where(net.nbr_ok, edges.edge_permute(scores, net.edge_perm), 0.0)
 
 
 def topic_msg_words(msg_topic: jax.Array, n_topics: int) -> jax.Array:
@@ -381,13 +379,19 @@ def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     wants = ihave_in & ~st.core.dlv.have[:, None, :] & joined_words[:, None, :]
     wants = jnp.where(ok[:, :, None], wants, jnp.uint32(0))
 
-    budget = jnp.maximum(cfg.max_ihave_length - st.iasked, 0)  # gossipsub.go:655-658
-    asks = _prefix_cap_bits(wants, budget, m)
+    # the MaxIHaveLength ask budget (gossipsub.go:655-658) can only bind if
+    # one heartbeat's asks could exceed it; with msg_slots far below the cap
+    # (the iasked >= cap gate above already ran) skip the prefix-cap pass
+    if m * (cfg.heartbeat_every + 1) > cfg.max_ihave_length:
+        budget = jnp.maximum(cfg.max_ihave_length - st.iasked, 0)
+        asks = _prefix_cap_bits(wants, budget, m)
+    else:
+        asks = wants
     n_asked = bitset.popcount(asks, axis=-1)
     iasked = st.iasked + n_asked
 
     # adopt one promised mid per edge when none is outstanding
-    first_ask = jnp.argmax(bitset.unpack(asks, m), axis=-1).astype(jnp.int32)
+    first_ask, _has = bitset.lowest_bit(asks)
     adopt = (n_asked > 0) & (st.promise_mid < 0)
     promise_mid = jnp.where(adopt, first_ask, st.promise_mid)
     promise_expire = jnp.where(adopt, tick + cfg.iwant_followup_ticks, st.promise_expire)
@@ -450,29 +454,43 @@ def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState):
 # delivery-edge selection
 
 
+def sender_carry_words(mesh: jax.Array, slotw: jax.Array) -> jax.Array:
+    """[N,K,W] sender-side: words each peer would push on edge k — the OR
+    over its topic slots of (slot's topic messages) where the edge is in
+    that slot's mesh. Word algebra only."""
+    contrib = jnp.where(mesh[:, :, :, None], slotw[:, :, None, :], jnp.uint32(0))
+    return bitset.word_or_reduce(contrib, axis=1)  # [N,K,W]
+
+
 def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
-                     joined_words: jax.Array, acc_ok: jax.Array) -> jax.Array:
+                     joined_words: jax.Array, acc_ok: jax.Array,
+                     slotw: jax.Array) -> jax.Array:
     """[N,K,W] edge-carry mask: mesh push (forwarding along the sender's
     mesh, gossipsub.go:981-1002) + v1.1 flood-publish for origin-sent
-    messages (gossipsub.go:957-963), gated by the receiver's graylist."""
-    mesh_in = gather_edge_slots(st.mesh, net).transpose(0, 2, 1)  # [N,K,S]
-    mslot = msg_slot_of(net, st.core.msgs.topic)                  # [N,M]
-    n, k_dim = net.nbr.shape
-    m = mslot.shape[1]
-    idx = jnp.broadcast_to(jnp.clip(mslot, 0)[:, None, :], (n, k_dim, m))
-    carry_bits = jnp.take_along_axis(mesh_in, idx, axis=2) & (mslot >= 0)[:, None, :]
+    messages (gossipsub.go:957-963), gated by the receiver's graylist.
+
+    Sender-side packed outbox + word gather (no [N,K,M] traffic)."""
+    carry_out = sender_carry_words(st.mesh, slotw)  # [N,K,W] at sender
+    mask = jnp.where(
+        net.nbr_ok[:, :, None],
+        edges.edge_permute(carry_out, net.edge_perm),
+        jnp.uint32(0),
+    )
 
     if cfg.flood_publish:
+        # origin floods to every topic peer it scores above publishThreshold;
+        # elementwise compare fused into the pack
         origin_is_sender = st.core.msgs.origin[None, :] == net.nbr[..., None]  # [N,K,M]
         if cfg.score_enabled:
             flood_ok = gather_peer_scores(st.scores, net) >= cfg.publish_threshold
         else:
             flood_ok = net.nbr_ok
-        carry_bits = carry_bits | (
-            origin_is_sender & flood_ok[:, :, None] & (mslot >= 0)[:, None, :]
+        mask = mask | (
+            bitset.pack(origin_is_sender) & jnp.where(
+                flood_ok[:, :, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+            )
         )
 
-    mask = bitset.pack(carry_bits)
     mask = jnp.where(acc_ok[:, :, None], mask, jnp.uint32(0))
     return mask & joined_words[:, None, :]
 
@@ -487,8 +505,14 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
     recv = bitset.word_or_reduce(extra, axis=1)
     new_words = recv & ~dlv.have
     new_bits = bitset.unpack(new_words, m)
-    extra_bits = bitset.unpack(extra, m)
-    arrival_edge = jnp.argmax(extra_bits, axis=1).astype(jnp.int8)
+
+    def fe_body(k, carry):
+        bits = bitset.unpack(extra[:, k, :], m)
+        return jnp.where(bits & (carry < 0), k.astype(jnp.int8), carry)
+
+    arrival_edge = jax.lax.fori_loop(
+        0, extra.shape[1], fe_body, jnp.full(new_bits.shape, -1, jnp.int8)
+    )
     valid_words = bitset.pack(core.msgs.valid)
 
     dlv = dlv.replace(
@@ -518,7 +542,8 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
 
 
 def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
-              score_params: PeerScoreParams | None) -> GossipSubState:
+              score_params: PeerScoreParams | None,
+              nbr_sub: jax.Array) -> GossipSubState:
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -527,10 +552,10 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     events = st.core.events
 
     # applyIwantPenalties: broken promises -> P7 (gossipsub.go:1578-1583)
+    # (compare-reduce instead of a per-element gather: M is small)
     have_bits = bitset.unpack(st.core.dlv.have, m)  # [N,M]
-    promised_have = jnp.take_along_axis(
-        have_bits, jnp.clip(st.promise_mid, 0), axis=-1
-    )  # [N,K]
+    mid_eq = st.promise_mid[:, :, None] == jnp.arange(m, dtype=jnp.int32)[None, None, :]
+    promised_have = jnp.any(mid_eq & have_bits[:, None, :], axis=-1)  # [N,K]
     live = st.promise_mid >= 0
     fulfilled = live & promised_have
     broken = live & ~promised_have & (tick > st.promise_expire)
@@ -558,7 +583,6 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     # ---- mesh maintenance per (peer, topic-slot) ------------------------
     mesh = st.mesh
     slot_live = net.my_topics >= 0
-    nbr_sub = gather_nbr_subscribed(net)  # [N,S,K]
     connected = net.nbr_ok[:, None, :] & slot_live[:, :, None]
     scores_b = jnp.broadcast_to(scores[:, None, :], mesh.shape)
 
@@ -643,12 +667,9 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     target = jnp.maximum(cfg.Dlazy, (cfg.gossip_factor * n_cand).astype(jnp.int32))
     chosen = select_random_mask(k6, gossip_cand, target)  # [N,S,K]
 
-    tw = topic_msg_words(st.core.msgs.topic, net.n_topics)  # [T,W]
-    slot_tw = tw[jnp.clip(net.my_topics, 0)]                # [N,S,W]
-    slot_tw = jnp.where(slot_live[:, :, None], slot_tw, jnp.uint32(0))
+    slot_tw = slot_topic_words(net, st.core.msgs.topic)  # [N,S,W]
     adv = jnp.where(
-        chosen[..., None], (gwin[:, None, :] & slot_tw)[:, :, None, :]
-        * jnp.uint32(1), jnp.uint32(0)
+        chosen[..., None], (gwin[:, None, :] & slot_tw)[:, :, None, :], jnp.uint32(0)
     )  # [N,S,K,W]
     ihave_out = bitset.word_or_reduce(adv, axis=1)  # [N,K,W]
 
@@ -714,6 +735,9 @@ def make_gossipsub_step(
         tpa = TopicParamsArrays.build(score_params, net.n_topics)
     tp = tpa.gather(net.my_topics)
     window_rounds_t = jnp.asarray(tpa.window_rounds)
+    # static: which of my slots' topics each neighbor subscribes (computed
+    # eagerly once; a jit constant thereafter)
+    nbr_sub_const = gather_nbr_subscribed(net)
 
     def step(st: GossipSubState, pub_origin, pub_topic, pub_valid) -> GossipSubState:
         core = st.core
@@ -739,16 +763,16 @@ def make_gossipsub_step(
         st2 = handle_ihave(cfg, net, st2, joined_words, acc_ok)
 
         # 4. delivery: mesh push + flood-publish + IWANT responses
-        edge_mask = gossip_edge_mask(cfg, net, st2, joined_words, acc_ok)
+        slotw = slot_topic_words(net, core.msgs.topic)
+        edge_mask = gossip_edge_mask(cfg, net, st2, joined_words, acc_ok, slotw)
         dlv, info = delivery_round(net, core.msgs, core.dlv, edge_mask, tick)
         dlv, info = merge_extra_tx(net, core, dlv, info, iwant_resp, tick)
 
-        # 5. score delivery attribution
+        # 5. score delivery attribution (packed)
         score = st2.score
         if cfg.score_enabled:
-            arrivals = bitset.unpack(info.trans, m)
             score = on_deliveries(
-                score, net, st2.mesh, tp, arrivals, info.new_bits,
+                score, net, st2.mesh, tp, info.trans, info.new_words,
                 dlv.first_edge, dlv.first_round,
                 core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
             )
@@ -768,7 +792,8 @@ def make_gossipsub_step(
         served_lo = st2.served_lo & keep_words[None, None, :]
         served_hi = st2.served_hi & keep_words[None, None, :]
         reused_bits = bitset.unpack(~keep_words, m)  # [M]
-        promise_reused = reused_bits[jnp.clip(st2.promise_mid, 0)]
+        mid_eq = st2.promise_mid[:, :, None] == jnp.arange(m, dtype=jnp.int32)[None, None, :]
+        promise_reused = jnp.any(mid_eq & reused_bits[None, None, :], axis=-1)
         promise_mid = jnp.where(
             (st2.promise_mid >= 0) & promise_reused, -1, st2.promise_mid
         )
@@ -787,13 +812,18 @@ def make_gossipsub_step(
             score=score,
         )
 
-        # 8. heartbeat
-        st2 = jax.lax.cond(
-            (tick % cfg.heartbeat_every) == 0,
-            lambda s: heartbeat(cfg, net, s, tp, score_params),
-            lambda s: s,
-            st2,
-        )
+        # 8. heartbeat — inline when it runs every round (the default tick
+        # model); lax.cond otherwise. The cond carries the whole state
+        # through both branches, which costs real copies of the big arrays.
+        if cfg.heartbeat_every == 1:
+            st2 = heartbeat(cfg, net, st2, tp, score_params, nbr_sub_const)
+        else:
+            st2 = jax.lax.cond(
+                (tick % cfg.heartbeat_every) == 0,
+                lambda s: heartbeat(cfg, net, s, tp, score_params, nbr_sub_const),
+                lambda s: s,
+                st2,
+            )
 
         return st2.replace(core=st2.core.replace(tick=tick + 1))
 

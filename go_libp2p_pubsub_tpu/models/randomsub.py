@@ -19,11 +19,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import bitset
+from ..ops import bitset, edges
 from ..ops.select import select_random_mask
+from ..score.engine import slot_topic_words
 from ..state import Net, SimState, allocate_publishes
 from .common import accumulate_round_events, delivery_round
-from .gossipsub import gather_edge_slots, gather_nbr_subscribed, joined_msg_words, msg_slot_of
+from .gossipsub import gather_nbr_subscribed, joined_msg_words, sender_carry_words
 
 RANDOMSUB_D = 6  # randomsub.go:17
 
@@ -42,22 +43,25 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D):
         np.where(mt >= 0, target_t[np.clip(mt, 0, None)], 0)
     )  # [N,S]
 
+    eligible = gather_nbr_subscribed(net)  # [N,S,K] static, eager
+
     def step(st: SimState, pub_origin, pub_topic, pub_valid) -> SimState:
         tick = st.tick
         m = st.msgs.capacity
 
         # fresh random fanout per sender/slot/round
         key = jax.random.fold_in(st.key, tick)
-        eligible = gather_nbr_subscribed(net)  # [N,S,K]
         sel = select_random_mask(key, eligible, target_ns)  # [N,S,K]
 
-        # receiver view: sender chose me for the message's topic?
-        sel_in = gather_edge_slots(sel, net).transpose(0, 2, 1)  # [N,K,S]
-        mslot = msg_slot_of(net, st.msgs.topic)  # [N,M]
-        n, k_dim = net.nbr.shape
-        idx = jnp.broadcast_to(jnp.clip(mslot, 0)[:, None, :], (n, k_dim, m))
-        carry = jnp.take_along_axis(sel_in, idx, axis=2) & (mslot >= 0)[:, None, :]
-        edge_mask = bitset.pack(carry) & joined_msg_words(net, st.msgs)[:, None, :]
+        # sender-side packed outbox, word-gathered by receivers
+        slotw = slot_topic_words(net, st.msgs.topic)           # [N,S,W]
+        carry_out = sender_carry_words(sel, slotw)             # [N,K,W]
+        carried = jnp.where(
+            net.nbr_ok[:, :, None],
+            edges.edge_permute(carry_out, net.edge_perm),
+            jnp.uint32(0),
+        )
+        edge_mask = carried & joined_msg_words(net, st.msgs)[:, None, :]
 
         dlv, info = delivery_round(net, st.msgs, st.dlv, edge_mask, tick)
         msgs, dlv, _slots, is_pub, _keep, _pw = allocate_publishes(

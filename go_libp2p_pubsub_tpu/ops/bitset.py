@@ -74,6 +74,30 @@ def popcount(words: jax.Array, axis=None) -> jax.Array:
     return jnp.sum(counts.astype(jnp.int32), axis=axis)
 
 
+def lowest_bit(words: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(index, any): index of the lowest set bit along the packed last axis
+    (0 when empty — check `any`). Word-arithmetic only; no unpack."""
+    w = words.shape[-1]
+    nonzero = words != 0
+    any_set = jnp.any(nonzero, axis=-1)
+    first_w = jnp.argmax(nonzero, axis=-1)  # first nonzero word
+    word = jnp.take_along_axis(words, first_w[..., None], axis=-1)[..., 0]
+    # lowest set bit position within the word: popcount((w-1) & ~w)
+    lsb = jax.lax.population_count((word - 1) & ~word)
+    idx = first_w.astype(jnp.int32) * WORD + lsb.astype(jnp.int32)
+    return jnp.where(any_set, idx, 0), any_set
+
+
+def edge_eq_words(first_edge: jax.Array, k_dim: int) -> jax.Array:
+    """first_edge[N, M] i8 -> [N, K, W] packed: bit m of row (n,k) set iff
+    first_edge[n,m] == k. The packed form of the per-edge message-identity
+    compare used by echo suppression and first-delivery attribution; XLA
+    fuses the compare into the pack reduction without materializing
+    [N,K,M]."""
+    eq = first_edge[:, None, :] == jnp.arange(k_dim, dtype=jnp.int8)[None, :, None]
+    return pack(eq)
+
+
 def make_mask_below(n_bits_valid: jax.Array, total_bits: int) -> jax.Array:
     """uint32[W] word mask with the lowest `n_bits_valid` bits set."""
     w = n_words(total_bits)

@@ -1,0 +1,74 @@
+"""Edge-permutation gathers and topic-bit packing.
+
+The protocol's cross-peer reads all have the shape "receiver j reads the
+sender's per-edge outbox at [nbr[j,k], rev[j,k]]". A naive multi-index
+gather lowers to per-element gather HLO — pathologically slow on TPU. But
+(n,k) -> (nbr[n,k], rev[n,k]) is a *permutation* (an involution) of the
+N*K edge-slot space, so every such read is a 1-D row gather through a
+static flat index `perm = nbr*K + rev` — the fast TPU gather path.
+
+Topic-slot payloads ([N,S,K] per-slot bools) are moved across edges by
+packing the S axis into *topic-id bit positions* of uint32 words (T bits
+total), permuting the [N,K,Wt] words, and re-extracting bits at the
+receiver's own slot->topic mapping — the two peers' compressed topic axes
+never meet, only topic ids cross the wire (exactly like the reference's
+per-topic control messages).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WORD = 32
+
+
+def n_topic_words(n_topics: int) -> int:
+    return (n_topics + WORD - 1) // WORD
+
+
+def build_edge_perm(nbr: np.ndarray, rev: np.ndarray, nbr_ok: np.ndarray) -> np.ndarray:
+    """[N,K] i32 flat index into the edge-slot space; self-pointing where
+    no edge exists (harmless — callers mask with nbr_ok)."""
+    n, k = nbr.shape
+    own = np.arange(n * k, dtype=np.int32).reshape(n, k)
+    perm = np.clip(nbr, 0, None).astype(np.int32) * k + rev.astype(np.int32)
+    return np.where(nbr_ok, perm, own)
+
+
+def edge_permute(x: jax.Array, perm: jax.Array) -> jax.Array:
+    """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] as a flat row gather."""
+    n, k = perm.shape
+    flat = x.reshape((n * k,) + x.shape[2:])
+    return flat[perm.reshape(-1)].reshape(x.shape)
+
+
+def topic_pack(x: jax.Array, my_topics: jax.Array, n_topics: int) -> jax.Array:
+    """x[N,S,K] bool -> [N,K,Wt] u32 with bit t set on edge k iff the
+    sender's slot for topic t has x true."""
+    wt = n_topic_words(n_topics)
+    t = my_topics  # [N,S]
+    live = (t >= 0)[:, :, None]  # [N,S,1]
+    shift = (jnp.clip(t, 0) % WORD).astype(jnp.uint32)[:, :, None]
+    val = jnp.where(x & live, jnp.uint32(1) << shift, jnp.uint32(0))  # [N,S,K]
+    words = []
+    for w in range(wt):
+        in_word = ((jnp.clip(t, 0) // WORD) == w)[:, :, None]
+        contrib = jnp.where(in_word, val, jnp.uint32(0))
+        words.append(jax.lax.reduce(contrib, jnp.uint32(0), lambda a, b: a | b, (1,)))
+    return jnp.stack(words, axis=-1)  # [N,K,Wt]
+
+
+def topic_unpack(words: jax.Array, my_topics: jax.Array) -> jax.Array:
+    """[N,K,Wt] u32 -> [N,S,K] bool at the receiver's slot->topic mapping."""
+    t = my_topics  # [N,S]
+    tc = jnp.clip(t, 0)
+    shift = (tc % WORD).astype(jnp.uint32)[:, :, None]  # [N,S,1]
+    # static Wt loop: pick the word holding topic t's bit
+    out = jnp.zeros(t.shape + (words.shape[1],), jnp.uint32)  # [N,S,K]
+    for w in range(words.shape[-1]):
+        sel = ((tc // WORD) == w)[:, :, None]  # [N,S,1]
+        out = out | jnp.where(sel, words[..., w][:, None, :], jnp.uint32(0))
+    bits = (out >> shift) & jnp.uint32(1)
+    return bits.astype(bool) & (t >= 0)[:, :, None]

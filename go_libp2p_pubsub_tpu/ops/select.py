@@ -28,6 +28,11 @@ def rank_desc(values: jax.Array, mask: jax.Array, key: jax.Array | None = None) 
     ranks after all masked ones. Ties are broken uniformly at random when
     `key` is given (otherwise by slot index), matching the reference's
     shuffle-before-sort idiom (gossipsub.go:1391-1395).
+
+    Computed as an O(K^2) pairwise comparison count rather than a sort: the
+    neighbor axis K is small (<= 64) and padded-static, so the [.., K, K]
+    compare lowers to pure vector work on TPU — profiling showed the
+    lexsort/argsort formulation dominating the heartbeat.
     """
     if key is not None:
         noise = jax.random.uniform(key, values.shape)
@@ -35,10 +40,15 @@ def rank_desc(values: jax.Array, mask: jax.Array, key: jax.Array | None = None) 
         noise = jnp.zeros(values.shape)
     neg = jnp.float32(-jnp.inf)
     primary = jnp.where(mask, values.astype(jnp.float32), neg)
-    # two-key sort: primary desc, noise as tiebreak. jnp.lexsort sorts
-    # ascending with the LAST key primary.
-    order = jnp.lexsort((noise, -primary), axis=-1)
-    return jnp.argsort(order, axis=-1).astype(jnp.int32)
+    k = values.shape[-1]
+    idx = jnp.arange(k, dtype=jnp.int32)
+    pi, pj = primary[..., :, None], primary[..., None, :]
+    ni, nj = noise[..., :, None], noise[..., None, :]
+    # strict lexicographic "j outranks i": (p, noise, index) descending
+    ties = pj == pi
+    nties = nj == ni
+    outranks = (pj > pi) | (ties & (nj > ni)) | (ties & nties & (idx[None, :] < idx[:, None]))
+    return jnp.sum(outranks, axis=-1).astype(jnp.int32)
 
 
 def select_topk_mask(

@@ -1,0 +1,1 @@
+from .sharding import make_mesh, shard_state, state_shardings  # noqa: F401

@@ -1,0 +1,51 @@
+"""Device-mesh sharding of the peer axis (survey §2 checklist: the
+TPU-native distributed backend).
+
+The framework's parallelism is data-parallel-over-peers: every state array
+whose leading dimension is N is sharded along a 1-D 'peers' mesh axis;
+small global structures (the message table, event counters, RNG key) are
+replicated. Cross-peer traffic — the neighbor gathers x[nbr] in the
+delivery engine and control-plane handlers — lowers to XLA collectives
+over ICI (single host) / DCN (multi host) under GSPMD; the topology
+builders can be composed with a peer-id relabeling so that most mesh
+edges stay shard-local, keeping those collectives small.
+
+This replaces the reference's libp2p stream layer + per-peer goroutines
+(comm.go) — the "NCCL analogue" named in the survey — with compiler-
+inserted collectives, per the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA do the rest.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D device mesh over the peer axis."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("peers",))
+
+
+def state_shardings(state, mesh: Mesh, n_peers: int):
+    """Pytree of NamedShardings: leaves with leading dim == n_peers are
+    sharded along 'peers'; everything else is replicated."""
+    peer = NamedSharding(mesh, P("peers"))
+    repl = NamedSharding(mesh, P())
+
+    def choose(leaf):
+        if hasattr(leaf, "shape") and leaf.ndim >= 1 and leaf.shape[0] == n_peers:
+            return peer
+        return repl
+
+    return jax.tree_util.tree_map(choose, state)
+
+
+def shard_state(state, mesh: Mesh, n_peers: int):
+    """Place a state pytree onto the mesh with peer-axis sharding."""
+    return jax.device_put(state, state_shardings(state, mesh, n_peers))

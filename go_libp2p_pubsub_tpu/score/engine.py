@@ -24,6 +24,7 @@ import numpy as np
 from flax import struct
 
 from ..config import PeerScoreParams, ticks_for
+from ..ops import bitset
 from ..state import Net
 
 
@@ -259,13 +260,22 @@ def on_prune(st: ScoreState, prune_mask: jax.Array, tp: dict) -> ScoreState:
 # tensor
 
 
+def slot_topic_words(net: Net, msg_topic: jax.Array) -> jax.Array:
+    """[N, S, W] packed: messages belonging to the topic of my slot s."""
+    n_topics = net.subscribed.shape[1]
+    onehot_t = msg_topic[None, :] == jnp.arange(n_topics, dtype=jnp.int32)[:, None]
+    tw = bitset.pack(onehot_t)                      # [T, W]
+    stw = tw[jnp.clip(net.my_topics, 0)]            # [N, S, W]
+    return jnp.where((net.my_topics >= 0)[:, :, None], stw, jnp.uint32(0))
+
+
 def on_deliveries(
     st: ScoreState,
     net: Net,
     in_mesh: jax.Array,       # [N,S,K] bool
     tp: dict,
-    arrivals: jax.Array,      # [N,K,M] bool — this round's per-edge receipts
-    new_bits: jax.Array,      # [N,M] bool — first receipts this round
+    trans_words: jax.Array,   # [N,K,W] u32 — this round's per-edge receipts
+    new_words: jax.Array,     # [N,W] u32 — first receipts this round
     first_edge: jax.Array,    # [N,M] i8 — arrival edge of the first copy
     first_round: jax.Array,   # [N,M] i32 — validation round of each msg
     msg_topic: jax.Array,     # [M] i32
@@ -286,44 +296,43 @@ def on_deliveries(
       (markInvalidMessageDelivery via RejectMessage/DuplicateMessage,
       score.go:776-782, 811-813)
 
-    All three are (K x M) @ (M x S) per-peer contractions: arrivals against
-    the per-peer message-topic-slot onehot — MXU work, not scatter work.
-    """
+    Everything is packed-word algebra: per-(peer,slot,edge) counts are
+    popcounts of word-AND — no [N,K,M] gathers, casts, or einsums in the
+    hot path."""
     n, s_slots = net.my_topics.shape
+    k_dim = net.nbr.shape[1]
     m = msg_topic.shape[0]
-
-    # per-peer msg -> topic-slot onehot [N, M, S]
     t = jnp.clip(msg_topic, 0)
-    slot = jnp.where(msg_topic[None, :] >= 0, net.slot_of[:, t], -1)  # [N,M]
-    onehot = (slot[:, :, None] == jnp.arange(s_slots)[None, None, :]) & (slot[:, :, None] >= 0)
-    onehot_f = onehot.astype(jnp.float32)
 
-    def contract(edge_msg_mask):  # [N,K,M] bool -> [N,S,K] f32 counts
-        return jnp.einsum("nkm,nms->nsk", edge_msg_mask.astype(jnp.float32), onehot_f)
+    slotw = slot_topic_words(net, msg_topic)  # [N,S,W]
 
-    valid_b = msg_valid[None, :]  # [1,M]
+    def per_slot_counts(words):  # [N,K,W] -> [N,S,K] f32 popcounts
+        outs = [
+            bitset.popcount(words & slotw[:, s : s + 1, :], axis=-1)  # [N,K]
+            for s in range(s_slots)
+        ]
+        return jnp.stack(outs, axis=1).astype(jnp.float32)
+
+    valid_w = bitset.pack(msg_valid)  # [W]
 
     # -- P2/P3 credit for valid messages ------------------------------------
-    # first-arrival edge mask per (n,k,m)
-    is_first_edge = (
-        first_edge[:, None, :] == jnp.arange(net.max_degree, dtype=jnp.int8)[None, :, None]
-    )
-    first_arrival = arrivals & is_first_edge & new_bits[:, None, :] & valid_b[:, None, :]
-    fmd_inc = contract(first_arrival)
+    fe_words = bitset.edge_eq_words(first_edge, k_dim)  # [N,K,W]
+    first_arrival = trans_words & fe_words & new_words[:, None, :] & valid_w[None, None, :]
+    fmd_inc = per_slot_counts(first_arrival)
     e = lambda a: a[..., None]
     fmd = jnp.minimum(st.fmd + fmd_inc, e(tp["cap2"]))
 
     # mesh delivery credit: first arrivals + near-first (same round) + later
     # duplicates within the window; only on mesh edges, only valid msgs
-    msg_window = window_rounds_t[t]  # [M] per-message window in rounds
-    within = (tick - first_round) <= msg_window[None, :]  # [N,M]
-    mesh_credit = arrivals & valid_b[:, None, :] & within[:, None, :]
-    mmd_inc = contract(mesh_credit) * in_mesh.astype(jnp.float32)
+    msg_window = window_rounds_t[t]  # [M]
+    within_w = bitset.pack((tick - first_round) <= msg_window[None, :])  # [N,W]
+    mesh_credit = trans_words & valid_w[None, None, :] & within_w[:, None, :]
+    mmd_inc = per_slot_counts(mesh_credit) * in_mesh.astype(jnp.float32)
     mmd = jnp.minimum(st.mmd + mmd_inc, e(tp["cap3"]))
 
     # -- P4 penalty for invalid messages ------------------------------------
-    invalid_arrival = arrivals & ~valid_b[:, None, :]
-    imd = st.imd + contract(invalid_arrival)
+    invalid_arrival = trans_words & ~valid_w[None, None, :]
+    imd = st.imd + per_slot_counts(invalid_arrival)
 
     # unscored slots track nothing (getTopicStats, score.go:881-884)
     scored = e(tp["scored"])

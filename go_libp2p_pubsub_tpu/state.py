@@ -22,7 +22,7 @@ import numpy as np
 from flax import struct
 
 from . import graph as graphlib
-from .ops import bitset
+from .ops import bitset, edges
 from .trace.events import zero_counters
 
 
@@ -41,6 +41,8 @@ class Net:
     ip_group: jax.Array    # [N] i32 (P6 colocation key)
     direct: jax.Array      # [N, K] bool — direct (explicit) peering edges
                            # (WithDirectPeers, gossipsub.go:332-345)
+    edge_perm: jax.Array   # [N, K] i32 — flat (nbr*K + rev) edge involution
+                           # (ops/edges.py: the fast-path cross-peer gather)
 
     @classmethod
     def build(
@@ -65,6 +67,9 @@ class Net:
             slot_of=jnp.asarray(subs.slot_of),
             ip_group=jnp.asarray(ip_group),
             direct=jnp.asarray(direct),
+            edge_perm=jnp.asarray(
+                edges.build_edge_perm(topo.nbr, topo.rev, topo.nbr_ok)
+            ),
         )
 
     @property

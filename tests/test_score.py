@@ -20,6 +20,7 @@ from go_libp2p_pubsub_tpu.score import (
     on_prune,
     refresh_scores,
 )
+from go_libp2p_pubsub_tpu.ops.bitset import pack
 from go_libp2p_pubsub_tpu.score.engine import add_penalties
 from go_libp2p_pubsub_tpu.state import Net
 
@@ -122,8 +123,8 @@ class Harness:
             self.net,
             self.in_mesh,
             self.tp,
-            jnp.asarray(arrivals),
-            jnp.asarray(new_bits),
+            pack(jnp.asarray(arrivals)),
+            pack(jnp.asarray(new_bits)),
             jnp.asarray(self.first_edge),
             jnp.asarray(self.first_round),
             jnp.asarray(self.msg_topic),
@@ -236,7 +237,7 @@ def test_p3_near_first_duplicates_count():
         h.oracle.duplicate_delivery(2, 0, in_window=True)
         h.st = on_deliveries(
             h.st, h.net, h.in_mesh, h.tp,
-            jnp.asarray(arrivals), jnp.asarray(new_bits),
+            pack(jnp.asarray(arrivals)), pack(jnp.asarray(new_bits)),
             jnp.asarray(h.first_edge), jnp.asarray(h.first_round),
             jnp.asarray(h.msg_topic), jnp.asarray(h.msg_valid),
             tick, jnp.asarray(h.tpa.window_rounds),
