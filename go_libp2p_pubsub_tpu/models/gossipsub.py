@@ -56,6 +56,7 @@ from ..score.engine import (
     refresh_scores,
     slot_topic_words,
 )
+from ..score.gater import GaterState, gater_accept, gater_decay, gater_on_round
 from ..state import Net, SimState, allocate_publishes
 from ..trace.events import EV
 from .common import accumulate_round_events, delivery_round
@@ -94,6 +95,11 @@ class GossipSubConfig:
     score_enabled: bool = False
     flood_publish: bool = False
     do_px: bool = False
+    # peer gater + validation pipeline model (validation.go front-end queue;
+    # 0 capacity = unbounded, gater inert without throttle pressure)
+    gater_enabled: bool = False
+    gater_quiet_ticks: int = 60
+    validation_capacity: int = 0  # accepted validations per peer per round
     # thresholds (v1.1; zeros for v1.0)
     gossip_threshold: float = 0.0
     publish_threshold: float = 0.0
@@ -108,7 +114,11 @@ class GossipSubConfig:
         thresholds: PeerScoreThresholds | None = None,
         score_enabled: bool = False,
         heartbeat_every: int = 1,
+        gater_params: "PeerGaterParams | None" = None,
+        validation_capacity: int = 0,
     ) -> "GossipSubConfig":
+        from ..config import PeerGaterParams  # local: avoid name shadowing
+
         p = params or GossipSubParams()
         p.validate()
         hb = p.heartbeat_interval
@@ -128,6 +138,9 @@ class GossipSubConfig:
             score_enabled=score_enabled,
             flood_publish=p.flood_publish,
             do_px=p.do_px,
+            gater_enabled=gater_params is not None,
+            gater_quiet_ticks=ticks_for(gater_params.quiet, hb) if gater_params else 60,
+            validation_capacity=validation_capacity,
         )
         if thresholds is not None:
             thresholds.validate()
@@ -178,6 +191,8 @@ class GossipSubState:
                                 # gossipsub.go:1333-1341)
     p6: jax.Array               # [N,K] f32 colocation surplus^2 (static topo)
     app_score: jax.Array        # [N] f32 (P5)
+    # peer gater (peer_gater.go)
+    gater: GaterState
 
     @classmethod
     def init(
@@ -223,6 +238,7 @@ class GossipSubState:
             app_score=jnp.zeros((n,), jnp.float32)
             if app_score is None
             else jnp.asarray(app_score, jnp.float32),
+            gater=GaterState.empty(n, k),
         )
 
 
@@ -543,7 +559,7 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
 
 def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               score_params: PeerScoreParams | None,
-              nbr_sub: jax.Array) -> GossipSubState:
+              nbr_sub: jax.Array, gater_params=None) -> GossipSubState:
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -579,6 +595,12 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         scores = compute_scores(score, st.mesh, tp, score_params, st.p6, st.app_score, net)
     else:
         scores = st.scores
+
+    # gater counter decay (peer_gater.go:204-216; DecayInterval default ==
+    # the heartbeat interval)
+    gater_state = st.gater
+    if cfg.gater_enabled:
+        gater_state = gater_decay(gater_state, gater_params)
 
     # ---- mesh maintenance per (peer, topic-slot) ------------------------
     mesh = st.mesh
@@ -697,6 +719,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         promise_mid=promise_mid,
         score=score,
         scores=scores,
+        gater=gater_state,
     )
 
 
@@ -716,16 +739,50 @@ def gather_nbr_subscribed(net: Net) -> jax.Array:
 # the full per-round step
 
 
+def apply_validation_throttle(dlv, info, cap: int, m: int, valid_words):
+    """Model the validation front-end queue (validation.go:230-244 Push with
+    a full queue => RejectValidationThrottled): each peer validates at most
+    `cap` new receipts per round; overflow receipts are refused — not marked
+    seen, not forwarded, no score attribution (score.go:745-749,761-767).
+
+    Returns (dlv, info, accepted_new_words, n_throttled[N])."""
+    counts = bitset.popcount(info.new_words, axis=-1)  # [N]
+    accepted = _prefix_cap_bits(info.new_words, jnp.full_like(counts, cap), m)
+    refused = info.new_words & ~accepted
+    n_throttled = bitset.popcount(refused, axis=-1)
+
+    refused_bits = bitset.unpack(refused, m)
+    dlv = dlv.replace(
+        have=dlv.have & ~refused,
+        fwd=dlv.fwd & ~refused,
+        first_round=jnp.where(refused_bits, -1, dlv.first_round),
+        first_edge=jnp.where(refused_bits, jnp.int8(-1), dlv.first_edge),
+    )
+    n_ref = n_throttled.sum().astype(jnp.int32)
+    info = info.replace(
+        new_words=accepted,
+        new_bits=bitset.unpack(accepted, m),
+        # accepted-valid deliver; accepted-invalid + throttled trace Reject
+        n_deliver=bitset.popcount(accepted & valid_words[None, :], axis=-1).sum().astype(jnp.int32),
+        n_reject=bitset.popcount(accepted & ~valid_words[None, :], axis=-1).sum().astype(jnp.int32) + n_ref,
+    )
+    return dlv, info, accepted, n_throttled
+
+
 def make_gossipsub_step(
     cfg: GossipSubConfig,
     net: Net,
     score_params: PeerScoreParams | None = None,
     heartbeat_interval: float = 1.0,
+    gater_params=None,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
     step(state, pub_origin[P], pub_topic[P], pub_valid[P]) -> state
     """
+    if cfg.gater_enabled:
+        assert gater_params is not None
+        gater_params.validate()
     if cfg.score_enabled:
         assert score_params is not None
         score_params.validate()
@@ -744,12 +801,21 @@ def make_gossipsub_step(
         tick = core.tick
         m = core.msgs.capacity
 
-        # AcceptFrom gate (gossipsub.go:583-594): direct always; graylisted
-        # never. (The gater's RED drop is stage-5 work.)
+        # AcceptFrom gate (gossipsub.go:583-594): direct always accepted;
+        # graylisted dropped entirely; the gater's RED decision drops only
+        # the message plane (AcceptControl, peer_gater.go:362)
         if cfg.score_enabled:
             acc_ok = (st.scores >= cfg.graylist_threshold) | net.direct
         else:
             acc_ok = net.nbr_ok
+        if cfg.gater_enabled:
+            gkey = jax.random.fold_in(core.key, tick * 2 + 1)
+            acc_msg = acc_ok & (
+                gater_accept(st.gater, net, gater_params, cfg.gater_quiet_ticks, tick, gkey)
+                | net.direct
+            )
+        else:
+            acc_msg = acc_ok
 
         # 1. GRAFT/PRUNE ingest
         st2, prune_resp, n_graft, n_prune = handle_graft_prune(cfg, net, st, tp, acc_ok)
@@ -764,9 +830,21 @@ def make_gossipsub_step(
 
         # 4. delivery: mesh push + flood-publish + IWANT responses
         slotw = slot_topic_words(net, core.msgs.topic)
-        edge_mask = gossip_edge_mask(cfg, net, st2, joined_words, acc_ok, slotw)
+        pre_have = core.dlv.have
+        edge_mask = gossip_edge_mask(cfg, net, st2, joined_words, acc_msg, slotw)
         dlv, info = delivery_round(net, core.msgs, core.dlv, edge_mask, tick)
+        iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
         dlv, info = merge_extra_tx(net, core, dlv, info, iwant_resp, tick)
+
+        # 4b. validation front-end throttle (validation.go:230-244)
+        valid_words_all = bitset.pack(core.msgs.valid)
+        if cfg.validation_capacity > 0:
+            dlv, info, accepted_new, n_throttled = apply_validation_throttle(
+                dlv, info, cfg.validation_capacity, m, valid_words_all
+            )
+        else:
+            accepted_new = info.new_words
+            n_throttled = jnp.zeros((net.n_peers,), jnp.int32)
 
         # 5. score delivery attribution (packed)
         score = st2.score
@@ -775,6 +853,27 @@ def make_gossipsub_step(
                 score, net, st2.mesh, tp, info.trans, info.new_words,
                 dlv.first_edge, dlv.first_round,
                 core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
+            )
+
+        # 5b. gater outcome counters (the RawTracer hooks,
+        # peer_gater.go:365-443)
+        gater_state = st2.gater
+        if cfg.gater_enabled:
+            fe_words_post = bitset.edge_eq_words(dlv.first_edge, net.max_degree)
+            first_arrival = (
+                info.trans & fe_words_post & accepted_new[:, None, :]
+                & valid_words_all[None, None, :]
+            )
+            deliver_inc = bitset.popcount(first_arrival, axis=-1).astype(jnp.float32)
+            dup_inc = bitset.popcount(
+                info.trans & pre_have[:, None, :], axis=-1
+            ).astype(jnp.float32)
+            rej_inc = bitset.popcount(
+                info.trans & ~valid_words_all[None, None, :], axis=-1
+            ).astype(jnp.float32)
+            n_validated = bitset.popcount(accepted_new, axis=-1)
+            gater_state = gater_on_round(
+                gater_state, n_validated, n_throttled, deliver_inc, dup_inc, rej_inc, tick
             )
 
         # 6. mcache put: validated new receipts in joined topics
@@ -810,20 +909,19 @@ def make_gossipsub_step(
             graft_out=jnp.zeros_like(st2.graft_out),
             prune_out=prune_resp,
             score=score,
+            gater=gater_state,
         )
 
         # 8. heartbeat — inline when it runs every round (the default tick
         # model); lax.cond otherwise. The cond carries the whole state
         # through both branches, which costs real copies of the big arrays.
+        def hb(s):
+            return heartbeat(cfg, net, s, tp, score_params, nbr_sub_const, gater_params)
+
         if cfg.heartbeat_every == 1:
-            st2 = heartbeat(cfg, net, st2, tp, score_params, nbr_sub_const)
+            st2 = hb(st2)
         else:
-            st2 = jax.lax.cond(
-                (tick % cfg.heartbeat_every) == 0,
-                lambda s: heartbeat(cfg, net, s, tp, score_params, nbr_sub_const),
-                lambda s: s,
-                st2,
-            )
+            st2 = jax.lax.cond((tick % cfg.heartbeat_every) == 0, hb, lambda s: s, st2)
 
         return st2.replace(core=st2.core.replace(tick=tick + 1))
 
