@@ -100,6 +100,9 @@ class GossipSubConfig:
     gater_enabled: bool = False
     gater_quiet_ticks: int = 60
     validation_capacity: int = 0  # accepted validations per peer per round
+    # fanout (publishing to unjoined topics, gossipsub.go:981-1002,1517-1554)
+    fanout_slots: int = 2         # concurrent unjoined publish topics/peer
+    fanout_ttl_ticks: int = 60
     # thresholds (v1.1; zeros for v1.0)
     gossip_threshold: float = 0.0
     publish_threshold: float = 0.0
@@ -141,6 +144,7 @@ class GossipSubConfig:
             gater_enabled=gater_params is not None,
             gater_quiet_ticks=ticks_for(gater_params.quiet, hb) if gater_params else 60,
             validation_capacity=validation_capacity,
+            fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if thresholds is not None:
             thresholds.validate()
@@ -193,6 +197,11 @@ class GossipSubState:
     app_score: jax.Array        # [N] f32 (P5)
     # peer gater (peer_gater.go)
     gater: GaterState
+    # fanout: per-peer slots for topics published to without joining
+    # (gossipsub.go:444-447 fanout + lastpub maps)
+    fanout_topic: jax.Array    # [N,F] i32, -1 free
+    fanout_peers: jax.Array    # [N,F,K] bool
+    fanout_lastpub: jax.Array  # [N,F] i32
 
     @classmethod
     def init(
@@ -239,6 +248,9 @@ class GossipSubState:
             if app_score is None
             else jnp.asarray(app_score, jnp.float32),
             gater=GaterState.empty(n, k),
+            fanout_topic=jnp.full((n, cfg.fanout_slots), -1, jnp.int32),
+            fanout_peers=jnp.zeros((n, cfg.fanout_slots, k), bool),
+            fanout_lastpub=jnp.zeros((n, cfg.fanout_slots), jnp.int32),
         )
 
 
@@ -318,8 +330,9 @@ def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: d
     )
     backoff_present = st.backoff_present | prune_in
 
-    # handleGraft
-    want = graft_in & ~mesh & net.nbr_ok[:, None, :]
+    # handleGraft — a floodsub-only node doesn't speak meshsub and ignores
+    # GRAFTs entirely (gossipsub_feat.go)
+    want = graft_in & ~mesh & net.nbr_ok[:, None, :] & (net.protocol >= 1)[:, None, None]
 
     rej_direct = want & net.direct[:, None, :]  # gossipsub.go:742-750
 
@@ -478,20 +491,40 @@ def sender_carry_words(mesh: jax.Array, slotw: jax.Array) -> jax.Array:
     return bitset.word_or_reduce(contrib, axis=1)  # [N,K,W]
 
 
+def fanout_carry_words(fanout_peers: jax.Array, fanout_topic: jax.Array,
+                       tw: jax.Array) -> jax.Array:
+    """[N,K,W]: words each peer pushes on edge k for its fanout topics
+    (gossipsub.go:1000-1002 — fanout peers receive published messages of
+    unjoined topics)."""
+    live = (fanout_topic >= 0)[:, :, None]  # [N,F,1]
+    ftw = jnp.where(live, tw[jnp.clip(fanout_topic, 0)], jnp.uint32(0))  # [N,F,W]
+    contrib = jnp.where(fanout_peers[:, :, :, None], ftw[:, :, None, :], jnp.uint32(0))
+    return bitset.word_or_reduce(contrib, axis=1)
+
+
 def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
                      joined_words: jax.Array, acc_ok: jax.Array,
-                     slotw: jax.Array) -> jax.Array:
+                     slotw: jax.Array, tw: jax.Array,
+                     flood_edges: jax.Array) -> jax.Array:
     """[N,K,W] edge-carry mask: mesh push (forwarding along the sender's
-    mesh, gossipsub.go:981-1002) + v1.1 flood-publish for origin-sent
-    messages (gossipsub.go:957-963), gated by the receiver's graylist.
+    mesh, gossipsub.go:981-1002) + fanout push + floodsub-peer edges
+    (protocol negotiation, gossipsub.go:973-978) + v1.1 flood-publish for
+    origin-sent messages (gossipsub.go:957-963), gated by the receiver's
+    graylist/gater.
 
     Sender-side packed outbox + word gather (no [N,K,M] traffic)."""
-    carry_out = sender_carry_words(st.mesh, slotw)  # [N,K,W] at sender
+    carry_out = sender_carry_words(st.mesh, slotw) | fanout_carry_words(
+        st.fanout_peers, st.fanout_topic, tw
+    )
     mask = jnp.where(
         net.nbr_ok[:, :, None],
         edges.edge_permute(carry_out, net.edge_perm),
         jnp.uint32(0),
     )
+
+    # floodsub-semantics edges (either endpoint is a floodsub peer): the
+    # sender forwards everything; the receiver's joined filter applies below
+    mask = mask | jnp.where(flood_edges[:, :, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
 
     if cfg.flood_publish:
         # origin floods to every topic peer it scores above publishThreshold;
@@ -509,6 +542,71 @@ def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
 
     mask = jnp.where(acc_ok[:, :, None], mask, jnp.uint32(0))
     return mask & joined_words[:, None, :]
+
+
+def update_fanout_on_publish(
+    cfg: GossipSubConfig,
+    net: Net,
+    st: "GossipSubState",
+    pub_origin: jax.Array,  # [P] i32, -1 pad
+    pub_topic: jax.Array,   # [P] i32
+    key: jax.Array,
+    nbr_sub_words: jax.Array,  # [N,K,Wt] static: neighbors' topic-bit subs
+) -> "GossipSubState":
+    """Publishing to an unjoined topic creates/refreshes a fanout slot with
+    D random eligible peers (gossipsub.go:983-998) and stamps lastpub."""
+    tick = st.core.tick
+    p_dim = pub_origin.shape[0]
+    f_dim = cfg.fanout_slots
+    o = jnp.clip(pub_origin, 0)
+    t = jnp.clip(pub_topic, 0)
+    is_pub = pub_origin >= 0
+    joined = net.subscribed[o, t]
+    # floodsub-only origins flood instead of tracking fanout
+    need = is_pub & ~joined & (net.protocol[o] >= 1)
+
+    # find a slot: existing topic match, else the oldest slot
+    ftop_o = st.fanout_topic[o]  # [P,F]
+    match = ftop_o == t[:, None]
+    has_match = jnp.any(match & need[:, None], axis=1)
+    match_slot = jnp.argmax(match, axis=1)
+    oldest_slot = jnp.argmin(st.fanout_lastpub[o] + jnp.where(ftop_o >= 0, 0, -(2**30)), axis=1)
+    slot = jnp.where(has_match, match_slot, oldest_slot)  # [P]
+
+    # candidates for a fresh slot: connected, mesh-capable, subscribed to
+    # the topic, not direct, score >= publishThreshold
+    wt_idx = t // 32
+    bit = (t % 32).astype(jnp.uint32)
+    subw = nbr_sub_words[o]  # [P,K,Wt]
+    nbr_subbed = jnp.zeros((p_dim, net.max_degree), bool)
+    for w in range(nbr_sub_words.shape[-1]):
+        nbr_subbed = nbr_subbed | (
+            ((subw[..., w] >> bit[:, None]) & 1).astype(bool) & (wt_idx == w)[:, None]
+        )
+    cand = (
+        nbr_subbed
+        & net.nbr_ok[o]
+        & (net.protocol[jnp.clip(net.nbr[o], 0)] >= 1)
+        & ~net.direct[o]
+    )
+    if cfg.score_enabled:
+        cand = cand & (st.scores[o] >= cfg.publish_threshold)
+    sel = select_random_mask(key, cand, cfg.D)  # [P,K]
+
+    # scatter: new slots take the fresh selection; matched slots keep theirs
+    po = jnp.where(need, o, net.n_peers)  # OOB drop for non-fanout entries
+    fresh = need & ~has_match
+    fanout_topic = st.fanout_topic.at[po, slot].set(t, mode="drop")
+    fanout_lastpub = st.fanout_lastpub.at[po, slot].set(
+        jnp.broadcast_to(tick, t.shape), mode="drop"
+    )
+    po_fresh = jnp.where(fresh, o, net.n_peers)
+    fanout_peers = st.fanout_peers.at[po_fresh, slot].set(sel, mode="drop")
+    return st.replace(
+        fanout_topic=fanout_topic,
+        fanout_peers=fanout_peers,
+        fanout_lastpub=fanout_lastpub,
+    )
 
 
 def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
@@ -559,7 +657,8 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
 
 def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               score_params: PeerScoreParams | None,
-              nbr_sub: jax.Array, gater_params=None) -> GossipSubState:
+              nbr_sub: jax.Array, gater_params=None,
+              nbr_sub_words: jax.Array | None = None) -> GossipSubState:
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -603,8 +702,9 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         gater_state = gater_decay(gater_state, gater_params)
 
     # ---- mesh maintenance per (peer, topic-slot) ------------------------
+    # floodsub-only nodes run no mesh/gossip machinery at all
     mesh = st.mesh
-    slot_live = net.my_topics >= 0
+    slot_live = (net.my_topics >= 0) & (net.protocol >= 1)[:, None]
     connected = net.nbr_ok[:, None, :] & slot_live[:, :, None]
     scores_b = jnp.broadcast_to(scores[:, None, :], mesh.shape)
 
@@ -680,6 +780,42 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     )
     backoff_present = backoff_present | toprune
 
+    # ---- fanout maintenance (gossipsub.go:1517-1554) --------------------
+    ft = st.fanout_topic
+    fpeers = st.fanout_peers
+    flastpub = st.fanout_lastpub
+    tw = topic_msg_words(st.core.msgs.topic, net.n_topics)  # [T,W]
+    if nbr_sub_words is not None and cfg.fanout_slots > 0:
+        # expire by FanoutTTL since last publish (gossipsub.go:1518-1524)
+        expired = (ft >= 0) & (flastpub + cfg.fanout_ttl_ticks < tick)
+        ft = jnp.where(expired, -1, ft)
+        f_live = ft >= 0
+        fpeers = fpeers & f_live[:, :, None]
+        # drop peers below the publish threshold (gossipsub.go:1528-1534)
+        if cfg.score_enabled:
+            fpeers = fpeers & (scores[:, None, :] >= cfg.publish_threshold)
+        # neighbor-subscribes-fanout-topic via topic-bit extraction
+        fb = (jnp.clip(ft, 0) % 32).astype(jnp.uint32)[:, :, None]
+        fw = (jnp.clip(ft, 0) // 32)[:, :, None]
+        nbr_sub_f = jnp.zeros(fpeers.shape, bool)
+        for w in range(nbr_sub_words.shape[-1]):
+            nbr_sub_f = nbr_sub_f | (
+                ((nbr_sub_words[:, None, :, w] >> fb) & 1).astype(bool) & (fw == w)
+            )
+        mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
+        base_f = (
+            nbr_sub_f
+            & mesh_capable[:, None, :]
+            & ~net.direct[:, None, :]
+            & f_live[:, :, None]
+        )
+        cand_f = base_f & ~fpeers
+        if cfg.score_enabled:
+            cand_f = cand_f & (scores[:, None, :] >= cfg.publish_threshold)
+        ineed_f = jnp.where(f_live, cfg.D - count_true(fpeers), 0)
+        kf1, kf2 = jax.random.split(jax.random.fold_in(key, 11))
+        fpeers = fpeers | select_random_mask(kf1, cand_f, ineed_f)
+
     # ---- emitGossip (gossipsub.go:1669-1723) ----------------------------
     gwin = bitset.word_or_reduce(st.mcache[:, : cfg.history_gossip, :], axis=1)  # [N,W]
     gossip_cand = connected & nbr_sub & ~mesh & ~net.direct[:, None, :]
@@ -694,6 +830,24 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         chosen[..., None], (gwin[:, None, :] & slot_tw)[:, :, None, :], jnp.uint32(0)
     )  # [N,S,K,W]
     ihave_out = bitset.word_or_reduce(adv, axis=1)  # [N,K,W]
+
+    # fanout-topic gossip (gossipsub.go:1551-1553; fanout peers excluded)
+    if nbr_sub_words is not None and cfg.fanout_slots > 0:
+        gossip_cand_f = base_f & ~fpeers
+        if cfg.score_enabled:
+            gossip_cand_f = gossip_cand_f & (scores[:, None, :] >= cfg.gossip_threshold)
+        n_cand_f = count_true(gossip_cand_f)
+        target_f = jnp.where(
+            (ft >= 0),
+            jnp.maximum(cfg.Dlazy, (cfg.gossip_factor * n_cand_f).astype(jnp.int32)),
+            0,
+        )
+        chosen_f = select_random_mask(kf2, gossip_cand_f, target_f)  # [N,F,K]
+        ftw = jnp.where((ft >= 0)[:, :, None], tw[jnp.clip(ft, 0)], jnp.uint32(0))
+        adv_f = jnp.where(
+            chosen_f[..., None], (gwin[:, None, :] & ftw)[:, :, None, :], jnp.uint32(0)
+        )
+        ihave_out = ihave_out | bitset.word_or_reduce(adv_f, axis=1)
 
     # mcache.Shift (gossipsub.go:1563)
     mcache = jnp.concatenate(
@@ -720,6 +874,9 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         score=score,
         scores=scores,
         gater=gater_state,
+        fanout_topic=ft,
+        fanout_peers=fpeers,
+        fanout_lastpub=flastpub,
     )
 
 
@@ -792,9 +949,21 @@ def make_gossipsub_step(
         tpa = TopicParamsArrays.build(score_params, net.n_topics)
     tp = tpa.gather(net.my_topics)
     window_rounds_t = jnp.asarray(tpa.window_rounds)
-    # static: which of my slots' topics each neighbor subscribes (computed
-    # eagerly once; a jit constant thereafter)
-    nbr_sub_const = gather_nbr_subscribed(net)
+    # static per-topology constants (computed eagerly once; jit constants):
+    # mesh candidates require a mesh-capable far end (gossipsub_feat.go
+    # GossipSubFeatureMesh; checked at gossipsub.go:1374,1692)
+    mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
+    nbr_sub_const = gather_nbr_subscribed(net) & mesh_capable[:, None, :]
+    # floodsub-semantics edges: the far end only speaks /floodsub/1.0.0
+    flood_from = (net.protocol[jnp.clip(net.nbr, 0)] == 0) & net.nbr_ok
+    i_am_floodsub = net.protocol == 0
+    # neighbors' full subscriptions as topic-bit words (for fanout checks)
+    subscribed_words_t = bitset.pack(net.subscribed)  # [N, Wt]
+    nbr_sub_words = jnp.where(
+        net.nbr_ok[:, :, None],
+        subscribed_words_t[jnp.clip(net.nbr, 0)],
+        jnp.uint32(0),
+    )  # [N,K,Wt]
 
     def step(st: GossipSubState, pub_origin, pub_topic, pub_valid) -> GossipSubState:
         core = st.core
@@ -828,10 +997,21 @@ def make_gossipsub_step(
         joined_words = joined_msg_words(net, core.msgs)
         st2 = handle_ihave(cfg, net, st2, joined_words, acc_ok)
 
-        # 4. delivery: mesh push + flood-publish + IWANT responses
+        # 4. delivery: mesh/fanout push + flood edges + IWANT responses
         slotw = slot_topic_words(net, core.msgs.topic)
+        tw = topic_msg_words(core.msgs.topic, net.n_topics)
         pre_have = core.dlv.have
-        edge_mask = gossip_edge_mask(cfg, net, st2, joined_words, acc_msg, slotw)
+        # floodsub-peer edges: sender floodsub => flood; receiver floodsub
+        # => gossipsub sender still sends everything (score-gated,
+        # gossipsub.go:973-978)
+        if cfg.score_enabled:
+            recv_ok = gather_peer_scores(st2.scores, net) >= cfg.publish_threshold
+        else:
+            recv_ok = net.nbr_ok
+        flood_edges = flood_from | (i_am_floodsub[:, None] & recv_ok & net.nbr_ok)
+        edge_mask = gossip_edge_mask(
+            cfg, net, st2, joined_words, acc_msg, slotw, tw, flood_edges
+        )
         dlv, info = delivery_round(net, core.msgs, core.dlv, edge_mask, tick)
         iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
         dlv, info = merge_extra_tx(net, core, dlv, info, iwant_resp, tick)
@@ -897,6 +1077,13 @@ def make_gossipsub_step(
             (st2.promise_mid >= 0) & promise_reused, -1, st2.promise_mid
         )
 
+        # 7b. fanout slots for publishes to unjoined topics
+        if cfg.fanout_slots > 0:
+            st2 = update_fanout_on_publish(
+                cfg, net, st2, pub_origin, pub_topic,
+                jax.random.fold_in(core.key, tick * 2 + 5), nbr_sub_words,
+            )
+
         events = accumulate_round_events(events, info, jnp.sum(is_pub.astype(jnp.int32)))
         st2 = st2.replace(
             core=core.replace(msgs=msgs, dlv=dlv, events=events),
@@ -916,7 +1103,9 @@ def make_gossipsub_step(
         # model); lax.cond otherwise. The cond carries the whole state
         # through both branches, which costs real copies of the big arrays.
         def hb(s):
-            return heartbeat(cfg, net, s, tp, score_params, nbr_sub_const, gater_params)
+            return heartbeat(
+                cfg, net, s, tp, score_params, nbr_sub_const, gater_params, nbr_sub_words
+            )
 
         if cfg.heartbeat_every == 1:
             st2 = hb(st2)

@@ -43,6 +43,9 @@ class Net:
                            # (WithDirectPeers, gossipsub.go:332-345)
     edge_perm: jax.Array   # [N, K] i32 — flat (nbr*K + rev) edge involution
                            # (ops/edges.py: the fast-path cross-peer gather)
+    protocol: jax.Array    # [N] i8 — negotiated protocol per peer
+                           # (gossipsub_feat.go:11-36): 0 = /floodsub/1.0.0,
+                           # 1 = /meshsub/1.0.0, 2 = /meshsub/1.1.0
 
     @classmethod
     def build(
@@ -51,12 +54,15 @@ class Net:
         subs: graphlib.Subscriptions,
         ip_group: np.ndarray | None = None,
         direct: np.ndarray | None = None,
+        protocol: np.ndarray | None = None,
     ) -> "Net":
         n = topo.n_peers
         if ip_group is None:
             ip_group = np.arange(n, dtype=np.int32)  # unique IPs
         if direct is None:
             direct = np.zeros(topo.nbr.shape, bool)
+        if protocol is None:
+            protocol = np.full((n,), 2, np.int8)  # all /meshsub/1.1.0
         return cls(
             nbr=jnp.asarray(topo.nbr),
             nbr_ok=jnp.asarray(topo.nbr_ok),
@@ -70,6 +76,7 @@ class Net:
             edge_perm=jnp.asarray(
                 edges.build_edge_perm(topo.nbr, topo.rev, topo.nbr_ok)
             ),
+            protocol=jnp.asarray(protocol, jnp.int8),
         )
 
     @property

@@ -1,0 +1,112 @@
+"""Fanout (publish to unjoined topics) + protocol negotiation (floodsub
+peers inside gossipsub) — gossipsub.go:981-1002,1517-1554 and
+gossipsub_feat.go analogues."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.ops import bitset
+from go_libp2p_pubsub_tpu.state import Net
+
+
+def pub(o, t, p=4):
+    po = np.full(p, -1, np.int32)
+    pt = np.full(p, -1, np.int32)
+    pv = np.zeros(p, bool)
+    po[0], pt[0], pv[0] = o, t, True
+    return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+
+def run(step, st, k):
+    a = no_publish()
+    for _ in range(k):
+        st = step(st, *a)
+    return st
+
+
+def test_fanout_publish_to_unjoined_topic():
+    # peer 0 subscribes only topic 1 but publishes to topic 0: fanout slot
+    # is created and subscribers of topic 0 receive the message
+    n = 40
+    topo = graph.random_connect(n, 8, seed=3)
+    mask = np.zeros((n, 2), bool)
+    mask[:, 0] = True          # everyone on topic 0 ...
+    mask[0, 0] = False         # ... except the publisher
+    mask[0, 1] = True
+    subs = graph.subscribe_mask(mask, max_slots=2)
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build()
+    st = GossipSubState.init(net, 32, cfg, seed=0)
+    step = make_gossipsub_step(cfg, net)
+    st = run(step, st, 10)
+    st = step(st, *pub(0, 0))
+    # fanout slot exists with ~D peers
+    ftop = np.asarray(st.fanout_topic[0])
+    assert 0 in ftop.tolist()
+    slot = ftop.tolist().index(0)
+    assert int(st.fanout_peers[0, slot].sum()) >= 1
+    st = run(step, st, 12)
+    have = np.asarray(bitset.unpack(st.core.dlv.have, 32))[:, 0]
+    subscribers = mask[:, 0]
+    assert have[subscribers].mean() > 0.9, "fanout publish must reach topic"
+
+
+def test_fanout_expires():
+    n = 30
+    topo = graph.random_connect(n, 8, seed=5)
+    mask = np.zeros((n, 2), bool)
+    mask[:, 0] = True
+    mask[0, 0] = False
+    mask[0, 1] = True
+    subs = graph.subscribe_mask(mask, max_slots=2)
+    net = Net.build(topo, subs)
+    import dataclasses
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+    params = dataclasses.replace(GossipSubParams(), fanout_ttl=5.0)
+    cfg = GossipSubConfig.build(params)
+    st = GossipSubState.init(net, 32, cfg, seed=0)
+    step = make_gossipsub_step(cfg, net)
+    st = run(step, st, 5)
+    st = step(st, *pub(0, 0))
+    assert 0 in np.asarray(st.fanout_topic[0]).tolist()
+    st = run(step, st, 10)  # > FanoutTTL with no further publishes
+    assert 0 not in np.asarray(st.fanout_topic[0]).tolist(), "fanout must expire"
+
+
+def test_floodsub_peers_interop():
+    # a third of the peers only speak /floodsub/1.0.0: they are never
+    # grafted into meshes but still receive and propagate everything
+    n = 45
+    topo = graph.random_connect(n, 10, seed=7)
+    subs = graph.subscribe_all(n, 1)
+    protocol = np.full((n,), 2, np.int8)
+    flood_peers = np.arange(0, n, 3)
+    protocol[flood_peers] = 0
+    net = Net.build(topo, subs, protocol=protocol)
+    cfg = GossipSubConfig.build()
+    st = GossipSubState.init(net, 32, cfg, seed=0)
+    step = make_gossipsub_step(cfg, net)
+    st = run(step, st, 12)
+    # no mesh edges toward floodsub peers
+    mesh = np.asarray(st.mesh[:, 0, :])
+    for j in range(n):
+        for k in range(topo.max_degree):
+            if topo.nbr_ok[j, k] and protocol[topo.nbr[j, k]] == 0:
+                assert not mesh[j, k], "floodsub peers must not be grafted"
+    # gossipsub publisher: floodsub peers still receive
+    st = step(st, *pub(1, 0))
+    st = run(step, st, 10)
+    have = np.asarray(bitset.unpack(st.core.dlv.have, 32))[:, 0]
+    assert have.all(), "everyone incl. floodsub peers must receive"
+    # floodsub publisher: message still floods the whole network
+    st = step(st, *pub(int(flood_peers[0]), 0))
+    st = run(step, st, 10)
+    have = np.asarray(bitset.unpack(st.core.dlv.have, 32))[:, 1]
+    assert have.all(), "floodsub-originated message must reach everyone"
