@@ -48,6 +48,7 @@ from ..score.engine import (
     ScoreState,
     TopicParamsArrays,
     add_penalties,
+    clear_edges,
     compute_scores,
     ip_colocation_surplus_sq,
     on_deliveries,
@@ -202,6 +203,13 @@ class GossipSubState:
     fanout_topic: jax.Array    # [N,F] i32, -1 free
     fanout_peers: jax.Array    # [N,F,K] bool
     fanout_lastpub: jax.Array  # [N,F] i32
+    # peer lifecycle (dynamic_peers builds): effective liveness + blacklist.
+    # up models the notify/dead-peer plane (notify.go:19-75, handleDeadPeers
+    # pubsub.go:648-689); blacklist is the global-view blacklist
+    # (blacklist.go:12-64, enforced at pubsub.go:1048-1060,636-639) — a
+    # blacklisted peer is disconnected everywhere next round
+    up: jax.Array              # [N] bool
+    blacklist: jax.Array       # [N] bool
 
     @classmethod
     def init(
@@ -251,6 +259,8 @@ class GossipSubState:
             fanout_topic=jnp.full((n, cfg.fanout_slots), -1, jnp.int32),
             fanout_peers=jnp.zeros((n, cfg.fanout_slots, k), bool),
             fanout_lastpub=jnp.zeros((n, cfg.fanout_slots), jnp.int32),
+            up=jnp.ones((n,), bool),
+            blacklist=jnp.zeros((n,), bool),
         )
 
 
@@ -565,24 +575,37 @@ def update_fanout_on_publish(
     # floodsub-only origins flood instead of tracking fanout
     need = is_pub & ~joined & (net.protocol[o] >= 1)
 
-    # find a slot: existing topic match, else the oldest slot
+    # find a slot: existing topic match, else the oldest slot. Several
+    # same-round fresh publishes by one origin must land on *different*
+    # slots: offset each by its rank among that origin's earlier fresh
+    # entries (pairwise over the small P axis).
     ftop_o = st.fanout_topic[o]  # [P,F]
     match = ftop_o == t[:, None]
     has_match = jnp.any(match & need[:, None], axis=1)
     match_slot = jnp.argmax(match, axis=1)
     oldest_slot = jnp.argmin(st.fanout_lastpub[o] + jnp.where(ftop_o >= 0, 0, -(2**30)), axis=1)
-    slot = jnp.where(has_match, match_slot, oldest_slot)  # [P]
+    fresh = need & ~has_match
+    idx_p = jnp.arange(p_dim)
+    same_origin_before = (
+        fresh[None, :] & fresh[:, None]
+        & (o[None, :] == o[:, None]) & (idx_p[None, :] < idx_p[:, None])
+    )
+    fresh_rank = jnp.sum(same_origin_before.astype(jnp.int32), axis=1)  # [P]
+    slot = jnp.where(has_match, match_slot, (oldest_slot + fresh_rank) % f_dim)
+
+    # a matched slot whose peer set has emptied (churn, threshold filtering)
+    # is repopulated like a fresh one (gossipsub.go:983-989: empty fanout
+    # map entry => select peers anew)
+    match_empty = has_match & (
+        count_true(jnp.take_along_axis(st.fanout_peers[o], slot[:, None, None], axis=1)[:, 0, :]) == 0
+    )
+    fresh = fresh | match_empty
 
     # candidates for a fresh slot: connected, mesh-capable, subscribed to
     # the topic, not direct, score >= publishThreshold
-    wt_idx = t // 32
-    bit = (t % 32).astype(jnp.uint32)
-    subw = nbr_sub_words[o]  # [P,K,Wt]
-    nbr_subbed = jnp.zeros((p_dim, net.max_degree), bool)
-    for w in range(nbr_sub_words.shape[-1]):
-        nbr_subbed = nbr_subbed | (
-            ((subw[..., w] >> bit[:, None]) & 1).astype(bool) & (wt_idx == w)[:, None]
-        )
+    nbr_subbed = bitset.bit_get(
+        nbr_sub_words[o], jnp.broadcast_to(t[:, None], (p_dim, net.max_degree))
+    )
     cand = (
         nbr_subbed
         & net.nbr_ok[o]
@@ -595,7 +618,6 @@ def update_fanout_on_publish(
 
     # scatter: new slots take the fresh selection; matched slots keep theirs
     po = jnp.where(need, o, net.n_peers)  # OOB drop for non-fanout entries
-    fresh = need & ~has_match
     fanout_topic = st.fanout_topic.at[po, slot].set(t, mode="drop")
     fanout_lastpub = st.fanout_lastpub.at[po, slot].set(
         jnp.broadcast_to(tick, t.shape), mode="drop"
@@ -795,13 +817,13 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         if cfg.score_enabled:
             fpeers = fpeers & (scores[:, None, :] >= cfg.publish_threshold)
         # neighbor-subscribes-fanout-topic via topic-bit extraction
-        fb = (jnp.clip(ft, 0) % 32).astype(jnp.uint32)[:, :, None]
-        fw = (jnp.clip(ft, 0) // 32)[:, :, None]
-        nbr_sub_f = jnp.zeros(fpeers.shape, bool)
-        for w in range(nbr_sub_words.shape[-1]):
-            nbr_sub_f = nbr_sub_f | (
-                ((nbr_sub_words[:, None, :, w] >> fb) & 1).astype(bool) & (fw == w)
-            )
+        n_f, f_dim = ft.shape
+        nbr_sub_f = bitset.bit_get(
+            jnp.broadcast_to(
+                nbr_sub_words[:, None, :, :], (n_f, f_dim) + nbr_sub_words.shape[1:]
+            ),
+            jnp.broadcast_to(jnp.clip(ft, 0)[:, :, None], fpeers.shape),
+        )
         mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
         base_f = (
             nbr_sub_f
@@ -932,10 +954,18 @@ def make_gossipsub_step(
     score_params: PeerScoreParams | None = None,
     heartbeat_interval: float = 1.0,
     gater_params=None,
+    dynamic_peers: bool = False,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
     step(state, pub_origin[P], pub_topic[P], pub_valid[P]) -> state
+
+    With ``dynamic_peers=True`` the step takes an extra ``up_next [N] bool``
+    argument (the notify plane, notify.go:19-75): peers transitioning down
+    — or blacklisted via ``state.blacklist`` — are disconnected with full
+    dead-peer cleanup (handleDeadPeers pubsub.go:648-689 + router
+    RemovePeer gossipsub.go:545-562 + score retention score.go:604-637),
+    and every edge touching a down peer carries nothing until it returns.
     """
     if cfg.gater_enabled:
         assert gater_params is not None
@@ -965,7 +995,59 @@ def make_gossipsub_step(
         jnp.uint32(0),
     )  # [N,K,Wt]
 
-    def step(st: GossipSubState, pub_origin, pub_topic, pub_valid) -> GossipSubState:
+    def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next) -> GossipSubState:
+        # ---- peer lifecycle transitions (dynamic_peers only) ------------
+        if dynamic_peers:
+            senders = jnp.clip(net.nbr, 0)
+            eff_next = up_next & ~st.blacklist
+            down_tr = st.up & ~eff_next
+            up_tr = ~st.up & eff_next
+            down_nbr = down_tr[senders] & net.nbr_ok
+            # every edge touching a down peer dies (both directions; a
+            # restarting node comes back with fresh soft state)
+            down_edge = (down_nbr | down_tr[:, None]) & net.nbr_ok
+            de3 = down_edge[:, None, :]
+            score0 = st.score
+            if cfg.score_enabled:
+                # retention: neighbor stats survive disconnect only while
+                # negative (score.go:604-637); a restarting node forgets all
+                clear_mask = (down_nbr & (st.scores >= 0)) | down_tr[:, None]
+                score0 = clear_edges(score0, clear_mask)
+            dlv0 = st.core.dlv.replace(
+                fwd=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.fwd)
+            )
+            ev0 = (
+                st.core.events
+                .at[EV.REMOVE_PEER].add(jnp.sum(down_tr.astype(jnp.int32)))
+                .at[EV.ADD_PEER].add(jnp.sum(up_tr.astype(jnp.int32)))
+            )
+            st = st.replace(
+                core=st.core.replace(dlv=dlv0, events=ev0),
+                mesh=st.mesh & ~de3,
+                fanout_peers=st.fanout_peers & ~de3,
+                graft_out=st.graft_out & ~de3,
+                prune_out=st.prune_out & ~de3,
+                ihave_out=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.ihave_out),
+                iwant_out=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.iwant_out),
+                served_lo=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.served_lo),
+                served_hi=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.served_hi),
+                peerhave=jnp.where(down_edge, 0, st.peerhave),
+                iasked=jnp.where(down_edge, 0, st.iasked),
+                promise_mid=jnp.where(down_edge, -1, st.promise_mid),
+                score=score0,
+                up=eff_next,
+            )
+            live = net.nbr_ok & st.up[:, None] & st.up[senders]
+            net_l = net.replace(nbr_ok=live)
+            nbr_sub_l = nbr_sub_const & live[:, None, :]
+            flood_from_l = flood_from & live
+            nbr_sub_words_l = jnp.where(live[:, :, None], nbr_sub_words, jnp.uint32(0))
+        else:
+            net_l = net
+            nbr_sub_l = nbr_sub_const
+            flood_from_l = flood_from
+            nbr_sub_words_l = nbr_sub_words
+
         core = st.core
         tick = core.tick
         m = core.msgs.capacity
@@ -974,47 +1056,50 @@ def make_gossipsub_step(
         # graylisted dropped entirely; the gater's RED decision drops only
         # the message plane (AcceptControl, peer_gater.go:362)
         if cfg.score_enabled:
-            acc_ok = (st.scores >= cfg.graylist_threshold) | net.direct
+            acc_ok = (st.scores >= cfg.graylist_threshold) | net_l.direct
         else:
-            acc_ok = net.nbr_ok
+            acc_ok = net_l.nbr_ok
         if cfg.gater_enabled:
-            gkey = jax.random.fold_in(core.key, tick * 2 + 1)
+            # per-subsystem streams: double fold with a distinct tag so no
+            # round's stream collides with another subsystem's at any tick
+            # (heartbeat consumes fold_in(key, tick) directly)
+            gkey = jax.random.fold_in(jax.random.fold_in(core.key, tick), 0x6A7E)
             acc_msg = acc_ok & (
-                gater_accept(st.gater, net, gater_params, cfg.gater_quiet_ticks, tick, gkey)
-                | net.direct
+                gater_accept(st.gater, net_l, gater_params, cfg.gater_quiet_ticks, tick, gkey)
+                | net_l.direct
             )
         else:
             acc_msg = acc_ok
 
         # 1. GRAFT/PRUNE ingest
-        st2, prune_resp, n_graft, n_prune = handle_graft_prune(cfg, net, st, tp, acc_ok)
-        events = core.events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
+        st2, prune_resp, n_graft, n_prune = handle_graft_prune(cfg, net_l, st, tp, acc_ok)
+        events = st.core.events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
 
         # 2. IWANT service (requests sent to me last round -> delivery carry)
-        st2, iwant_resp = iwant_responses(cfg, net, st2)
+        st2, iwant_resp = iwant_responses(cfg, net_l, st2)
 
         # 3. IHAVE ingest (advertisements -> next round's requests)
-        joined_words = joined_msg_words(net, core.msgs)
-        st2 = handle_ihave(cfg, net, st2, joined_words, acc_ok)
+        joined_words = joined_msg_words(net_l, core.msgs)
+        st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok)
 
         # 4. delivery: mesh/fanout push + flood edges + IWANT responses
-        slotw = slot_topic_words(net, core.msgs.topic)
-        tw = topic_msg_words(core.msgs.topic, net.n_topics)
+        slotw = slot_topic_words(net_l, core.msgs.topic)
+        tw = topic_msg_words(core.msgs.topic, net_l.n_topics)
         pre_have = core.dlv.have
         # floodsub-peer edges: sender floodsub => flood; receiver floodsub
         # => gossipsub sender still sends everything (score-gated,
         # gossipsub.go:973-978)
         if cfg.score_enabled:
-            recv_ok = gather_peer_scores(st2.scores, net) >= cfg.publish_threshold
+            recv_ok = gather_peer_scores(st2.scores, net_l) >= cfg.publish_threshold
         else:
-            recv_ok = net.nbr_ok
-        flood_edges = flood_from | (i_am_floodsub[:, None] & recv_ok & net.nbr_ok)
+            recv_ok = net_l.nbr_ok
+        flood_edges = flood_from_l | (i_am_floodsub[:, None] & recv_ok & net_l.nbr_ok)
         edge_mask = gossip_edge_mask(
-            cfg, net, st2, joined_words, acc_msg, slotw, tw, flood_edges
+            cfg, net_l, st2, joined_words, acc_msg, slotw, tw, flood_edges
         )
-        dlv, info = delivery_round(net, core.msgs, core.dlv, edge_mask, tick)
+        dlv, info = delivery_round(net_l, core.msgs, core.dlv, edge_mask, tick)
         iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
-        dlv, info = merge_extra_tx(net, core, dlv, info, iwant_resp, tick)
+        dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick)
 
         # 4b. validation front-end throttle (validation.go:230-244)
         valid_words_all = bitset.pack(core.msgs.valid)
@@ -1030,7 +1115,7 @@ def make_gossipsub_step(
         score = st2.score
         if cfg.score_enabled:
             score = on_deliveries(
-                score, net, st2.mesh, tp, info.trans, info.new_words,
+                score, net_l, st2.mesh, tp, info.trans, info.new_words,
                 dlv.first_edge, dlv.first_round,
                 core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
             )
@@ -1039,7 +1124,7 @@ def make_gossipsub_step(
         # peer_gater.go:365-443)
         gater_state = st2.gater
         if cfg.gater_enabled:
-            fe_words_post = bitset.edge_eq_words(dlv.first_edge, net.max_degree)
+            fe_words_post = bitset.edge_eq_words(dlv.first_edge, net_l.max_degree)
             first_arrival = (
                 info.trans & fe_words_post & accepted_new[:, None, :]
                 & valid_words_all[None, None, :]
@@ -1080,8 +1165,9 @@ def make_gossipsub_step(
         # 7b. fanout slots for publishes to unjoined topics
         if cfg.fanout_slots > 0:
             st2 = update_fanout_on_publish(
-                cfg, net, st2, pub_origin, pub_topic,
-                jax.random.fold_in(core.key, tick * 2 + 5), nbr_sub_words,
+                cfg, net_l, st2, pub_origin, pub_topic,
+                jax.random.fold_in(jax.random.fold_in(core.key, tick), 0xFA40),
+                nbr_sub_words_l,
             )
 
         events = accumulate_round_events(events, info, jnp.sum(is_pub.astype(jnp.int32)))
@@ -1104,7 +1190,7 @@ def make_gossipsub_step(
         # through both branches, which costs real copies of the big arrays.
         def hb(s):
             return heartbeat(
-                cfg, net, s, tp, score_params, nbr_sub_const, gater_params, nbr_sub_words
+                cfg, net_l, s, tp, score_params, nbr_sub_l, gater_params, nbr_sub_words_l
             )
 
         if cfg.heartbeat_every == 1:
@@ -1114,6 +1200,13 @@ def make_gossipsub_step(
 
         return st2.replace(core=st2.core.replace(tick=tick + 1))
 
+    if dynamic_peers:
+        def step(st, pub_origin, pub_topic, pub_valid, up_next):
+            return _round(st, pub_origin, pub_topic, pub_valid, up_next)
+    else:
+        def step(st, pub_origin, pub_topic, pub_valid):
+            return _round(st, pub_origin, pub_topic, pub_valid, None)
+
     return jax.jit(step, donate_argnums=0)
 
 
@@ -1121,3 +1214,11 @@ def no_publish(p: int = 4):
     """Empty publish buffers."""
     z = jnp.full((p,), -1, jnp.int32)
     return z, z, jnp.zeros((p,), bool)
+
+
+def set_blacklist(st: GossipSubState, mask) -> GossipSubState:
+    """BlacklistPeer (pubsub.go:590-605): host-side toggle; takes effect on
+    the next dynamic_peers step with full disconnect cleanup, and keeps the
+    peer disconnected for as long as the flag is set (the blacklist checks
+    at pubsub.go:1048-1060 and connection-time :636-639)."""
+    return st.replace(blacklist=jnp.asarray(mask, bool))

@@ -245,6 +245,27 @@ def on_graft(st: ScoreState, graft_mask: jax.Array, tick) -> ScoreState:
     )
 
 
+def clear_edges(st: ScoreState, mask: jax.Array) -> ScoreState:
+    """Reset all per-edge score stats where mask [N,K] — the disconnect path
+    (score.go:604-637 removePeer): a peer leaving with a *non-negative*
+    score has its stats deleted immediately; negative scores are retained so
+    disconnect/reconnect can't wash them (the caller computes the mask
+    accordingly). Retained stats keep decaying via refresh_scores, which
+    matches the reference's decay-to-zero during the retention window."""
+    m3 = mask[:, None, :]
+    z = lambda a: jnp.where(m3, jnp.zeros_like(a), a)
+    return st.replace(
+        fmd=z(st.fmd),
+        mmd=z(st.mmd),
+        mfp=z(st.mfp),
+        imd=z(st.imd),
+        graft_tick=jnp.where(m3, -1, st.graft_tick),
+        mesh_time=jnp.where(m3, 0, st.mesh_time),
+        mmd_active=st.mmd_active & ~m3,
+        bp=jnp.where(mask, 0.0, st.bp),
+    )
+
+
 def on_prune(st: ScoreState, prune_mask: jax.Array, tp: dict) -> ScoreState:
     """prune_mask [N,S,K]: edges leaving the mesh. Applies the sticky mesh
     failure penalty when pruned while active and below threshold
