@@ -70,6 +70,12 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0):
 
 def main():
     import jax
+
+    # the image's sitecustomize pins the axon TPU platform; BENCH_PLATFORM
+    # overrides it through jax.config (env vars are clobbered at startup)
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
 
     n_peers = int(os.environ.get("BENCH_N", 100_000))

@@ -1,0 +1,633 @@
+"""Application API: the reference's L6 surface (topic.go, subscription.go,
+pubsub.go Join/Subscribe/Publish) over the vectorized engine.
+
+A `Network` owns one simulation (all N nodes in one device program — the
+TPU-idiomatic replacement for N processes with event loops); each `Node` is
+the per-peer API view a go-libp2p-pubsub user would hold:
+
+    net = Network(router="gossipsub")
+    a, b = net.add_node(), net.add_node()
+    net.connect(a, b)
+    ta, tb = a.join("news"), b.join("news")
+    sub = tb.subscribe()
+    net.start()
+    ta.publish(b"hello")
+    net.run(3)
+    msg = sub.next()            # pb.Message with from/seqno/signature
+
+Reference-surface mapping (citations into /root/reference):
+  Node.join / Topic           — PubSub.Join + tryJoin (pubsub.go:1146-1197)
+  Topic.subscribe             — topic.go:135-173 (buffered chan 32,
+                                drop-if-slow pubsub.go:905-916)
+  Topic.relay                 — refcounted relaying, topic.go:178-199
+  Topic.publish               — topic.go:211-249 (build+sign+seqno, local
+                                validation push validation.go:216-226)
+  Topic.event_handler         — PeerJoin/PeerLeave log, topic.go:305-390
+  Node.register_topic_validator — pubsub.go:1297 + validation.go:391-438
+  Node.blacklist_peer         — pubsub.go:590-605 (global-view in the
+                                vectorized engine; see state.py docstring)
+  Network.connect/_all/sparse/dense — the test topology helpers
+                                (floodsub_test.go:57-99)
+
+Static-after-start contract: topology and the topic universe freeze at
+`start()` (they are jit constants of the compiled step). Subscriptions,
+relays, validators, publishes, churn, and blacklists are all live. This is
+the explicit trade the survey §7 architecture makes; mid-run Join of a
+*new* topic raises rather than silently recompiling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from . import graph as graphlib
+from .blacklist import MapBlacklist
+from .config import (
+    GossipSubParams,
+    PeerGaterParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    default_peer_score_params,
+)
+from .pb import rpc_pb2
+from .sign import Identity, SignPolicy, check_signing_policy, sign_message
+from .state import Net, SimState
+from .subscription_filter import SubscriptionFilter
+from .trace.drain import TraceSession, snapshot
+
+# validation defaults (validation.go:13-17)
+DEFAULT_VALIDATE_THROTTLE = 8192
+DEFAULT_TOPIC_THROTTLE = 1024
+SUBSCRIPTION_BUFFER = 32  # pubsub.go chan size; drop-if-slow
+
+
+class APIError(RuntimeError):
+    pass
+
+
+class ValidationError(APIError):
+    """Local publish rejected (reject or throttle), like PushLocal errors."""
+
+
+PEER_JOIN = "PEER_JOIN"
+PEER_LEAVE = "PEER_LEAVE"
+
+
+class Subscription:
+    """Buffered delivery queue (subscription.go). `next()` returns the next
+    pb.Message or None when empty; messages beyond the buffer are dropped
+    and counted (the reference's drop-if-slow, pubsub.go:909-914)."""
+
+    def __init__(self, topic: "Topic", buffer: int = SUBSCRIPTION_BUFFER):
+        self.topic = topic
+        self._q: deque = deque()
+        self._buffer = buffer
+        self.dropped = 0
+        self.cancelled = False
+
+    def next(self):
+        if self._q:
+            return self._q.popleft()
+        return None
+
+    def __iter__(self):
+        while self._q:
+            yield self._q.popleft()
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        self.topic._subs.discard(self)
+
+    def _push(self, msg) -> None:
+        if len(self._q) >= self._buffer:
+            self.dropped += 1
+            return
+        self._q.append(msg)
+
+
+class TopicEventHandler:
+    """Coalescing PeerJoin/PeerLeave event log (topic.go:305-390)."""
+
+    def __init__(self, topic: "Topic"):
+        self.topic = topic
+        self._q: deque = deque()
+        # coalescing: one pending state per peer (the reference's event log
+        # keeps only the latest transition per peer)
+        self._pending: dict[bytes, str] = {}
+
+    def _emit(self, kind: str, peer: bytes) -> None:
+        prev = self._pending.get(peer)
+        if prev == kind:
+            return
+        if prev is not None and prev != kind:
+            # join then leave (or vice versa) coalesces to nothing
+            del self._pending[peer]
+            self._q = deque((k, p) for k, p in self._q if p != peer)
+            return
+        self._pending[peer] = kind
+        self._q.append((kind, peer))
+
+    def next_event(self):
+        if not self._q:
+            return None
+        kind, peer = self._q.popleft()
+        self._pending.pop(peer, None)
+        return kind, peer
+
+
+@dataclasses.dataclass
+class _Validator:
+    fn: Callable
+    inline: bool
+    throttle: int
+
+
+class Topic:
+    """Per-(node, topic) handle; one per topic per node (pubsub.go:1146)."""
+
+    def __init__(self, node: "Node", name: str, tid: int):
+        self.node = node
+        self.name = name
+        self.tid = tid
+        self._subs: set[Subscription] = set()
+        self._relays = 0
+        self._handlers: list[TopicEventHandler] = []
+        self.closed = False
+
+    # -- subscription ------------------------------------------------------
+
+    def subscribe(self, buffer: int = SUBSCRIPTION_BUFFER) -> Subscription:
+        sub = Subscription(self, buffer)
+        self._subs.add(sub)
+        return sub
+
+    def relay(self) -> Callable[[], None]:
+        """Keep forwarding this topic without delivering locally
+        (topic.go:178-199). Returns the cancel closure."""
+        self._relays += 1
+        done = [False]
+
+        def cancel():
+            if not done[0]:
+                done[0] = True
+                self._relays -= 1
+
+        return cancel
+
+    def event_handler(self) -> TopicEventHandler:
+        h = TopicEventHandler(self)
+        self._handlers.append(h)
+        # replay current membership as joins (reference primes from
+        # ListPeers at handler creation)
+        for other in self.node.network._topic_members(self.tid):
+            if other is not self.node and other.up:
+                h._emit(PEER_JOIN, other.identity.peer_id)
+        return h
+
+    # -- publish -----------------------------------------------------------
+
+    def publish(self, data: bytes) -> bytes:
+        """Build, sign, locally validate, and enqueue a message for the next
+        round (topic.go:211-249 -> validation.PushLocal). Returns the
+        message id."""
+        if self.closed:
+            raise APIError("topic handle closed")
+        return self.node.network._publish(self.node, self, data)
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class Node:
+    """One simulated peer's API endpoint."""
+
+    def __init__(self, network: "Network", idx: int, identity: Identity,
+                 protocol: str, ip: str | None,
+                 sub_filter: SubscriptionFilter | None):
+        self.network = network
+        self.idx = idx
+        self.identity = identity
+        self.protocol = protocol
+        self.ip = ip
+        self.sub_filter = sub_filter
+        self.topics: dict[str, Topic] = {}
+        self.blacklist = MapBlacklist()
+        self.up = True
+        self._seqno = 0
+
+    @property
+    def peer_id(self) -> bytes:
+        return self.identity.peer_id
+
+    # -- topic lifecycle ---------------------------------------------------
+
+    def join(self, topic: str) -> Topic:
+        """Join a topic (subscribes the node at the protocol level). One
+        handle per topic; joining again returns it (pubsub.go:1146-1157)."""
+        if topic in self.topics:
+            return self.topics[topic]
+        if self.sub_filter is not None and not self.sub_filter.can_subscribe(topic):
+            raise APIError(f"subscription filter rejects topic {topic!r}")
+        t = self.network._join(self, topic)
+        self.topics[topic] = t
+        return t
+
+    def leave(self, topic: str) -> None:
+        t = self.topics.pop(topic, None)
+        if t is not None:
+            t.close()
+            self.network._leave(self, t)
+
+    # -- validators --------------------------------------------------------
+
+    def register_topic_validator(self, topic: str, fn: Callable,
+                                 inline: bool = False,
+                                 throttle: int = DEFAULT_TOPIC_THROTTLE) -> None:
+        """fn(peer_id, pb.Message) -> bool/None; False rejects. Inline
+        validators run synchronously (WithValidatorInline); async ones are
+        subject to global + per-topic throttles (validation.go:391-438)."""
+        self.network._register_validator(topic, _Validator(fn, inline, throttle))
+
+    def unregister_topic_validator(self, topic: str) -> None:
+        self.network._unregister_validator(topic)
+
+    # -- lifecycle / moderation -------------------------------------------
+
+    def blacklist_peer(self, peer: bytes) -> None:
+        """BlacklistPeer (pubsub.go:590-605). In the vectorized engine the
+        blacklist is global-view: the peer is disconnected from the whole
+        simulation on the next round."""
+        self.blacklist.add(peer)
+        self.network._refresh_blacklist()
+
+    def disconnect(self) -> None:
+        self.up = False
+
+    def reconnect(self) -> None:
+        self.up = True
+
+    def peer_scores(self) -> dict[bytes, float]:
+        """Score snapshot for this node's neighbors (WithPeerScoreInspect,
+        score.go:120-177)."""
+        return self.network._peer_scores(self)
+
+
+class Network:
+    """The simulation owner: topology assembly -> start() -> run()."""
+
+    def __init__(
+        self,
+        router: str = "gossipsub",
+        params: GossipSubParams | None = None,
+        score_params: PeerScoreParams | None = None,
+        thresholds: PeerScoreThresholds | None = None,
+        gater_params: PeerGaterParams | None = None,
+        sign_policy: SignPolicy = SignPolicy.STRICT_SIGN,
+        msg_slots: int = 64,
+        max_publishes_per_round: int = 8,
+        validate_throttle: int = DEFAULT_VALIDATE_THROTTLE,
+        seed: int = 0,
+        trace_sinks=None,
+        msg_id_fn: Callable | None = None,
+    ):
+        if router not in ("gossipsub", "floodsub", "randomsub"):
+            raise APIError(f"unknown router {router!r}")
+        self.router = router
+        self.params = params or GossipSubParams()
+        self.score_params = score_params
+        self.thresholds = thresholds or PeerScoreThresholds()
+        self.gater_params = gater_params
+        self.sign_policy = sign_policy
+        self.msg_slots = msg_slots
+        self.pub_width = max_publishes_per_round
+        self.validate_throttle = validate_throttle
+        self.seed = seed
+        self.trace_sinks = trace_sinks
+        self.msg_id_fn = msg_id_fn or default_msg_id
+        self.nodes: list[Node] = []
+        self.topic_ids: dict[str, int] = {}
+        self._edges: set[tuple[int, int]] = set()
+        self._validators: dict[str, _Validator] = {}
+        self._pub_queue: deque = deque()
+        self._slot_msg: dict[int, rpc_pb2.Message] = {}
+        self._seen_mids: dict[bytes, int] = {}  # msgid -> slot
+        self.started = False
+        self._session: TraceSession | None = None
+        self.state = None
+        self.net = None
+        self._async_budget = validate_throttle
+        self._topic_budget: dict[str, int] = {}
+
+    # -- assembly ----------------------------------------------------------
+
+    def add_node(self, protocol: str = "/meshsub/1.1.0", ip: str | None = None,
+                 sub_filter: SubscriptionFilter | None = None,
+                 seed: int | None = None) -> Node:
+        self._check_not_started("add_node")
+        idx = len(self.nodes)
+        ident = Identity.generate(self.seed * 1_000_003 + idx if seed is None else seed)
+        node = Node(self, idx, ident, protocol, ip, sub_filter)
+        self.nodes.append(node)
+        return node
+
+    def add_nodes(self, n: int, **kw) -> list[Node]:
+        return [self.add_node(**kw) for _ in range(n)]
+
+    def connect(self, a: Node, b: Node) -> None:
+        """a dials b (direction recorded for the outbound quota)."""
+        self._check_not_started("connect")
+        if a.idx == b.idx:
+            raise APIError("self connection")
+        self._edges.add((a.idx, b.idx))
+
+    def connect_all(self) -> None:
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1:]:
+                self.connect(a, b)
+
+    def sparse_connect(self, d: int = 3, seed: int = 0) -> None:
+        """Each node dials d random others (floodsub_test.go:72-79)."""
+        rng = np.random.default_rng(seed)
+        n = len(self.nodes)
+        for a in self.nodes:
+            for j in rng.choice(n, size=min(d + 1, n), replace=False):
+                if j != a.idx:
+                    self.connect(a, self.nodes[int(j)])
+
+    def dense_connect(self, d: int = 10, seed: int = 0) -> None:
+        self.sparse_connect(d, seed)
+
+    # -- internal assembly hooks ------------------------------------------
+
+    def _check_not_started(self, what: str) -> None:
+        if self.started:
+            raise APIError(f"{what} after start(): topology is frozen (jit constant)")
+
+    def _join(self, node: Node, topic: str) -> Topic:
+        if self.started and topic not in self.topic_ids:
+            raise APIError("cannot create a new topic after start()")
+        tid = self.topic_ids.setdefault(topic, len(self.topic_ids))
+        t = Topic(node, topic, tid)
+        if self.started:
+            raise APIError("join after start() not supported yet")
+        return t
+
+    def _leave(self, node: Node, t: Topic) -> None:
+        self._check_not_started("leave")
+
+    def _topic_members(self, tid: int):
+        return [n for n in self.nodes if any(t.tid == tid for t in n.topics.values())]
+
+    def _register_validator(self, topic: str, v: _Validator) -> None:
+        if topic in self._validators:
+            raise APIError(f"duplicate validator for topic {topic!r}")
+        self._validators[topic] = v
+
+    def _unregister_validator(self, topic: str) -> None:
+        if topic not in self._validators:
+            raise APIError(f"no validator for topic {topic!r}")
+        del self._validators[topic]
+
+    # -- start: freeze + compile ------------------------------------------
+
+    def start(self) -> None:
+        if self.started:
+            return
+        import jax.numpy as jnp
+
+        from .models.gossipsub import (
+            GossipSubConfig,
+            GossipSubState,
+            make_gossipsub_step,
+        )
+        from .models.randomsub import make_randomsub_step
+
+        n = len(self.nodes)
+        if n == 0:
+            raise APIError("empty network")
+        n_topics = max(1, len(self.topic_ids))
+
+        dialed = [set() for _ in range(n)]
+        for a, b in self._edges:
+            dialed[a].add(b)
+        topo = graphlib._from_edge_lists(n, dialed, None)
+
+        sub_mask = np.zeros((n, n_topics), bool)
+        for node in self.nodes:
+            for t in node.topics.values():
+                sub_mask[node.idx, t.tid] = True
+        subs = graphlib.subscribe_mask(sub_mask)
+
+        proto_code = {"/floodsub/1.0.0": 0, "/meshsub/1.0.0": 1, "/meshsub/1.1.0": 2}
+        protocol = np.array([proto_code[nd.protocol] for nd in self.nodes], np.int8)
+        ip_names = [nd.ip if nd.ip is not None else f"ip-{nd.idx}" for nd in self.nodes]
+        ip_tbl: dict[str, int] = {}
+        ip_group = np.array([ip_tbl.setdefault(s, len(ip_tbl)) for s in ip_names], np.int32)
+
+        self.net = Net.build(topo, subs, ip_group=ip_group, protocol=protocol)
+        self.topic_names = {tid: name for name, tid in self.topic_ids.items()}
+
+        if self.router == "gossipsub":
+            sp = self.score_params
+            score_enabled = sp is not None
+            cfg = GossipSubConfig.build(
+                self.params, self.thresholds,
+                score_enabled=score_enabled,
+                gater_params=self.gater_params,
+            )
+            self.state = GossipSubState.init(
+                self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed
+            )
+            self._step = make_gossipsub_step(
+                cfg, self.net, score_params=sp,
+                gater_params=self.gater_params, dynamic_peers=True,
+            )
+            self._dynamic = True
+        elif self.router == "randomsub":
+            self.state = SimState.init(n, self.msg_slots, self.seed)
+            self._step = make_randomsub_step(self.net)
+            self._dynamic = False
+        else:  # floodsub
+            from .models.floodsub import floodsub_step
+
+            self.state = SimState.init(n, self.msg_slots, self.seed)
+
+            def _fstep(st, po, pt, pv, _net=self.net):
+                return floodsub_step(_net, st, po, pt, pv)
+
+            self._step = _fstep
+            self._dynamic = False
+
+        self._jnp = jnp
+        self.started = True
+        if self.trace_sinks:
+            self._session = TraceSession(
+                self.net, self.trace_sinks,
+                topic_name=lambda t: self.topic_names.get(t, f"topic-{t}"),
+            )
+            self._session.emit_init(snapshot(self.state))
+
+    # -- publish path ------------------------------------------------------
+
+    def _publish(self, node: Node, topic: Topic, data: bytes) -> bytes:
+        if not self.started:
+            raise APIError("publish before start()")
+        msg = rpc_pb2.Message(data=data, topic=topic.name)
+        if self.sign_policy in (SignPolicy.STRICT_SIGN, SignPolicy.LAX_SIGN):
+            setattr(msg, "from", node.identity.peer_id)
+            msg.seqno = node._seqno.to_bytes(8, "big")
+            node._seqno += 1
+            if self.sign_policy.signs:
+                sign_message(msg, node.identity)
+        # local validation front-end (PushLocal validation.go:216-226):
+        # signing policy, then inline + async validators
+        check_signing_policy(self.sign_policy, msg)
+        valid = self._run_validators(node, topic, msg, local=True)
+        mid = self.msg_id_fn(msg)
+        self._pub_queue.append((node.idx, topic.tid, valid, msg, mid))
+        # local delivery to the publisher's own subscriptions happens at
+        # publish (publishMessage -> notifySubs, pubsub.go:1124-1128)
+        for sub in list(topic._subs):
+            if not sub.cancelled:
+                sub._push(msg)
+        return mid
+
+    def _run_validators(self, node: Node, topic: Topic, msg, local: bool) -> bool:
+        v = self._validators.get(topic.name)
+        if v is None:
+            return True
+        if not v.inline:
+            tb = self._topic_budget.setdefault(topic.name, v.throttle)
+            if self._async_budget <= 0 or tb <= 0:
+                # throttled: local publishes error out (validation.go:241-244)
+                raise ValidationError("validation throttled")
+            self._async_budget -= 1
+            self._topic_budget[topic.name] = tb - 1
+        res = v.fn(node.identity.peer_id, msg)
+        if res is False:
+            if local:
+                raise ValidationError("message rejected by validator")
+            return False
+        return True
+
+    # -- run loop ----------------------------------------------------------
+
+    def run(self, rounds: int = 1) -> None:
+        """Advance the simulation; distributes queued publishes over the
+        first rounds (pub_width per round) and drains deliveries into
+        subscriptions after each round."""
+        if not self.started:
+            self.start()
+        jnp = self._jnp
+        # per-run validation throttle budgets (the reference's are
+        # steady-state queue depths; one run() is our quantum)
+        self._async_budget = self.validate_throttle
+        self._topic_budget = {}
+
+        for _ in range(rounds):
+            po = np.full(self.pub_width, -1, np.int32)
+            pt = np.zeros(self.pub_width, np.int32)
+            pv = np.zeros(self.pub_width, bool)
+            batch = []
+            for j in range(self.pub_width):
+                if not self._pub_queue:
+                    break
+                origin, tid, valid, msg, mid = self._pub_queue.popleft()
+                po[j], pt[j], pv[j] = origin, tid, valid
+                batch.append((msg, mid))
+
+            prev = snapshot(self.state)
+            args = (self.state, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+            if self._dynamic:
+                up = np.array([nd.up and not self._blacklisted(nd) for nd in self.nodes])
+                self.state = self._step(*args, jnp.asarray(up))
+            else:
+                self.state = self._step(*args)
+            new = snapshot(self.state)
+            if prev.up is not None and new.up is not None:
+                self._emit_membership_events(prev.up, new.up)
+
+            # record slot -> message for delivery fan-out
+            is_pub = po >= 0
+            pos = np.cumsum(is_pub) - 1
+            slots = (prev.cursor + pos) % self.msg_slots
+            for j, (msg, mid) in zip(np.nonzero(is_pub)[0], batch):
+                slot = int(slots[j])
+                self._slot_msg[slot] = msg
+                self._seen_mids[mid] = slot
+
+            if self._session is not None:
+                self._session.observe(prev, new, po, pt, pv)
+            self._drain_deliveries(prev, new)
+
+    def _blacklisted(self, node: Node) -> bool:
+        pid = node.identity.peer_id
+        return any(other.blacklist.contains(pid) for other in self.nodes)
+
+    def _refresh_blacklist(self) -> None:
+        pass  # evaluated per round in run()
+
+    def _emit_membership_events(self, prev_up: np.ndarray, up: np.ndarray) -> None:
+        changed = np.nonzero(prev_up != up)[0]
+        if changed.size == 0:
+            return
+        for node in self.nodes:
+            for t in node.topics.values():
+                for h in t._handlers:
+                    for i in changed:
+                        other = self.nodes[int(i)]
+                        if other is node or t.name not in other.topics:
+                            continue
+                        h._emit(PEER_JOIN if up[i] else PEER_LEAVE,
+                                other.identity.peer_id)
+
+    def _drain_deliveries(self, prev, new) -> None:
+        """First receipts this round -> subscription queues (notifySubs,
+        pubsub.go:905-916) + remote validator execution for visibility."""
+        recv = (new.first_round == prev.tick) & (new.first_edge >= 0) & \
+            new.msg_valid[None, :]
+        peers, mslots = np.nonzero(recv)
+        for p, s in zip(peers.tolist(), mslots.tolist()):
+            msg = self._slot_msg.get(s)
+            if msg is None:
+                continue
+            node = self.nodes[p]
+            t = node.topics.get(msg.topic)
+            if t is None:
+                continue
+            for sub in list(t._subs):
+                if not sub.cancelled:
+                    sub._push(msg)
+
+    def _peer_scores(self, node: Node) -> dict[bytes, float]:
+        st = self.state
+        if not hasattr(st, "scores"):
+            return {}
+        scores = np.asarray(st.scores)[node.idx]
+        nbr = np.asarray(self.net.nbr)[node.idx]
+        ok = np.asarray(self.net.nbr_ok)[node.idx]
+        return {
+            self.nodes[int(nbr[k])].identity.peer_id: float(scores[k])
+            for k in range(len(nbr)) if ok[k]
+        }
+
+    def stop(self) -> None:
+        if self._session is not None:
+            self._session.close(snapshot(self.state))
+            self._session = None
+
+
+def default_msg_id(msg: rpc_pb2.Message) -> bytes:
+    """DefaultMsgIdFn: from || seqno (pubsub.go:1041-1043); falls back to a
+    content hash when unsigned (anonymous mode needs WithMessageIdFn in the
+    reference; hashing is the customary choice)."""
+    frm = getattr(msg, "from")
+    if frm or msg.seqno:
+        return frm + msg.seqno
+    import hashlib
+
+    return hashlib.sha256(msg.data + msg.topic.encode()).digest()

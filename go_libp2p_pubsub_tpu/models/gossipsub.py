@@ -49,6 +49,7 @@ from ..score.engine import (
     TopicParamsArrays,
     add_penalties,
     clear_edges,
+    clear_mesh_status,
     compute_scores,
     ip_colocation_surplus_sq,
     on_deliveries,
@@ -1009,12 +1010,22 @@ def make_gossipsub_step(
             de3 = down_edge[:, None, :]
             score0 = st.score
             if cfg.score_enabled:
-                # retention: neighbor stats survive disconnect only while
-                # negative (score.go:604-637); a restarting node forgets all
+                # removePeer (score.go:604-637): first convert any standing
+                # P3 deficit on mesh edges of the departing peer into the
+                # one-shot sticky P3b penalty, then drop in-mesh status on
+                # every dead edge; only then delete stats — except retained
+                # (negative-score) neighbors, whose counters keep decaying
+                score0 = on_prune(score0, st.mesh & down_nbr[:, None, :], tp)
+                score0 = clear_mesh_status(score0, down_nbr)
                 clear_mask = (down_nbr & (st.scores >= 0)) | down_tr[:, None]
                 score0 = clear_edges(score0, clear_mask)
+            # a crashing node loses all soft state: seen-cache, forward set,
+            # receipt history (it will re-receive after restart), mcache
             dlv0 = st.core.dlv.replace(
-                fwd=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.fwd)
+                have=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.have),
+                fwd=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.fwd),
+                first_round=jnp.where(down_tr[:, None], -1, st.core.dlv.first_round),
+                first_edge=jnp.where(down_tr[:, None], jnp.int8(-1), st.core.dlv.first_edge),
             )
             ev0 = (
                 st.core.events
@@ -1023,6 +1034,7 @@ def make_gossipsub_step(
             )
             st = st.replace(
                 core=st.core.replace(dlv=dlv0, events=ev0),
+                mcache=jnp.where(down_tr[:, None, None], jnp.uint32(0), st.mcache),
                 mesh=st.mesh & ~de3,
                 fanout_peers=st.fanout_peers & ~de3,
                 graft_out=st.graft_out & ~de3,

@@ -1,0 +1,16 @@
+"""Generated wire-schema bindings (protoc output of the .proto files here).
+
+Regenerate after editing a schema:
+    protoc -I go_libp2p_pubsub_tpu/pb --python_out=go_libp2p_pubsub_tpu/pb \
+        go_libp2p_pubsub_tpu/pb/*.proto
+
+Schemas are wire-compatible with the reference's pb/rpc.proto,
+pb/trace.proto and compat/compat.proto (field-by-field; see each .proto
+header for citations).
+"""
+
+from . import pubsub_compat_pb2 as compat_pb2
+from . import pubsub_rpc_pb2 as rpc_pb2
+from . import pubsub_trace_pb2 as trace_pb2
+
+__all__ = ["rpc_pb2", "trace_pb2", "compat_pb2"]

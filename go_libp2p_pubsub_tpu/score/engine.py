@@ -266,6 +266,21 @@ def clear_edges(st: ScoreState, mask: jax.Array) -> ScoreState:
     )
 
 
+def clear_mesh_status(st: ScoreState, mask: jax.Array) -> ScoreState:
+    """Clear in-mesh bookkeeping (graft tick, mesh time, P3 activation) on
+    every edge in mask [N,K] — the removePeer path's "no longer in any mesh"
+    step (score.go:614-625), applied to retained *and* deleted stats alike.
+    Without this, a retained (negative-score) peer's mmd_active would stay
+    latched while mmd decays, turning the P3 deficit into a permanent
+    penalty instead of the one-shot P3b conversion the reference applies."""
+    m3 = mask[:, None, :]
+    return st.replace(
+        graft_tick=jnp.where(m3, -1, st.graft_tick),
+        mesh_time=jnp.where(m3, 0, st.mesh_time),
+        mmd_active=st.mmd_active & ~m3,
+    )
+
+
 def on_prune(st: ScoreState, prune_mask: jax.Array, tp: dict) -> ScoreState:
     """prune_mask [N,S,K]: edges leaving the mesh. Applies the sticky mesh
     failure penalty when pruned while active and below threshold

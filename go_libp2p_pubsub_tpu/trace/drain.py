@@ -1,0 +1,264 @@
+"""Device→host trace drain.
+
+The reference calls its tracer inline from every protocol action
+(trace.go:63-530). The vectorized loop cannot call host code per event, so
+tracing is *reconstructive*: the drain snapshots the small trace-relevant
+slices of device state each round, diffs consecutive snapshots, and emits
+`TraceEvent` protos in the reference schema (pb/pubsub_trace.proto) to any
+set of sinks (sinks.py).
+
+Fidelity contract (documented, tested):
+  exact per-event — PUBLISH_MESSAGE, DELIVER_MESSAGE, REJECT_MESSAGE
+    (first receipts carry the arrival edge in `first_edge`), GRAFT/PRUNE
+    (mesh diffs), ADD_PEER/REMOVE_PEER (liveness diffs), JOIN/LEAVE,
+    SEND_RPC/RECV_RPC for every message-bearing first-delivery RPC,
+    DROP_RPC from the outbound-queue model (overflow beyond `queue_cap`
+    messages per edge per round — pubsub.go:240's 32-deep queue).
+  aggregate-only — duplicate arrivals and control-only RPCs are counted
+    exactly in the device event counters (state.core.events, see
+    events.py) but not expanded into per-event records; `counter_events()`
+    exposes those totals. Propagation analysis (latency CDFs — the north
+    star's tracestat parity) uses first-deliveries only, which are exact.
+
+Identity: peer ids are stable opaque bytes from the peer index; message ids
+follow DefaultMsgIdFn = from || seqno (pubsub.go:1041-1043) with per-origin
+monotone seqnos (pubsub.go:1259-1264) assigned host-side at publish.
+Timestamps are tick * tick_ns (integer time base — survey §7: the reference
+already quantizes to heartbeat ticks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..pb import trace_pb2
+from .events import EV
+
+PROTOCOL_NAMES = {0: "/floodsub/1.0.0", 1: "/meshsub/1.0.0", 2: "/meshsub/1.1.0"}
+
+
+def peer_id(i: int) -> bytes:
+    """Stable opaque peer-id bytes for a peer index."""
+    return b"sim-peer-%08d" % int(i)
+
+
+def message_id(origin_id: bytes, seqno: int) -> bytes:
+    """DefaultMsgIdFn: from || seqno (pubsub.go:1041-1043)."""
+    return origin_id + int(seqno).to_bytes(8, "big")
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """Host copy of the trace-relevant state slices for one round."""
+
+    tick: int
+    cursor: int
+    msg_topic: np.ndarray    # [M]
+    msg_origin: np.ndarray   # [M]
+    msg_valid: np.ndarray    # [M]
+    first_round: np.ndarray  # [N,M]
+    first_edge: np.ndarray   # [N,M]
+    events: np.ndarray       # [N_EVENTS]
+    mesh: np.ndarray | None = None  # [N,S,K]
+    up: np.ndarray | None = None    # [N]
+
+
+def snapshot(st) -> Snapshot:
+    """Pull a Snapshot from any router state: GossipSubState (exposes
+    `.core`) or a bare SimState; mesh/up captured when present."""
+    core = getattr(st, "core", st)
+    return Snapshot(
+        tick=int(core.tick),
+        cursor=int(core.msgs.cursor),
+        msg_topic=np.asarray(core.msgs.topic),
+        msg_origin=np.asarray(core.msgs.origin),
+        msg_valid=np.asarray(core.msgs.valid),
+        first_round=np.asarray(core.dlv.first_round),
+        first_edge=np.asarray(core.dlv.first_edge),
+        events=np.asarray(core.events),
+        mesh=np.asarray(st.mesh) if hasattr(st, "mesh") else None,
+        up=np.asarray(st.up) if hasattr(st, "up") else None,
+    )
+
+
+class TraceSession:
+    """Reconstructive tracer over a simulation run.
+
+    Usage:
+        sess = TraceSession(net, [sink...], tick_ns=10**9)
+        sess.emit_init(snapshot(st))
+        for each round:
+            prev = snapshot(st); st = step(st, po, pt, pv)
+            sess.observe(prev, snapshot(st), po, pt, pv)
+        sess.close(snapshot(st))
+    """
+
+    def __init__(self, net, sinks, tick_ns: int = 10**9, queue_cap: int = 32,
+                 topic_name=None):
+        self.sinks = list(sinks)
+        self.tick_ns = tick_ns
+        self.queue_cap = queue_cap
+        self.topic_name = topic_name or (lambda t: f"topic-{t}")
+        self.nbr = np.asarray(net.nbr)
+        self.my_topics = np.asarray(net.my_topics)
+        self.subscribed = np.asarray(net.subscribed)
+        self.protocol = np.asarray(net.protocol)
+        n = self.nbr.shape[0]
+        self.peer_ids = [peer_id(i) for i in range(n)]
+        self.seqno = np.zeros(n, np.int64)       # per-origin counters
+        m_cap = None  # learned from first snapshot
+        self._m_cap = m_cap
+        self.slot_mid: dict[int, bytes] = {}     # slot -> message id bytes
+
+    # -- emission helpers --------------------------------------------------
+
+    def _emit(self, ev: trace_pb2.TraceEvent) -> None:
+        for s in self.sinks:
+            s.trace(ev)
+
+    def _base(self, typ, peer: int, tick: int) -> trace_pb2.TraceEvent:
+        return trace_pb2.TraceEvent(
+            type=typ, peerID=self.peer_ids[peer], timestamp=tick * self.tick_ns
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def emit_init(self, snap: Snapshot) -> None:
+        """ADD_PEER + JOIN for the initial network (replayed as events the
+        way a node would have seen its boot)."""
+        n = len(self.peer_ids)
+        up = snap.up if snap.up is not None else np.ones(n, bool)
+        for i in range(n):
+            if not up[i]:
+                continue
+            ev = self._base(trace_pb2.TraceEvent.ADD_PEER, i, snap.tick)
+            ev.addPeer.peerID = self.peer_ids[i]
+            ev.addPeer.proto = PROTOCOL_NAMES.get(int(self.protocol[i]), "?")
+            self._emit(ev)
+            for t in np.nonzero(self.subscribed[i])[0]:
+                ev = self._base(trace_pb2.TraceEvent.JOIN, i, snap.tick)
+                ev.join.topic = self.topic_name(int(t))
+                self._emit(ev)
+
+    def close(self, snap: Snapshot | None = None) -> None:
+        if snap is not None:
+            for i in range(len(self.peer_ids)):
+                if snap.up is not None and not snap.up[i]:
+                    continue
+                for t in np.nonzero(self.subscribed[i])[0]:
+                    ev = self._base(trace_pb2.TraceEvent.LEAVE, i, snap.tick)
+                    ev.leave.topic = self.topic_name(int(t))
+                    self._emit(ev)
+        for s in self.sinks:
+            s.close()
+
+    # -- per-round observation --------------------------------------------
+
+    def observe(self, prev: Snapshot, new: Snapshot,
+                pub_origin, pub_topic, pub_valid) -> None:
+        tick = prev.tick  # the round just executed
+        m = len(new.msg_topic)
+
+        # publishes: replicate the allocator's slot assignment
+        # (state.allocate_publishes: slots = cursor + running index, mod M)
+        po = np.asarray(pub_origin)
+        pt = np.asarray(pub_topic)
+        is_pub = po >= 0
+        pos = np.cumsum(is_pub) - 1
+        slots = (prev.cursor + pos) % m
+        for j in np.nonzero(is_pub)[0]:
+            origin, slot = int(po[j]), int(slots[j])
+            sq = int(self.seqno[origin])
+            self.seqno[origin] += 1
+            mid = message_id(self.peer_ids[origin], sq)
+            self.slot_mid[slot] = mid
+            ev = self._base(trace_pb2.TraceEvent.PUBLISH_MESSAGE, origin, tick)
+            ev.publishMessage.messageID = mid
+            ev.publishMessage.topic = self.topic_name(int(pt[j]))
+            self._emit(ev)
+
+        # first receipts this round: first_round == tick with an arrival edge
+        recv = (new.first_round == tick) & (new.first_edge >= 0)
+        peers, mslots = np.nonzero(recv)
+        # per-(sender,receiver) message counts for the queue model
+        edge_count: dict[tuple[int, int], int] = {}
+        for p, s in zip(peers.tolist(), mslots.tolist()):
+            sender = int(self.nbr[p, new.first_edge[p, s]])
+            mid = self.slot_mid.get(s, b"?unknown")
+            topic = self.topic_name(int(new.msg_topic[s]))
+            if new.msg_valid[s]:
+                ev = self._base(trace_pb2.TraceEvent.DELIVER_MESSAGE, p, tick)
+                ev.deliverMessage.messageID = mid
+                ev.deliverMessage.topic = topic
+                ev.deliverMessage.receivedFrom = self.peer_ids[sender]
+            else:
+                ev = self._base(trace_pb2.TraceEvent.REJECT_MESSAGE, p, tick)
+                ev.rejectMessage.messageID = mid
+                ev.rejectMessage.receivedFrom = self.peer_ids[sender]
+                ev.rejectMessage.reason = "validation failed"
+                ev.rejectMessage.topic = topic
+            self._emit(ev)
+
+            # the message-bearing RPC on this edge (exact for firsts)
+            sev = self._base(trace_pb2.TraceEvent.SEND_RPC, sender, tick)
+            sev.sendRPC.sendTo = self.peer_ids[p]
+            mm = sev.sendRPC.meta.messages.add()
+            mm.messageID = mid
+            mm.topic = topic
+            self._emit(sev)
+            rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, tick)
+            rev.recvRPC.receivedFrom = self.peer_ids[sender]
+            mm = rev.recvRPC.meta.messages.add()
+            mm.messageID = mid
+            mm.topic = topic
+            self._emit(rev)
+
+            key = (sender, p)
+            edge_count[key] = edge_count.get(key, 0) + 1
+
+        # outbound-queue model: overflow beyond queue_cap msgs/edge/round
+        # drops the RPC (comm.go:139-170 bounded chan; DropRPC trace at
+        # gossipsub.go:1153-1160)
+        for (sender, p), cnt in edge_count.items():
+            for _ in range(max(0, cnt - self.queue_cap)):
+                ev = self._base(trace_pb2.TraceEvent.DROP_RPC, sender, tick)
+                ev.dropRPC.sendTo = self.peer_ids[p]
+                self._emit(ev)
+
+        # mesh diffs -> GRAFT / PRUNE (peer's own mesh view)
+        if prev.mesh is not None and new.mesh is not None:
+            added = new.mesh & ~prev.mesh
+            removed = prev.mesh & ~new.mesh
+            for typ, diff in ((trace_pb2.TraceEvent.GRAFT, added),
+                              (trace_pb2.TraceEvent.PRUNE, removed)):
+                pp, ss, kk = np.nonzero(diff)
+                for p, s, k in zip(pp.tolist(), ss.tolist(), kk.tolist()):
+                    other = int(self.nbr[p, k])
+                    topic = self.topic_name(int(self.my_topics[p, s]))
+                    ev = self._base(typ, p, tick)
+                    sub = ev.graft if typ == trace_pb2.TraceEvent.GRAFT else ev.prune
+                    sub.peerID = self.peer_ids[other]
+                    sub.topic = topic
+                    self._emit(ev)
+
+        # liveness diffs -> ADD_PEER / REMOVE_PEER
+        if prev.up is not None and new.up is not None:
+            for p in np.nonzero(new.up & ~prev.up)[0]:
+                ev = self._base(trace_pb2.TraceEvent.ADD_PEER, int(p), tick)
+                ev.addPeer.peerID = self.peer_ids[int(p)]
+                ev.addPeer.proto = PROTOCOL_NAMES.get(int(self.protocol[p]), "?")
+                self._emit(ev)
+            for p in np.nonzero(prev.up & ~new.up)[0]:
+                ev = self._base(trace_pb2.TraceEvent.REMOVE_PEER, int(p), tick)
+                ev.removePeer.peerID = self.peer_ids[int(p)]
+                self._emit(ev)
+
+    # -- aggregates --------------------------------------------------------
+
+    @staticmethod
+    def counter_events(snap: Snapshot) -> dict[str, int]:
+        """Exact cumulative totals from the device counters (includes the
+        duplicate/control volume the per-event stream elides)."""
+        return {e.name: int(snap.events[e]) for e in EV}

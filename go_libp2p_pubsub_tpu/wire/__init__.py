@@ -1,0 +1,20 @@
+"""Host wire layer: varint-delimited protobuf framing and per-peer queue
+semantics (the comm.go equivalent). The compute path never sees this — it
+exists at the edges: trace sinks, interop harnesses, and the native runtime
+(see native/)."""
+
+from .framing import (
+    decode_uvarint,
+    encode_uvarint,
+    read_delimited,
+    read_delimited_messages,
+    write_delimited,
+)
+
+__all__ = [
+    "encode_uvarint",
+    "decode_uvarint",
+    "write_delimited",
+    "read_delimited",
+    "read_delimited_messages",
+]

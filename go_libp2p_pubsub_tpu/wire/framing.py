@@ -1,0 +1,93 @@
+"""Varint-delimited protobuf framing.
+
+The reference frames every RPC and trace record as LEB128 length prefix +
+protobuf payload on the stream (protoio delimited writer/reader used by
+comm.go:42-88,139-170 and tracer.go:132-181). This is the pure-Python
+codec; the native C++ runtime (native/) implements the same framing for
+the high-rate paths, and the two are round-trip tested against each other.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Iterator
+
+
+def encode_uvarint(n: int) -> bytes:
+    """LEB128 unsigned varint."""
+    if n < 0:
+        raise ValueError("uvarint encodes non-negative integers")
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_uvarint(buf: bytes, pos: int = 0) -> tuple[int, int]:
+    """Decode a uvarint at buf[pos:]; returns (value, next_pos)."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise EOFError("truncated uvarint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint too long")
+
+
+def write_delimited(stream: BinaryIO, msg) -> int:
+    """Write one length-prefixed protobuf message; returns bytes written."""
+    payload = msg.SerializeToString()
+    header = encode_uvarint(len(payload))
+    stream.write(header)
+    stream.write(payload)
+    return len(header) + len(payload)
+
+
+def _read_uvarint_stream(stream: BinaryIO) -> int | None:
+    result = 0
+    shift = 0
+    while True:
+        b = stream.read(1)
+        if not b:
+            if shift == 0:
+                return None  # clean EOF at a frame boundary
+            raise EOFError("truncated uvarint")
+        v = b[0]
+        result |= (v & 0x7F) << shift
+        if not (v & 0x80):
+            return result
+        shift += 7
+        if shift > 63:
+            raise ValueError("uvarint too long")
+
+
+def read_delimited(stream: BinaryIO, msg_type):
+    """Read one length-prefixed message; None at clean EOF."""
+    size = _read_uvarint_stream(stream)
+    if size is None:
+        return None
+    payload = stream.read(size)
+    if len(payload) != size:
+        raise EOFError("truncated frame")
+    msg = msg_type()
+    msg.ParseFromString(payload)
+    return msg
+
+
+def read_delimited_messages(stream: BinaryIO, msg_type) -> Iterator:
+    """Yield messages until EOF."""
+    while True:
+        msg = read_delimited(stream, msg_type)
+        if msg is None:
+            return
+        yield msg

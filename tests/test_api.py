@@ -1,0 +1,200 @@
+"""Host API tests — the reference's tier-2 integration style
+(floodsub_test.go getNetHosts/connect/assertReceive) driven through the
+Network/Node/Topic/Subscription surface."""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import api
+from go_libp2p_pubsub_tpu.config import default_peer_score_params
+from go_libp2p_pubsub_tpu.subscription_filter import AllowlistSubscriptionFilter
+
+
+def _basic_net(router="gossipsub", n=10, **kw):
+    net = api.Network(router=router, **kw)
+    nodes = net.add_nodes(n)
+    net.dense_connect(d=5, seed=1)
+    return net, nodes
+
+
+def test_basic_delivery_gossipsub():
+    net, nodes = _basic_net()
+    topics = [nd.join("news") for nd in nodes]
+    subs = [t.subscribe() for t in topics]
+    net.start()
+    mid = topics[0].publish(b"msg-0")
+    assert isinstance(mid, bytes) and len(mid) > 8
+    net.run(6)  # mesh forms at tick0 heartbeat; then propagation
+    got = [s.next() for s in subs]
+    # everyone (publisher included) got exactly the published message
+    assert all(m is not None and m.data == b"msg-0" for m in got)
+    assert all(m.topic == "news" for m in got)
+    assert all(s.next() is None for s in subs)
+    # signature travels with the message
+    assert got[1].HasField("signature")
+    assert getattr(got[1], "from") == nodes[0].peer_id
+
+
+def test_basic_delivery_floodsub():
+    net, nodes = _basic_net(router="floodsub")
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.start()
+    nodes[3].topics["t"].publish(b"flood")
+    net.run(5)
+    assert all(s.next() is not None for s in subs)
+
+
+def test_basic_delivery_randomsub():
+    net, nodes = _basic_net(router="randomsub", n=12)
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.start()
+    nodes[0].topics["t"].publish(b"rnd")
+    net.run(6)
+    delivered = sum(1 for s in subs if s.next() is not None)
+    assert delivered >= 10  # sqrt-fanout flood reaches (nearly) everyone
+
+
+def test_multi_topic_isolation():
+    net = api.Network()
+    nodes = net.add_nodes(8)
+    net.connect_all()
+    t_a = [nd.join("a") for nd in nodes[:4]]
+    t_b = [nd.join("b") for nd in nodes[4:]]
+    sub_a = [t.subscribe() for t in t_a]
+    sub_b = [t.subscribe() for t in t_b]
+    net.start()
+    t_a[0].publish(b"for-a")
+    net.run(5)
+    assert all(s.next().data == b"for-a" for s in sub_a)
+    assert all(s.next() is None for s in sub_b)
+
+
+def test_validator_rejects_propagation():
+    net, nodes = _basic_net(n=8)
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    nodes[0].register_topic_validator(
+        "t", lambda pid, m: not m.data.startswith(b"spam"), inline=True
+    )
+    net.start()
+    with pytest.raises(api.ValidationError):
+        nodes[1].topics["t"].publish(b"spam-1")  # local reject errors out
+    net.run(4)
+    assert all(s.next() is None for s in subs[2:])
+
+
+def test_validator_throttle():
+    net, nodes = _basic_net(n=4, validate_throttle=2)
+    t = [nd.join("t") for nd in nodes]
+    nodes[0].register_topic_validator("t", lambda pid, m: True)  # async
+    net.start()
+    t[0].publish(b"a")
+    t[0].publish(b"b")
+    with pytest.raises(api.ValidationError):
+        t[0].publish(b"c")  # global throttle exhausted
+    net.run(1)  # budget resets per run
+    t[0].publish(b"d")
+
+
+def test_subscription_filter_blocks_join():
+    net = api.Network()
+    a = net.add_node(sub_filter=AllowlistSubscriptionFilter(["ok"]))
+    a.join("ok")
+    with pytest.raises(api.APIError):
+        a.join("not-ok")
+
+
+def test_relay_forwards_without_delivery():
+    # line: 0 -1- 2, node 1 relays but doesn't subscribe
+    net = api.Network()
+    nodes = net.add_nodes(3)
+    net.connect(nodes[0], nodes[1])
+    net.connect(nodes[1], nodes[2])
+    t0 = nodes[0].join("t")
+    t1 = nodes[1].join("t")
+    t2 = nodes[2].join("t")
+    cancel = t1.relay()
+    sub2 = t2.subscribe()
+    net.start()
+    t0.publish(b"through")
+    net.run(4)
+    assert sub2.next().data == b"through"
+    cancel()
+    assert t1._relays == 0
+
+
+def test_event_handler_churn():
+    net, nodes = _basic_net(n=6)
+    topics = [nd.join("t") for nd in nodes]
+    h = topics[0].event_handler()
+    net.start()
+    # initial membership replay: everyone else is already joined
+    seen = set()
+    while (ev := h.next_event()) is not None:
+        kind, pid = ev
+        assert kind == api.PEER_JOIN
+        seen.add(pid)
+    assert seen == {nd.peer_id for nd in nodes[1:]}
+    nodes[3].disconnect()
+    net.run(1)
+    assert h.next_event() == (api.PEER_LEAVE, nodes[3].peer_id)
+    nodes[3].reconnect()
+    net.run(1)
+    assert h.next_event() == (api.PEER_JOIN, nodes[3].peer_id)
+
+
+def test_blacklist_disconnects():
+    net, nodes = _basic_net(n=6)
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.start()
+    net.run(2)  # let the mesh form
+    nodes[0].blacklist_peer(nodes[5].peer_id)
+    net.run(1)
+    nodes[5].topics["t"].publish(b"from-banned")
+    net.run(4)
+    # the blacklisted peer is cut off: nobody else receives its message
+    assert all(subs[i].next() is None for i in range(5))
+
+
+def test_subscription_buffer_drops():
+    net = api.Network(max_publishes_per_round=64)
+    nodes = net.add_nodes(2)
+    net.connect(nodes[0], nodes[1])
+    t0 = nodes[0].join("t")
+    sub = nodes[1].join("t").subscribe(buffer=4)
+    net.start()
+    for i in range(10):
+        t0.publish(b"m%d" % i)
+    net.run(4)
+    assert len(sub._q) == 4
+    assert sub.dropped == 6
+
+
+def test_peer_scores_surface():
+    sp = default_peer_score_params(1)
+    net, nodes = _basic_net(n=6, score_params=sp)
+    [nd.join("t") for nd in nodes]
+    net.start()
+    net.run(3)
+    scores = nodes[0].peer_scores()
+    assert scores  # neighbors present
+    assert all(isinstance(k, bytes) for k in scores)
+
+
+def test_traced_network(tmp_path):
+    from go_libp2p_pubsub_tpu.trace import sinks
+
+    path = str(tmp_path / "api.json")
+    net = api.Network(trace_sinks=[sinks.JSONTracer(path)])
+    nodes = net.add_nodes(5)
+    net.connect_all()
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.start()
+    nodes[0].topics["t"].publish(b"x")
+    net.run(4)
+    net.stop()
+    evs = list(sinks.read_json_trace(path))
+    kinds = {e.type for e in evs}
+    from go_libp2p_pubsub_tpu.pb import trace_pb2
+
+    assert trace_pb2.TraceEvent.PUBLISH_MESSAGE in kinds
+    assert trace_pb2.TraceEvent.DELIVER_MESSAGE in kinds

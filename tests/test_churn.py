@@ -12,6 +12,7 @@ import dataclasses
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from go_libp2p_pubsub_tpu import graph
 from go_libp2p_pubsub_tpu.config import (
@@ -24,6 +25,7 @@ from go_libp2p_pubsub_tpu.models.gossipsub import (
     GossipSubConfig,
     GossipSubState,
     make_gossipsub_step,
+    no_publish as nopub,
     set_blacklist,
 )
 from go_libp2p_pubsub_tpu.ops import bitset
@@ -78,11 +80,6 @@ def pub(o, t=0, valid=True, p=4):
     pv = np.zeros(p, bool)
     po[0], pt[0], pv[0] = o, t, valid
     return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
-
-
-def nopub(p=4):
-    z = jnp.full((p,), -1, jnp.int32)
-    return z, z, jnp.zeros((p,), bool)
 
 
 def run(step, st, up, k, publishes=()):
@@ -230,3 +227,113 @@ def test_positive_stats_cleared_on_disconnect():
     fmd = np.asarray(st.score.fmd)
     for j, k in viewers:
         assert fmd[j, :, k].sum() == 0
+
+
+def test_retained_deficit_converts_to_decaying_penalty():
+    """removePeer (score.go:604-637): when a mesh peer with a negative
+    (retained) score disconnects, its standing P3 deficit must convert to
+    the decaying P3b penalty once and the activation latch must drop —
+    not stay latched as a permanent deficit. With heartbeat_every=1 the
+    heartbeat prunes negative-score mesh edges with the same memoized
+    score snapshot the disconnect sees, so the window only opens in
+    multi-round-heartbeat configs; this exercises the engine path the
+    model's down-transition composes (on_prune + clear_mesh_status +
+    clear_edges with a retention mask)."""
+    from go_libp2p_pubsub_tpu.score.engine import (
+        ScoreState,
+        TopicParamsArrays,
+        clear_edges,
+        clear_mesh_status,
+        compute_scores,
+        on_prune,
+        refresh_scores,
+    )
+
+    tp_params = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.0,
+        first_message_deliveries_weight=0.0,
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_decay=0.9,
+        mesh_message_deliveries_cap=100.0,
+        mesh_message_deliveries_threshold=10.0,
+        mesh_message_deliveries_activation=1.0,
+        mesh_failure_penalty_weight=-1.0,
+        mesh_failure_penalty_decay=0.5,
+        invalid_message_deliveries_weight=-10.0,
+        invalid_message_deliveries_decay=0.95,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp_params},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-10.0,
+        behaviour_penalty_threshold=0.0,
+        behaviour_penalty_decay=0.9,
+        ip_colocation_factor_weight=0.0,
+    )
+    topo = graph.ring_lattice(6, d=2)
+    net = Net.build(topo, graph.subscribe_all(6, 1))
+    n, k, s = net.n_peers, net.max_degree, net.n_slots
+    tpa = TopicParamsArrays.build(sp, 1, 1.0)
+    tp = tpa.gather(net.my_topics)
+
+    st = ScoreState.empty(n, s, k)
+    # viewer 0 has neighbor slot 0 in mesh, activation latched, zero mmd
+    # counter -> full deficit
+    in_mesh = jnp.zeros((n, s, k), bool).at[0, 0, 0].set(True)
+    st = st.replace(mmd_active=jnp.zeros((n, s, k), bool).at[0, 0, 0].set(True))
+
+    # down-transition composition from make_gossipsub_step for a dead
+    # neighbor with a retained (negative) score
+    down_nbr = jnp.zeros((n, k), bool).at[0, 0].set(True)
+    retained = jnp.zeros((n, k), bool)  # negative score -> NOT cleared
+    st2 = on_prune(st, in_mesh & down_nbr[:, None, :], tp)
+    st2 = clear_mesh_status(st2, down_nbr)
+    st2 = clear_edges(st2, retained)
+
+    thr = float(np.asarray(tp["thr3"])[0, 0])
+    assert not bool(np.asarray(st2.mmd_active)[0, 0, 0])
+    assert np.asarray(st2.mfp)[0, 0, 0] == pytest.approx(thr * thr)
+
+    # scores after: P3 no longer applies (latch cleared), P3b does, and
+    # decays away over refreshes
+    no_mesh = jnp.zeros((n, s, k), bool)
+    sc = np.asarray(compute_scores(st2, no_mesh, tp, sp, jnp.zeros((n, k)),
+                                   jnp.zeros((n,)), net))
+    assert sc[0, 0] == pytest.approx(-thr * thr)
+    for t in range(20):
+        st2 = refresh_scores(st2, no_mesh, t, tp, sp)
+    sc_late = np.asarray(compute_scores(st2, no_mesh, tp, sp,
+                                        jnp.zeros((n, k)), jnp.zeros((n,)), net))
+    assert abs(sc_late[0, 0]) < 1e-3
+
+    # contrast: without the status clear the deficit would be permanent
+    st_bug = on_prune(st, in_mesh & down_nbr[:, None, :], tp)
+    st_bug = clear_edges(st_bug, retained)
+    for t in range(20):
+        st_bug = refresh_scores(st_bug, no_mesh, t, tp, sp)
+    sc_bug = np.asarray(compute_scores(st_bug, no_mesh, tp, sp,
+                                       jnp.zeros((n, k)), jnp.zeros((n,)), net))
+    assert sc_bug[0, 0] < -thr * thr / 2  # latched deficit never heals
+
+
+def test_restarting_peer_loses_soft_state():
+    """A crashing node restarts with an empty seen-cache/mcache (soft state
+    is rebuilt from the network — survey §5 failure detection; the engine's
+    down transition models the process dying)."""
+    topo, net, cfg, st, step = build()
+    n = net.n_peers
+    up = jnp.ones((n,), bool)
+    st = run(step, st, up, 5, publishes={0: pub(n - 1)})
+    assert len(received(st, 0)) > 0
+
+    down = up.at[0].set(False)
+    st = step(st, *nopub(), down)
+    # seen-cache wiped at the crash
+    assert received(st, 0) == set()
+    assert np.asarray(st.mcache)[0].sum() == 0
+
+    # back up: re-receives traffic from scratch
+    st = step(st, *nopub(), up)
+    st = run(step, st, up, 10, publishes={2: pub(n - 1)})
+    assert len(received(st, 0)) > 0

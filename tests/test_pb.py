@@ -1,0 +1,80 @@
+"""Wire-schema tests: RPC round-trips and old/new compat (the reference's
+compat_test.go:10-83 scenarios on our generated bindings)."""
+
+from go_libp2p_pubsub_tpu.pb import compat_pb2, rpc_pb2, trace_pb2
+
+
+def test_rpc_roundtrip_full():
+    rpc = rpc_pb2.RPC()
+    rpc.subscriptions.add(subscribe=True, topicid="news")
+    rpc.subscriptions.add(subscribe=False, topicid="olds")
+    m = rpc.publish.add()
+    setattr(m, "from", b"\x01peerA")  # `from` is a Python keyword
+    m.data = b"payload"
+    m.seqno = (7).to_bytes(8, "big")
+    m.topic = "news"
+    m.signature = b"sig"
+    m.key = b"key"
+    rpc.control.ihave.add(topicID="news", messageIDs=["m1", "m2"])
+    rpc.control.iwant.add(messageIDs=["m1"])
+    rpc.control.graft.add(topicID="news")
+    pr = rpc.control.prune.add(topicID="news", backoff=60)
+    pr.peers.add(peerID=b"\x01peerB", signedPeerRecord=b"rec")
+
+    out = rpc_pb2.RPC()
+    out.ParseFromString(rpc.SerializeToString())
+    assert out == rpc
+    assert out.publish[0].topic == "news"
+    assert out.control.prune[0].backoff == 60
+
+
+def test_compat_new_to_old():
+    # a single-topic new-form message parses as old-form with one topicID
+    m = rpc_pb2.Message(data=b"d", seqno=b"\0" * 8, topic="t")
+    setattr(m, "from", b"p")
+    old = compat_pb2.Message()
+    old.ParseFromString(m.SerializeToString())
+    assert list(old.topicIDs) == ["t"]
+    assert old.data == b"d"
+
+
+def test_compat_old_to_new():
+    # old-form single topic parses as the new single `topic` field;
+    # multi-topic old messages surface as the *last* topic (proto2
+    # last-wins for repeated->optional), which is the documented reference
+    # behavior for deprecated multi-topic messages
+    old = compat_pb2.Message(data=b"d", topicIDs=["a"])
+    m = rpc_pb2.Message()
+    m.ParseFromString(old.SerializeToString())
+    assert m.topic == "a"
+
+    old2 = compat_pb2.Message(data=b"d", topicIDs=["a", "b"])
+    m2 = rpc_pb2.Message()
+    m2.ParseFromString(old2.SerializeToString())
+    assert m2.topic == "b"
+
+
+def test_trace_event_schema():
+    ev = trace_pb2.TraceEvent(
+        type=trace_pb2.TraceEvent.GRAFT,
+        peerID=b"p0",
+        timestamp=123,
+    )
+    ev.graft.peerID = b"p1"
+    ev.graft.topic = "t"
+    out = trace_pb2.TraceEvent()
+    out.ParseFromString(ev.SerializeToString())
+    assert out.type == trace_pb2.TraceEvent.GRAFT
+    assert out.graft.topic == "t"
+    # all 13 event types exist with the reference's numbering
+    assert trace_pb2.TraceEvent.PUBLISH_MESSAGE == 0
+    assert trace_pb2.TraceEvent.PRUNE == 12
+
+
+def test_trace_batch():
+    b = trace_pb2.TraceEventBatch()
+    for i in range(3):
+        b.batch.add(timestamp=i)
+    out = trace_pb2.TraceEventBatch()
+    out.ParseFromString(b.SerializeToString())
+    assert len(out.batch) == 3

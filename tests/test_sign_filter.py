@@ -1,0 +1,150 @@
+"""Signing, subscription-filter, and blacklist tests (reference
+sign_test.go, subscription_filter_test.go, blacklist.go semantics)."""
+
+import pytest
+
+from go_libp2p_pubsub_tpu import blacklist as bl
+from go_libp2p_pubsub_tpu import subscription_filter as sf
+from go_libp2p_pubsub_tpu.pb import rpc_pb2
+from go_libp2p_pubsub_tpu.sign import (
+    Identity,
+    SignError,
+    SignPolicy,
+    check_signing_policy,
+    pubkey_from_peer_id,
+    sign_message,
+    verify_message,
+)
+
+
+def _msg(ident, data=b"hello", topic="t", seqno=1):
+    m = rpc_pb2.Message(data=data, topic=topic, seqno=seqno.to_bytes(8, "big"))
+    setattr(m, "from", ident.peer_id)
+    return m
+
+
+def test_sign_verify_roundtrip():
+    ident = Identity.generate(1)
+    m = _msg(ident)
+    sign_message(m, ident)
+    verify_message(m)  # no raise
+
+
+def test_identity_deterministic_and_key_embedded():
+    a, b = Identity.generate(7), Identity.generate(7)
+    assert a.peer_id == b.peer_id
+    assert pubkey_from_peer_id(a.peer_id) is not None
+    assert a.peer_id != Identity.generate(8).peer_id
+
+
+def test_tampered_data_fails():
+    ident = Identity.generate(2)
+    m = _msg(ident)
+    sign_message(m, ident)
+    m.data = b"tampered"
+    with pytest.raises(SignError):
+        verify_message(m)
+
+
+def test_wrong_from_fails():
+    ident, other = Identity.generate(3), Identity.generate(4)
+    m = _msg(ident)
+    sign_message(m, ident)
+    setattr(m, "from", other.peer_id)  # impersonation
+    with pytest.raises(SignError):
+        verify_message(m)
+
+
+def test_sign_requires_matching_identity():
+    ident, other = Identity.generate(5), Identity.generate(6)
+    m = _msg(ident)
+    with pytest.raises(SignError):
+        sign_message(m, other)
+
+
+def test_policy_strict_sign():
+    ident = Identity.generate(9)
+    m = _msg(ident)
+    with pytest.raises(SignError):
+        check_signing_policy(SignPolicy.STRICT_SIGN, m)  # unsigned
+    sign_message(m, ident)
+    check_signing_policy(SignPolicy.STRICT_SIGN, m)
+
+
+def test_policy_strict_no_sign():
+    ident = Identity.generate(10)
+    m = _msg(ident)
+    sign_message(m, ident)
+    with pytest.raises(SignError):
+        check_signing_policy(SignPolicy.STRICT_NO_SIGN, m)
+    anon = rpc_pb2.Message(data=b"x", topic="t")
+    check_signing_policy(SignPolicy.STRICT_NO_SIGN, anon)
+
+
+def test_policy_lax():
+    ident = Identity.generate(11)
+    anon = rpc_pb2.Message(data=b"x", topic="t")
+    check_signing_policy(SignPolicy.LAX_SIGN, anon)     # absent sig ok
+    m = _msg(ident)
+    sign_message(m, ident)
+    check_signing_policy(SignPolicy.LAX_SIGN, m)        # present verifies
+    m.data = b"bad"
+    with pytest.raises(SignError):
+        check_signing_policy(SignPolicy.LAX_SIGN, m)
+
+
+# -- subscription filters ---------------------------------------------------
+
+
+def test_allowlist_filter():
+    f = sf.AllowlistSubscriptionFilter(["a", "b"])
+    assert f.can_subscribe("a") and not f.can_subscribe("c")
+    out = f.filter_incoming_subscriptions(
+        b"p", [(True, "a"), (True, "c"), (True, "a"), (False, "b")]
+    )
+    assert out == [(True, "a"), (False, "b")]
+
+
+def test_regex_filter():
+    f = sf.RegexSubscriptionFilter(r"^news/")
+    assert f.can_subscribe("news/world")
+    assert not f.can_subscribe("sports")
+
+
+def test_limit_filter():
+    f = sf.LimitSubscriptionFilter(sf.AllowlistSubscriptionFilter(["a"]), limit=2)
+    assert f.filter_incoming_subscriptions(b"p", [(True, "a")]) == [(True, "a")]
+    with pytest.raises(sf.TooManySubscriptions):
+        f.filter_incoming_subscriptions(
+            b"p", [(True, "a"), (True, "b"), (True, "c")]
+        )
+
+
+# -- blacklists -------------------------------------------------------------
+
+
+def test_map_blacklist():
+    b = bl.MapBlacklist()
+    assert not b.contains(b"p")
+    b.add(b"p")
+    assert b.contains(b"p")
+    b.remove(b"p")
+    assert not b.contains(b"p")
+
+
+def test_timecached_blacklist_expiry():
+    t = [0.0]
+    b = bl.TimeCachedBlacklist(ttl=10.0, now=lambda: t[0])
+    b.add(b"p")
+    assert b.contains(b"p")
+    t[0] = 9.9
+    assert b.contains(b"p")
+    t[0] = 10.1
+    assert not b.contains(b"p")
+
+
+def test_blacklist_mask():
+    b = bl.MapBlacklist()
+    b.add(b"p1")
+    mask = bl.blacklist_mask(b, [b"p0", b"p1", b"p2"])
+    assert mask.tolist() == [False, True, False]
