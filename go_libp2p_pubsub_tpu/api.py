@@ -53,6 +53,7 @@ from .config import (
     PeerScoreThresholds,
     default_peer_score_params,
 )
+from .discovery import Discovery, DiscoverySession, min_topic_size
 from .pb import rpc_pb2
 from .sign import Identity, SignPolicy, check_signing_policy, sign_message
 from .state import Net, SimState
@@ -71,6 +72,10 @@ class APIError(RuntimeError):
 
 class ValidationError(APIError):
     """Local publish rejected (reject or throttle), like PushLocal errors."""
+
+
+class NotReadyError(APIError):
+    """Publish gated on router readiness (RouterReady / MinTopicSize)."""
 
 
 PEER_JOIN = "PEER_JOIN"
@@ -190,13 +195,23 @@ class Topic:
 
     # -- publish -----------------------------------------------------------
 
-    def publish(self, data: bytes) -> bytes:
+    def publish(self, data: bytes, min_peers: int | None = None) -> bytes:
         """Build, sign, locally validate, and enqueue a message for the next
         round (topic.go:211-249 -> validation.PushLocal). Returns the
-        message id."""
+        message id.
+
+        `min_peers` mirrors `WithReadiness(MinTopicSize(n))`: the publish is
+        gated on the router having enough topic peers (discovery.go:76-82),
+        evaluated against live mesh state."""
         if self.closed:
             raise APIError("topic handle closed")
-        return self.node.network._publish(self.node, self, data)
+        net = self.node.network
+        if min_peers is not None and net.discovery is not None:
+            if not net.discovery.enough_peers(self.node, self.name, min_peers):
+                raise NotReadyError(
+                    f"router not ready for {self.name!r} (min {min_peers} peers)"
+                )
+        return net._publish(self.node, self, data)
 
     def close(self) -> None:
         self.closed = True
@@ -293,6 +308,7 @@ class Network:
         seed: int = 0,
         trace_sinks=None,
         msg_id_fn: Callable | None = None,
+        discovery: Discovery | None = None,
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
@@ -321,6 +337,11 @@ class Network:
         self.net = None
         self._async_budget = validate_throttle
         self._topic_budget: dict[str, int] = {}
+        # discovery pipeline (WithDiscovery; discovery.go Start)
+        self.discovery = (
+            DiscoverySession(self, discovery, seed=seed)
+            if discovery is not None else None
+        )
 
     # -- assembly ----------------------------------------------------------
 
@@ -374,10 +395,47 @@ class Network:
         t = Topic(node, topic, tid)
         if self.started:
             raise APIError("join after start() not supported yet")
+        # advertise joined topics to the discovery service
+        # (handleAddSubscription -> disc.Advertise, pubsub.go:759-780)
+        if self.discovery is not None:
+            self.discovery.advertise(node, topic)
         return t
 
     def _leave(self, node: Node, t: Topic) -> None:
         self._check_not_started("leave")
+        if self.discovery is not None:
+            self.discovery.stop_advertise(node, t.name)
+
+    def are_connected(self, a: Node, b: Node) -> bool:
+        return (a.idx, b.idx) in self._edges or (b.idx, a.idx) in self._edges
+
+    def bootstrap(self, topic: str, min_peers: int = 0, max_polls: int = 100) -> bool:
+        """Discover peers for `topic` until the router is ready
+        (discover.Bootstrap, discovery.go:239-295). Pre-start this grows the
+        topology; returns readiness."""
+        if self.discovery is None:
+            return True  # no discovery configured: trivially ready (d.Bootstrap nil path)
+        return self.discovery.bootstrap(
+            topic, min_topic_size(min_peers), max_polls=max_polls
+        )
+
+    def restart(self) -> None:
+        """Unfreeze the topology: drop the compiled program + device state so
+        assembly (connect / bootstrap / join) is allowed again; the next
+        start()/run() recompiles with the grown topology. Protocol state is
+        soft and rebuilt from the network, exactly as a process restart in
+        the reference (SURVEY §5: no checkpointing of mesh state; it is
+        reconstructed via heartbeats)."""
+        if not self.started:
+            return
+        self.stop()
+        self.started = False
+        self.state = None
+        self.net = None
+        self._session = None
+        self._slot_msg.clear()
+        self._seen_mids.clear()
+        self._pub_queue.clear()
 
     def _topic_members(self, tid: int):
         return [n for n in self.nodes if any(t.tid == tid for t in n.topics.values())]

@@ -1,0 +1,163 @@
+"""Discovery pipeline tests — mirrors discovery_test.go: an in-memory
+rendezvous server with TTL records (mockDiscoveryServer, :27-73), advertise
+on join, bootstrap growing a starving topic's connectivity
+(TestSimpleDiscovery :126, TestGossipSubDiscoveryAfterBootstrap :221), and
+publish-readiness gating (MinTopicSize, discovery.go:76-82)."""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import api, discovery
+
+
+def test_advertise_on_join():
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="floodsub", discovery=server)
+    nodes = net.add_nodes(4)
+    for nd in nodes[:3]:
+        nd.join("foobar")
+    ns = discovery.namespace("foobar")
+    assert ns == "floodsub:foobar"
+    for nd in nodes[:3]:
+        assert server.has_peer_record(ns, nd.peer_id)
+    assert not server.has_peer_record(ns, nodes[3].peer_id)
+
+
+def test_leave_stops_advertising():
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="floodsub", discovery=server)
+    (a,) = net.add_nodes(1)
+    a.join("t")
+    a.leave("t")
+    assert not server.has_peer_record(discovery.namespace("t"), a.peer_id)
+
+
+def test_ttl_expiry():
+    server = discovery.MemoryDiscovery()
+    server.advertise("floodsub:x", b"peer-1", ttl=5)
+    assert server.find_peers("floodsub:x") == [b"peer-1"]
+    server.advance(6)
+    assert server.find_peers("floodsub:x") == []
+
+
+def test_find_peers_limit():
+    server = discovery.MemoryDiscovery()
+    for i in range(10):
+        server.advertise("floodsub:x", b"peer-%d" % i)
+    assert len(server.find_peers("floodsub:x", limit=3)) == 3
+    assert len(server.find_peers("floodsub:x")) == 10
+
+
+def test_backoff_connector():
+    conn = discovery.BackoffConnector(seed=0)
+    assert conn.may_dial(0, 1, tick=0)
+    conn.record_dial(0, 1, tick=0)
+    # full jitter in [0, 10s) but at least 1 tick
+    assert not conn.may_dial(0, 1, tick=0)
+    assert conn.may_dial(0, 1, tick=discovery.BACKOFF_MIN_TICKS)
+    # growth is capped
+    for i in range(10):
+        conn.record_dial(0, 1, tick=0)
+    assert conn.may_dial(0, 1, tick=discovery.BACKOFF_MAX_TICKS)
+
+
+def test_bootstrap_connects_starving_topic_floodsub():
+    """TestSimpleDiscovery shape: nodes share only a discovery server (no
+    pre-wired edges); bootstrap must produce a connected, publishable
+    topic."""
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="floodsub", discovery=server)
+    nodes = net.add_nodes(12)
+    subs = [nd.join("foobar").subscribe() for nd in nodes]
+    assert len(net._edges) == 0
+    ok = net.bootstrap("foobar", min_peers=5)
+    assert ok
+    assert len(net._edges) > 0
+    net.start()
+    nodes[0].topics["foobar"].publish(b"hey")
+    net.run(6)
+    delivered = sum(1 for s in subs if s.next() is not None)
+    # floodsub floods the discovered graph; everyone connected transitively
+    assert delivered == 12
+
+
+def test_bootstrap_gossipsub_enough_peers_uses_dlo():
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="gossipsub", discovery=server)
+    nodes = net.add_nodes(10)
+    for nd in nodes:
+        nd.join("t")
+    assert net.bootstrap("t")  # suggestion 0 -> Dlo (gossipsub.go:572-574)
+    sess = net.discovery
+    assert any(sess.enough_peers(nd, "t", 0) for nd in nodes)
+
+
+def test_publish_readiness_gate():
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="floodsub", discovery=server)
+    a, b = net.add_nodes(2)
+    ta = a.join("t")
+    b.join("t")
+    net.connect(a, b)
+    net.start()
+    # only 1 topic peer < min 2 -> gated (MinTopicSize semantics)
+    with pytest.raises(api.NotReadyError):
+        ta.publish(b"x", min_peers=2)
+    # suggestion 1 is satisfied
+    mid = ta.publish(b"x", min_peers=1)
+    assert isinstance(mid, bytes)
+
+
+def test_enough_peers_floodsub_default_threshold():
+    """floodsub.go:52-68: suggestion 0 means FloodSubTopicSearchSize=5."""
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="floodsub", discovery=server)
+    nodes = net.add_nodes(6)
+    for nd in nodes:
+        nd.join("t")
+    for other in nodes[1:5]:
+        net.connect(nodes[0], other)  # 4 topic peers: not enough
+    sess = net.discovery
+    assert not sess.enough_peers(nodes[0], "t", 0)
+    net.connect(nodes[0], nodes[5])  # 5: enough
+    assert sess.enough_peers(nodes[0], "t", 0)
+
+
+def test_poll_respects_backoff_no_duplicate_edges():
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="floodsub", discovery=server)
+    nodes = net.add_nodes(3)
+    for nd in nodes:
+        nd.join("t")
+    sess = net.discovery
+    made_total = 0
+    for _ in range(5):
+        made_total += sess.poll_once()
+    # complete graph on 3 nodes has 3 undirected edges; polling more never
+    # duplicates (are_connected check) — K3 still starves vs threshold 5,
+    # so the poll keeps running but has nothing left to add
+    assert len(net._edges) == 3
+    assert made_total == 3
+
+
+def test_restart_applies_discovered_topology():
+    """Edges discovered after start() apply on restart(); protocol state is
+    soft-rebuilt (reference semantics: mesh state is reconstructed from the
+    network, SURVEY §5)."""
+    server = discovery.MemoryDiscovery()
+    net = api.Network(router="floodsub", discovery=server)
+    nodes = net.add_nodes(6)
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.connect(nodes[0], nodes[1])
+    net.start()
+    # late joiners advertised; poll post-start records intent but cannot
+    # rewire the frozen program
+    n_edges = len(net._edges)
+    net.discovery.poll_once()
+    assert len(net._edges) == n_edges  # frozen
+    net.restart()  # unfreeze: growth is allowed again
+    net.bootstrap("t", min_peers=5)
+    net.start()    # refreeze with the discovered edges
+    nodes[0].topics["t"].publish(b"after-restart")
+    net.run(6)
+    assert sum(1 for s in subs if s.next() is not None) == 6
