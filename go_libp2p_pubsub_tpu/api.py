@@ -309,6 +309,7 @@ class Network:
         trace_sinks=None,
         msg_id_fn: Callable | None = None,
         discovery: Discovery | None = None,
+        track_tags: bool = False,
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
@@ -342,6 +343,9 @@ class Network:
             DiscoverySession(self, discovery, seed=seed)
             if discovery is not None else None
         )
+        # connmgr tag tracer (tag_tracer.go), attached at start()
+        self._track_tags = track_tags
+        self.tag_tracer = None
 
     # -- assembly ----------------------------------------------------------
 
@@ -433,6 +437,7 @@ class Network:
         self.state = None
         self.net = None
         self._session = None
+        self.tag_tracer = None  # rebuilt at next start()
         self._slot_msg.clear()
         self._seen_mids.clear()
         self._pub_queue.clear()
@@ -522,6 +527,10 @@ class Network:
 
         self._jnp = jnp
         self.started = True
+        if self._track_tags:
+            from .connmgr import TagTracer
+
+            self.tag_tracer = TagTracer(self.net)
         if self.trace_sinks:
             self._session = TraceSession(
                 self.net, self.trace_sinks,
@@ -620,6 +629,8 @@ class Network:
 
             if self._session is not None:
                 self._session.observe(prev, new, po, pt, pv)
+            if self.tag_tracer is not None:
+                self.tag_tracer.observe(prev, new)
             self._drain_deliveries(prev, new)
 
     def _blacklisted(self, node: Node) -> bool:

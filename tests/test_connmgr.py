@@ -1,0 +1,121 @@
+"""Connection-manager / tag-tracer tests (tag_tracer.go semantics:
+protection of direct+mesh peers, decaying delivery tags cap 15 / decay
+1 per 10 min, trim keeps protected and high-value connections)."""
+
+import numpy as np
+
+from go_libp2p_pubsub_tpu import connmgr, graph
+from go_libp2p_pubsub_tpu.state import Net, SimState
+from go_libp2p_pubsub_tpu.trace.drain import snapshot
+
+
+def _net(n=8, d=4, direct_edges=None):
+    topo = graph.random_connect(n, d=d, seed=3)
+    subs = graph.subscribe_all(n, 1)
+    direct = None
+    if direct_edges:
+        direct = np.zeros(topo.nbr.shape, bool)
+        nbr = topo.nbr
+        for a, b in direct_edges:
+            for k in range(nbr.shape[1]):
+                if nbr[a, k] == b and topo.nbr_ok[a, k]:
+                    direct[a, k] = True
+    return Net.build(topo, subs, direct=direct)
+
+
+def test_direct_peers_protected():
+    net = _net(direct_edges=[(0, int(np.asarray(graph.random_connect(8, 4, seed=3).nbr)[0, 0]))])
+    cm = connmgr.ConnManager(net.n_peers, net.n_slots, net.max_degree)
+    prot = cm.protected(net, mesh=None)
+    assert prot[0].any()
+    assert not prot[1:].any()
+
+
+def test_mesh_peers_protected_and_unprotected_on_prune():
+    net = _net()
+    cm = connmgr.ConnManager(net.n_peers, net.n_slots, net.max_degree)
+    mesh = np.zeros((net.n_peers, net.n_slots, net.max_degree), bool)
+    mesh[2, 0, 1] = True  # grafted
+    assert cm.protected(net, mesh)[2, 1]
+    mesh[2, 0, 1] = False  # pruned
+    assert not cm.protected(net, mesh)[2, 1]
+
+
+def test_delivery_tag_bump_cap_and_decay():
+    cm = connmgr.ConnManager(4, 1, 4)
+    for _ in range(20):
+        cm.bump(0, 0, 2)
+    assert cm.tags[0, 0, 2] == connmgr.TAG_CAP  # BumpSumBounded cap 15
+    # decay 1 per 600 ticks (10 min at 1s heartbeats)
+    cm.maybe_decay(connmgr.TAG_DECAY_INTERVAL_TICKS)
+    assert cm.tags[0, 0, 2] == connmgr.TAG_CAP - 1
+    cm.maybe_decay(connmgr.TAG_DECAY_INTERVAL_TICKS * 16)
+    assert cm.tags[0, 0, 2] == 0  # floors at 0
+
+
+def test_edge_value_and_trim():
+    net = _net(n=8, d=6)
+    n, k = net.n_peers, net.max_degree
+    cm = connmgr.ConnManager(n, net.n_slots, k)
+    nbr_ok = np.asarray(net.nbr_ok)
+    live = np.nonzero(nbr_ok[0])[0]
+    assert live.size >= 4
+    mesh = np.zeros((n, net.n_slots, k), bool)
+    mesh[0, 0, live[0]] = True              # mesh peer: protected
+    cm.tags[0, 0, live[1]] = 9              # valuable
+    cm.tags[0, 0, live[2]] = 1              # cheap
+    keep = cm.trim(net, mesh, max_conns=2)
+    assert keep[0, live[0]]                 # protected survives
+    assert keep[0, live[1]]                 # highest tag fills the budget
+    assert not keep[0, live[2]]
+    # value ordering: mesh adds 20, direct would add 1000
+    val = cm.edge_value(net, mesh)
+    assert val[0, live[0]] == connmgr.MESH_PEER_TAG_VALUE
+    assert val[0, live[1]] == 9
+
+
+def test_tag_tracer_bumps_first_delivery_edge():
+    """Integration: flood a message through a small floodsub net; every
+    first receipt must bump exactly the arrival edge's tag for the topic."""
+    from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+
+    net = _net(n=10, d=4)
+    st = SimState.init(net.n_peers, 32, seed=0)
+    tracer = connmgr.TagTracer(net)
+
+    po = np.full(4, -1, np.int32); po[0] = 0
+    pt = np.zeros(4, np.int32)
+    pv = np.zeros(4, bool); pv[0] = True
+    import jax.numpy as jnp
+    for r in range(5):
+        prev = snapshot(st)
+        st = floodsub_step(net, st, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+        tracer.observe(prev, snapshot(st))
+        po[:] = -1; pv[:] = False  # publish only in round 0
+
+    fr = np.asarray(st.dlv.first_round)[:, 0]
+    fe = np.asarray(st.dlv.first_edge)[:, 0]
+    receivers = np.nonzero(fe >= 0)[0]
+    assert receivers.size >= 5  # flood reached most of the graph
+    for p in receivers:
+        assert tracer.cm.tags[p, 0, fe[p]] == connmgr.TAG_BUMP
+        # and nothing else bumped for that peer
+        assert tracer.cm.tags[p].sum() == connmgr.TAG_BUMP
+    # origin never gets a delivery bump (local publish, first_edge=-1)
+    assert tracer.cm.tags[0].sum() == 0
+
+
+def test_network_track_tags_end_to_end():
+    """API-level: track_tags=True wires the tracer into run()."""
+    from go_libp2p_pubsub_tpu import api
+
+    net = api.Network(router="floodsub", track_tags=True)
+    nodes = net.add_nodes(6)
+    net.connect_all()
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.start()
+    nodes[0].topics["t"].publish(b"tagged")
+    net.run(4)
+    assert sum(1 for s in subs if s.next() is not None) == 6
+    # someone's arrival edge got a bump
+    assert net.tag_tracer.cm.tags.sum() >= 5
