@@ -1,0 +1,125 @@
+"""Checkpoint / resume of simulation state.
+
+The reference has no checkpointing — protocol state is soft and rebuilt
+from the network (SURVEY §5). For the TPU simulator, snapshotting the
+peer×topic device arrays is cheap and makes long simulations resumable, so
+this is deliberate new work with no reference semantics to match.
+
+Two backends:
+  * npz — `save`/`restore`: flatten the (flax struct) state pytree to a
+    flat list of arrays in one compressed .npz. Restore requires a template
+    state with the same structure (build it from the same configs/topology);
+    shapes and dtypes are checked leaf by leaf. PRNG key leaves are
+    serialized via `jax.random.key_data` and re-wrapped on load, so a
+    resumed run continues the exact random stream — continuation equals an
+    uninterrupted run (tested).
+  * orbax — `save_orbax`/`restore_orbax` for async, sharded, multi-host
+    checkpoints of the same pytree (optional; imported lazily).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_FORMAT_VERSION = 1
+
+
+def _is_key(leaf) -> bool:
+    return isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key)
+
+
+def save(path: str, state) -> None:
+    """Write the state pytree to a compressed .npz."""
+    leaves = jax.tree_util.tree_leaves(state)
+    out = {"__version__": np.int64(_FORMAT_VERSION),
+           "__n_leaves__": np.int64(len(leaves))}
+    for i, leaf in enumerate(leaves):
+        if _is_key(leaf):
+            out[f"leaf_{i}"] = np.asarray(jax.random.key_data(leaf))
+            out[f"leaf_{i}__is_key"] = np.bool_(True)
+        else:
+            out[f"leaf_{i}"] = np.asarray(leaf)
+    np.savez_compressed(path, **out)
+
+
+def restore(path: str, template):
+    """Rebuild a state pytree from `path` using `template`'s structure.
+
+    The template provides the treedef (and expected shapes/dtypes); its
+    array values are ignored. Raises ValueError on any mismatch.
+    """
+    with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as data:
+        version = int(data["__version__"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"unknown checkpoint version {version}")
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        n = int(data["__n_leaves__"])
+        if n != len(t_leaves):
+            raise ValueError(
+                f"checkpoint has {n} leaves, template has {len(t_leaves)} "
+                "(different configs/topology?)"
+            )
+        leaves = []
+        for i, tmpl in enumerate(t_leaves):
+            arr = data[f"leaf_{i}"]
+            if f"leaf_{i}__is_key" in data.files:
+                if not _is_key(tmpl):
+                    raise ValueError(
+                        f"leaf {i}: checkpoint holds a PRNG key, template does not"
+                    )
+                want = tuple(np.asarray(jax.random.key_data(tmpl)).shape)
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"leaf {i}: key data shape {tuple(arr.shape)} != "
+                        f"template {want}"
+                    )
+                leaf = jax.random.wrap_key_data(jnp.asarray(arr))
+            else:
+                if _is_key(tmpl):
+                    raise ValueError(
+                        f"leaf {i}: template expects a PRNG key, checkpoint "
+                        "holds a plain array"
+                    )
+                leaf = jnp.asarray(arr)
+                if tuple(tmpl.shape) != tuple(leaf.shape):
+                    raise ValueError(
+                        f"leaf {i}: shape {tuple(leaf.shape)} != template "
+                        f"{tuple(tmpl.shape)}"
+                    )
+                if tmpl.dtype != leaf.dtype:
+                    raise ValueError(f"leaf {i}: dtype {leaf.dtype} != {tmpl.dtype}")
+            leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_orbax(path: str, state) -> None:
+    """Orbax backend (async/sharded-capable); keys are unwrapped the same
+    way so the two backends are interchangeable."""
+    import orbax.checkpoint as ocp
+
+    def unkey(leaf):
+        return jax.random.key_data(leaf) if _is_key(leaf) else leaf
+
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, jax.tree.map(unkey, state))
+
+
+def restore_orbax(path: str, template):
+    import orbax.checkpoint as ocp
+
+    def unkey(leaf):
+        return jax.random.key_data(leaf) if _is_key(leaf) else leaf
+
+    ckptr = ocp.PyTreeCheckpointer()
+    raw = ckptr.restore(path, item=jax.tree.map(unkey, template))
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    r_leaves = jax.tree_util.tree_leaves(raw)
+    out = []
+    for tmpl, leaf in zip(t_leaves, r_leaves):
+        if _is_key(tmpl):
+            out.append(jax.random.wrap_key_data(jnp.asarray(leaf)))
+        else:
+            out.append(jnp.asarray(leaf))
+    return jax.tree_util.tree_unflatten(treedef, out)

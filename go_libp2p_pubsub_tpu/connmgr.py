@@ -144,9 +144,11 @@ class TagTracer:
             slots = self.slot_of[peers, topics]
             edges = new.first_edge[peers, msgs].astype(np.int64)
             ok = slots >= 0
-            bump = np.zeros_like(self.cm.tags)
-            np.add.at(bump, (peers[ok], slots[ok], edges[ok]), TAG_BUMP)
-            self.cm.bump_array(bump)
+            idx = (peers[ok], slots[ok], edges[ok])
+            # in-place scatter + cap only the touched entries: O(deliveries),
+            # not O(N*S*K), per round
+            np.add.at(self.cm.tags, idx, TAG_BUMP)
+            self.cm.tags[idx] = np.minimum(self.cm.tags[idx], TAG_CAP)
         self.cm.maybe_decay(new.tick)
 
     def tags_for(self, peer: int) -> np.ndarray:
