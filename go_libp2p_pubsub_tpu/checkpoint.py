@@ -51,6 +51,8 @@ def restore(path: str, template):
     array values are ignored. Raises ValueError on any mismatch.
     """
     with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as data:
+        if "__version__" not in data.files or "__n_leaves__" not in data.files:
+            raise ValueError(f"{path} is not a go_libp2p_pubsub_tpu checkpoint")
         version = int(data["__version__"])
         if version != _FORMAT_VERSION:
             raise ValueError(f"unknown checkpoint version {version}")
@@ -107,6 +109,8 @@ def save_orbax(path: str, state) -> None:
 
 
 def restore_orbax(path: str, template):
+    """Validates against the template exactly like `restore` (leaf count,
+    per-leaf shape/dtype) so the backends really are interchangeable."""
     import orbax.checkpoint as ocp
 
     def unkey(leaf):
@@ -116,10 +120,21 @@ def restore_orbax(path: str, template):
     raw = ckptr.restore(path, item=jax.tree.map(unkey, template))
     t_leaves, treedef = jax.tree_util.tree_flatten(template)
     r_leaves = jax.tree_util.tree_leaves(raw)
+    if len(r_leaves) != len(t_leaves):
+        raise ValueError(
+            f"checkpoint has {len(r_leaves)} leaves, template has "
+            f"{len(t_leaves)} (different configs/topology?)"
+        )
     out = []
-    for tmpl, leaf in zip(t_leaves, r_leaves):
-        if _is_key(tmpl):
-            out.append(jax.random.wrap_key_data(jnp.asarray(leaf)))
-        else:
-            out.append(jnp.asarray(leaf))
+    for i, (tmpl, leaf) in enumerate(zip(t_leaves, r_leaves)):
+        leaf = jnp.asarray(leaf)
+        want = jax.random.key_data(tmpl) if _is_key(tmpl) else tmpl
+        if tuple(want.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"leaf {i}: shape {tuple(leaf.shape)} != template "
+                f"{tuple(want.shape)}"
+            )
+        if want.dtype != leaf.dtype:
+            raise ValueError(f"leaf {i}: dtype {leaf.dtype} != {want.dtype}")
+        out.append(jax.random.wrap_key_data(leaf) if _is_key(tmpl) else leaf)
     return jax.tree_util.tree_unflatten(treedef, out)

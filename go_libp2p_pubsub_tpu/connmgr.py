@@ -68,10 +68,6 @@ class ConnManager:
         t = self.tags[peer, slot, edge] + amount
         self.tags[peer, slot, edge] = min(t, TAG_CAP)
 
-    def bump_array(self, bump_mask: np.ndarray) -> None:
-        """bump_mask [N, S, K] int — add and cap elementwise."""
-        self.tags = np.minimum(self.tags + bump_mask, TAG_CAP)
-
     # -- valuation + trimming ---------------------------------------------
 
     def protected(self, net, mesh: np.ndarray | None) -> np.ndarray:

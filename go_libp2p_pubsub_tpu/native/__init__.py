@@ -1,0 +1,251 @@
+"""ctypes bindings for the native runtime layer (native/pubsub_native.cc).
+
+The compute path is JAX/XLA; this is the host runtime around it — the
+varint-delimited frame codec of the wire layer (comm.go protoio framing),
+the buffered/gzip delimited trace writer (tracer.go:132-303 PB/Remote
+sinks), and a bytes→slot interning table for the device↔host drain.
+
+Everything degrades gracefully: if the shared library hasn't been built
+(`make -C native`), `available()` is False and callers fall back to the
+pure-Python implementations in wire/framing.py — the two are round-trip
+tested against each other (tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_LIB_ERR: str | None = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _lib_path() -> str:
+    return os.path.join(_repo_root(), "native", "libpubsub_native.so")
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.ps_uvarint_encode.restype = ctypes.c_size_t
+    lib.ps_uvarint_encode.argtypes = [ctypes.c_uint64, ctypes.c_char_p]
+    lib.ps_uvarint_decode.restype = ctypes.c_long
+    lib.ps_uvarint_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
+    lib.ps_frame_split.restype = ctypes.c_long
+    lib.ps_frame_split.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_size_t)]
+    lib.ps_frame_join.restype = ctypes.c_long
+    lib.ps_frame_join.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t]
+    lib.ps_writer_open.restype = ctypes.c_void_p
+    lib.ps_writer_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_size_t, ctypes.c_size_t,
+        ctypes.c_int]
+    lib.ps_writer_write.restype = ctypes.c_int
+    lib.ps_writer_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.ps_writer_flush.restype = ctypes.c_int
+    lib.ps_writer_flush.argtypes = [ctypes.c_void_p]
+    lib.ps_writer_frames.restype = ctypes.c_uint64
+    lib.ps_writer_frames.argtypes = [ctypes.c_void_p]
+    lib.ps_writer_dropped.restype = ctypes.c_uint64
+    lib.ps_writer_dropped.argtypes = [ctypes.c_void_p]
+    lib.ps_writer_close.restype = ctypes.c_int
+    lib.ps_writer_close.argtypes = [ctypes.c_void_p]
+    lib.ps_interner_new.restype = ctypes.c_void_p
+    lib.ps_interner_new.argtypes = [ctypes.c_size_t]
+    lib.ps_interner_put.restype = ctypes.c_int
+    lib.ps_interner_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]
+    lib.ps_interner_get.restype = ctypes.c_int
+    lib.ps_interner_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.ps_interner_len.restype = ctypes.c_size_t
+    lib.ps_interner_len.argtypes = [ctypes.c_void_p]
+    lib.ps_interner_free.restype = None
+    lib.ps_interner_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _load():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    path = _lib_path()
+    try:
+        if not os.path.exists(path):
+            raise OSError(f"{path} not built (run `make -C native`)")
+        _LIB = _bind(ctypes.CDLL(path))
+    except OSError as e:  # missing or unloadable
+        _LIB_ERR = str(e)
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build() -> bool:
+    """Invoke make; returns True if the library is then loadable."""
+    global _LIB, _LIB_ERR
+    subprocess.run(["make", "-C", os.path.join(_repo_root(), "native")],
+                   check=True, capture_output=True)
+    _LIB, _LIB_ERR = None, None
+    return available()
+
+
+def _lib() -> ctypes.CDLL:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_LIB_ERR}")
+    return lib
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+def encode_uvarint(n: int) -> bytes:
+    buf = ctypes.create_string_buffer(10)
+    ln = _lib().ps_uvarint_encode(n, buf)
+    return buf.raw[:ln]
+
+
+def decode_uvarint(data: bytes) -> tuple[int, int]:
+    """(value, consumed); raises on truncated/overlong input."""
+    val = ctypes.c_uint64()
+    rc = _lib().ps_uvarint_decode(data, len(data), ctypes.byref(val))
+    if rc == 0:
+        raise EOFError("truncated uvarint")
+    if rc < 0:
+        raise ValueError("uvarint too long")
+    return val.value, rc
+
+
+def frame_join(payload: bytes) -> bytes:
+    cap = len(payload) + 10
+    out = ctypes.create_string_buffer(cap)
+    n = _lib().ps_frame_join(payload, len(payload), out, cap)
+    if n < 0:
+        raise ValueError("frame_join overflow")
+    return out.raw[:n]
+
+
+def frame_split(data: bytes, max_frames: int = 1 << 20) -> tuple[list[bytes], int]:
+    """Split a buffer of concatenated delimited frames into payloads.
+    Returns (payloads, consumed); a trailing partial frame is left
+    unconsumed (streaming contract of the reference's read loop)."""
+    offs = (ctypes.c_size_t * max_frames)()
+    lens = (ctypes.c_size_t * max_frames)()
+    consumed = ctypes.c_size_t()
+    n = _lib().ps_frame_split(data, len(data), offs, lens, max_frames,
+                              ctypes.byref(consumed))
+    if n < 0:
+        raise ValueError("malformed frame stream")
+    return [data[offs[i]:offs[i] + lens[i]] for i in range(n)], consumed.value
+
+
+# ---------------------------------------------------------------------------
+# trace writer
+
+
+class NativeTraceWriter:
+    """Buffered delimited-frame writer (optionally gzip) — the native
+    counterpart of trace/sinks.PBTracer's file plane."""
+
+    def __init__(self, path: str, gzip_level: int = 0,
+                 buffer_cap: int = 1 << 16, max_frame: int = 1 << 22,
+                 append: bool = False):
+        self._lib = _lib()
+        self._h = self._lib.ps_writer_open(
+            path.encode(), gzip_level, buffer_cap, max_frame, int(append))
+        if not self._h:
+            raise OSError(f"cannot open {path}")
+
+    def write(self, payload: bytes) -> bool:
+        """Append one frame; False if dropped (over max_frame)."""
+        rc = self._lib.ps_writer_write(self._h, payload, len(payload))
+        if rc < 0:
+            raise OSError("write failed")
+        return rc == 0
+
+    def write_message(self, msg) -> bool:
+        return self.write(msg.SerializeToString())
+
+    @property
+    def frames(self) -> int:
+        return self._lib.ps_writer_frames(self._h)
+
+    @property
+    def dropped(self) -> int:
+        return self._lib.ps_writer_dropped(self._h)
+
+    def flush(self) -> None:
+        if self._lib.ps_writer_flush(self._h) != 0:
+            raise OSError("flush failed")
+
+    def close(self) -> None:
+        if self._h:
+            rc = self._lib.ps_writer_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise OSError("close failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# interner
+
+
+class Interner:
+    """bytes -> int64 hash table (message-id -> slot map of the drain)."""
+
+    def __init__(self, capacity_hint: int = 1024):
+        self._lib = _lib()
+        self._h = self._lib.ps_interner_new(capacity_hint)
+        if not self._h:
+            raise MemoryError("interner allocation failed")
+
+    def put(self, key: bytes, value: int) -> None:
+        if self._lib.ps_interner_put(self._h, key, len(key), value) < 0:
+            raise MemoryError("interner insert failed")
+
+    def get(self, key: bytes, default: int | None = None) -> int | None:
+        out = ctypes.c_int64()
+        if self._lib.ps_interner_get(self._h, key, len(key), ctypes.byref(out)):
+            return out.value
+        return default
+
+    def __contains__(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def __len__(self) -> int:
+        return self._lib.ps_interner_len(self._h)
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.ps_interner_free(self._h)
+                self._h = None
+        except Exception:
+            pass

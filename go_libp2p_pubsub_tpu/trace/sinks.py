@@ -87,19 +87,41 @@ class JSONTracer(Tracer):
 
 
 class PBTracer(Tracer):
-    """Varint-delimited protobuf file sink (tracer.go:132-181)."""
+    """Varint-delimited protobuf file sink (tracer.go:132-181).
 
-    def __init__(self, path: str, **kw):
+    Uses the native C++ buffered writer (native/pubsub_native.cc) when the
+    shared library is built; pure-Python framing otherwise. Both produce
+    byte-identical files (tests/test_native.py interop tests)."""
+
+    def __init__(self, path: str, use_native: bool | None = None, **kw):
         super().__init__(**kw)
-        self._f = open(path, "ab")
+        from .. import native
+
+        if use_native is None:
+            use_native = native.available()
+        if use_native:
+            self._w = native.NativeTraceWriter(path, append=True)
+            self._f = None
+        else:
+            self._w = None
+            self._f = open(path, "ab")
 
     def _write(self, evs):
-        for ev in evs:
-            framing.write_delimited(self._f, ev)
-        self._f.flush()
+        if self._w is not None:
+            for ev in evs:
+                if not self._w.write_message(ev):
+                    self.dropped += 1  # over the native max_frame bound
+            self._w.flush()
+        else:
+            for ev in evs:
+                framing.write_delimited(self._f, ev)
+            self._f.flush()
 
     def _close(self):
-        self._f.close()
+        if self._w is not None:
+            self._w.close()
+        else:
+            self._f.close()
 
 
 class RemoteTracer(Tracer):

@@ -1,0 +1,341 @@
+// Native runtime layer for go_libp2p_pubsub_tpu.
+//
+// The reference's wire layer frames every RPC / trace record as a LEB128
+// varint length prefix + protobuf payload (protoio, used by comm.go:42-88,
+// 139-170 and tracer.go:132-181). The Go implementation leans on goroutines
+// + buffered writers; here the host-side hot paths (trace-file encode /
+// decode, message-id interning for the device<->host drain) are plain C++
+// behind a C ABI consumed via ctypes (no pybind11 in the image).
+//
+// Exposed surfaces:
+//   uvarint + frame codec  — single frames and batch splitting
+//   trace writer           — buffered delimited writer, optional gzip
+//                            (RemoteTracer batches gzip-compressed frames,
+//                            tracer.go:186-303)
+//   interner               — bytes -> int64 open-addressing hash table
+//                            (message-id -> slot table of the drain)
+//
+// Build: `make -C native` -> libpubsub_native.so. Everything is
+// single-threaded by design: callers own their handles (the Python side
+// serializes access exactly like the reference's per-sink writer goroutine).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// uvarint
+
+// Encode n as LEB128; out must hold >= 10 bytes. Returns bytes written.
+size_t ps_uvarint_encode(uint64_t n, uint8_t *out) {
+  size_t i = 0;
+  for (;;) {
+    uint8_t b = n & 0x7f;
+    n >>= 7;
+    if (n) {
+      out[i++] = b | 0x80;
+    } else {
+      out[i++] = b;
+      return i;
+    }
+  }
+}
+
+// Decode a uvarint at buf[0..len). On success returns consumed byte count
+// and stores the value; returns 0 if truncated, -1 if >64-bit (overlong).
+long ps_uvarint_decode(const uint8_t *buf, size_t len, uint64_t *value) {
+  uint64_t result = 0;
+  unsigned shift = 0;
+  for (size_t i = 0; i < len; i++) {
+    uint8_t b = buf[i];
+    result |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *value = result;
+      return (long)(i + 1);
+    }
+    shift += 7;
+    if (shift > 63) return -1;
+  }
+  return 0;  // truncated
+}
+
+// ---------------------------------------------------------------------------
+// frame batch codec
+
+// Scan a buffer of concatenated [varint len][payload] frames. Fills
+// offsets[]/lengths[] with payload extents for up to max_frames frames.
+// Returns the number of complete frames found; *consumed is the byte count
+// of those complete frames (a trailing partial frame is left unconsumed).
+// Returns -1 on a malformed varint.
+long ps_frame_split(const uint8_t *buf, size_t len, size_t *offsets,
+                    size_t *lengths, size_t max_frames, size_t *consumed) {
+  size_t pos = 0, n = 0;
+  *consumed = 0;
+  while (pos < len && n < max_frames) {
+    uint64_t flen;
+    long hdr = ps_uvarint_decode(buf + pos, len - pos, &flen);
+    if (hdr < 0) return -1;
+    if (hdr == 0 || pos + (size_t)hdr + flen > len) break;  // partial tail
+    offsets[n] = pos + (size_t)hdr;
+    lengths[n] = (size_t)flen;
+    pos += (size_t)hdr + (size_t)flen;
+    n++;
+    *consumed = pos;
+  }
+  return (long)n;
+}
+
+// Encode payloads into a delimited stream buffer. Returns bytes written or
+// -1 if out_cap is too small.
+long ps_frame_join(const uint8_t *payload, size_t n, uint8_t *out,
+                   size_t out_cap) {
+  uint8_t hdr[10];
+  size_t h = ps_uvarint_encode((uint64_t)n, hdr);
+  if (h + n > out_cap) return -1;
+  memcpy(out, hdr, h);
+  memcpy(out + h, payload, n);
+  return (long)(h + n);
+}
+
+// ---------------------------------------------------------------------------
+// buffered delimited trace writer (PBTracer / RemoteTracer file plane)
+
+struct PsWriter {
+  FILE *f;        // plain file (gz == nullptr)
+  gzFile gz;      // gzip stream (f == nullptr)
+  uint8_t *buf;
+  size_t cap;
+  size_t pos;
+  uint64_t frames;
+  uint64_t dropped;
+  size_t max_frame;  // frames larger than this are dropped (lossy contract)
+};
+
+static int ps_writer_flush_internal(PsWriter *w) {
+  if (w->pos == 0) return 0;
+  size_t wrote;
+  if (w->gz) {
+    wrote = (size_t)gzwrite(w->gz, w->buf, (unsigned)w->pos);
+  } else {
+    wrote = fwrite(w->buf, 1, w->pos, w->f);
+  }
+  if (wrote != w->pos) return -1;
+  w->pos = 0;
+  return 0;
+}
+
+// Open a writer. gzip_level 0 = plain file; 1..9 = gzip. buffer_cap is the
+// internal coalescing buffer (bytes); max_frame bounds a single payload
+// (larger payloads are counted in dropped, mirroring the reference's lossy
+// tracer buffer, tracer.go:23-24).
+void *ps_writer_open(const char *path, int gzip_level, size_t buffer_cap,
+                     size_t max_frame, int append) {
+  PsWriter *w = (PsWriter *)calloc(1, sizeof(PsWriter));
+  if (!w) return nullptr;
+  if (gzip_level > 0) {
+    char mode[8];
+    snprintf(mode, sizeof mode, "%cb%d", append ? 'a' : 'w',
+             gzip_level > 9 ? 9 : gzip_level);
+    w->gz = gzopen(path, mode);
+    if (!w->gz) { free(w); return nullptr; }
+  } else {
+    w->f = fopen(path, append ? "ab" : "wb");
+    if (!w->f) { free(w); return nullptr; }
+  }
+  w->cap = buffer_cap ? buffer_cap : (1 << 16);
+  w->max_frame = max_frame ? max_frame : (1 << 22);
+  w->buf = (uint8_t *)malloc(w->cap);
+  if (!w->buf) {
+    if (w->f) fclose(w->f);
+    if (w->gz) gzclose(w->gz);
+    free(w);
+    return nullptr;
+  }
+  return w;
+}
+
+// Append one delimited frame. Returns 0 ok, 1 dropped (over max_frame),
+// -1 on I/O error.
+int ps_writer_write(void *handle, const uint8_t *payload, size_t n) {
+  PsWriter *w = (PsWriter *)handle;
+  if (n > w->max_frame) { w->dropped++; return 1; }
+  uint8_t hdr[10];
+  size_t h = ps_uvarint_encode((uint64_t)n, hdr);
+  if (w->pos + h + n > w->cap && ps_writer_flush_internal(w) != 0) return -1;
+  if (h + n > w->cap) {
+    // frame larger than the coalescing buffer: write through
+    size_t wh, wn;
+    if (w->gz) {
+      wh = (size_t)gzwrite(w->gz, hdr, (unsigned)h);
+      wn = (size_t)gzwrite(w->gz, payload, (unsigned)n);
+    } else {
+      wh = fwrite(hdr, 1, h, w->f);
+      wn = fwrite(payload, 1, n, w->f);
+    }
+    if (wh != h || wn != n) return -1;
+  } else {
+    memcpy(w->buf + w->pos, hdr, h);
+    memcpy(w->buf + w->pos + h, payload, n);
+    w->pos += h + n;
+  }
+  w->frames++;
+  return 0;
+}
+
+int ps_writer_flush(void *handle) {
+  PsWriter *w = (PsWriter *)handle;
+  if (ps_writer_flush_internal(w) != 0) return -1;
+  if (w->f) return fflush(w->f) == 0 ? 0 : -1;
+  return gzflush(w->gz, Z_SYNC_FLUSH) == Z_OK ? 0 : -1;
+}
+
+uint64_t ps_writer_frames(void *handle) { return ((PsWriter *)handle)->frames; }
+uint64_t ps_writer_dropped(void *handle) { return ((PsWriter *)handle)->dropped; }
+
+int ps_writer_close(void *handle) {
+  PsWriter *w = (PsWriter *)handle;
+  int rc = ps_writer_flush_internal(w);
+  if (w->f && fclose(w->f) != 0) rc = -1;
+  if (w->gz && gzclose(w->gz) != Z_OK) rc = -1;
+  free(w->buf);
+  free(w);
+  return rc;
+}
+
+// ---------------------------------------------------------------------------
+// interner: bytes -> int64, open addressing, FNV-1a
+
+struct PsSlot {
+  uint64_t hash;
+  size_t key_off;
+  uint32_t key_len;
+  int64_t value;
+  uint8_t used;
+};
+
+struct PsInterner {
+  PsSlot *slots;
+  size_t cap;     // power of two
+  size_t count;
+  uint8_t *arena;
+  size_t arena_cap;
+  size_t arena_pos;
+};
+
+static uint64_t fnv1a(const uint8_t *k, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < n; i++) {
+    h ^= k[i];
+    h *= 1099511628211ull;
+  }
+  return h ? h : 1;  // reserve 0 for "empty"
+}
+
+void *ps_interner_new(size_t capacity_hint) {
+  size_t cap = 64;
+  while (cap < capacity_hint * 2) cap <<= 1;
+  PsInterner *t = (PsInterner *)calloc(1, sizeof(PsInterner));
+  if (!t) return nullptr;
+  t->slots = (PsSlot *)calloc(cap, sizeof(PsSlot));
+  t->cap = cap;
+  t->arena_cap = cap * 16;
+  t->arena = (uint8_t *)malloc(t->arena_cap);
+  if (!t->slots || !t->arena) {
+    free(t->slots);
+    free(t->arena);
+    free(t);
+    return nullptr;
+  }
+  return t;
+}
+
+static int ps_interner_grow(PsInterner *t);
+
+// Insert or update. Returns 0 inserted, 1 updated, -1 on alloc failure.
+int ps_interner_put(void *handle, const uint8_t *key, size_t len,
+                    int64_t value) {
+  PsInterner *t = (PsInterner *)handle;
+  if (t->count * 4 >= t->cap * 3 && ps_interner_grow(t) != 0) return -1;
+  uint64_t h = fnv1a(key, len);
+  size_t mask = t->cap - 1;
+  for (size_t i = h & mask;; i = (i + 1) & mask) {
+    PsSlot *s = &t->slots[i];
+    if (!s->used) {
+      if (t->arena_pos + len > t->arena_cap) {
+        size_t ncap = t->arena_cap * 2 + len;
+        uint8_t *na = (uint8_t *)realloc(t->arena, ncap);
+        if (!na) return -1;
+        t->arena = na;
+        t->arena_cap = ncap;
+      }
+      memcpy(t->arena + t->arena_pos, key, len);
+      s->hash = h;
+      s->key_off = t->arena_pos;
+      s->key_len = (uint32_t)len;
+      s->value = value;
+      s->used = 1;
+      t->arena_pos += len;
+      t->count++;
+      return 0;
+    }
+    if (s->hash == h && s->key_len == len &&
+        memcmp(t->arena + s->key_off, key, len) == 0) {
+      s->value = value;
+      return 1;
+    }
+  }
+}
+
+static int ps_interner_grow(PsInterner *t) {
+  size_t ncap = t->cap * 2;
+  PsSlot *ns = (PsSlot *)calloc(ncap, sizeof(PsSlot));
+  if (!ns) return -1;
+  size_t mask = ncap - 1;
+  for (size_t i = 0; i < t->cap; i++) {
+    PsSlot *s = &t->slots[i];
+    if (!s->used) continue;
+    for (size_t j = s->hash & mask;; j = (j + 1) & mask) {
+      if (!ns[j].used) {
+        ns[j] = *s;
+        break;
+      }
+    }
+  }
+  free(t->slots);
+  t->slots = ns;
+  t->cap = ncap;
+  return 0;
+}
+
+// Returns 1 and stores *value if present, else 0.
+int ps_interner_get(void *handle, const uint8_t *key, size_t len,
+                    int64_t *value) {
+  PsInterner *t = (PsInterner *)handle;
+  uint64_t h = fnv1a(key, len);
+  size_t mask = t->cap - 1;
+  for (size_t i = h & mask;; i = (i + 1) & mask) {
+    PsSlot *s = &t->slots[i];
+    if (!s->used) return 0;
+    if (s->hash == h && s->key_len == len &&
+        memcmp(t->arena + s->key_off, key, len) == 0) {
+      *value = s->value;
+      return 1;
+    }
+  }
+}
+
+size_t ps_interner_len(void *handle) { return ((PsInterner *)handle)->count; }
+
+void ps_interner_free(void *handle) {
+  PsInterner *t = (PsInterner *)handle;
+  free(t->slots);
+  free(t->arena);
+  free(t);
+}
+
+}  // extern "C"

@@ -100,3 +100,19 @@ def test_orbax_roundtrip(tmp_path):
     checkpoint.save_orbax(path, st)
     back = checkpoint.restore_orbax(path, SimState.init(8, 16, seed=0))
     _assert_tree_equal(st, back)
+
+
+def test_restore_rejects_non_checkpoint_npz(tmp_path):
+    path = str(tmp_path / "plain.npz")
+    np.savez(path, a=np.zeros(3))
+    with pytest.raises(ValueError):
+        checkpoint.restore(path, SimState.init(4, 16, seed=0))
+
+
+def test_orbax_restore_shape_mismatch_rejected(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    st = SimState.init(8, 16, seed=3)
+    path = str(tmp_path / "orbax_bad")
+    checkpoint.save_orbax(path, st)
+    with pytest.raises(ValueError):
+        checkpoint.restore_orbax(path, SimState.init(16, 16, seed=0))

@@ -1,0 +1,162 @@
+"""Native runtime layer: C++ codec/writer/interner round-tripped against
+the pure-Python wire implementation (wire/framing.py)."""
+
+import gzip
+import io
+import os
+
+import pytest
+
+from go_libp2p_pubsub_tpu import native
+from go_libp2p_pubsub_tpu.pb import trace_pb2
+from go_libp2p_pubsub_tpu.wire import framing
+
+pytestmark = pytest.mark.skipif(
+    not native.available() and not native.build(),
+    reason="native library not buildable",
+)
+
+
+@pytest.mark.parametrize("n", [0, 1, 127, 128, 300, 2**21, 2**32, 2**63 - 1])
+def test_uvarint_matches_python(n):
+    assert native.encode_uvarint(n) == framing.encode_uvarint(n)
+    v, consumed = native.decode_uvarint(framing.encode_uvarint(n) + b"tail")
+    assert v == n
+    assert consumed == len(framing.encode_uvarint(n))
+
+
+def test_uvarint_truncated_and_overlong():
+    with pytest.raises(EOFError):
+        native.decode_uvarint(b"\x80")
+    with pytest.raises(ValueError):
+        native.decode_uvarint(b"\xff" * 10 + b"\x01")
+
+
+def test_frame_join_split_roundtrip():
+    payloads = [b"", b"a", b"hello world", os.urandom(5000)]
+    stream = b"".join(native.frame_join(p) for p in payloads)
+    out, consumed = native.frame_split(stream)
+    assert out == payloads
+    assert consumed == len(stream)
+
+
+def test_frame_split_partial_tail():
+    full = native.frame_join(b"complete")
+    partial = native.frame_join(b"never-finished")[:-3]
+    out, consumed = native.frame_split(full + partial)
+    assert out == [b"complete"]
+    assert consumed == len(full)  # partial tail left for the next read
+
+
+def test_frame_split_interop_with_python_writer():
+    buf = io.BytesIO()
+    evs = []
+    for i in range(10):
+        ev = trace_pb2.TraceEvent(type=trace_pb2.TraceEvent.PUBLISH_MESSAGE,
+                                  peerID=b"peer-%d" % i, timestamp=i)
+        evs.append(ev)
+        framing.write_delimited(buf, ev)
+    payloads, consumed = native.frame_split(buf.getvalue())
+    assert consumed == len(buf.getvalue())
+    got = [trace_pb2.TraceEvent.FromString(p) for p in payloads]
+    assert got == evs
+
+
+def test_native_writer_read_back_with_python_reader(tmp_path):
+    path = str(tmp_path / "trace.pb")
+    evs = [trace_pb2.TraceEvent(type=trace_pb2.TraceEvent.GRAFT,
+                                peerID=b"p%d" % i, timestamp=i)
+           for i in range(50)]
+    with native.NativeTraceWriter(path) as w:
+        for ev in evs:
+            assert w.write_message(ev)
+        assert w.frames == 50
+        w.flush()
+    with open(path, "rb") as f:
+        got = list(framing.read_delimited_messages(f, trace_pb2.TraceEvent))
+    assert got == evs
+
+
+def test_native_writer_gzip(tmp_path):
+    path = str(tmp_path / "trace.pb.gz")
+    evs = [trace_pb2.TraceEvent(type=trace_pb2.TraceEvent.PRUNE,
+                                peerID=b"z", timestamp=i) for i in range(20)]
+    with native.NativeTraceWriter(path, gzip_level=6) as w:
+        for ev in evs:
+            w.write_message(ev)
+    with gzip.open(path, "rb") as f:
+        got = list(framing.read_delimited_messages(f, trace_pb2.TraceEvent))
+    assert got == evs
+
+
+def test_native_writer_drops_oversize(tmp_path):
+    path = str(tmp_path / "t.pb")
+    with native.NativeTraceWriter(path, max_frame=16) as w:
+        assert w.write(b"x" * 8)
+        assert not w.write(b"x" * 64)  # dropped, lossy contract
+        assert w.frames == 1 and w.dropped == 1
+
+
+def test_interner_basic():
+    t = native.Interner(4)
+    assert t.get(b"missing") is None
+    t.put(b"msg-1", 7)
+    t.put(b"msg-2", 9)
+    assert t.get(b"msg-1") == 7
+    assert b"msg-2" in t and len(t) == 2
+    t.put(b"msg-1", 42)  # update, not duplicate
+    assert t.get(b"msg-1") == 42 and len(t) == 2
+
+
+def test_interner_growth_many_keys():
+    t = native.Interner(4)
+    for i in range(5000):
+        t.put(b"key-%d" % i, i)
+    assert len(t) == 5000
+    for i in range(0, 5000, 37):
+        assert t.get(b"key-%d" % i) == i
+
+
+def test_interner_matches_dict_random():
+    import random
+
+    rng = random.Random(0)
+    t = native.Interner()
+    ref = {}
+    for _ in range(2000):
+        k = bytes(rng.randbytes(rng.randint(0, 40)))
+        v = rng.randint(-2**62, 2**62)
+        t.put(k, v)
+        ref[k] = v
+    assert len(t) == len(ref)
+    for k, v in ref.items():
+        assert t.get(k) == v
+
+
+def test_pbtracer_native_path_matches_python(tmp_path):
+    """PBTracer with use_native=True/False writes byte-identical files."""
+    from go_libp2p_pubsub_tpu.trace import sinks
+
+    evs = [trace_pb2.TraceEvent(type=trace_pb2.TraceEvent.DELIVER_MESSAGE,
+                                peerID=b"p%d" % i, timestamp=i)
+           for i in range(40)]
+    p_native = str(tmp_path / "n.pb")
+    p_python = str(tmp_path / "p.pb")
+    for path, use in ((p_native, True), (p_python, False)):
+        t = sinks.PBTracer(path, use_native=use)
+        t.trace_many(evs)
+        t.close()
+    with open(p_native, "rb") as a, open(p_python, "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_native_writer_append_mode(tmp_path):
+    path = str(tmp_path / "a.pb")
+    with native.NativeTraceWriter(path) as w:
+        w.write(b"one")
+    with native.NativeTraceWriter(path, append=True) as w:
+        w.write(b"two")
+    with open(path, "rb") as f:
+        data = f.read()
+    payloads, _ = native.frame_split(data)
+    assert payloads == [b"one", b"two"]
