@@ -17,8 +17,6 @@ import ctypes
 import os
 import subprocess
 
-import numpy as np
-
 _LIB = None
 _LIB_ERR: str | None = None
 
@@ -95,10 +93,15 @@ def available() -> bool:
 
 
 def build() -> bool:
-    """Invoke make; returns True if the library is then loadable."""
+    """Invoke make; returns True if the library is then loadable, False if
+    the toolchain is missing or the build fails (safe as a skip guard)."""
     global _LIB, _LIB_ERR
-    subprocess.run(["make", "-C", os.path.join(_repo_root(), "native")],
-                   check=True, capture_output=True)
+    try:
+        subprocess.run(["make", "-C", os.path.join(_repo_root(), "native")],
+                       check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        _LIB_ERR = f"native build failed: {e}"
+        return False
     _LIB, _LIB_ERR = None, None
     return available()
 
@@ -140,18 +143,30 @@ def frame_join(payload: bytes) -> bytes:
     return out.raw[:n]
 
 
-def frame_split(data: bytes, max_frames: int = 1 << 20) -> tuple[list[bytes], int]:
+def frame_split(data: bytes) -> tuple[list[bytes], int]:
     """Split a buffer of concatenated delimited frames into payloads.
     Returns (payloads, consumed); a trailing partial frame is left
     unconsumed (streaming contract of the reference's read loop)."""
-    offs = (ctypes.c_size_t * max_frames)()
-    lens = (ctypes.c_size_t * max_frames)()
-    consumed = ctypes.c_size_t()
-    n = _lib().ps_frame_split(data, len(data), offs, lens, max_frames,
-                              ctypes.byref(consumed))
-    if n < 0:
-        raise ValueError("malformed frame stream")
-    return [data[offs[i]:offs[i] + lens[i]] for i in range(n)], consumed.value
+    # a frame needs >= 2 bytes (1-byte header + payload, or empty payload
+    # headers alone), so len//2 + 1 bounds the count; loop to drain buffers
+    # whose frames are all empty-payload (1 byte each)
+    payloads: list[bytes] = []
+    total = 0
+    lib = _lib()
+    while True:
+        rest = data[total:]
+        cap = min(max(len(rest) // 2 + 1, 1), 1 << 16)
+        offs = (ctypes.c_size_t * cap)()
+        lens = (ctypes.c_size_t * cap)()
+        consumed = ctypes.c_size_t()
+        n = lib.ps_frame_split(rest, len(rest), offs, lens, cap,
+                               ctypes.byref(consumed))
+        if n < 0:
+            raise ValueError("malformed frame stream")
+        payloads.extend(rest[offs[i]:offs[i] + lens[i]] for i in range(n))
+        total += consumed.value
+        if n < cap or consumed.value == 0:
+            return payloads, total
 
 
 # ---------------------------------------------------------------------------
@@ -171,9 +186,14 @@ class NativeTraceWriter:
         if not self._h:
             raise OSError(f"cannot open {path}")
 
+    def _handle(self):
+        if self._h is None:
+            raise ValueError("I/O operation on closed NativeTraceWriter")
+        return self._h
+
     def write(self, payload: bytes) -> bool:
         """Append one frame; False if dropped (over max_frame)."""
-        rc = self._lib.ps_writer_write(self._h, payload, len(payload))
+        rc = self._lib.ps_writer_write(self._handle(), payload, len(payload))
         if rc < 0:
             raise OSError("write failed")
         return rc == 0
@@ -183,14 +203,14 @@ class NativeTraceWriter:
 
     @property
     def frames(self) -> int:
-        return self._lib.ps_writer_frames(self._h)
+        return self._lib.ps_writer_frames(self._handle())
 
     @property
     def dropped(self) -> int:
-        return self._lib.ps_writer_dropped(self._h)
+        return self._lib.ps_writer_dropped(self._handle())
 
     def flush(self) -> None:
-        if self._lib.ps_writer_flush(self._h) != 0:
+        if self._lib.ps_writer_flush(self._handle()) != 0:
             raise OSError("flush failed")
 
     def close(self) -> None:

@@ -1,0 +1,390 @@
+"""Scalar GossipSub oracle: a per-node Python transcription of the
+reference protocol (gossipsub.go) under the simulator's synchronous-round
+timing, used as the parity target for the vectorized router.
+
+Scope: the honest-network data+control plane — mesh maintenance
+(gossipsub.go:1344-1515), GRAFT/PRUNE with backoff (handleGraft :718-809,
+handlePrune :811-843), IHAVE/IWANT lazy gossip with flood caps
+(handleIHave :615-677, handleIWant :679-716), mcache windows (mcache.go),
+flood-publish (gossipsub.go:957-963). Scoring is disabled here — the score
+engine has its own dedicated oracle (oracle/score.py, tests/test_score.py)
+— and fanout is out of scope (parity harnesses subscribe every peer).
+
+RNG parity with the vectorized engine is impossible by design (survey §7
+hard-part (d)); the oracle draws from its own `random.Random`, and parity
+is asserted *distributionally*: propagation-latency CDFs within 2%
+(BASELINE.json north_star).
+
+Round ordering mirrors models/gossipsub.py `_round` exactly:
+  1. GRAFT/PRUNE ingest (sent by neighbors last round)
+  2. IWANT service (requests I issued last round -> extra deliveries)
+  3. IHAVE ingest (advertisements from neighbors' last heartbeat -> asks)
+  4. mesh/flood delivery of senders' forward sets, then IWANT merges
+  5. mcache put of validated new receipts
+  6. publish interning (transmits next round)
+  7. heartbeat: backoff clear, mesh maintenance, emitGossip, mcache shift
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from ..graph import Subscriptions, Topology
+from ..models.gossipsub import GossipSubConfig
+from ..trace.events import EV, N_EVENTS
+
+
+@dataclass
+class OMsg:
+    slot: int
+    topic: int
+    origin: int
+    birth: int
+    valid: bool
+
+
+@dataclass
+class OracleGossipSub:
+    topo: Topology
+    subs: Subscriptions
+    cfg: GossipSubConfig
+    msg_slots: int = 64
+    seed: int = 0
+
+    tick: int = 0
+    msgs: dict = field(default_factory=dict)   # slot -> OMsg
+    cursor: int = 0
+    first_round: dict = field(default_factory=dict)  # (i, slot) -> round
+    first_edge: dict = field(default_factory=dict)   # (i, slot) -> k | -1
+
+    def __post_init__(self):
+        assert not self.cfg.score_enabled, "score plane has its own oracle"
+        assert self.cfg.heartbeat_every == 1
+        n = self.topo.n_peers
+        self.rng = random.Random(self.seed)
+        self.seen = [set() for _ in range(n)]
+        self.fwd = [set() for _ in range(n)]
+        # mesh[i][t] = set of edge slots k
+        self.mesh = [dict() for _ in range(n)]
+        for i in range(n):
+            for t in range(self.subs.n_topics):
+                if self.subs.subscribed[i, t]:
+                    self.mesh[i][t] = set()
+        self.backoff_expire = [dict() for _ in range(n)]  # (t,k) -> tick
+        self.backoff_present = [set() for _ in range(n)]  # {(t,k)}
+        # mcache windows: index 0 = current heartbeat (mcache.go:94-104)
+        self.mcache = [[set() for _ in range(self.cfg.history_length)]
+                       for _ in range(n)]
+        self.ihave_out = [dict() for _ in range(n)]  # k -> set(slot)
+        self.iwant_out = [dict() for _ in range(n)]  # k -> set(slot)
+        self.graft_out = [set() for _ in range(n)]   # {(t, k)}
+        self.prune_out = [set() for _ in range(n)]   # {(t, k)}
+        self.peerhave = [dict() for _ in range(n)]   # k -> int
+        self.iasked = [dict() for _ in range(n)]     # k -> int
+        self.served = [dict() for _ in range(n)]     # (k, slot) -> count
+        self.events = [0] * N_EVENTS
+
+    # -- helpers ------------------------------------------------------------
+
+    def _edges(self, i):
+        """Valid (k, s, r): edge slot k to neighbor s whose reverse slot is r."""
+        topo = self.topo
+        for k in range(topo.max_degree):
+            if topo.nbr_ok[i, k]:
+                yield k, int(topo.nbr[i, k]), int(topo.rev[i, k])
+
+    def _sample(self, pool, k):
+        pool = sorted(pool)
+        if k <= 0 or not pool:
+            return set()
+        if k >= len(pool):
+            return set(pool)
+        return set(self.rng.sample(pool, k))
+
+    def _recycle(self, slot):
+        self.msgs.pop(slot, None)
+        for i in range(self.topo.n_peers):
+            self.seen[i].discard(slot)
+            self.fwd[i].discard(slot)
+            self.first_round.pop((i, slot), None)
+            self.first_edge.pop((i, slot), None)
+            for w in self.mcache[i]:
+                w.discard(slot)
+            for d in (self.ihave_out[i], self.iwant_out[i]):
+                for s in d.values():
+                    s.discard(slot)
+            for key in [key for key in self.served[i] if key[1] == slot]:
+                del self.served[i][key]
+
+    def publish(self, origin, topic, valid=True):
+        slot = self.cursor % self.msg_slots
+        self.cursor += 1
+        self._recycle(slot)
+        self.msgs[slot] = OMsg(slot, topic, origin, self.tick, valid)
+        self.seen[origin].add(slot)
+        self.fwd[origin].add(slot)
+        self.first_round[(origin, slot)] = self.tick
+        self.first_edge[(origin, slot)] = -1
+        self.mcache[origin][0].add(slot)
+        self.events[EV.PUBLISH_MESSAGE] += 1
+        return slot
+
+    # -- one round ----------------------------------------------------------
+
+    def step(self, publishes=()):
+        cfg, topo, subs = self.cfg, self.topo, self.subs
+        n = topo.n_peers
+        tick = self.tick
+
+        # 1. GRAFT/PRUNE ingest (handle_graft_prune)
+        prune_resp = [set() for _ in range(n)]
+        for i in range(n):
+            incoming_graft, incoming_prune = [], []
+            for k, s, r in self._edges(i):
+                for (t, ks) in self.graft_out[s]:
+                    if ks == r and t in self.mesh[i]:
+                        incoming_graft.append((t, k))
+                for (t, ks) in self.prune_out[s]:
+                    if ks == r and t in self.mesh[i]:
+                        incoming_prune.append((t, k))
+            # handlePrune first (the vectorized handler masks mesh before
+            # computing graft admission)
+            for (t, k) in incoming_prune:
+                if k in self.mesh[i][t]:
+                    self.mesh[i][t].discard(k)
+                    self.events[EV.PRUNE] += 1
+                be = self.backoff_expire[i]
+                be[(t, k)] = max(be.get((t, k), 0), tick + cfg.prune_backoff_ticks)
+                self.backoff_present[i].add((t, k))
+            # handleGraft: one degree snapshot for all of this round's grafts
+            deg0 = {t: len(m) for t, m in self.mesh[i].items()}
+            for (t, k) in incoming_graft:
+                if k in self.mesh[i][t]:
+                    continue
+                be = self.backoff_expire[i].get((t, k), None)
+                backoff_active = (t, k) in self.backoff_present[i] and (
+                    be is not None and tick < be
+                )
+                full = deg0[t] >= cfg.Dhi and not topo.outbound[i, k]
+                if backoff_active or full:
+                    prune_resp[i].add((t, k))
+                    be2 = self.backoff_expire[i]
+                    be2[(t, k)] = max(be2.get((t, k), 0), tick + cfg.prune_backoff_ticks)
+                    self.backoff_present[i].add((t, k))
+                else:
+                    self.mesh[i][t].add(k)
+                    self.events[EV.GRAFT] += 1
+
+        # 2. IWANT service (iwant_responses): what I asked last round, from
+        # the neighbor's full mcache window, capped per (edge, msg)
+        extra = [dict() for _ in range(n)]  # i -> {slot: [k,...]}
+        for i in range(n):
+            for k, s, r in self._edges(i):
+                asked = self.iwant_out[i].get(k, ())
+                if not asked:
+                    continue
+                window = set().union(*self.mcache[s])
+                for slot in asked:
+                    if slot not in window:
+                        continue
+                    cnt = self.served[i].get((k, slot), 0)
+                    if cnt >= min(max(cfg.gossip_retransmission, 0), 3):
+                        continue
+                    self.served[i][(k, slot)] = cnt + 1
+                    extra[i].setdefault(slot, []).append(k)
+
+        # 3. IHAVE ingest (handle_ihave) -> next round's asks
+        new_iwant = [dict() for _ in range(n)]
+        for i in range(n):
+            for k, s, r in self._edges(i):
+                advertised = self.ihave_out[s].get(r, ())
+                if not advertised:
+                    continue
+                ph = self.peerhave[i].get(k, 0) + 1
+                self.peerhave[i][k] = ph
+                if ph > cfg.max_ihave_messages:
+                    continue
+                ia = self.iasked[i].get(k, 0)
+                if ia >= cfg.max_ihave_length:
+                    continue
+                wants = sorted(
+                    slot for slot in advertised
+                    if slot not in self.seen[i]
+                    and self.msgs[slot].topic in self.mesh[i]
+                )
+                asks = wants[: cfg.max_ihave_length - ia]
+                if asks:
+                    self.iasked[i][k] = ia + len(asks)
+                    new_iwant[i][k] = set(asks)
+        self.iwant_out = new_iwant
+
+        # 4. delivery: senders push last round's fwd along mesh (+flood)
+        arrivals = [dict() for _ in range(n)]  # slot -> [k,...]
+        n_rpc = 0
+        for i in range(n):
+            for k, s, r in self._edges(i):
+                for slot in self.fwd[s]:
+                    msg = self.msgs.get(slot)
+                    if msg is None or msg.origin == i:
+                        continue
+                    if msg.topic not in self.mesh[i]:
+                        continue  # receiver's joined filter
+                    if self.first_edge.get((s, slot)) == r:
+                        continue  # echo exclusion
+                    carries = r in self.mesh[s].get(msg.topic, ())
+                    if cfg.flood_publish and msg.origin == s:
+                        carries = True
+                    if not carries:
+                        continue
+                    arrivals[i].setdefault(slot, []).append(k)
+                    n_rpc += 1
+
+        new_fwd = [set() for _ in range(n)]
+        n_new = n_deliver = 0
+        for i in range(n):
+            for slot, ks in sorted(arrivals[i].items()):
+                if slot in self.seen[i]:
+                    continue
+                n_new += 1
+                self.seen[i].add(slot)
+                self.first_round[(i, slot)] = tick
+                self.first_edge[(i, slot)] = min(ks)
+                if self.msgs[slot].valid:
+                    n_deliver += 1
+                    new_fwd[i].add(slot)
+        # merge IWANT responses (merge_extra_tx: no echo exclusion,
+        # origin-exclusion only, mesh arrivals take first_edge precedence)
+        for i in range(n):
+            for slot, ks in sorted(extra[i].items()):
+                msg = self.msgs.get(slot)
+                live = [k for k in ks if msg is not None and msg.origin != i]
+                n_rpc += len(live)
+                if not live or slot in self.seen[i]:
+                    continue
+                n_new += 1
+                self.seen[i].add(slot)
+                self.first_round[(i, slot)] = tick
+                self.first_edge[(i, slot)] = min(live)
+                if msg.valid:
+                    n_deliver += 1
+                    new_fwd[i].add(slot)
+        self.events[EV.DELIVER_MESSAGE] += n_deliver
+        self.events[EV.REJECT_MESSAGE] += n_new - n_deliver
+        self.events[EV.DUPLICATE_MESSAGE] += n_rpc - n_new
+        self.events[EV.SEND_RPC] += n_rpc
+        self.events[EV.RECV_RPC] += n_rpc
+
+        # 5. mcache put: validated new receipts in joined topics
+        for i in range(n):
+            for slot in new_fwd[i]:
+                if self.msgs[slot].topic in self.mesh[i]:
+                    self.mcache[i][0].add(slot)
+        self.fwd = new_fwd
+
+        # 6. publishes (transmit next round)
+        for origin, topic, valid in publishes:
+            self.publish(origin, topic, valid)
+
+        # 7. heartbeat
+        self.prune_out = prune_resp
+        self._heartbeat()
+        self.tick += 1
+
+    # -- heartbeat ----------------------------------------------------------
+
+    def _heartbeat(self):
+        cfg, topo = self.cfg, self.topo
+        n = topo.n_peers
+        tick = self.tick
+
+        for i in range(n):
+            # clearIHaveCounters
+            self.peerhave[i] = {}
+            self.iasked[i] = {}
+            # clearBackoff every backoff_clear_ticks, with slack
+            if tick % cfg.backoff_clear_ticks == 0:
+                expired = [
+                    key for key in self.backoff_present[i]
+                    if self.backoff_expire[i].get(key, 0) + cfg.backoff_slack_ticks < tick
+                ]
+                for key in expired:
+                    self.backoff_present[i].discard(key)
+                    self.backoff_expire[i].pop(key, None)
+
+            tograft, toprune = set(), set()
+            nbr_sub = {}  # t -> set of candidate-capable edges
+            for t in self.mesh[i]:
+                nbr_sub[t] = {
+                    k for k, s, r in self._edges(i) if self.subs.subscribed[s, t]
+                }
+
+            for t, m in self.mesh[i].items():
+                cand = {
+                    k for k in nbr_sub[t]
+                    if k not in m and (t, k) not in self.backoff_present[i]
+                }
+                # underpopulated -> graft to D
+                if len(m) < cfg.Dlo:
+                    grafts = self._sample(cand, cfg.D - len(m))
+                    m |= grafts
+                    tograft |= {(t, k) for k in grafts}
+                    cand -= grafts
+                # overpopulated -> keep D with >= Dout outbound
+                if len(m) > cfg.Dhi:
+                    protected = self._sample(m, cfg.Dscore)  # score off: random
+                    keep = protected | self._sample(m - protected, cfg.D - cfg.Dscore)
+                    out_in_keep = {k for k in keep if topo.outbound[i, k]}
+                    x_need = max(cfg.Dout - len(out_in_keep), 0)
+                    bring = self._sample(
+                        {k for k in m - keep if topo.outbound[i, k]}, x_need
+                    )
+                    droppable = {k for k in keep - protected if not topo.outbound[i, k]}
+                    drop = self._sample(droppable, len(bring))
+                    keep = (keep - drop) | bring
+                    toprune |= {(t, k) for k in m - keep}
+                    m &= keep
+                # outbound quota top-up
+                if len(m) >= cfg.Dlo:
+                    have_out = sum(1 for k in m if topo.outbound[i, k])
+                    need = max(cfg.Dout - have_out, 0)
+                    grafts2 = self._sample(
+                        {k for k in cand - m if topo.outbound[i, k]}, need
+                    )
+                    m |= grafts2
+                    tograft |= {(t, k) for k in grafts2}
+
+            for (t, k) in toprune:
+                be = self.backoff_expire[i]
+                be[(t, k)] = max(be.get((t, k), 0), tick + cfg.prune_backoff_ticks)
+                self.backoff_present[i].add((t, k))
+            self.graft_out[i] = tograft
+            self.prune_out[i] = self.prune_out[i] | toprune
+            self.events[EV.GRAFT] += len(tograft)
+            self.events[EV.PRUNE] += len(toprune)
+
+            # emitGossip: IHAVE of the gossip window to random non-mesh peers
+            gwin = set().union(*self.mcache[i][: cfg.history_gossip])
+            ihave = {}
+            for t, m in self.mesh[i].items():
+                gcand = nbr_sub[t] - m
+                target = max(cfg.Dlazy, int(cfg.gossip_factor * len(gcand)))
+                adv = {slot for slot in gwin if self.msgs[slot].topic == t}
+                if not adv:
+                    continue
+                for k in self._sample(gcand, target):
+                    ihave.setdefault(k, set()).update(adv)
+            self.ihave_out[i] = ihave
+
+            # mcache.Shift
+            self.mcache[i] = [set()] + self.mcache[i][: cfg.history_length - 1]
+
+    # -- metrics ------------------------------------------------------------
+
+    def hops(self):
+        """{(peer, slot): hop} for every first receipt, origin included at 0."""
+        return {
+            (i, slot): r - self.msgs[slot].birth
+            for (i, slot), r in self.first_round.items()
+            if slot in self.msgs
+        }

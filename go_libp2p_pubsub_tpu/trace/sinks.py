@@ -91,7 +91,9 @@ class PBTracer(Tracer):
 
     Uses the native C++ buffered writer (native/pubsub_native.cc) when the
     shared library is built; pure-Python framing otherwise. Both produce
-    byte-identical files (tests/test_native.py interop tests)."""
+    byte-identical files (tests/test_native.py interop tests): the native
+    writer's per-frame size bound is disabled here so no event the Python
+    path would write is ever dropped by the native one."""
 
     def __init__(self, path: str, use_native: bool | None = None, **kw):
         super().__init__(**kw)
@@ -100,7 +102,9 @@ class PBTracer(Tracer):
         if use_native is None:
             use_native = native.available()
         if use_native:
-            self._w = native.NativeTraceWriter(path, append=True)
+            # 2^62: effectively unbounded (0 means "use the C default")
+            self._w = native.NativeTraceWriter(path, append=True,
+                                               max_frame=1 << 62)
             self._f = None
         else:
             self._w = None

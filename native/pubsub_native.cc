@@ -51,17 +51,19 @@ size_t ps_uvarint_encode(uint64_t n, uint8_t *out) {
 long ps_uvarint_decode(const uint8_t *buf, size_t len, uint64_t *value) {
   uint64_t result = 0;
   unsigned shift = 0;
-  for (size_t i = 0; i < len; i++) {
+  for (size_t i = 0; i < len && i < 10; i++) {
     uint8_t b = buf[i];
+    // the 10th byte holds bit 63 only: continuation or payload > 1
+    // overflows uint64 (same rule as Go's binary.Uvarint)
+    if (i == 9 && b > 1) return -1;
     result |= (uint64_t)(b & 0x7f) << shift;
     if (!(b & 0x80)) {
       *value = result;
       return (long)(i + 1);
     }
     shift += 7;
-    if (shift > 63) return -1;
   }
-  return 0;  // truncated
+  return len >= 10 ? -1 : 0;  // overlong : truncated
 }
 
 // ---------------------------------------------------------------------------
@@ -80,7 +82,8 @@ long ps_frame_split(const uint8_t *buf, size_t len, size_t *offsets,
     uint64_t flen;
     long hdr = ps_uvarint_decode(buf + pos, len - pos, &flen);
     if (hdr < 0) return -1;
-    if (hdr == 0 || pos + (size_t)hdr + flen > len) break;  // partial tail
+    // overflow-safe bounds check: remaining = len - pos - hdr
+    if (hdr == 0 || flen > len - pos - (size_t)hdr) break;  // partial tail
     offsets[n] = pos + (size_t)hdr;
     lengths[n] = (size_t)flen;
     pos += (size_t)hdr + (size_t)flen;

@@ -160,3 +160,40 @@ def test_native_writer_append_mode(tmp_path):
         data = f.read()
     payloads, _ = native.frame_split(data)
     assert payloads == [b"one", b"two"]
+
+
+def test_uvarint_64bit_overflow_rejected():
+    # 2^64 (10th byte = 0x02): Python's arbitrary-precision decoder returns
+    # 2^64 but uint64 wraps — the native decoder must reject, not wrap
+    with pytest.raises(ValueError):
+        native.decode_uvarint(b"\x80" * 9 + b"\x02")
+    # bit 63 alone is the largest legal 10-byte varint
+    v, c = native.decode_uvarint(b"\x80" * 9 + b"\x01")
+    assert v == 2**63 and c == 10
+
+
+def test_frame_split_rejects_overflowing_length():
+    # frame length 2^64-1 must read as a partial tail (or error), never as
+    # an accepted frame via size_t wraparound
+    evil = b"\xff" * 9 + b"\x01" + b"payload"
+    out, consumed = native.frame_split(evil)
+    assert out == [] and consumed == 0
+
+
+def test_frame_split_many_tiny_frames():
+    # more frames than one C call's offset-array capacity (len//2+1 when
+    # every frame is a bare empty-payload header byte)
+    stream = native.frame_join(b"") * 300
+    out, consumed = native.frame_split(stream)
+    assert out == [b""] * 300 and consumed == len(stream)
+
+
+def test_writer_use_after_close_raises(tmp_path):
+    w = native.NativeTraceWriter(str(tmp_path / "c.pb"))
+    w.write(b"x")
+    w.close()
+    for op in (lambda: w.write(b"y"), lambda: w.flush(),
+               lambda: w.frames, lambda: w.dropped):
+        with pytest.raises(ValueError):
+            op()
+    w.close()  # idempotent

@@ -1,0 +1,131 @@
+"""Propagation-latency CDF parity: vectorized router vs. scalar oracle.
+
+The north-star parity claim (BASELINE.json) is distributional: RNG
+streams can't match between the batched engine and a per-node
+implementation (survey §7 hard-part (d)), so we assert that the
+propagation-latency CDF of the vectorized GossipSub router stays within
+2% (sup-norm) of the scalar oracle's — the same tolerance the north star
+specifies against the Go reference, with oracle/gossipsub.py standing in
+as the faithful per-node transcription of gossipsub.go.
+
+Both sides run the identical topology, subscriptions, and publish
+schedule; only the random choices (mesh selection, gossip targets)
+differ. The CDF is over (subscribed peer, message) pairs: fraction first
+reached within h rounds of publish.
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import GossipSubParams
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.oracle.gossipsub import OracleGossipSub
+from go_libp2p_pubsub_tpu.state import Net, hops
+from go_libp2p_pubsub_tpu.trace.events import EV
+
+N = 192
+DEG = 8
+MSG_SLOTS = 64
+WARMUP = 20
+PUB_ROUNDS = 18
+PUBS_PER_ROUND = 2
+DRAIN = 12
+MAX_H = 14
+
+
+def publish_schedule(seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, N, size=(PUB_ROUNDS, PUBS_PER_ROUND)).astype(np.int32)
+
+
+def cdf_from_hops(hop_counts, n_msgs, n_subscribed):
+    """hop_counts: list of hop values (one per first receipt). Returns the
+    CDF over all (subscribed peer, msg) pairs at h = 0..MAX_H; pairs never
+    reached contribute to the denominator but no step."""
+    total = n_msgs * n_subscribed
+    hist = np.zeros(MAX_H + 1)
+    for h in hop_counts:
+        hist[min(h, MAX_H)] += 1
+    return np.cumsum(hist) / total
+
+
+def run_vectorized(topo, subs, params, schedule):
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build(params)
+    st = GossipSubState.init(net, MSG_SLOTS, cfg, seed=3)
+    step = make_gossipsub_step(cfg, net)
+    empty = no_publish(PUBS_PER_ROUND)
+    for _ in range(WARMUP):
+        st = step(st, *empty)
+    import jax.numpy as jnp
+
+    pt = jnp.zeros((PUBS_PER_ROUND,), jnp.int32)
+    pv = jnp.ones((PUBS_PER_ROUND,), bool)
+    for r in range(PUB_ROUNDS):
+        st = step(st, jnp.asarray(schedule[r]), pt, pv)
+    for _ in range(DRAIN):
+        st = step(st, *empty)
+    h = np.asarray(hops(st.core.msgs, st.core.dlv))  # [N, M]
+    ev = np.asarray(st.core.events)
+    return [int(x) for x in h[h >= 0]], ev
+
+
+def run_oracle(topo, subs, params, schedule):
+    cfg = GossipSubConfig.build(params)
+    o = OracleGossipSub(topo, subs, cfg, msg_slots=MSG_SLOTS, seed=11)
+    for _ in range(WARMUP):
+        o.step()
+    for r in range(PUB_ROUNDS):
+        o.step([(int(p), 0, True) for p in schedule[r]])
+    for _ in range(DRAIN):
+        o.step()
+    return list(o.hops().values()), o.events
+
+
+@pytest.mark.parametrize("flood_publish", [False, True])
+def test_propagation_cdf_within_2pct(flood_publish):
+    topo = graph.random_connect(N, d=DEG, seed=5)
+    subs = graph.subscribe_all(N, 1)
+    params = GossipSubParams(flood_publish=flood_publish)
+    schedule = publish_schedule()
+    n_msgs = PUB_ROUNDS * PUBS_PER_ROUND
+
+    hv, ev_v = run_vectorized(topo, subs, params, schedule)
+    ho, ev_o = run_oracle(topo, subs, params, schedule)
+
+    cv = cdf_from_hops(hv, n_msgs, N)
+    co = cdf_from_hops(ho, n_msgs, N)
+
+    sup = float(np.max(np.abs(cv - co)))
+    assert sup <= 0.02, f"CDF sup-distance {sup:.4f} > 2%\nvec={cv}\noracle={co}"
+
+    # full coverage on an honest connected network, both sides
+    assert cv[-1] >= 0.999 and co[-1] >= 0.999
+
+    # mean propagation latency within 2% relative
+    mv, mo = np.mean(hv), np.mean(ho)
+    assert abs(mv - mo) / mo <= 0.02, f"mean hops {mv:.3f} vs {mo:.3f}"
+
+
+def test_event_accounting_tracks_oracle():
+    """Aggregate trace counters (deliver / duplicate / RPC volume) are
+    RNG-dependent but must land in the same regime: within 10%."""
+    topo = graph.random_connect(N, d=DEG, seed=5)
+    subs = graph.subscribe_all(N, 1)
+    params = GossipSubParams()
+    schedule = publish_schedule()
+
+    _, ev_v = run_vectorized(topo, subs, params, schedule)
+    _, ev_o = run_oracle(topo, subs, params, schedule)
+
+    for e in (EV.DELIVER_MESSAGE, EV.DUPLICATE_MESSAGE, EV.SEND_RPC):
+        v, o = float(ev_v[e]), float(ev_o[e])
+        assert o > 0
+        assert abs(v - o) / o <= 0.10, f"event {e}: vec {v} oracle {o}"
+    assert int(ev_v[EV.PUBLISH_MESSAGE]) == int(ev_o[EV.PUBLISH_MESSAGE])
