@@ -201,3 +201,23 @@ def ip_groups_with_sybils(n: int, n_sybil_groups: int, sybil_frac: float, seed: 
     if n_sybil and n_sybil_groups:
         groups[n - n_sybil :] = (n - n_sybil) + rng.integers(0, n_sybil_groups, size=n_sybil)
     return groups
+
+
+def dormant_edges(topo: Topology, frac: float, seed: int = 0) -> np.ndarray:
+    """[N, K] bool, symmetric over the edge involution: a random `frac` of
+    each peer's undirected edges marked *dormant* — provisioned slots in
+    the padded adjacency that start disconnected and can be activated at
+    runtime by PX (peer exchange, gossipsub.go:861-941 pxConnect). This is
+    how a static-shape simulation models new connections: the candidate
+    graph is built dense, PX flips candidate edges live."""
+    rng = np.random.default_rng(seed)
+    dormant = np.zeros(topo.nbr.shape, bool)
+    for j in range(topo.n_peers):
+        for k in range(topo.max_degree):
+            i = topo.nbr[j, k]
+            if not topo.nbr_ok[j, k] or i < j:
+                continue  # handle each undirected edge once, from low end
+            if rng.random() < frac:
+                dormant[j, k] = True
+                dormant[i, topo.rev[j, k]] = True
+    return dormant

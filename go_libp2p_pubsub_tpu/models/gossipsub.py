@@ -211,6 +211,14 @@ class GossipSubState:
     # blacklisted peer is disconnected everywhere next round
     up: jax.Array              # [N] bool
     blacklist: jax.Array       # [N] bool
+    # PX connection plane (do_px only): which provisioned edges are live.
+    # Dormant edges (graph.dormant_edges) start False; a PRUNE carrying PX
+    # (makePrune gossipsub.go:1814-1850) lets the pruned peer activate
+    # dormant edges to suggested peers (pxConnect :861-941). Kept symmetric
+    # over the edge involution.
+    edge_live: jax.Array       # [N,K] bool
+    # PX flag riding this round's PRUNEs (parallel outbox to prune_out)
+    prune_px_out: jax.Array    # [N,S,K] bool
 
     @classmethod
     def init(
@@ -221,6 +229,7 @@ class GossipSubState:
         score_params: PeerScoreParams | None = None,
         seed: int = 0,
         app_score: np.ndarray | None = None,
+        dormant: np.ndarray | None = None,
     ) -> "GossipSubState":
         n, k = net.nbr.shape
         s = net.n_slots
@@ -262,6 +271,12 @@ class GossipSubState:
             fanout_lastpub=jnp.zeros((n, cfg.fanout_slots), jnp.int32),
             up=jnp.ones((n,), bool),
             blacklist=jnp.zeros((n,), bool),
+            # copy, never alias: the step donates state buffers, and an
+            # aliased net.nbr_ok would be deleted with them
+            edge_live=net.nbr_ok & ~jnp.asarray(dormant, bool)
+            if dormant is not None
+            else jnp.copy(net.nbr_ok),
+            prune_px_out=jnp.zeros((n, s, k), bool),
         )
 
 
@@ -331,6 +346,14 @@ def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: d
     graft_in = gather_edge_slots(st.graft_out, net) & acc_ok[:, None, :]
     prune_in = gather_edge_slots(st.prune_out, net) & acc_ok[:, None, :]
 
+    # PX ingest (handlePrune gossipsub.go:834-841): a PRUNE carrying PX is
+    # honored only if the pruner's score clears AcceptPXThreshold
+    if cfg.do_px:
+        px_in = gather_edge_slots(st.prune_px_out, net) & prune_in
+        px_ok = jnp.any(px_in, axis=1) & (st.scores >= cfg.accept_px_threshold)  # [N,K]
+    else:
+        px_ok = None
+
     # handlePrune: drop from mesh, obey backoff, sticky P3b
     pruned = prune_in & st.mesh
     score = on_prune(st.score, pruned, tp) if cfg.score_enabled else st.score
@@ -385,9 +408,18 @@ def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: d
         backoff_present=backoff_present,
         score=score,
     )
+    # graft-rejection PRUNEs carry PX for decently-scored peers (handleGraft
+    # calls makePrune with doPX && score-ok, gossipsub.go:796-806); score-
+    # rejections get none
+    if cfg.do_px:
+        # rejected & ~rej_score already implies score >= 0 (rej_score covers
+        # every negative-score rejection)
+        px_resp = rejected & ~rej_score
+    else:
+        px_resp = jnp.zeros_like(rejected)
     n_graft = jnp.sum(accepted.astype(jnp.int32))
     n_prune = jnp.sum(pruned.astype(jnp.int32))
-    return st, rejected, n_graft, n_prune
+    return st, rejected, px_resp, px_ok, n_graft, n_prune
 
 
 def _prefix_cap_bits(words: jax.Array, cap: jax.Array, m: int) -> jax.Array:
@@ -771,6 +803,12 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     pruned_over = mesh & ~keep & over
     mesh = jnp.where(over, mesh & keep, mesh)
     toprune = toprune | pruned_over
+    # over-subscription prunes carry PX; score-prunes (`bad` above) are
+    # noPX (gossipsub.go:1365 vs :1446 — makePrune's doPX argument)
+    if cfg.do_px:
+        px_prune = pruned_over & (scores_b >= 0 if cfg.score_enabled else True)
+    else:
+        px_prune = jnp.zeros_like(pruned_over)
 
     # outbound quota top-up at Dlo <= |mesh| (gossipsub.go:1451-1476)
     deg = count_true(mesh)
@@ -891,6 +929,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         ihave_out=ihave_out,
         graft_out=new_grafts,
         prune_out=st.prune_out | toprune,
+        prune_px_out=st.prune_px_out | px_prune,
         peerhave=peerhave,
         iasked=iasked,
         promise_mid=promise_mid,
@@ -956,6 +995,7 @@ def make_gossipsub_step(
     heartbeat_interval: float = 1.0,
     gater_params=None,
     dynamic_peers: bool = False,
+    adversary_no_forward: np.ndarray | None = None,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
@@ -965,8 +1005,17 @@ def make_gossipsub_step(
     argument (the notify plane, notify.go:19-75): peers transitioning down
     — or blacklisted via ``state.blacklist`` — are disconnected with full
     dead-peer cleanup (handleDeadPeers pubsub.go:648-689 + router
-    RemovePeer gossipsub.go:545-562 + score retention score.go:604-637),
+    RemovePeer gossipsub.go:545-562 + score retention score.go:604-689),
     and every edge touching a down peer carries nothing until it returns.
+
+    ``adversary_no_forward`` is a static [N] bool behavior vector (survey
+    §7 stage 6): marked peers run the full control plane — subscribe,
+    GRAFT/PRUNE, IHAVE gossip — but never transmit message data (mesh
+    push, flood-publish, fanout, IWANT service). This is the vectorized
+    analogue of the reference test suite's ``sybilSquatter`` attacker
+    (gossipsub_test.go:1777-1811): grafted-but-silent peers that starve
+    their mesh neighbors, to be caught by the P3 mesh-delivery deficit and
+    IWANT-promise (P7) machinery.
     """
     if cfg.gater_enabled:
         assert gater_params is not None
@@ -995,6 +1044,13 @@ def make_gossipsub_step(
         subscribed_words_t[jnp.clip(net.nbr, 0)],
         jnp.uint32(0),
     )  # [N,K,Wt]
+    # adversary behavior vector: edge (j,k) carries data only if its sender
+    # nbr[j,k] forwards (static jit constant; None => all-honest fast path)
+    if adversary_no_forward is not None:
+        adv = jnp.asarray(adversary_no_forward, bool)
+        sender_fwd_ok = ~adv[jnp.clip(net.nbr, 0)] & net.nbr_ok  # [N,K]
+    else:
+        sender_fwd_ok = None
 
     def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next) -> GossipSubState:
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
@@ -1050,6 +1106,13 @@ def make_gossipsub_step(
                 up=eff_next,
             )
             live = net.nbr_ok & st.up[:, None] & st.up[senders]
+        else:
+            live = None
+        if cfg.do_px:
+            # PX connection plane: dormant edges carry nothing until
+            # activated (edge_live kept symmetric, so one side suffices)
+            live = (net.nbr_ok if live is None else live) & st.edge_live
+        if live is not None:
             net_l = net.replace(nbr_ok=live)
             nbr_sub_l = nbr_sub_const & live[:, None, :]
             flood_from_l = flood_from & live
@@ -1084,8 +1147,36 @@ def make_gossipsub_step(
             acc_msg = acc_ok
 
         # 1. GRAFT/PRUNE ingest
-        st2, prune_resp, n_graft, n_prune = handle_graft_prune(cfg, net_l, st, tp, acc_ok)
+        st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
+            cfg, net_l, st, tp, acc_ok
+        )
         events = st.core.events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
+
+        # 1b. PX connect (pxConnect gossipsub.go:861-941): a peer pruned
+        # with PX activates its dormant provisioned edges to peers the
+        # pruner suggested — the pruner's current mesh members for the
+        # topic (makePrune/getPeers :1814-1872; here the union over the
+        # pruner's topics, one-round-stale by the outbox model). The id
+        # match runs per prune-edge over the small K axis.
+        if cfg.do_px:
+            sugg_ids = jnp.where(
+                jnp.any(st.mesh, axis=1) & net_l.nbr_ok, net_l.nbr, -1
+            )  # [N,C] each peer's suggestion list
+            sugg_g = sugg_ids[jnp.clip(net.nbr, 0)]  # [N,K,C] per-edge pruner rows
+            dormant_avail = net.nbr_ok & ~st.edge_live & (net.nbr >= 0)
+            if dynamic_peers:
+                dormant_avail = dormant_avail & st.up[:, None] & st.up[jnp.clip(net.nbr, 0)]
+            act = jnp.zeros_like(dormant_avail)
+            for kk in range(net.max_degree):
+                hit = jnp.any(
+                    net.nbr[:, :, None] == sugg_g[:, kk, :][:, None, :], axis=-1
+                )  # [N,K']: my dormant-slot peer is among pruner kk's suggestions
+                act = act | (hit & px_ok[:, kk : kk + 1])
+            act = act & dormant_avail
+            act_sym = (act | edges.edge_permute(act, net.edge_perm)) & net.nbr_ok
+            edge_live_next = st.edge_live | act_sym
+        else:
+            edge_live_next = st.edge_live
 
         # 2. IWANT service (requests sent to me last round -> delivery carry)
         st2, iwant_resp = iwant_responses(cfg, net_l, st2)
@@ -1109,6 +1200,9 @@ def make_gossipsub_step(
         edge_mask = gossip_edge_mask(
             cfg, net_l, st2, joined_words, acc_msg, slotw, tw, flood_edges
         )
+        if sender_fwd_ok is not None:
+            edge_mask = jnp.where(sender_fwd_ok[:, :, None], edge_mask, jnp.uint32(0))
+            iwant_resp = jnp.where(sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0))
         dlv, info = delivery_round(net_l, core.msgs, core.dlv, edge_mask, tick)
         iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
         dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick)
@@ -1163,7 +1257,11 @@ def make_gossipsub_step(
             core.msgs, dlv, tick, pub_origin, pub_topic, pub_valid
         )
         mcache = (mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)) & keep_words[None, None, :]
-        ihave_out = st2.ihave_out & keep_words[None, None, :]
+        # IHAVE outboxes were gathered by the far end this round (step 3);
+        # clear so a batch is received exactly once per heartbeat emission
+        # (the reference sends IHAVE once, at the heartbeat) — emitGossip
+        # below repopulates on heartbeat rounds
+        ihave_out = jnp.zeros_like(st2.ihave_out)
         iwant_out = st2.iwant_out & keep_words[None, None, :]
         served_lo = st2.served_lo & keep_words[None, None, :]
         served_hi = st2.served_hi & keep_words[None, None, :]
@@ -1193,6 +1291,8 @@ def make_gossipsub_step(
             promise_mid=promise_mid,
             graft_out=jnp.zeros_like(st2.graft_out),
             prune_out=prune_resp,
+            prune_px_out=px_resp,
+            edge_live=edge_live_next,
             score=score,
             gater=gater_state,
         )

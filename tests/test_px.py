@@ -1,0 +1,198 @@
+"""PX (peer exchange): PRUNE carries suggested peers; the pruned peer
+activates dormant provisioned edges to them, gated by AcceptPXThreshold
+(makePrune gossipsub.go:1814-1850, handlePrune :834-841, pxConnect
+:861-941). In the vectorized model a "connect" flips a dormant edge of the
+candidate graph live (graph.dormant_edges)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.state import Net
+
+
+def benign_sp():
+    tp = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.01,
+        time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=10.0,
+        first_message_deliveries_weight=1.0,
+        first_message_deliveries_cap=50.0,
+        first_message_deliveries_decay=0.9,
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+        invalid_message_deliveries_weight=-10.0,
+        invalid_message_deliveries_decay=0.9,
+    )
+    return PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-10.0,
+        behaviour_penalty_threshold=0.0,
+        behaviour_penalty_decay=0.9,
+        ip_colocation_factor_weight=0.0,
+    )
+
+
+def build_px(n=24, d=8, seed=0, dormant_frac=0.4, accept_px=0.0, score=True):
+    topo = graph.random_connect(n, d, seed=seed)
+    dormant = graph.dormant_edges(topo, dormant_frac, seed=seed + 1)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    params = dataclasses.replace(GossipSubParams(), do_px=True)
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0,
+        publish_threshold=-4.0,
+        graylist_threshold=-8.0,
+        accept_px_threshold=accept_px,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(params, thr, score_enabled=score)
+    sp = benign_sp() if score else None
+    st = GossipSubState.init(net, 32, cfg, score_params=sp, seed=seed, dormant=dormant)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    return topo, dormant, net, cfg, st, step
+
+
+def edge_to(topo, j, target):
+    for k in range(topo.max_degree):
+        if topo.nbr_ok[j, k] and topo.nbr[j, k] == target:
+            return k
+    return None
+
+
+def run(step, st, k):
+    a = no_publish()
+    for _ in range(k):
+        st = step(st, *a)
+    return st
+
+
+def find_px_triple(topo, dormant, mesh, exclude_live_jl=True):
+    """(pruner i, pruned j, suggested s): j--i live edge; s in i's mesh;
+    j--s edge exists and is dormant."""
+    n = topo.n_peers
+    for i in range(n):
+        for j_k in range(topo.max_degree):
+            if not topo.nbr_ok[i, j_k] or dormant[i, j_k]:
+                continue
+            j = int(topo.nbr[i, j_k])
+            for s_k in range(topo.max_degree):
+                if not (topo.nbr_ok[i, s_k] and mesh[i, 0, s_k]) or dormant[i, s_k]:
+                    continue
+                s = int(topo.nbr[i, s_k])
+                if s == j:
+                    continue
+                k_js = edge_to(topo, j, s)
+                if k_js is not None and dormant[j, k_js]:
+                    return i, j, s, k_js
+    return None
+
+
+def inject_prune_px(st, i, k_ij, px=True):
+    p = np.asarray(st.prune_out).copy()
+    p[i, 0, k_ij] = True
+    ppx = np.asarray(st.prune_px_out).copy()
+    ppx[i, 0, k_ij] = px
+    return st.replace(prune_out=jnp.asarray(p), prune_px_out=jnp.asarray(ppx))
+
+
+def test_dormant_edges_carry_nothing():
+    topo, dormant, net, cfg, st, step = build_px(seed=1)
+    st = run(step, st, 10)
+    # no mesh membership ever forms across a dormant edge
+    mesh = np.asarray(st.mesh[:, 0, :])
+    assert not (mesh & dormant).any()
+
+
+def test_px_activates_dormant_edge():
+    topo, dormant, net, cfg, st, step = build_px(seed=1)
+    st = run(step, st, 8)
+    trip = find_px_triple(topo, dormant, np.asarray(st.mesh))
+    assert trip is not None, "seed should admit a PX triple"
+    i, j, s, k_js = trip
+    k_ij = edge_to(topo, i, j)
+
+    before = np.asarray(st.edge_live)
+    assert not before[j, k_js]
+    st = inject_prune_px(st, i, k_ij)
+    st = step(st, *no_publish())
+
+    after = np.asarray(st.edge_live)
+    assert after[j, k_js], "dormant edge to suggested peer must activate"
+    # symmetric on the far side
+    k_sj = edge_to(topo, s, j)
+    assert after[s, k_sj]
+    # and the new edge becomes mesh-eligible: run on, j may graft s
+    st = run(step, st, 6)
+    assert np.asarray(st.edge_live)[j, k_js]
+
+
+def test_px_rejected_below_threshold():
+    topo, dormant, net, cfg, st, step = build_px(seed=1, accept_px=100.0)
+    st = run(step, st, 8)
+    trip = find_px_triple(topo, dormant, np.asarray(st.mesh))
+    assert trip is not None
+    i, j, s, k_js = trip
+    k_ij = edge_to(topo, i, j)
+    st = inject_prune_px(st, i, k_ij)
+    st = step(st, *no_publish())
+    # pruner's score cannot clear AcceptPXThreshold=100 -> no activation
+    assert not np.asarray(st.edge_live)[j, k_js]
+
+
+def test_prune_without_px_no_activation():
+    topo, dormant, net, cfg, st, step = build_px(seed=1)
+    st = run(step, st, 8)
+    trip = find_px_triple(topo, dormant, np.asarray(st.mesh))
+    assert trip is not None
+    i, j, s, k_js = trip
+    k_ij = edge_to(topo, i, j)
+    st = inject_prune_px(st, i, k_ij, px=False)
+    st = step(st, *no_publish())
+    assert not np.asarray(st.edge_live)[j, k_js]
+
+
+def test_heartbeat_oversub_prune_carries_px():
+    """Over-subscribed meshes prune with PX attached; score-prunes are
+    noPX (gossipsub.go:1365 vs :1446)."""
+    # tiny Dhi so over-subscription prunes happen during warmup
+    topo = graph.random_connect(24, 10, seed=3)
+    dormant = graph.dormant_edges(topo, 0.3, seed=4)
+    subs = graph.subscribe_all(24, 1)
+    net = Net.build(topo, subs)
+    params = dataclasses.replace(GossipSubParams(), do_px=True, D=3, Dlo=2, Dhi=4,
+                                 Dscore=2, Dout=1, Dlazy=3)
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0, graylist_threshold=-8.0,
+        accept_px_threshold=0.0, opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(params, thr, score_enabled=True)
+    st = GossipSubState.init(net, 32, cfg, score_params=benign_sp(), seed=0,
+                             dormant=dormant)
+    step = make_gossipsub_step(cfg, net, score_params=benign_sp())
+    saw_px = False
+    for _ in range(12):
+        st = step(st, *no_publish())
+        if np.asarray(st.prune_px_out).any():
+            saw_px = True
+    assert saw_px, "over-subscription prunes should carry PX"
+    # network stays healthy: all meshes bounded, some dormant edges may
+    # have come alive but none beyond the provisioned candidate set
+    live = np.asarray(st.edge_live)
+    assert not (live & ~np.asarray(net.nbr_ok)).any()
