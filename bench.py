@@ -120,7 +120,13 @@ def main():
     for _ in range(3):
         t0 = time.perf_counter()
         st = run_seg_j(st)
-        jax.block_until_ready(st)
+        # force a device->host readback inside the timed region:
+        # jax.block_until_ready on the axon remote platform has been
+        # observed to return before execution completes (async handles
+        # report ready), inflating rates ~1000x. Fetching a scalar that
+        # depends on the full step (the tick counter + a score checksum)
+        # is the honest completion barrier.
+        _ = (int(st.core.tick), float(jnp.sum(st.scores)))
         dt = time.perf_counter() - t0
         rates.append(seg / dt)
     value = max(rates)

@@ -96,15 +96,8 @@ def delivery_round(
     new_words = recv_words & ~dlv.have
     new_bits = bitset.unpack(new_words, m)
 
-    # first-arrival edge: lowest edge slot carrying each new bit, as a
-    # K-step word scan (no [N,K,M] transpose/argmax)
-    def fe_body(k, carry):
-        bits = bitset.unpack(trans[:, k, :], m)
-        return jnp.where(bits & (carry < 0), k.astype(jnp.int8), carry)
-
-    arrival_edge = jax.lax.fori_loop(
-        0, k_slots, fe_body, jnp.full((n, m), -1, jnp.int8)
-    )
+    # first-arrival edge: lowest edge slot carrying each new bit
+    arrival_edge = bitset.first_edge_of(trans, m)
     first_edge = jnp.where(new_bits & (arrival_edge >= 0), arrival_edge, dlv.first_edge)
     first_round = jnp.where(new_bits, tick, dlv.first_round)
 

@@ -280,37 +280,6 @@ class GossipSubState:
         )
 
 
-# ---------------------------------------------------------------------------
-# edge-view gathers (receivers read sender outboxes through rev[])
-
-
-def gather_edge_slots(x: jax.Array, net: Net) -> jax.Array:
-    """x[N, S, K] (sender, sender-slot, sender-edge) -> [N, S', K] receiver
-    view: out[j, s', k] = x[nbr[j,k], slot_of[nbr[j,k], my_topics[j,s']],
-    rev[j,k]].
-
-    Topic-bit packing + the flat edge-permutation row gather (ops/edges.py)
-    — topic ids cross the wire as word bits, like the reference's per-topic
-    control messages; no multi-index gathers."""
-    words = edges.topic_pack(x, net.my_topics, net.n_topics)   # [N,K,Wt]
-    words_in = edges.edge_permute(words, net.edge_perm)
-    out = edges.topic_unpack(words_in, net.my_topics)          # [N,S,K]
-    return out & net.nbr_ok[:, None, :]
-
-
-def gather_edge_words(x: jax.Array, net: Net) -> jax.Array:
-    """x[N, K, W] outbox -> inbox: in[j,k] = x[nbr[j,k], rev[j,k]]."""
-    return jnp.where(
-        net.nbr_ok[:, :, None], edges.edge_permute(x, net.edge_perm), jnp.uint32(0)
-    )
-
-
-def gather_peer_scores(scores: jax.Array, net: Net) -> jax.Array:
-    """[N,K]: the score neighbor k holds of ME (sender-side publish gates
-    seen from the receiving end)."""
-    return jnp.where(net.nbr_ok, edges.edge_permute(scores, net.edge_perm), 0.0)
-
-
 def topic_msg_words(msg_topic: jax.Array, n_topics: int) -> jax.Array:
     """[T, W] packed per-topic message masks."""
     onehot = msg_topic[None, :] == jnp.arange(n_topics, dtype=jnp.int32)[:, None]
@@ -337,19 +306,21 @@ def joined_msg_words(net: Net, msgs) -> jax.Array:
 
 
 def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
-                       acc_ok: jax.Array):
+                       acc_ok: jax.Array, graft_in_raw: jax.Array,
+                       prune_in_raw: jax.Array, px_in_raw):
     """Process GRAFT/PRUNE received this round (handleGraft
     gossipsub.go:718-809, handlePrune :811-843). Returns updated state plus
-    next round's PRUNE responses."""
+    next round's PRUNE responses. `*_raw` are the pre-gathered edge views
+    from the step's merged wire exchange (already nbr_ok-masked)."""
     tick = st.core.tick
 
-    graft_in = gather_edge_slots(st.graft_out, net) & acc_ok[:, None, :]
-    prune_in = gather_edge_slots(st.prune_out, net) & acc_ok[:, None, :]
+    graft_in = graft_in_raw & acc_ok[:, None, :]
+    prune_in = prune_in_raw & acc_ok[:, None, :]
 
     # PX ingest (handlePrune gossipsub.go:834-841): a PRUNE carrying PX is
     # honored only if the pruner's score clears AcceptPXThreshold
     if cfg.do_px:
-        px_in = gather_edge_slots(st.prune_px_out, net) & prune_in
+        px_in = px_in_raw & prune_in
         px_ok = jnp.any(px_in, axis=1) & (st.scores >= cfg.accept_px_threshold)  # [N,K]
     else:
         px_ok = None
@@ -431,13 +402,14 @@ def _prefix_cap_bits(words: jax.Array, cap: jax.Array, m: int) -> jax.Array:
 
 
 def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
-                 joined_words: jax.Array, acc_ok: jax.Array) -> GossipSubState:
+                 joined_words: jax.Array, acc_ok: jax.Array,
+                 ihave_in_raw: jax.Array) -> GossipSubState:
     """IHAVE received this round -> IWANT requests + a promise
-    (handleIHave gossipsub.go:615-677)."""
+    (handleIHave gossipsub.go:615-677). `ihave_in_raw` is the pre-gathered
+    edge view from the step's merged wire exchange."""
     m = st.core.msgs.capacity
     tick = st.core.tick
-    ihave_in = gather_edge_words(st.ihave_out, net)
-    ihave_in = jnp.where(acc_ok[:, :, None], ihave_in, jnp.uint32(0))
+    ihave_in = jnp.where(acc_ok[:, :, None], ihave_in_raw, jnp.uint32(0))
 
     got = bitset.popcount(ihave_in, axis=-1) > 0  # [N,K] one batch per round
     peerhave = st.peerhave + got.astype(jnp.int32)
@@ -490,11 +462,14 @@ def _served_capped(cfg: GossipSubConfig, lo: jax.Array, hi: jax.Array) -> jax.Ar
     return jnp.full_like(lo, 0xFFFFFFFF)
 
 
-def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState):
+def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState,
+                    nbr_score_of_me):
     """The IWANT-response carry for this round's delivery + retransmission
     counter update (handleIWant gossipsub.go:679-716). `st.iwant_out` holds
     what I asked each neighbor last round; the neighbor serves from its full
-    mcache history window subject to the per-(edge,msg) cap."""
+    mcache history window subject to the per-(edge,msg) cap.
+    `nbr_score_of_me` [N,K] comes from the step's merged wire exchange
+    (None only when scoring is disabled)."""
     asked = st.iwant_out
     sender_window = bitset.word_or_reduce(st.mcache, axis=1)       # [N,W]
     window_g = jnp.where(
@@ -508,7 +483,6 @@ def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState):
     if cfg.score_enabled:
         # responder ignores requesters below the gossip threshold
         # (gossipsub.go:681-685): the score the neighbor holds of me
-        nbr_score_of_me = gather_peer_scores(st.scores, net)
         resp = jnp.where(
             (nbr_score_of_me >= cfg.gossip_threshold)[:, :, None], resp, jnp.uint32(0)
         )
@@ -548,7 +522,7 @@ def fanout_carry_words(fanout_peers: jax.Array, fanout_topic: jax.Array,
 def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
                      joined_words: jax.Array, acc_ok: jax.Array,
                      slotw: jax.Array, tw: jax.Array,
-                     flood_edges: jax.Array) -> jax.Array:
+                     flood_edges: jax.Array, nbr_score_of_me) -> jax.Array:
     """[N,K,W] edge-carry mask: mesh push (forwarding along the sender's
     mesh, gossipsub.go:981-1002) + fanout push + floodsub-peer edges
     (protocol negotiation, gossipsub.go:973-978) + v1.1 flood-publish for
@@ -574,7 +548,7 @@ def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
         # elementwise compare fused into the pack
         origin_is_sender = st.core.msgs.origin[None, :] == net.nbr[..., None]  # [N,K,M]
         if cfg.score_enabled:
-            flood_ok = gather_peer_scores(st.scores, net) >= cfg.publish_threshold
+            flood_ok = nbr_score_of_me >= cfg.publish_threshold
         else:
             flood_ok = net.nbr_ok
         mask = mask | (
@@ -675,13 +649,7 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
     new_words = recv & ~dlv.have
     new_bits = bitset.unpack(new_words, m)
 
-    def fe_body(k, carry):
-        bits = bitset.unpack(extra[:, k, :], m)
-        return jnp.where(bits & (carry < 0), k.astype(jnp.int8), carry)
-
-    arrival_edge = jax.lax.fori_loop(
-        0, extra.shape[1], fe_body, jnp.full(new_bits.shape, -1, jnp.int8)
-    )
+    arrival_edge = bitset.first_edge_of(extra, m)
     valid_words = bitset.pack(core.msgs.valid)
 
     dlv = dlv.replace(
@@ -1146,9 +1114,50 @@ def make_gossipsub_step(
         else:
             acc_msg = acc_ok
 
+        # 0b. merged wire exchange: every per-edge outbox crosses the edge
+        # involution in ONE gather. Separate gathers each pay a fixed
+        # dispatch cost on TPU, so the control plane ships as a single
+        # concatenated word tensor (graft | prune | ihave [| px] [| score])
+        # and is split receiver-side — the vectorized analogue of the
+        # reference piggybacking all control into one RPC (gossipsub.go:
+        # 1096-1141 sendRPC + piggyback).
+        parts = [
+            edges.topic_pack(st.graft_out, net.my_topics, net.n_topics),
+            edges.topic_pack(st.prune_out, net.my_topics, net.n_topics),
+            st.ihave_out,
+        ]
+        if cfg.do_px:
+            parts.append(
+                edges.topic_pack(st.prune_px_out, net.my_topics, net.n_topics)
+            )
+        if cfg.score_enabled:
+            parts.append(
+                jax.lax.bitcast_convert_type(st.scores, jnp.uint32)[..., None]
+            )
+        sizes = np.cumsum([0] + [p.shape[-1] for p in parts])
+        wire = edges.edge_permute(jnp.concatenate(parts, axis=-1), net.edge_perm)
+        wire = jnp.where(net_l.nbr_ok[:, :, None], wire, jnp.uint32(0))
+        w_seg = lambda i: wire[..., sizes[i] : sizes[i + 1]]
+        ok_slots = net_l.nbr_ok[:, None, :]
+        graft_in_raw = edges.topic_unpack(w_seg(0), net.my_topics) & ok_slots
+        prune_in_raw = edges.topic_unpack(w_seg(1), net.my_topics) & ok_slots
+        ihave_in_raw = w_seg(2)
+        px_in_raw = (
+            edges.topic_unpack(w_seg(3), net.my_topics) & ok_slots
+            if cfg.do_px else None
+        )
+        if cfg.score_enabled:
+            nbr_score_of_me = jnp.where(
+                net_l.nbr_ok,
+                jax.lax.bitcast_convert_type(w_seg(len(parts) - 1)[..., 0], jnp.float32),
+                0.0,
+            )
+        else:
+            nbr_score_of_me = None
+
         # 1. GRAFT/PRUNE ingest
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
-            cfg, net_l, st, tp, acc_ok
+            cfg, net_l, st, tp, acc_ok, graft_in_raw, prune_in_raw, px_in_raw
         )
         events = st.core.events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
 
@@ -1179,11 +1188,11 @@ def make_gossipsub_step(
             edge_live_next = st.edge_live
 
         # 2. IWANT service (requests sent to me last round -> delivery carry)
-        st2, iwant_resp = iwant_responses(cfg, net_l, st2)
+        st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me)
 
         # 3. IHAVE ingest (advertisements -> next round's requests)
         joined_words = joined_msg_words(net_l, core.msgs)
-        st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok)
+        st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok, ihave_in_raw)
 
         # 4. delivery: mesh/fanout push + flood edges + IWANT responses
         slotw = slot_topic_words(net_l, core.msgs.topic)
@@ -1193,12 +1202,13 @@ def make_gossipsub_step(
         # => gossipsub sender still sends everything (score-gated,
         # gossipsub.go:973-978)
         if cfg.score_enabled:
-            recv_ok = gather_peer_scores(st2.scores, net_l) >= cfg.publish_threshold
+            recv_ok = nbr_score_of_me >= cfg.publish_threshold
         else:
             recv_ok = net_l.nbr_ok
         flood_edges = flood_from_l | (i_am_floodsub[:, None] & recv_ok & net_l.nbr_ok)
         edge_mask = gossip_edge_mask(
-            cfg, net_l, st2, joined_words, acc_msg, slotw, tw, flood_edges
+            cfg, net_l, st2, joined_words, acc_msg, slotw, tw, flood_edges,
+            nbr_score_of_me,
         )
         if sender_fwd_ok is not None:
             edge_mask = jnp.where(sender_fwd_ok[:, :, None], edge_mask, jnp.uint32(0))

@@ -105,3 +105,21 @@ def make_mask_below(n_bits_valid: jax.Array, total_bits: int) -> jax.Array:
     bits = (bit_idx < n_bits_valid).astype(jnp.uint32)
     shifts = jnp.arange(WORD, dtype=jnp.uint32)
     return jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def first_edge_of(trans: jax.Array, n_bits: int) -> jax.Array:
+    """trans uint32[N, K, W] -> int8[N, n_bits]: lowest edge slot k whose
+    packed row carries each bit, -1 where no edge carries it.
+
+    One unpack + one min-reduce instead of a K-step fori loop — sequential
+    loop trips each pay a dispatch on TPU, so the [N,K,M] intermediate
+    (int8, fused away by XLA) is the cheaper shape."""
+    k_dim = trans.shape[-2]
+    assert k_dim <= 128, "edge slot index must fit int8"
+    bits = unpack(trans, n_bits)  # [N,K,M] bool
+    ks = jnp.arange(k_dim, dtype=jnp.int8)[None, :, None]
+    cand = jnp.where(bits, ks, jnp.int8(127))
+    first = jnp.min(cand, axis=-2)
+    # a separate any-reduce (not a sentinel compare) so slot 127 at K=128
+    # is still reported
+    return jnp.where(jnp.any(bits, axis=-2), first, jnp.int8(-1))

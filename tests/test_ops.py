@@ -119,3 +119,28 @@ def test_median_masked_upper_median():
 
 def test_pytest_env_has_8_devices():
     assert len(jax.devices()) == 8
+
+
+def test_first_edge_of_matches_scan_incl_k128():
+    # slot 127 at K=128 must be reported, not confused with the sentinel
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.ops import bitset
+
+    rng = np.random.default_rng(7)
+    for n, k, m in [(4, 16, 40), (3, 128, 33)]:
+        w = (m + 31) // 32
+        trans = rng.integers(0, 2**32, size=(n, k, w), dtype=np.uint64).astype(np.uint32)
+        # zero out invalid high bits
+        trans = np.asarray(bitset.pack(bitset.unpack(jnp.asarray(trans), m)))
+        got = np.asarray(bitset.first_edge_of(jnp.asarray(trans), m))
+        bits = np.asarray(bitset.unpack(jnp.asarray(trans), m))  # [n,k,m]
+        want = np.full((n, m), -1, np.int8)
+        for kk in range(k - 1, -1, -1):
+            want = np.where(bits[:, kk, :], kk, want)
+        assert (got == want).all()
+    # slot-127-only case
+    trans = np.zeros((1, 128, 1), np.uint32)
+    trans[0, 127, 0] = 0b1000
+    got = np.asarray(bitset.first_edge_of(jnp.asarray(trans), 4))
+    assert got[0, 3] == 127 and (got[0, :3] == -1).all()
