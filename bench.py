@@ -80,7 +80,7 @@ def main():
 
     n_peers = int(os.environ.get("BENCH_N", 100_000))
     msg_slots = int(os.environ.get("BENCH_M", 64))
-    seg = int(os.environ.get("BENCH_ROUNDS", 50))
+    seg = int(os.environ.get("BENCH_ROUNDS", 200))
     pubs_per_round = 4
 
     sizes = [n_peers, n_peers // 2, n_peers // 4, 25_000, 10_000]

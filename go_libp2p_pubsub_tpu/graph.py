@@ -131,9 +131,40 @@ def random_connect(n: int, d: int, seed: int = 0, max_degree: int | None = None)
 
 def ring_lattice(n: int, d: int, max_degree: int | None = None) -> Topology:
     """Deterministic ring lattice (each node dials its next d ring
-    neighbors); used for reproducible small tests."""
-    dialed = [set(((i + 1 + o) % n) for o in range(d)) for i in range(n)]
-    return _from_edge_lists(n, dialed, max_degree)
+    neighbors); used for reproducible small tests and the scale bench.
+
+    Built in *offset-canonical* slot order — slot k holds ring offset
+    +1..+d then -1..-d for every node — so the topology is detectable as
+    banded-regular (ops/edges.detect_banded): every cross-peer exchange
+    then compiles to static rolls instead of gathers, which profiled ~9x
+    faster on TPU. Requires 2d < n (otherwise offsets collide and we fall
+    back to the generic builder)."""
+    if n <= 2 * d:
+        dialed = [set(((i + 1 + o) % n) for o in range(d)) for i in range(n)]
+        return _from_edge_lists(n, dialed, max_degree)
+    k = 2 * d
+    if max_degree is not None:
+        if max_degree < k:
+            raise ValueError(f"max degree {k} exceeds K={max_degree}")
+        # padding slots beyond 2d breaks detect_banded (absent edges), so
+        # the extra capacity costs the roll fast path — callers wanting
+        # banded speed should leave max_degree unset
+        k = max_degree
+    offs = np.array([i + 1 for i in range(d)] + [-(i + 1) for i in range(d)],
+                    np.int64)
+    nbr = np.full((n, k), -1, np.int32)
+    rev = np.zeros((n, k), np.int32)
+    outb = np.zeros((n, k), bool)
+    nbr[:, : 2 * d] = (np.arange(n)[:, None] + offs[None, :]) % n
+    # the reverse of offset +i (slot i-1) is offset -i (slot d+i-1)
+    rev[:, : 2 * d] = np.array(
+        [kk + d for kk in range(d)] + [kk for kk in range(d)], np.int32
+    )[None, :]
+    outb[:, :d] = True  # the d dialed (+offset) edges
+    return Topology(
+        nbr=nbr, nbr_ok=nbr >= 0, rev=rev, outbound=outb,
+        degree=np.full((n,), 2 * d, np.int32),
+    )
 
 
 # ---------------------------------------------------------------------------

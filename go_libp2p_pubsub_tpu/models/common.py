@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..ops import bitset, edges
+from ..ops import bitset
 from ..state import Delivery, MsgTable, Net
 from ..trace.events import EV
 
@@ -76,16 +76,14 @@ def delivery_round(
     n, k_slots = net.nbr.shape
     m = msgs.capacity
 
-    senders = jnp.clip(net.nbr, 0)  # [N,K]; masked below where ~nbr_ok
-
     # what each sender is forwarding this round: [N, K, W] word gather
-    fwd_gathered = dlv.fwd[senders]
+    fwd_gathered = net.peer_gather(dlv.fwd)
 
     # echo exclusion: sender s does not send m back on the edge it arrived
     # on. Sender-side packed compare (fused, no [N,K,M] gather), then a
     # word gather: echo[j,k] = "messages s first-received on its edge to j"
     echo_out = bitset.edge_eq_words(dlv.first_edge, k_slots)   # [N,K,W] at sender
-    echo_words = edges.edge_permute(echo_out, net.edge_perm)   # flat row gather
+    echo_words = net.edge_gather(echo_out)
 
     ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     not_mine = ~origin_msg_words(net, msgs)  # [N, W]

@@ -474,7 +474,7 @@ def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     sender_window = bitset.word_or_reduce(st.mcache, axis=1)       # [N,W]
     window_g = jnp.where(
         net.nbr_ok[:, :, None],
-        sender_window[jnp.clip(net.nbr, 0)],                        # [N,K,W]
+        net.peer_gather(sender_window),                             # [N,K,W]
         jnp.uint32(0),
     )
     capped = _served_capped(cfg, st.served_lo, st.served_hi)
@@ -535,7 +535,7 @@ def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     )
     mask = jnp.where(
         net.nbr_ok[:, :, None],
-        edges.edge_permute(carry_out, net.edge_perm),
+        net.edge_gather(carry_out),
         jnp.uint32(0),
     )
 
@@ -831,7 +831,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
             ),
             jnp.broadcast_to(jnp.clip(ft, 0)[:, :, None], fpeers.shape),
         )
-        mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
+        mesh_capable = (net.peer_gather(net.protocol) >= 1) & net.nbr_ok
         base_f = (
             nbr_sub_f
             & mesh_capable[:, None, :]
@@ -1023,11 +1023,10 @@ def make_gossipsub_step(
     def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next) -> GossipSubState:
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
         if dynamic_peers:
-            senders = jnp.clip(net.nbr, 0)
             eff_next = up_next & ~st.blacklist
             down_tr = st.up & ~eff_next
             up_tr = ~st.up & eff_next
-            down_nbr = down_tr[senders] & net.nbr_ok
+            down_nbr = net.peer_gather(down_tr) & net.nbr_ok
             # every edge touching a down peer dies (both directions; a
             # restarting node comes back with fresh soft state)
             down_edge = (down_nbr | down_tr[:, None]) & net.nbr_ok
@@ -1073,7 +1072,7 @@ def make_gossipsub_step(
                 score=score0,
                 up=eff_next,
             )
-            live = net.nbr_ok & st.up[:, None] & st.up[senders]
+            live = net.nbr_ok & st.up[:, None] & net.peer_gather(st.up)
         else:
             live = None
         if cfg.do_px:
@@ -1135,7 +1134,7 @@ def make_gossipsub_step(
                 jax.lax.bitcast_convert_type(st.scores, jnp.uint32)[..., None]
             )
         sizes = np.cumsum([0] + [p.shape[-1] for p in parts])
-        wire = edges.edge_permute(jnp.concatenate(parts, axis=-1), net.edge_perm)
+        wire = net_l.edge_gather(jnp.concatenate(parts, axis=-1))
         wire = jnp.where(net_l.nbr_ok[:, :, None], wire, jnp.uint32(0))
         w_seg = lambda i: wire[..., sizes[i] : sizes[i + 1]]
         ok_slots = net_l.nbr_ok[:, None, :]
@@ -1171,10 +1170,10 @@ def make_gossipsub_step(
             sugg_ids = jnp.where(
                 jnp.any(st.mesh, axis=1) & net_l.nbr_ok, net_l.nbr, -1
             )  # [N,C] each peer's suggestion list
-            sugg_g = sugg_ids[jnp.clip(net.nbr, 0)]  # [N,K,C] per-edge pruner rows
+            sugg_g = net.peer_gather(sugg_ids)  # [N,K,C] per-edge pruner rows
             dormant_avail = net.nbr_ok & ~st.edge_live & (net.nbr >= 0)
             if dynamic_peers:
-                dormant_avail = dormant_avail & st.up[:, None] & st.up[jnp.clip(net.nbr, 0)]
+                dormant_avail = dormant_avail & st.up[:, None] & net.peer_gather(st.up)
             act = jnp.zeros_like(dormant_avail)
             for kk in range(net.max_degree):
                 hit = jnp.any(
@@ -1182,7 +1181,7 @@ def make_gossipsub_step(
                 )  # [N,K']: my dormant-slot peer is among pruner kk's suggestions
                 act = act | (hit & px_ok[:, kk : kk + 1])
             act = act & dormant_avail
-            act_sym = (act | edges.edge_permute(act, net.edge_perm)) & net.nbr_ok
+            act_sym = (act | net.edge_gather(act)) & net.nbr_ok
             edge_live_next = st.edge_live | act_sym
         else:
             edge_live_next = st.edge_live

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import bitset, edges
+from ..ops import bitset
 from ..ops.select import select_random_mask
 from ..score.engine import slot_topic_words
 from ..state import Net, SimState, allocate_publishes
@@ -58,7 +58,7 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D):
         carry_out = sender_carry_words(sel, slotw)             # [N,K,W]
         carried = jnp.where(
             net.nbr_ok[:, :, None],
-            edges.edge_permute(carry_out, net.edge_perm),
+            net.edge_gather(carry_out),
             jnp.uint32(0),
         )
         edge_mask = carried & joined_msg_words(net, st.msgs)[:, None, :]

@@ -43,18 +43,28 @@ def unpack(words: jax.Array, n_bits: int) -> jax.Array:
     return bits[..., :n_bits].astype(bool)
 
 
+def take_word(words: jax.Array, w: jax.Array) -> jax.Array:
+    """words[..., W], w int[...] -> words[..., w] — a one-hot sum rather
+    than take_along_axis: gathers over the tiny static word axis lower to
+    scalar-memory custom calls on TPU (profiled at ~45 ms per executed op
+    at N=100k), while the one-hot compare+select fuses to vector work."""
+    w_dim = words.shape[-1]
+    onehot = jnp.arange(w_dim, dtype=jnp.int32) == w[..., None]
+    return jnp.sum(jnp.where(onehot, words, 0), axis=-1, dtype=words.dtype)
+
+
 def bit_get(words: jax.Array, idx: jax.Array) -> jax.Array:
     """Gather single bits: words uint32[..., W], idx int[...] -> bool[...]."""
     w = idx // WORD
     s = (idx % WORD).astype(jnp.uint32)
-    return ((jnp.take_along_axis(words, w[..., None], axis=-1)[..., 0] >> s) & 1).astype(bool)
+    return ((take_word(words, w) >> s) & 1).astype(bool)
 
 
 def bit_set(words: jax.Array, idx: jax.Array, on: jax.Array) -> jax.Array:
     """Set bit `idx` to (old | on) along the last word axis (one idx per row)."""
     w = idx // WORD
     s = (idx % WORD).astype(jnp.uint32)
-    cur = jnp.take_along_axis(words, w[..., None], axis=-1)[..., 0]
+    cur = take_word(words, w)
     new = jnp.where(on, cur | (jnp.uint32(1) << s), cur)
     return jnp.where(
         jnp.arange(words.shape[-1]) == w[..., None], new[..., None], words
@@ -81,7 +91,7 @@ def lowest_bit(words: jax.Array) -> tuple[jax.Array, jax.Array]:
     nonzero = words != 0
     any_set = jnp.any(nonzero, axis=-1)
     first_w = jnp.argmax(nonzero, axis=-1)  # first nonzero word
-    word = jnp.take_along_axis(words, first_w[..., None], axis=-1)[..., 0]
+    word = take_word(words, first_w)
     # lowest set bit position within the word: popcount((w-1) & ~w)
     lsb = jax.lax.population_count((word - 1) & ~word)
     idx = first_w.astype(jnp.int32) * WORD + lsb.astype(jnp.int32)

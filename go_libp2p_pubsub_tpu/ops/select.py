@@ -20,6 +20,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from . import bitset
+
 
 def rank_desc(values: jax.Array, mask: jax.Array, key: jax.Array | None = None) -> jax.Array:
     """Dense descending rank along the last axis.
@@ -86,5 +88,5 @@ def median_masked(values: jax.Array, mask: jax.Array) -> jax.Array:
     v_sorted = jnp.sort(v, axis=-1)
     n = count_true(mask)
     idx = jnp.clip(n // 2, 0, values.shape[-1] - 1)
-    med = jnp.take_along_axis(v_sorted, idx[..., None], axis=-1)[..., 0]
+    med = bitset.take_word(v_sorted, idx)
     return jnp.where(n > 0, med, big)

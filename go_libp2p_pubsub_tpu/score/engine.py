@@ -141,7 +141,7 @@ def ip_colocation_surplus_sq(net: Net, threshold: int, whitelist=()) -> jax.Arra
     """[N, K] f32: (peersInIP - threshold)^2 where the count of my connected
     neighbors sharing neighbor k's ip-group exceeds the threshold
     (score.go:337-381). Static for a static topology — precompute once."""
-    groups = net.ip_group[jnp.clip(net.nbr, 0)]  # [N,K]
+    groups = net.peer_gather(net.ip_group)  # [N,K]
     same = (groups[:, :, None] == groups[:, None, :]) & net.nbr_ok[:, None, :]
     count = jnp.sum(same.astype(jnp.int32), axis=-1)  # [N,K]
     surplus = (count - threshold).astype(jnp.float32)
@@ -191,7 +191,7 @@ def compute_scores(
         score = jnp.minimum(score, params.topic_score_cap)
 
     # P5 (score.go:320-321)
-    score = score + app_score[jnp.clip(net.nbr, 0)] * params.app_specific_weight
+    score = score + net.peer_gather(app_score) * params.app_specific_weight
 
     # P6 (score.go:324-325)
     score = score + p6 * params.ip_colocation_factor_weight

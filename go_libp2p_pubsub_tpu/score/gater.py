@@ -46,7 +46,7 @@ def same_source_matrix(net: Net) -> jax.Array:
     """[N,K,K] f32: neighbors k and k' share a source ip-group (static
     topology => precompute once). Used to share outcome stats per source IP
     (peer_gater.go:261-278)."""
-    groups = net.ip_group[jnp.clip(net.nbr, 0)]  # [N,K]
+    groups = net.peer_gather(net.ip_group)  # [N,K]
     same = (groups[:, :, None] == groups[:, None, :]) & net.nbr_ok[:, None, :] & net.nbr_ok[:, :, None]
     return same.astype(jnp.float32)
 
@@ -88,7 +88,7 @@ def gater_accept(
     # per-source shared outcome totals (stats keyed by source ip-group,
     # peer_gater.go:261-278); the [N,K,K] compare is built in-place and
     # fused into the contraction
-    groups = net.ip_group[jnp.clip(net.nbr, 0)]  # [N,K]
+    groups = net.peer_gather(net.ip_group)  # [N,K]
     same = (
         (groups[:, :, None] == groups[:, None, :])
         & net.nbr_ok[:, None, :]

@@ -46,6 +46,24 @@ class Net:
     protocol: jax.Array    # [N] i8 — negotiated protocol per peer
                            # (gossipsub_feat.go:11-36): 0 = /floodsub/1.0.0,
                            # 1 = /meshsub/1.0.0, 2 = /meshsub/1.1.0
+    # banded-regular structure (ops/edges.detect_banded): static aux data;
+    # when set, cross-peer gathers compile to rolls (~9x faster on TPU)
+    band_off: tuple = struct.field(pytree_node=False, default=None)
+    band_rev: tuple = struct.field(pytree_node=False, default=None)
+
+    def edge_gather(self, x: jax.Array) -> jax.Array:
+        """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] (the edge involution).
+        Callers mask with nbr_ok; entries on dead/absent edges are junk."""
+        if self.band_off is not None:
+            return edges.edge_permute_banded(x, self.band_off, self.band_rev)
+        return edges.edge_permute(x, self.edge_perm)
+
+    def peer_gather(self, v: jax.Array) -> jax.Array:
+        """v[N, ...] -> [N, K, ...] neighbor view v[nbr[j,k]]. Same masking
+        contract as edge_gather."""
+        if self.band_off is not None:
+            return edges.peer_gather_banded(v, self.band_off)
+        return v[jnp.clip(self.nbr, 0)]
 
     @classmethod
     def build(
@@ -63,7 +81,10 @@ class Net:
             direct = np.zeros(topo.nbr.shape, bool)
         if protocol is None:
             protocol = np.full((n,), 2, np.int8)  # all /meshsub/1.1.0
+        band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
         return cls(
+            band_off=band[0] if band else None,
+            band_rev=band[1] if band else None,
             nbr=jnp.asarray(topo.nbr),
             nbr_ok=jnp.asarray(topo.nbr_ok),
             rev=jnp.asarray(topo.rev),

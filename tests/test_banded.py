@@ -1,0 +1,100 @@
+"""Banded-regular topology fast path (ops/edges.detect_banded): rolls must
+be bit-identical to the generic edge-permutation gathers — the bench's
+ring-lattice runs take only this path, so parity here is what makes its
+numbers trustworthy."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.ops import edges
+from go_libp2p_pubsub_tpu.state import Net
+
+
+def test_ring_lattice_detects_banded():
+    topo = graph.ring_lattice(64, d=3)
+    band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
+    assert band is not None
+    off, rev = band
+    assert sorted(off) == sorted((o % 64) for o in [1, 2, 3, -1, -2, -3])
+    # rev is an involution on slots: rev[rev[k]] == k
+    assert all(rev[rev[k]] == k for k in range(6))
+
+
+def test_random_connect_not_banded():
+    topo = graph.random_connect(64, d=3, seed=0)
+    assert edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok) is None
+
+
+def test_banded_kernels_match_gather():
+    rng = np.random.default_rng(3)
+    topo = graph.ring_lattice(50, d=4)
+    band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
+    assert band is not None
+    off, rev = band
+    perm = jnp.asarray(edges.build_edge_perm(topo.nbr, topo.rev, topo.nbr_ok))
+
+    x = jnp.asarray(rng.integers(0, 2**31, size=(50, 8, 3), dtype=np.int64).astype(np.uint32))
+    a = np.asarray(edges.edge_permute(x, perm))
+    b = np.asarray(edges.edge_permute_banded(x, off, rev))
+    assert (a == b).all()
+
+    v = jnp.asarray(rng.normal(size=(50,)).astype(np.float32))
+    pa = np.asarray(v[jnp.asarray(topo.nbr)])
+    pb = np.asarray(edges.peer_gather_banded(v, off))
+    assert (pa == pb).all()
+
+
+def test_gossipsub_step_banded_equals_gather():
+    # the full v1.1 step (publishes, heartbeats, scoring, fanout) must be
+    # bit-identical between the roll path and the generic gather path
+    n, m = 96, 32
+    topo = graph.ring_lattice(n, d=3)
+    subs = graph.subscribe_all(n, 1)
+    net_banded = Net.build(topo, subs)
+    assert net_banded.band_off is not None
+    net_gather = net_banded.replace(band_off=None, band_rev=None)
+
+    params = dataclasses.replace(GossipSubParams(), flood_publish=True)
+    sp = PeerScoreParams(
+        topics={0: TopicScoreParams()},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
+
+    finals = []
+    for net in (net_banded, net_gather):
+        st = GossipSubState.init(net, m, cfg, score_params=sp, seed=0)
+        step = make_gossipsub_step(cfg, net, score_params=sp)
+        for r in range(10):
+            po = jnp.asarray(
+                np.random.default_rng(r).integers(0, n, size=(4,)).astype(np.int32)
+            )
+            pt = jnp.zeros((4,), jnp.int32)
+            pv = jnp.ones((4,), bool)
+            st = step(st, po, pt, pv)
+        finals.append(st)
+
+    a_leaves = jax.tree_util.tree_leaves(finals[0])
+    b_leaves = jax.tree_util.tree_leaves(finals[1])
+    for la, lb in zip(a_leaves, b_leaves):
+        if jnp.issubdtype(la.dtype, jax.dtypes.prng_key):
+            la, lb = jax.random.key_data(la), jax.random.key_data(lb)
+        assert (np.asarray(la) == np.asarray(lb)).all()
