@@ -145,6 +145,28 @@ class TopicEventHandler:
 
 
 @dataclasses.dataclass
+class TopicScoreSnapshot:
+    """Per-topic counters behind a neighbor's score (TopicScoreSnapshot,
+    score.go:155-166), in ticks / raw counter units."""
+
+    time_in_mesh: int
+    first_message_deliveries: float
+    mesh_message_deliveries: float
+    invalid_message_deliveries: float
+
+
+@dataclasses.dataclass
+class PeerScoreSnapshot:
+    """Detailed score inspection record (PeerScoreSnapshot, score.go:134-153;
+    surfaced by WithPeerScoreInspectDetailed)."""
+
+    score: float
+    topics: "dict[str, TopicScoreSnapshot]"
+    behaviour_penalty: float
+    ip_colocation_factor: float
+
+
+@dataclasses.dataclass
 class _Validator:
     fn: Callable
     inline: bool
@@ -289,6 +311,12 @@ class Node:
         """Score snapshot for this node's neighbors (WithPeerScoreInspect,
         score.go:120-177)."""
         return self.network._peer_scores(self)
+
+    def peer_score_snapshots(self) -> "dict[bytes, PeerScoreSnapshot]":
+        """Extended inspection (WithPeerScoreInspectDetailed): per-neighbor
+        score plus the per-topic counters it is computed from
+        (PeerScoreSnapshot/TopicScoreSnapshot, score.go:134-177)."""
+        return self.network._peer_score_snapshots(self)
 
 
 class Network:
@@ -683,6 +711,45 @@ class Network:
             self.nodes[int(nbr[k])].identity.peer_id: float(scores[k])
             for k in range(len(nbr)) if ok[k]
         }
+
+    def _peer_score_snapshots(self, node: Node) -> "dict[bytes, PeerScoreSnapshot]":
+        st = self.state
+        if not hasattr(st, "score"):
+            return {}
+        i = node.idx
+        nbr = np.asarray(self.net.nbr)[i]
+        ok = np.asarray(self.net.nbr_ok)[i]
+        my_topics = np.asarray(self.net.my_topics)[i]
+        sc = st.score
+        scores = np.asarray(st.scores)[i]
+        fmd = np.asarray(sc.fmd)[i]; mmd = np.asarray(sc.mmd)[i]
+        imd = np.asarray(sc.imd)[i]; mt = np.asarray(sc.mesh_time)[i]
+        bp = np.asarray(sc.bp)[i]
+        # the exact P6 input the score used (threshold-gated surplus^2,
+        # whitelist-aware — ip_colocation_surplus_sq)
+        p6 = np.asarray(st.p6)[i] if hasattr(st, "p6") else np.zeros(len(nbr))
+        out: dict[bytes, PeerScoreSnapshot] = {}
+        for k in range(len(nbr)):
+            if not ok[k]:
+                continue
+            j = int(nbr[k])
+            topics = {}
+            for s, t in enumerate(my_topics):
+                if t < 0:
+                    continue
+                topics[self.topic_names[int(t)]] = TopicScoreSnapshot(
+                    time_in_mesh=int(mt[s, k]),
+                    first_message_deliveries=float(fmd[s, k]),
+                    mesh_message_deliveries=float(mmd[s, k]),
+                    invalid_message_deliveries=float(imd[s, k]),
+                )
+            out[self.nodes[j].identity.peer_id] = PeerScoreSnapshot(
+                score=float(scores[k]),
+                topics=topics,
+                behaviour_penalty=float(bp[k]),
+                ip_colocation_factor=float(p6[k]),
+            )
+        return out
 
     def stop(self) -> None:
         if self._session is not None:

@@ -92,6 +92,7 @@ class GossipSubConfig:
     opportunistic_graft_peers: int = 2
     backoff_clear_ticks: int = 15   # gossipsub.go:1587
     backoff_slack_ticks: int = 2    # gossipsub.go:1596
+    direct_connect_ticks: int = 300  # gossipsub.go:1606-1628
     heartbeat_every: int = 1        # rounds per heartbeat tick
     # v1.1 switches
     score_enabled: bool = False
@@ -139,6 +140,7 @@ class GossipSubConfig:
             graft_flood_ticks=ticks_for(p.graft_flood_threshold, hb),
             opportunistic_graft_ticks=p.opportunistic_graft_ticks,
             opportunistic_graft_peers=p.opportunistic_graft_peers,
+            direct_connect_ticks=p.direct_connect_ticks,
             heartbeat_every=heartbeat_every,
             score_enabled=score_enabled,
             flood_publish=p.flood_publish,
@@ -681,7 +683,11 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
 def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               score_params: PeerScoreParams | None,
               nbr_sub: jax.Array, gater_params=None,
-              nbr_sub_words: jax.Array | None = None) -> GossipSubState:
+              nbr_sub_words: jax.Array | None = None,
+              present_ok: jax.Array | None = None) -> GossipSubState:
+    """`net` is the live view (nbr_ok masked by churn/edge-liveness);
+    `present_ok` is the static edge-presence mask, needed by directConnect
+    to re-dial edges that are currently dormant (defaults to net.nbr_ok)."""
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -883,6 +889,18 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         [jnp.zeros_like(st.mcache[:, :1, :]), st.mcache[:, :-1, :]], axis=1
     )
 
+    # directConnect (gossipsub.go:1606-1628): every DirectConnectTicks,
+    # re-dial direct peers — in the PX edge-liveness model, a dormant
+    # direct edge reactivates (both directions)
+    edge_live = st.edge_live
+    if cfg.do_px and cfg.direct_connect_ticks > 0:
+        direct_sym = net.direct | net.edge_gather(net.direct)
+        # tick 0 is skipped: the reference delays the first dial
+        # (DirectConnectInitialDelay) past connection setup
+        redial = ((tick % cfg.direct_connect_ticks) == 0) & (tick > 0)
+        ok = net.nbr_ok if present_ok is None else present_ok
+        edge_live = jnp.where(redial, edge_live | (direct_sym & ok), edge_live)
+
     events = (
         events.at[EV.GRAFT].add(jnp.sum(new_grafts.astype(jnp.int32)))
         .at[EV.PRUNE].add(jnp.sum(toprune.astype(jnp.int32)))
@@ -891,6 +909,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     return st.replace(
         core=st.core.replace(events=events),
         mesh=mesh,
+        edge_live=edge_live,
         backoff_expire=backoff_expire,
         backoff_present=backoff_present,
         mcache=mcache,
@@ -1311,7 +1330,8 @@ def make_gossipsub_step(
         # through both branches, which costs real copies of the big arrays.
         def hb(s):
             return heartbeat(
-                cfg, net_l, s, tp, score_params, nbr_sub_l, gater_params, nbr_sub_words_l
+                cfg, net_l, s, tp, score_params, nbr_sub_l, gater_params,
+                nbr_sub_words_l, present_ok=net.nbr_ok,
             )
 
         if cfg.heartbeat_every == 1:

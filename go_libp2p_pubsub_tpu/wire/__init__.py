@@ -3,18 +3,23 @@ semantics (the comm.go equivalent). The compute path never sees this — it
 exists at the edges: trace sinks, interop harnesses, and the native runtime
 (see native/)."""
 
+from .fragment import DEFAULT_MAX_RPC_SIZE, fragment_rpc
 from .framing import (
     decode_uvarint,
     encode_uvarint,
     read_delimited,
     read_delimited_messages,
     write_delimited,
+    write_rpc,
 )
 
 __all__ = [
     "encode_uvarint",
     "decode_uvarint",
     "write_delimited",
+    "write_rpc",
     "read_delimited",
     "read_delimited_messages",
+    "fragment_rpc",
+    "DEFAULT_MAX_RPC_SIZE",
 ]

@@ -53,6 +53,19 @@ def write_delimited(stream: BinaryIO, msg) -> int:
     return len(header) + len(payload)
 
 
+def write_rpc(stream: BinaryIO, rpc, limit: int | None = None):
+    """Frame an outbound RPC onto a stream, fragmenting first when it
+    exceeds the size cap (sendRPC -> fragmentRPC, gossipsub.go:1096-1141).
+    Returns (bytes_written, dropped_messages)."""
+    from .fragment import DEFAULT_MAX_RPC_SIZE, fragment_rpc
+
+    frags, dropped = fragment_rpc(rpc, limit or DEFAULT_MAX_RPC_SIZE)
+    n = 0
+    for f in frags:
+        n += write_delimited(stream, f)
+    return n, dropped
+
+
 def _read_uvarint_stream(stream: BinaryIO) -> int | None:
     result = 0
     shift = 0

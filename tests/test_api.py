@@ -198,3 +198,32 @@ def test_traced_network(tmp_path):
 
     assert trace_pb2.TraceEvent.PUBLISH_MESSAGE in kinds
     assert trace_pb2.TraceEvent.DELIVER_MESSAGE in kinds
+
+
+def test_peer_score_snapshots_detailed():
+    # WithPeerScoreInspectDetailed parity: per-topic counters behind the score
+    from go_libp2p_pubsub_tpu import api
+
+    net = api.Network(score_params=default_peer_score_params(1))
+    nodes = net.add_nodes(10)
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.dense_connect(d=4, seed=1)
+    net.start()
+    nodes[0].topics["t"].publish(b"x")
+    net.run(6)
+    snaps = nodes[1].peer_score_snapshots()
+    assert snaps, "expected neighbor snapshots"
+    for pid, snap in snaps.items():
+        assert isinstance(snap.score, float)
+        assert "t" in snap.topics
+        ts = snap.topics["t"]
+        assert ts.time_in_mesh >= 0
+        assert ts.first_message_deliveries >= 0.0
+        assert snap.ip_colocation_factor >= 0.0
+    # somewhere in the network a first delivery must have been credited
+    all_snaps = [s for nd in nodes for s in nd.peer_score_snapshots().values()]
+    assert any(s.topics["t"].first_message_deliveries > 0 for s in all_snaps)
+    # scores agree with the simple inspection map
+    simple = nodes[1].peer_scores()
+    for pid, snap in snaps.items():
+        assert abs(simple[pid] - snap.score) < 1e-6

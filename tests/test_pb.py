@@ -78,3 +78,105 @@ def test_trace_batch():
     out = trace_pb2.TraceEventBatch()
     out.ParseFromString(b.SerializeToString())
     assert len(out.batch) == 3
+
+
+# ---------------------------------------------------------------------------
+# RPC fragmentation
+# (fragmentRPC, gossipsub.go:1162-1251)
+
+
+def _mk_rpc(n_msgs=0, msg_size=0, n_ids=0, subs=("a",), grafts=(), id_size=20):
+    rpc = rpc_pb2.RPC()
+    for t in subs:
+        rpc.subscriptions.add(subscribe=True, topicid=t)
+    for i in range(n_msgs):
+        m = rpc.publish.add()
+        m.data = bytes(msg_size)
+        m.seqno = i.to_bytes(8, "big")
+        m.topic = "a"
+    for t in grafts:
+        rpc.control.graft.add(topicID=t)
+    if n_ids:
+        ih = rpc.control.ihave.add()
+        ih.topicID = "a"
+        ih.messageIDs.extend("m%0*d" % (id_size - 1, i) for i in range(n_ids))
+    return rpc
+
+
+def test_fragment_noop_under_limit():
+    from go_libp2p_pubsub_tpu.wire.fragment import fragment_rpc
+
+    rpc = _mk_rpc(n_msgs=3, msg_size=100)
+    frags, dropped = fragment_rpc(rpc, limit=1 << 20)
+    assert frags == [rpc] and dropped == []
+
+
+def test_fragment_splits_messages_and_preserves_content():
+    from go_libp2p_pubsub_tpu.wire.fragment import fragment_rpc
+
+    rpc = _mk_rpc(n_msgs=40, msg_size=4000)
+    limit = 20_000
+    frags, dropped = fragment_rpc(rpc, limit=limit)
+    assert not dropped and len(frags) > 1
+    assert all(f.ByteSize() <= limit for f in frags)
+    got = [m.seqno for f in frags for m in f.publish]
+    assert got == [m.seqno for m in rpc.publish]
+    # subscriptions only in the first fragment
+    assert len(frags[0].subscriptions) == 1
+    assert all(not f.subscriptions for f in frags[1:])
+
+
+def test_fragment_drops_single_oversize_message():
+    from go_libp2p_pubsub_tpu.wire.fragment import fragment_rpc
+
+    rpc = _mk_rpc(n_msgs=2, msg_size=50_000)
+    frags, dropped = fragment_rpc(rpc, limit=10_000)
+    assert len(dropped) == 2
+    assert all(f.ByteSize() <= 10_000 for f in frags)
+
+
+def test_fragment_splits_ihave_id_lists():
+    from go_libp2p_pubsub_tpu.wire.fragment import fragment_rpc
+
+    rpc = _mk_rpc(n_ids=5000, grafts=("a", "b"))
+    limit = 30_000
+    frags, dropped = fragment_rpc(rpc, limit=limit)
+    assert not dropped and len(frags) > 1
+    assert all(f.ByteSize() <= limit for f in frags)
+    ids = [m for f in frags for ih in f.control.ihave for m in ih.messageIDs]
+    assert ids == list(rpc.control.ihave[0].messageIDs)
+    assert all(ih.topicID == "a" for f in frags for ih in f.control.ihave)
+    n_grafts = sum(len(f.control.graft) for f in frags)
+    assert n_grafts == 2
+
+
+def test_fragment_mixed_publish_then_control_respects_limit():
+    # regression: first id of a control entry appended without a room check
+    from go_libp2p_pubsub_tpu.wire.fragment import fragment_rpc
+
+    rpc = _mk_rpc(n_msgs=7, msg_size=1400)  # lands near the limit boundary
+    iw = rpc.control.iwant.add()
+    iw.messageIDs.extend(["x" * 500, "y" * 500])
+    limit = 10_000
+    frags, dropped = fragment_rpc(rpc, limit=limit)
+    assert not dropped
+    assert all(f.ByteSize() <= limit for f in frags), [f.ByteSize() for f in frags]
+    ids = [m for f in frags for w in f.control.iwant for m in w.messageIDs]
+    assert ids == list(iw.messageIDs)
+
+
+def test_write_rpc_fragments_on_stream():
+    import io
+
+    from go_libp2p_pubsub_tpu.pb import rpc_pb2
+    from go_libp2p_pubsub_tpu.wire import framing
+
+    rpc = _mk_rpc(n_ids=3000)
+    buf = io.BytesIO()
+    n, dropped = framing.write_rpc(buf, rpc, limit=20_000)
+    assert not dropped and n == len(buf.getvalue())
+    buf.seek(0)
+    got = list(framing.read_delimited_messages(buf, rpc_pb2.RPC))
+    assert len(got) > 1
+    ids = [m for f in got for ih in f.control.ihave for m in ih.messageIDs]
+    assert ids == list(rpc.control.ihave[0].messageIDs)

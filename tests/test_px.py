@@ -196,3 +196,36 @@ def test_heartbeat_oversub_prune_carries_px():
     # have come alive but none beyond the provisioned candidate set
     live = np.asarray(st.edge_live)
     assert not (live & ~np.asarray(net.nbr_ok)).any()
+
+
+def test_direct_connect_reactivates_dormant_direct_edges():
+    # directConnect (gossipsub.go:1606-1628): every DirectConnectTicks the
+    # router re-dials direct peers; a dormant direct edge comes back live
+    n, d = 16, 4
+    topo = graph.random_connect(n, d, seed=2)
+    dormant = graph.dormant_edges(topo, 0.9, seed=3)
+    subs = graph.subscribe_all(n, 1)
+    # pick one dormant edge and mark it direct (both directions)
+    ij = np.argwhere(dormant & topo.nbr_ok)
+    i, k = ij[0]
+    j, rk = topo.nbr[i, k], topo.rev[i, k]
+    direct = np.zeros_like(topo.nbr_ok)
+    direct[i, k] = True
+    direct[j, rk] = True
+    net = Net.build(topo, subs, direct=direct)
+    params = dataclasses.replace(GossipSubParams(), do_px=True,
+                                 direct_connect_ticks=5)
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
+    sp = benign_sp()
+    st = GossipSubState.init(net, 16, cfg, score_params=sp, seed=0,
+                             dormant=dormant)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    assert not bool(st.edge_live[i, k])
+    po, pt, pv = no_publish()
+    for r in range(4):
+        st = step(st, po, pt, pv)
+        assert not bool(st.edge_live[i, k]), f"too early at round {r}"
+    st = step(st, po, pt, pv)  # tick 4 runs heartbeat at tick%5==0? tick counts from 0
+    # by tick 5 the redial must have happened on both directions
+    st = step(st, po, pt, pv)
+    assert bool(st.edge_live[i, k]) and bool(st.edge_live[j, rk])
