@@ -15,6 +15,8 @@ counters, and the score engine later consumes it for delivery attribution.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -22,6 +24,18 @@ from flax import struct
 from ..ops import bitset
 from ..state import Delivery, MsgTable, Net
 from ..trace.events import EV
+
+# opt-in fused Pallas delivery kernel for banded topologies (exact parity
+# with the XLA path — tests/test_pallas.py). Off by default: the current
+# libtpu's Mosaic pass rejects the packed-word shape casts on real TPU
+# (see ops/pallas_delivery.py docstring), so the opt-in runs the kernel in
+# interpret mode (set PUBSUB_PALLAS_COMPILE=1 to attempt a real compile on
+# a future libtpu). The XLA path stays the production default.
+USE_PALLAS = os.environ.get("PUBSUB_PALLAS", "") == "1"
+
+
+def _pallas_block() -> int:
+    return int(os.environ.get("PUBSUB_PALLAS_BLOCK", "2000"))
 
 
 @struct.dataclass
@@ -76,6 +90,16 @@ def delivery_round(
     n, k_slots = net.nbr.shape
     m = msgs.capacity
 
+    if USE_PALLAS and net.band_off is not None and forward_mask is None:
+        from ..ops.pallas_delivery import pallas_supported
+
+        block = min(_pallas_block(), n)
+        if pallas_supported(net.band_off, n, block):
+            interpret = os.environ.get("PUBSUB_PALLAS_COMPILE", "") != "1"
+            return _delivery_round_pallas(
+                net, msgs, dlv, edge_mask, tick, block=block, interpret=interpret
+            )
+
     # what each sender is forwarding this round: [N, K, W] word gather
     fwd_gathered = net.peer_gather(dlv.fwd)
 
@@ -112,19 +136,51 @@ def delivery_round(
         first_edge=first_edge,
     )
 
+    return dlv, _round_info(trans, new_words, m, valid_words)
+
+
+def _round_info(trans, new_words, m, valid_words) -> RoundInfo:
+    """Delivery observables from a round's transmit/new sets (shared by the
+    XLA and pallas paths so the trace-counter semantics stay single-source)."""
     n_rpc = bitset.popcount(trans, axis=None).astype(jnp.int32).sum()
     n_new = bitset.popcount(new_words, axis=None).astype(jnp.int32).sum()
-    n_deliver = bitset.popcount(new_words & valid_words[None, :], axis=None).astype(jnp.int32).sum()
-    info = RoundInfo(
+    n_deliver = (
+        bitset.popcount(new_words & valid_words[None, :], axis=None)
+        .astype(jnp.int32).sum()
+    )
+    return RoundInfo(
         trans=trans,
         new_words=new_words,
-        new_bits=new_bits,
+        new_bits=bitset.unpack(new_words, m),
         n_deliver=n_deliver,
         n_reject=n_new - n_deliver,
         n_duplicate=n_rpc - n_new,
         n_rpc=n_rpc,
     )
-    return dlv, info
+
+
+def _delivery_round_pallas(net, msgs, dlv, edge_mask, tick, block=None,
+                           interpret=False):
+    """Banded fast path: one fused kernel for the whole round (see
+    ops/pallas_delivery.py). Bit-identical to the generic path above."""
+    from ..ops.pallas_delivery import delivery_round_banded
+
+    n, k_slots = net.nbr.shape
+    m = msgs.capacity
+    w = bitset.n_words(m)
+    ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    emask_flat = (edge_mask & ok_words).reshape(n, k_slots * w)
+    valid_words = bitset.pack(msgs.valid)
+    trans, have2, fwd2, fr2, fe2 = delivery_round_banded(
+        dlv.fwd, dlv.first_edge, emask_flat, dlv.have, dlv.first_round,
+        msgs.origin, valid_words, tick,
+        block=min(block or n, n), m=m,
+        offsets=net.band_off, revs=net.band_rev,
+        interpret=interpret,
+    )
+    new_words = have2 & ~dlv.have
+    dlv2 = dlv.replace(have=have2, fwd=fwd2, first_round=fr2, first_edge=fe2)
+    return dlv2, _round_info(trans, new_words, m, valid_words)
 
 
 def accumulate_round_events(events: jax.Array, info: RoundInfo, n_publish) -> jax.Array:
