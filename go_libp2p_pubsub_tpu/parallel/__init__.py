@@ -1,1 +1,7 @@
-from .sharding import make_mesh, shard_state, state_shardings  # noqa: F401
+from .sharding import (  # noqa: F401
+    make_mesh,
+    make_multihost_mesh,
+    peer_spec,
+    shard_state,
+    state_shardings,
+)

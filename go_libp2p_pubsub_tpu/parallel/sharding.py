@@ -32,10 +32,42 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), ("peers",))
 
 
+def make_multihost_mesh(
+    n_hosts: int | None = None, devices=None, axis_names=("dcn", "ici")
+) -> Mesh:
+    """2-D (hosts x chips-per-host) mesh for multi-host runs: the peer axis
+    is sharded over BOTH axes (dcn-major), so neighbor gathers between
+    peer-shards on one host ride ICI while only the band edges that cross a
+    host boundary pay DCN — the banded topology builders put consecutive
+    peer ids on the same host, keeping DCN traffic to the halo.
+
+    Single-process multi-host simulation (the driver's virtual-device
+    setup) and real multi-host (jax.distributed + one process per host)
+    build the same mesh; under GSPMD the collective choice per edge is
+    XLA's, exactly the scaling-book recipe."""
+    if devices is None:
+        devices = jax.devices()
+    if n_hosts is None:
+        n_hosts = max(1, len(set(d.process_index for d in devices)))
+    n_dev = len(devices)
+    assert n_dev % n_hosts == 0, "devices must split evenly across hosts"
+    # host-major order so each 'ici' row stays within one process — the
+    # global device list is not guaranteed to be grouped by host
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    arr = np.asarray(devices).reshape(n_hosts, n_dev // n_hosts)
+    return Mesh(arr, axis_names)
+
+
+def peer_spec(mesh: Mesh) -> P:
+    """PartitionSpec sharding the leading (peer) axis over every mesh axis."""
+    return P(tuple(mesh.axis_names)) if len(mesh.axis_names) > 1 else P(mesh.axis_names[0])
+
+
 def state_shardings(state, mesh: Mesh, n_peers: int):
     """Pytree of NamedShardings: leaves with leading dim == n_peers are
-    sharded along 'peers'; everything else is replicated."""
-    peer = NamedSharding(mesh, P("peers"))
+    sharded along the peer axes (all mesh axes); everything else is
+    replicated."""
+    peer = NamedSharding(mesh, peer_spec(mesh))
     repl = NamedSharding(mesh, P())
 
     def choose(leaf):
