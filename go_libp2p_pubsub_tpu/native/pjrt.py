@@ -243,7 +243,13 @@ class PjrtExecutable:
         return n
 
     def run(self, inputs) -> list[np.ndarray]:
-        """Execute with host arrays (or PjrtBuffers); returns host arrays."""
+        """Execute with host arrays (or PjrtBuffers); returns host arrays.
+
+        When built via compile_exported, arguments the compiler pruned
+        are dropped here (pass the original full argument list)."""
+        kept = getattr(self, "_kept_var_idx", None)
+        if kept is not None:
+            inputs = [inputs[i] for i in kept]
         bufs = [
             x if isinstance(x, PjrtBuffer) else self._c.buffer_from_numpy(np.asarray(x))
             for x in inputs
@@ -317,6 +323,17 @@ class PjrtClient:
         if not h:
             raise PjrtError(err.value.decode())
         return PjrtExecutable(self, h)
+
+    def compile_exported(self, exported) -> "PjrtExecutable":
+        """Compile a `jax.export.Exported`, recording its kept-argument
+        indices on the executable. XLA prunes unused parameters from the
+        compiled program, so executing with the caller's full argument
+        list mismatches the executable's arity (observed to crash the
+        remote backend); `Exported.module_kept_var_idx` says which of the
+        original arguments survive, and run() applies it."""
+        exe = self.compile(exported.mlir_module_serialized)
+        exe._kept_var_idx = tuple(exported.module_kept_var_idx)
+        return exe
 
     def buffer_from_numpy(self, arr: np.ndarray) -> PjrtBuffer:
         arr = np.ascontiguousarray(arr)
