@@ -51,7 +51,6 @@ from .config import (
     PeerGaterParams,
     PeerScoreParams,
     PeerScoreThresholds,
-    default_peer_score_params,
 )
 from .discovery import Discovery, DiscoverySession, min_topic_size
 from .pb import rpc_pb2

@@ -26,7 +26,6 @@ pubsub.go:842-859 — announcements are modeled as instantaneous).
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass
 
 import numpy as np

@@ -123,8 +123,6 @@ class GossipSubConfig:
         gater_params: "PeerGaterParams | None" = None,
         validation_capacity: int = 0,
     ) -> "GossipSubConfig":
-        from ..config import PeerGaterParams  # local: avoid name shadowing
-
         p = params or GossipSubParams()
         p.validate()
         hb = p.heartbeat_interval

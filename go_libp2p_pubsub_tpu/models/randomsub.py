@@ -1,25 +1,23 @@
 """RandomSub router, vectorized (randomsub.go).
 
 Reference semantics (randomsub.go:99-160): on each publish/forward, send to
-max(RandomSubD=6, ceil(sqrt(topic size))) random peers subscribed to the
-topic (gossipsub-capable peers are sampled; floodsub peers always get it —
-here all peers are mesh-capable, survey #11 protocol negotiation arrives
-with the adversary/protocol flags).
+max(RandomSubD=6, ceil(sqrt(topic size))) random *gossip-capable* peers
+subscribed to the topic, while peers speaking only /floodsub/1.0.0 always
+receive (randomsub.go:107-116 splits the peer list before sampling).
 
 Vector form: each sender draws a fresh random-k edge selection per topic
-slot per round; the receiver-side gather translates it through the
+slot per round over the gossip-capable neighbors, ORs in the floodsub-only
+edges unconditionally; the receiver-side gather translates it through the
 reverse-edge index exactly like the gossipsub mesh mask.
 """
 
 from __future__ import annotations
 
-import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import bitset
 from ..ops.select import select_random_mask
 from ..score.engine import slot_topic_words
 from ..state import Net, SimState, allocate_publishes
@@ -32,11 +30,14 @@ RANDOMSUB_D = 6  # randomsub.go:17
 def make_randomsub_step(net: Net, d: int = RANDOMSUB_D):
     """Build the jitted per-round RandomSub step.
 
-    The per-topic fanout target is max(d, ceil(sqrt(topic_size)))
-    (randomsub.go:124-131), with topic sizes from the static subscription
-    table."""
-    topic_size = np.asarray(jnp.sum(net.subscribed, axis=0))  # [T]
-    target_t = np.maximum(d, np.ceil(np.sqrt(topic_size))).astype(np.int32)
+    The per-topic fanout target is max(d, ceil(sqrt(gossip-capable topic
+    size))) — the reference splits floodsub peers out *before* sizing the
+    random sample (randomsub.go:107-131)."""
+    protocol = np.asarray(net.protocol)
+    gs_size = np.asarray(
+        jnp.sum(net.subscribed & jnp.asarray(protocol >= 1)[:, None], axis=0)
+    )  # [T] gossip-capable subscribers only
+    target_t = np.maximum(d, np.ceil(np.sqrt(gs_size))).astype(np.int32)
     # per (peer, slot) target
     mt = np.asarray(net.my_topics)
     target_ns = jnp.asarray(
@@ -44,6 +45,14 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D):
     )  # [N,S]
 
     eligible = gather_nbr_subscribed(net)  # [N,S,K] static, eager
+    # the random draw samples gossip-capable peers only; floodsub-only
+    # neighbors are always sent to (randomsub.go:107-116)
+    fs_edge = (net.peer_gather(net.protocol) == 0) & net.nbr_ok  # [N,K]
+    elig_random = eligible & ~fs_edge[:, None, :]
+    always = eligible & fs_edge[:, None, :]
+    # a floodsub-only *sender* runs the floodsub router, not randomsub:
+    # it forwards to every subscribed neighbor (floodsub.go:76-100)
+    i_am_floodsub = jnp.asarray(protocol == 0)
 
     def step(st: SimState, pub_origin, pub_topic, pub_valid) -> SimState:
         tick = st.tick
@@ -51,7 +60,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D):
 
         # fresh random fanout per sender/slot/round
         key = jax.random.fold_in(st.key, tick)
-        sel = select_random_mask(key, eligible, target_ns)  # [N,S,K]
+        sel = select_random_mask(key, elig_random, target_ns) | always  # [N,S,K]
+        sel = jnp.where(i_am_floodsub[:, None, None], eligible, sel)
 
         # sender-side packed outbox, word-gathered by receivers
         slotw = slot_topic_words(net, st.msgs.topic)           # [N,S,W]

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..config import PeerGaterParams, ticks_for
+from ..config import PeerGaterParams
 from ..state import Net
 
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import gzip
 import io
-from typing import BinaryIO, Callable, Iterable, Iterator
+from typing import Callable, Iterable, Iterator
 
 from google.protobuf import json_format
 

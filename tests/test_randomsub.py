@@ -76,3 +76,47 @@ def test_randomsub_fanout_bound():
     # the publish round sends to exactly max(6, ceil(sqrt(64)))=8 peers
     assert ev[EV.SEND_RPC] <= 8 + 1
     assert ev[EV.DELIVER_MESSAGE] >= 6
+
+
+def test_floodsub_peers_always_receive():
+    # randomsub.go:107-116: floodsub-only peers are not subject to the
+    # random draw — every publish reaches them (if subscribed + adjacent)
+    n = 24
+    topo = graph.ring_lattice(n, d=6)
+    subs = graph.subscribe_all(n, 1)
+    protocol = np.full(n, 2, np.int8)
+    fs = [3, 9, 17]
+    protocol[fs] = 0  # floodsub-only speakers
+    net = Net.build(topo, subs, protocol=protocol)
+    st = SimState.init(n, 32, seed=0)
+    step = make_randomsub_step(net, d=2)  # small d so the draw is sparse
+
+    for r in range(6):
+        st = step(st, *_pub((5 * r + 1) % n, 0))
+        st = step(st, *_none())
+    have = np.asarray(bitset.unpack(st.dlv.have, 32))
+    # every floodsub peer adjacent to any holder of a message eventually
+    # has it: with always-forward they receive on first contact; just check
+    # they received at least as many messages as the network median
+    counts = have.sum(axis=1)
+    assert all(counts[f] >= np.median(counts) for f in fs), (
+        counts[fs], np.median(counts))
+
+
+def test_floodsub_sender_floods_all_neighbors():
+    # a /floodsub/1.0.0 speaker runs floodsub semantics: its messages go to
+    # every subscribed neighbor in one hop, not a random subset
+    n = 40
+    topo = graph.ring_lattice(n, d=8)  # degree 16 >> randomsub target
+    subs = graph.subscribe_all(n, 1)
+    protocol = np.full(n, 2, np.int8)
+    protocol[7] = 0
+    net = Net.build(topo, subs, protocol=protocol)
+    st = SimState.init(n, 32, seed=3)
+    step = make_randomsub_step(net, d=2)
+    st = step(st, *_pub(7, 0))
+    st = step(st, *_none())
+    have = np.asarray(bitset.unpack(st.dlv.have, 32))
+    nbrs = np.asarray(topo.nbr)[7][np.asarray(topo.nbr_ok)[7]]
+    # after one delivery round every neighbor of 7 must hold the message
+    assert have[nbrs, 0].all()
