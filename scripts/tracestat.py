@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""tracestat — summarize a pubsub trace file (the analysis the reference's
+README points at for its `trace.pb` streams; north star: "tracestat
+analysis is unchanged").
+
+Reads JSON-lines (JSONTracer) or varint-delimited protobuf (PBTracer)
+TraceEvent files and reports:
+  * per-type event counts;
+  * publish/deliver/duplicate/reject totals and the delivery ratio;
+  * propagation delay percentiles (DELIVER_MESSAGE timestamps relative to
+    the message's PUBLISH_MESSAGE, by message id), in the trace's time
+    base (nanoseconds; the drain writes tick * tick_ns).
+
+Usage: python scripts/tracestat.py TRACEFILE [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter, defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from go_libp2p_pubsub_tpu.pb import trace_pb2
+from go_libp2p_pubsub_tpu.trace import sinks
+
+
+def read_events(path: str, fmt: str = "auto"):
+    """Yield TraceEvent via the package's tested readers. `fmt` is "json",
+    "pb", or "auto" — auto tries JSON first and falls back to delimited
+    protobuf (first-byte sniffing alone is unsound: a PB record of length
+    123 starts with the same 0x7b byte as '{')."""
+    if fmt == "json":
+        yield from sinks.read_json_trace(path)
+        return
+    if fmt == "pb":
+        yield from sinks.read_pb_trace(path)
+        return
+    try:
+        events = list(sinks.read_json_trace(path))
+    except Exception:
+        events = None
+    if events is None:
+        events = list(sinks.read_pb_trace(path))
+    yield from events
+
+
+def percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def summarize(events) -> dict:
+    counts = Counter()
+    publish_ts: dict[bytes, int] = {}
+    delays: list[int] = []
+    peers = set()
+
+    for ev in events:
+        tname = trace_pb2.TraceEvent.Type.Name(ev.type)
+        counts[tname] += 1
+        peers.add(bytes(ev.peerID))
+        if ev.type == trace_pb2.TraceEvent.PUBLISH_MESSAGE:
+            publish_ts[bytes(ev.publishMessage.messageID)] = ev.timestamp
+        elif ev.type == trace_pb2.TraceEvent.DELIVER_MESSAGE:
+            t0 = publish_ts.get(bytes(ev.deliverMessage.messageID))
+            if t0 is not None:
+                delays.append(ev.timestamp - t0)
+
+    delays.sort()
+    pub = counts.get("PUBLISH_MESSAGE", 0)
+    dlv = counts.get("DELIVER_MESSAGE", 0)
+    return {
+        "events": sum(counts.values()),
+        "peers": len(peers),
+        "counts": dict(sorted(counts.items())),
+        "published": pub,
+        "delivered": dlv,
+        "duplicates": counts.get("DUPLICATE_MESSAGE", 0),
+        "rejected": counts.get("REJECT_MESSAGE", 0),
+        "deliveries_per_publish": round(dlv / pub, 3) if pub else None,
+        "delay_ns": {
+            "p50": percentile(delays, 0.50),
+            "p90": percentile(delays, 0.90),
+            "p99": percentile(delays, 0.99),
+            "max": delays[-1] if delays else None,
+            "samples": len(delays),
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("tracefile")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--format", choices=("auto", "json", "pb"), default="auto")
+    args = ap.parse_args()
+
+    stats = summarize(read_events(args.tracefile, args.format))
+    if args.json:
+        print(json.dumps(stats))
+        return
+    print(f"events: {stats['events']}   peers: {stats['peers']}")
+    for name, c in stats["counts"].items():
+        print(f"  {name:20s} {c}")
+    print(
+        f"published {stats['published']}  delivered {stats['delivered']}  "
+        f"dup {stats['duplicates']}  rejected {stats['rejected']}  "
+        f"deliveries/publish {stats['deliveries_per_publish']}"
+    )
+    d = stats["delay_ns"]
+    ms = lambda v: None if v is None else round(v / 1e6, 3)
+    print(
+        f"propagation delay (ms): p50={ms(d['p50'])} p90={ms(d['p90'])} "
+        f"p99={ms(d['p99'])} max={ms(d['max'])} (n={d['samples']})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -223,3 +223,46 @@ def test_traced_run_accounting(tmp_path):
     assert len(rem) == 1 and rem[0].removePeer.peerID == drain.peer_id(3)
     adds = [e for e in evs if e.type == trace_pb2.TraceEvent.ADD_PEER]
     assert len(adds) == n + 1
+
+
+def test_tracestat_cli(tmp_path):
+    # run a traced network, then the tracestat summarizer over both sink
+    # formats — the analysis workflow the reference points its users at
+    import json as jsonlib
+    import pathlib
+    import subprocess
+    import sys
+
+    from go_libp2p_pubsub_tpu import api
+    from go_libp2p_pubsub_tpu.trace import sinks
+
+    jpath = tmp_path / "t.ndjson"
+    ppath = tmp_path / "t.pb"
+    net = api.Network(
+        trace_sinks=[sinks.JSONTracer(str(jpath)), sinks.PBTracer(str(ppath))]
+    )
+    nodes = net.add_nodes(12)
+    for nd in nodes:
+        nd.join("x").subscribe()
+    net.dense_connect(d=4, seed=0)
+    net.start()
+    for i in range(3):
+        nodes[i].topics["x"].publish(b"m%d" % i)
+    net.run(6)
+    net.stop()
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    results = {}
+    for path in (jpath, ppath):
+        out = subprocess.run(
+            [sys.executable, "scripts/tracestat.py", str(path), "--json"],
+            capture_output=True, text=True, check=True, cwd=str(repo),
+        )
+        results[path] = jsonlib.loads(out.stdout)
+    for stats in results.values():
+        assert stats["published"] == 3
+        assert stats["delivered"] >= 3 * 11  # every other node got each one
+        assert stats["delay_ns"]["p50"] is not None
+        assert stats["counts"]["GRAFT"] > 0
+    # both formats describe the same run
+    assert results[jpath] == results[ppath]
