@@ -39,6 +39,8 @@ the explicit trade the survey §7 architecture makes; mid-run Join of a
 from __future__ import annotations
 
 import dataclasses
+import logging
+import time
 from collections import deque
 from typing import Callable
 
@@ -63,6 +65,9 @@ from .trace.drain import TraceSession, snapshot
 DEFAULT_VALIDATE_THROTTLE = 8192
 DEFAULT_TOPIC_THROTTLE = 1024
 SUBSCRIPTION_BUFFER = 32  # pubsub.go chan size; drop-if-slow
+SLOW_HEARTBEAT_WARN = 0.1  # warn fraction of the interval (gossipsub.go:258)
+
+_log = logging.getLogger("go_libp2p_pubsub_tpu")
 
 
 class APIError(RuntimeError):
@@ -358,6 +363,7 @@ class Network:
         self._validators: dict[str, _Validator] = {}
         self._pub_queue: deque = deque()
         self._slot_msg: dict[int, rpc_pb2.Message] = {}
+        self._timed_round = False  # first round pays jit compile; no warn
         self._seen_mids: dict[bytes, int] = {}  # msgid -> slot
         self.started = False
         self._session: TraceSession | None = None
@@ -623,6 +629,7 @@ class Network:
         self._topic_budget = {}
 
         for _ in range(rounds):
+            _t0 = time.perf_counter()
             po = np.full(self.pub_width, -1, np.int32)
             pt = np.zeros(self.pub_width, np.int32)
             pv = np.zeros(self.pub_width, bool)
@@ -659,6 +666,20 @@ class Network:
             if self.tag_tracer is not None:
                 self.tag_tracer.observe(prev, new)
             self._drain_deliveries(prev, new)
+
+            # slow-heartbeat warning (gossipsub.go:133-135,1305-1312): a
+            # real-time co-simulation can't keep up when a tick's wall
+            # time exceeds the warn fraction of the heartbeat interval.
+            # The first round is excluded — it pays one-time jit compile.
+            dt = time.perf_counter() - _t0
+            warmed, self._timed_round = self._timed_round, True
+            if warmed and dt > SLOW_HEARTBEAT_WARN * self.params.heartbeat_interval:
+                _log.warning(
+                    "slow heartbeat: tick took %.3fs, %.0f%% of the %.1fs "
+                    "interval", dt,
+                    100.0 * dt / self.params.heartbeat_interval,
+                    self.params.heartbeat_interval,
+                )
 
     def _blacklisted(self, node: Node) -> bool:
         pid = node.identity.peer_id

@@ -355,3 +355,40 @@ def test_sybil_squatters_pruned_and_delivery_survives():
     slot = int(np.where(np.asarray(st.core.msgs.origin) == 1)[0][-1])
     have = np.asarray(bitset.unpack(st.core.dlv.have, M))
     assert have[:32, slot].all(), "honest delivery must survive the sybils"
+
+
+# ---------------------------------------------------------------------------
+# IWANT flood: the retransmission cap (handleIWant gossipsub.go:695-707,
+# the `iwantEverything` greedy client, gossipsub_test.go:2009)
+
+
+def test_iwant_flood_served_at_most_retransmission_cap():
+    topo, net, cfg, st, step = build(n=12, d=5, seed=3, score=False)
+    # victim publishes; the message sits in its mcache window
+    victim = 0
+    attacker = int(topo.nbr[victim][topo.nbr_ok[victim]][0])
+    k_att = edge_to(topo, attacker, victim)  # attacker's edge toward victim
+    st, slot = withheld_publish(st, step, victim)
+    # use a long history so the window doesn't expire before the cap bites
+    word, bit = slot // 32, np.uint32(1 << (slot % 32))
+
+    served = 0
+    for _ in range(cfg.gossip_retransmission + 3):
+        # attacker re-requests the message from the victim every round
+        # (raw-wire greedy client), and pretends it never received it
+        iw = np.zeros(np.asarray(st.iwant_out).shape, np.uint32)
+        iw[attacker, k_att, word] = bit
+        have = np.asarray(st.core.dlv.have).copy()
+        have[attacker, word] &= ~bit
+        st = st.replace(
+            iwant_out=jnp.asarray(iw),
+            core=st.core.replace(dlv=st.core.dlv.replace(have=jnp.asarray(have))),
+        )
+        st = step(st, *no_publish())
+        # the bit was cleared before the step, so holding it now means the
+        # victim served this round's request
+        if np.asarray(st.core.dlv.have)[attacker, word] & bit:
+            served += 1
+
+    assert served == cfg.gossip_retransmission, (
+        served, cfg.gossip_retransmission)

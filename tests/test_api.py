@@ -227,3 +227,25 @@ def test_peer_score_snapshots_detailed():
     simple = nodes[1].peer_scores()
     for pid, snap in snaps.items():
         assert abs(simple[pid] - snap.score) < 1e-6
+
+
+def test_slow_heartbeat_warning(caplog):
+    # gossipsub.go:1305-1312: warn when a tick's wall time exceeds 10% of
+    # the heartbeat interval — force it with a tiny interval
+    import dataclasses
+    import logging
+
+    from go_libp2p_pubsub_tpu import api
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+
+    params = dataclasses.replace(GossipSubParams(), heartbeat_interval=1e-4)
+    net = api.Network(params=params)
+    nodes = net.add_nodes(4)
+    for nd in nodes:
+        nd.join("t")
+    net.connect_all()
+    net.start()
+    net.run(1)  # first round is exempt (jit compile)
+    with caplog.at_level(logging.WARNING, logger="go_libp2p_pubsub_tpu"):
+        net.run(1)
+    assert any("slow heartbeat" in r.message for r in caplog.records)
