@@ -181,3 +181,82 @@ def test_first_deliverers_gain_score():
     ok = np.asarray(net.nbr_ok)
     # (one delivery, P2 decayed ~0.9^10 plus P1 time-in-mesh)
     assert scores[ok].max() > 0.3
+
+
+def test_eth2_subnet_shape_isolation_and_delivery():
+    """BASELINE.json config-5 geometry at reduced N: 64 attestation-subnet
+    topics, 3 subscribed per validator (topic-slot compression keeps state
+    at [N,3,K], not [N,64,K]). Publishes must reach only subscribers, and
+    every subnet with enough members must deliver."""
+    n, n_topics, tpp = 256, 64, 3
+    topo = graph.random_connect(n, d=8, seed=4)
+    subs = graph.subscribe_random(n, n_topics=n_topics, topics_per_peer=tpp, seed=4)
+    net = Net.build(topo, subs)
+    assert net.n_slots == tpp  # compression, not dense topics
+    params = dataclasses.replace(GossipSubParams(), flood_publish=True)
+    # P3 deficit penalties off: attestation subnets here are quiet, and a
+    # live mesh-delivery threshold would (correctly) collapse every mesh
+    # as delivery-deficient — the reference's guidance is to disable the
+    # deficit terms on low-traffic topics
+    sp = PeerScoreParams(
+        topics={t: TopicScoreParams(mesh_message_deliveries_weight=0.0,
+                                    mesh_failure_penalty_weight=0.0)
+                for t in range(n_topics)},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
+    st = GossipSubState.init(net, 64, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+
+    sub_table = np.asarray(net.subscribed)
+    rng = np.random.default_rng(0)
+    topics = rng.choice(n_topics, size=6, replace=False)
+    origins, pts = [], []
+    for t in topics:
+        members = np.flatnonzero(sub_table[:, t])
+        assert len(members) > 1, f"subnet {t} too small for the test"
+        origins.append(int(members[0]))
+        pts.append(int(t))
+    # publish two per round
+    for i in range(0, 6, 2):
+        po = np.array(origins[i : i + 2] + [-1, -1], np.int32)
+        pt = np.array(pts[i : i + 2] + [-1, -1], np.int32)
+        pv = po >= 0
+        st = step(st, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+    # drain long enough for the farthest component paths (mesh grafting
+    # takes a heartbeat or two before push paths exist)
+    for _ in range(25):
+        st = step(st, *no_publish())
+
+    have = np.asarray(bitset.unpack(st.core.dlv.have, 64))
+    mtopic = np.asarray(st.core.msgs.topic)
+    nbr, ok = np.asarray(net.nbr), np.asarray(net.nbr_ok)
+
+    def reachable(origin, members):
+        # BFS over contact-graph edges between co-subscribed peers — the
+        # only paths a static topology offers (the reference grows more
+        # via discovery, which this test deliberately leaves out)
+        seen, frontier = {origin}, [origin]
+        while frontier:
+            u = frontier.pop()
+            for k in np.flatnonzero(ok[u]):
+                v = int(nbr[u, k])
+                if v in members and v not in seen:
+                    seen.add(v)
+                    frontier.append(v)
+        return seen
+
+    for t, o in zip(pts, origins):
+        slots = np.flatnonzero((mtopic == t) & (np.asarray(st.core.msgs.origin) >= 0))
+        assert len(slots) == 1, (t, slots)  # the publish must be resident
+        members = set(np.flatnonzero(sub_table[:, t]))
+        comp = reachable(o, members)
+        for s in slots:
+            holders = set(np.flatnonzero(have[:, s]))
+            # no leakage outside the subnet
+            assert holders <= members, (t, s)
+            # complete delivery within the origin's connected component
+            assert holders == comp, (t, sorted(comp - holders))
