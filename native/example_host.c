@@ -1,0 +1,156 @@
+/* Pure-C host for the PJRT bridge — the embedding shape a Go program
+ * would use via cgo (same C ABI; Go toolchain is not in this image, so C
+ * stands in as the proof).
+ *
+ * Usage:
+ *   example_host PLUGIN.so MODULE.mlirpb OPTIONS.pb [name:type:value ...]
+ *
+ * Loads a PJRT plugin, creates a client (options given as name:type:value
+ * triples; type s=string, i=int64, b=bool), compiles the serialized
+ * StableHLO module, feeds it a fixed f32[8] input, and prints the f32
+ * outputs — zero Python anywhere.
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* the pjx_* C ABI exported by libpjrt_bridge.so */
+extern void *pjx_load(const char *plugin_path, char *err, size_t errlen);
+extern void pjx_unload(void *h);
+extern void *pjx_client_create(void *h, const char **names, const int *types,
+                               const char **string_values,
+                               const int64_t *int_values, size_t nopts,
+                               char *err, size_t errlen);
+extern void pjx_client_destroy(void *h, void *client);
+extern void *pjx_compile(void *h, void *client, const char *code,
+                         size_t code_size, const char *format,
+                         const char *options, size_t options_size, char *err,
+                         size_t errlen);
+extern void pjx_executable_destroy(void *h, void *exe);
+extern void *pjx_buffer_from_host(void *h, void *client, const void *data,
+                                  int dtype, const int64_t *dims, size_t ndims,
+                                  char *err, size_t errlen);
+extern void pjx_buffer_destroy(void *h, void *buf);
+extern long pjx_buffer_to_host(void *h, void *buf, void *dst, size_t dst_size,
+                               char *err, size_t errlen);
+extern long pjx_execute(void *h, void *exe, void *const *inputs, size_t nin,
+                        void **outputs, size_t max_out, char *err,
+                        size_t errlen);
+
+#define ERRLEN 4096
+#define F32 11 /* PJRT_Buffer_Type_F32 */
+
+static char *read_file(const char *path, size_t *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = malloc(n > 0 ? (size_t)n : 1);
+  if (fread(buf, 1, (size_t)n, f) != (size_t)n) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  fclose(f);
+  *size = (size_t)n;
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s PLUGIN MODULE OPTIONS [name:type:value...]\n",
+            argv[0]);
+    return 2;
+  }
+  char err[ERRLEN] = {0};
+
+  size_t code_size = 0, opt_size = 0;
+  char *code = read_file(argv[2], &code_size);
+  char *opts = read_file(argv[3], &opt_size);
+  if (!code || !opts) {
+    fprintf(stderr, "cannot read module/options file\n");
+    return 2;
+  }
+
+  /* client options from name:type:value CLI triples */
+  size_t nopts = (size_t)(argc - 4);
+  const char **names = calloc(nopts ? nopts : 1, sizeof(char *));
+  int *types = calloc(nopts ? nopts : 1, sizeof(int));
+  const char **svals = calloc(nopts ? nopts : 1, sizeof(char *));
+  int64_t *ivals = calloc(nopts ? nopts : 1, sizeof(int64_t));
+  for (size_t i = 0; i < nopts; i++) {
+    char *spec = strdup(argv[4 + i]);
+    char *name = strtok(spec, ":");
+    char *type = strtok(NULL, ":");
+    char *val = strtok(NULL, "");
+    if (!name || !type || !val) {
+      fprintf(stderr, "bad option spec %s\n", argv[4 + i]);
+      return 2;
+    }
+    names[i] = name;
+    if (type[0] == 's') {
+      types[i] = 0;
+      svals[i] = val;
+    } else if (type[0] == 'i') {
+      types[i] = 1;
+      ivals[i] = atoll(val);
+    } else {
+      types[i] = 2;
+      ivals[i] = atoll(val);
+    }
+  }
+
+  void *h = pjx_load(argv[1], err, ERRLEN);
+  if (!h) {
+    fprintf(stderr, "load: %s\n", err);
+    return 1;
+  }
+  void *client =
+      pjx_client_create(h, names, types, svals, ivals, nopts, err, ERRLEN);
+  if (!client) {
+    fprintf(stderr, "client: %s\n", err);
+    return 1;
+  }
+  void *exe = pjx_compile(h, client, code, code_size, "mlir", opts, opt_size,
+                          err, ERRLEN);
+  if (!exe) {
+    fprintf(stderr, "compile: %s\n", err);
+    return 1;
+  }
+
+  float input[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+  int64_t dims[1] = {8};
+  void *in = pjx_buffer_from_host(h, client, input, F32, dims, 1, err, ERRLEN);
+  if (!in) {
+    fprintf(stderr, "buffer: %s\n", err);
+    return 1;
+  }
+
+  void *outs[8] = {0};
+  void *ins[1] = {in};
+  long nout = pjx_execute(h, exe, ins, 1, outs, 8, err, ERRLEN);
+  if (nout < 0) {
+    fprintf(stderr, "execute: %s\n", err);
+    return 1;
+  }
+  for (long i = 0; i < nout; i++) {
+    float out[8] = {0};
+    long n = pjx_buffer_to_host(h, outs[i], out, sizeof out, err, ERRLEN);
+    if (n < 0) {
+      fprintf(stderr, "to_host: %s\n", err);
+      return 1;
+    }
+    printf("out%ld:", i);
+    for (size_t j = 0; j < n / sizeof(float); j++) printf(" %g", out[j]);
+    printf("\n");
+    pjx_buffer_destroy(h, outs[i]);
+  }
+  pjx_buffer_destroy(h, in);
+  pjx_executable_destroy(h, exe);
+  pjx_client_destroy(h, client);
+  pjx_unload(h);
+  return 0;
+}

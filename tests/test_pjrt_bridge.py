@@ -179,3 +179,54 @@ def test_execute_full_gossipsub_step(client):
     ref_leaves[ki] = jax.random.key_data(ref_leaves[ki])
     for a, b in zip(outs, ref_leaves):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pure_c_host_executes_module(tmp_path):
+    """The Go-embedding proof, minus Go (not in this image): a pure-C
+    program (native/example_host.c) linked against the bridge library
+    compiles and executes an exported StableHLO module with no Python in
+    the process at all."""
+    import pathlib
+    import subprocess
+
+    import jax
+
+    from go_libp2p_pubsub_tpu.native.pjrt import (
+        axon_create_options,
+        default_compile_options,
+        default_plugin_path,
+    )
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    host = repo / "native" / "example_host"
+    if not host.exists():
+        rc = subprocess.run(["make", "-C", str(repo / "native"), "example_host"],
+                            capture_output=True, text=True)
+        if rc.returncode != 0:
+            pytest.skip(f"example_host not buildable: {rc.stderr[-200:]}")
+    plugin = default_plugin_path()
+    if plugin is None:
+        pytest.skip("no PJRT plugin on this machine")
+
+    def f(x):
+        return x * 2.0 + 1.0
+
+    exported = jax.export.export(jax.jit(f))(
+        jax.ShapeDtypeStruct((8,), np.float32)
+    )
+    mod = tmp_path / "m.mlirpb"
+    mod.write_bytes(exported.mlir_module_serialized)
+    opts = tmp_path / "opts.pb"
+    opts.write_bytes(default_compile_options())
+
+    args = [str(host), plugin, str(mod), str(opts)]
+    if "axon" in plugin:
+        for name, val in axon_create_options().items():
+            t = "s" if isinstance(val, str) else "i"
+            args.append(f"{name}:{t}:{val}")
+    rc = subprocess.run(args, capture_output=True, text=True, timeout=240)
+    if rc.returncode != 0 and "client:" in rc.stderr:
+        pytest.skip(f"PJRT client unavailable to C host: {rc.stderr[-150:]}")
+    assert rc.returncode == 0, rc.stderr[-400:]
+    # f([1..8]) = [3 5 7 9 11 13 15 17]
+    assert rc.stdout.strip().startswith("out0: 3 5 7 9 11 13 15 17"), rc.stdout
