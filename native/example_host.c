@@ -34,7 +34,7 @@ extern void *pjx_buffer_from_host(void *h, void *client, const void *data,
                                   char *err, size_t errlen);
 extern void pjx_buffer_destroy(void *h, void *buf);
 extern long pjx_buffer_to_host(void *h, void *buf, void *dst, size_t dst_size,
-                               char *err, size_t errlen);
+                               long row_major, char *err, size_t errlen);
 extern long pjx_execute(void *h, void *exe, void *const *inputs, size_t nin,
                         void **outputs, size_t max_out, char *err,
                         size_t errlen);
@@ -48,6 +48,10 @@ static char *read_file(const char *path, size_t *size) {
   fseek(f, 0, SEEK_END);
   long n = ftell(f);
   fseek(f, 0, SEEK_SET);
+  if (n < 0) { /* non-seekable input (FIFO, /dev/stdin) */
+    fclose(f);
+    return NULL;
+  }
   char *buf = malloc(n > 0 ? (size_t)n : 1);
   if (fread(buf, 1, (size_t)n, f) != (size_t)n) {
     fclose(f);
@@ -138,7 +142,7 @@ int main(int argc, char **argv) {
   }
   for (long i = 0; i < nout; i++) {
     float out[8] = {0};
-    long n = pjx_buffer_to_host(h, outs[i], out, sizeof out, err, ERRLEN);
+    long n = pjx_buffer_to_host(h, outs[i], out, sizeof out, 1, err, ERRLEN);
     if (n < 0) {
       fprintf(stderr, "to_host: %s\n", err);
       return 1;
