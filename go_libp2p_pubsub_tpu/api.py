@@ -544,13 +544,13 @@ class Network:
             )
             self._dynamic = True
         elif self.router == "randomsub":
-            self.state = SimState.init(n, self.msg_slots, self.seed)
+            self.state = SimState.init(n, self.msg_slots, self.seed, k=self.net.max_degree)
             self._step = make_randomsub_step(self.net)
             self._dynamic = False
         else:  # floodsub
             from .models.floodsub import floodsub_step
 
-            self.state = SimState.init(n, self.msg_slots, self.seed)
+            self.state = SimState.init(n, self.msg_slots, self.seed, k=self.net.max_degree)
 
             def _fstep(st, po, pt, pv, _net=self.net):
                 return floodsub_step(_net, st, po, pt, pv)

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_FORMAT_VERSION = 1
+# v2: Delivery.first_edge [N,M] i8 replaced by packed fe_words [N,K,W] u32
+_FORMAT_VERSION = 2
 
 
 def _is_key(leaf) -> bool:

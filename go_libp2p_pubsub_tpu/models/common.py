@@ -74,6 +74,7 @@ def delivery_round(
     edge_mask: jax.Array,  # [N, K, W] u32: words edge (j,k) may carry j-ward
     tick: jax.Array,
     forward_mask: jax.Array | None = None,  # [N, W] extra gate on what gets re-forwarded
+    count_events: bool = True,
 ) -> tuple[Delivery, RoundInfo]:
     """Advance one propagation round: transmit every sender's `fwd` set along
     permitted edges, dedup against the seen-cache, record first receipts.
@@ -90,6 +91,12 @@ def delivery_round(
     n, k_slots = net.nbr.shape
     m = msgs.capacity
 
+    assert dlv.fe_words.shape[1] == k_slots, (
+        "Delivery.fe_words edge axis does not match the topology's "
+        f"max_degree ({dlv.fe_words.shape[1]} != {k_slots}) — construct the "
+        "state with SimState.init(..., k=net.max_degree)"
+    )
+
     if USE_PALLAS and net.band_off is not None and forward_mask is None:
         from ..ops.pallas_delivery import pallas_supported
 
@@ -97,17 +104,18 @@ def delivery_round(
         if pallas_supported(net.band_off, n, block):
             interpret = os.environ.get("PUBSUB_PALLAS_COMPILE", "") != "1"
             return _delivery_round_pallas(
-                net, msgs, dlv, edge_mask, tick, block=block, interpret=interpret
+                net, msgs, dlv, edge_mask, tick, block=block,
+                interpret=interpret, count_events=count_events,
             )
 
     # what each sender is forwarding this round: [N, K, W] word gather
     fwd_gathered = net.peer_gather(dlv.fwd)
 
     # echo exclusion: sender s does not send m back on the edge it arrived
-    # on. Sender-side packed compare (fused, no [N,K,M] gather), then a
-    # word gather: echo[j,k] = "messages s first-received on its edge to j"
-    echo_out = bitset.edge_eq_words(dlv.first_edge, k_slots)   # [N,K,W] at sender
-    echo_words = net.edge_gather(echo_out)
+    # on. The packed first-arrival plane IS the sender-side echo set, so
+    # this is a plain word gather: echo[j,k] = "messages s first-received
+    # on its edge to j"
+    echo_words = net.edge_gather(dlv.fe_words)
 
     ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     not_mine = ~origin_msg_words(net, msgs)  # [N, W]
@@ -118,9 +126,9 @@ def delivery_round(
     new_words = recv_words & ~dlv.have
     new_bits = bitset.unpack(new_words, m)
 
-    # first-arrival edge: lowest edge slot carrying each new bit
-    arrival_edge = bitset.first_edge_of(trans, m)
-    first_edge = jnp.where(new_bits & (arrival_edge >= 0), arrival_edge, dlv.first_edge)
+    # first-arrival edge: lowest edge slot carrying each new bit, isolated
+    # in word algebra
+    fa_words = bitset.first_set_per_bit(trans, axis=1) & new_words[:, None, :]
     first_round = jnp.where(new_bits, tick, dlv.first_round)
 
     # forwarding: new receipts of valid messages (honest store-and-forward)
@@ -133,15 +141,30 @@ def delivery_round(
         have=dlv.have | new_words,
         fwd=fwd_next,
         first_round=first_round,
-        first_edge=first_edge,
+        # overwrite (not OR) on new receipts so stale bits can't survive a
+        # slot whose message is re-received after its fe column was cleared
+        fe_words=(dlv.fe_words & ~new_words[:, None, :]) | fa_words,
     )
 
-    return dlv, _round_info(trans, new_words, m, valid_words)
+    return dlv, _round_info(trans, new_words, m, valid_words, count_events)
 
 
-def _round_info(trans, new_words, m, valid_words) -> RoundInfo:
+def _round_info(trans, new_words, m, valid_words, count_events=True) -> RoundInfo:
     """Delivery observables from a round's transmit/new sets (shared by the
-    XLA and pallas paths so the trace-counter semantics stay single-source)."""
+    XLA and pallas paths so the trace-counter semantics stay single-source).
+
+    `count_events=False` (no EventTracer attached — tracing is opt-in in
+    the reference, pubsub.go WithEventTracer) skips the aggregate popcount
+    reductions; the per-message delivery state (first_round/first_edge,
+    the CDF source) is exact either way."""
+    if not count_events:
+        z = jnp.int32(0)
+        return RoundInfo(
+            trans=trans,
+            new_words=new_words,
+            new_bits=bitset.unpack(new_words, m),
+            n_deliver=z, n_reject=z, n_duplicate=z, n_rpc=z,
+        )
     n_rpc = bitset.popcount(trans, axis=None).astype(jnp.int32).sum()
     n_new = bitset.popcount(new_words, axis=None).astype(jnp.int32).sum()
     n_deliver = (
@@ -160,9 +183,11 @@ def _round_info(trans, new_words, m, valid_words) -> RoundInfo:
 
 
 def _delivery_round_pallas(net, msgs, dlv, edge_mask, tick, block=None,
-                           interpret=False):
+                           interpret=False, count_events=True):
     """Banded fast path: one fused kernel for the whole round (see
-    ops/pallas_delivery.py). Bit-identical to the generic path above."""
+    ops/pallas_delivery.py). Bit-identical to the generic path above.
+    The kernel speaks the [N, M] i8 first-edge form; the packed state is
+    converted at the boundary (this path is opt-in)."""
     from ..ops.pallas_delivery import delivery_round_banded
 
     n, k_slots = net.nbr.shape
@@ -171,16 +196,20 @@ def _delivery_round_pallas(net, msgs, dlv, edge_mask, tick, block=None,
     ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     emask_flat = (edge_mask & ok_words).reshape(n, k_slots * w)
     valid_words = bitset.pack(msgs.valid)
+    fe_i8 = bitset.first_edge_of(dlv.fe_words, m)
     trans, have2, fwd2, fr2, fe2 = delivery_round_banded(
-        dlv.fwd, dlv.first_edge, emask_flat, dlv.have, dlv.first_round,
+        dlv.fwd, fe_i8, emask_flat, dlv.have, dlv.first_round,
         msgs.origin, valid_words, tick,
         block=min(block or n, n), m=m,
         offsets=net.band_off, revs=net.band_rev,
         interpret=interpret,
     )
     new_words = have2 & ~dlv.have
-    dlv2 = dlv.replace(have=have2, fwd=fwd2, first_round=fr2, first_edge=fe2)
-    return dlv2, _round_info(trans, new_words, m, valid_words)
+    dlv2 = dlv.replace(
+        have=have2, fwd=fwd2, first_round=fr2,
+        fe_words=bitset.edge_eq_words(fe2, k_slots),
+    )
+    return dlv2, _round_info(trans, new_words, m, valid_words, count_events)
 
 
 def accumulate_round_events(events: jax.Array, info: RoundInfo, n_publish) -> jax.Array:

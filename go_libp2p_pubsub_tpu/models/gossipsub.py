@@ -106,6 +106,10 @@ class GossipSubConfig:
     # fanout (publishing to unjoined topics, gossipsub.go:981-1002,1517-1554)
     fanout_slots: int = 2         # concurrent unjoined publish topics/peer
     fanout_ttl_ticks: int = 60
+    # aggregate trace counters (EventTracer accounting). Tracing is opt-in
+    # in the reference (WithEventTracer); False skips the event popcount
+    # reductions — per-message delivery state stays exact
+    count_events: bool = True
     # thresholds (v1.1; zeros for v1.0)
     gossip_threshold: float = 0.0
     publish_threshold: float = 0.0
@@ -244,7 +248,7 @@ class GossipSubState:
         else:
             p6 = jnp.zeros((n, k), jnp.float32)
         return cls(
-            core=SimState.init(n, msg_slots, seed),
+            core=SimState.init(n, msg_slots, seed, k=k),
             mesh=jnp.zeros((n, s, k), bool),
             backoff_expire=jnp.zeros((n, s, k), jnp.int32),
             backoff_present=jnp.zeros((n, s, k), bool),
@@ -388,8 +392,11 @@ def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: d
         px_resp = rejected & ~rej_score
     else:
         px_resp = jnp.zeros_like(rejected)
-    n_graft = jnp.sum(accepted.astype(jnp.int32))
-    n_prune = jnp.sum(pruned.astype(jnp.int32))
+    if cfg.count_events:
+        n_graft = jnp.sum(accepted.astype(jnp.int32))
+        n_prune = jnp.sum(pruned.astype(jnp.int32))
+    else:
+        n_graft = n_prune = jnp.int32(0)
     return st, rejected, px_resp, px_ok, n_graft, n_prune
 
 
@@ -530,9 +537,11 @@ def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     graylist/gater.
 
     Sender-side packed outbox + word gather (no [N,K,M] traffic)."""
-    carry_out = sender_carry_words(st.mesh, slotw) | fanout_carry_words(
-        st.fanout_peers, st.fanout_topic, tw
-    )
+    carry_out = sender_carry_words(st.mesh, slotw)
+    if cfg.fanout_slots > 0:
+        carry_out = carry_out | fanout_carry_words(
+            st.fanout_peers, st.fanout_topic, tw
+        )
     mask = jnp.where(
         net.nbr_ok[:, :, None],
         net.edge_gather(carry_out),
@@ -638,7 +647,8 @@ def update_fanout_on_publish(
     )
 
 
-def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
+def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
+                   count_events: bool = True):
     """Fold IWANT-response transmissions (not part of senders' fwd sets)
     into the round's delivery results."""
     m = core.msgs.capacity
@@ -649,28 +659,31 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick):
     new_words = recv & ~dlv.have
     new_bits = bitset.unpack(new_words, m)
 
-    arrival_edge = bitset.first_edge_of(extra, m)
+    fa_words = bitset.first_set_per_bit(extra, axis=1) & new_words[:, None, :]
     valid_words = bitset.pack(core.msgs.valid)
 
     dlv = dlv.replace(
         have=dlv.have | new_words,
         fwd=dlv.fwd | (new_words & valid_words[None, :]),
-        first_edge=jnp.where(new_bits, arrival_edge, dlv.first_edge),
+        fe_words=(dlv.fe_words & ~new_words[:, None, :]) | fa_words,
         first_round=jnp.where(new_bits, tick, dlv.first_round),
     )
 
-    n_extra = bitset.popcount(extra, axis=-1).sum().astype(jnp.int32)
-    n_new = bitset.popcount(new_words, axis=-1).sum().astype(jnp.int32)
-    n_deliver = bitset.popcount(new_words & valid_words[None, :], axis=-1).sum().astype(jnp.int32)
     info = info.replace(
         trans=info.trans | extra,
         new_words=info.new_words | new_words,
         new_bits=info.new_bits | new_bits,
-        n_deliver=info.n_deliver + n_deliver,
-        n_reject=info.n_reject + (n_new - n_deliver),
-        n_duplicate=info.n_duplicate + (n_extra - n_new),
-        n_rpc=info.n_rpc + n_extra,
     )
+    if count_events:
+        n_extra = bitset.popcount(extra, axis=-1).sum().astype(jnp.int32)
+        n_new = bitset.popcount(new_words, axis=-1).sum().astype(jnp.int32)
+        n_deliver = bitset.popcount(new_words & valid_words[None, :], axis=-1).sum().astype(jnp.int32)
+        info = info.replace(
+            n_deliver=info.n_deliver + n_deliver,
+            n_reject=info.n_reject + (n_new - n_deliver),
+            n_duplicate=info.n_duplicate + (n_extra - n_new),
+            n_rpc=info.n_rpc + n_extra,
+        )
     return dlv, info
 
 
@@ -694,10 +707,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     events = st.core.events
 
     # applyIwantPenalties: broken promises -> P7 (gossipsub.go:1578-1583)
-    # (compare-reduce instead of a per-element gather: M is small)
-    have_bits = bitset.unpack(st.core.dlv.have, m)  # [N,M]
-    mid_eq = st.promise_mid[:, :, None] == jnp.arange(m, dtype=jnp.int32)[None, None, :]
-    promised_have = jnp.any(mid_eq & have_bits[:, None, :], axis=-1)  # [N,K]
+    # (one-hot word pick instead of an [N,K,M] compare-reduce)
+    promised_have = bitset.bit_get(st.core.dlv.have[:, None, :], st.promise_mid)
     live = st.promise_mid >= 0
     fulfilled = live & promised_have
     broken = live & ~promised_have & (tick > st.promise_expire)
@@ -749,10 +760,20 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     if cfg.score_enabled:
         cand = cand & (scores_b >= 0)
 
+    # Each maintenance sub-pass below is lax.cond-gated on "any row needs
+    # it": in a converged mesh the low-degree/over-subscription/quota cases
+    # are rare, and skipping their selection ranks most ticks is pure win
+    # (both branches produce identical results to the unconditional code —
+    # a selection with an all-zero need-vector is the empty mask).
+
     # |mesh| < Dlo -> graft to D (gossipsub.go:1371-1385)
     deg = count_true(mesh)
     ineed = jnp.where(deg < cfg.Dlo, cfg.D - deg, 0)
-    grafts = select_random_mask(k1, cand, ineed)
+    grafts = jax.lax.cond(
+        jnp.any(ineed > 0),
+        lambda: select_random_mask(k1, cand, ineed),
+        lambda: jnp.zeros_like(mesh),
+    )
     mesh = mesh | grafts
     tograft = tograft | grafts
 
@@ -760,20 +781,28 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     # (gossipsub.go:1388-1448)
     deg = count_true(mesh)
     over = (deg > cfg.Dhi)[:, :, None]
-    noise = jax.random.uniform(k2, mesh.shape)
-    if cfg.score_enabled:
-        topscore = select_topk_mask(scores_b, mesh, cfg.Dscore, key=k3)
-    else:
-        topscore = select_random_mask(k3, mesh, cfg.Dscore)
-    rest_rand = select_topk_mask(noise, mesh & ~topscore, cfg.D - cfg.Dscore)
-    keep = topscore | rest_rand
     outb = jnp.broadcast_to(net.outbound[:, None, :], mesh.shape)
-    x_need = jnp.maximum(cfg.Dout - count_true(keep & outb), 0)
-    bring = select_topk_mask(noise, mesh & outb & ~keep, x_need)
-    drop = select_topk_mask(-noise, keep & ~outb & ~topscore, count_true(bring))
-    keep = (keep & ~drop) | bring
-    pruned_over = mesh & ~keep & over
-    mesh = jnp.where(over, mesh & keep, mesh)
+
+    def _over_subscribed():
+        noise = jax.random.uniform(k2, mesh.shape)
+        if cfg.score_enabled:
+            topscore = select_topk_mask(scores_b, mesh, cfg.Dscore, key=k3)
+        else:
+            topscore = select_random_mask(k3, mesh, cfg.Dscore)
+        rest_rand = select_topk_mask(noise, mesh & ~topscore, cfg.D - cfg.Dscore)
+        keep = topscore | rest_rand
+        x_need = jnp.maximum(cfg.Dout - count_true(keep & outb), 0)
+        bring = select_topk_mask(noise, mesh & outb & ~keep, x_need)
+        drop = select_topk_mask(-noise, keep & ~outb & ~topscore, count_true(bring))
+        keep = (keep & ~drop) | bring
+        pruned_over = mesh & ~keep & over
+        return jnp.where(over, mesh & keep, mesh), pruned_over
+
+    mesh, pruned_over = jax.lax.cond(
+        jnp.any(over),
+        _over_subscribed,
+        lambda: (mesh, jnp.zeros_like(mesh)),
+    )
     toprune = toprune | pruned_over
     # over-subscription prunes carry PX; score-prunes (`bad` above) are
     # noPX (gossipsub.go:1365 vs :1446 — makePrune's doPX argument)
@@ -787,18 +816,28 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     need_out = jnp.where(
         deg >= cfg.Dlo, jnp.maximum(cfg.Dout - count_true(mesh & outb), 0), 0
     )
-    grafts2 = select_random_mask(k4, cand & outb & ~mesh, need_out)
+    grafts2 = jax.lax.cond(
+        jnp.any(need_out > 0),
+        lambda: select_random_mask(k4, cand & outb & ~mesh, need_out),
+        lambda: jnp.zeros_like(mesh),
+    )
     mesh = mesh | grafts2
     tograft = tograft | grafts2
 
     # opportunistic grafting (gossipsub.go:1479-1510)
     if cfg.score_enabled and cfg.opportunistic_graft_ticks > 0:
-        oppo = (tick % cfg.opportunistic_graft_ticks) == 0
-        med = median_masked(scores_b, mesh)  # [N,S]
-        low = oppo & (med < cfg.opportunistic_graft_threshold) & (count_true(mesh) > 1)
-        cand3 = cand & ~mesh & (scores_b > med[:, :, None])
-        grafts3 = select_random_mask(
-            k5, cand3, jnp.where(low, cfg.opportunistic_graft_peers, 0)
+        def _oppo_grafts():
+            med = median_masked(scores_b, mesh)  # [N,S]
+            low = (med < cfg.opportunistic_graft_threshold) & (count_true(mesh) > 1)
+            cand3 = cand & ~mesh & (scores_b > med[:, :, None])
+            return select_random_mask(
+                k5, cand3, jnp.where(low, cfg.opportunistic_graft_peers, 0)
+            )
+
+        grafts3 = jax.lax.cond(
+            (tick % cfg.opportunistic_graft_ticks) == 0,
+            _oppo_grafts,
+            lambda: jnp.zeros_like(mesh),
         )
         mesh = mesh | grafts3
         tograft = tograft | grafts3
@@ -899,10 +938,11 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         ok = net.nbr_ok if present_ok is None else present_ok
         edge_live = jnp.where(redial, edge_live | (direct_sym & ok), edge_live)
 
-    events = (
-        events.at[EV.GRAFT].add(jnp.sum(new_grafts.astype(jnp.int32)))
-        .at[EV.PRUNE].add(jnp.sum(toprune.astype(jnp.int32)))
-    )
+    if cfg.count_events:
+        events = (
+            events.at[EV.GRAFT].add(jnp.sum(new_grafts.astype(jnp.int32)))
+            .at[EV.PRUNE].add(jnp.sum(toprune.astype(jnp.int32)))
+        )
 
     return st.replace(
         core=st.core.replace(events=events),
@@ -960,7 +1000,7 @@ def apply_validation_throttle(dlv, info, cap: int, m: int, valid_words):
         have=dlv.have & ~refused,
         fwd=dlv.fwd & ~refused,
         first_round=jnp.where(refused_bits, -1, dlv.first_round),
-        first_edge=jnp.where(refused_bits, jnp.int8(-1), dlv.first_edge),
+        fe_words=dlv.fe_words & ~refused[:, None, :],
     )
     n_ref = n_throttled.sum().astype(jnp.int32)
     info = info.replace(
@@ -1065,13 +1105,17 @@ def make_gossipsub_step(
                 have=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.have),
                 fwd=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.fwd),
                 first_round=jnp.where(down_tr[:, None], -1, st.core.dlv.first_round),
-                first_edge=jnp.where(down_tr[:, None], jnp.int8(-1), st.core.dlv.first_edge),
+                fe_words=jnp.where(
+                    down_tr[:, None, None], jnp.uint32(0), st.core.dlv.fe_words
+                ),
             )
-            ev0 = (
-                st.core.events
-                .at[EV.REMOVE_PEER].add(jnp.sum(down_tr.astype(jnp.int32)))
-                .at[EV.ADD_PEER].add(jnp.sum(up_tr.astype(jnp.int32)))
-            )
+            ev0 = st.core.events
+            if cfg.count_events:
+                ev0 = (
+                    ev0
+                    .at[EV.REMOVE_PEER].add(jnp.sum(down_tr.astype(jnp.int32)))
+                    .at[EV.ADD_PEER].add(jnp.sum(up_tr.astype(jnp.int32)))
+                )
             st = st.replace(
                 core=st.core.replace(dlv=dlv0, events=ev0),
                 mcache=jnp.where(down_tr[:, None, None], jnp.uint32(0), st.mcache),
@@ -1175,7 +1219,9 @@ def make_gossipsub_step(
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
             cfg, net_l, st, tp, acc_ok, graft_in_raw, prune_in_raw, px_in_raw
         )
-        events = st.core.events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
+        events = st.core.events
+        if cfg.count_events:
+            events = events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
 
         # 1b. PX connect (pxConnect gossipsub.go:861-941): a peer pruned
         # with PX activates its dormant provisioned edges to peers the
@@ -1229,9 +1275,13 @@ def make_gossipsub_step(
         if sender_fwd_ok is not None:
             edge_mask = jnp.where(sender_fwd_ok[:, :, None], edge_mask, jnp.uint32(0))
             iwant_resp = jnp.where(sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0))
-        dlv, info = delivery_round(net_l, core.msgs, core.dlv, edge_mask, tick)
+        dlv, info = delivery_round(
+            net_l, core.msgs, core.dlv, edge_mask, tick,
+            count_events=cfg.count_events,
+        )
         iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
-        dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick)
+        dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick,
+                                   count_events=cfg.count_events)
 
         # 4b. validation front-end throttle (validation.go:230-244)
         valid_words_all = bitset.pack(core.msgs.valid)
@@ -1248,7 +1298,7 @@ def make_gossipsub_step(
         if cfg.score_enabled:
             score = on_deliveries(
                 score, net_l, st2.mesh, tp, info.trans, info.new_words,
-                dlv.first_edge, dlv.first_round,
+                dlv.fe_words, dlv.first_round,
                 core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
             )
 
@@ -1256,7 +1306,7 @@ def make_gossipsub_step(
         # peer_gater.go:365-443)
         gater_state = st2.gater
         if cfg.gater_enabled:
-            fe_words_post = bitset.edge_eq_words(dlv.first_edge, net_l.max_degree)
+            fe_words_post = dlv.fe_words
             first_arrival = (
                 info.trans & fe_words_post & accepted_new[:, None, :]
                 & valid_words_all[None, None, :]
@@ -1291,9 +1341,8 @@ def make_gossipsub_step(
         iwant_out = st2.iwant_out & keep_words[None, None, :]
         served_lo = st2.served_lo & keep_words[None, None, :]
         served_hi = st2.served_hi & keep_words[None, None, :]
-        reused_bits = bitset.unpack(~keep_words, m)  # [M]
-        mid_eq = st2.promise_mid[:, :, None] == jnp.arange(m, dtype=jnp.int32)[None, None, :]
-        promise_reused = jnp.any(mid_eq & reused_bits[None, None, :], axis=-1)
+        # one-hot word pick instead of an [N,K,M] compare-reduce
+        promise_reused = bitset.bit_get((~keep_words)[None, None, :], st2.promise_mid)
         promise_mid = jnp.where(
             (st2.promise_mid >= 0) & promise_reused, -1, st2.promise_mid
         )
@@ -1306,7 +1355,10 @@ def make_gossipsub_step(
                 nbr_sub_words_l,
             )
 
-        events = accumulate_round_events(events, info, jnp.sum(is_pub.astype(jnp.int32)))
+        if cfg.count_events:
+            events = accumulate_round_events(
+                events, info, jnp.sum(is_pub.astype(jnp.int32))
+            )
         st2 = st2.replace(
             core=core.replace(msgs=msgs, dlv=dlv, events=events),
             mcache=mcache,

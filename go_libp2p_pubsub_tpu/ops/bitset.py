@@ -86,16 +86,43 @@ def popcount(words: jax.Array, axis=None) -> jax.Array:
 
 def lowest_bit(words: jax.Array) -> tuple[jax.Array, jax.Array]:
     """(index, any): index of the lowest set bit along the packed last axis
-    (0 when empty — check `any`). Word-arithmetic only; no unpack."""
-    w = words.shape[-1]
+    (0 when empty — check `any`). Word-arithmetic only; no unpack. The
+    first nonzero word is isolated with a cumsum mask — argmax lowers to a
+    variadic reduce that profiled several times slower at N=100k."""
     nonzero = words != 0
     any_set = jnp.any(nonzero, axis=-1)
-    first_w = jnp.argmax(nonzero, axis=-1)  # first nonzero word
-    word = take_word(words, first_w)
+    csum = jnp.cumsum(nonzero.astype(jnp.int32), axis=-1)
+    firstmask = nonzero & (csum == 1)
+    word = jnp.sum(jnp.where(firstmask, words, jnp.uint32(0)), axis=-1,
+                   dtype=jnp.uint32)
+    widx = jnp.sum(
+        jnp.where(firstmask, jnp.arange(words.shape[-1], dtype=jnp.int32), 0),
+        axis=-1, dtype=jnp.int32,
+    )
     # lowest set bit position within the word: popcount((w-1) & ~w)
     lsb = jax.lax.population_count((word - 1) & ~word)
-    idx = first_w.astype(jnp.int32) * WORD + lsb.astype(jnp.int32)
+    idx = widx * WORD + lsb.astype(jnp.int32)
     return jnp.where(any_set, idx, 0), any_set
+
+
+def first_set_per_bit(words: jax.Array, axis: int = 1) -> jax.Array:
+    """Isolate, per bit, the lowest index along `axis` whose word carries
+    it: out has exactly the bits of `words` that are each bit's first
+    occurrence along the axis. The word-algebra way to find "the lowest
+    edge slot carrying each message" without unpacking to [N,K,M].
+
+    A static K-step accumulator chain of word-sized elementwise ops — a
+    log-depth shift tree of concatenates profiled ~5x slower at N=100k
+    (each concat materializes the full [N,K,W] tensor; this formulation
+    reads `words` once and fuses)."""
+    k = words.shape[axis]
+    acc = jnp.zeros_like(jnp.take(words, 0, axis=axis))
+    outs = []
+    for kk in range(k):
+        wk = jnp.take(words, kk, axis=axis)
+        outs.append(wk & ~acc)
+        acc = acc | wk
+    return jnp.stack(outs, axis=axis)
 
 
 def edge_eq_words(first_edge: jax.Array, k_dim: int) -> jax.Array:

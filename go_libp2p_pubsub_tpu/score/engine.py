@@ -312,7 +312,7 @@ def on_deliveries(
     tp: dict,
     trans_words: jax.Array,   # [N,K,W] u32 — this round's per-edge receipts
     new_words: jax.Array,     # [N,W] u32 — first receipts this round
-    first_edge: jax.Array,    # [N,M] i8 — arrival edge of the first copy
+    fe_words: jax.Array,      # [N,K,W] u32 — packed first-arrival edge plane
     first_round: jax.Array,   # [N,M] i32 — validation round of each msg
     msg_topic: jax.Array,     # [M] i32
     msg_valid: jax.Array,     # [M] bool
@@ -336,7 +336,6 @@ def on_deliveries(
     popcounts of word-AND — no [N,K,M] gathers, casts, or einsums in the
     hot path."""
     n, s_slots = net.my_topics.shape
-    k_dim = net.nbr.shape[1]
     m = msg_topic.shape[0]
     t = jnp.clip(msg_topic, 0)
 
@@ -352,7 +351,6 @@ def on_deliveries(
     valid_w = bitset.pack(msg_valid)  # [W]
 
     # -- P2/P3 credit for valid messages ------------------------------------
-    fe_words = bitset.edge_eq_words(first_edge, k_dim)  # [N,K,W]
     first_arrival = trans_words & fe_words & new_words[:, None, :] & valid_w[None, None, :]
     fmd_inc = per_slot_counts(first_arrival)
     e = lambda a: a[..., None]

@@ -153,23 +153,35 @@ class Delivery:
                   accepted for forwarding, or own publishes)
     first_round — round of first receipt, -1 never (propagation CDF +
                   delivery-window attribution)
-    first_edge  — neighbor slot the first copy arrived on, -1 = published
-                  locally (the "source" exclusion, floodsub.go:85-88)
+    fe_words    — first-arrival edge, stored packed: bit m of row (n, k)
+                  set iff the first copy of message m arrived at n on edge
+                  k; no bit on any edge = published locally / never
+                  received (the "source" exclusion, floodsub.go:85-88).
+                  Packed storage keeps echo suppression and delivery
+                  attribution in word algebra; the [N, M] edge-index form
+                  is the derived `first_edge` property (host/trace/test
+                  consumers — deriving it unpacks to [N,K,M]).
     """
 
     have: jax.Array         # [N, W] u32
     fwd: jax.Array          # [N, W] u32
     first_round: jax.Array  # [N, M] i32
-    first_edge: jax.Array   # [N, M] i8
+    fe_words: jax.Array     # [N, K, W] u32
+
+    @property
+    def first_edge(self) -> jax.Array:
+        """[N, M] i8: first-arrival edge slot per message, -1 when none
+        (local publish or never received)."""
+        return bitset.first_edge_of(self.fe_words, self.first_round.shape[-1])
 
     @classmethod
-    def empty(cls, n: int, m: int) -> "Delivery":
+    def empty(cls, n: int, m: int, k: int = 0) -> "Delivery":
         w = bitset.n_words(m)
         return cls(
             have=jnp.zeros((n, w), jnp.uint32),
             fwd=jnp.zeros((n, w), jnp.uint32),
             first_round=jnp.full((n, m), -1, jnp.int32),
-            first_edge=jnp.full((n, m), -1, jnp.int8),
+            fe_words=jnp.zeros((n, k, w), jnp.uint32),
         )
 
 
@@ -184,12 +196,15 @@ class SimState:
     events: jax.Array    # [N_EVENTS] i64 cumulative trace counters
 
     @classmethod
-    def init(cls, n_peers: int, msg_slots: int, seed: int = 0) -> "SimState":
+    def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0) -> "SimState":
+        """`k` is the topology's padded max degree (net.max_degree) — it
+        sizes the packed first-arrival-edge plane. k=0 is only for states
+        that never enter a delivery round (e.g. checkpoint plumbing)."""
         return cls(
             tick=jnp.int32(0),
             key=jax.random.key(seed),
             msgs=MsgTable.empty(msg_slots),
-            dlv=Delivery.empty(n_peers, msg_slots),
+            dlv=Delivery.empty(n_peers, msg_slots, k),
             events=zero_counters(),
         )
 
@@ -221,7 +236,7 @@ def allocate_publishes(
     # scatter index M (out of bounds, mode=drop) for padding entries
     sidx = jnp.where(is_pub, slots, m)
 
-    # clear recycled slots: bit columns in have/fwd, rows in first_round/edge
+    # clear recycled slots: bit columns in have/fwd/fe, rows in first_round
     reused = jnp.zeros((m,), bool).at[sidx].set(True, mode="drop")
     reused_words = bitset.pack(reused)
     keep = ~reused_words
@@ -229,7 +244,7 @@ def allocate_publishes(
         have=dlv.have & keep[None, :],
         fwd=dlv.fwd & keep[None, :],
         first_round=jnp.where(reused[None, :], -1, dlv.first_round),
-        first_edge=jnp.where(reused[None, :], jnp.int8(-1), dlv.first_edge),
+        fe_words=dlv.fe_words & keep[None, None, :],
     )
 
     msgs = msgs.replace(

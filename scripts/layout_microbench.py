@@ -1,0 +1,104 @@
+"""Micro-benchmark: carry-layout conventions for the scanned step.
+
+Three formulations of the same loop body (a representative mix of the
+step's hot ops: roll-gather across the edge involution, elementwise score
+update, pairwise rank, popcount reduce) over a [N,K]-shaped state:
+
+  A. row-major carry [N,K] (the current convention),
+  B. transposed storage [K,N] with jnp.transpose at body entry/exit
+     (compute code unchanged — tests whether XLA turns the transposes
+     into free layout assignments),
+  C. native [K,N] compute (the full-refactor endpoint).
+
+Usage: python scripts/layout_microbench.py [N] [ITERS]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    k = 16
+    offs = tuple(int(o) for o in list(range(1, 9)) + [n - o for o in range(1, 9)])
+
+    def body_nk(scores, counters, words):
+        # peer_gather (banded rolls): [N,K]
+        g = jnp.stack([jnp.roll(scores[:, r % k], -o, axis=0) for r, o in enumerate(offs)], axis=1)
+        counters = counters * 0.95 + (g > 0).astype(jnp.float32)
+        vals = counters + g
+        # pairwise rank over K
+        outranks = (vals[:, None, :] > vals[:, :, None])
+        rank = jnp.sum(outranks, axis=-1).astype(jnp.int32)
+        sel = rank < 4
+        # popcount-ish reduce over packed words
+        w = words ^ jax.lax.shift_right_logical(words, jnp.uint32(1))
+        tot = jnp.sum(w & jnp.uint32(0x55555555), dtype=jnp.uint32)
+        scores = jnp.where(sel, vals, scores * 0.9) + (tot.astype(jnp.float32) * 1e-30)
+        words = words + jnp.uint32(1)
+        return scores, counters, words
+
+    def body_kn(scores, counters, words):
+        # same math, [K,N] layout: rolls along the minor axis
+        g = jnp.stack([jnp.roll(scores[r % k], -o, axis=0) for r, o in enumerate(offs)], axis=0)
+        counters = counters * 0.95 + (g > 0).astype(jnp.float32)
+        vals = counters + g
+        outranks = (vals[None, :, :] > vals[:, None, :])
+        rank = jnp.sum(outranks, axis=1).astype(jnp.int32)
+        sel = rank < 4
+        w = words ^ jax.lax.shift_right_logical(words, jnp.uint32(1))
+        tot = jnp.sum(w & jnp.uint32(0x55555555), dtype=jnp.uint32)
+        scores = jnp.where(sel, vals, scores * 0.9) + (tot.astype(jnp.float32) * 1e-30)
+        words = words + jnp.uint32(1)
+        return scores, counters, words
+
+    def scan_a(state):
+        def f(c, _):
+            return body_nk(*c), None
+        c, _ = jax.lax.scan(f, state, None, length=iters)
+        return c
+
+    def scan_b(state):
+        def f(c, _):
+            s, cn, w = c
+            s2, cn2, w2 = body_nk(s.T, cn.T, w.T)
+            return (s2.T, cn2.T, w2.T), None
+        c, _ = jax.lax.scan(f, state, None, length=iters)
+        return c
+
+    def scan_c(state):
+        def f(c, _):
+            return body_kn(*c), None
+        c, _ = jax.lax.scan(f, state, None, length=iters)
+        return c
+
+    rng = np.random.default_rng(0)
+    s0 = jnp.asarray(rng.standard_normal((n, k)).astype(np.float32))
+    c0 = jnp.asarray(rng.standard_normal((n, k)).astype(np.float32))
+    w0 = jnp.asarray(rng.integers(0, 2**32, size=(n, 2), dtype=np.uint64).astype(np.uint32))
+
+    for name, fn, st in [
+        ("A row-major [N,K] carry", scan_a, (s0, c0, w0)),
+        ("B [K,N] storage + transposed body", scan_b, (s0.T, c0.T, w0.T)),
+        ("C native [K,N] compute", scan_c, (s0.T, c0.T, w0.T)),
+    ]:
+        run = jax.jit(fn)
+        out = run(st)
+        _ = float(jnp.sum(out[0]))  # honest completion barrier (see bench.py)
+        t0 = time.perf_counter()
+        out = run(st)
+        _ = float(jnp.sum(out[0]))
+        dt = (time.perf_counter() - t0) / iters
+        print(f"{name:36s} {dt * 1e6:9.1f} us/iter")
+
+
+if __name__ == "__main__":
+    main()

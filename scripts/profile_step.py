@@ -1,0 +1,153 @@
+"""Ablation profiler: time the GossipSub step's sub-computations separately
+on the current default JAX platform (the real chip under the driver; CPU
+with JAX_PLATFORMS=cpu elsewhere).
+
+Each phase is jitted on its own so the wall split is attributable; numbers
+won't add exactly to the fused step (XLA fuses across phases there) but
+they rank the hot spots, which is what perf work needs.
+
+Usage: python scripts/profile_step.py [N] [ROUNDS]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreParams,
+        PeerScoreThresholds,
+        TopicScoreParams,
+    )
+    from go_libp2p_pubsub_tpu.models import common
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        TopicParamsArrays,
+        gather_nbr_subscribed,
+        gossip_edge_mask,
+        heartbeat,
+        joined_msg_words,
+        make_gossipsub_step,
+        no_publish,
+        slot_topic_words,
+        topic_msg_words,
+    )
+    from go_libp2p_pubsub_tpu.ops import bitset, edges
+    from go_libp2p_pubsub_tpu.score.engine import compute_scores, refresh_scores
+    from go_libp2p_pubsub_tpu.state import Net
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    m = 64
+
+    topo = graph.ring_lattice(n, d=8)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
+    tp0 = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0, mesh_failure_penalty_weight=0.0
+    )
+    sp = PeerScoreParams(
+        topics={0: tp0},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
+    st = GossipSubState.init(net, m, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+
+    tpa = TopicParamsArrays.build(sp, net.n_topics, 1.0)
+    tp = tpa.gather(net.my_topics)
+    nbr_sub = gather_nbr_subscribed(net)
+    subscribed_words_t = bitset.pack(net.subscribed)
+    nbr_sub_words = jnp.where(
+        net.nbr_ok[:, :, None],
+        subscribed_words_t[jnp.clip(net.nbr, 0)],
+        jnp.uint32(0),
+    )
+
+    po, pt, pv = no_publish(4)
+    po = po.at[0].set(0)
+    pt = pt.at[0].set(0)
+    pv = pv.at[0].set(True)
+
+    def timeit(name, fn, *args, iters=rounds):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        print(f"{name:34s} {dt * 1e3:8.3f} ms")
+        return out
+
+    # warm state: run a few full steps (not donated here)
+    step_nodonate = jax.jit(lambda s, a, b, c: step(s, a, b, c))
+    for _ in range(3):
+        st = step_nodonate(st, po, pt, pv)
+    jax.block_until_ready(st)
+
+    print(f"platform={jax.devices()[0].platform} n={n} m={m} rounds={rounds}")
+    timeit("full step", step_nodonate, st, po, pt, pv)
+
+    # --- phases --------------------------------------------------------
+    @jax.jit
+    def phase_wire(s):
+        parts = [
+            edges.topic_pack(s.graft_out, net.my_topics, net.n_topics),
+            edges.topic_pack(s.prune_out, net.my_topics, net.n_topics),
+            s.ihave_out,
+            jax.lax.bitcast_convert_type(s.scores, jnp.uint32)[..., None],
+        ]
+        wire = net.edge_gather(jnp.concatenate(parts, axis=-1))
+        return jnp.where(net.nbr_ok[:, :, None], wire, jnp.uint32(0))
+
+    timeit("wire exchange (merged gather)", phase_wire, st)
+
+    @jax.jit
+    def phase_delivery(s):
+        core = s.core
+        joined_words = joined_msg_words(net, core.msgs)
+        slotw = slot_topic_words(net, core.msgs.topic)
+        tw = topic_msg_words(core.msgs.topic, net.n_topics)
+        flood_edges = jnp.zeros_like(net.nbr_ok)
+        emask = gossip_edge_mask(
+            cfg, net, s, joined_words, net.nbr_ok, slotw, tw, flood_edges,
+            s.scores,
+        )
+        return common.delivery_round(net, core.msgs, core.dlv, emask, core.tick)
+
+    timeit("edge mask + delivery round", phase_delivery, st)
+
+    @jax.jit
+    def phase_scores(s):
+        sc = refresh_scores(s.score, s.mesh, s.core.tick, tp, sp)
+        return compute_scores(sc, s.mesh, tp, sp, s.p6, s.app_score, net)
+
+    timeit("refresh+compute scores", phase_scores, st)
+
+    @jax.jit
+    def phase_heartbeat(s):
+        return heartbeat(cfg, net, s, tp, sp, nbr_sub, None, nbr_sub_words,
+                         present_ok=net.nbr_ok)
+
+    timeit("heartbeat (full)", phase_heartbeat, st)
+
+
+if __name__ == "__main__":
+    main()

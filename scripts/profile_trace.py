@@ -1,0 +1,105 @@
+"""Device-op profile of the scanned GossipSub step (bench configuration).
+
+Captures a jax.profiler trace of one scanned segment and prints the top HLO
+ops by self time — the attribution the ablation timer can't give on the
+tunneled platform (per-call dispatch RTT swamps isolated-phase timings).
+
+Usage: python scripts/profile_trace.py [N] [ROUNDS]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import sys
+
+import numpy as np
+
+
+def build(n_peers: int, msg_slots: int):
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreParams,
+        PeerScoreThresholds,
+        TopicScoreParams,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    topo = graph.ring_lattice(n_peers, d=8)
+    subs = graph.subscribe_all(n_peers, 1)
+    net = Net.build(topo, subs)
+    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0, mesh_failure_penalty_weight=0.0
+    )
+    sp = PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
+    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    return st, step
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    st, step = build(n, 64)
+
+    rng = np.random.default_rng(0)
+    po = jnp.asarray(rng.integers(0, n, size=(rounds, 4)).astype(np.int32))
+    pt = jnp.asarray(np.zeros((rounds, 4), np.int32))
+    pv = jnp.asarray(np.ones((rounds, 4), bool))
+
+    def run_seg(s):
+        def body(carry, xs):
+            return step(carry, *xs), None
+        s, _ = jax.lax.scan(body, s, (po, pt, pv))
+        return s
+
+    run = jax.jit(run_seg, donate_argnums=0)
+    st = run(st)
+    jax.block_until_ready(st)
+
+    logdir = "/tmp/pubsub_prof"
+    os.system(f"rm -rf {logdir}")
+    with jax.profiler.trace(logdir):
+        st = run(st)
+        jax.block_until_ready(st)
+
+    # ---- summarize: top ops by self time -------------------------------
+    paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    print("xplane:", paths)
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+
+    data, _ = raw_to_tool_data.xspace_to_tool_data(paths, "hlo_stats", {})
+    import json
+
+    tbl = json.loads(data) if isinstance(data, (str, bytes)) else data
+    # hlo_stats returns {..., "data": rows} gviz-ish; dump the first rows
+    out_path = "/tmp/pubsub_prof/hlo_stats.json"
+    with open(out_path, "w") as f:
+        f.write(data if isinstance(data, str) else str(data))
+    print("wrote", out_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,19 +70,19 @@ def test_roundtrip_resume_equals_uninterrupted(tmp_path):
 
 
 def test_simstate_roundtrip(tmp_path):
-    st = SimState.init(8, 16, seed=7)
+    st = SimState.init(8, 16, seed=7, k=4)
     path = str(tmp_path / "sim.npz")
     checkpoint.save(path, st)
-    back = checkpoint.restore(path, SimState.init(8, 16, seed=0))
+    back = checkpoint.restore(path, SimState.init(8, 16, seed=0, k=4))
     _assert_tree_equal(st, back)
 
 
 def test_restore_shape_mismatch_rejected(tmp_path):
-    st = SimState.init(8, 16, seed=0)
+    st = SimState.init(8, 16, seed=0, k=4)
     path = str(tmp_path / "sim.npz")
     checkpoint.save(path, st)
     with pytest.raises(ValueError):
-        checkpoint.restore(path, SimState.init(16, 16, seed=0))
+        checkpoint.restore(path, SimState.init(16, 16, seed=0, k=4))
 
 
 def test_restore_structure_mismatch_rejected(tmp_path):
@@ -90,15 +90,15 @@ def test_restore_structure_mismatch_rejected(tmp_path):
     path = str(tmp_path / "gs.npz")
     checkpoint.save(path, st)
     with pytest.raises(ValueError):
-        checkpoint.restore(path, SimState.init(16, 32, seed=0))
+        checkpoint.restore(path, SimState.init(16, 32, seed=0, k=4))
 
 
 def test_orbax_roundtrip(tmp_path):
     pytest.importorskip("orbax.checkpoint")
-    st = SimState.init(8, 16, seed=3)
+    st = SimState.init(8, 16, seed=3, k=4)
     path = str(tmp_path / "orbax_ckpt")
     checkpoint.save_orbax(path, st)
-    back = checkpoint.restore_orbax(path, SimState.init(8, 16, seed=0))
+    back = checkpoint.restore_orbax(path, SimState.init(8, 16, seed=0, k=4))
     _assert_tree_equal(st, back)
 
 
@@ -106,13 +106,13 @@ def test_restore_rejects_non_checkpoint_npz(tmp_path):
     path = str(tmp_path / "plain.npz")
     np.savez(path, a=np.zeros(3))
     with pytest.raises(ValueError):
-        checkpoint.restore(path, SimState.init(4, 16, seed=0))
+        checkpoint.restore(path, SimState.init(4, 16, seed=0, k=4))
 
 
 def test_orbax_restore_shape_mismatch_rejected(tmp_path):
     pytest.importorskip("orbax.checkpoint")
-    st = SimState.init(8, 16, seed=3)
+    st = SimState.init(8, 16, seed=3, k=4)
     path = str(tmp_path / "orbax_bad")
     checkpoint.save_orbax(path, st)
     with pytest.raises(ValueError):
-        checkpoint.restore_orbax(path, SimState.init(16, 16, seed=0))
+        checkpoint.restore_orbax(path, SimState.init(16, 16, seed=0, k=4))

@@ -80,7 +80,7 @@ def test_tag_tracer_bumps_first_delivery_edge():
     from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
 
     net = _net(n=10, d=4)
-    st = SimState.init(net.n_peers, 32, seed=0)
+    st = SimState.init(net.n_peers, 32, seed=0, k=net.max_degree)
     tracer = connmgr.TagTracer(net)
 
     po = np.full(4, -1, np.int32); po[0] = 0

@@ -24,7 +24,7 @@ def _mk(n, d=None, n_topics=1, msg_slots=32, seed=0, all_topics=True):
         else graph.subscribe_random(n, n_topics, 1, seed=seed)
     )
     net = Net.build(topo, subs)
-    state = SimState.init(n, msg_slots, seed=seed)
+    state = SimState.init(n, msg_slots, seed=seed, k=net.max_degree)
     return topo, subs, net, state
 
 
@@ -90,7 +90,7 @@ def test_topic_isolation():
     topo = graph.random_connect(n, 4, seed=5)
     subs = graph.subscribe_random(n, n_topics=2, topics_per_peer=1, seed=5)
     net = Net.build(topo, subs)
-    state = SimState.init(n, 32, seed=0)
+    state = SimState.init(n, 32, seed=0, k=net.max_degree)
     origin = int(np.nonzero(subs.subscribed[:, 0])[0][0])
     state = floodsub_step(net, state, *_pub([origin], [0], [True]))
     state = run_rounds(net, state, 10)
@@ -103,7 +103,7 @@ def _run_oracle_equivalence(n, d, n_topics, msg_slots, schedule, seed):
     topo = graph.random_connect(n, d, seed=seed)
     subs = graph.subscribe_random(n, n_topics, max(1, n_topics // 2), seed=seed)
     net = Net.build(topo, subs)
-    state = SimState.init(n, msg_slots, seed=seed)
+    state = SimState.init(n, msg_slots, seed=seed, k=net.max_degree)
     oracle = OracleFloodSub(topo, subs, msg_slots=msg_slots)
 
     for pubs in schedule:
@@ -156,7 +156,7 @@ def test_hops_cdf_vs_oracle():
     topo = graph.random_connect(n, 3, seed=9)
     subs = graph.subscribe_all(n, 1)
     net = Net.build(topo, subs)
-    state = SimState.init(n, 32, seed=0)
+    state = SimState.init(n, 32, seed=0, k=net.max_degree)
     oracle = OracleFloodSub(topo, subs, msg_slots=32)
     pubs0 = [(5, 0, True)]
     state = floodsub_step(net, state, *_pub(*zip(*pubs0)))

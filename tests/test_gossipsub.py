@@ -213,3 +213,38 @@ def test_graft_prune_events_traced():
     # over-subscription pruning should have fired somewhere
     deg = np.asarray(st.mesh.sum(axis=(1, 2)))
     assert (deg <= cfg.Dhi).all()
+
+
+def test_count_events_off_identical_protocol_state():
+    """Tracer-detached mode (count_events=False) must change nothing but
+    the aggregate counters — every protocol-visible array stays identical
+    (tracing is opt-in in the reference: WithEventTracer, pubsub.go)."""
+    import jax
+
+    cfg_on = GossipSubConfig.build()
+    cfg_off = dataclasses.replace(cfg_on, count_events=False)
+    topo = graph.random_connect(40, 8, seed=9)
+    subs = graph.subscribe_all(40, 1)
+    net = Net.build(topo, subs)
+    states = {}
+    for name, cfg in [("on", cfg_on), ("off", cfg_off)]:
+        st = GossipSubState.init(net, 32, cfg, seed=1)
+        step = make_gossipsub_step(cfg, net)
+        for r in range(12):
+            st = step(st, *pub([r % 40], [0]))
+        states[name] = st
+    a, b = states["on"], states["off"]
+    la_all = dict(
+        (jax.tree_util.keystr(p), l) for p, l in jax.tree_util.tree_leaves_with_path(a)
+    )
+    lb_all = dict(
+        (jax.tree_util.keystr(p), l) for p, l in jax.tree_util.tree_leaves_with_path(b)
+    )
+    assert la_all.keys() == lb_all.keys()
+    for name in la_all:
+        if "events" in name or "key" in name:
+            continue
+        assert (np.asarray(la_all[name]) == np.asarray(lb_all[name])).all(), name
+    # counters-off leaves the event array untouched
+    assert (np.asarray(b.core.events) == 0).all()
+    assert int(np.asarray(a.core.events)[EV.DELIVER_MESSAGE]) > 0

@@ -144,3 +144,47 @@ def test_first_edge_of_matches_scan_incl_k128():
     trans[0, 127, 0] = 0b1000
     got = np.asarray(bitset.first_edge_of(jnp.asarray(trans), 4))
     assert got[0, 3] == 127 and (got[0, :3] == -1).all()
+
+
+def test_first_set_per_bit_matches_naive():
+    from go_libp2p_pubsub_tpu.ops import bitset
+
+    rng = np.random.default_rng(13)
+    for n, k, w in [(5, 16, 2), (3, 7, 1), (2, 1, 3)]:
+        words = rng.integers(0, 2**32, size=(n, k, w), dtype=np.uint64).astype(
+            np.uint32
+        )
+        got = np.asarray(bitset.first_set_per_bit(jnp.asarray(words), axis=1))
+        # naive: for each bit, keep it only on the lowest k that has it
+        seen = np.zeros((n, w), np.uint32)
+        want = np.zeros_like(words)
+        for kk in range(k):
+            want[:, kk] = words[:, kk] & ~seen
+            seen |= words[:, kk]
+        assert (got == want).all(), (n, k, w)
+        # exactly one surviving copy of each present bit
+        assert (
+            np.asarray(bitset.popcount(jnp.asarray(got), axis=None)).sum()
+            == np.asarray(
+                bitset.popcount(
+                    jnp.asarray(seen), axis=None
+                )
+            ).sum()
+        )
+
+
+def test_lowest_bit_matches_naive():
+    from go_libp2p_pubsub_tpu.ops import bitset
+
+    rng = np.random.default_rng(17)
+    words = rng.integers(0, 2**32, size=(64, 3), dtype=np.uint64).astype(np.uint32)
+    words[5] = 0  # empty row
+    words[6, 0] = 0  # first word empty, later set
+    idx, has = bitset.lowest_bit(jnp.asarray(words))
+    idx, has = np.asarray(idx), np.asarray(has)
+    for i in range(64):
+        flat = [w * 32 + b for w in range(3) for b in range(32) if (int(words[i, w]) >> b) & 1]
+        if flat:
+            assert has[i] and idx[i] == min(flat), i
+        else:
+            assert not has[i] and idx[i] == 0, i

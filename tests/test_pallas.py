@@ -7,6 +7,7 @@ import jax.numpy as jnp
 
 from go_libp2p_pubsub_tpu import graph
 from go_libp2p_pubsub_tpu.models import common
+from go_libp2p_pubsub_tpu.ops.bitset import edge_eq_words
 from go_libp2p_pubsub_tpu.state import Delivery, MsgTable, Net
 
 
@@ -26,7 +27,9 @@ def _random_state(n, m, k, rng):
         have=words((n,)),
         fwd=words((n,)),
         first_round=jnp.asarray(rng.integers(-1, 5, size=(n, m)).astype(np.int32)),
-        first_edge=jnp.asarray(rng.integers(-1, k, size=(n, m)).astype(np.int8)),
+        fe_words=edge_eq_words(
+            jnp.asarray(rng.integers(-1, k, size=(n, m)).astype(np.int8)), k
+        ),
     )
     msgs = MsgTable(
         topic=jnp.asarray(rng.integers(0, 2, size=(m,)).astype(np.int32)),

@@ -30,7 +30,7 @@ def test_randomsub_propagates():
     topo = graph.random_connect(n, 20, seed=1)
     subs = graph.subscribe_all(n, 1)
     net = Net.build(topo, subs)
-    st = SimState.init(n, 32, seed=0)
+    st = SimState.init(n, 32, seed=0, k=net.max_degree)
     step = make_randomsub_step(net)
     st = step(st, *_pub(0, 0))
     for _ in range(12):
@@ -46,13 +46,13 @@ def test_randomsub_cheaper_than_flood():
     subs = graph.subscribe_all(n, 1)
     net = Net.build(topo, subs)
 
-    st_r = SimState.init(n, 32, seed=0)
+    st_r = SimState.init(n, 32, seed=0, k=net.max_degree)
     step_r = make_randomsub_step(net)
     st_r = step_r(st_r, *_pub(0, 0))
     for _ in range(12):
         st_r = step_r(st_r, *_none())
 
-    st_f = SimState.init(n, 32, seed=0)
+    st_f = SimState.init(n, 32, seed=0, k=net.max_degree)
     st_f = floodsub_step(net, st_f, *_pub(0, 0))
     for _ in range(12):
         st_f = floodsub_step(net, st_f, *_none())
@@ -68,7 +68,7 @@ def test_randomsub_fanout_bound():
     topo = graph.connect_all(n)
     subs = graph.subscribe_all(n, 1)
     net = Net.build(topo, subs)
-    st = SimState.init(n, 16, seed=0)
+    st = SimState.init(n, 16, seed=0, k=net.max_degree)
     step = make_randomsub_step(net)
     st = step(st, *_pub(0, 0))
     st = step(st, *_none())
@@ -88,7 +88,7 @@ def test_floodsub_peers_always_receive():
     fs = [3, 9, 17]
     protocol[fs] = 0  # floodsub-only speakers
     net = Net.build(topo, subs, protocol=protocol)
-    st = SimState.init(n, 32, seed=0)
+    st = SimState.init(n, 32, seed=0, k=net.max_degree)
     step = make_randomsub_step(net, d=2)  # small d so the draw is sparse
 
     for r in range(6):
@@ -112,7 +112,7 @@ def test_floodsub_sender_floods_all_neighbors():
     protocol = np.full(n, 2, np.int8)
     protocol[7] = 0
     net = Net.build(topo, subs, protocol=protocol)
-    st = SimState.init(n, 32, seed=3)
+    st = SimState.init(n, 32, seed=3, k=net.max_degree)
     step = make_randomsub_step(net, d=2)
     st = step(st, *_pub(7, 0))
     st = step(st, *_none())

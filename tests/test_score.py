@@ -20,7 +20,7 @@ from go_libp2p_pubsub_tpu.score import (
     on_prune,
     refresh_scores,
 )
-from go_libp2p_pubsub_tpu.ops.bitset import pack
+from go_libp2p_pubsub_tpu.ops.bitset import edge_eq_words, pack
 from go_libp2p_pubsub_tpu.score.engine import add_penalties
 from go_libp2p_pubsub_tpu.state import Net
 
@@ -125,7 +125,7 @@ class Harness:
             self.tp,
             pack(jnp.asarray(arrivals)),
             pack(jnp.asarray(new_bits)),
-            jnp.asarray(self.first_edge),
+            edge_eq_words(jnp.asarray(self.first_edge), self.k),
             jnp.asarray(self.first_round),
             jnp.asarray(self.msg_topic),
             jnp.asarray(self.msg_valid),
@@ -238,7 +238,7 @@ def test_p3_near_first_duplicates_count():
         h.st = on_deliveries(
             h.st, h.net, h.in_mesh, h.tp,
             pack(jnp.asarray(arrivals)), pack(jnp.asarray(new_bits)),
-            jnp.asarray(h.first_edge), jnp.asarray(h.first_round),
+            edge_eq_words(jnp.asarray(h.first_edge), h.k), jnp.asarray(h.first_round),
             jnp.asarray(h.msg_topic), jnp.asarray(h.msg_valid),
             tick, jnp.asarray(h.tpa.window_rounds),
         )
