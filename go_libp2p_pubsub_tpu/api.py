@@ -337,6 +337,7 @@ class Network:
         msg_slots: int = 64,
         max_publishes_per_round: int = 8,
         validate_throttle: int = DEFAULT_VALIDATE_THROTTLE,
+        validation_delay_rounds: int = 0,
         seed: int = 0,
         trace_sinks=None,
         msg_id_fn: Callable | None = None,
@@ -345,6 +346,10 @@ class Network:
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
+        if validation_delay_rounds and router != "gossipsub":
+            raise APIError(
+                "validation_delay_rounds is only modeled on the gossipsub router"
+            )
         self.router = router
         self.params = params or GossipSubParams()
         self.score_params = score_params
@@ -354,6 +359,7 @@ class Network:
         self.msg_slots = msg_slots
         self.pub_width = max_publishes_per_round
         self.validate_throttle = validate_throttle
+        self.validation_delay_rounds = validation_delay_rounds
         self.seed = seed
         self.trace_sinks = trace_sinks
         self.msg_id_fn = msg_id_fn or default_msg_id
@@ -534,6 +540,7 @@ class Network:
                 self.params, self.thresholds,
                 score_enabled=score_enabled,
                 gater_params=self.gater_params,
+                validation_delay_rounds=self.validation_delay_rounds,
             )
             self.state = GossipSubState.init(
                 self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed

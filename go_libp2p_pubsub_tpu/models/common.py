@@ -40,13 +40,21 @@ def _pallas_block() -> int:
 
 @struct.dataclass
 class RoundInfo:
-    """Per-round delivery observables consumed by tracing + scoring."""
+    """Per-round delivery observables consumed by tracing + scoring.
+
+    With inline validation (val_delay=0) the entry and validated cohorts
+    coincide (`recv_new_words is new_words`); with the async-validation
+    pipeline, `recv_new_words` is this round's fresh receipts (queue
+    admission — the throttle's cohort) while `new_words` is the receipts
+    whose validation completed this round (delivery/forwarding/scoring
+    cohort, the reference's post-validation publishMessage timing)."""
 
     trans: jax.Array        # [N, K, W] u32 — words transmitted to j on edge k
-    new_words: jax.Array    # [N, W] u32 — first receipts this round
+    new_words: jax.Array    # [N, W] u32 — receipts validated this round
     new_bits: jax.Array     # [N, M] bool — same, unpacked
-    n_deliver: jax.Array    # i64 — first receipts of valid messages
-    n_reject: jax.Array     # i64 — first receipts of invalid messages
+    recv_new_words: jax.Array  # [N, W] u32 — first receipts this round
+    n_deliver: jax.Array    # i64 — validated receipts of valid messages
+    n_reject: jax.Array     # i64 — validated receipts of invalid messages
     n_duplicate: jax.Array  # i64 — arrivals beyond the first per (peer,msg)
     n_rpc: jax.Array        # i64 — total (edge, msg) transmissions
 
@@ -87,6 +95,13 @@ def delivery_round(
     Messages are marked seen whether valid or not (markSeen happens inside
     validation, validation.go:285-293); only valid ones are re-forwarded
     (honest behavior — Reject stops propagation, validation.go:309-351).
+
+    A state built with the async-validation pipeline (survey §7 hard
+    part (c); validation.go's worker pool — `dlv.pending` is not None)
+    marks receipts seen on arrival but holds them in the pipeline before
+    their verdict; forwarding, the Deliver/Reject outcome, and `first_round`
+    (the propagation-CDF timestamp, matching the reference's
+    post-validation DeliverMessage timing) all happen at pipeline exit.
     """
     n, k_slots = net.nbr.shape
     m = msgs.capacity
@@ -96,8 +111,12 @@ def delivery_round(
         f"max_degree ({dlv.fe_words.shape[1]} != {k_slots}) — construct the "
         "state with SimState.init(..., k=net.max_degree)"
     )
+    # the pipeline's presence in the state IS the configuration — deriving
+    # it here means a caller can never mismatch the two
+    val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
 
-    if USE_PALLAS and net.band_off is not None and forward_mask is None:
+    if (USE_PALLAS and net.band_off is not None and forward_mask is None
+            and val_delay == 0):
         from ..ops.pallas_delivery import pallas_supported
 
         block = min(_pallas_block(), n)
@@ -124,16 +143,28 @@ def delivery_round(
 
     recv_words = bitset.word_or_reduce(trans, axis=1)  # [N, W]
     new_words = recv_words & ~dlv.have
-    new_bits = bitset.unpack(new_words, m)
 
     # first-arrival edge: lowest edge slot carrying each new bit, isolated
     # in word algebra
     fa_words = bitset.first_set_per_bit(trans, axis=1) & new_words[:, None, :]
-    first_round = jnp.where(new_bits, tick, dlv.first_round)
-
-    # forwarding: new receipts of valid messages (honest store-and-forward)
     valid_words = bitset.pack(msgs.valid)  # [W]
-    fwd_next = new_words & valid_words[None, :]
+
+    if val_delay > 0:
+        # fresh receipts enter stage 0; this round's validated cohort exits
+        validated = dlv.pending[:, -1]
+        pending = jnp.concatenate(
+            [new_words[:, None, :], dlv.pending[:, :-1]], axis=1
+        )
+    else:
+        validated = new_words
+        pending = dlv.pending
+
+    validated_bits = bitset.unpack(validated, m)
+    first_round = jnp.where(validated_bits, tick, dlv.first_round)
+
+    # forwarding: validated receipts of valid messages (store-and-forward
+    # happens after the verdict — Reject stops propagation)
+    fwd_next = validated & valid_words[None, :]
     if forward_mask is not None:
         fwd_next = fwd_next & forward_mask
 
@@ -144,9 +175,17 @@ def delivery_round(
         # overwrite (not OR) on new receipts so stale bits can't survive a
         # slot whose message is re-received after its fe column was cleared
         fe_words=(dlv.fe_words & ~new_words[:, None, :]) | fa_words,
+        pending=pending,
     )
 
-    return dlv, _round_info(trans, new_words, m, valid_words, count_events)
+    info = _round_info(trans, validated, m, valid_words, count_events)
+    info = info.replace(recv_new_words=new_words)
+    if count_events and val_delay > 0:
+        # arrival-cohort counters (duplicates/rpc) are already arrival-based
+        # inside _round_info only when the cohorts coincide; recompute here
+        n_new = bitset.popcount(new_words, axis=None).astype(jnp.int32).sum()
+        info = info.replace(n_duplicate=info.n_rpc - n_new)
+    return dlv, info
 
 
 def _round_info(trans, new_words, m, valid_words, count_events=True) -> RoundInfo:
@@ -163,6 +202,7 @@ def _round_info(trans, new_words, m, valid_words, count_events=True) -> RoundInf
             trans=trans,
             new_words=new_words,
             new_bits=bitset.unpack(new_words, m),
+            recv_new_words=new_words,
             n_deliver=z, n_reject=z, n_duplicate=z, n_rpc=z,
         )
     n_rpc = bitset.popcount(trans, axis=None).astype(jnp.int32).sum()
@@ -175,6 +215,7 @@ def _round_info(trans, new_words, m, valid_words, count_events=True) -> RoundInf
         trans=trans,
         new_words=new_words,
         new_bits=bitset.unpack(new_words, m),
+        recv_new_words=new_words,
         n_deliver=n_deliver,
         n_reject=n_new - n_deliver,
         n_duplicate=n_rpc - n_new,

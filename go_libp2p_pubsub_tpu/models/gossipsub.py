@@ -103,6 +103,10 @@ class GossipSubConfig:
     gater_enabled: bool = False
     gater_quiet_ticks: int = 60
     validation_capacity: int = 0  # accepted validations per peer per round
+    # async validation latency in rounds (survey §7 hard-part (c)): receipts
+    # spend this many rounds in the pipeline between arrival (markSeen) and
+    # their verdict (forward + Deliver/Reject + CDF timestamp). 0 = inline.
+    validation_delay_rounds: int = 0
     # fanout (publishing to unjoined topics, gossipsub.go:981-1002,1517-1554)
     fanout_slots: int = 2         # concurrent unjoined publish topics/peer
     fanout_ttl_ticks: int = 60
@@ -126,6 +130,7 @@ class GossipSubConfig:
         heartbeat_every: int = 1,
         gater_params: "PeerGaterParams | None" = None,
         validation_capacity: int = 0,
+        validation_delay_rounds: int = 0,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
@@ -150,6 +155,7 @@ class GossipSubConfig:
             gater_enabled=gater_params is not None,
             gater_quiet_ticks=ticks_for(gater_params.quiet, hb) if gater_params else 60,
             validation_capacity=validation_capacity,
+            validation_delay_rounds=validation_delay_rounds,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if thresholds is not None:
@@ -248,7 +254,8 @@ class GossipSubState:
         else:
             p6 = jnp.zeros((n, k), jnp.float32)
         return cls(
-            core=SimState.init(n, msg_slots, seed, k=k),
+            core=SimState.init(n, msg_slots, seed, k=k,
+                               val_delay=cfg.validation_delay_rounds),
             mesh=jnp.zeros((n, s, k), bool),
             backoff_expire=jnp.zeros((n, s, k), jnp.int32),
             backoff_present=jnp.zeros((n, s, k), bool),
@@ -650,8 +657,11 @@ def update_fanout_on_publish(
 def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
                    count_events: bool = True):
     """Fold IWANT-response transmissions (not part of senders' fwd sets)
-    into the round's delivery results."""
+    into the round's delivery results. With the async-validation pipeline
+    these receipts enter stage 0 like any other arrival; their verdict
+    (forward/Deliver/first_round) happens at pipeline exit."""
     m = core.msgs.capacity
+    val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
     onehot = core.msgs.origin[None, :] == jnp.arange(net.n_peers, dtype=jnp.int32)[:, None]
     extra = extra & ~bitset.pack(onehot)[:, None, :]
 
@@ -664,26 +674,42 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
 
     dlv = dlv.replace(
         have=dlv.have | new_words,
-        fwd=dlv.fwd | (new_words & valid_words[None, :]),
         fe_words=(dlv.fe_words & ~new_words[:, None, :]) | fa_words,
-        first_round=jnp.where(new_bits, tick, dlv.first_round),
     )
+    if val_delay > 0:
+        dlv = dlv.replace(
+            pending=dlv.pending.at[:, 0, :].set(dlv.pending[:, 0, :] | new_words)
+        )
+    else:
+        dlv = dlv.replace(
+            fwd=dlv.fwd | (new_words & valid_words[None, :]),
+            first_round=jnp.where(new_bits, tick, dlv.first_round),
+        )
 
     info = info.replace(
         trans=info.trans | extra,
-        new_words=info.new_words | new_words,
-        new_bits=info.new_bits | new_bits,
+        recv_new_words=info.recv_new_words | new_words,
     )
+    if val_delay == 0:
+        info = info.replace(
+            new_words=info.new_words | new_words,
+            new_bits=info.new_bits | new_bits,
+        )
     if count_events:
         n_extra = bitset.popcount(extra, axis=-1).sum().astype(jnp.int32)
         n_new = bitset.popcount(new_words, axis=-1).sum().astype(jnp.int32)
-        n_deliver = bitset.popcount(new_words & valid_words[None, :], axis=-1).sum().astype(jnp.int32)
         info = info.replace(
-            n_deliver=info.n_deliver + n_deliver,
-            n_reject=info.n_reject + (n_new - n_deliver),
             n_duplicate=info.n_duplicate + (n_extra - n_new),
             n_rpc=info.n_rpc + n_extra,
         )
+        if val_delay == 0:
+            n_deliver = bitset.popcount(
+                new_words & valid_words[None, :], axis=-1
+            ).sum().astype(jnp.int32)
+            info = info.replace(
+                n_deliver=info.n_deliver + n_deliver,
+                n_reject=info.n_reject + (n_new - n_deliver),
+            )
     return dlv, info
 
 
@@ -985,15 +1011,34 @@ def gather_nbr_subscribed(net: Net) -> jax.Array:
 
 def apply_validation_throttle(dlv, info, cap: int, m: int, valid_words):
     """Model the validation front-end queue (validation.go:230-244 Push with
-    a full queue => RejectValidationThrottled): each peer validates at most
+    a full queue => RejectValidationThrottled): each peer admits at most
     `cap` new receipts per round; overflow receipts are refused — not marked
     seen, not forwarded, no score attribution (score.go:745-749,761-767).
+    The cap applies at queue admission (this round's fresh receipts), so
+    with the async pipeline it clears stage 0 instead of the verdict state.
 
     Returns (dlv, info, accepted_new_words, n_throttled[N])."""
-    counts = bitset.popcount(info.new_words, axis=-1)  # [N]
-    accepted = _prefix_cap_bits(info.new_words, jnp.full_like(counts, cap), m)
-    refused = info.new_words & ~accepted
+    val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
+    entry = info.recv_new_words
+    counts = bitset.popcount(entry, axis=-1)  # [N]
+    accepted = _prefix_cap_bits(entry, jnp.full_like(counts, cap), m)
+    refused = entry & ~accepted
     n_throttled = bitset.popcount(refused, axis=-1)
+    n_ref = n_throttled.sum().astype(jnp.int32)
+
+    if val_delay > 0:
+        dlv = dlv.replace(
+            have=dlv.have & ~refused,
+            fe_words=dlv.fe_words & ~refused[:, None, :],
+            pending=dlv.pending.at[:, 0, :].set(dlv.pending[:, 0, :] & ~refused),
+        )
+        # this round's verdicts (pipeline exits) are unaffected; throttled
+        # receipts trace Reject now
+        info = info.replace(
+            recv_new_words=accepted,
+            n_reject=info.n_reject + n_ref,
+        )
+        return dlv, info, info.new_words, n_throttled
 
     refused_bits = bitset.unpack(refused, m)
     dlv = dlv.replace(
@@ -1002,10 +1047,10 @@ def apply_validation_throttle(dlv, info, cap: int, m: int, valid_words):
         first_round=jnp.where(refused_bits, -1, dlv.first_round),
         fe_words=dlv.fe_words & ~refused[:, None, :],
     )
-    n_ref = n_throttled.sum().astype(jnp.int32)
     info = info.replace(
         new_words=accepted,
         new_bits=bitset.unpack(accepted, m),
+        recv_new_words=accepted,
         # accepted-valid deliver; accepted-invalid + throttled trace Reject
         n_deliver=bitset.popcount(accepted & valid_words[None, :], axis=-1).sum().astype(jnp.int32),
         n_reject=bitset.popcount(accepted & ~valid_words[None, :], axis=-1).sum().astype(jnp.int32) + n_ref,
@@ -1108,6 +1153,9 @@ def make_gossipsub_step(
                 fe_words=jnp.where(
                     down_tr[:, None, None], jnp.uint32(0), st.core.dlv.fe_words
                 ),
+                pending=jnp.where(
+                    down_tr[:, None, None], jnp.uint32(0), st.core.dlv.pending
+                ) if st.core.dlv.pending is not None else None,
             )
             ev0 = st.core.events
             if cfg.count_events:
@@ -1300,6 +1348,11 @@ def make_gossipsub_step(
                 score, net_l, st2.mesh, tp, info.trans, info.new_words,
                 dlv.fe_words, dlv.first_round,
                 core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
+                pending_words=(
+                    bitset.word_or_reduce(dlv.pending, axis=1)
+                    if cfg.validation_delay_rounds > 0 else None
+                ),
+                recv_new_words=info.recv_new_words,
             )
 
         # 5b. gater outcome counters (the RawTracer hooks,
@@ -1307,8 +1360,10 @@ def make_gossipsub_step(
         gater_state = st2.gater
         if cfg.gater_enabled:
             fe_words_post = dlv.fe_words
+            # fe ⊆ arrivals, so the packed first-arrival plane restricted
+            # to the validated cohort is the attribution mask directly
             first_arrival = (
-                info.trans & fe_words_post & accepted_new[:, None, :]
+                fe_words_post & accepted_new[:, None, :]
                 & valid_words_all[None, None, :]
             )
             deliver_inc = bitset.popcount(first_arrival, axis=-1).astype(jnp.float32)

@@ -318,6 +318,9 @@ def on_deliveries(
     msg_valid: jax.Array,     # [M] bool
     tick,
     window_rounds_t: jax.Array,  # [T] i32 — per-topic P3 window (tpa.window_rounds)
+    pending_words: jax.Array | None = None,   # [N,W] u32 — msgs in the
+                                              # async-validation pipeline
+    recv_new_words: jax.Array | None = None,  # [N,W] u32 — fresh receipts
 ) -> ScoreState:
     """Fold one delivery round into the counters.
 
@@ -351,16 +354,43 @@ def on_deliveries(
     valid_w = bitset.pack(msg_valid)  # [W]
 
     # -- P2/P3 credit for valid messages ------------------------------------
-    first_arrival = trans_words & fe_words & new_words[:, None, :] & valid_w[None, None, :]
+    # fe ⊆ arrivals, so the packed first-arrival plane restricted to this
+    # round's validated cohort is the attribution mask directly (with async
+    # validation the physical arrival was rounds ago; credit lands at the
+    # verdict, the reference's DeliverMessage timing, score.go:695-719)
+    first_arrival = fe_words & new_words[:, None, :] & valid_w[None, None, :]
     fmd_inc = per_slot_counts(first_arrival)
     e = lambda a: a[..., None]
     fmd = jnp.minimum(st.fmd + fmd_inc, e(tp["cap2"]))
 
     # mesh delivery credit: first arrivals + near-first (same round) + later
-    # duplicates within the window; only on mesh edges, only valid msgs
+    # duplicates within the window; only on mesh edges, only valid msgs.
+    # The window gate requires a set first_round (a message still awaiting
+    # its verdict has first_round = -1, which must not pass the compare).
     msg_window = window_rounds_t[t]  # [M]
-    within_w = bitset.pack((tick - first_round) <= msg_window[None, :])  # [N,W]
+    within_w = bitset.pack(
+        (first_round >= 0) & ((tick - first_round) <= msg_window[None, :])
+    )  # [N,W]
     mesh_credit = trans_words & valid_w[None, None, :] & within_w[:, None, :]
+    if pending_words is not None:
+        # async pipeline (DeliverMessage's drec.peers loop, score.go:712-718):
+        #  * the first-arrival edge earns its mesh credit at the verdict —
+        #    its physical transmission happened rounds ago, so trans can't
+        #    supply it;
+        #  * duplicates arriving while the message is pending are in the
+        #    delivery record and credited unconditionally (credited here at
+        #    arrival; the count matches, only the decay instant differs).
+        #    The fresh first arrival itself is excluded — it gets credit at
+        #    its own verdict via the first branch.
+        exclude_first = (
+            fe_words & recv_new_words[:, None, :]
+            if recv_new_words is not None else jnp.uint32(0)
+        )
+        pend_dup = (
+            trans_words & pending_words[:, None, :] & valid_w[None, None, :]
+            & ~exclude_first
+        )
+        mesh_credit = mesh_credit | pend_dup | first_arrival
     mmd_inc = per_slot_counts(mesh_credit) * in_mesh.astype(jnp.float32)
     mmd = jnp.minimum(st.mmd + mmd_inc, e(tp["cap3"]))
 

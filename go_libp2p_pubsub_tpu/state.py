@@ -167,6 +167,11 @@ class Delivery:
     fwd: jax.Array          # [N, W] u32
     first_round: jax.Array  # [N, M] i32
     fe_words: jax.Array     # [N, K, W] u32
+    # async-validation pipeline (survey §7 hard-part (c); the reference's
+    # parallel validation workers, validation.go:123-135): receipts sit in
+    # V shift stages between arrival and their validation verdict; absent
+    # (None) when validation is inline (V=0)
+    pending: jax.Array | None = None  # [N, V, W] u32
 
     @property
     def first_edge(self) -> jax.Array:
@@ -175,13 +180,14 @@ class Delivery:
         return bitset.first_edge_of(self.fe_words, self.first_round.shape[-1])
 
     @classmethod
-    def empty(cls, n: int, m: int, k: int = 0) -> "Delivery":
+    def empty(cls, n: int, m: int, k: int = 0, val_delay: int = 0) -> "Delivery":
         w = bitset.n_words(m)
         return cls(
             have=jnp.zeros((n, w), jnp.uint32),
             fwd=jnp.zeros((n, w), jnp.uint32),
             first_round=jnp.full((n, m), -1, jnp.int32),
             fe_words=jnp.zeros((n, k, w), jnp.uint32),
+            pending=jnp.zeros((n, val_delay, w), jnp.uint32) if val_delay > 0 else None,
         )
 
 
@@ -196,15 +202,17 @@ class SimState:
     events: jax.Array    # [N_EVENTS] i64 cumulative trace counters
 
     @classmethod
-    def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0) -> "SimState":
+    def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0,
+             val_delay: int = 0) -> "SimState":
         """`k` is the topology's padded max degree (net.max_degree) — it
         sizes the packed first-arrival-edge plane. k=0 is only for states
-        that never enter a delivery round (e.g. checkpoint plumbing)."""
+        that never enter a delivery round (e.g. checkpoint plumbing).
+        `val_delay` > 0 adds the async-validation pipeline stages."""
         return cls(
             tick=jnp.int32(0),
             key=jax.random.key(seed),
             msgs=MsgTable.empty(msg_slots),
-            dlv=Delivery.empty(n_peers, msg_slots, k),
+            dlv=Delivery.empty(n_peers, msg_slots, k, val_delay),
             events=zero_counters(),
         )
 
@@ -245,6 +253,7 @@ def allocate_publishes(
         fwd=dlv.fwd & keep[None, :],
         first_round=jnp.where(reused[None, :], -1, dlv.first_round),
         fe_words=dlv.fe_words & keep[None, None, :],
+        pending=dlv.pending & keep[None, None, :] if dlv.pending is not None else None,
     )
 
     msgs = msgs.replace(

@@ -93,8 +93,6 @@ def main():
     data, _ = raw_to_tool_data.xspace_to_tool_data(paths, "hlo_stats", {})
     import json
 
-    tbl = json.loads(data) if isinstance(data, (str, bytes)) else data
-    # hlo_stats returns {..., "data": rows} gviz-ish; dump the first rows
     out_path = "/tmp/pubsub_prof/hlo_stats.json"
     with open(out_path, "w") as f:
         f.write(data if isinstance(data, str) else str(data))
