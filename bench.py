@@ -20,13 +20,24 @@ import time
 import numpy as np
 
 
-def build_bench(n_peers: int, msg_slots: int, seed: int = 0):
+def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "default"):
+    """Build (state, step) for a BENCH_CONFIG:
+
+    default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
+              north-star workload the driver measures)
+    eth2    — 100k-peer Eth2 attestation-subnet geometry: 64 topics, each
+              peer subscribed to 2 random subnets (BASELINE.json config #5)
+    sybil   — 20% sybil attackers (control-plane-only peers that never
+              forward data), peer gater + deficit scoring enabled
+              (BASELINE.json config #4; default BENCH_N 50k)
+    """
     import jax
     import jax.numpy as jnp
 
     from go_libp2p_pubsub_tpu import graph
     from go_libp2p_pubsub_tpu.config import (
         GossipSubParams,
+        PeerGaterParams,
         PeerScoreParams,
         PeerScoreThresholds,
         TopicScoreParams,
@@ -41,35 +52,65 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0):
 
     # bounded-degree topology (K stays small and static for the compiler)
     topo = graph.ring_lattice(n_peers, d=8)  # degree 16, K=16
-    subs = graph.subscribe_all(n_peers, 1)
+    if config == "eth2":
+        n_topics = 64  # attestation subnet count
+        subs = graph.subscribe_random(n_peers, n_topics=n_topics,
+                                      topics_per_peer=2, seed=seed)
+    else:
+        n_topics = 1
+        subs = graph.subscribe_all(n_peers, 1)
     net = Net.build(topo, subs)
 
     params = dataclasses.replace(GossipSubParams(), flood_publish=False)
-    tp = TopicScoreParams(
-        mesh_message_deliveries_weight=0.0,  # deficit penalties off: honest net
-        mesh_failure_penalty_weight=0.0,
-    )
+    if config == "sybil":
+        # deficit penalties on: the sybils are what scoring must catch
+        tp = TopicScoreParams(
+            mesh_message_deliveries_weight=-0.5,
+            mesh_message_deliveries_threshold=4.0,
+            mesh_message_deliveries_activation=10.0,
+            mesh_message_deliveries_window=2.0,
+        )
+    else:
+        tp = TopicScoreParams(
+            mesh_message_deliveries_weight=0.0,  # deficit off: honest net
+            mesh_failure_penalty_weight=0.0,
+        )
     sp = PeerScoreParams(
-        topics={0: tp},
+        topics={t: tp for t in range(n_topics)},
         skip_app_specific=True,
         behaviour_penalty_weight=-1.0,
         behaviour_penalty_threshold=1.0,
         behaviour_penalty_decay=0.9,
     )
-    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
+    gater = PeerGaterParams() if config == "sybil" else None
+    adversary = None
+    if config == "sybil":
+        rng = np.random.default_rng(seed)
+        adversary = rng.random(n_peers) < 0.2
+    cfg = GossipSubConfig.build(
+        params, PeerScoreThresholds(), score_enabled=True, gater_params=gater,
+        validation_capacity=8 if config == "sybil" else 0,
+    )
     # tracer-detached configuration (tracing is opt-in in the reference):
-    # no aggregate event counters; no fanout slots (every peer subscribes
-    # the topic, so fanout provably can't occur in this workload)
-    cfg = dataclasses.replace(cfg, count_events=False, fanout_slots=0)
+    # no aggregate event counters; no fanout slots when every peer
+    # subscribes the topic (fanout provably can't occur in that workload)
+    cfg = dataclasses.replace(
+        cfg, count_events=False,
+        fanout_slots=0 if config != "eth2" else cfg.fanout_slots,
+    )
     st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=seed)
-    step = make_gossipsub_step(cfg, net, score_params=sp)
+    step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gater,
+                               adversary_no_forward=adversary)
 
     n_dev = len(jax.devices())
     if n_dev > 1 and n_peers % n_dev == 0:
         mesh = make_mesh(n_dev)
         st = shard_state(st, mesh, n_peers)
 
-    return st, step
+    # honest peers only as publish origins: a sybil origin would silently
+    # drop its own publish (adversary peers never transmit message data)
+    honest = np.flatnonzero(~adversary) if adversary is not None else None
+    return st, step, n_topics, honest
 
 
 def main():
@@ -82,20 +123,30 @@ def main():
         jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
 
-    n_peers = int(os.environ.get("BENCH_N", 100_000))
+    config = os.environ.get("BENCH_CONFIG", "default")
+    default_n = 50_000 if config == "sybil" else 100_000
+    n_peers = int(os.environ.get("BENCH_N", default_n))
     msg_slots = int(os.environ.get("BENCH_M", 64))
     seg = int(os.environ.get("BENCH_ROUNDS", 200))
     pubs_per_round = 4
 
-    sizes = [n_peers, n_peers // 2, n_peers // 4, 25_000, 10_000]
+    sizes, n = [], n_peers
+    while n >= 10_000:
+        sizes.append(n)
+        n //= 2
     st = step = None
     for n in sizes:
         try:
-            st, step = build_bench(n, msg_slots)
+            st, step, n_topics, honest = build_bench(n, msg_slots, config=config)
             # publish schedule [R, P]
             rng = np.random.default_rng(0)
-            po = rng.integers(0, n, size=(seg, pubs_per_round)).astype(np.int32)
-            pt = np.zeros((seg, pubs_per_round), np.int32)
+            if honest is not None:
+                po = honest[
+                    rng.integers(0, len(honest), size=(seg, pubs_per_round))
+                ].astype(np.int32)
+            else:
+                po = rng.integers(0, n, size=(seg, pubs_per_round)).astype(np.int32)
+            pt = rng.integers(0, n_topics, size=(seg, pubs_per_round)).astype(np.int32)
             pv = np.ones((seg, pubs_per_round), bool)
             po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
 
@@ -138,10 +189,11 @@ def main():
         rates.append(seg / dt)
     value = max(rates)
 
+    tag = "" if config == "default" else f"_{config}"
     print(
         json.dumps(
             {
-                "metric": f"gossipsub_v1.1_heartbeat_ticks_per_sec_n{n_peers}",
+                "metric": f"gossipsub_v1.1_heartbeat_ticks_per_sec_n{n_peers}{tag}",
                 "value": round(value, 2),
                 "unit": "ticks/s",
                 "vs_baseline": round(value / 10_000.0, 4),
