@@ -130,7 +130,8 @@ def main():
     seg = int(os.environ.get("BENCH_ROUNDS", 200))
     pubs_per_round = 4
 
-    sizes, n = [], n_peers
+    # always try the requested size; halve down to 10k as the OOM fallback
+    sizes, n = [n_peers], n_peers // 2
     while n >= 10_000:
         sizes.append(n)
         n //= 2

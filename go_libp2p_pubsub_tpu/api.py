@@ -31,9 +31,11 @@ Reference-surface mapping (citations into /root/reference):
 
 Static-after-start contract: topology and the topic universe freeze at
 `start()` (they are jit constants of the compiled step). Subscriptions,
-relays, validators, publishes, churn, and blacklists are all live. This is
-the explicit trade the survey §7 architecture makes; mid-run Join of a
-*new* topic raises rather than silently recompiling.
+relays, validators, publishes, churn, blacklists — and runtime Join/Leave
+of *existing* topics (pubsub.go:1146-1218), which rebuild the subscription
+constants and recompile the step with a per-node topic-slot state remap —
+are all live. Mid-run Join of a topic that never existed before start()
+still raises rather than silently growing the topic universe.
 """
 
 from __future__ import annotations
@@ -209,6 +211,19 @@ class Topic:
 
         return cancel
 
+    def set_score_params(self, tsp) -> None:
+        """Live per-topic score-parameter update (Topic.SetScoreParams,
+        topic.go:36-74): validates, swaps the topic's params, and — when
+        the router is running with scoring — recompiles the step. Counters
+        are parameter-independent, so state carries unchanged."""
+        net = self.node.network
+        if net.score_params is None:
+            raise APIError("scoring is not enabled on this network")
+        tsp.validate()
+        net.score_params.topics[self.tid] = tsp
+        if net.started and net.router == "gossipsub":
+            net._recompile_gossipsub()
+
     def event_handler(self) -> TopicEventHandler:
         h = TopicEventHandler(self)
         self._handlers.append(h)
@@ -284,6 +299,32 @@ class Node:
             self.network._leave(self, t)
 
     # -- validators --------------------------------------------------------
+
+    def get_topics(self) -> "list[str]":
+        """Topics this node is subscribed to (GetTopics, pubsub.go)."""
+        return sorted(self.topics)
+
+    def list_peers(self, topic: str) -> "list[bytes]":
+        """Peer ids of connected peers known to subscribe `topic`
+        (ListPeers, pubsub.go:1220-1237 — the per-node topics-map view)."""
+        net = self.network
+        if topic not in net.topic_ids:
+            return []
+        tid = net.topic_ids[topic]
+        if not net.started:
+            return sorted(
+                nd.identity.peer_id for nd in net._topic_members(tid)
+                if nd is not self and net.are_connected(self, nd)
+            )
+        nbr = np.asarray(net.net.nbr)[self.idx]
+        ok = np.asarray(net.net.nbr_ok)[self.idx]
+        subbed = np.asarray(net.net.subscribed)[:, tid]
+        out = []
+        for k in range(len(nbr)):
+            j = int(nbr[k])
+            if ok[k] and j >= 0 and subbed[j] and net.nodes[j].up:
+                out.append(net.nodes[j].identity.peer_id)
+        return sorted(set(out))
 
     def register_topic_validator(self, topic: str, fn: Callable,
                                  inline: bool = False,
@@ -437,7 +478,10 @@ class Network:
         tid = self.topic_ids.setdefault(topic, len(self.topic_ids))
         t = Topic(node, topic, tid)
         if self.started:
-            raise APIError("join after start() not supported yet")
+            # runtime Join (pubsub.go:1163-1197): register the handle
+            # first so _build_net sees the new subscription
+            node.topics[topic] = t
+            self._resubscribe()
         # advertise joined topics to the discovery service
         # (handleAddSubscription -> disc.Advertise, pubsub.go:759-780)
         if self.discovery is not None:
@@ -445,7 +489,8 @@ class Network:
         return t
 
     def _leave(self, node: Node, t: Topic) -> None:
-        self._check_not_started("leave")
+        if self.started:
+            self._resubscribe(leaver=(node.idx, t.tid))
         if self.discovery is not None:
             self.discovery.stop_advertise(node, t.name)
 
@@ -494,23 +539,11 @@ class Network:
             raise APIError(f"no validator for topic {topic!r}")
         del self._validators[topic]
 
-    # -- start: freeze + compile ------------------------------------------
+    # -- net construction (start() and post-start resubscription) ---------
 
-    def start(self) -> None:
-        if self.started:
-            return
-        import jax.numpy as jnp
-
-        from .models.gossipsub import (
-            GossipSubConfig,
-            GossipSubState,
-            make_gossipsub_step,
-        )
-        from .models.randomsub import make_randomsub_step
-
+    def _build_net(self, min_slots: int = 0):
+        """Assemble the Net from the current nodes/edges/subscriptions."""
         n = len(self.nodes)
-        if n == 0:
-            raise APIError("empty network")
         n_topics = max(1, len(self.topic_ids))
 
         dialed = [set() for _ in range(n)]
@@ -522,15 +555,166 @@ class Network:
         for node in self.nodes:
             for t in node.topics.values():
                 sub_mask[node.idx, t.tid] = True
-        subs = graphlib.subscribe_mask(sub_mask)
+        max_slots = max(int(sub_mask.sum(axis=1).max()) if n else 1, min_slots, 1)
+        subs = graphlib.subscribe_mask(sub_mask, max_slots=max_slots)
 
         proto_code = {"/floodsub/1.0.0": 0, "/meshsub/1.0.0": 1, "/meshsub/1.1.0": 2}
         protocol = np.array([proto_code[nd.protocol] for nd in self.nodes], np.int8)
         ip_names = [nd.ip if nd.ip is not None else f"ip-{nd.idx}" for nd in self.nodes]
         ip_tbl: dict[str, int] = {}
         ip_group = np.array([ip_tbl.setdefault(s, len(ip_tbl)) for s in ip_names], np.int32)
+        return Net.build(topo, subs, ip_group=ip_group, protocol=protocol)
 
-        self.net = Net.build(topo, subs, ip_group=ip_group, protocol=protocol)
+    def _resubscribe(self, leaver: "tuple[int, int] | None" = None) -> None:
+        """Runtime Join/Leave (pubsub.go:1146-1218, topic.go): rebuild the
+        subscription constants and recompile the step, carrying all protocol
+        state across with a per-node topic-slot remap. The reference
+        announces subscription changes via a SubOpts RPC that peers apply on
+        receipt (announce, pubsub.go:842-859); here the new subscription map
+        becomes visible to everyone on the next round — the same one-RTT
+        visibility, without modeling announce-retry.
+
+        For a Leave, the leaver first PRUNEs its mesh members (Leave sends
+        PRUNE+backoff, gossipsub.go:1066-1082): the prune rides the current
+        compiled step for one transition round before the rebuild."""
+        import jax.numpy as jnp
+
+        from .trace.events import EV
+
+        if self.router == "gossipsub" and leaver is not None:
+            node_idx, tid = leaver
+            s_old = int(np.asarray(self.net.slot_of)[node_idx, tid])
+            if s_old >= 0:
+                mesh_row = self.state.mesh[node_idx, s_old]
+                self.state = self.state.replace(
+                    prune_out=self.state.prune_out.at[node_idx, s_old].set(
+                        self.state.prune_out[node_idx, s_old] | mesh_row
+                    ),
+                    mesh=self.state.mesh.at[node_idx, s_old].set(False),
+                )
+                # one transition round under the old net so the PRUNE
+                # crosses the wire and the far ends apply it — advanced
+                # directly, without run()'s publish-queue drain or
+                # validation-budget reset side effects
+                self._advance_empty_round()
+
+        old_net = self.net
+        old_s = old_net.n_slots
+        # never shrink the slot axis: keeps array shapes monotonic
+        self.net = self._build_net(min_slots=old_s)
+        self.topic_names = {tid: name for name, tid in self.topic_ids.items()}
+
+        if self.router == "gossipsub":
+            # per-node slot remap: new slot s (topic t) takes the old
+            # slot's state when the node was subscribed to t before
+            my_t_new = np.asarray(self.net.my_topics)        # [N, S']
+            old_slot_of = np.asarray(old_net.slot_of)        # [N, T_old]
+            t_old_dim = old_slot_of.shape[1]
+            tclip = np.clip(my_t_new, 0, t_old_dim - 1)
+            old_slot = np.where(
+                (my_t_new >= 0) & (my_t_new < t_old_dim),
+                np.take_along_axis(old_slot_of, tclip, axis=1), -1,
+            )
+            idx = np.where(old_slot >= 0, old_slot, old_s)   # old_s = fresh
+
+            def remap(a, fill):
+                arr = np.asarray(a)
+                pad_shape = (arr.shape[0], 1) + arr.shape[2:]
+                padded = np.concatenate(
+                    [arr, np.full(pad_shape, fill, arr.dtype)], axis=1
+                )
+                ix = idx.reshape(idx.shape + (1,) * (arr.ndim - 2))
+                return jnp.asarray(
+                    np.take_along_axis(padded, np.broadcast_to(
+                        ix, (idx.shape[0], idx.shape[1]) + arr.shape[2:]
+                    ), axis=1)
+                )
+
+            st = self.state
+            sc = st.score
+            # a freshly joined topic that was being tracked as fanout is
+            # promoted (Join, gossipsub.go:1024-1048): drop the fanout slot;
+            # the next heartbeat grafts the mesh
+            joined_now = np.asarray(self.net.subscribed)
+            ft = np.asarray(st.fanout_topic)
+            drop_f = (ft >= 0) & np.take_along_axis(
+                joined_now, np.clip(ft, 0, joined_now.shape[1] - 1), axis=1
+            )
+            events = st.core.events
+            if self._cfg.count_events:
+                events = events.at[EV.JOIN if leaver is None else EV.LEAVE].add(1)
+            self.state = st.replace(
+                core=st.core.replace(events=events),
+                mesh=remap(st.mesh, False),
+                backoff_expire=remap(st.backoff_expire, 0),
+                backoff_present=remap(st.backoff_present, False),
+                graft_out=remap(st.graft_out, False),
+                prune_out=remap(st.prune_out, False),
+                prune_px_out=remap(st.prune_px_out, False),
+                fanout_topic=jnp.asarray(np.where(drop_f, -1, ft)),
+                score=sc.replace(
+                    fmd=remap(sc.fmd, 0.0), mmd=remap(sc.mmd, 0.0),
+                    mfp=remap(sc.mfp, 0.0), imd=remap(sc.imd, 0.0),
+                    graft_tick=remap(sc.graft_tick, -1),
+                    mesh_time=remap(sc.mesh_time, 0),
+                    mmd_active=remap(sc.mmd_active, False),
+                ),
+            )
+            self._recompile_gossipsub()
+            if self.tag_tracer is not None:
+                old_tags = self.tag_tracer.cm.tags
+                last_decay = self.tag_tracer.cm.last_decay
+                from .connmgr import TagTracer
+
+                self.tag_tracer = TagTracer(self.net)
+                padded = np.concatenate(
+                    [old_tags, np.zeros_like(old_tags[:, :1])], axis=1
+                )
+                self.tag_tracer.cm.tags = np.take_along_axis(
+                    padded, idx[:, :, None], axis=1
+                )
+                self.tag_tracer.cm.last_decay = last_decay
+        elif self.router == "randomsub":
+            from .models.randomsub import make_randomsub_step
+
+            self._step = make_randomsub_step(self.net)
+        else:
+            from .models.floodsub import floodsub_step
+
+            def _fstep(st, po, pt, pv, _net=self.net):
+                return floodsub_step(_net, st, po, pt, pv)
+
+            self._step = _fstep
+
+        if self._session is not None:
+            self._session.nbr = np.asarray(self.net.nbr)
+            self._session.my_topics = np.asarray(self.net.my_topics)
+            self._session.subscribed = np.asarray(self.net.subscribed)
+
+    def _recompile_gossipsub(self) -> None:
+        """(Re)build the compiled gossipsub step for the current net +
+        score/gater params (start, runtime Join/Leave, SetScoreParams)."""
+        from .models.gossipsub import make_gossipsub_step
+
+        self._step = make_gossipsub_step(
+            self._cfg, self.net, score_params=self.score_params,
+            gater_params=self.gater_params, dynamic_peers=True,
+        )
+
+    # -- start: freeze + compile ------------------------------------------
+
+    def start(self) -> None:
+        if self.started:
+            return
+        import jax.numpy as jnp
+
+        from .models.gossipsub import GossipSubConfig, GossipSubState
+        from .models.randomsub import make_randomsub_step
+
+        n = len(self.nodes)
+        if n == 0:
+            raise APIError("empty network")
+        self.net = self._build_net()
         self.topic_names = {tid: name for name, tid in self.topic_ids.items()}
 
         if self.router == "gossipsub":
@@ -545,10 +729,8 @@ class Network:
             self.state = GossipSubState.init(
                 self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed
             )
-            self._step = make_gossipsub_step(
-                cfg, self.net, score_params=sp,
-                gater_params=self.gater_params, dynamic_peers=True,
-            )
+            self._cfg = cfg
+            self._recompile_gossipsub()
             self._dynamic = True
         elif self.router == "randomsub":
             self.state = SimState.init(n, self.msg_slots, self.seed, k=self.net.max_degree)
@@ -622,6 +804,31 @@ class Network:
         return True
 
     # -- run loop ----------------------------------------------------------
+
+    def _advance_empty_round(self) -> None:
+        """One protocol round with no publishes and full observation
+        bookkeeping (traces, tags, membership, delivery drain) — but
+        without run()'s publish-queue drain or validation-budget reset.
+        Used for internal transition rounds (e.g. Leave's PRUNE)."""
+        jnp = self._jnp
+        po = np.full(self.pub_width, -1, np.int32)
+        pt = np.zeros(self.pub_width, np.int32)
+        pv = np.zeros(self.pub_width, bool)
+        prev = snapshot(self.state)
+        args = (self.state, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+        if self._dynamic:
+            up = np.array([nd.up and not self._blacklisted(nd) for nd in self.nodes])
+            self.state = self._step(*args, jnp.asarray(up))
+        else:
+            self.state = self._step(*args)
+        new = snapshot(self.state)
+        if prev.up is not None and new.up is not None:
+            self._emit_membership_events(prev.up, new.up)
+        if self._session is not None:
+            self._session.observe(prev, new, po, pt, pv)
+        if self.tag_tracer is not None:
+            self.tag_tracer.observe(prev, new)
+        self._drain_deliveries(prev, new)
 
     def run(self, rounds: int = 1) -> None:
         """Advance the simulation; distributes queued publishes over the
