@@ -6,8 +6,10 @@ All routers share it; they differ only in *which edges carry* a message
 (flood: every topic edge, floodsub.go:76-100; gossipsub: mesh/fanout edges;
 randomsub: a random subset chosen at publish).
 
-Gather-only dataflow (no scatters in the hot loop): each receiver j reads
-its senders' forward sets at nbr[j,k] and applies edge/topic masks. The
+Gather-only dataflow for all N-sized traffic: each receiver j reads its
+senders' forward sets at nbr[j,k] and applies edge/topic masks. (The one
+deliberate exception is an M-element scatter marking message origins —
+M is the tiny message-slot axis, not a peer-sized tensor.) The
 transmit tensor `trans[N, K, W]` (packed words) *is* the round's wire
 traffic; aggregate popcounts of it produce the SendRPC/RecvRPC trace
 counters, and the score engine later consumes it for delivery attribution.
@@ -69,10 +71,19 @@ def subscribed_msg_words(net: Net, msgs: MsgTable) -> jax.Array:
 def origin_msg_words(net: Net, msgs: MsgTable) -> jax.Array:
     """[N, W] packed mask: messages peer n originated (never sent back to the
     origin — the `pid == peer.ID(msg.GetFrom())` check, floodsub.go:87,
-    gossipsub.go:1007)."""
+    gossipsub.go:1007).
+
+    Each message has exactly one origin, so this is an M-element scatter of
+    single-bit words — not an [N, M] one-hot compare+pack (which costs
+    N*M work per round just to mark M bits)."""
     n = net.n_peers
-    onehot = msgs.origin[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]
-    return bitset.pack(onehot)
+    m = msgs.capacity
+    w = bitset.n_words(m)
+    slot = jnp.arange(m, dtype=jnp.int32)
+    upd = jnp.uint32(1) << (slot % 32).astype(jnp.uint32)
+    row = jnp.where(msgs.origin >= 0, msgs.origin, n)  # OOB-drop padding
+    # distinct bit positions per (row, word) pair make add equivalent to or
+    return jnp.zeros((n, w), jnp.uint32).at[row, slot // 32].add(upd, mode="drop")
 
 
 def delivery_round(

@@ -61,7 +61,7 @@ from ..score.engine import (
 from ..score.gater import GaterState, gater_accept, gater_decay, gater_on_round
 from ..state import Net, SimState, allocate_publishes
 from ..trace.events import EV
-from .common import accumulate_round_events, delivery_round
+from .common import accumulate_round_events, delivery_round, origin_msg_words
 
 
 # ---------------------------------------------------------------------------
@@ -662,8 +662,7 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
     (forward/Deliver/first_round) happens at pipeline exit."""
     m = core.msgs.capacity
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
-    onehot = core.msgs.origin[None, :] == jnp.arange(net.n_peers, dtype=jnp.int32)[:, None]
-    extra = extra & ~bitset.pack(onehot)[:, None, :]
+    extra = extra & ~origin_msg_words(net, core.msgs)[:, None, :]
 
     recv = bitset.word_or_reduce(extra, axis=1)
     new_words = recv & ~dlv.have

@@ -1,0 +1,164 @@
+"""Parity report: vectorized routers vs. the scalar per-node oracles.
+
+Runs the BASELINE.json comparison configs (scaled to oracle-tractable
+sizes — the oracles are deliberately naive per-node Python) and writes
+PARITY.md with, per config:
+
+  * propagation-latency CDF sup-distance (north-star tolerance: 2%)
+  * mean-hop relative difference
+  * delivery coverage on both sides
+  * aggregate event-counter ratios (deliver / duplicate / RPC)
+
+FloodSub is deterministic given the topology, so its row is checked
+bit-for-bit (seen sets, first_round, first_edge, every counter) rather
+than distributionally.
+
+Usage: python scripts/parity_report.py  (CPU; a few minutes)
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, ".")
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+    from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+        no_publish,
+    )
+    from go_libp2p_pubsub_tpu.oracle.floodsub import OracleFloodSub
+    from go_libp2p_pubsub_tpu.oracle.gossipsub import OracleGossipSub
+    from go_libp2p_pubsub_tpu.ops import bitset
+    from go_libp2p_pubsub_tpu.state import Net, SimState, hops
+    from go_libp2p_pubsub_tpu.trace.events import EV
+
+    MAX_H = 16
+    rows = []
+
+    def cdf(hop_list, n_msgs, n_peers):
+        hist = np.zeros(MAX_H + 1)
+        for h in hop_list:
+            hist[min(int(h), MAX_H)] += 1
+        return np.cumsum(hist) / (n_msgs * n_peers)
+
+    # ---- config 1: FloodSub, 64 hosts, connectAll — bit-exact ----------
+    n, msg_slots = 64, 64
+    topo = graph.connect_all(n)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    st = SimState.init(n, msg_slots, seed=0, k=net.max_degree)
+    oracle = OracleFloodSub(topo, subs, msg_slots=msg_slots)
+    rng = np.random.default_rng(0)
+    exact = True
+    for r in range(30):
+        pubs = [(int(rng.integers(0, n)), 0, True)] if r % 2 == 0 else []
+        po = np.full((1,), pubs[0][0] if pubs else -1, np.int32)
+        pt = np.zeros((1,), np.int32)
+        pv = np.asarray([bool(pubs)])
+        st = floodsub_step(net, st, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+        oracle.step(pubs)
+    have = np.asarray(bitset.unpack(st.dlv.have, msg_slots))
+    fr = np.asarray(st.dlv.first_round)
+    fe = np.asarray(st.dlv.first_edge)
+    for i in range(n):
+        if set(np.nonzero(have[i])[0].tolist()) != oracle.seen[i]:
+            exact = False
+        for slot in oracle.seen[i]:
+            if fr[i, slot] != oracle.first_round[(i, slot)]:
+                exact = False
+            if fe[i, slot] != oracle.first_edge[(i, slot)]:
+                exact = False
+    ev = np.asarray(st.events)
+    ev_exact = all(int(ev[e]) == oracle.events[e] for e in range(len(ev)))
+    rows.append(("FloodSub 64 connectAll (config #1)",
+                 "bit-exact" if exact and ev_exact else "MISMATCH",
+                 "-", "-", "every seen set, first_round, first_edge, counter"))
+
+    # ---- gossipsub configs: CDF comparison ------------------------------
+    def gossip_row(label, n, deg, params, warmup=20, pub_rounds=18, drain=14,
+                   seed=5):
+        topo = graph.random_connect(n, d=deg, seed=seed)
+        subs = graph.subscribe_all(n, 1)
+        schedule = np.random.default_rng(7).integers(
+            0, n, size=(pub_rounds, 2)).astype(np.int32)
+
+        netx = Net.build(topo, subs)
+        cfg = GossipSubConfig.build(params)
+        stx = GossipSubState.init(netx, 64, cfg, seed=3)
+        step = make_gossipsub_step(cfg, netx)
+        empty = no_publish(2)
+        for _ in range(warmup):
+            stx = step(stx, *empty)
+        pt = jnp.zeros((2,), jnp.int32)
+        pv = jnp.ones((2,), bool)
+        for r in range(pub_rounds):
+            stx = step(stx, jnp.asarray(schedule[r]), pt, pv)
+        for _ in range(drain):
+            stx = step(stx, *empty)
+        hv = np.asarray(hops(stx.core.msgs, stx.core.dlv))
+        hv = [int(x) for x in hv[hv >= 0]]
+        ev_v = np.asarray(stx.core.events)
+
+        o = OracleGossipSub(topo, subs, cfg, msg_slots=64, seed=11)
+        for _ in range(warmup):
+            o.step()
+        for r in range(pub_rounds):
+            o.step([(int(p), 0, True) for p in schedule[r]])
+        for _ in range(drain):
+            o.step()
+        ho = list(o.hops().values())
+
+        n_msgs = pub_rounds * 2
+        cv, co = cdf(hv, n_msgs, n), cdf(ho, n_msgs, n)
+        sup = float(np.max(np.abs(cv - co)))
+        mean_rel = abs(np.mean(hv) - np.mean(ho)) / np.mean(ho)
+        ratios = []
+        for e in (EV.DELIVER_MESSAGE, EV.DUPLICATE_MESSAGE, EV.SEND_RPC):
+            ratios.append(float(ev_v[e]) / max(float(o.events[e]), 1.0))
+        rows.append((label, f"{100*sup:.2f}%", f"{100*mean_rel:.2f}%",
+                     f"{cv[-1]*100:.1f}% / {co[-1]*100:.1f}%",
+                     "dlv/dup/rpc ratios " + "/".join(f"{x:.3f}" for x in ratios)))
+
+    gossip_row("GossipSub v1.0, 192 peers d=8 (config #3 scaled)",
+               192, 8, GossipSubParams())
+    gossip_row("GossipSub v1.0 + flood-publish, 192 peers d=8",
+               192, 8, GossipSubParams(flood_publish=True))
+    gossip_row("GossipSub v1.0, 512 peers d=10 sparse",
+               512, 10, GossipSubParams(), pub_rounds=14)
+
+    # ---- write report ---------------------------------------------------
+    lines = [
+        "# PARITY — vectorized routers vs. scalar per-node oracles",
+        "",
+        "Generated by `scripts/parity_report.py` (CPU run). The oracles",
+        "(`oracle/`) are deliberately naive per-node Python transcriptions of",
+        "the reference call stacks (SURVEY §3); RNG streams cannot match a",
+        "batched engine (survey §7 hard-part (d)), so gossipsub rows compare",
+        "propagation-latency CDFs — the north-star tolerance is 2% sup-norm.",
+        "FloodSub has no randomness: its row is bit-exact equivalence.",
+        "",
+        "| config | CDF sup-dist | mean-hop rel. diff | coverage (vec/oracle) | notes |",
+        "|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append("| " + " | ".join(str(x) for x in r) + " |")
+    lines.append("")
+    open("PARITY.md", "w").write("\n".join(lines))
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()
