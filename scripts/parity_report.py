@@ -159,6 +159,15 @@ def main():
     open("PARITY.md", "w").write("\n".join(lines))
     print("\n".join(lines))
 
+    # enforce the documented tolerances: bit-exactness for floodsub, the
+    # 2% north-star sup-norm for every gossipsub row
+    failed = [r[0] for r in rows if r[1] == "MISMATCH"]
+    failed += [r[0] for r in rows
+               if r[1].endswith("%") and float(r[1].rstrip("%")) > 2.0]
+    if failed:
+        print("PARITY FAILURES:", "; ".join(failed))
+        sys.exit(1)
+
 
 if __name__ == "__main__":
     main()
