@@ -61,7 +61,12 @@ from ..score.engine import (
 from ..score.gater import GaterState, gater_accept, gater_decay, gater_on_round
 from ..state import Net, SimState, allocate_publishes
 from ..trace.events import EV
-from .common import accumulate_round_events, delivery_round, origin_msg_words
+from .common import (
+    accumulate_round_events,
+    delivery_round,
+    origin_msg_words,
+    subscribed_msg_words,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -306,10 +311,8 @@ def msg_slot_of(net: Net, msg_topic: jax.Array) -> jax.Array:
 
 def joined_msg_words(net: Net, msgs) -> jax.Array:
     """[N, W]: messages in topics peer n has joined (mesh exists <=>
-    subscribed in the sim)."""
-    t = jnp.clip(msgs.topic, 0)
-    joined = jnp.where(msgs.topic[None, :] >= 0, net.subscribed[:, t], False)
-    return bitset.pack(joined)
+    subscribed in the sim) — the alias documents that equivalence."""
+    return subscribed_msg_words(net, msgs)
 
 
 # ---------------------------------------------------------------------------

@@ -196,3 +196,30 @@ def test_p3_mesh_credit_survives_pipeline():
     # pipelined validation must not lose mesh-delivery credit; mesh
     # composition is stochastic per-config, so compare with slack
     assert totals[2] >= 0.7 * totals[0], totals
+
+
+def test_traced_run_under_delay(tmp_path):
+    """The trace drain reconstructs DeliverMessage at the verdict round
+    (first_round stamp + first-arrival edge), so a traced run under the
+    async pipeline must produce a consistent event stream: one Deliver per
+    (peer, msg) pair, senders resolvable, publish count exact."""
+    from go_libp2p_pubsub_tpu.pb import trace_pb2
+    from go_libp2p_pubsub_tpu.trace import sinks
+
+    path = str(tmp_path / "delay.json")
+    net = api.Network(validation_delay_rounds=2,
+                      trace_sinks=[sinks.JSONTracer(path)])
+    nodes = net.add_nodes(8)
+    net.connect_all()
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.start()
+    nodes[0].topics["t"].publish(b"one")
+    net.run(10)
+    net.stop()
+    evs = list(sinks.read_json_trace(path))
+    pubs = [e for e in evs if e.type == trace_pb2.TraceEvent.PUBLISH_MESSAGE]
+    dels = [e for e in evs if e.type == trace_pb2.TraceEvent.DELIVER_MESSAGE]
+    assert len(pubs) == 1
+    # every non-origin subscriber delivers exactly once, after validation
+    assert len(dels) == 7
+    assert all(sum(1 for _ in s) == 1 for s in subs)
