@@ -4,10 +4,13 @@
 // ("invoke compiled XLA programs from the Go-facing API via cgo→PJRT").
 // It is the line-for-line Go twin of example_host.c against the same
 // pjx_* C ABI (native/pjrt_bridge.cc); the C program is the compiled,
-// tested proof in this image (no Go toolchain here — see Makefile), and
-// this file documents the cgo shape a Go embedder uses:
+// tested proof in this image (no Go toolchain here — see ../Makefile),
+// and this file documents the cgo shape a Go embedder uses. It lives in
+// its own directory so cgo does not try to compile the sibling C/C++
+// sources into the package:
 //
-//	go build -tags pjrt_example -o example_host_go .
+//	cd native/go_example && go mod init pubsub_example \
+//	  && go build -tags pjrt_example -o example_host_go .
 //	./example_host_go PLUGIN.so MODULE.mlirpb OPTIONS.pb [name:type:value ...]
 //
 // The module/options inputs are produced exactly as for the C host (see
@@ -17,7 +20,7 @@
 package main
 
 /*
-#cgo LDFLAGS: -L. -lpjrt_bridge
+#cgo LDFLAGS: -L${SRCDIR}/.. -lpjrt_bridge -Wl,-rpath,${SRCDIR}/..
 #include <stdint.h>
 #include <stdlib.h>
 
@@ -76,6 +79,10 @@ func main() {
 		fmt.Fprintln(os.Stderr, "reading module/options:", errM, errO)
 		os.Exit(1)
 	}
+	if len(module) == 0 || len(options) == 0 {
+		fmt.Fprintln(os.Stderr, "empty module or options file")
+		os.Exit(1)
+	}
 
 	cerr := make([]C.char, errLen)
 	plugin := C.CString(os.Args[1])
@@ -106,14 +113,23 @@ func main() {
 		case "i":
 			types = append(types, 1)
 			svals = append(svals, nil)
-			n, _ := strconv.ParseInt(parts[2], 10, 64)
+			n, perr := strconv.ParseInt(parts[2], 10, 64)
+			if perr != nil {
+				fmt.Fprintln(os.Stderr, "bad int option value:", arg)
+				os.Exit(2)
+			}
 			ivals = append(ivals, C.int64_t(n))
 		case "b":
 			types = append(types, 2)
 			svals = append(svals, nil)
-			// numeric parse, matching the C host's atoll (so the two
-			// twins configure the client identically for any input)
-			n, _ := strconv.ParseInt(parts[2], 10, 64)
+			// numeric 0/1 like the C host's atoll; malformed values are
+			// rejected here (stricter than atoll's silent leading-digit
+			// parse) rather than silently configuring the client as 0
+			n, perr := strconv.ParseInt(parts[2], 10, 64)
+			if perr != nil {
+				fmt.Fprintln(os.Stderr, "bad bool option value:", arg)
+				os.Exit(2)
+			}
 			if n != 0 {
 				ivals = append(ivals, 1)
 			} else {
