@@ -22,14 +22,14 @@ from go_libp2p_pubsub_tpu.state import Net
 from go_libp2p_pubsub_tpu.trace.events import EV
 
 
-def build(v, n=24, d=3, msg_slots=16, flood=False):
+def build(v, n=24, d=3, msg_slots=16, flood=False, dynamic_peers=False):
     topo = graph.ring_lattice(n, d=d)
     subs = graph.subscribe_all(n, 1)
     net = Net.build(topo, subs)
     params = dataclasses.replace(GossipSubParams(), flood_publish=flood)
     cfg = GossipSubConfig.build(params, validation_delay_rounds=v)
     st = GossipSubState.init(net, msg_slots, cfg, seed=0)
-    step = make_gossipsub_step(cfg, net)
+    step = make_gossipsub_step(cfg, net, dynamic_peers=dynamic_peers)
     return net, cfg, st, step
 
 
@@ -223,3 +223,35 @@ def test_traced_run_under_delay(tmp_path):
     # every non-origin subscriber delivers exactly once, after validation
     assert len(dels) == 7
     assert all(sum(1 for _ in s) == 1 for s in subs)
+
+
+def test_churn_clears_pending_pipeline():
+    """A peer that dies mid-validation loses its pending receipts with the
+    rest of its soft state (handleDeadPeers pubsub.go:648-689): after
+    restart it re-receives and re-validates from scratch."""
+    v = 3
+    net, cfg, st, step = build(v, n=12, d=2, msg_slots=32,
+                               dynamic_peers=True)
+    up = np.ones(12, bool)
+
+    for _ in range(5):
+        st = step(st, *no_publish(), jnp.asarray(up))
+    st = step(st, *pub(0), jnp.asarray(up))
+    # one hop: direct neighbors (incl. 1 and 11) receive and enter the
+    # pipeline
+    st = step(st, *no_publish(), jnp.asarray(up))
+    pend = np.asarray(st.core.dlv.pending)
+    assert pend[1].any() and pend[11].any()
+    # peer 1 dies before its verdict completes
+    up[1] = False
+    st = step(st, *no_publish(), jnp.asarray(up))
+    assert not np.asarray(st.core.dlv.pending)[1].any()
+    assert not np.asarray(st.core.dlv.have)[1].any()
+    # it returns with fresh soft state and re-validates from scratch: its
+    # delivery must land a full pipeline (>= 1+v rounds) after the restart
+    up[1] = True
+    restart_tick = int(st.core.tick)
+    for _ in range(3 + v + 2):
+        st = step(st, *no_publish(), jnp.asarray(up))
+    fr = int(np.asarray(st.core.dlv.first_round)[1, 0])
+    assert fr >= restart_tick + 1 + v, (fr, restart_tick)
