@@ -132,3 +132,12 @@ class OracleFloodSub:
         for origin, topic, valid in publishes:
             self.publish(origin, topic, valid)
         self.tick += 1
+
+    def hops(self) -> dict:
+        """(node, slot) -> propagation hops of the first receipt."""
+        out = {}
+        for (i, slot), r in self.first_round.items():
+            msg = self.msgs.get(slot)
+            if msg is not None:
+                out[(i, slot)] = r - msg.birth
+        return out

@@ -129,3 +129,44 @@ def test_event_accounting_tracks_oracle():
         assert o > 0
         assert abs(v - o) / o <= 0.10, f"event {e}: vec {v} oracle {o}"
     assert int(ev_v[EV.PUBLISH_MESSAGE]) == int(ev_o[EV.PUBLISH_MESSAGE])
+
+
+def test_randomsub_propagation_cdf_within_2pct():
+    """RandomSub (sqrt-fanout) CDF parity against its scalar oracle —
+    distributional, like gossipsub (fresh random draws every round on
+    both sides)."""
+    from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+    from go_libp2p_pubsub_tpu.oracle.randomsub import OracleRandomSub
+    from go_libp2p_pubsub_tpu.state import SimState
+
+    import jax.numpy as jnp
+
+    topo = graph.random_connect(N, d=DEG, seed=5)
+    subs = graph.subscribe_all(N, 1)
+    schedule = publish_schedule()
+    n_msgs = PUB_ROUNDS * PUBS_PER_ROUND
+
+    net = Net.build(topo, subs)
+    st = SimState.init(N, MSG_SLOTS, seed=3, k=net.max_degree)
+    step = make_randomsub_step(net)
+    pt = jnp.zeros((PUBS_PER_ROUND,), jnp.int32)
+    pv = jnp.ones((PUBS_PER_ROUND,), bool)
+    for r in range(PUB_ROUNDS):
+        st = step(st, jnp.asarray(schedule[r]), pt, pv)
+    for _ in range(DRAIN):
+        st = step(st, *no_publish(PUBS_PER_ROUND))
+    h = np.asarray(hops(st.msgs, st.dlv))
+    hv = [int(x) for x in h[h >= 0]]
+
+    o = OracleRandomSub(topo, subs, msg_slots=MSG_SLOTS, seed=11)
+    for r in range(PUB_ROUNDS):
+        o.step([(int(p), 0, True) for p in schedule[r]])
+    for _ in range(DRAIN):
+        o.step()
+    ho = list(o.hops().values())
+
+    cv = cdf_from_hops(hv, n_msgs, N)
+    co = cdf_from_hops(ho, n_msgs, N)
+    sup = float(np.max(np.abs(cv - co)))
+    assert sup <= 0.02, f"CDF sup-distance {sup:.4f} > 2%\nvec={cv}\noracle={co}"
+    assert cv[-1] >= 0.999 and co[-1] >= 0.999
