@@ -30,6 +30,7 @@ CDF comparison):
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,7 @@ from ..config import (
     ticks_for,
 )
 from ..ops import bitset, edges
+from ..ops import fused_round as fr
 from ..ops.select import count_true, median_masked, select_random_mask, select_topk_mask
 from ..score.engine import (
     ScoreState,
@@ -62,6 +64,7 @@ from ..score.gater import GaterState, gater_accept, gater_decay, gater_on_round
 from ..state import Net, SimState, allocate_publishes
 from ..trace.events import EV
 from .common import (
+    RoundInfo,
     accumulate_round_events,
     delivery_round,
     origin_msg_words,
@@ -468,15 +471,9 @@ def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
 
 def _served_capped(cfg: GossipSubConfig, lo: jax.Array, hi: jax.Array) -> jax.Array:
     """Word-mask of slots whose 2-bit served count has reached the
-    retransmission cap (cap clamps to the counter range 0..3)."""
-    cap = min(max(cfg.gossip_retransmission, 0), 3)
-    if cap >= 3:
-        return hi & lo
-    if cap == 2:
-        return hi
-    if cap == 1:
-        return hi | lo
-    return jnp.full_like(lo, 0xFFFFFFFF)
+    retransmission cap (cap clamps to the counter range 0..3). Shared with
+    the fused kernel so the two paths cannot drift."""
+    return fr.served_capped_mask(cfg.gossip_retransmission, lo, hi)
 
 
 def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState,
@@ -1124,6 +1121,33 @@ def make_gossipsub_step(
     else:
         sender_fwd_ok = None
 
+    # fused Pallas data plane (ops/fused_round.py): the whole edge-crossing
+    # exchange + delivery as two kernels on banded topologies. Opt-in via
+    # PUBSUB_FUSED=1 (bit-identical to the XLA path — tests/
+    # test_fused_round.py): measured on the current libtpu the kernels
+    # lose to XLA's fusion pipeline (per-grid-step and strided-DMA
+    # overheads dominate the halo reads at these shapes), so the XLA path
+    # stays the production default. The async-validation pipeline always
+    # keeps the XLA path (pending stages live outside the kernel).
+    from .common import USE_PALLAS as _old_pallas
+
+    fused_env = os.environ.get("PUBSUB_FUSED", "")
+    fused_eligible = (
+        net.band_off is not None
+        and fr.fused_supported(net.n_peers, net.band_off, net.max_degree)
+        and cfg.validation_delay_rounds == 0
+        and not _old_pallas
+    )
+    fused_interp = jax.default_backend() != "tpu"
+    use_fused = fused_eligible and fused_env == "1"
+    fused_block = (
+        fr.pick_block(net.n_peers, net.band_off) if use_fused else None
+    )
+    sender_fwd_full = (
+        sender_fwd_ok if sender_fwd_ok is not None
+        else jnp.ones(net.nbr.shape, bool)
+    )
+
     def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next) -> GossipSubState:
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
         if dynamic_peers:
@@ -1230,7 +1254,9 @@ def make_gossipsub_step(
         # concatenated word tensor (graft | prune | ihave [| px] [| score])
         # and is split receiver-side — the vectorized analogue of the
         # reference piggybacking all control into one RPC (gossipsub.go:
-        # 1096-1141 sendRPC + piggyback).
+        # 1096-1141 sendRPC + piggyback). On banded topologies the gather
+        # runs as a Pallas halo kernel (ops/fused_round.edge_exchange) and
+        # the score plane rides as f32 instead of a bitcast word.
         parts = [
             edges.topic_pack(st.graft_out, net.my_topics, net.n_topics),
             edges.topic_pack(st.prune_out, net.my_topics, net.n_topics),
@@ -1240,13 +1266,35 @@ def make_gossipsub_step(
             parts.append(
                 edges.topic_pack(st.prune_px_out, net.my_topics, net.n_topics)
             )
-        if cfg.score_enabled:
+        if not use_fused and cfg.score_enabled:
             parts.append(
                 jax.lax.bitcast_convert_type(st.scores, jnp.uint32)[..., None]
             )
         sizes = np.cumsum([0] + [p.shape[-1] for p in parts])
-        wire = net_l.edge_gather(jnp.concatenate(parts, axis=-1))
-        wire = jnp.where(net_l.nbr_ok[:, :, None], wire, jnp.uint32(0))
+        n_peers = net.n_peers
+        k_dim = net.max_degree
+        if use_fused:
+            wc = int(sizes[-1])
+            wire_flat, nbr_score_of_me = fr.edge_exchange(
+                jnp.concatenate(parts, axis=-1).reshape(n_peers, k_dim * wc),
+                st.scores if cfg.score_enabled else None,
+                net_l.nbr_ok.astype(jnp.uint32),
+                block=fused_block, offsets=net.band_off, revs=net.band_rev,
+                c=wc, score_enabled=cfg.score_enabled,
+                interpret=fused_interp,
+            )
+            wire = wire_flat.reshape(n_peers, k_dim, wc)
+        else:
+            wire = net_l.edge_gather(jnp.concatenate(parts, axis=-1))
+            wire = jnp.where(net_l.nbr_ok[:, :, None], wire, jnp.uint32(0))
+            if cfg.score_enabled:
+                nbr_score_of_me = jnp.where(
+                    net_l.nbr_ok,
+                    jax.lax.bitcast_convert_type(wire[..., sizes[-1] - 1], jnp.float32),
+                    0.0,
+                )
+        if not cfg.score_enabled:
+            nbr_score_of_me = None
         w_seg = lambda i: wire[..., sizes[i] : sizes[i + 1]]
         ok_slots = net_l.nbr_ok[:, None, :]
         graft_in_raw = edges.topic_unpack(w_seg(0), net.my_topics) & ok_slots
@@ -1256,14 +1304,6 @@ def make_gossipsub_step(
             edges.topic_unpack(w_seg(3), net.my_topics) & ok_slots
             if cfg.do_px else None
         )
-        if cfg.score_enabled:
-            nbr_score_of_me = jnp.where(
-                net_l.nbr_ok,
-                jax.lax.bitcast_convert_type(w_seg(len(parts) - 1)[..., 0], jnp.float32),
-                0.0,
-            )
-        else:
-            nbr_score_of_me = None
 
         # 1. GRAFT/PRUNE ingest
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
@@ -1299,39 +1339,132 @@ def make_gossipsub_step(
         else:
             edge_live_next = st.edge_live
 
-        # 2. IWANT service (requests sent to me last round -> delivery carry)
-        st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me)
-
-        # 3. IHAVE ingest (advertisements -> next round's requests)
         joined_words = joined_msg_words(net_l, core.msgs)
-        st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok, ihave_in_raw)
-
-        # 4. delivery: mesh/fanout push + flood edges + IWANT responses
         slotw = slot_topic_words(net_l, core.msgs.topic)
         tw = topic_msg_words(core.msgs.topic, net_l.n_topics)
         pre_have = core.dlv.have
-        # floodsub-peer edges: sender floodsub => flood; receiver floodsub
-        # => gossipsub sender still sends everything (score-gated,
-        # gossipsub.go:973-978)
-        if cfg.score_enabled:
-            recv_ok = nbr_score_of_me >= cfg.publish_threshold
+        if use_fused:
+            # 2+3+4 fused: IHAVE ingest first (it consumes nothing the
+            # delivery kernel writes), then the whole delivery plane —
+            # mesh/fanout/flood push, echo suppression, IWANT service with
+            # retransmission counters, seen-cache dedup, first-arrival
+            # attribution — in one Pallas kernel over the post-graft mesh.
+            asked_old = st2.iwant_out
+            served_lo_old, served_hi_old = st2.served_lo, st2.served_hi
+            st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok, ihave_in_raw)
+
+            carry = sender_carry_words(st2.mesh, slotw)
+            if cfg.fanout_slots > 0:
+                carry = carry | fanout_carry_words(
+                    st2.fanout_peers, st2.fanout_topic, tw
+                )
+            origin_w = origin_msg_words(net_l, core.msgs)
+            if cfg.flood_publish:
+                # sender-side fold of v1.1 flood-publish: the origin pushes
+                # its own messages on every edge it scores above
+                # publishThreshold (gossipsub.go:957-963) — equivalent to
+                # the receiver-side origin compare, because nbr_score_of_me
+                # at the receiver IS the sender's score of that edge
+                fp_ok = (
+                    (st.scores >= cfg.publish_threshold)
+                    if cfg.score_enabled else net_l.nbr_ok
+                )
+                carry = carry | jnp.where(
+                    fp_ok[:, :, None], origin_w[:, None, :], jnp.uint32(0)
+                )
+            flags = fr.make_flags(
+                acc_msg, flood_from, i_am_floodsub, sender_fwd_full,
+                net_l.nbr_ok,
+            )
+            mcw = bitset.word_or_reduce(st2.mcache, axis=1)
+            w_dim = bitset.n_words(m)
+            kw = k_dim * w_dim
+            res = fr.fused_delivery(
+                carry.reshape(n_peers, kw),
+                core.dlv.fe_words.reshape(n_peers, kw),
+                core.dlv.fwd, mcw,
+                nbr_score_of_me,
+                asked_old.reshape(n_peers, kw),
+                served_lo_old.reshape(n_peers, kw),
+                served_hi_old.reshape(n_peers, kw),
+                flags, pre_have, origin_w, joined_words,
+                bitset.pack(core.msgs.valid)[None, :],
+                block=fused_block, offsets=net.band_off, revs=net.band_rev,
+                w=w_dim, score_enabled=cfg.score_enabled,
+                want_cohorts=cfg.count_events,
+                retrans_cap=cfg.gossip_retransmission,
+                gossip_thr=float(cfg.gossip_threshold),
+                publish_thr=float(cfg.publish_threshold),
+                interpret=fused_interp,
+            )
+            new_words_f = res["new"]
+            new_bits_f = bitset.unpack(new_words_f, m)
+            dlv = core.dlv.replace(
+                have=res["have"], fwd=res["fwd"],
+                first_round=jnp.where(new_bits_f, tick, core.dlv.first_round),
+                fe_words=res["fe"].reshape(n_peers, k_dim, w_dim),
+            )
+            st2 = st2.replace(
+                served_lo=res["served_lo"].reshape(n_peers, k_dim, w_dim),
+                served_hi=res["served_hi"].reshape(n_peers, k_dim, w_dim),
+            )
+            if cfg.count_events:
+                # cohort-split counters matching the XLA path's two-stage
+                # accounting (delivery_round then merge_extra_tx): RPCs
+                # count mesh-push and IWANT-response transmissions
+                # separately even when they overlap on an (edge, msg)
+                valid_pack = bitset.pack(core.msgs.valid)
+                n_rpc = (
+                    bitset.popcount(res["mesh_trans"], axis=None).sum()
+                    + bitset.popcount(res["extra"], axis=None).sum()
+                ).astype(jnp.int32)
+                n_new = bitset.popcount(new_words_f, axis=None).sum().astype(jnp.int32)
+                n_deliver = (
+                    bitset.popcount(new_words_f & valid_pack[None, :], axis=None)
+                    .sum().astype(jnp.int32)
+                )
+                n_reject = n_new - n_deliver
+                n_duplicate = n_rpc - n_new
+            else:
+                n_rpc = n_new = n_deliver = n_reject = n_duplicate = jnp.int32(0)
+            info = RoundInfo(
+                trans=res["trans"].reshape(n_peers, k_dim, w_dim),
+                new_words=new_words_f,
+                new_bits=new_bits_f,
+                recv_new_words=new_words_f,
+                n_deliver=n_deliver, n_reject=n_reject,
+                n_duplicate=n_duplicate, n_rpc=n_rpc,
+            )
         else:
-            recv_ok = net_l.nbr_ok
-        flood_edges = flood_from_l | (i_am_floodsub[:, None] & recv_ok & net_l.nbr_ok)
-        edge_mask = gossip_edge_mask(
-            cfg, net_l, st2, joined_words, acc_msg, slotw, tw, flood_edges,
-            nbr_score_of_me,
-        )
-        if sender_fwd_ok is not None:
-            edge_mask = jnp.where(sender_fwd_ok[:, :, None], edge_mask, jnp.uint32(0))
-            iwant_resp = jnp.where(sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0))
-        dlv, info = delivery_round(
-            net_l, core.msgs, core.dlv, edge_mask, tick,
-            count_events=cfg.count_events,
-        )
-        iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
-        dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick,
-                                   count_events=cfg.count_events)
+            # 2. IWANT service (requests sent to me last round -> delivery carry)
+            st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me)
+
+            # 3. IHAVE ingest (advertisements -> next round's requests)
+            st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok, ihave_in_raw)
+
+            # 4. delivery: mesh/fanout push + flood edges + IWANT responses
+            # floodsub-peer edges: sender floodsub => flood; receiver floodsub
+            # => gossipsub sender still sends everything (score-gated,
+            # gossipsub.go:973-978)
+            if cfg.score_enabled:
+                recv_ok = nbr_score_of_me >= cfg.publish_threshold
+            else:
+                recv_ok = net_l.nbr_ok
+            flood_edges = flood_from_l | (i_am_floodsub[:, None] & recv_ok & net_l.nbr_ok)
+            edge_mask = gossip_edge_mask(
+                cfg, net_l, st2, joined_words, acc_msg, slotw, tw, flood_edges,
+                nbr_score_of_me,
+            )
+            if sender_fwd_ok is not None:
+                edge_mask = jnp.where(sender_fwd_ok[:, :, None], edge_mask, jnp.uint32(0))
+                iwant_resp = jnp.where(sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0))
+            dlv, info = delivery_round(
+                net_l, core.msgs, core.dlv, edge_mask, tick,
+                count_events=cfg.count_events,
+            )
+            iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
+            dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick,
+                                       count_events=cfg.count_events)
 
         # 4b. validation front-end throttle (validation.go:230-244)
         valid_words_all = bitset.pack(core.msgs.valid)

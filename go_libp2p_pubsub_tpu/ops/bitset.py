@@ -91,8 +91,17 @@ def lowest_bit(words: jax.Array) -> tuple[jax.Array, jax.Array]:
     variadic reduce that profiled several times slower at N=100k."""
     nonzero = words != 0
     any_set = jnp.any(nonzero, axis=-1)
-    csum = jnp.cumsum(nonzero.astype(jnp.int32), axis=-1)
-    firstmask = nonzero & (csum == 1)
+    # first nonzero word via an unrolled prefix-OR: jnp.cumsum over the tiny
+    # word axis lowers to reduce_window (~110 us/round at N=100k); W static
+    # ops fuse to nothing
+    w_dim = words.shape[-1]
+    prefix_any = [nonzero[..., 0]]
+    for i in range(1, w_dim):
+        prefix_any.append(prefix_any[-1] | nonzero[..., i])
+    seen_before = jnp.stack(
+        [jnp.zeros_like(prefix_any[0])] + prefix_any[:-1], axis=-1
+    )
+    firstmask = nonzero & ~seen_before
     word = jnp.sum(jnp.where(firstmask, words, jnp.uint32(0)), axis=-1,
                    dtype=jnp.uint32)
     widx = jnp.sum(

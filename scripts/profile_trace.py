@@ -4,12 +4,14 @@ Captures a jax.profiler trace of one scanned segment and prints the top HLO
 ops by self time — the attribution the ablation timer can't give on the
 tunneled platform (per-call dispatch RTT swamps isolated-phase timings).
 
+Builds the EXACT bench workload (bench.build_bench) so op attribution maps
+1:1 onto what BENCH_r*.json measures; BENCH_CONFIG selects the variant.
+
 Usage: python scripts/profile_trace.py [N] [ROUNDS]
 """
 
 from __future__ import annotations
 
-import dataclasses
 import glob
 import os
 import sys
@@ -17,56 +19,26 @@ import sys
 import numpy as np
 
 
-def build(n_peers: int, msg_slots: int):
-    import jax
-    import jax.numpy as jnp
-
-    sys.path.insert(0, ".")
-    from go_libp2p_pubsub_tpu import graph
-    from go_libp2p_pubsub_tpu.config import (
-        GossipSubParams,
-        PeerScoreParams,
-        PeerScoreThresholds,
-        TopicScoreParams,
-    )
-    from go_libp2p_pubsub_tpu.models.gossipsub import (
-        GossipSubConfig,
-        GossipSubState,
-        make_gossipsub_step,
-    )
-    from go_libp2p_pubsub_tpu.state import Net
-
-    topo = graph.ring_lattice(n_peers, d=8)
-    subs = graph.subscribe_all(n_peers, 1)
-    net = Net.build(topo, subs)
-    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
-    tp = TopicScoreParams(
-        mesh_message_deliveries_weight=0.0, mesh_failure_penalty_weight=0.0
-    )
-    sp = PeerScoreParams(
-        topics={0: tp},
-        skip_app_specific=True,
-        behaviour_penalty_weight=-1.0,
-        behaviour_penalty_threshold=1.0,
-        behaviour_penalty_decay=0.9,
-    )
-    cfg = GossipSubConfig.build(params, PeerScoreThresholds(), score_enabled=True)
-    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=0)
-    step = make_gossipsub_step(cfg, net, score_params=sp)
-    return st, step
-
-
 def main():
     import jax
     import jax.numpy as jnp
 
+    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import build_bench
+
+    config = os.environ.get("BENCH_CONFIG", "default")
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 50
-    st, step = build(n, 64)
+    st, step, n_topics, honest = build_bench(n, 64, config=config)
 
     rng = np.random.default_rng(0)
-    po = jnp.asarray(rng.integers(0, n, size=(rounds, 4)).astype(np.int32))
-    pt = jnp.asarray(np.zeros((rounds, 4), np.int32))
+    if honest is not None:
+        po = honest[rng.integers(0, len(honest), size=(rounds, 4))].astype(np.int32)
+    else:
+        po = rng.integers(0, n, size=(rounds, 4)).astype(np.int32)
+    po = jnp.asarray(po)
+    pt = jnp.asarray(rng.integers(0, n_topics, size=(rounds, 4)).astype(np.int32))
     pv = jnp.asarray(np.ones((rounds, 4), bool))
 
     def run_seg(s):
@@ -86,17 +58,46 @@ def main():
         jax.block_until_ready(st)
 
     # ---- summarize: top ops by self time -------------------------------
+    # (xprof's converter works where tensorboard_plugin_profile 2.13 fails)
     paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
     print("xplane:", paths)
-    from tensorboard_plugin_profile.convert import raw_to_tool_data
+    from xprof.convert import raw_to_tool_data
 
     data, _ = raw_to_tool_data.xspace_to_tool_data(paths, "hlo_stats", {})
     import json
 
+    obj = data if isinstance(data, dict) else json.loads(data)
     out_path = "/tmp/pubsub_prof/hlo_stats.json"
     with open(out_path, "w") as f:
-        f.write(data if isinstance(data, str) else str(data))
+        json.dump(obj, f, default=lambda o: o.decode() if isinstance(o, bytes) else str(o))
     print("wrote", out_path)
+    rows = [r["c"] if isinstance(r, dict) else r for r in obj["rows"]]
+
+    def val(r, i):
+        v = r[i]
+        return v.get("v") if isinstance(v, dict) else v
+
+    items, total = [], 0.0
+    from collections import defaultdict
+
+    bycat = defaultdict(float)
+    for r in rows:
+        selft = float(val(r, 9) or 0)
+        total += selft
+        bycat[val(r, 2)] += selft
+        items.append((selft, val(r, 3), (val(r, 4) or ""), (val(r, 25) or "")))
+    items.sort(reverse=True)
+    print(f"total device self time: {total/1e3:.1f} ms; per round: {total/rounds:.0f} us")
+    print("\nby category:")
+    for k, v in sorted(bycat.items(), key=lambda x: -x[1]):
+        print(f"  {v/rounds:8.1f} us/rd {100*v/total:5.1f}%  {k}")
+    print("\ntop 30 ops:")
+    for selft, name, text, src in items[:30]:
+        import re
+
+        s = re.sub(r"<[^>]+>", "", src)
+        print(f"  {selft/rounds:7.1f} us/rd {name:<30} {s.strip()[:80]}")
+        print(f"      {text[:140]}")
 
 
 if __name__ == "__main__":

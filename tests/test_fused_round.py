@@ -1,0 +1,247 @@
+"""Fused Pallas data plane (ops/fused_round.py) vs the XLA path.
+
+Runs full multi-round simulations through make_gossipsub_step twice — once
+with PUBSUB_FUSED=1 (interpret mode on CPU) and once with the XLA path —
+and asserts the complete state trees stay bit-identical. Both paths consume
+the same PRNG streams (selection/gater randomness lives outside the
+kernel), so exact equality is the contract, not a tolerance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerGaterParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.ops import fused_round as fr
+from go_libp2p_pubsub_tpu.state import Net
+
+
+def _build(n=96, d=4, n_topics=1, msg_slots=32, score=True, flood_publish=False,
+           gater=False, adversary=None, protocol=None, validation_capacity=0,
+           fanout=False, do_px=False, count_events=True):
+    topo = graph.ring_lattice(n, d=d)
+    if n_topics == 1:
+        subs = graph.subscribe_all(n, 1)
+    else:
+        subs = graph.subscribe_random(n, n_topics=n_topics, topics_per_peer=2,
+                                      seed=3)
+    net = Net.build(topo, subs, protocol=protocol)
+    assert net.band_off is not None, "test topology must be banded"
+    params = dataclasses.replace(
+        GossipSubParams(), flood_publish=flood_publish, do_px=do_px
+    )
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=-0.2,
+        mesh_message_deliveries_threshold=2.0,
+        mesh_message_deliveries_activation=4.0,
+        mesh_message_deliveries_window=2.0,
+    )
+    sp = PeerScoreParams(
+        topics={t: tp for t in range(n_topics)},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    gp = PeerGaterParams() if gater else None
+    cfg = GossipSubConfig.build(
+        params, PeerScoreThresholds(), score_enabled=score, gater_params=gp,
+        validation_capacity=validation_capacity,
+    )
+    if not fanout:
+        cfg = dataclasses.replace(cfg, fanout_slots=0)
+    cfg = dataclasses.replace(cfg, count_events=count_events)
+    st = GossipSubState.init(net, msg_slots, cfg,
+                             score_params=sp if score else None, seed=0)
+    return net, cfg, sp, gp, st, adversary
+
+
+def _run_both(n_rounds, invalid_every=0, **kw):
+    net, cfg, sp, gp, st0, adversary = _build(**kw)
+    n = net.n_peers
+    rng = np.random.default_rng(0)
+    po = rng.integers(0, n, size=(n_rounds, 4)).astype(np.int32)
+    pt = rng.integers(0, net.n_topics, size=(n_rounds, 4)).astype(np.int32)
+    pv = np.ones((n_rounds, 4), bool)
+    if invalid_every:
+        pv[::invalid_every, 0] = False
+
+    results = []
+    for fused in ("0", "1"):
+        os.environ["PUBSUB_FUSED"] = fused
+        try:
+            step = make_gossipsub_step(
+                cfg, net, score_params=sp if cfg.score_enabled else None,
+                gater_params=gp, adversary_no_forward=adversary,
+            )
+            st = jax.tree.map(jnp.copy, st0)
+            for r in range(n_rounds):
+                st = step(st, jnp.asarray(po[r]), jnp.asarray(pt[r]),
+                          jnp.asarray(pv[r]))
+            results.append(jax.device_get(st))
+        finally:
+            del os.environ["PUBSUB_FUSED"]
+    ref, fus = results
+    _assert_trees_equal(ref, fus)
+    return ref
+
+
+def _assert_trees_equal(ref, fus):
+    paths_r = jax.tree_util.tree_flatten_with_path(ref)[0]
+    flat_f = jax.tree.leaves(fus)
+    for (path, a), b in zip(paths_r, flat_f):
+        if jnp.issubdtype(jnp.asarray(a).dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"mismatch in {jax.tree_util.keystr(path)}"
+        )
+
+
+def test_supported_detects_banded():
+    topo = graph.ring_lattice(64, d=4)
+    net = Net.build(topo, graph.subscribe_all(64, 1))
+    assert fr.fused_supported(net.n_peers, net.band_off, net.max_degree)
+    assert fr.pick_block(64, net.band_off) == 64
+
+
+def test_parity_v11_scoring():
+    st = _run_both(24, score=True)
+    # sanity: traffic actually flowed
+    assert int(np.asarray(st.core.events).sum()) > 0
+
+
+def test_parity_v10_no_score():
+    _run_both(20, score=False)
+
+
+def test_parity_invalid_messages():
+    _run_both(20, score=True, invalid_every=3)
+
+
+def test_parity_flood_publish():
+    _run_both(16, score=True, flood_publish=True)
+
+
+def test_parity_multi_topic_fanout():
+    _run_both(20, n_topics=8, fanout=True, msg_slots=32)
+
+
+def test_parity_gater_and_throttle():
+    _run_both(16, gater=True, validation_capacity=2)
+
+
+def test_parity_adversary():
+    rng = np.random.default_rng(1)
+    adv = rng.random(96) < 0.25
+    _run_both(20, adversary=adv)
+
+
+def test_parity_floodsub_interop():
+    proto = np.full(96, 2, np.int8)
+    proto[::7] = 0  # floodsub-only peers
+    _run_both(20, protocol=proto)
+
+
+def test_parity_no_events():
+    _run_both(12, count_events=False)
+
+
+def test_parity_do_px_dormant_edges():
+    # PX wire segment + edge_live-masked live set through the kernel
+    n = 96
+    topo = graph.ring_lattice(n, d=4)
+    dormant = graph.dormant_edges(topo, 0.3, seed=5)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    params = dataclasses.replace(GossipSubParams(), do_px=True)
+    tp = TopicScoreParams()
+    sp = PeerScoreParams(topics={0: tp}, skip_app_specific=True,
+                         behaviour_penalty_weight=-1.0,
+                         behaviour_penalty_threshold=1.0,
+                         behaviour_penalty_decay=0.9)
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
+                                score_enabled=True)
+    cfg = dataclasses.replace(cfg, fanout_slots=0)
+    st0 = GossipSubState.init(net, 32, cfg, score_params=sp, seed=0,
+                              dormant=dormant)
+    rng = np.random.default_rng(2)
+    po = rng.integers(0, n, size=(20, 4)).astype(np.int32)
+    results = []
+    for fused in ("0", "1"):
+        os.environ["PUBSUB_FUSED"] = fused
+        try:
+            step = make_gossipsub_step(cfg, net, score_params=sp)
+            st = jax.tree.map(jnp.copy, st0)
+            for r in range(20):
+                st = step(st, jnp.asarray(po[r]),
+                          jnp.asarray(np.zeros(4, np.int32)),
+                          jnp.asarray(np.ones(4, bool)))
+            results.append(jax.device_get(st))
+        finally:
+            del os.environ["PUBSUB_FUSED"]
+    _assert_trees_equal(results[0], results[1])
+
+
+def test_parity_dynamic_peers_churn():
+    net, cfg, sp, gp, st0, _ = _build()
+    n = net.n_peers
+    rng = np.random.default_rng(4)
+    po = rng.integers(0, n, size=(20, 4)).astype(np.int32)
+    up = np.ones((20, n), bool)
+    up[8:14, ::9] = False  # a churn window taking ~11% of peers down
+    results = []
+    for fused in ("0", "1"):
+        os.environ["PUBSUB_FUSED"] = fused
+        try:
+            step = make_gossipsub_step(cfg, net, score_params=sp,
+                                       dynamic_peers=True)
+            st = jax.tree.map(jnp.copy, st0)
+            for r in range(20):
+                st = step(st, jnp.asarray(po[r]),
+                          jnp.asarray(np.zeros(4, np.int32)),
+                          jnp.asarray(np.ones(4, bool)),
+                          jnp.asarray(up[r]))
+            results.append(jax.device_get(st))
+        finally:
+            del os.environ["PUBSUB_FUSED"]
+    _assert_trees_equal(results[0], results[1])
+
+
+def test_parity_heartbeat_every_3():
+    net, cfg, sp, gp, st0, _ = _build()
+    cfg = dataclasses.replace(cfg, heartbeat_every=3)
+    n = net.n_peers
+    po, pt, pv = no_publish()
+    results = []
+    for fused in ("0", "1"):
+        os.environ["PUBSUB_FUSED"] = fused
+        try:
+            step = make_gossipsub_step(cfg, net, score_params=sp)
+            st = jax.tree.map(jnp.copy, st0)
+            po2 = jnp.asarray(np.array([1, -1, -1, -1], np.int32))
+            pt2 = jnp.asarray(np.zeros(4, np.int32))
+            pv2 = jnp.asarray(np.ones(4, bool))
+            for r in range(9):
+                st = step(st, po2, pt2, pv2)
+            results.append(jax.device_get(st))
+        finally:
+            del os.environ["PUBSUB_FUSED"]
+    _assert_trees_equal(results[0], results[1])
