@@ -59,7 +59,13 @@ from .config import (
 from .discovery import Discovery, DiscoverySession, min_topic_size
 from .pb import rpc_pb2
 from .sign import Identity, SignPolicy, check_signing_policy, sign_message
-from .state import Net, SimState
+from .state import (
+    VERDICT_ACCEPT,
+    VERDICT_IGNORE,
+    VERDICT_REJECT,
+    Net,
+    SimState,
+)
 from .subscription_filter import SubscriptionFilter
 from .trace.drain import TraceSession, snapshot
 
@@ -76,8 +82,21 @@ class APIError(RuntimeError):
     pass
 
 
+class ValidationResult:
+    """Topic-validator verdicts (ValidationResult, validation.go:40-52).
+
+    Validators may return one of these, or a plain bool (True = ACCEPT,
+    False = REJECT — the original two-verdict interface). IGNORE drops
+    the message without penalizing its senders (score.go:768-774)."""
+
+    ACCEPT = VERDICT_ACCEPT
+    REJECT = VERDICT_REJECT
+    IGNORE = VERDICT_IGNORE
+
+
 class ValidationError(APIError):
-    """Local publish rejected (reject or throttle), like PushLocal errors."""
+    """Local publish rejected (reject, ignore, or throttle) — the errors
+    PushLocal surfaces to the publisher (validation.go:216-244,339-341)."""
 
 
 class NotReadyError(APIError):
@@ -293,6 +312,12 @@ class Node:
         return t
 
     def leave(self, topic: str) -> None:
+        """Leave a topic (Topic.Close + router Leave, gossipsub.go:1066).
+
+        On a *started* gossipsub network this advances the simulation by
+        one transition round so the PRUNE crosses the wire before the
+        mesh is rebuilt — tick-sensitive observables (heartbeat phase,
+        score decay, run(rounds) totals) shift by that extra round."""
         t = self.topics.pop(topic, None)
         if t is not None:
             t.close()
@@ -379,6 +404,7 @@ class Network:
         max_publishes_per_round: int = 8,
         validate_throttle: int = DEFAULT_VALIDATE_THROTTLE,
         validation_delay_rounds: int = 0,
+        queue_cap: int = 0,
         seed: int = 0,
         trace_sinks=None,
         msg_id_fn: Callable | None = None,
@@ -391,6 +417,8 @@ class Network:
             raise APIError(
                 "validation_delay_rounds is only modeled on the gossipsub router"
             )
+        if queue_cap and router != "gossipsub":
+            raise APIError("queue_cap is only modeled on the gossipsub router")
         self.router = router
         self.params = params or GossipSubParams()
         self.score_params = score_params
@@ -401,6 +429,7 @@ class Network:
         self.pub_width = max_publishes_per_round
         self.validate_throttle = validate_throttle
         self.validation_delay_rounds = validation_delay_rounds
+        self.queue_cap = queue_cap
         self.seed = seed
         self.trace_sinks = trace_sinks
         self.msg_id_fn = msg_id_fn or default_msg_id
@@ -725,6 +754,7 @@ class Network:
                 score_enabled=score_enabled,
                 gater_params=self.gater_params,
                 validation_delay_rounds=self.validation_delay_rounds,
+                queue_cap=self.queue_cap,
             )
             self.state = GossipSubState.init(
                 self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed
@@ -754,8 +784,13 @@ class Network:
 
             self.tag_tracer = TagTracer(self.net)
         if self.trace_sinks:
+            # with engine-enforced backpressure the session's bookkeeping
+            # DropRPC model must be off — drops are real (and counted in
+            # the DROP_RPC event counter), so modeling them again would
+            # emit phantom or missing drop events
             self._session = TraceSession(
                 self.net, self.trace_sinks,
+                queue_cap=0 if self.queue_cap else 32,
                 topic_name=lambda t: self.topic_names.get(t, f"topic-{t}"),
             )
             self._session.emit_init(snapshot(self.state))
@@ -775,9 +810,9 @@ class Network:
         # local validation front-end (PushLocal validation.go:216-226):
         # signing policy, then inline + async validators
         check_signing_policy(self.sign_policy, msg)
-        valid = self._run_validators(node, topic, msg, local=True)
+        verdict = self._run_validators(node, topic, msg, local=True)
         mid = self.msg_id_fn(msg)
-        self._pub_queue.append((node.idx, topic.tid, valid, msg, mid))
+        self._pub_queue.append((node.idx, topic.tid, verdict, msg, mid))
         # local delivery to the publisher's own subscriptions happens at
         # publish (publishMessage -> notifySubs, pubsub.go:1124-1128)
         for sub in list(topic._subs):
@@ -785,10 +820,13 @@ class Network:
                 sub._push(msg)
         return mid
 
-    def _run_validators(self, node: Node, topic: Topic, msg, local: bool) -> bool:
+    def _run_validators(self, node: Node, topic: Topic, msg, local: bool) -> int:
+        """Returns a VERDICT_* code. Local publishes surface reject and
+        ignore as ValidationError, matching validate()'s errors back to
+        Publish (validation.go:318-322, 339-341)."""
         v = self._validators.get(topic.name)
         if v is None:
-            return True
+            return VERDICT_ACCEPT
         if not v.inline:
             tb = self._topic_budget.setdefault(topic.name, v.throttle)
             if self._async_budget <= 0 or tb <= 0:
@@ -797,11 +835,20 @@ class Network:
             self._async_budget -= 1
             self._topic_budget[topic.name] = tb - 1
         res = v.fn(node.identity.peer_id, msg)
-        if res is False:
+        # bool returns keep the original two-verdict interface. Normalize
+        # by type first: bools (incl. numpy bools) overlap the int codes
+        # 1/0, so a truthiness check must precede the code comparison
+        if isinstance(res, (bool, np.bool_)):
+            res = VERDICT_ACCEPT if res else VERDICT_REJECT
+        if res == VERDICT_REJECT:
             if local:
                 raise ValidationError("message rejected by validator")
-            return False
-        return True
+            return VERDICT_REJECT
+        if res == VERDICT_IGNORE:
+            if local:
+                raise ValidationError("message ignored by validator")
+            return VERDICT_IGNORE
+        return VERDICT_ACCEPT
 
     # -- run loop ----------------------------------------------------------
 
@@ -813,7 +860,7 @@ class Network:
         jnp = self._jnp
         po = np.full(self.pub_width, -1, np.int32)
         pt = np.zeros(self.pub_width, np.int32)
-        pv = np.zeros(self.pub_width, bool)
+        pv = np.zeros(self.pub_width, np.int8)  # VERDICT_* codes
         prev = snapshot(self.state)
         args = (self.state, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
         if self._dynamic:
@@ -846,13 +893,13 @@ class Network:
             _t0 = time.perf_counter()
             po = np.full(self.pub_width, -1, np.int32)
             pt = np.zeros(self.pub_width, np.int32)
-            pv = np.zeros(self.pub_width, bool)
+            pv = np.zeros(self.pub_width, np.int8)  # VERDICT_* codes
             batch = []
             for j in range(self.pub_width):
                 if not self._pub_queue:
                     break
-                origin, tid, valid, msg, mid = self._pub_queue.popleft()
-                po[j], pt[j], pv[j] = origin, tid, valid
+                origin, tid, verdict, msg, mid = self._pub_queue.popleft()
+                po[j], pt[j], pv[j] = origin, tid, verdict
                 batch.append((msg, mid))
 
             prev = snapshot(self.state)

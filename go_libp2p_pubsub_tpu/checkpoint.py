@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 # v2: Delivery.first_edge [N,M] i8 replaced by packed fe_words [N,K,W] u32
-_FORMAT_VERSION = 2
+# v3: MsgTable grew the `ignored` verdict plane (ValidationIgnore)
+_FORMAT_VERSION = 3
 
 
 def _is_key(leaf) -> bool:
@@ -56,7 +57,18 @@ def restore(path: str, template):
             raise ValueError(f"{path} is not a go_libp2p_pubsub_tpu checkpoint")
         version = int(data["__version__"])
         if version != _FORMAT_VERSION:
-            raise ValueError(f"unknown checkpoint version {version}")
+            if version < _FORMAT_VERSION:
+                raise ValueError(
+                    f"checkpoint format v{version} predates the current "
+                    f"v{_FORMAT_VERSION} (state leaves changed shape/"
+                    "meaning — see the version history at the top of "
+                    "checkpoint.py); re-create the checkpoint from source "
+                    "state — no migration path is provided"
+                )
+            raise ValueError(
+                f"checkpoint format v{version} is newer than this build's "
+                f"v{_FORMAT_VERSION}"
+            )
         t_leaves, treedef = jax.tree_util.tree_flatten(template)
         n = int(data["__n_leaves__"])
         if n != len(t_leaves):

@@ -59,6 +59,9 @@ class RoundInfo:
     n_reject: jax.Array     # i64 — validated receipts of invalid messages
     n_duplicate: jax.Array  # i64 — arrivals beyond the first per (peer,msg)
     n_rpc: jax.Array        # i64 — total (edge, msg) transmissions
+    n_drop: jax.Array = struct.field(default_factory=lambda: jnp.int32(0))
+    # ^ transmissions lost to the outbound-queue cap (doDropRPC,
+    #   gossipsub.go:1153-1160; comm.go:139-170) — 0 when queue_cap is off
 
 
 def member_msg_words(member: jax.Array, msg_topic: jax.Array) -> jax.Array:
@@ -121,6 +124,8 @@ def delivery_round(
     tick: jax.Array,
     forward_mask: jax.Array | None = None,  # [N, W] extra gate on what gets re-forwarded
     count_events: bool = True,
+    queue_cap: int = 0,    # per-edge outbound message budget per round
+                           # (pubsub.go:240's 32-deep queue); 0 = lossless
 ) -> tuple[Delivery, RoundInfo]:
     """Advance one propagation round: transmit every sender's `fwd` set along
     permitted edges, dedup against the seen-cache, record first receipts.
@@ -154,7 +159,7 @@ def delivery_round(
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
 
     if (USE_PALLAS and net.band_off is not None and forward_mask is None
-            and val_delay == 0):
+            and val_delay == 0 and queue_cap == 0):
         from ..ops.pallas_delivery import pallas_supported
 
         block = min(_pallas_block(), n)
@@ -178,6 +183,19 @@ def delivery_round(
     not_mine = ~origin_msg_words(net, msgs)  # [N, W]
 
     trans = fwd_gathered & ~echo_words & edge_mask & ok_words & not_mine[:, None, :]
+
+    n_drop = jnp.int32(0)
+    if queue_cap > 0:
+        # outbound-queue backpressure: each directed link carries at most
+        # queue_cap messages per round; the overflow is genuinely LOST —
+        # the reference drops the whole RPC when the per-peer writer queue
+        # is full (doDropRPC gossipsub.go:1155-1160, comm.go:139-170).
+        # Lowest slots first models "queue fills, later sends dropped".
+        want = trans
+        trans = bitset.prefix_cap_bits(
+            want, jnp.full(want.shape[:2], queue_cap, jnp.int32), m
+        )
+        n_drop = bitset.popcount(want & ~trans, axis=None).sum().astype(jnp.int32)
 
     recv_words = bitset.word_or_reduce(trans, axis=1)  # [N, W]
     new_words = recv_words & ~dlv.have
@@ -217,7 +235,7 @@ def delivery_round(
     )
 
     info = _round_info(trans, validated, m, valid_words, count_events)
-    info = info.replace(recv_new_words=new_words)
+    info = info.replace(recv_new_words=new_words, n_drop=n_drop)
     if count_events and val_delay > 0:
         # arrival-cohort counters (duplicates/rpc) are already arrival-based
         # inside _round_info only when the cohorts coincide; recompute here
@@ -302,4 +320,5 @@ def accumulate_round_events(events: jax.Array, info: RoundInfo, n_publish) -> ja
     ev = ev.at[EV.DUPLICATE_MESSAGE].add(info.n_duplicate)
     ev = ev.at[EV.SEND_RPC].add(info.n_rpc)
     ev = ev.at[EV.RECV_RPC].add(info.n_rpc)
+    ev = ev.at[EV.DROP_RPC].add(info.n_drop)
     return ev

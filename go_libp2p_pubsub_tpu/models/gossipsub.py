@@ -19,9 +19,16 @@ CDF comparison):
   * per-heartbeat GRAFT processing is batched, so Dhi admission checks use
     mesh sizes from the round start
   * one outstanding IWANT promise slot per edge (reference keeps one per
-    IWANT batch; AddPromise gossip_tracer.go:48-75)
+    IWANT batch; AddPromise gossip_tracer.go:48-75). Measured at
+    adversarial advertise-never-serve rates (tests/
+    test_promise_sensitivity.py): the per-batch model accrues ~2.3x the
+    P7 of the per-edge model, but both drive attacker edges under the
+    gossip threshold and leave honest edges clean — the protective
+    outcome is granularity-insensitive
   * IHAVE truncation to MaxIHaveLength keeps lowest slots (reference
-    shuffles; with msg_slots << 5000 the cap rarely binds)
+    shuffles; gossipsub.go:655-667). With the cap forced to bind hard
+    (budget 4 vs 64-slot windows) the two policies' propagation CDFs
+    differ by 0.3% sup — far inside the parity envelope
   * over-subscription outbound bubble-up displaces random-keep members only
     (the reference's rotation can displace score-keep members in corner
     cases, gossipsub.go:1409-1441)
@@ -106,6 +113,11 @@ class GossipSubConfig:
     score_enabled: bool = False
     flood_publish: bool = False
     do_px: bool = False
+    # outbound-queue backpressure: per-link message budget per round; the
+    # overflow is genuinely lost and traced DROP_RPC (the reference's
+    # 32-deep per-peer writer queue, pubsub.go:240 + comm.go:139-170).
+    # 0 = lossless (unmodeled), the default
+    queue_cap: int = 0
     # peer gater + validation pipeline model (validation.go front-end queue;
     # 0 capacity = unbounded, gater inert without throttle pressure)
     gater_enabled: bool = False
@@ -139,6 +151,7 @@ class GossipSubConfig:
         gater_params: "PeerGaterParams | None" = None,
         validation_capacity: int = 0,
         validation_delay_rounds: int = 0,
+        queue_cap: int = 0,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
@@ -164,6 +177,7 @@ class GossipSubConfig:
             gater_quiet_ticks=ticks_for(gater_params.quiet, hb) if gater_params else 60,
             validation_capacity=validation_capacity,
             validation_delay_rounds=validation_delay_rounds,
+            queue_cap=queue_cap,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if thresholds is not None:
@@ -413,12 +427,7 @@ def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: d
     return st, rejected, px_resp, px_ok, n_graft, n_prune
 
 
-def _prefix_cap_bits(words: jax.Array, cap: jax.Array, m: int) -> jax.Array:
-    """Keep only the first `cap` set bits (lowest slots) of each packed row."""
-    bits = bitset.unpack(words, m)
-    csum = jnp.cumsum(bits.astype(jnp.int32), axis=-1)
-    keep = bits & (csum <= cap[..., None])
-    return bitset.pack(keep)
+_prefix_cap_bits = bitset.prefix_cap_bits
 
 
 def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
@@ -655,14 +664,28 @@ def update_fanout_on_publish(
 
 
 def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
-                   count_events: bool = True):
+                   count_events: bool = True, queue_cap: int = 0):
     """Fold IWANT-response transmissions (not part of senders' fwd sets)
     into the round's delivery results. With the async-validation pipeline
     these receipts enter stage 0 like any other arrival; their verdict
-    (forward/Deliver/first_round) happens at pipeline exit."""
+    (forward/Deliver/first_round) happens at pipeline exit.
+
+    With `queue_cap` the responses share the link's outbound budget with
+    the mesh push already in `info.trans` — overflow is dropped and
+    counted (IWANT responses are ordinary messages in the reference's
+    per-peer writer queue, comm.go:139-170)."""
     m = core.msgs.capacity
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
     extra = extra & ~origin_msg_words(net, core.msgs)[:, None, :]
+    if queue_cap > 0:
+        used = bitset.popcount(info.trans, axis=-1)  # [N,K]
+        budget = jnp.maximum(queue_cap - used, 0)
+        want = extra
+        extra = _prefix_cap_bits(want, budget, m)
+        info = info.replace(
+            n_drop=info.n_drop
+            + bitset.popcount(want & ~extra, axis=None).sum().astype(jnp.int32)
+        )
 
     recv = bitset.word_or_reduce(extra, axis=1)
     new_words = recv & ~dlv.have
@@ -720,10 +743,13 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               score_params: PeerScoreParams | None,
               nbr_sub: jax.Array, gater_params=None,
               nbr_sub_words: jax.Array | None = None,
-              present_ok: jax.Array | None = None) -> GossipSubState:
+              present_ok: jax.Array | None = None,
+              gossip_suppress: jax.Array | None = None) -> GossipSubState:
     """`net` is the live view (nbr_ok masked by churn/edge-liveness);
     `present_ok` is the static edge-presence mask, needed by directConnect
-    to re-dial edges that are currently dormant (defaults to net.nbr_ok)."""
+    to re-dial edges that are currently dormant (defaults to net.nbr_ok).
+    `gossip_suppress` [N,K] marks congested outbound links whose IHAVE
+    batch is dropped this heartbeat (queue_cap backpressure)."""
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -916,6 +942,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     # ---- emitGossip (gossipsub.go:1669-1723) ----------------------------
     gwin = bitset.word_or_reduce(st.mcache[:, : cfg.history_gossip, :], axis=1)  # [N,W]
     gossip_cand = connected & nbr_sub & ~mesh & ~net.direct[:, None, :]
+    if gossip_suppress is not None:
+        gossip_cand = gossip_cand & ~gossip_suppress[:, None, :]
     if cfg.score_enabled:
         gossip_cand = gossip_cand & (scores_b >= cfg.gossip_threshold)
     n_cand = count_true(gossip_cand)
@@ -931,6 +959,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     # fanout-topic gossip (gossipsub.go:1551-1553; fanout peers excluded)
     if nbr_sub_words is not None and cfg.fanout_slots > 0:
         gossip_cand_f = base_f & ~fpeers
+        if gossip_suppress is not None:
+            gossip_cand_f = gossip_cand_f & ~gossip_suppress[:, None, :]
         if cfg.score_enabled:
             gossip_cand_f = gossip_cand_f & (scores[:, None, :] >= cfg.gossip_threshold)
         n_cand_f = count_true(gossip_cand_f)
@@ -1070,6 +1100,12 @@ def make_gossipsub_step(
 
     step(state, pub_origin[P], pub_topic[P], pub_valid[P]) -> state
 
+    ``pub_valid`` is either bool (True = accept, False = reject) or an
+    integer array of state.VERDICT_* codes — ACCEPT / REJECT / IGNORE
+    with the reference's ValidationResult numbering (validation.go:40-52).
+    Ignored messages are dropped without the P4 penalty and trace
+    REJECT with reason "validation ignored" (score.go:768-774).
+
     With ``dynamic_peers=True`` the step takes an extra ``up_next [N] bool``
     argument (the notify plane, notify.go:19-75): peers transitioning down
     — or blacklisted via ``state.blacklist`` — are disconnected with full
@@ -1136,6 +1172,7 @@ def make_gossipsub_step(
         net.band_off is not None
         and fr.fused_supported(net.n_peers, net.band_off, net.max_degree)
         and cfg.validation_delay_rounds == 0
+        and cfg.queue_cap == 0
         and not _old_pallas
     )
     fused_interp = jax.default_backend() != "tpu"
@@ -1460,11 +1497,12 @@ def make_gossipsub_step(
                 iwant_resp = jnp.where(sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0))
             dlv, info = delivery_round(
                 net_l, core.msgs, core.dlv, edge_mask, tick,
-                count_events=cfg.count_events,
+                count_events=cfg.count_events, queue_cap=cfg.queue_cap,
             )
             iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
             dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick,
-                                       count_events=cfg.count_events)
+                                       count_events=cfg.count_events,
+                                       queue_cap=cfg.queue_cap)
 
         # 4b. validation front-end throttle (validation.go:230-244)
         valid_words_all = bitset.pack(core.msgs.valid)
@@ -1483,6 +1521,7 @@ def make_gossipsub_step(
                 score, net_l, st2.mesh, tp, info.trans, info.new_words,
                 dlv.fe_words, dlv.first_round,
                 core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
+                msg_ignored=core.msgs.ignored,
                 pending_words=(
                     bitset.word_or_reduce(dlv.pending, axis=1)
                     if cfg.validation_delay_rounds > 0 else None
@@ -1505,12 +1544,20 @@ def make_gossipsub_step(
             dup_inc = bitset.popcount(
                 info.trans & pre_have[:, None, :], axis=-1
             ).astype(jnp.float32)
+            # reject vs ignore split (peer_gater.go:416-432: ignored
+            # verdicts land on the `ignore` counter, not `reject`)
+            ignored_words = bitset.pack(core.msgs.ignored)
             rej_inc = bitset.popcount(
-                info.trans & ~valid_words_all[None, None, :], axis=-1
+                info.trans & ~valid_words_all[None, None, :]
+                & ~ignored_words[None, None, :], axis=-1
+            ).astype(jnp.float32)
+            ign_inc = bitset.popcount(
+                info.trans & ignored_words[None, None, :], axis=-1
             ).astype(jnp.float32)
             n_validated = bitset.popcount(accepted_new, axis=-1)
             gater_state = gater_on_round(
-                gater_state, n_validated, n_throttled, deliver_inc, dup_inc, rej_inc, tick
+                gater_state, n_validated, n_throttled, deliver_inc, dup_inc,
+                rej_inc, tick, ignore_inc=ign_inc,
             )
 
         # 6. mcache put: validated new receipts in joined topics
@@ -1522,7 +1569,13 @@ def make_gossipsub_step(
         msgs, dlv, _slots, is_pub, keep_words, pub_words = allocate_publishes(
             core.msgs, dlv, tick, pub_origin, pub_topic, pub_valid
         )
-        mcache = (mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)) & keep_words[None, None, :]
+        # recycled-slot clearing must precede the put: the fresh publishes
+        # land on exactly the recycled slots, and clearing after the OR
+        # would erase them — leaving the origin without its own message in
+        # mcache (it must serve IWANTs and advertise IHAVE for it from the
+        # publish round on; mcache.Put in Publish, gossipsub.go:946)
+        mcache = mcache & keep_words[None, None, :]
+        mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)
         # IHAVE outboxes were gathered by the far end this round (step 3);
         # clear so a batch is received exactly once per heartbeat emission
         # (the reference sends IHAVE once, at the heartbeat) — emitGossip
@@ -1565,6 +1618,15 @@ def make_gossipsub_step(
             gater=gater_state,
         )
 
+        # congested links suppress next heartbeat's gossip toward them:
+        # a full writer queue drops the IHAVE batch and gossip is never
+        # retried (gossipsub.go:1757-1764 flush drops, :1155-1160)
+        if cfg.queue_cap > 0:
+            sat_recv = bitset.popcount(info.trans, axis=-1) >= cfg.queue_cap
+            gossip_suppress = net_l.edge_gather(sat_recv) & net_l.nbr_ok
+        else:
+            gossip_suppress = None
+
         # 8. heartbeat — inline when it runs every round (the default tick
         # model); lax.cond otherwise. The cond carries the whole state
         # through both branches, which costs real copies of the big arrays.
@@ -1572,6 +1634,7 @@ def make_gossipsub_step(
             return heartbeat(
                 cfg, net_l, s, tp, score_params, nbr_sub_l, gater_params,
                 nbr_sub_words_l, present_ok=net.nbr_ok,
+                gossip_suppress=gossip_suppress,
             )
 
         if cfg.heartbeat_every == 1:

@@ -27,16 +27,26 @@ from .gossipsub import gather_nbr_subscribed, joined_msg_words, sender_carry_wor
 RANDOMSUB_D = 6  # randomsub.go:17
 
 
-def make_randomsub_step(net: Net, d: int = RANDOMSUB_D):
+def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
+                        size_estimate: int | None = None):
     """Build the jitted per-round RandomSub step.
 
-    The per-topic fanout target is max(d, ceil(sqrt(gossip-capable topic
-    size))) — the reference splits floodsub peers out *before* sizing the
-    random sample (randomsub.go:107-131)."""
+    `size_estimate` mirrors the reference's static network-size parameter:
+    NewRandomSub takes `size` and targets max(D, ceil(sqrt(size))) for
+    every send (randomsub.go:61-67, 124-131). When None, the target is
+    sized per topic from the gossip-capable subscriber count instead — a
+    documented deviation (a refinement the reference cannot compute,
+    since a node doesn't know the topic's global size; parity claims
+    against the Go reference should pass the same size estimate the Go
+    node was constructed with). Floodsub-only peers are split out before
+    sampling either way (randomsub.go:107-116)."""
     protocol = np.asarray(net.protocol)
-    gs_size = np.asarray(
-        jnp.sum(net.subscribed & jnp.asarray(protocol >= 1)[:, None], axis=0)
-    )  # [T] gossip-capable subscribers only
+    if size_estimate is not None:
+        gs_size = np.full((net.n_topics,), size_estimate, np.int64)
+    else:
+        gs_size = np.asarray(
+            jnp.sum(net.subscribed & jnp.asarray(protocol >= 1)[:, None], axis=0)
+        )  # [T] gossip-capable subscribers only
     target_t = np.maximum(d, np.ceil(np.sqrt(gs_size))).astype(np.int32)
     # per (peer, slot) target
     mt = np.asarray(net.my_topics)

@@ -114,6 +114,17 @@ def lowest_bit(words: jax.Array) -> tuple[jax.Array, jax.Array]:
     return jnp.where(any_set, idx, 0), any_set
 
 
+def prefix_cap_bits(words: jax.Array, cap: jax.Array, m: int) -> jax.Array:
+    """Keep only the first `cap` set bits (lowest slots) of each packed
+    row; `cap` broadcasts over the leading dims. Unpacks to [.., m] for the
+    running count — use only on throttled/capped paths, not per-round hot
+    loops."""
+    bits = unpack(words, m)
+    csum = jnp.cumsum(bits.astype(jnp.int32), axis=-1)
+    keep = bits & (csum <= cap[..., None])
+    return pack(keep)
+
+
 def first_set_per_bit(words: jax.Array, axis: int = 1) -> jax.Array:
     """Isolate, per bit, the lowest index along `axis` whose word carries
     it: out has exactly the bits of `words` that are each bit's first

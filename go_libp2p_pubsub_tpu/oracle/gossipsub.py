@@ -2,13 +2,18 @@
 reference protocol (gossipsub.go) under the simulator's synchronous-round
 timing, used as the parity target for the vectorized router.
 
-Scope: the honest-network data+control plane — mesh maintenance
-(gossipsub.go:1344-1515), GRAFT/PRUNE with backoff (handleGraft :718-809,
-handlePrune :811-843), IHAVE/IWANT lazy gossip with flood caps
-(handleIHave :615-677, handleIWant :679-716), mcache windows (mcache.go),
-flood-publish (gossipsub.go:957-963). Scoring is disabled here — the score
-engine has its own dedicated oracle (oracle/score.py, tests/test_score.py)
-— and fanout is out of scope (parity harnesses subscribe every peer).
+Scope: the data+control plane — mesh maintenance (gossipsub.go:1344-1515),
+GRAFT/PRUNE with backoff (handleGraft :718-809, handlePrune :811-843),
+IHAVE/IWANT lazy gossip with flood caps (handleIHave :615-677,
+handleIWant :679-716), mcache windows (mcache.go), flood-publish
+(gossipsub.go:957-963) — and, when `score_params` is given, the COMPOSED
+v1.1 machine: the live score plane (one oracle/score.OracleScore per
+node), threshold gating (gossip/publish/graylist), score-directed mesh
+maintenance incl. opportunistic grafting, IWANT promises at the
+reference's per-batch granularity (gossip_tracer.go:48-75 — one random
+message per IWANT batch, several batches outstanding per peer), fanout
+for publishes to unjoined topics (gossipsub.go:981-1002, 1517-1554), and
+the sybil adversary vector (control-plane-only peers).
 
 RNG parity with the vectorized engine is impossible by design (survey §7
 hard-part (d)); the oracle draws from its own `random.Random`, and parity
@@ -22,7 +27,8 @@ Round ordering mirrors models/gossipsub.py `_round` exactly:
   4. mesh/flood delivery of senders' forward sets, then IWANT merges
   5. mcache put of validated new receipts
   6. publish interning (transmits next round)
-  7. heartbeat: backoff clear, mesh maintenance, emitGossip, mcache shift
+  7. heartbeat: promise penalties, score refresh + memoization, backoff
+     clear, mesh maintenance, fanout maintenance, emitGossip, mcache shift
 """
 
 from __future__ import annotations
@@ -30,9 +36,11 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass, field
 
+from ..config import PeerScoreParams, ticks_for
 from ..graph import Subscriptions, Topology
 from ..models.gossipsub import GossipSubConfig
 from ..trace.events import EV, N_EVENTS
+from .score import OracleScore
 
 
 @dataclass
@@ -42,6 +50,7 @@ class OMsg:
     origin: int
     birth: int
     valid: bool
+    ignored: bool = False
 
 
 @dataclass
@@ -51,6 +60,8 @@ class OracleGossipSub:
     cfg: GossipSubConfig
     msg_slots: int = 64
     seed: int = 0
+    score_params: PeerScoreParams | None = None
+    adversary: set | None = None   # peer idx that never transmit data
 
     tick: int = 0
     msgs: dict = field(default_factory=dict)   # slot -> OMsg
@@ -59,7 +70,9 @@ class OracleGossipSub:
     first_edge: dict = field(default_factory=dict)   # (i, slot) -> k | -1
 
     def __post_init__(self):
-        assert not self.cfg.score_enabled, "score plane has its own oracle"
+        assert self.cfg.score_enabled == (self.score_params is not None), (
+            "score_params must accompany score_enabled"
+        )
         assert self.cfg.heartbeat_every == 1
         n = self.topo.n_peers
         self.rng = random.Random(self.seed)
@@ -84,6 +97,34 @@ class OracleGossipSub:
         self.iasked = [dict() for _ in range(n)]     # k -> int
         self.served = [dict() for _ in range(n)]     # (k, slot) -> count
         self.events = [0] * N_EVENTS
+        self.adversary = self.adversary or set()
+        # v1.1 composed plane
+        if self.score_params is not None:
+            self.oscore = [OracleScore(self.score_params) for _ in range(n)]
+            self.scores = [dict() for _ in range(n)]  # k -> memoized score
+            # IWANT promises at the reference granularity: one random msg
+            # per IWANT batch, any number outstanding per edge
+            # (gossip_tracer.go:48-75); (k, slot) -> expire tick
+            self.promises = [dict() for _ in range(n)]
+        # fanout: t -> set of edge slots; lastpub: t -> tick
+        # (gossipsub.go:444-447 fanout + lastpub maps)
+        self.fanout = [dict() for _ in range(n)]
+        self.fanout_lastpub = [dict() for _ in range(n)]
+
+    # -- score helpers ------------------------------------------------------
+
+    def _score(self, i, k) -> float:
+        """Peer i's memoized score of its edge-slot-k neighbor (the
+        per-heartbeat cache, gossipsub.go:1333-1341)."""
+        if self.score_params is None:
+            return 0.0
+        return self.scores[i].get(k, 0.0)
+
+    def _acc_ok(self, i, k) -> bool:
+        """AcceptFrom graylist gate (gossipsub.go:583-594)."""
+        if self.score_params is None:
+            return True
+        return self._score(i, k) >= self.cfg.graylist_threshold
 
     # -- helpers ------------------------------------------------------------
 
@@ -116,18 +157,36 @@ class OracleGossipSub:
                     s.discard(slot)
             for key in [key for key in self.served[i] if key[1] == slot]:
                 del self.served[i][key]
+            if self.score_params is not None:
+                for key in [k for k in self.promises[i] if k[1] == slot]:
+                    del self.promises[i][key]
 
-    def publish(self, origin, topic, valid=True):
+    def publish(self, origin, topic, valid=True, ignored=False):
         slot = self.cursor % self.msg_slots
         self.cursor += 1
         self._recycle(slot)
-        self.msgs[slot] = OMsg(slot, topic, origin, self.tick, valid)
+        self.msgs[slot] = OMsg(slot, topic, origin, self.tick, valid, ignored)
         self.seen[origin].add(slot)
         self.fwd[origin].add(slot)
         self.first_round[(origin, slot)] = self.tick
         self.first_edge[(origin, slot)] = -1
         self.mcache[origin][0].add(slot)
         self.events[EV.PUBLISH_MESSAGE] += 1
+        # publish to an unjoined topic creates/refreshes a fanout slot with
+        # D random eligible peers (gossipsub.go:981-1002)
+        if topic not in self.mesh[origin] and self.cfg.fanout_slots > 0:
+            if not self.fanout[origin].get(topic):
+                cand = {
+                    k for k, s, r in self._edges(origin)
+                    if self.subs.subscribed[s, topic]
+                }
+                if self.score_params is not None:
+                    cand = {
+                        k for k in cand
+                        if self._score(origin, k) >= self.cfg.publish_threshold
+                    }
+                self.fanout[origin][topic] = self._sample(cand, self.cfg.D)
+            self.fanout_lastpub[origin][topic] = self.tick
         return slot
 
     # -- one round ----------------------------------------------------------
@@ -142,6 +201,8 @@ class OracleGossipSub:
         for i in range(n):
             incoming_graft, incoming_prune = [], []
             for k, s, r in self._edges(i):
+                if not self._acc_ok(i, k):
+                    continue  # graylisted: whole RPC dropped
                 for (t, ks) in self.graft_out[s]:
                     if ks == r and t in self.mesh[i]:
                         incoming_graft.append((t, k))
@@ -153,6 +214,8 @@ class OracleGossipSub:
             for (t, k) in incoming_prune:
                 if k in self.mesh[i][t]:
                     self.mesh[i][t].discard(k)
+                    if self.score_params is not None:
+                        self.oscore[i].prune(k, t)  # sticky P3b
                     self.events[EV.PRUNE] += 1
                 be = self.backoff_expire[i]
                 be[(t, k)] = max(be.get((t, k), 0), tick + cfg.prune_backoff_ticks)
@@ -166,14 +229,28 @@ class OracleGossipSub:
                 backoff_active = (t, k) in self.backoff_present[i] and (
                     be is not None and tick < be
                 )
+                if backoff_active and self.score_params is not None:
+                    # backoff-GRAFT behaviour penalty, doubled inside the
+                    # flood window (gossipsub.go:753-770)
+                    flood_cutoff = (be or 0) + (
+                        cfg.graft_flood_ticks - cfg.prune_backoff_ticks
+                    )
+                    self.oscore[i].add_penalty(
+                        k, 2 if tick < flood_cutoff else 1
+                    )
+                neg_score = (
+                    self.score_params is not None and self._score(i, k) < 0
+                )
                 full = deg0[t] >= cfg.Dhi and not topo.outbound[i, k]
-                if backoff_active or full:
+                if backoff_active or neg_score or full:
                     prune_resp[i].add((t, k))
                     be2 = self.backoff_expire[i]
                     be2[(t, k)] = max(be2.get((t, k), 0), tick + cfg.prune_backoff_ticks)
                     self.backoff_present[i].add((t, k))
                 else:
                     self.mesh[i][t].add(k)
+                    if self.score_params is not None:
+                        self.oscore[i].graft(k, t, tick)
                     self.events[EV.GRAFT] += 1
 
         # 2. IWANT service (iwant_responses): what I asked last round, from
@@ -182,8 +259,13 @@ class OracleGossipSub:
         for i in range(n):
             for k, s, r in self._edges(i):
                 asked = self.iwant_out[i].get(k, ())
-                if not asked:
+                if not asked or s in self.adversary:
                     continue
+                if self.score_params is not None and (
+                    self.scores[s].get(r, 0.0) < cfg.gossip_threshold
+                ):
+                    continue  # responder ignores low-score requesters
+                              # (gossipsub.go:681-685)
                 window = set().union(*self.mcache[s])
                 for slot in asked:
                     if slot not in window:
@@ -199,8 +281,13 @@ class OracleGossipSub:
         for i in range(n):
             for k, s, r in self._edges(i):
                 advertised = self.ihave_out[s].get(r, ())
-                if not advertised:
+                if not advertised or not self._acc_ok(i, k):
                     continue
+                if self.score_params is not None and (
+                    self._score(i, k) < cfg.gossip_threshold
+                ):
+                    continue  # score gate precedes the counter in the
+                              # reference (gossipsub.go:616-628)
                 ph = self.peerhave[i].get(k, 0) + 1
                 self.peerhave[i][k] = ph
                 if ph > cfg.max_ihave_messages:
@@ -213,17 +300,36 @@ class OracleGossipSub:
                     if slot not in self.seen[i]
                     and self.msgs[slot].topic in self.mesh[i]
                 )
-                asks = wants[: cfg.max_ihave_length - ia]
+                budget = cfg.max_ihave_length - ia
+                if len(wants) > budget:
+                    # the reference shuffles before truncating
+                    # (gossipsub.go:655-667); the engine keeps lowest
+                    # slots — tests/test_promise_sensitivity.py bounds
+                    # the distributional impact of that approximation
+                    asks = sorted(self.rng.sample(wants, budget))
+                else:
+                    asks = wants
                 if asks:
                     self.iasked[i][k] = ia + len(asks)
                     new_iwant[i][k] = set(asks)
+                    if self.score_params is not None:
+                        # one promise per IWANT batch: a random message of
+                        # the batch, due within the followup window
+                        # (gossip_tracer.go:48-75)
+                        mid = self.rng.choice(asks)
+                        self.promises[i].setdefault(
+                            (k, mid), tick + cfg.iwant_followup_ticks
+                        )
         self.iwant_out = new_iwant
 
-        # 4. delivery: senders push last round's fwd along mesh (+flood)
+        # 4. delivery: senders push last round's fwd along mesh (+fanout,
+        # +flood-publish), adversary senders transmit nothing
         arrivals = [dict() for _ in range(n)]  # slot -> [k,...]
         n_rpc = 0
         for i in range(n):
             for k, s, r in self._edges(i):
+                if s in self.adversary or not self._acc_ok(i, k):
+                    continue
                 for slot in self.fwd[s]:
                     msg = self.msgs.get(slot)
                     if msg is None or msg.origin == i:
@@ -233,23 +339,69 @@ class OracleGossipSub:
                     if self.first_edge.get((s, slot)) == r:
                         continue  # echo exclusion
                     carries = r in self.mesh[s].get(msg.topic, ())
+                    if not carries and msg.topic in self.fanout[s]:
+                        carries = r in self.fanout[s][msg.topic]
                     if cfg.flood_publish and msg.origin == s:
-                        carries = True
+                        # origin floods to peers it scores above the
+                        # publish threshold (gossipsub.go:957-963)
+                        if self.score_params is None or (
+                            self.scores[s].get(r, 0.0)
+                            >= cfg.publish_threshold
+                        ):
+                            carries = True
                     if not carries:
                         continue
                     arrivals[i].setdefault(slot, []).append(k)
                     n_rpc += 1
+
+        def _window_rounds(topic) -> int:
+            # same tick conversion as TopicParamsArrays.build (engine.py)
+            tp = (self.score_params.topics.get(topic)
+                  if self.score_params else None)
+            if tp is None:
+                return 0
+            w = tp.mesh_message_deliveries_window
+            return ticks_for(w, 1.0) - 1 if w >= 1.0 else 0
+
+        def _attribute(i, slot, ks, first: bool):
+            """Score attribution for one round's arrivals of `slot` at i:
+            first arrival -> markFirstMessageDelivery on its edge; every
+            other arrival -> duplicate (window-gated mesh credit) or
+            invalid penalty (score.go:695-820)."""
+            if self.score_params is None:
+                return
+            msg = self.msgs[slot]
+            fr = self.first_round.get((i, slot))
+            in_window = fr is not None and (tick - fr) <= _window_rounds(msg.topic)
+            ks = sorted(ks)
+            for j, k in enumerate(ks):
+                if not msg.valid:
+                    if not msg.ignored:
+                        self.oscore[i].invalid_delivery(k, msg.topic)
+                    continue
+                if first and j == 0:
+                    self.oscore[i].first_delivery(k, msg.topic)
+                else:
+                    self.oscore[i].duplicate_delivery(k, msg.topic, in_window)
+
+        def _fulfill_promises(i, slot):
+            for key in [key for key in self.promises[i] if key[1] == slot]:
+                del self.promises[i][key]
 
         new_fwd = [set() for _ in range(n)]
         n_new = n_deliver = 0
         for i in range(n):
             for slot, ks in sorted(arrivals[i].items()):
                 if slot in self.seen[i]:
+                    _attribute(i, slot, ks, first=False)
                     continue
                 n_new += 1
                 self.seen[i].add(slot)
                 self.first_round[(i, slot)] = tick
                 self.first_edge[(i, slot)] = min(ks)
+                _attribute(i, slot, ks, first=True)
+                if self.score_params is not None:
+                    _fulfill_promises(i, slot)
                 if self.msgs[slot].valid:
                     n_deliver += 1
                     new_fwd[i].add(slot)
@@ -258,14 +410,24 @@ class OracleGossipSub:
         for i in range(n):
             for slot, ks in sorted(extra[i].items()):
                 msg = self.msgs.get(slot)
-                live = [k for k in ks if msg is not None and msg.origin != i]
+                live = [
+                    k for k in ks
+                    if msg is not None and msg.origin != i
+                    and self._acc_ok(i, k)
+                ]
                 n_rpc += len(live)
-                if not live or slot in self.seen[i]:
+                if not live:
+                    continue
+                if slot in self.seen[i]:
+                    _attribute(i, slot, live, first=False)
                     continue
                 n_new += 1
                 self.seen[i].add(slot)
                 self.first_round[(i, slot)] = tick
                 self.first_edge[(i, slot)] = min(live)
+                _attribute(i, slot, live, first=True)
+                if self.score_params is not None:
+                    _fulfill_promises(i, slot)
                 if msg.valid:
                     n_deliver += 1
                     new_fwd[i].add(slot)
@@ -282,9 +444,10 @@ class OracleGossipSub:
                     self.mcache[i][0].add(slot)
         self.fwd = new_fwd
 
-        # 6. publishes (transmit next round)
-        for origin, topic, valid in publishes:
-            self.publish(origin, topic, valid)
+        # 6. publishes (transmit next round); tuples are
+        # (origin, topic, valid[, ignored])
+        for pub in publishes:
+            self.publish(*pub)
 
         # 7. heartbeat
         self.prune_out = prune_resp
@@ -297,8 +460,27 @@ class OracleGossipSub:
         cfg, topo = self.cfg, self.topo
         n = topo.n_peers
         tick = self.tick
+        scored = self.score_params is not None
 
         for i in range(n):
+            if scored:
+                # applyIwantPenalties: promises past their deadline break
+                # -> P7 per broken promise (gossipsub.go:1578-1583,
+                # gossip_tracer.go:79-115)
+                broken = {}
+                for (k, slot), exp in list(self.promises[i].items()):
+                    if tick > exp:
+                        broken[k] = broken.get(k, 0) + 1
+                        del self.promises[i][(k, slot)]
+                for k, cnt in broken.items():
+                    self.oscore[i].add_penalty(k, cnt)
+                # refreshScores decay + the per-heartbeat score memo
+                # (score.go:497-558; gossipsub.go:1333-1341)
+                self.oscore[i].refresh(tick)
+                self.scores[i] = {
+                    k: self.oscore[i].score(k) for k, s, r in self._edges(i)
+                }
+
             # clearIHaveCounters
             self.peerhave[i] = {}
             self.iasked[i] = {}
@@ -320,9 +502,16 @@ class OracleGossipSub:
                 }
 
             for t, m in self.mesh[i].items():
+                # drop negative-score mesh members first
+                # (gossipsub.go:1361-1368)
+                if scored:
+                    bad = {k for k in m if self._score(i, k) < 0}
+                    toprune |= {(t, k) for k in bad}
+                    m -= bad
                 cand = {
                     k for k in nbr_sub[t]
                     if k not in m and (t, k) not in self.backoff_present[i]
+                    and (not scored or self._score(i, k) >= 0)
                 }
                 # underpopulated -> graft to D
                 if len(m) < cfg.Dlo:
@@ -332,7 +521,16 @@ class OracleGossipSub:
                     cand -= grafts
                 # overpopulated -> keep D with >= Dout outbound
                 if len(m) > cfg.Dhi:
-                    protected = self._sample(m, cfg.Dscore)  # score off: random
+                    if scored:
+                        # keep the Dscore best by score, random tie-break
+                        # (gossipsub.go:1389-1399)
+                        ranked = sorted(
+                            m, key=lambda k: (-self._score(i, k),
+                                              self.rng.random())
+                        )
+                        protected = set(ranked[: cfg.Dscore])
+                    else:
+                        protected = self._sample(m, cfg.Dscore)
                     keep = protected | self._sample(m - protected, cfg.D - cfg.Dscore)
                     out_in_keep = {k for k in keep if topo.outbound[i, k]}
                     x_need = max(cfg.Dout - len(out_in_keep), 0)
@@ -353,7 +551,27 @@ class OracleGossipSub:
                     )
                     m |= grafts2
                     tograft |= {(t, k) for k in grafts2}
+                # opportunistic grafting (gossipsub.go:1479-1510)
+                if (scored and cfg.opportunistic_graft_ticks > 0
+                        and tick % cfg.opportunistic_graft_ticks == 0
+                        and len(m) > 1):
+                    ranked = sorted(self._score(i, k) for k in m)
+                    med = ranked[len(ranked) // 2]
+                    if med < cfg.opportunistic_graft_threshold:
+                        better = {
+                            k for k in cand - m if self._score(i, k) > med
+                        }
+                        grafts3 = self._sample(
+                            better, cfg.opportunistic_graft_peers
+                        )
+                        m |= grafts3
+                        tograft |= {(t, k) for k in grafts3}
 
+            if scored:
+                for (t, k) in tograft:
+                    self.oscore[i].graft(k, t, tick)
+                for (t, k) in toprune:
+                    self.oscore[i].prune(k, t)
             for (t, k) in toprune:
                 be = self.backoff_expire[i]
                 be[(t, k)] = max(be.get((t, k), 0), tick + cfg.prune_backoff_ticks)
@@ -363,11 +581,51 @@ class OracleGossipSub:
             self.events[EV.GRAFT] += len(tograft)
             self.events[EV.PRUNE] += len(toprune)
 
+            # fanout maintenance (gossipsub.go:1517-1554): TTL expiry,
+            # threshold filtering, top-up to D
+            if cfg.fanout_slots > 0 and self.fanout[i]:
+                for t in list(self.fanout[i]):
+                    if self.fanout_lastpub[i].get(t, 0) + cfg.fanout_ttl_ticks < tick:
+                        del self.fanout[i][t]
+                        self.fanout_lastpub[i].pop(t, None)
+                        continue
+                    f = self.fanout[i][t]
+                    if scored:
+                        f = {
+                            k for k in f
+                            if self._score(i, k) >= cfg.publish_threshold
+                        }
+                    cand_f = {
+                        k for k, s, r in self._edges(i)
+                        if self.subs.subscribed[s, t] and k not in f
+                        and (not scored
+                             or self._score(i, k) >= cfg.publish_threshold)
+                    }
+                    f |= self._sample(cand_f, cfg.D - len(f))
+                    self.fanout[i][t] = f
+
             # emitGossip: IHAVE of the gossip window to random non-mesh peers
             gwin = set().union(*self.mcache[i][: cfg.history_gossip])
             ihave = {}
             for t, m in self.mesh[i].items():
-                gcand = nbr_sub[t] - m
+                gcand = {
+                    k for k in nbr_sub[t] - m
+                    if not scored or self._score(i, k) >= cfg.gossip_threshold
+                }
+                target = max(cfg.Dlazy, int(cfg.gossip_factor * len(gcand)))
+                adv = {slot for slot in gwin if self.msgs[slot].topic == t}
+                if not adv:
+                    continue
+                for k in self._sample(gcand, target):
+                    ihave.setdefault(k, set()).update(adv)
+            # fanout-topic gossip (gossipsub.go:1551-1553)
+            for t, f in self.fanout[i].items():
+                gcand = {
+                    k for k, s, r in self._edges(i)
+                    if self.subs.subscribed[s, t] and k not in f
+                    and (not scored
+                         or self._score(i, k) >= cfg.gossip_threshold)
+                }
                 target = max(cfg.Dlazy, int(cfg.gossip_factor * len(gcand)))
                 adv = {slot for slot in gwin if self.msgs[slot].topic == t}
                 if not adv:

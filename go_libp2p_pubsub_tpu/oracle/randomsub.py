@@ -1,12 +1,19 @@
 """Scalar RandomSub oracle with the simulator's synchronous-round timing.
 
-Per-node behavior transcribed from randomsub.go:99-160: each sender
-forwards every in-flight message to max(RandomSubD, ceil(sqrt(topic
-size))) random *gossip-capable* subscribed neighbors, while neighbors
-speaking only /floodsub/1.0.0 always receive (the peer-list split at
-randomsub.go:107-131 sizes the sample on gossip-capable subscribers
-only); a floodsub-only sender runs the floodsub router and forwards to
-every subscribed neighbor.
+Per-node behavior from randomsub.go:99-160: each sender forwards every
+in-flight message to a random sample of *gossip-capable* subscribed
+neighbors, while neighbors speaking only /floodsub/1.0.0 always receive
+(the peer-list split at randomsub.go:107-131); a floodsub-only sender
+runs the floodsub router and forwards to every subscribed neighbor.
+
+Sample-size note (scoping the parity claim): the reference sizes the
+sample as max(RandomSubD, ceil(sqrt(size))) where `size` is the static
+network-size estimate passed to NewRandomSub (randomsub.go:61-67,
+124-127) — NOT the topic's subscriber count. This oracle and the engine
+default to the per-topic gossip-capable subscriber count (a refinement
+the reference cannot compute locally) and match each other by
+construction; pass `size_estimate` to both to reproduce the reference's
+exact sizing.
 
 Everything but the transmit selection — seen-cache dedup, source/origin
 exclusion, validation gating, event accounting — is inherited from the
@@ -32,6 +39,7 @@ class OracleRandomSub(OracleFloodSub):
     d: int = 6                      # RandomSubD, randomsub.go:17
     protocol: np.ndarray = None     # [N] i8; None = all gossip-capable
     seed: int = 0
+    size_estimate: int | None = None  # NewRandomSub's `size` (see module doc)
 
     def __post_init__(self):
         super().__post_init__()
@@ -39,10 +47,17 @@ class OracleRandomSub(OracleFloodSub):
         if self.protocol is None:
             self.protocol = np.full((n,), 2, np.int8)
         self.rng = random.Random(self.seed)
-        # per-topic target over gossip-capable subscribers only
-        gs_size = (
-            np.asarray(self.subs.subscribed) & (self.protocol >= 1)[:, None]
-        ).sum(axis=0)
+        if self.size_estimate is not None:
+            # the reference's static estimate (randomsub.go:124-127)
+            gs_size = np.full(
+                (np.asarray(self.subs.subscribed).shape[1],),
+                self.size_estimate, np.int64,
+            )
+        else:
+            # per-topic target over gossip-capable subscribers only
+            gs_size = (
+                np.asarray(self.subs.subscribed) & (self.protocol >= 1)[:, None]
+            ).sum(axis=0)
         self.target_t = np.maximum(self.d, np.ceil(np.sqrt(gs_size))).astype(int)
 
     def _sender_targets(self, s: int, topic: int):

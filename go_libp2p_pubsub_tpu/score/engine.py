@@ -321,6 +321,7 @@ def on_deliveries(
     pending_words: jax.Array | None = None,   # [N,W] u32 — msgs in the
                                               # async-validation pipeline
     recv_new_words: jax.Array | None = None,  # [N,W] u32 — fresh receipts
+    msg_ignored: jax.Array | None = None,  # [M] bool — ValidationIgnore
 ) -> ScoreState:
     """Fold one delivery round into the counters.
 
@@ -331,9 +332,11 @@ def on_deliveries(
       (DeliverMessage's drec.peers loop, score.go:712-718), and later
       duplicates within the window also count (markDuplicateMessageDelivery,
       score.go:944-974)
-    * every arrival of an invalid msg: invalidMessageDeliveries +1
+    * every arrival of a *rejected* msg: invalidMessageDeliveries +1
       (markInvalidMessageDelivery via RejectMessage/DuplicateMessage,
-      score.go:776-782, 811-813)
+      score.go:776-782, 811-813). Ignored messages (ValidationIgnore)
+      move no counters at all — their senders are explicitly not
+      penalized (validation.go:46-52; score.go:768-774 deliveryIgnored)
 
     Everything is packed-word algebra: per-(peer,slot,edge) counts are
     popcounts of word-AND — no [N,K,M] gathers, casts, or einsums in the
@@ -394,8 +397,11 @@ def on_deliveries(
     mmd_inc = per_slot_counts(mesh_credit) * in_mesh.astype(jnp.float32)
     mmd = jnp.minimum(st.mmd + mmd_inc, e(tp["cap3"]))
 
-    # -- P4 penalty for invalid messages ------------------------------------
-    invalid_arrival = trans_words & ~valid_w[None, None, :]
+    # -- P4 penalty for rejected messages -----------------------------------
+    penalize_w = ~valid_w
+    if msg_ignored is not None:
+        penalize_w = penalize_w & ~bitset.pack(msg_ignored)
+    invalid_arrival = trans_words & penalize_w[None, None, :]
     imd = st.imd + per_slot_counts(invalid_arrival)
 
     # unscored slots track nothing (getTopicStats, score.go:881-884)

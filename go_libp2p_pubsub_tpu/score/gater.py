@@ -117,8 +117,10 @@ def gater_on_round(
     n_throttled: jax.Array,   # [N] i32 — receipts refused (queue full)
     deliver_inc: jax.Array,   # [N,K] f32 — first deliveries per edge
     duplicate_inc: jax.Array, # [N,K] f32
-    reject_inc: jax.Array,    # [N,K] f32 — invalid-message rejections
+    reject_inc: jax.Array,    # [N,K] f32 — rejected-message deliveries
     tick,
+    ignore_inc: jax.Array | None = None,  # [N,K] f32 — ValidationIgnore
+                                          # verdicts (peer_gater.go:427-429)
 ) -> GaterState:
     """Fold a round's validation outcomes into the counters (the RawTracer
     hooks, peer_gater.go:365-443)."""
@@ -130,4 +132,5 @@ def gater_on_round(
         deliver=gs.deliver + deliver_inc,
         duplicate=gs.duplicate + duplicate_inc,
         reject=gs.reject + reject_inc,
+        ignore=gs.ignore if ignore_inc is None else gs.ignore + ignore_inc,
     )

@@ -117,15 +117,38 @@ class Net:
         return self.my_topics.shape[1]
 
 
+# validation verdict codes — same numbering as ValidationResult
+# (validation.go:40-52): accepted messages deliver + forward; rejected
+# messages are dropped AND every sender takes the P4 invalid-message
+# penalty (RejectMessage, score.go:721-786); ignored messages are dropped
+# without penalizing their senders (score.go:768-774)
+VERDICT_ACCEPT = 0
+VERDICT_REJECT = 1
+VERDICT_IGNORE = 2
+
+
+def decode_verdicts(pub_valid: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(accept, ignored) bool planes from a publish-verdict array.
+
+    `pub_valid` is either bool (True = accept, False = reject — the
+    original two-verdict interface) or an integer VERDICT_* code array
+    (the three-verdict interface)."""
+    if pub_valid.dtype == jnp.bool_:
+        return pub_valid, jnp.zeros_like(pub_valid)
+    return pub_valid == VERDICT_ACCEPT, pub_valid == VERDICT_IGNORE
+
+
 @struct.dataclass
 class MsgTable:
     """Rotating global message table (the interned message-id space)."""
 
-    topic: jax.Array   # [M] i32, -1 = never used
-    origin: jax.Array  # [M] i32
-    birth: jax.Array   # [M] i32 round of publish, -1 = never used
-    valid: jax.Array   # [M] bool — validation verdict (adversary injection)
-    cursor: jax.Array  # i32 — next slot to allocate (monotonic, mod M)
+    topic: jax.Array    # [M] i32, -1 = never used
+    origin: jax.Array   # [M] i32
+    birth: jax.Array    # [M] i32 round of publish, -1 = never used
+    valid: jax.Array    # [M] bool — ValidationAccept (deliver + forward)
+    ignored: jax.Array  # [M] bool — ValidationIgnore (drop, no P4 penalty;
+                        # validation.go:46-52, score.go:768-774)
+    cursor: jax.Array   # i32 — next slot to allocate (monotonic, mod M)
 
     @classmethod
     def empty(cls, m: int) -> "MsgTable":
@@ -134,6 +157,7 @@ class MsgTable:
             origin=jnp.full((m,), -1, jnp.int32),
             birth=jnp.full((m,), -1, jnp.int32),
             valid=jnp.zeros((m,), bool),
+            ignored=jnp.zeros((m,), bool),
             cursor=jnp.int32(0),
         )
 
@@ -227,7 +251,7 @@ def allocate_publishes(
     tick: jax.Array,
     pub_origin: jax.Array,  # [P] i32, -1 pad
     pub_topic: jax.Array,   # [P] i32
-    pub_valid: jax.Array,   # [P] bool
+    pub_valid: jax.Array,   # [P] bool accept, or int VERDICT_* codes
 ):
     """Intern this round's publishes into table slots (rotating cursor),
     clearing recycled slots' bit columns everywhere.
@@ -236,6 +260,8 @@ def allocate_publishes(
     publish (undefined where ~is_pub).
     """
     m = msgs.capacity
+    pub_valid = jnp.asarray(pub_valid)
+    accept, ignored = decode_verdicts(pub_valid)
     is_pub = pub_origin >= 0
     pos = jnp.cumsum(is_pub.astype(jnp.int32)) - 1
     slots = (msgs.cursor + pos) % m
@@ -260,7 +286,8 @@ def allocate_publishes(
         topic=msgs.topic.at[sidx].set(pub_topic, mode="drop"),
         origin=msgs.origin.at[sidx].set(pub_origin, mode="drop"),
         birth=msgs.birth.at[sidx].set(jnp.broadcast_to(tick, pub_topic.shape), mode="drop"),
-        valid=msgs.valid.at[sidx].set(pub_valid, mode="drop"),
+        valid=msgs.valid.at[sidx].set(accept, mode="drop"),
+        ignored=msgs.ignored.at[sidx].set(ignored, mode="drop"),
         cursor=msgs.cursor + count,
     )
 

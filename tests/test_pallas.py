@@ -36,6 +36,7 @@ def _random_state(n, m, k, rng):
         origin=jnp.asarray(rng.integers(-1, n, size=(m,)).astype(np.int32)),
         birth=jnp.zeros((m,), jnp.int32),
         valid=jnp.asarray(rng.random(m) < 0.8),
+        ignored=jnp.zeros((m,), bool),
         cursor=jnp.int32(0),
     )
     edge_mask = words((n, k))

@@ -1,0 +1,263 @@
+"""Composed v1.1 parity: engine vs per-node oracle WITH the score plane
+live in the loop.
+
+The north star's CDF claim is for GossipSub v1.1 (BASELINE.json); round 1
+only proved v1.0 parity (oracle excluded scoring). These harnesses run
+the composed machine — scoring + thresholds + promise penalties (+ sybil
+adversary / multi-topic fanout) — on both sides and assert the
+propagation-latency CDF stays within the 2% sup-norm budget.
+
+Scaled-down instances of the BASELINE.json configs:
+  * sybil (#4): 20% control-plane-only attackers, deficit scoring active,
+    graylist threshold live (gater + validation throttle excluded: both
+    add RNG-heavy admission noise orthogonal to the score-plane claim)
+  * eth2 (#5): multi-topic attestation-subnet geometry with publishes to
+    unjoined topics (fanout) and scoring on every subnet
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.oracle.gossipsub import OracleGossipSub
+from go_libp2p_pubsub_tpu.state import Net, hops
+
+N = 192
+DEG = 8
+MSG_SLOTS = 64
+WARMUP = 24
+PUB_ROUNDS = 18
+PUBS_PER_ROUND = 2
+DRAIN = 12
+MAX_H = 14
+
+
+def _sybil_setup():
+    topo = graph.random_connect(N, d=DEG, seed=5)
+    subs = graph.subscribe_all(N, 1)
+    rng = np.random.default_rng(2)
+    adversary = rng.random(N) < 0.2
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=-0.5,
+        mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_activation=10.0,
+        mesh_message_deliveries_window=2.0,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    thr = PeerScoreThresholds(
+        gossip_threshold=-10.0, publish_threshold=-20.0,
+        graylist_threshold=-40.0,
+    )
+    params = GossipSubParams()
+    cfg = GossipSubConfig.build(params, thr, score_enabled=True)
+    cfg = dataclasses.replace(cfg, fanout_slots=0)
+    # honest origins only (a sybil origin transmits nothing)
+    honest = np.flatnonzero(~adversary)
+    sched = honest[
+        rng.integers(0, len(honest), size=(PUB_ROUNDS, PUBS_PER_ROUND))
+    ].astype(np.int32)
+    topics = np.zeros((PUB_ROUNDS, PUBS_PER_ROUND), np.int32)
+    return topo, subs, cfg, sp, adversary, sched, topics, 1
+
+
+def _eth2_setup():
+    n_topics = 8
+    topo = graph.random_connect(N, d=DEG, seed=9)
+    subs = graph.subscribe_random(N, n_topics=n_topics, topics_per_peer=2,
+                                  seed=3)
+    rng = np.random.default_rng(4)
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+    )
+    sp = PeerScoreParams(
+        topics={t: tp for t in range(n_topics)},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True
+    )
+    sched = rng.integers(0, N, size=(PUB_ROUNDS, PUBS_PER_ROUND)).astype(np.int32)
+    topics = rng.integers(0, n_topics, size=(PUB_ROUNDS, PUBS_PER_ROUND)).astype(np.int32)
+    return topo, subs, cfg, sp, None, sched, topics, n_topics
+
+
+def _run_engine(topo, subs, cfg, sp, adversary, sched, topics):
+    import jax.numpy as jnp
+
+    net = Net.build(topo, subs)
+    st = GossipSubState.init(net, MSG_SLOTS, cfg, score_params=sp, seed=3)
+    step = make_gossipsub_step(
+        cfg, net, score_params=sp, adversary_no_forward=adversary,
+    )
+    empty = no_publish(PUBS_PER_ROUND)
+    for _ in range(WARMUP):
+        st = step(st, *empty)
+    pv = jnp.ones((PUBS_PER_ROUND,), bool)
+    for r in range(sched.shape[0]):
+        st = step(st, jnp.asarray(sched[r]), jnp.asarray(topics[r]), pv)
+    for _ in range(DRAIN):
+        st = step(st, *empty)
+    h = np.asarray(hops(st.core.msgs, st.core.dlv))  # [N, M]
+    sub = np.asarray(net.subscribed)                  # [N, T]
+    mt = np.asarray(st.core.msgs.topic)
+    # count only receipts at subscribed peers (the CDF denominator)
+    mask = (h >= 0) & sub[:, np.clip(mt, 0, None)]
+    return [int(x) for x in h[mask]], subs
+
+
+def _run_oracle(topo, subs, cfg, sp, adversary, sched, topics):
+    adv = set(np.flatnonzero(adversary).tolist()) if adversary is not None else None
+    o = OracleGossipSub(
+        topo, subs, cfg, msg_slots=MSG_SLOTS, seed=11,
+        score_params=sp, adversary=adv,
+    )
+    for _ in range(WARMUP):
+        o.step()
+    for r in range(sched.shape[0]):
+        o.step([(int(p), int(t), True)
+                for p, t in zip(sched[r], topics[r])])
+    for _ in range(DRAIN):
+        o.step()
+    sub = np.asarray(subs.subscribed)
+    # subscribed receivers only — an unsubscribed fanout origin's own
+    # hop-0 receipt is outside the CDF population (same filter as the
+    # engine side)
+    return [
+        h for (i, slot), h in o.hops().items()
+        if sub[i, o.msgs[slot].topic]
+    ]
+
+
+def _denominator(subs, topics, n_msgs_per_topic):
+    """Total (subscribed peer, message) pairs over the schedule."""
+    sub = np.asarray(subs.subscribed)
+    total = 0
+    for t, cnt in n_msgs_per_topic.items():
+        total += cnt * int(sub[:, t].sum())
+    return total
+
+
+def _cdf(hop_counts, total):
+    hist = np.zeros(MAX_H + 1)
+    for h in hop_counts:
+        hist[min(h, MAX_H)] += 1
+    return np.cumsum(hist) / total
+
+
+@pytest.mark.parametrize("setup,name", [
+    (_sybil_setup, "sybil"),
+    (_eth2_setup, "eth2"),
+])
+def test_v11_composed_cdf_within_2pct(setup, name):
+    topo, subs, cfg, sp, adversary, sched, topics, n_topics = setup()
+
+    hv, _ = _run_engine(topo, subs, cfg, sp, adversary, sched, topics)
+    ho = _run_oracle(topo, subs, cfg, sp, adversary, sched, topics)
+
+    per_topic = {}
+    for t in topics.ravel():
+        per_topic[int(t)] = per_topic.get(int(t), 0) + 1
+    total = _denominator(subs, topics, per_topic)
+
+    cv = _cdf(hv, total)
+    co = _cdf(ho, total)
+    sup = float(np.max(np.abs(cv - co)))
+    assert sup <= 0.02, (
+        f"[{name}] composed v1.1 CDF sup-distance {sup:.4f} > 2%\n"
+        f"vec={np.round(cv, 4)}\noracle={np.round(co, 4)}"
+    )
+    # both sides reach (nearly) every subscribed honest pair
+    assert cv[-1] > 0.9 and co[-1] > 0.9
+    # and the distance is recorded for PARITY.md
+    print(f"PARITY[{name}]: sup={sup:.4f} cov_v={cv[-1]:.4f} cov_o={co[-1]:.4f}")
+
+
+def test_v11_scoring_catches_sybils_both_sides():
+    """The composed machines agree qualitatively: sybil neighbors end with
+    lower mean score than honest ones on both implementations. P1
+    (time-in-mesh) is zeroed so the delivery-driven terms (P2 credit, P3
+    deficit) provide the separation — the signal this config exists to
+    test."""
+    topo, subs, cfg, sp, adversary, sched, topics, _ = _sybil_setup()
+    tp0 = dataclasses.replace(
+        sp.topics[0],
+        time_in_mesh_weight=0.0,
+        first_message_deliveries_weight=1.0,
+    )
+    sp = dataclasses.replace(sp, topics={0: tp0})
+    import jax.numpy as jnp
+
+    net = Net.build(topo, subs)
+    st = GossipSubState.init(net, MSG_SLOTS, cfg, score_params=sp, seed=3)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               adversary_no_forward=adversary)
+    pv = jnp.ones((PUBS_PER_ROUND,), bool)
+    for _ in range(WARMUP):
+        st = step(st, *no_publish(PUBS_PER_ROUND))
+    for r in range(sched.shape[0]):
+        st = step(st, jnp.asarray(sched[r]), jnp.asarray(topics[r]), pv)
+    for _ in range(16):
+        st = step(st, *no_publish(PUBS_PER_ROUND))
+
+    scores = np.asarray(st.scores)          # [N,K]
+    nbr = np.asarray(net.nbr)
+    ok = np.asarray(net.nbr_ok)
+    mesh = np.asarray(st.mesh)[:, 0, :].astype(bool)
+    hon_rows = ~adversary
+    adv_nbr = adversary[np.clip(nbr, 0, None)] & ok
+    # the deficit machinery largely expels sybils from honest meshes
+    # (they started at ~20% of edges)
+    syb_frac_v = (mesh & adv_nbr)[hon_rows].sum() / max(mesh[hon_rows].sum(), 1)
+    assert syb_frac_v < 0.10
+    # and across all edges, sybil neighbors score below honest ones
+    assert scores[adv_nbr].mean() < scores[~adv_nbr & ok].mean()
+
+    o = OracleGossipSub(
+        topo, subs, cfg, msg_slots=MSG_SLOTS, seed=11, score_params=sp,
+        adversary=set(np.flatnonzero(adversary).tolist()),
+    )
+    for _ in range(WARMUP):
+        o.step()
+    for r in range(sched.shape[0]):
+        o.step([(int(p), int(t), True) for p, t in zip(sched[r], topics[r])])
+    for _ in range(16):
+        o.step()
+    adv_s, hon_s = [], []
+    syb_mesh = tot_mesh = 0
+    for i in range(N):
+        if adversary[i]:
+            continue
+        m = o.mesh[i].get(0, set())
+        for k, s, r in o._edges(i):
+            if k in m:
+                tot_mesh += 1
+                syb_mesh += s in o.adversary
+            (adv_s if s in o.adversary else hon_s).append(o._score(i, k))
+    assert syb_mesh / max(tot_mesh, 1) < 0.10
+    assert np.mean(adv_s) < np.mean(hon_s)

@@ -1,0 +1,192 @@
+"""ValidationIgnore verdicts + the reject-reason taxonomy.
+
+Reference semantics (validation.go:40-52; score.go:721-786): an ignored
+message is neither delivered nor forwarded, but — unlike a rejected one —
+its senders take no P4 invalid-message penalty; the gater counts it on the
+`ignore` stat (peer_gater.go:427-429); the trace reason is "validation
+ignored" (tracer.go:38).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu import api, graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerGaterParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.state import (
+    VERDICT_ACCEPT,
+    VERDICT_IGNORE,
+    VERDICT_REJECT,
+    Net,
+)
+from go_libp2p_pubsub_tpu.trace import sinks
+from go_libp2p_pubsub_tpu.trace.events import EV
+
+
+def _build(n=48, gater=False, invalid_weight=-1.0):
+    topo = graph.ring_lattice(n, d=4)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    tp = TopicScoreParams(
+        invalid_message_deliveries_weight=invalid_weight,
+        invalid_message_deliveries_decay=0.9,
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    gp = PeerGaterParams() if gater else None
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True,
+        gater_params=gp,
+    )
+    cfg = dataclasses.replace(cfg, fanout_slots=0)
+    st = GossipSubState.init(net, 32, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gp)
+    return net, cfg, sp, st, step
+
+
+def _run(step, st, verdict, rounds=10, origin=0):
+    po = jnp.asarray(np.array([origin, -1, -1, -1], np.int32))
+    pt = jnp.asarray(np.zeros(4, np.int32))
+    pv = jnp.asarray(np.full(4, verdict, np.int8))
+    for _ in range(rounds):
+        st = step(st, po, pt, pv)
+        po = jnp.asarray(np.array([-1, -1, -1, -1], np.int32))
+    return st
+
+
+def test_ignored_messages_move_no_score():
+    net, cfg, sp, st0, step = _build()
+    st_ign = _run(step, jax.tree.map(jnp.copy, st0), VERDICT_IGNORE)
+    st_rej = _run(step, jax.tree.map(jnp.copy, st0), VERDICT_REJECT)
+
+    imd_ign = np.asarray(st_ign.score.imd)
+    imd_rej = np.asarray(st_rej.score.imd)
+    # rejected copies penalize every delivering edge; ignored move nothing
+    assert imd_rej.sum() > 0
+    assert imd_ign.sum() == 0
+    # and the P4 term shows in the composed scores
+    assert float(np.asarray(st_rej.scores).min()) < 0
+    assert float(np.asarray(st_ign.scores).min()) >= 0
+
+
+def test_ignored_not_forwarded_not_delivered():
+    net, cfg, sp, st0, step = _build()
+    st = _run(step, st0, VERDICT_IGNORE, rounds=8)
+    # the message propagated nowhere beyond direct neighbors of the origin:
+    # receivers mark it seen but never forward (fwd stays empty), so only
+    # mesh neighbors of the origin ever saw it
+    have = np.asarray(st.core.dlv.have)
+    seen_peers = (have != 0).any(axis=1).sum()
+    assert seen_peers <= 1 + net.max_degree  # origin + its direct mesh
+    assert np.asarray(st.core.dlv.fwd).sum() == 0
+    # REJECT was traced for the receipts (events counted), DELIVER was not
+    ev = np.asarray(st.core.events)
+    assert ev[EV.REJECT_MESSAGE] > 0
+    assert ev[EV.DELIVER_MESSAGE] == 0
+
+
+def test_gater_counts_ignore_separately():
+    net, cfg, sp, st0, step = _build(gater=True)
+    st_ign = _run(step, jax.tree.map(jnp.copy, st0), VERDICT_IGNORE)
+    st_rej = _run(step, jax.tree.map(jnp.copy, st0), VERDICT_REJECT)
+    assert np.asarray(st_ign.gater.ignore).sum() > 0
+    assert np.asarray(st_ign.gater.reject).sum() == 0
+    assert np.asarray(st_rej.gater.reject).sum() > 0
+    assert np.asarray(st_rej.gater.ignore).sum() == 0
+
+
+def test_trace_reason_taxonomy(tmp_path):
+    # drive through the api with a validator returning IGNORE, and check
+    # the traced REJECT events carry "validation ignored"
+    path = str(tmp_path / "trace.json")
+    net = api.Network(trace_sinks=[sinks.JSONTracer(path)])
+    nodes = net.add_nodes(16)
+    net.dense_connect(d=6, seed=0)
+    [nd.join("t") for nd in nodes]
+    nodes[0].register_topic_validator(
+        "t", lambda pid, msg: api.ValidationResult.IGNORE
+        if msg.data.startswith(b"ign") else True,
+    )
+    net.start()
+    net.run(2)
+    try:
+        nodes[1].topics["t"].publish(b"ignore-me")
+        raised = False
+    except api.ValidationError:
+        raised = True
+    # local publish of an ignored message errors out like PushLocal
+    assert raised
+    # a remote-style injection: publish valid traffic so the trace has both
+    nodes[2].topics["t"].publish(b"ok")
+    net.run(6)
+    net.stop()
+    import json
+
+    reasons = []
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if "rejectMessage" in ev:
+                reasons.append(ev["rejectMessage"].get("reason"))
+    # nothing rejected in this honest run; now check the engine-level
+    # reason via a direct verdict injection with a session
+    assert all(r == "validation failed" for r in reasons)
+
+
+def test_trace_reason_ignored_via_session(tmp_path):
+    from go_libp2p_pubsub_tpu.trace.drain import TraceSession, snapshot
+
+    net, cfg, sp, st, step = _build(n=24)
+    path = str(tmp_path / "t.json")
+    sess = TraceSession(net, [sinks.JSONTracer(path)])
+    sess.emit_init(snapshot(st))
+    po = np.array([0, -1, -1, -1], np.int32)
+    pt = np.zeros(4, np.int32)
+    for r in range(6):
+        pv = np.full(4, VERDICT_IGNORE if r == 0 else VERDICT_ACCEPT, np.int8)
+        if r > 0:
+            po = np.array([r % 24, -1, -1, -1], np.int32)
+        prev = snapshot(st)
+        st = step(st, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+        sess.observe(prev, snapshot(st), po, pt, pv)
+    sess.close(snapshot(st))
+
+    import json
+
+    reasons = set()
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if "rejectMessage" in ev:
+                reasons.add(ev["rejectMessage"].get("reason"))
+    assert "validation ignored" in reasons
+
+
+def test_bool_verdicts_still_work():
+    net, cfg, sp, st0, step = _build()
+    po = jnp.asarray(np.array([0, -1, -1, -1], np.int32))
+    pt = jnp.asarray(np.zeros(4, np.int32))
+    pv = jnp.asarray(np.ones(4, bool))
+    st = step(st0, po, pt, pv)
+    assert int(st.core.tick) == 1
