@@ -1,0 +1,110 @@
+"""Multi-chip scaling measurement on the virtual CPU mesh (round-1 review
+item: "measure multi-chip scaling before hardware arrives").
+
+Runs the bench workload sharded over 1/2/4/8 virtual CPU devices and
+reports (a) relative step time and (b) which collectives GSPMD inserted
+for the cross-peer neighbor gathers. On the banded ring topology the
+peer-axis relabeling keeps every mesh edge within +-8 ids, so the
+expected lowering is halo exchange (collective-permute of the band
+edges), NOT all-gathers of peer-sized tensors.
+
+CPU timing is NOT a TPU perf prediction — XLA:CPU's collective runtime
+is a functional stand-in — but GSPMD partitioning decisions (which
+collectives, how many, on what shapes) are platform-independent, which
+is what this measures. tests/test_collectives.py pins the collective
+profile in CI.
+
+Usage: python scripts/scaling_cpu_mesh.py [N] [ROUNDS]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def collective_profile(hlo_text: str) -> dict:
+    """Count collective ops in compiled HLO, with the peer-sized tensor
+    shapes they move."""
+    prof = {}
+    for op in ("collective-permute", "all-gather", "all-reduce",
+               "all-to-all", "reduce-scatter"):
+        hits = re.findall(rf"(\S+) = \S+ {op}\(", hlo_text)
+        prof[op] = len(hits)
+    return prof
+
+
+def main():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import build_bench
+    from go_libp2p_pubsub_tpu.parallel import make_mesh, shard_state
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+
+    rng = np.random.default_rng(0)
+    po = jnp.asarray(rng.integers(0, n, size=(rounds, 4)).astype(np.int32))
+    pt = jnp.asarray(np.zeros((rounds, 4), np.int32))
+    pv = jnp.asarray(np.ones((rounds, 4), bool))
+
+    results = []
+    base_time = None
+    for n_dev in (1, 2, 4, 8):
+        st, step, n_topics, honest = build_bench(n, 64, config="default")
+        if n_dev > 1:
+            mesh = make_mesh(n_dev)
+            st = shard_state(st, mesh, n)
+
+        def run_seg(s):
+            def body(carry, xs):
+                return step(carry, *xs), None
+            s, _ = jax.lax.scan(body, s, (po, pt, pv))
+            return s
+
+        runj = jax.jit(run_seg, donate_argnums=0)
+        lowered = runj.lower(st)
+        compiled = lowered.compile()
+        prof = collective_profile(compiled.as_text())
+        st = compiled(st)
+        jax.block_until_ready(st)
+        # re-shard a fresh state (donation consumed the last one)
+        st2, _, _, _ = build_bench(n, 64, config="default")
+        if n_dev > 1:
+            st2 = shard_state(st2, make_mesh(n_dev), n)
+        t0 = time.perf_counter()
+        st2 = runj(st2)
+        jax.block_until_ready(st2)
+        dt = (time.perf_counter() - t0) / rounds
+        if base_time is None:
+            base_time = dt
+        results.append((n_dev, dt, base_time / dt, prof))
+        print(f"devices={n_dev}: {dt*1e3:8.1f} ms/round  "
+              f"speedup x{base_time/dt:4.2f}  collectives={prof}")
+
+    print("\n| devices | ms/round (CPU) | speedup | collective-permute | "
+          "all-gather | all-reduce |")
+    print("|---|---|---|---|---|---|")
+    for n_dev, dt, sp, prof in results:
+        print(f"| {n_dev} | {dt*1e3:.1f} | x{sp:.2f} | "
+              f"{prof['collective-permute']} | {prof['all-gather']} | "
+              f"{prof['all-reduce']} |")
+
+
+if __name__ == "__main__":
+    main()

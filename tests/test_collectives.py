@@ -1,0 +1,98 @@
+"""GSPMD collective profile of the sharded step (round-1 review item:
+"prove the banded relabeling makes neighbor gathers halo exchanges").
+
+Compiles the full v1.1 step sharded over the 8-virtual-device CPU mesh
+and pins the collective profile of the partitioned HLO:
+
+  * ZERO all-gathers — no peer-sized tensor is ever replicated; every
+    cross-peer neighbor gather lowers to collective-permute of the band
+    halo (the ring offsets are +-8, so each shard exchanges only its
+    edge rows with its two neighbor shards);
+  * a bounded, device-count-independent number of collective-permutes
+    (one per rolled gather, not per device pair);
+  * a handful of scalar all-reduces (event counters / popcount sums).
+
+GSPMD partitioning decisions are platform-independent, so this CPU-mesh
+check pins what XLA will emit on real ICI. scripts/scaling_cpu_mesh.py
+produces the full 1/2/4/8-device table recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.parallel import make_mesh, shard_state
+from go_libp2p_pubsub_tpu.state import Net
+
+
+def collective_profile(hlo_text: str) -> dict:
+    return {
+        op: len(re.findall(rf"(\S+) = \S+ {op}\(", hlo_text))
+        for op in ("collective-permute", "all-gather", "all-reduce",
+                   "all-to-all", "reduce-scatter")
+    }
+
+
+def test_sharded_step_collective_profile():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU harness")
+    n = 4096
+    topo = graph.ring_lattice(n, d=8)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    sp = PeerScoreParams(
+        topics={0: TopicScoreParams(
+            mesh_message_deliveries_weight=0.0,
+            mesh_failure_penalty_weight=0.0,
+        )},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True
+    )
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, fanout_slots=0, count_events=False)
+    st = GossipSubState.init(net, 64, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    st = shard_state(st, make_mesh(8), n)
+
+    import jax.numpy as jnp
+
+    po = jnp.asarray(np.array([0, -1, -1, -1], np.int32))
+    pt = jnp.asarray(np.zeros(4, np.int32))
+    pv = jnp.asarray(np.ones(4, bool))
+    compiled = step.lower(st, po, pt, pv).compile()
+    prof = collective_profile(compiled.as_text())
+
+    # the claim: neighbor gathers are halo exchanges, never replication
+    assert prof["all-gather"] == 0, prof
+    assert prof["all-to-all"] == 0, prof
+    # one permute per rolled gather — bounded and independent of device
+    # count (regression guard: a layout/sharding change that makes GSPMD
+    # replicate or per-pair-permute would blow past this)
+    assert 0 < prof["collective-permute"] <= 130, prof
+    assert prof["all-reduce"] <= 10, prof
+
+    # and the sharded step actually runs
+    out = compiled(st, po, pt, pv)
+    jax.block_until_ready(out)
+    assert int(out.core.tick) == 1

@@ -112,16 +112,24 @@ def test_compile_garbage_errors(client):
         client.compile(b"not an mlir module")
 
 
-def test_execute_full_gossipsub_step(client):
+@pytest.mark.parametrize("scored", [False, True])
+def test_execute_full_gossipsub_step(client, scored):
     """The flagship program end-to-end through the native bridge: export
-    the full jitted GossipSub v1.1 round step (state pytree flattened to
+    the full jitted GossipSub round step (state pytree flattened to
     buffers, PRNG key passed as raw key-data) and run one round with zero
-    Python in the loop — the embedding a Go host would use."""
+    Python in the loop — the embedding a Go host would use. The scored
+    variant is the production v1.1 machine (live score plane +
+    thresholds), pinning the ABI the Go embedder depends on."""
     import jax
     import jax.numpy as jnp
 
     from go_libp2p_pubsub_tpu import graph
-    from go_libp2p_pubsub_tpu.config import GossipSubParams, PeerScoreThresholds
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreParams,
+        PeerScoreThresholds,
+        TopicScoreParams,
+    )
     from go_libp2p_pubsub_tpu.models.gossipsub import (
         GossipSubConfig,
         GossipSubState,
@@ -132,9 +140,28 @@ def test_execute_full_gossipsub_step(client):
     n, m = 64, 32
     topo = graph.ring_lattice(n, d=3)
     net = Net.build(topo, graph.subscribe_all(n, 1))
-    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds())
-    st = GossipSubState.init(net, m, cfg, seed=0)
-    step = make_gossipsub_step(cfg, net)
+    if scored:
+        sp = PeerScoreParams(
+            topics={0: TopicScoreParams(
+                mesh_message_deliveries_weight=-0.5,
+                mesh_message_deliveries_threshold=2.0,
+                mesh_message_deliveries_activation=4.0,
+                mesh_message_deliveries_window=2.0,
+            )},
+            skip_app_specific=True,
+            behaviour_penalty_weight=-1.0,
+            behaviour_penalty_threshold=1.0,
+            behaviour_penalty_decay=0.9,
+        )
+        cfg = GossipSubConfig.build(
+            GossipSubParams(), PeerScoreThresholds(), score_enabled=True
+        )
+        st = GossipSubState.init(net, m, cfg, score_params=sp, seed=0)
+        step = make_gossipsub_step(cfg, net, score_params=sp)
+    else:
+        cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds())
+        st = GossipSubState.init(net, m, cfg, seed=0)
+        step = make_gossipsub_step(cfg, net)
 
     leaves, treedef = jax.tree_util.tree_flatten(st)
     key_idx = [
