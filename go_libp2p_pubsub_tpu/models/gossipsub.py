@@ -531,20 +531,29 @@ def sender_carry_words(mesh: jax.Array, slotw: jax.Array) -> jax.Array:
     return bitset.word_or_reduce(contrib, axis=1)  # [N,K,W]
 
 
+def fanout_topic_words(fanout_topic: jax.Array, msg_topic: jax.Array) -> jax.Array:
+    """[N,F,W] packed: messages in the topic of fanout slot f. Direct
+    compare+pack — the [N,F]-row gather from the tiny [T,W] table lowers
+    to a slow TPU gather (same finding as slot_topic_words)."""
+    bits = (
+        msg_topic[None, None, :] == fanout_topic[:, :, None]
+    ) & (msg_topic >= 0)[None, None, :]
+    return bitset.pack(bits)
+
+
 def fanout_carry_words(fanout_peers: jax.Array, fanout_topic: jax.Array,
-                       tw: jax.Array) -> jax.Array:
+                       msg_topic: jax.Array) -> jax.Array:
     """[N,K,W]: words each peer pushes on edge k for its fanout topics
     (gossipsub.go:1000-1002 — fanout peers receive published messages of
     unjoined topics)."""
-    live = (fanout_topic >= 0)[:, :, None]  # [N,F,1]
-    ftw = jnp.where(live, tw[jnp.clip(fanout_topic, 0)], jnp.uint32(0))  # [N,F,W]
+    ftw = fanout_topic_words(fanout_topic, msg_topic)  # [N,F,W]
     contrib = jnp.where(fanout_peers[:, :, :, None], ftw[:, :, None, :], jnp.uint32(0))
     return bitset.word_or_reduce(contrib, axis=1)
 
 
 def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
                      joined_words: jax.Array, acc_ok: jax.Array,
-                     slotw: jax.Array, tw: jax.Array,
+                     slotw: jax.Array, msg_topic: jax.Array,
                      flood_edges: jax.Array, nbr_score_of_me) -> jax.Array:
     """[N,K,W] edge-carry mask: mesh push (forwarding along the sender's
     mesh, gossipsub.go:981-1002) + fanout push + floodsub-peer edges
@@ -556,7 +565,7 @@ def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     carry_out = sender_carry_words(st.mesh, slotw)
     if cfg.fanout_slots > 0:
         carry_out = carry_out | fanout_carry_words(
-            st.fanout_peers, st.fanout_topic, tw
+            st.fanout_peers, st.fanout_topic, msg_topic
         )
     mask = jnp.where(
         net.nbr_ok[:, :, None],
@@ -907,7 +916,6 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     ft = st.fanout_topic
     fpeers = st.fanout_peers
     flastpub = st.fanout_lastpub
-    tw = topic_msg_words(st.core.msgs.topic, net.n_topics)  # [T,W]
     if nbr_sub_words is not None and cfg.fanout_slots > 0:
         # expire by FanoutTTL since last publish (gossipsub.go:1518-1524)
         expired = (ft >= 0) & (flastpub + cfg.fanout_ttl_ticks < tick)
@@ -970,7 +978,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
             0,
         )
         chosen_f = select_random_mask(kf2, gossip_cand_f, target_f)  # [N,F,K]
-        ftw = jnp.where((ft >= 0)[:, :, None], tw[jnp.clip(ft, 0)], jnp.uint32(0))
+        ftw = fanout_topic_words(ft, st.core.msgs.topic)
         adv_f = jnp.where(
             chosen_f[..., None], (gwin[:, None, :] & ftw)[:, :, None, :], jnp.uint32(0)
         )
@@ -1321,7 +1329,7 @@ def make_gossipsub_step(
                 interpret=fused_interp,
             )
             wire = wire_flat.reshape(n_peers, k_dim, wc)
-        else:
+        elif sizes[-1] <= 5:
             wire = net_l.edge_gather(jnp.concatenate(parts, axis=-1))
             wire = jnp.where(net_l.nbr_ok[:, :, None], wire, jnp.uint32(0))
             if cfg.score_enabled:
@@ -1330,9 +1338,31 @@ def make_gossipsub_step(
                     jax.lax.bitcast_convert_type(wire[..., sizes[-1] - 1], jnp.float32),
                     0.0,
                 )
+        else:
+            # wide-topic wire: a single merged gather result gets one
+            # monolithic layout-conversion copy (profiled 1.2 ms/round on
+            # the eth2 config, [N,16,7]) because its segments want
+            # different layouts; gathering per part lets each take its
+            # consumer's layout directly
+            gathered = [
+                jnp.where(
+                    net_l.nbr_ok[:, :, None], net_l.edge_gather(p), jnp.uint32(0)
+                )
+                for p in parts
+            ]
+            wire = None
+            if cfg.score_enabled:
+                nbr_score_of_me = jnp.where(
+                    net_l.nbr_ok,
+                    jax.lax.bitcast_convert_type(gathered[-1][..., 0], jnp.float32),
+                    0.0,
+                )
         if not cfg.score_enabled:
             nbr_score_of_me = None
-        w_seg = lambda i: wire[..., sizes[i] : sizes[i + 1]]
+        w_seg = (
+            (lambda i: wire[..., sizes[i] : sizes[i + 1]])
+            if wire is not None else (lambda i: gathered[i])
+        )
         ok_slots = net_l.nbr_ok[:, None, :]
         graft_in_raw = edges.topic_unpack(w_seg(0), net.my_topics) & ok_slots
         prune_in_raw = edges.topic_unpack(w_seg(1), net.my_topics) & ok_slots
@@ -1378,7 +1408,6 @@ def make_gossipsub_step(
 
         joined_words = joined_msg_words(net_l, core.msgs)
         slotw = slot_topic_words(net_l, core.msgs.topic)
-        tw = topic_msg_words(core.msgs.topic, net_l.n_topics)
         pre_have = core.dlv.have
         if use_fused:
             # 2+3+4 fused: IHAVE ingest first (it consumes nothing the
@@ -1393,7 +1422,7 @@ def make_gossipsub_step(
             carry = sender_carry_words(st2.mesh, slotw)
             if cfg.fanout_slots > 0:
                 carry = carry | fanout_carry_words(
-                    st2.fanout_peers, st2.fanout_topic, tw
+                    st2.fanout_peers, st2.fanout_topic, core.msgs.topic
                 )
             origin_w = origin_msg_words(net_l, core.msgs)
             if cfg.flood_publish:
@@ -1489,7 +1518,8 @@ def make_gossipsub_step(
                 recv_ok = net_l.nbr_ok
             flood_edges = flood_from_l | (i_am_floodsub[:, None] & recv_ok & net_l.nbr_ok)
             edge_mask = gossip_edge_mask(
-                cfg, net_l, st2, joined_words, acc_msg, slotw, tw, flood_edges,
+                cfg, net_l, st2, joined_words, acc_msg, slotw,
+                core.msgs.topic, flood_edges,
                 nbr_score_of_me,
             )
             if sender_fwd_ok is not None:
@@ -1522,6 +1552,7 @@ def make_gossipsub_step(
                 dlv.fe_words, dlv.first_round,
                 core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
                 msg_ignored=core.msgs.ignored,
+                slotw=slotw,
                 pending_words=(
                     bitset.word_or_reduce(dlv.pending, axis=1)
                     if cfg.validation_delay_rounds > 0 else None

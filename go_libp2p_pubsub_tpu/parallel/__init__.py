@@ -1,4 +1,5 @@
 from .sharding import (  # noqa: F401
+    collective_profile,
     make_mesh,
     make_multihost_mesh,
     peer_spec,

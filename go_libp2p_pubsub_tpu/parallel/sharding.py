@@ -81,3 +81,21 @@ def state_shardings(state, mesh: Mesh, n_peers: int):
 def shard_state(state, mesh: Mesh, n_peers: int):
     """Place a state pytree onto the mesh with peer-axis sharding."""
     return jax.device_put(state, state_shardings(state, mesh, n_peers))
+
+
+def collective_profile(hlo_text: str) -> dict:
+    """Count collective ops in compiled (partitioned) HLO — including the
+    async start forms, which is how XLA often emits them. Used by the
+    scaling report (scripts/scaling_cpu_mesh.py) and the CI regression
+    guard (tests/test_collectives.py) to pin the GSPMD lowering of the
+    cross-peer neighbor gathers (halo collective-permutes, never
+    peer-sized all-gathers)."""
+    import re
+
+    prof = {}
+    for op in ("collective-permute", "all-gather", "all-reduce",
+               "all-to-all", "reduce-scatter"):
+        n = len(re.findall(rf"= \S+ {op}\(", hlo_text))
+        n += len(re.findall(rf"= \S+ {op}-start\(", hlo_text))
+        prof[op] = n
+    return prof

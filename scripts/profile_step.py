@@ -124,11 +124,10 @@ def main():
         core = s.core
         joined_words = joined_msg_words(net, core.msgs)
         slotw = slot_topic_words(net, core.msgs.topic)
-        tw = topic_msg_words(core.msgs.topic, net.n_topics)
         flood_edges = jnp.zeros_like(net.nbr_ok)
         emask = gossip_edge_mask(
-            cfg, net, s, joined_words, net.nbr_ok, slotw, tw, flood_edges,
-            s.scores,
+            cfg, net, s, joined_words, net.nbr_ok, slotw, core.msgs.topic,
+            flood_edges, s.scores,
         )
         return common.delivery_round(net, core.msgs, core.dlv, emask, core.tick)
 

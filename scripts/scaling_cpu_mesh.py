@@ -20,22 +20,10 @@ Usage: python scripts/scaling_cpu_mesh.py [N] [ROUNDS]
 from __future__ import annotations
 
 import os
-import re
 import sys
 import time
 
 import numpy as np
-
-
-def collective_profile(hlo_text: str) -> dict:
-    """Count collective ops in compiled HLO, with the peer-sized tensor
-    shapes they move."""
-    prof = {}
-    for op in ("collective-permute", "all-gather", "all-reduce",
-               "all-to-all", "reduce-scatter"):
-        hits = re.findall(rf"(\S+) = \S+ {op}\(", hlo_text)
-        prof[op] = len(hits)
-    return prof
 
 
 def main():
@@ -53,7 +41,11 @@ def main():
     sys.path.insert(0, ".")
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from bench import build_bench
-    from go_libp2p_pubsub_tpu.parallel import make_mesh, shard_state
+    from go_libp2p_pubsub_tpu.parallel import (
+        collective_profile,
+        make_mesh,
+        shard_state,
+    )
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 10
@@ -83,12 +75,14 @@ def main():
         prof = collective_profile(compiled.as_text())
         st = compiled(st)
         jax.block_until_ready(st)
-        # re-shard a fresh state (donation consumed the last one)
+        # re-shard a fresh state (donation consumed the last one) and time
+        # the AOT-compiled executable — calling the jit wrapper here would
+        # re-trace and re-compile inside the timed region
         st2, _, _, _ = build_bench(n, 64, config="default")
         if n_dev > 1:
             st2 = shard_state(st2, make_mesh(n_dev), n)
         t0 = time.perf_counter()
-        st2 = runj(st2)
+        st2 = compiled(st2)
         jax.block_until_ready(st2)
         dt = (time.perf_counter() - t0) / rounds
         if base_time is None:

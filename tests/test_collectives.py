@@ -19,8 +19,6 @@ produces the full 1/2/4/8-device table recorded in BASELINE.md.
 
 from __future__ import annotations
 
-import re
-
 import jax
 import numpy as np
 import pytest
@@ -37,16 +35,12 @@ from go_libp2p_pubsub_tpu.models.gossipsub import (
     GossipSubState,
     make_gossipsub_step,
 )
-from go_libp2p_pubsub_tpu.parallel import make_mesh, shard_state
+from go_libp2p_pubsub_tpu.parallel import (
+    collective_profile,
+    make_mesh,
+    shard_state,
+)
 from go_libp2p_pubsub_tpu.state import Net
-
-
-def collective_profile(hlo_text: str) -> dict:
-    return {
-        op: len(re.findall(rf"(\S+) = \S+ {op}\(", hlo_text))
-        for op in ("collective-permute", "all-gather", "all-reduce",
-                   "all-to-all", "reduce-scatter")
-    }
 
 
 def test_sharded_step_collective_profile():
