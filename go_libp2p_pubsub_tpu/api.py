@@ -58,7 +58,14 @@ from .config import (
 )
 from .discovery import Discovery, DiscoverySession, min_topic_size
 from .pb import rpc_pb2
-from .sign import Identity, SignPolicy, check_signing_policy, sign_message
+from .sign import (
+    Identity,
+    SignPolicy,
+    check_signing_policy,
+    make_peer_record,
+    sign_message,
+    validate_peer_record,
+)
 from .state import (
     VERDICT_ACCEPT,
     VERDICT_IGNORE,
@@ -405,6 +412,7 @@ class Network:
         validate_throttle: int = DEFAULT_VALIDATE_THROTTLE,
         validation_delay_rounds: int = 0,
         queue_cap: int = 0,
+        px_connect: bool = False,
         seed: int = 0,
         trace_sinks=None,
         msg_id_fn: Callable | None = None,
@@ -419,6 +427,14 @@ class Network:
             )
         if queue_cap and router != "gossipsub":
             raise APIError("queue_cap is only modeled on the gossipsub router")
+        if px_connect:
+            if router != "gossipsub":
+                raise APIError("px_connect requires the gossipsub router")
+            if params is None or not params.do_px:
+                raise APIError(
+                    "px_connect requires GossipSubParams(do_px=True) — PX "
+                    "only rides PRUNEs when the router emits it"
+                )
         self.router = router
         self.params = params or GossipSubParams()
         self.score_params = score_params
@@ -430,6 +446,15 @@ class Network:
         self.validate_throttle = validate_throttle
         self.validation_delay_rounds = validation_delay_rounds
         self.queue_cap = queue_cap
+        self.px_connect = px_connect
+        # the certified addr-book analogue: each peer's self-signed record,
+        # what makePrune attaches to PX suggestions (gossipsub.go:1827-45).
+        # Tests may override _px_record_source to model record forgery.
+        self._peer_records: dict[int, "object"] = {}
+        self._px_record_source = (
+            lambda pruner_idx, suggested_idx:
+            self._peer_records.get(suggested_idx)
+        )
         self.seed = seed
         self.trace_sinks = trace_sinks
         self.msg_id_fn = msg_id_fn or default_msg_id
@@ -779,6 +804,11 @@ class Network:
 
         self._jnp = jnp
         self.started = True
+        # certified addr book: every peer's self-signed record (what
+        # makePrune will attach to PX suggestions)
+        self._peer_records = {
+            nd.idx: make_peer_record(nd.identity, 0) for nd in self.nodes
+        }
         if self._track_tags:
             from .connmgr import TagTracer
 
@@ -819,6 +849,163 @@ class Network:
             if not sub.cancelled:
                 sub._push(msg)
         return mid
+
+    # -- peer exchange (host-side pxConnect) ------------------------------
+
+    def _px_connect_pass(self) -> None:
+        """Host-side pxConnect (gossipsub.go:861-941): a PRUNE carrying PX
+        suggests up to PrunePeers of the pruner's current topic-mesh
+        members (score >= 0, excluding the pruned peer — makePrune,
+        gossipsub.go:1814-1850), each with a signed peer record. The
+        pruned peer validates every record — identity mismatch or a
+        signature that doesn't verify against the advertised peer's key
+        discards the suggestion (gossipsub.go:877-895) — and dials
+        validated peers it has no edge to, genuinely growing the topology
+        (the engine-level PX plane can only activate pre-provisioned
+        dormant edges). At most 8 dials per round (the reference's
+        connector pool, gossipsub.go:493-495)."""
+        px_out = np.asarray(self.state.prune_px_out)
+        if not px_out.any():
+            return
+        nbr = np.asarray(self.net.nbr)
+        nbr_ok = np.asarray(self.net.nbr_ok)
+        mesh = np.asarray(self.state.mesh)
+        scores = np.asarray(self.state.scores)
+        rng = np.random.default_rng(self.seed ^ (int(self.state.core.tick) << 1))
+        PRUNE_PEERS = 16   # GossipSubPrunePeers (gossipsub.go:46)
+        MAX_DIALS = 8      # per-peer pending-dial cap: each peer's router
+                           # owns its own connector pool (gossipsub.go:493-495)
+        dials: dict[int, int] = {}
+        new_edges = []
+        have = {(min(a, b), max(a, b)) for a, b in self._edges}
+        for j, s, k in np.argwhere(px_out):
+            if not nbr_ok[j, k]:
+                continue
+            p = int(nbr[j, k])   # the pruned peer receiving suggestions
+            sugg = [
+                int(nbr[j, kk]) for kk in np.nonzero(mesh[j, s])[0]
+                if nbr_ok[j, kk] and scores[j, kk] >= 0
+                and int(nbr[j, kk]) != p
+            ]
+            if len(sugg) > PRUNE_PEERS:
+                sugg = [int(x) for x in
+                        rng.choice(sugg, size=PRUNE_PEERS, replace=False)]
+            for q in sugg:
+                if dials.get(p, 0) >= MAX_DIALS:
+                    break
+                key = (min(p, q), max(p, q))
+                if p == q or key in have:
+                    continue
+                rec = self._px_record_source(int(j), q)
+                if not validate_peer_record(rec, self.nodes[q].identity.peer_id):
+                    continue
+                new_edges.append((p, q))
+                have.add(key)
+                dials[p] = dials.get(p, 0) + 1
+        if new_edges:
+            for a, b in new_edges:
+                self._edges.add((a, b))
+            self._rebuild_edges()
+
+    def _rebuild_edges(self) -> None:
+        """Rebuild the topology after edge additions, carrying all
+        per-edge protocol state across with an edge-slot remap (the edge
+        analogue of _resubscribe's topic-slot remap). Existing neighbors
+        keep their state at their new slot; fresh edges start with clean
+        soft state."""
+        import jax.numpy as jnp
+
+        assert self.router == "gossipsub"
+        old_net = self.net
+        self.net = self._build_net(min_slots=old_net.n_slots)
+
+        old_nbr = np.asarray(old_net.nbr)
+        old_ok = np.asarray(old_net.nbr_ok)
+        new_nbr = np.asarray(self.net.nbr)
+        new_ok = np.asarray(self.net.nbr_ok)
+        n = len(self.nodes)
+        k_old, k_new = old_nbr.shape[1], new_nbr.shape[1]
+        # idx[i, k'] = old edge slot holding the same neighbor, k_old = fresh
+        idx = np.full((n, k_new), k_old, np.int64)
+        for i in range(n):
+            pos = {int(old_nbr[i, kk]): kk
+                   for kk in range(k_old) if old_ok[i, kk]}
+            for kk in range(k_new):
+                if new_ok[i, kk]:
+                    o = pos.get(int(new_nbr[i, kk]))
+                    if o is not None:
+                        idx[i, kk] = o
+
+        def remap(arr, axis, fill):
+            a = np.asarray(arr)
+            pad_shape = list(a.shape)
+            pad_shape[axis] = 1
+            ap = np.concatenate(
+                [a, np.full(pad_shape, fill, a.dtype)], axis=axis
+            )
+            ix_shape = [1] * a.ndim
+            ix_shape[0] = n
+            ix_shape[axis] = k_new
+            out_shape = list(a.shape)
+            out_shape[axis] = k_new
+            ix = np.broadcast_to(idx.reshape(ix_shape), out_shape)
+            return jnp.asarray(np.take_along_axis(ap, ix, axis=axis))
+
+        st = self.state
+        score = st.score.replace(
+            fmd=remap(st.score.fmd, 2, 0.0),
+            mmd=remap(st.score.mmd, 2, 0.0),
+            mfp=remap(st.score.mfp, 2, 0.0),
+            imd=remap(st.score.imd, 2, 0.0),
+            graft_tick=remap(st.score.graft_tick, 2, -1),
+            mesh_time=remap(st.score.mesh_time, 2, 0),
+            mmd_active=remap(st.score.mmd_active, 2, False),
+            bp=remap(st.score.bp, 1, 0.0),
+        )
+        gater = st.gater.replace(
+            deliver=remap(st.gater.deliver, 1, 0.0),
+            duplicate=remap(st.gater.duplicate, 1, 0.0),
+            ignore=remap(st.gater.ignore, 1, 0.0),
+            reject=remap(st.gater.reject, 1, 0.0),
+        )
+        if self.score_params is not None:
+            from .score.engine import ip_colocation_surplus_sq
+
+            p6 = ip_colocation_surplus_sq(
+                self.net,
+                self.score_params.ip_colocation_factor_threshold,
+                self.score_params.ip_colocation_factor_whitelist,
+            )
+        else:
+            p6 = jnp.zeros((n, k_new), jnp.float32)
+        self.state = st.replace(
+            core=st.core.replace(
+                dlv=st.core.dlv.replace(
+                    fe_words=remap(st.core.dlv.fe_words, 1, 0)
+                )
+            ),
+            mesh=remap(st.mesh, 2, False),
+            backoff_expire=remap(st.backoff_expire, 2, 0),
+            backoff_present=remap(st.backoff_present, 2, False),
+            graft_out=remap(st.graft_out, 2, False),
+            prune_out=remap(st.prune_out, 2, False),
+            prune_px_out=remap(st.prune_px_out, 2, False),
+            ihave_out=remap(st.ihave_out, 1, 0),
+            iwant_out=remap(st.iwant_out, 1, 0),
+            served_lo=remap(st.served_lo, 1, 0),
+            served_hi=remap(st.served_hi, 1, 0),
+            peerhave=remap(st.peerhave, 1, 0),
+            iasked=remap(st.iasked, 1, 0),
+            promise_mid=remap(st.promise_mid, 1, -1),
+            promise_expire=remap(st.promise_expire, 1, 0),
+            scores=remap(st.scores, 1, 0.0),
+            p6=p6,
+            fanout_peers=remap(st.fanout_peers, 2, False),
+            edge_live=remap(st.edge_live, 1, True),
+            score=score,
+            gater=gater,
+        )
+        self._recompile_gossipsub()
 
     def _run_validators(self, node: Node, topic: Topic, msg, local: bool) -> int:
         """Returns a VERDICT_* code. Local publishes surface reject and
@@ -927,6 +1114,8 @@ class Network:
             if self.tag_tracer is not None:
                 self.tag_tracer.observe(prev, new)
             self._drain_deliveries(prev, new)
+            if self.px_connect:
+                self._px_connect_pass()
 
             # slow-heartbeat warning (gossipsub.go:133-135,1305-1312): a
             # real-time co-simulation can't keep up when a tick's wall

@@ -313,12 +313,6 @@ class GossipSubState:
         )
 
 
-def topic_msg_words(msg_topic: jax.Array, n_topics: int) -> jax.Array:
-    """[T, W] packed per-topic message masks."""
-    onehot = msg_topic[None, :] == jnp.arange(n_topics, dtype=jnp.int32)[:, None]
-    return bitset.pack(onehot)
-
-
 def msg_slot_of(net: Net, msg_topic: jax.Array) -> jax.Array:
     """[N, M] receiver topic-slot per message (-1 when not subscribed)."""
     t = jnp.clip(msg_topic, 0)

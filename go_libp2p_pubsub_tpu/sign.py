@@ -158,3 +158,59 @@ def check_signing_policy(policy: SignPolicy, msg: rpc_pb2.Message) -> None:
     # lax: verify only when a signature is present
     if msg.HasField("signature"):
         verify_message(msg)
+
+
+# ---------------------------------------------------------------------------
+# signed peer records (PX payloads)
+#
+# PRUNE peer exchange carries a signed peer record per suggested peer
+# (pb/rpc.proto:55-57 PeerInfo.signedPeerRecord); the pruned peer validates
+# the envelope before dialing — a record whose payload identity doesn't
+# match the advertised peer, or whose signature doesn't verify against that
+# identity's key, is discarded (pxConnect, gossipsub.go:877-895). The
+# record here is the sim's envelope analogue: (peer_id, seqno) signed by
+# the subject's key, with the key recoverable from the ed25519
+# key-in-peer-id encoding (peer_id_from_pubkey above).
+
+PEER_RECORD_DOMAIN = b"libp2p-peer-record:"
+
+
+@dataclass(frozen=True)
+class SignedPeerRecord:
+    peer_id: bytes
+    seqno: int
+    signature: bytes
+
+
+def _record_payload(peer_id: bytes, seqno: int) -> bytes:
+    return PEER_RECORD_DOMAIN + peer_id + int(seqno).to_bytes(8, "big")
+
+
+def make_peer_record(ident: Identity, seqno: int = 0) -> SignedPeerRecord:
+    """Self-signed peer record (the certified addr-book entry the reference
+    attaches in makePrune, gossipsub.go:1827-1845)."""
+    return SignedPeerRecord(
+        peer_id=ident.peer_id,
+        seqno=seqno,
+        signature=ident.key.sign(_record_payload(ident.peer_id, seqno)),
+    )
+
+
+def validate_peer_record(rec: "SignedPeerRecord | None",
+                         expected_peer_id: bytes) -> bool:
+    """The pxConnect envelope checks (gossipsub.go:877-895): the record's
+    identity must match the advertised peer and the signature must verify
+    against the key embedded in that identity. Returns False — discard,
+    don't dial — on any mismatch or forgery."""
+    if rec is None:
+        return False
+    if rec.peer_id != expected_peer_id:
+        return False
+    pub = pubkey_from_peer_id(rec.peer_id)
+    if pub is None:
+        return False
+    try:
+        pub.verify(rec.signature, _record_payload(rec.peer_id, rec.seqno))
+        return True
+    except InvalidSignature:
+        return False

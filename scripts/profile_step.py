@@ -42,7 +42,6 @@ def main():
         make_gossipsub_step,
         no_publish,
         slot_topic_words,
-        topic_msg_words,
     )
     from go_libp2p_pubsub_tpu.ops import bitset, edges
     from go_libp2p_pubsub_tpu.score.engine import compute_scores, refresh_scores
