@@ -1323,21 +1323,14 @@ def make_gossipsub_step(
                 interpret=fused_interp,
             )
             wire = wire_flat.reshape(n_peers, k_dim, wc)
-        elif sizes[-1] <= 5:
-            wire = net_l.edge_gather(jnp.concatenate(parts, axis=-1))
-            wire = jnp.where(net_l.nbr_ok[:, :, None], wire, jnp.uint32(0))
-            if cfg.score_enabled:
-                nbr_score_of_me = jnp.where(
-                    net_l.nbr_ok,
-                    jax.lax.bitcast_convert_type(wire[..., sizes[-1] - 1], jnp.float32),
-                    0.0,
-                )
         else:
-            # wide-topic wire: a single merged gather result gets one
-            # monolithic layout-conversion copy (profiled 1.2 ms/round on
-            # the eth2 config, [N,16,7]) because its segments want
-            # different layouts; gathering per part lets each take its
-            # consumer's layout directly
+            # per-part gathers: a single merged gather result gets one
+            # monolithic layout-conversion copy (profiled 1.2 ms/round —
+            # 32% of the default config's round, [N,16,5]) because its
+            # segments want different layouts; gathering per part lets
+            # each take its consumer's layout directly. (Round 1 measured
+            # the merged gather as a win; the cond-gated heartbeat and
+            # packed fe-plane changes since have inverted that.)
             gathered = [
                 jnp.where(
                     net_l.nbr_ok[:, :, None], net_l.edge_gather(p), jnp.uint32(0)
