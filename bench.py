@@ -169,7 +169,7 @@ def main():
                 # unroll: adjacent iterations let XLA cancel the carry
                 # layout conversions the while-loop form pays per tick
                 # (profiled ~35% of device time); 4 is the measured knee
-                s, _ = jax.lax.scan(body, s, (po, pt, pv), unroll=4)
+                s, _ = jax.lax.scan(body, s, (po, pt, pv), unroll=int(os.environ.get('BENCH_UNROLL', 4)))
                 return s
 
             run_seg_j = jax.jit(run_seg, donate_argnums=0)
