@@ -1,0 +1,30 @@
+# Top-level workflow targets. The perf workflow is `make bench`: the
+# single-chip number is only meaningful alongside the sharded collective
+# audit — round 2 shipped a single-chip win (bf9cbc9) that silently
+# regressed the multi-chip halo-permute count from 96 to 144, which is
+# exactly what the paired audit now catches.
+
+.PHONY: bench audit test quick native go-example
+
+# the driver's bench (one JSON line, real chip) + the GSPMD collective
+# audit pinned by tests/test_collectives.py (8 virtual CPU devices)
+bench:
+	python bench.py
+	python -m pytest tests/test_collectives.py -q
+
+# the full 1/2/4/8-device collective table (BASELINE.md)
+audit:
+	python scripts/scaling_cpu_mesh.py
+
+test:
+	python -m pytest tests/ -q
+
+# quick tier only (skips tests marked `slow` — see tests/conftest.py)
+quick:
+	python -m pytest tests/ -q -m "not slow"
+
+native:
+	$(MAKE) -C native
+
+go-example:
+	$(MAKE) -C native example_host_go

@@ -1097,10 +1097,20 @@ def make_gossipsub_step(
     gater_params=None,
     dynamic_peers: bool = False,
     adversary_no_forward: np.ndarray | None = None,
+    static_heartbeat: bool = False,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
     step(state, pub_origin[P], pub_topic[P], pub_valid[P]) -> state
+
+    With ``static_heartbeat=True`` (and ``cfg.heartbeat_every > 1``) the
+    step takes a trailing *static* python bool ``do_heartbeat`` instead of
+    deciding via ``tick % heartbeat_every`` on device. A driver that knows
+    the cadence at trace time (any fixed-schedule scan does) should use
+    this: the ``lax.cond`` form carries every state array through both
+    branches, and the branch-materialization copies measured 407 -> 113
+    ticks/s at heartbeat_every=2 on the bench (BASELINE.md round 3). The
+    caller owns the contract do_heartbeat == (tick % heartbeat_every == 0).
 
     ``pub_valid`` is either bool (True = accept, False = reject) or an
     integer array of state.VERDICT_* codes — ACCEPT / REJECT / IGNORE
@@ -1187,7 +1197,8 @@ def make_gossipsub_step(
         else jnp.ones(net.nbr.shape, bool)
     )
 
-    def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next) -> GossipSubState:
+    def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
+               do_heartbeat: bool = True) -> GossipSubState:
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
         if dynamic_peers:
             eff_next = up_next & ~st.blacklist
@@ -1296,19 +1307,21 @@ def make_gossipsub_step(
         # 1096-1141 sendRPC + piggyback). On banded topologies the gather
         # runs as a Pallas halo kernel (ops/fused_round.edge_exchange) and
         # the score plane rides as f32 instead of a bitcast word.
-        parts = [
-            edges.topic_pack(st.graft_out, net.my_topics, net.n_topics),
-            edges.topic_pack(st.prune_out, net.my_topics, net.n_topics),
-            st.ihave_out,
+        named_parts = [
+            ("graft", edges.topic_pack(st.graft_out, net.my_topics, net.n_topics)),
+            ("prune", edges.topic_pack(st.prune_out, net.my_topics, net.n_topics)),
+            ("ihave", st.ihave_out),
         ]
         if cfg.do_px:
-            parts.append(
-                edges.topic_pack(st.prune_px_out, net.my_topics, net.n_topics)
+            named_parts.append(
+                ("px", edges.topic_pack(st.prune_px_out, net.my_topics, net.n_topics))
             )
         if not use_fused and cfg.score_enabled:
-            parts.append(
-                jax.lax.bitcast_convert_type(st.scores, jnp.uint32)[..., None]
+            named_parts.append(
+                ("score",
+                 jax.lax.bitcast_convert_type(st.scores, jnp.uint32)[..., None])
             )
+        parts = [p for _, p in named_parts]
         sizes = np.cumsum([0] + [p.shape[-1] for p in parts])
         n_peers = net.n_peers
         k_dim = net.max_degree
@@ -1324,24 +1337,54 @@ def make_gossipsub_step(
             )
             wire = wire_flat.reshape(n_peers, k_dim, wc)
         else:
-            # per-part gathers: a single merged gather result gets one
-            # monolithic layout-conversion copy (profiled 1.2 ms/round —
-            # 32% of the default config's round, [N,16,5]) because its
-            # segments want different layouts; gathering per part lets
-            # each take its consumer's layout directly. (Round 1 measured
-            # the merged gather as a win; the cond-gated heartbeat and
-            # packed fe-plane changes since have inverted that.)
-            gathered = [
-                jnp.where(
-                    net_l.nbr_ok[:, :, None], net_l.edge_gather(p), jnp.uint32(0)
+            # Gather-merge policy (measured on the real chip, round 3).
+            # Each gathered tensor = one set of rolled halo permutes on
+            # the sharded mesh (test_collectives pins the total), so fewer
+            # gathers is better — UNLESS merging parts whose consumers
+            # want different layouts, which re-creates the monolithic
+            # relayout copy (1.2 ms/round when the f32-bitcast score
+            # column rode along in round 2; eth2 210 -> 168 when ihave
+            # merged with the 2-word topic parts). Measured policy: at
+            # wt == 1 ALL control words share one gather ([N,K,4] merged,
+            # 408 vs 400 ticks/s); at wt > 1 only the topic_unpack
+            # consumers (graft/prune/px) merge and ihave rides alone; the
+            # score plane ALWAYS rides alone. Grouping is by part name so
+            # the policy cannot drift from the parts list above.
+            ctrl_names = [nm for nm, _ in named_parts if nm != "score"]
+            wt_t = parts[0].shape[-1]
+            if wt_t == 1:
+                groups = [list(range(len(ctrl_names)))]
+            else:
+                topicish = [
+                    i for i, nm in enumerate(ctrl_names) if nm != "ihave"
+                ]
+                groups = [topicish, [ctrl_names.index("ihave")]]
+            gathered = [None] * len(ctrl_names)
+            for grp in groups:
+                g = (
+                    jnp.concatenate([parts[i] for i in grp], axis=-1)
+                    if len(grp) > 1 else parts[grp[0]]
                 )
-                for p in parts
-            ]
+                gg = jnp.where(
+                    net_l.nbr_ok[:, :, None], net_l.edge_gather(g), jnp.uint32(0)
+                )
+                off = 0
+                for i in grp:
+                    pw = parts[i].shape[-1]
+                    gathered[i] = gg[..., off : off + pw]
+                    off += pw
             wire = None
             if cfg.score_enabled:
+                # the score plane always rides alone: its f32-bitcast
+                # consumer's layout caused the round-2 relayout copy
+                score_g = jnp.where(
+                    net_l.nbr_ok[:, :, None],
+                    net_l.edge_gather(dict(named_parts)["score"]),
+                    jnp.uint32(0),
+                )
                 nbr_score_of_me = jnp.where(
                     net_l.nbr_ok,
-                    jax.lax.bitcast_convert_type(gathered[-1][..., 0], jnp.float32),
+                    jax.lax.bitcast_convert_type(score_g[..., 0], jnp.float32),
                     0.0,
                 )
         if not cfg.score_enabled:
@@ -1657,10 +1700,31 @@ def make_gossipsub_step(
 
         if cfg.heartbeat_every == 1:
             st2 = hb(st2)
+        elif static_heartbeat:
+            # trace-time decision: the driver asserts the cadence; the
+            # non-heartbeat trace contains no heartbeat code at all (no
+            # lax.cond branch-materialization copies of the state)
+            if do_heartbeat:
+                st2 = hb(st2)
         else:
             st2 = jax.lax.cond((tick % cfg.heartbeat_every) == 0, hb, lambda s: s, st2)
 
         return st2.replace(core=st2.core.replace(tick=tick + 1))
+
+    use_static_hb = static_heartbeat and cfg.heartbeat_every > 1
+    if use_static_hb:
+        # do_heartbeat is REQUIRED here: a default would let a driver
+        # silently heartbeat every round (or never) against the cadence
+        if dynamic_peers:
+            def step(st, pub_origin, pub_topic, pub_valid, up_next, *, do_heartbeat):
+                return _round(st, pub_origin, pub_topic, pub_valid, up_next,
+                              do_heartbeat)
+        else:
+            def step(st, pub_origin, pub_topic, pub_valid, *, do_heartbeat):
+                return _round(st, pub_origin, pub_topic, pub_valid, None,
+                              do_heartbeat)
+        return jax.jit(step, donate_argnums=0,
+                       static_argnames=("do_heartbeat",))
 
     if dynamic_peers:
         def step(st, pub_origin, pub_topic, pub_valid, up_next):

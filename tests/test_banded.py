@@ -3,6 +3,7 @@ be bit-identical to the generic edge-permutation gathers — the bench's
 ring-lattice runs take only this path, so parity here is what makes its
 numbers trustworthy."""
 
+import pytest
 import dataclasses
 
 import jax
@@ -59,6 +60,7 @@ def test_banded_kernels_match_gather():
     assert (pa == pb).all()
 
 
+@pytest.mark.slow
 def test_gossipsub_step_banded_equals_gather():
     # the full v1.1 step (publishes, heartbeats, scoring, fanout) must be
     # bit-identical between the roll path and the generic gather path

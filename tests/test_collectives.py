@@ -82,8 +82,16 @@ def test_sharded_step_collective_profile():
     assert prof["all-to-all"] == 0, prof
     # one permute per rolled gather — bounded and independent of device
     # count (regression guard: a layout/sharding change that makes GSPMD
-    # replicate or per-pair-permute would blow past this)
-    assert 0 < prof["collective-permute"] <= 130, prof
+    # replicate or per-pair-permute would blow past this).
+    # Pinned at 112 (round 3): 16 ring offsets x 7 gathers (merged
+    # control wire, score plane, fwd, fe, window, + heartbeat's
+    # direct/suppress gathers). Round-2 history: 96 with the score column
+    # folded into the wire gather (cost 1.2 ms/round single-chip), 144
+    # with fully per-part gathers (the bf9cbc9 regression). The merge
+    # policy in models/gossipsub.py trades one extra halo exchange
+    # (+16 permutes, ~K*W halo rows each) for the measured single-chip
+    # win; BASELINE.md "round 3" records the deliberate tradeoff.
+    assert 0 < prof["collective-permute"] <= 116, prof
     assert prof["all-reduce"] <= 10, prof
 
     # and the sharded step actually runs

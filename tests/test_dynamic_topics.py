@@ -44,6 +44,7 @@ def test_join_after_start_receives_messages():
     assert len(got) == 1 and got[0].data == b"after-join"
 
 
+@pytest.mark.slow
 def test_leave_after_start_stops_delivery_and_prunes():
     net = api.Network()
     nodes = net.add_nodes(12)
@@ -73,6 +74,7 @@ def test_leave_after_start_stops_delivery_and_prunes():
     assert "t" not in leaver.topics
 
 
+@pytest.mark.slow
 def test_rejoin_forms_mesh_again():
     net = api.Network()
     nodes = net.add_nodes(10)
@@ -90,6 +92,7 @@ def test_rejoin_forms_mesh_again():
     assert sum(1 for _ in sub) == 1
 
 
+@pytest.mark.slow
 def test_scored_state_survives_resubscribe():
     """Counters for the untouched topic must carry across the rebuild."""
     net = api.Network(score_params=_scored_params())
@@ -143,6 +146,7 @@ def test_get_topics_and_list_peers():
     assert nodes[0].list_peers("nope") == []
 
 
+@pytest.mark.slow
 def test_set_score_params_live():
     from go_libp2p_pubsub_tpu.config import TopicScoreParams
 
@@ -218,6 +222,7 @@ def test_randomsub_runtime_join():
     assert got >= 1
 
 
+@pytest.mark.slow
 def test_resubscribe_with_tags_and_traces(tmp_path):
     """The TagTracer connmgr state and the TraceSession's net views must
     survive a runtime leave (slot remap + session refresh)."""

@@ -35,6 +35,10 @@ from go_libp2p_pubsub_tpu.ops import fused_round as fr
 from go_libp2p_pubsub_tpu.state import Net
 
 
+# the fused Pallas kernels are opt-in (PUBSUB_FUSED=1) and off in
+# production; their 13 ~20s parity suites run in the nightly tier
+pytestmark = pytest.mark.slow
+
 def _build(n=96, d=4, n_topics=1, msg_slots=32, score=True, flood_publish=False,
            gater=False, adversary=None, protocol=None, validation_capacity=0,
            fanout=False, do_px=False, count_events=True):

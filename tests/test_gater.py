@@ -1,6 +1,7 @@
 """Peer gater + validation-throttle tests (peer_gater_test.go /
 TestValidateOverload analogues)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -108,6 +109,7 @@ def test_validation_throttle_limits_intake():
     assert have[:, :4].mean() > 0.6
 
 
+@pytest.mark.slow
 def test_gater_protects_under_overload():
     # sustained invalid flood from one peer + tight validation capacity:
     # gater kicks in and the spammer's edges see drops while the honest

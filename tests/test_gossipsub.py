@@ -215,6 +215,7 @@ def test_graft_prune_events_traced():
     assert (deg <= cfg.Dhi).all()
 
 
+@pytest.mark.slow
 def test_count_events_off_identical_protocol_state():
     """Tracer-detached mode (count_events=False) must change nothing but
     the aggregate counters — every protocol-visible array stays identical
@@ -248,3 +249,33 @@ def test_count_events_off_identical_protocol_state():
     # counters-off leaves the event array untouched
     assert (np.asarray(b.core.events) == 0).all()
     assert int(np.asarray(a.core.events)[EV.DELIVER_MESSAGE]) > 0
+
+
+def test_static_heartbeat_matches_cond():
+    """make_gossipsub_step(static_heartbeat=True) is bit-identical to the
+    lax.cond cadence when driven with do_heartbeat == (tick % he == 0).
+    (The static form exists because the cond's branch-materialization
+    copies measured 407 -> 113 ticks/s on the bench — BASELINE.md r3.)"""
+    import jax
+
+    he = 3
+    cfg = dataclasses.replace(GossipSubConfig.build(), heartbeat_every=he)
+    topo = graph.random_connect(48, 8, seed=5)
+    net = Net.build(topo, graph.subscribe_all(48, 1))
+    st0 = GossipSubState.init(net, 32, cfg, seed=1)
+    step_c = make_gossipsub_step(cfg, net)
+    step_s = make_gossipsub_step(cfg, net, static_heartbeat=True)
+
+    sa = jax.tree.map(jnp.copy, st0)
+    sb = st0
+    rng = np.random.default_rng(7)
+    for t in range(2 * he + 1):
+        args = pub([int(rng.integers(0, 48))], [0])
+        sa = step_c(sa, *args)
+        sb = step_s(sb, *args, do_heartbeat=(t % he == 0))
+    la = jax.tree.leaves(sa)
+    lb = jax.tree.leaves(sb)
+    for a, b in zip(la, lb):
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        assert (np.asarray(a) == np.asarray(b)).all()

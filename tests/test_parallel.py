@@ -2,6 +2,7 @@
 a 1-D ICI mesh and a 2-D (dcn, ici) multi-host mesh on the virtual
 8-device CPU platform, with results identical to the unsharded run."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -62,6 +63,7 @@ def test_multihost_mesh_shape():
     assert peer_spec(mesh) == jax.sharding.PartitionSpec(("dcn", "ici"))
 
 
+@pytest.mark.slow
 def test_sharded_step_matches_unsharded():
     st0, step = _build()
     ref = _run(st0, step)

@@ -113,6 +113,7 @@ def test_compile_garbage_errors(client):
 
 
 @pytest.mark.parametrize("scored", [False, True])
+@pytest.mark.slow
 def test_execute_full_gossipsub_step(client, scored):
     """The flagship program end-to-end through the native bridge: export
     the full jitted GossipSub round step (state pytree flattened to

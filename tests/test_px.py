@@ -4,6 +4,7 @@ activates dormant provisioned edges to them, gated by AcceptPXThreshold
 :861-941). In the vectorized model a "connect" flips a dormant edge of the
 candidate graph live (graph.dormant_edges)."""
 
+import pytest
 import dataclasses
 
 import jax.numpy as jnp
@@ -198,6 +199,7 @@ def test_heartbeat_oversub_prune_carries_px():
     assert not (live & ~np.asarray(net.nbr_ok)).any()
 
 
+@pytest.mark.slow
 def test_direct_connect_reactivates_dormant_direct_edges():
     # directConnect (gossipsub.go:1606-1628): every DirectConnectTicks the
     # router re-dials direct peers; a dormant direct edge comes back live

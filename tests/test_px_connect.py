@@ -12,6 +12,8 @@ forged records.
 
 from __future__ import annotations
 
+import pytest
+
 import numpy as np
 
 from go_libp2p_pubsub_tpu import api
@@ -51,6 +53,7 @@ def test_record_roundtrip_and_forgery():
     assert not validate_peer_record(forged, a.peer_id)    # forged signature
 
 
+@pytest.mark.slow
 def test_px_grows_topology_to_new_peers():
     net, nodes = _crowded_net()
     before = set((min(a, b), max(a, b)) for a, b in net._edges)
@@ -93,6 +96,7 @@ def test_forged_px_records_rejected():
     assert after == before, "forged records must not create connections"
 
 
+@pytest.mark.slow
 def test_state_survives_px_rebuild():
     net, nodes = _crowded_net()
     net.start()

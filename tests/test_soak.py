@@ -4,6 +4,7 @@ standing invariants the short tests can't see drift in (the reference's
 closest analogues are the long multi-hop/churn integration tests,
 gossipsub_test.go:853-1121, and the 50-host opportunistic-grafting run)."""
 
+import pytest
 import dataclasses
 
 import jax.numpy as jnp
@@ -25,6 +26,7 @@ from go_libp2p_pubsub_tpu.state import Net
 from go_libp2p_pubsub_tpu.trace.events import EV
 
 
+@pytest.mark.slow
 def test_soak_300_rounds_churn_and_adversary():
     n, m, rounds = 60, 32, 300
     rng = np.random.default_rng(42)

@@ -7,6 +7,8 @@ the reference's post-validation publishMessage ordering
 
 import dataclasses
 
+import pytest
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -115,6 +117,7 @@ def test_delayed_deliveries_catch_up_with_ample_slots():
     assert (np.sort(np.unique(np.nonzero(fr >= 0)[1])).size) == 6
 
 
+@pytest.mark.slow
 def test_api_network_with_validation_delay():
     net = api.Network(validation_delay_rounds=2)
     nodes = net.add_nodes(14)
@@ -225,6 +228,7 @@ def test_traced_run_under_delay(tmp_path):
     assert all(sum(1 for _ in s) == 1 for s in subs)
 
 
+@pytest.mark.slow
 def test_churn_clears_pending_pipeline():
     """A peer that dies mid-validation loses its pending receipts with the
     rest of its soft state (handleDeadPeers pubsub.go:648-689): after

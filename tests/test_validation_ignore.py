@@ -9,6 +9,8 @@ ignored" (tracer.go:38).
 
 from __future__ import annotations
 
+import pytest
+
 import dataclasses
 
 import jax
@@ -106,6 +108,7 @@ def test_ignored_not_forwarded_not_delivered():
     assert ev[EV.DELIVER_MESSAGE] == 0
 
 
+@pytest.mark.slow
 def test_gater_counts_ignore_separately():
     net, cfg, sp, st0, step = _build(gater=True)
     st_ign = _run(step, jax.tree.map(jnp.copy, st0), VERDICT_IGNORE)
@@ -116,6 +119,7 @@ def test_gater_counts_ignore_separately():
     assert np.asarray(st_rej.gater.ignore).sum() == 0
 
 
+@pytest.mark.slow
 def test_trace_reason_taxonomy(tmp_path):
     # drive through the api with a validator returning IGNORE, and check
     # the traced REJECT events carry "validation ignored"
