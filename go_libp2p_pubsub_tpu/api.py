@@ -58,6 +58,7 @@ from .config import (
 )
 from .discovery import Discovery, DiscoverySession, min_topic_size
 from .pb import rpc_pb2
+from .protocol import ProtocolMatcher
 from .sign import (
     Identity,
     SignPolicy,
@@ -418,6 +419,7 @@ class Network:
         msg_id_fn: Callable | None = None,
         discovery: Discovery | None = None,
         track_tags: bool = False,
+        protocol_matcher: "ProtocolMatcher | None" = None,
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
@@ -436,6 +438,17 @@ class Network:
                     "only rides PRUNEs when the router emits it"
                 )
         self.router = router
+        # protocol id -> feature set (custom protocols + WithProtocolMatchFn
+        # analogue; protocol.py documents the mapping to Net.protocol levels)
+        self.protocol_matcher = protocol_matcher or ProtocolMatcher()
+        # announce-retry model (pubsub.go:842-901): with queue_cap, a
+        # runtime Join's SubOpts announcement toward a congested link is
+        # dropped and retried with jitter; until it lands, that neighbor
+        # cannot see the subscription (sub_knowledge_holes)
+        self._pending_announce: dict = {}  # (joiner, tid) -> {receiver: due}
+        self.announce_retries = 0
+        self._announce_rng = np.random.default_rng(seed ^ 0xA220)
+        self._sub_holes = None  # [N, K, T] bool | None
         self.params = params or GossipSubParams()
         self.score_params = score_params
         self.thresholds = thresholds or PeerScoreThresholds()
@@ -487,6 +500,7 @@ class Network:
                  sub_filter: SubscriptionFilter | None = None,
                  seed: int | None = None) -> Node:
         self._check_not_started("add_node")
+        self.protocol_matcher.level(protocol)  # fail fast on unknown ids
         idx = len(self.nodes)
         ident = Identity.generate(self.seed * 1_000_003 + idx if seed is None else seed)
         node = Node(self, idx, ident, protocol, ip, sub_filter)
@@ -535,7 +549,7 @@ class Network:
             # runtime Join (pubsub.go:1163-1197): register the handle
             # first so _build_net sees the new subscription
             node.topics[topic] = t
-            self._resubscribe()
+            self._resubscribe(joiner=(node.idx, tid))
         # advertise joined topics to the discovery service
         # (handleAddSubscription -> disc.Advertise, pubsub.go:759-780)
         if self.discovery is not None:
@@ -612,21 +626,29 @@ class Network:
         max_slots = max(int(sub_mask.sum(axis=1).max()) if n else 1, min_slots, 1)
         subs = graphlib.subscribe_mask(sub_mask, max_slots=max_slots)
 
-        proto_code = {"/floodsub/1.0.0": 0, "/meshsub/1.0.0": 1, "/meshsub/1.1.0": 2}
-        protocol = np.array([proto_code[nd.protocol] for nd in self.nodes], np.int8)
+        protocol = np.array(
+            [self.protocol_matcher.level(nd.protocol) for nd in self.nodes],
+            np.int8,
+        )
         ip_names = [nd.ip if nd.ip is not None else f"ip-{nd.idx}" for nd in self.nodes]
         ip_tbl: dict[str, int] = {}
         ip_group = np.array([ip_tbl.setdefault(s, len(ip_tbl)) for s in ip_names], np.int32)
         return Net.build(topo, subs, ip_group=ip_group, protocol=protocol)
 
-    def _resubscribe(self, leaver: "tuple[int, int] | None" = None) -> None:
+    def _resubscribe(self, leaver: "tuple[int, int] | None" = None,
+                     joiner: "tuple[int, int] | None" = None) -> None:
         """Runtime Join/Leave (pubsub.go:1146-1218, topic.go): rebuild the
         subscription constants and recompile the step, carrying all protocol
         state across with a per-node topic-slot remap. The reference
-        announces subscription changes via a SubOpts RPC that peers apply on
-        receipt (announce, pubsub.go:842-859); here the new subscription map
-        becomes visible to everyone on the next round — the same one-RTT
-        visibility, without modeling announce-retry.
+        announces subscription changes via a SubOpts RPC that peers apply
+        on receipt (announce, pubsub.go:842-859); without backpressure the
+        new subscription map becomes visible to everyone on the next round
+        — the same one-RTT visibility. With ``queue_cap`` the announce
+        rides the joiner's per-link outbound queues: toward a link that
+        was saturated it is dropped and retried with jitter
+        (pubsub.go:861-901), and until it lands that neighbor cannot see
+        the subscription (sub_knowledge_holes; _process_announces runs the
+        retry loop each round).
 
         For a Leave, the leaver first PRUNEs its mesh members (Leave sends
         PRUNE+backoff, gossipsub.go:1066-1082): the prune rides the current
@@ -714,6 +736,22 @@ class Network:
                     mmd_active=remap(sc.mmd_active, False),
                 ),
             )
+            if joiner is not None and self.queue_cap > 0:
+                # every live edge of the joiner needs the SubOpts announce
+                # delivered before the far end can see the subscription;
+                # first attempt rides out next round
+                j, tid = joiner
+                nbr = np.asarray(self.net.nbr)
+                ok = np.asarray(self.net.nbr_ok)
+                now = int(self.state.core.tick)
+                recv = {
+                    i: now + 1
+                    for i in range(len(self.nodes))
+                    if i != j and bool((ok[i] & (nbr[i] == j)).any())
+                }
+                if recv:
+                    self._pending_announce[(j, tid)] = recv
+                    self._rebuild_sub_holes()
             self._recompile_gossipsub()
             if self.tag_tracer is not None:
                 old_tags = self.tag_tracer.cm.tags
@@ -753,6 +791,7 @@ class Network:
         self._step = make_gossipsub_step(
             self._cfg, self.net, score_params=self.score_params,
             gater_params=self.gater_params, dynamic_peers=True,
+            sub_knowledge_holes=self._sub_holes,
         )
 
     # -- start: freeze + compile ------------------------------------------
@@ -998,6 +1037,7 @@ class Network:
             iasked=remap(st.iasked, 1, 0),
             promise_mid=remap(st.promise_mid, 1, -1),
             promise_expire=remap(st.promise_expire, 1, 0),
+            congested_in=remap(st.congested_in, 1, False),
             scores=remap(st.scores, 1, 0.0),
             p6=p6,
             fanout_peers=remap(st.fanout_peers, 2, False),
@@ -1005,7 +1045,65 @@ class Network:
             score=score,
             gater=gater,
         )
+        # pending-announce holes are keyed by receiver id, not edge slot,
+        # but the [N, K, T] mask must be rebuilt at the new max_degree
+        # before the recompile consumes it
+        self._rebuild_sub_holes()
         self._recompile_gossipsub()
+
+    def _edge_slots_toward(self, i: int, j: int, nbr=None, ok=None):
+        """Edge slots of receiver i whose far end is peer j (live edges)."""
+        nbr = np.asarray(self.net.nbr) if nbr is None else nbr
+        ok = np.asarray(self.net.nbr_ok) if ok is None else ok
+        return np.flatnonzero(ok[i] & (nbr[i] == j))
+
+    def _rebuild_sub_holes(self) -> None:
+        """[N, K, T] knowledge-hole mask from the pending announces (which
+        are keyed by RECEIVER id — edge slots are derived from the CURRENT
+        net here, so topology rebuilds can't leave stale slots)."""
+        if not self._pending_announce:
+            self._sub_holes = None
+            return
+        nbr = np.asarray(self.net.nbr)
+        ok = np.asarray(self.net.nbr_ok)
+        holes = np.zeros(
+            (len(self.nodes), self.net.max_degree, self.net.n_topics), bool
+        )
+        for (j, tid), recv in self._pending_announce.items():
+            for i in recv:
+                for k in self._edge_slots_toward(i, j, nbr, ok):
+                    holes[i, k, tid] = True
+        self._sub_holes = holes
+
+    def _process_announces(self) -> None:
+        """One round of the announce-retry loop (pubsub.go:861-901): a
+        pending SubOpts announcement lands unless the joiner's outbound
+        link toward that neighbor was saturated this round — then it is
+        dropped and retried after a jittered backoff."""
+        if not self._pending_announce or self.router != "gossipsub":
+            return
+        cong = np.asarray(self.state.congested_in)  # [N, K]
+        nbr = np.asarray(self.net.nbr)
+        ok = np.asarray(self.net.nbr_ok)
+        now = int(self.state.core.tick)
+        changed = False
+        for key, recv in list(self._pending_announce.items()):
+            j, _tid = key
+            for i in list(recv):
+                if now < recv[i]:
+                    continue
+                ks = self._edge_slots_toward(i, j, nbr, ok)
+                if ks.size and bool(cong[i, ks].any()):
+                    self.announce_retries += 1
+                    recv[i] = now + 1 + int(self._announce_rng.integers(0, 2))
+                else:
+                    del recv[i]
+                    changed = True
+            if not recv:
+                del self._pending_announce[key]
+        if changed:
+            self._rebuild_sub_holes()
+            self._recompile_gossipsub()
 
     def _run_validators(self, node: Node, topic: Topic, msg, local: bool) -> int:
         """Returns a VERDICT_* code. Local publishes surface reject and
@@ -1116,6 +1214,7 @@ class Network:
             self._drain_deliveries(prev, new)
             if self.px_connect:
                 self._px_connect_pass()
+            self._process_announces()
 
             # slow-heartbeat warning (gossipsub.go:133-135,1305-1312): a
             # real-time co-simulation can't keep up when a tick's wall

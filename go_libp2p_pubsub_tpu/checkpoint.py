@@ -25,7 +25,9 @@ import numpy as np
 
 # v2: Delivery.first_edge [N,M] i8 replaced by packed fe_words [N,K,W] u32
 # v3: MsgTable grew the `ignored` verdict plane (ValidationIgnore)
-_FORMAT_VERSION = 3
+# v4: GossipSubState grew `congested_in` [N,K] (queue-cap link saturation,
+#     read by the host announce-retry model)
+_FORMAT_VERSION = 4
 
 
 def _is_key(leaf) -> bool:

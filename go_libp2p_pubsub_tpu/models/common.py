@@ -116,6 +116,32 @@ def origin_msg_words(net: Net, msgs: MsgTable) -> jax.Array:
     return jnp.zeros((n, w), jnp.uint32).at[row, slot // 32].add(upd, mode="drop")
 
 
+def pipeline_entry_masks(msg_topic: jax.Array, delay_topic: tuple, v: int) -> jax.Array:
+    """[V, W] u32 stage-entry masks for the per-topic validation-latency
+    pipeline: a receipt of a topic with delay d enters shift stage V - d,
+    so its verdict lands d rounds after arrival (the reference's per-topic
+    async validators complete at different times, validation.go:391-438).
+    Padding topics (-1) never match a stage — their bits can't arrive."""
+    import numpy as np
+
+    dt = jnp.asarray(np.asarray(delay_topic, np.int32))[jnp.clip(msg_topic, 0)]
+    stage = jnp.where(msg_topic >= 0, v - dt, -1)  # [M]
+    return bitset.pack(stage[None, :] == jnp.arange(v, dtype=jnp.int32)[:, None])
+
+
+def pipeline_insert(pending_shifted: jax.Array, new_words: jax.Array,
+                    msg_topic: jax.Array, delay_topic: tuple | None) -> jax.Array:
+    """Insert this round's fresh receipts into the (already shifted)
+    pipeline at their per-topic entry stage (stage 0 when uniform)."""
+    v = pending_shifted.shape[1]
+    if delay_topic is None:
+        return pending_shifted.at[:, 0, :].set(
+            pending_shifted[:, 0, :] | new_words
+        )
+    masks = pipeline_entry_masks(msg_topic, delay_topic, v)  # [V, W]
+    return pending_shifted | (new_words[:, None, :] & masks[None, :, :])
+
+
 def delivery_round(
     net: Net,
     msgs: MsgTable,
@@ -126,6 +152,8 @@ def delivery_round(
     count_events: bool = True,
     queue_cap: int = 0,    # per-edge outbound message budget per round
                            # (pubsub.go:240's 32-deep queue); 0 = lossless
+    val_delay_topic: tuple | None = None,  # per-topic pipeline delays
+                           # (cfg.validation_delay_topic); None = uniform
 ) -> tuple[Delivery, RoundInfo]:
     """Advance one propagation round: transmit every sender's `fwd` set along
     permitted edges, dedup against the seen-cache, record first receipts.
@@ -206,11 +234,13 @@ def delivery_round(
     valid_words = bitset.pack(msgs.valid)  # [W]
 
     if val_delay > 0:
-        # fresh receipts enter stage 0; this round's validated cohort exits
+        # fresh receipts enter at their per-topic stage (uniform: stage 0);
+        # this round's validated cohort exits stage V-1
         validated = dlv.pending[:, -1]
-        pending = jnp.concatenate(
-            [new_words[:, None, :], dlv.pending[:, :-1]], axis=1
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(dlv.pending[:, :1]), dlv.pending[:, :-1]], axis=1
         )
+        pending = pipeline_insert(shifted, new_words, msgs.topic, val_delay_topic)
     else:
         validated = new_words
         pending = dlv.pending

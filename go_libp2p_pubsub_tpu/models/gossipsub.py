@@ -127,6 +127,14 @@ class GossipSubConfig:
     # spend this many rounds in the pipeline between arrival (markSeen) and
     # their verdict (forward + Deliver/Reject + CDF timestamp). 0 = inline.
     validation_delay_rounds: int = 0
+    # per-topic validation latency (the reference's per-topic async
+    # validators complete at different times — NumCPU workers + per-topic
+    # throttles, validation.go:123-135,391-438): a static tuple of T
+    # per-topic delays, each in [1, validation_delay_rounds]; a message's
+    # verdict lands delay[topic] rounds after arrival, so verdicts of
+    # different topics interleave out of arrival order. None = uniform
+    # validation_delay_rounds for every topic.
+    validation_delay_topic: tuple | None = None
     # fanout (publishing to unjoined topics, gossipsub.go:981-1002,1517-1554)
     fanout_slots: int = 2         # concurrent unjoined publish topics/peer
     fanout_ttl_ticks: int = 60
@@ -151,10 +159,23 @@ class GossipSubConfig:
         gater_params: "PeerGaterParams | None" = None,
         validation_capacity: int = 0,
         validation_delay_rounds: int = 0,
+        validation_delay_topic: tuple | None = None,
         queue_cap: int = 0,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
+        if validation_delay_topic is not None:
+            validation_delay_topic = tuple(int(d) for d in validation_delay_topic)
+            if validation_delay_rounds <= 0:
+                validation_delay_rounds = max(validation_delay_topic)
+            if not all(
+                1 <= d <= validation_delay_rounds for d in validation_delay_topic
+            ):
+                raise ValueError(
+                    "validation_delay_topic entries must lie in "
+                    f"[1, {validation_delay_rounds}] (the pipeline depth); "
+                    f"got {validation_delay_topic}"
+                )
         hb = p.heartbeat_interval
         kw = dict(
             D=p.D, Dlo=p.Dlo, Dhi=p.Dhi, Dscore=p.Dscore, Dout=p.Dout,
@@ -177,6 +198,7 @@ class GossipSubConfig:
             gater_quiet_ticks=ticks_for(gater_params.quiet, hb) if gater_params else 60,
             validation_capacity=validation_capacity,
             validation_delay_rounds=validation_delay_rounds,
+            validation_delay_topic=validation_delay_topic,
             queue_cap=queue_cap,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
@@ -251,6 +273,12 @@ class GossipSubState:
     edge_live: jax.Array       # [N,K] bool
     # PX flag riding this round's PRUNEs (parallel outbox to prune_out)
     prune_px_out: jax.Array    # [N,S,K] bool
+    # inbound-link saturation observed last round (queue_cap only; zeros
+    # otherwise): congested_in[i,k] = the sender nbr[i,k]'s outbound queue
+    # toward i was full. The host's announce-retry model reads it — a
+    # SubOpts announcement riding a full queue is dropped and retried
+    # with jitter (pubsub.go:861-901)
+    congested_in: jax.Array    # [N,K] bool
 
     @classmethod
     def init(
@@ -310,6 +338,7 @@ class GossipSubState:
             if dormant is not None
             else jnp.copy(net.nbr_ok),
             prune_px_out=jnp.zeros((n, s, k), bool),
+            congested_in=jnp.zeros((n, k), bool),
         )
 
 
@@ -667,7 +696,8 @@ def update_fanout_on_publish(
 
 
 def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
-                   count_events: bool = True, queue_cap: int = 0):
+                   count_events: bool = True, queue_cap: int = 0,
+                   val_delay_topic: tuple | None = None):
     """Fold IWANT-response transmissions (not part of senders' fwd sets)
     into the round's delivery results. With the async-validation pipeline
     these receipts enter stage 0 like any other arrival; their verdict
@@ -702,8 +732,12 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
         fe_words=(dlv.fe_words & ~new_words[:, None, :]) | fa_words,
     )
     if val_delay > 0:
+        from .common import pipeline_insert
+
         dlv = dlv.replace(
-            pending=dlv.pending.at[:, 0, :].set(dlv.pending[:, 0, :] | new_words)
+            pending=pipeline_insert(
+                dlv.pending, new_words, core.msgs.topic, val_delay_topic
+            )
         )
     else:
         dlv = dlv.replace(
@@ -1058,10 +1092,13 @@ def apply_validation_throttle(dlv, info, cap: int, m: int, valid_words):
     n_ref = n_throttled.sum().astype(jnp.int32)
 
     if val_delay > 0:
+        # refused receipts are fresh this round, so they sit in exactly
+        # their entry stage; clearing every stage is equivalent and works
+        # for any per-topic entry pattern
         dlv = dlv.replace(
             have=dlv.have & ~refused,
             fe_words=dlv.fe_words & ~refused[:, None, :],
-            pending=dlv.pending.at[:, 0, :].set(dlv.pending[:, 0, :] & ~refused),
+            pending=dlv.pending & ~refused[:, None, :],
         )
         # this round's verdicts (pipeline exits) are unaffected; throttled
         # receipts trace Reject now
@@ -1098,6 +1135,7 @@ def make_gossipsub_step(
     dynamic_peers: bool = False,
     adversary_no_forward: np.ndarray | None = None,
     static_heartbeat: bool = False,
+    sub_knowledge_holes: np.ndarray | None = None,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
@@ -1137,6 +1175,15 @@ def make_gossipsub_step(
     if cfg.gater_enabled:
         assert gater_params is not None
         gater_params.validate()
+    if cfg.validation_delay_topic is not None and (
+        len(cfg.validation_delay_topic) != net.n_topics
+    ):
+        # the engine's per-message delay gather would silently clamp
+        # out-of-range topic ids; reject the mismatch at build time
+        raise ValueError(
+            f"validation_delay_topic has {len(cfg.validation_delay_topic)} "
+            f"entries for a {net.n_topics}-topic universe"
+        )
     if cfg.score_enabled:
         assert score_params is not None
         score_params.validate()
@@ -1151,6 +1198,20 @@ def make_gossipsub_step(
     # GossipSubFeatureMesh; checked at gossipsub.go:1374,1692)
     mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
     nbr_sub_const = gather_nbr_subscribed(net) & mesh_capable[:, None, :]
+    # announce-visibility holes (pubsub.go:842-901): sub_knowledge_holes
+    # [N,K,T] marks (receiver i, edge k, topic t) triples whose SubOpts
+    # announcement has not yet arrived — the unannounced subscriber is
+    # invisible to mesh-candidate selection, gossip targeting, and fanout
+    # (the host's announce-retry model under queue_cap supplies the mask
+    # and recompiles as announcements land; api.Network._process_announces)
+    if sub_knowledge_holes is not None:
+        _holes = np.asarray(sub_knowledge_holes, bool)  # [N,K,T]
+        _mt = np.asarray(net.my_topics)                 # [N,S]
+        _hs = np.take_along_axis(
+            _holes, np.clip(_mt, 0, None)[:, None, :], axis=2
+        ).transpose(0, 2, 1)                            # [N,S,K]
+        _hs = _hs & (_mt >= 0)[:, :, None]
+        nbr_sub_const = nbr_sub_const & ~jnp.asarray(_hs)
     # floodsub-semantics edges: the far end only speaks /floodsub/1.0.0
     flood_from = (net.protocol[jnp.clip(net.nbr, 0)] == 0) & net.nbr_ok
     i_am_floodsub = net.protocol == 0
@@ -1161,6 +1222,11 @@ def make_gossipsub_step(
         subscribed_words_t[jnp.clip(net.nbr, 0)],
         jnp.uint32(0),
     )  # [N,K,Wt]
+    if sub_knowledge_holes is not None:
+        # unannounced subscriptions are invisible to fanout selection too
+        nbr_sub_words = nbr_sub_words & ~bitset.pack(
+            jnp.asarray(np.asarray(sub_knowledge_holes, bool))
+        )
     # adversary behavior vector: edge (j,k) carries data only if its sender
     # nbr[j,k] forwards (static jit constant; None => all-honest fast path)
     if adversary_no_forward is not None:
@@ -1558,11 +1624,13 @@ def make_gossipsub_step(
             dlv, info = delivery_round(
                 net_l, core.msgs, core.dlv, edge_mask, tick,
                 count_events=cfg.count_events, queue_cap=cfg.queue_cap,
+                val_delay_topic=cfg.validation_delay_topic,
             )
             iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
             dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick,
                                        count_events=cfg.count_events,
-                                       queue_cap=cfg.queue_cap)
+                                       queue_cap=cfg.queue_cap,
+                                       val_delay_topic=cfg.validation_delay_topic)
 
         # 4b. validation front-end throttle (validation.go:230-244)
         valid_words_all = bitset.pack(core.msgs.valid)
@@ -1685,6 +1753,7 @@ def make_gossipsub_step(
         if cfg.queue_cap > 0:
             sat_recv = bitset.popcount(info.trans, axis=-1) >= cfg.queue_cap
             gossip_suppress = net_l.edge_gather(sat_recv) & net_l.nbr_ok
+            st2 = st2.replace(congested_in=sat_recv)
         else:
             gossip_suppress = None
 

@@ -9,8 +9,9 @@
 // its own directory so cgo does not try to compile the sibling C/C++
 // sources into the package:
 //
-//	cd native/go_example && go mod init pubsub_example \
-//	  && go build -tags pjrt_example -o example_host_go .
+//	cd native/go_example && go build -tags pjrt_example -o example_host_go .
+//	(go.mod is committed; `make go-example` at the repo root does this,
+//	or reports "no Go toolchain" on images without one)
 //	./example_host_go PLUGIN.so MODULE.mlirpb OPTIONS.pb [name:type:value ...]
 //
 // The module/options inputs are produced exactly as for the C host (see
@@ -23,28 +24,7 @@ package main
 #cgo LDFLAGS: -L${SRCDIR}/.. -lpjrt_bridge -Wl,-rpath,${SRCDIR}/..
 #include <stdint.h>
 #include <stdlib.h>
-
-extern void *pjx_load(const char *plugin_path, char *err, size_t errlen);
-extern void pjx_unload(void *h);
-extern void *pjx_client_create(void *h, const char **names, const int *types,
-                               const char **string_values,
-                               const int64_t *int_values, size_t nopts,
-                               char *err, size_t errlen);
-extern void pjx_client_destroy(void *h, void *client);
-extern void *pjx_compile(void *h, void *client, const char *code,
-                         size_t code_size, const char *format,
-                         const char *options, size_t options_size, char *err,
-                         size_t errlen);
-extern void pjx_executable_destroy(void *h, void *exe);
-extern void *pjx_buffer_from_host(void *h, void *client, const void *data,
-                                  int dtype, const int64_t *dims, size_t ndims,
-                                  char *err, size_t errlen);
-extern void pjx_buffer_destroy(void *h, void *buf);
-extern long pjx_buffer_to_host(void *h, void *buf, void *dst, size_t dst_size,
-                               long row_major, char *err, size_t errlen);
-extern long pjx_execute(void *h, void *exe, void *const *inputs, size_t nin,
-                        void **outputs, size_t max_out, char *err,
-                        size_t errlen);
+#include "pjx.h"
 */
 import "C"
 

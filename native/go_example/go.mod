@@ -1,0 +1,12 @@
+// Go module for the cgo->PJRT embedding example. Build from this
+// directory with a stock Go toolchain (none is baked into the dev
+// image — `make go-example` from the repo root says so explicitly):
+//
+//	go build -tags pjrt_example -o example_host_go .
+//
+// Requires ../libpjrt_bridge.so (make -C .. libpjrt_bridge.so); pjx.h
+// here is the vendored copy of ../pjx.h (the Makefile keeps them in
+// sync with a cmp check).
+module pubsub_example
+
+go 1.21

@@ -1,0 +1,77 @@
+/* pjx.h — the C ABI of libpjrt_bridge.so (native/pjrt_bridge.cc).
+ *
+ * This is the single embedder-facing surface for invoking compiled XLA
+ * programs from non-Python hosts: the C host (example_host.c) and the Go
+ * cgo host (go_example/example_host.go) both build against exactly this
+ * header, mirroring the reference's embedder API boundary
+ * (/root/reference/pubsub.go:169-198 — the surface an application links).
+ *
+ * Every function reports failure through (err, errlen): on error the
+ * return is NULL/-1 and err holds a NUL-terminated message. Handles are
+ * opaque; destroy in reverse order of creation (buffers/executables
+ * before the client, client before pjx_unload).
+ */
+#ifndef PUBSUB_PJX_H
+#define PUBSUB_PJX_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* dlopen a PJRT plugin (libtpu.so, the CPU plugin, ...) and bind its
+ * PJRT_Api. Returns an opaque library handle. */
+void *pjx_load(const char *plugin_path, char *err, size_t errlen);
+void pjx_unload(void *h);
+
+/* PJRT C API version of the loaded plugin. */
+void pjx_api_version(void *h, int *major, int *minor);
+
+/* Create a client. Options are parallel arrays of length nopts:
+ * names[i] with types[i] == 0 -> string_values[i], 1 -> int_values[i]
+ * (int64), 2 -> int_values[i] as bool. */
+void *pjx_client_create(void *h, const char **names, const int *types,
+                        const char **string_values, const int64_t *int_values,
+                        size_t nopts, char *err, size_t errlen);
+void pjx_client_destroy(void *h, void *client);
+
+/* Platform introspection. Both return -1 on error; pjx_platform_name
+ * writes up to buflen bytes (NUL-terminated) and returns the length. */
+long pjx_platform_name(void *h, void *client, char *buf, size_t buflen,
+                       char *err, size_t errlen);
+long pjx_device_count(void *h, void *client, int addressable, char *err,
+                      size_t errlen);
+
+/* Compile a serialized module. `format` is "mlir" for StableHLO bytecode
+ * / MLIR module bytes (what jax.jit(...).lower(...) emits) or "hlo" for
+ * an HloModuleProto. `options` is a serialized CompileOptionsProto. */
+void *pjx_compile(void *h, void *client, const char *code, size_t code_size,
+                  const char *format, const char *options,
+                  size_t options_size, char *err, size_t errlen);
+void pjx_executable_destroy(void *h, void *exe);
+long pjx_num_outputs(void *h, void *exe, char *err, size_t errlen);
+
+/* Host<->device transfers. `dtype` is the PJRT_Buffer_Type enum value
+ * (F32 == 11, S32 == 7, U32 == 10, PRED == 1, ...). */
+void *pjx_buffer_from_host(void *h, void *client, const void *data, int dtype,
+                           const int64_t *dims, size_t ndims, char *err,
+                           size_t errlen);
+void pjx_buffer_destroy(void *h, void *buf);
+long pjx_buffer_dims(void *h, void *buf, int64_t *dims, size_t max_dims,
+                     char *err, size_t errlen);
+long pjx_buffer_dtype(void *h, void *buf, char *err, size_t errlen);
+long pjx_buffer_to_host(void *h, void *buf, void *dst, size_t dst_size,
+                        long row_major, char *err, size_t errlen);
+
+/* Execute with nin input buffers; writes up to max_out output buffer
+ * handles into outputs and returns the output count (-1 on error). */
+long pjx_execute(void *h, void *exe, void *const *inputs, size_t nin,
+                 void **outputs, size_t max_out, char *err, size_t errlen);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PUBSUB_PJX_H */

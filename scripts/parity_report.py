@@ -92,64 +92,107 @@ def main():
     # CDF mostly measures the mesh-formation lottery of one RNG draw (the
     # across-seed spread of converged mean degree is as large as any
     # engine/oracle gap — measured at 512/d=10: engine 8.13-8.45, oracle
-    # 8.18-8.53). Each side therefore pools several seeds.
-    SEEDS_V = (3, 4, 5)
-    SEEDS_O = (11, 12, 13)
+    # 8.18-8.53). Each side therefore pools 5 seeds, and the error bars
+    # come from a leave-one-out jackknife: the sup-distance is recomputed
+    # for every (drop one engine seed, drop one oracle seed) pool pair,
+    # and the row reports pooled sup + jackknife mean and max (round-3
+    # review item: margins without spread are not evidence of parity).
+    SEEDS_V = (3, 4, 5, 6, 7)
+    SEEDS_O = (11, 12, 13, 14, 15)
+
+    def _sup_with_jackknife(hv_per_seed, ho_per_seed, denom_per_run):
+        """hv_per_seed/ho_per_seed: list of per-seed hop lists.
+        denom_per_run: (subscribed peer, msg) pair count of ONE run.
+        Returns (pooled_sup, jk_mean, jk_max)."""
+        sv, so = len(hv_per_seed), len(ho_per_seed)
+
+        def pooled(per_seed, skip):
+            hist = np.zeros(MAX_H + 1)
+            for i, hs in enumerate(per_seed):
+                if i == skip:
+                    continue
+                for h in hs:
+                    hist[min(int(h), MAX_H)] += 1
+            runs = len(per_seed) - (1 if skip is not None else 0)
+            return np.cumsum(hist) / (runs * denom_per_run)
+
+        full = float(np.max(np.abs(pooled(hv_per_seed, None)
+                                   - pooled(ho_per_seed, None))))
+        jk = [
+            float(np.max(np.abs(pooled(hv_per_seed, i) - pooled(ho_per_seed, j))))
+            for i in range(sv) for j in range(so)
+        ]
+        return full, float(np.mean(jk)), float(np.max(jk))
 
     def gossip_row(label, n, deg, params, warmup=20, pub_rounds=18, drain=14,
-                   seed=5):
+                   seed=5, n_topics=1, topic_sched=None,
+                   validation_delay_topic=None, extra_note=""):
         topo = graph.random_connect(n, d=deg, seed=seed)
-        subs = graph.subscribe_all(n, 1)
+        subs = graph.subscribe_all(n, n_topics)
         schedule = np.random.default_rng(7).integers(
             0, n, size=(pub_rounds, 2)).astype(np.int32)
+        topics = (topic_sched if topic_sched is not None
+                  else np.zeros((pub_rounds, 2), np.int32))
 
         netx = Net.build(topo, subs)
-        cfg = GossipSubConfig.build(params)
+        cfg = GossipSubConfig.build(
+            params, validation_delay_topic=validation_delay_topic
+        )
         step = make_gossipsub_step(cfg, netx)
         empty = no_publish(2)
-        pt = jnp.zeros((2,), jnp.int32)
         pv = jnp.ones((2,), bool)
         from go_libp2p_pubsub_tpu.trace.events import N_EVENTS
 
-        hv, ev_v = [], np.zeros(N_EVENTS, np.int64)
+        hv_seeds, ev_v = [], np.zeros(N_EVENTS, np.int64)
         for sd in SEEDS_V:
             stx = GossipSubState.init(netx, 64, cfg, seed=sd)
             for _ in range(warmup):
                 stx = step(stx, *empty)
             for r in range(pub_rounds):
-                stx = step(stx, jnp.asarray(schedule[r]), pt, pv)
+                stx = step(stx, jnp.asarray(schedule[r]),
+                           jnp.asarray(topics[r]), pv)
             for _ in range(drain):
                 stx = step(stx, *empty)
             h = np.asarray(hops(stx.core.msgs, stx.core.dlv))
-            hv += [int(x) for x in h[h >= 0]]
+            hv_seeds.append([int(x) for x in h[h >= 0]])
             ev_v = ev_v + np.asarray(stx.core.events)
 
-        ho, ev_o = [], np.zeros(len(ev_v))
+        ho_seeds, ev_o = [], np.zeros(len(ev_v))
         for sd in SEEDS_O:
             o = OracleGossipSub(topo, subs, cfg, msg_slots=64, seed=sd)
             for _ in range(warmup):
                 o.step()
             for r in range(pub_rounds):
-                o.step([(int(p), 0, True) for p in schedule[r]])
+                o.step([(int(p), int(t), True)
+                        for p, t in zip(schedule[r], topics[r])])
             for _ in range(drain):
                 o.step()
-            ho += list(o.hops().values())
+            ho_seeds.append(list(o.hops().values()))
             ev_o = ev_o + np.asarray(o.events)
 
         n_msgs = pub_rounds * 2
-        cv = cdf(hv, n_msgs * len(SEEDS_V), n)
-        co = cdf(ho, n_msgs * len(SEEDS_O), n)
-        sup = float(np.max(np.abs(cv - co)))
+        sup, jk_mean, jk_max = _sup_with_jackknife(
+            hv_seeds, ho_seeds, n_msgs * n
+        )
+        hv = [h for hs in hv_seeds for h in hs]
+        ho = [h for hs in ho_seeds for h in hs]
         mean_rel = abs(np.mean(hv) - np.mean(ho)) / np.mean(ho)
+        cov_v = len(hv) / (len(SEEDS_V) * n_msgs * n)
+        cov_o = len(ho) / (len(SEEDS_O) * n_msgs * n)
         ratios = []
         for e in (EV.DELIVER_MESSAGE, EV.DUPLICATE_MESSAGE, EV.SEND_RPC):
             ratios.append(
                 (float(ev_v[e]) / len(SEEDS_V))
                 / max(float(ev_o[e]) / len(SEEDS_O), 1.0)
             )
-        rows.append((label, f"{100*sup:.2f}%", f"{100*mean_rel:.2f}%",
-                     f"{cv[-1]*100:.1f}% / {co[-1]*100:.1f}%",
-                     "dlv/dup/rpc ratios " + "/".join(f"{x:.3f}" for x in ratios)))
+        note = "dlv/dup/rpc ratios " + "/".join(f"{x:.3f}" for x in ratios)
+        if extra_note:
+            note = extra_note + "; " + note
+        rows.append((label,
+                     f"{100*sup:.2f}% (jk {100*jk_mean:.2f}/{100*jk_max:.2f}%)",
+                     f"{100*mean_rel:.2f}%",
+                     f"{cov_v*100:.1f}% / {cov_o*100:.1f}%",
+                     note))
 
     # ---- config 2: RandomSub sqrt-fanout (scaled) -----------------------
     def randomsub_row(label, n, deg, pub_rounds=18, drain=12, seed=5):
@@ -195,6 +238,12 @@ def main():
                192, 8, GossipSubParams(flood_publish=True))
     gossip_row("GossipSub v1.0, 512 peers d=10 sparse",
                512, 10, GossipSubParams(), pub_rounds=14)
+    gossip_row("GossipSub + mixed per-topic validation latency (1/3/2 rounds)",
+               192, 8, GossipSubParams(), n_topics=3,
+               topic_sched=(np.arange(36) % 3).reshape(18, 2).astype(np.int32),
+               validation_delay_topic=(1, 3, 2), drain=40,
+               extra_note="async verdicts interleave across topics "
+                          "(validation.go:123-135,391-438)")
 
     # ---- v1.1 composed rows (score plane live in the loop) --------------
     def v11_row(label, n, deg, sp, thr, adversary=None, n_topics=1,
@@ -224,54 +273,60 @@ def main():
         if not fanout:
             cfg = _dc.replace(cfg, fanout_slots=0)
         netx = Net.build(topo, subs)
-        stx = GossipSubState.init(netx, 64, cfg, score_params=sp, seed=3)
-        step = make_gossipsub_step(cfg, netx, score_params=sp,
-                                   adversary_no_forward=adversary)
-        empty = no_publish(2)
-        for _ in range(warmup):
-            stx = step(stx, *empty)
-        pv = jnp.ones((2,), bool)
-        for r in range(pub_rounds):
-            stx = step(stx, jnp.asarray(schedule[r]),
-                       jnp.asarray(topics[r]), pv)
-        for _ in range(drain):
-            stx = step(stx, *empty)
-        h = np.asarray(hops(stx.core.msgs, stx.core.dlv))
         subm = np.asarray(netx.subscribed)
-        mt = np.asarray(stx.core.msgs.topic)
-        mask = (h >= 0) & subm[:, np.clip(mt, 0, None)]
-        hv = [int(x) for x in h[mask]]
-
-        adv_set = (set(np.flatnonzero(adversary).tolist())
-                   if adversary is not None else None)
-        o = OracleGossipSub(topo, subs, cfg, msg_slots=64, seed=11,
-                            score_params=sp, adversary=adv_set)
-        for _ in range(warmup):
-            o.step()
-        for r in range(pub_rounds):
-            o.step([(int(p), int(t), True)
-                    for p, t in zip(schedule[r], topics[r])])
-        for _ in range(drain):
-            o.step()
-        ho = [hh for (i, slot), hh in o.hops().items()
-              if subm[i, o.msgs[slot].topic]]
-
         per_topic = {}
         for t in topics.ravel():
             per_topic[int(t)] = per_topic.get(int(t), 0) + 1
         total = sum(cnt * int(subm[:, t].sum())
                     for t, cnt in per_topic.items())
-        hist_v = np.zeros(MAX_H + 1)
-        for hh in hv:
-            hist_v[min(hh, MAX_H)] += 1
-        hist_o = np.zeros(MAX_H + 1)
-        for hh in ho:
-            hist_o[min(hh, MAX_H)] += 1
-        cv, co = np.cumsum(hist_v) / total, np.cumsum(hist_o) / total
-        sup = float(np.max(np.abs(cv - co)))
+
+        step = make_gossipsub_step(cfg, netx, score_params=sp,
+                                   adversary_no_forward=adversary)
+        empty = no_publish(2)
+        pv = jnp.ones((2,), bool)
+        hv_seeds = []
+        for sd in SEEDS_V:
+            stx = GossipSubState.init(netx, 64, cfg, score_params=sp, seed=sd)
+            for _ in range(warmup):
+                stx = step(stx, *empty)
+            for r in range(pub_rounds):
+                stx = step(stx, jnp.asarray(schedule[r]),
+                           jnp.asarray(topics[r]), pv)
+            for _ in range(drain):
+                stx = step(stx, *empty)
+            h = np.asarray(hops(stx.core.msgs, stx.core.dlv))
+            mt = np.asarray(stx.core.msgs.topic)
+            mask = (h >= 0) & subm[:, np.clip(mt, 0, None)]
+            hv_seeds.append([int(x) for x in h[mask]])
+
+        adv_set = (set(np.flatnonzero(adversary).tolist())
+                   if adversary is not None else None)
+        ho_seeds = []
+        for sd in SEEDS_O:
+            o = OracleGossipSub(topo, subs, cfg, msg_slots=64, seed=sd,
+                                score_params=sp, adversary=adv_set)
+            for _ in range(warmup):
+                o.step()
+            for r in range(pub_rounds):
+                o.step([(int(p), int(t), True)
+                        for p, t in zip(schedule[r], topics[r])])
+            for _ in range(drain):
+                o.step()
+            ho_seeds.append([hh for (i, slot), hh in o.hops().items()
+                             if subm[i, o.msgs[slot].topic]])
+
+        sup, jk_mean, jk_max = _sup_with_jackknife(
+            hv_seeds, ho_seeds, total
+        )
+        hv = [h for hs in hv_seeds for h in hs]
+        ho = [h for hs in ho_seeds for h in hs]
         mean_rel = abs(np.mean(hv) - np.mean(ho)) / np.mean(ho)
-        rows.append((label, f"{100*sup:.2f}%", f"{100*mean_rel:.2f}%",
-                     f"{cv[-1]*100:.1f}% / {co[-1]*100:.1f}%",
+        cov_v = len(hv) / (len(SEEDS_V) * total)
+        cov_o = len(ho) / (len(SEEDS_O) * total)
+        rows.append((label,
+                     f"{100*sup:.2f}% (jk {100*jk_mean:.2f}/{100*jk_max:.2f}%)",
+                     f"{100*mean_rel:.2f}%",
+                     f"{cov_v*100:.1f}% / {cov_o*100:.1f}%",
                      "composed v1.1: scoring+thresholds live in the loop"))
 
     from go_libp2p_pubsub_tpu.config import (
@@ -350,7 +405,23 @@ def main():
         "converged, so a single-seed comparison mostly measures the",
         "mesh-formation lottery (across-seed converged-degree spread at",
         "512/d=10: engine 8.13-8.45, oracle 8.18-8.53 — overlapping, no",
-        "bias); the gossipsub rows therefore pool 3 RNG seeds per side.",
+        "bias).",
+        "",
+        "Round 3: the round-2 review flagged the 1.80%/1.68% margins as",
+        "evidence-free without spread. Re-measured with 5 seeds per side:",
+        "the v1.0 pooled sup drops to ~1.0% (jk max 1.43%) and the sybil",
+        "v1.1 row to ~0.6% (jk max 1.21%) — the thin round-2 margins were",
+        "3-seed/single-seed sampling noise, not a hidden bug (the means",
+        "agree to <0.6% throughout). Method: every gossipsub row pools 5",
+        "RNG seeds",
+        "per side, and the sup column carries leave-one-out jackknife",
+        "error bars: `pooled (jk mean/max)` over all 25 (drop-one-engine,",
+        "drop-one-oracle) pool pairs. Both the pooled sup and the",
+        "jackknife max are enforced <= 2% — a margin that only holds for",
+        "one lucky seed set is not parity. The mixed-validation-latency",
+        "row runs per-topic async verdict delays (survey §7 hard-part c;",
+        "tests/test_parity_valdelay.py pins the same bound plus the",
+        "deterministic hop law in CI).",
         "",
         "| config | CDF sup-dist | mean-hop rel. diff | coverage (vec/oracle) | notes |",
         "|---|---|---|---|---|",
@@ -362,10 +433,20 @@ def main():
     print("\n".join(lines))
 
     # enforce the documented tolerances: bit-exactness for floodsub, the
-    # 2% north-star sup-norm for every distributional (CDF) row
+    # 2% north-star sup-norm for every distributional row's POOLED sup AND
+    # its jackknife max (no leave-one-out pool pair may exceed 2% either —
+    # a margin that only holds for one lucky seed set is not parity)
     failed = [r[0] for r in rows if r[1] == "MISMATCH"]
-    failed += [r[0] for r in rows
-               if r[1].endswith("%") and float(r[1].rstrip("%")) > 2.0]
+    for r in rows:
+        if "%" not in str(r[1]):
+            continue
+        pooled_sup = float(str(r[1]).split("%")[0])
+        if pooled_sup > 2.0:
+            failed.append(f"{r[0]} (pooled {pooled_sup}%)")
+        if "jk " in str(r[1]):
+            jk_max = float(str(r[1]).split("/")[-1].rstrip("%)"))
+            if jk_max > 2.0:
+                failed.append(f"{r[0]} (jk max {jk_max}%)")
     if failed:
         print("PARITY FAILURES:", "; ".join(failed))
         sys.exit(1)

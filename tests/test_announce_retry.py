@@ -1,0 +1,101 @@
+"""Announce-retry with jitter under backpressure (pubsub.go:842-901).
+
+A runtime Join must announce its subscription (SubOpts) to every peer;
+with `queue_cap` an announcement riding a saturated link is dropped and
+retried with jitter. Until it lands, that neighbor cannot see the
+subscription — no grafts, no gossip, no fanout selection toward the
+joiner (the stale-subscription window the reference exhibits under
+churn + congestion)."""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import api
+
+
+def _mesh_degree(net, idx):
+    return int(np.asarray(net.state.mesh)[idx].sum())
+
+
+def test_announce_holes_delay_mesh_formation_then_converge():
+    """With queue_cap congestion, a late joiner's mesh forms only as the
+    announce retries land; without congestion it forms immediately. Both
+    converge."""
+    net = api.Network(queue_cap=2, seed=5)
+    nodes = net.add_nodes(16)
+    net.dense_connect(d=6, seed=2)
+    subs = [nd.join("t").subscribe() for nd in nodes[:-1]]  # node 15 waits
+    net.start()
+    net.run(6)
+
+    # saturate the network so announce drops are likely: heavy publishing
+    for r in range(3):
+        for nd in nodes[:6]:
+            nd.topics["t"].publish(b"x%d" % r + bytes([nd.idx]))
+    late = nodes[-1].join("t").subscribe()
+    # the announce is pending toward every neighbor of node 15
+    assert net._pending_announce, "join under queue_cap must queue announces"
+    assert net._sub_holes is not None and net._sub_holes[:, :, 0].any()
+
+    net.run(20)
+    # all announces eventually land (retries with jitter, then delivery)
+    assert not net._pending_announce
+    assert net._sub_holes is None
+    # and the late joiner is meshed + receiving
+    net.run(5)
+    assert _mesh_degree(net, 15) >= 1
+    nodes[0].topics["t"].publish(b"final")
+    net.run(6)
+    got = [m for m in iter(late.next, None)]
+    assert any(m.data == b"final" for m in got)
+
+
+def test_announce_retries_counted_under_sustained_congestion():
+    """Sustained saturation of the JOINER's own outbound links produces
+    measured retries (the announce shares the joiner's per-peer writer
+    queues with its forwarding traffic — announce/DropRPC/retry path).
+    The joiner is a busy forwarder on a background topic, so its queues
+    are full when the new topic's SubOpts goes out."""
+    net = api.Network(queue_cap=1, seed=7)
+    nodes = net.add_nodes(12)
+    net.dense_connect(d=5, seed=3)
+    for nd in nodes:
+        nd.join("bg").subscribe()      # everyone forwards bg traffic
+    for nd in nodes[:-1]:
+        nd.join("t").subscribe()
+    net.start()
+    net.run(8)
+    # saturate the queue_cap=1 links BEFORE the join so the announce's
+    # first attempt already rides full queues, and keep them saturated
+    for r in range(3):
+        for nd in nodes[:6]:
+            nd.topics["bg"].publish(bytes([r, nd.idx]))
+        net.run(1)
+    retries_seen = False
+    nodes[-1].join("t")
+    assert net._pending_announce
+    for r in range(10):
+        for nd in nodes[:6]:
+            nd.topics["bg"].publish(bytes([64 + r, nd.idx]))
+        net.run(1)
+        retries_seen = retries_seen or net.announce_retries > 0
+    assert retries_seen, "saturated joiner links must drop + retry announces"
+    net.run(25)
+    assert not net._pending_announce  # converges once congestion clears
+
+
+def test_no_queue_cap_announce_is_instantaneous():
+    """Without backpressure the announce model is inert: visibility next
+    round, no pending state (the documented lossless-wire behavior)."""
+    net = api.Network(seed=3)
+    nodes = net.add_nodes(10)
+    net.dense_connect(d=4, seed=1)
+    for nd in nodes[:-1]:
+        nd.join("t").subscribe()
+    net.start()
+    net.run(4)
+    nodes[-1].join("t").subscribe()
+    assert not net._pending_announce
+    assert net._sub_holes is None
+    net.run(10)
+    assert _mesh_degree(net, 9) >= 1

@@ -1,0 +1,96 @@
+"""Custom protocol matching (gossipsub_feat.go:11-36 feature function +
+the WithProtocolMatchFn seam, gossipsub_matchfn_test.go): embedders
+register custom protocol ids with declared feature sets, or a match
+function admitting versioned variants; the router treats the speakers
+by their features."""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import api
+from go_libp2p_pubsub_tpu.protocol import (
+    DEFAULT_FEATURES,
+    FEATURE_MESH,
+    FEATURE_PX,
+    ProtocolMatcher,
+    ProtocolError,
+    prefix_match,
+)
+
+
+def test_matcher_defaults_and_levels():
+    m = ProtocolMatcher()
+    assert m.level("/floodsub/1.0.0") == 0
+    assert m.level("/meshsub/1.0.0") == 1
+    assert m.level("/meshsub/1.1.0") == 2
+    assert m.supports("/meshsub/1.0.0", FEATURE_MESH)
+    assert not m.supports("/meshsub/1.0.0", FEATURE_PX)
+    with pytest.raises(ProtocolError):
+        m.level("/unknown/9.9.9")
+
+
+def test_matcher_custom_table_and_match_fn():
+    m = ProtocolMatcher(
+        features={"/my-app/gossip/2.0.0": FEATURE_MESH | FEATURE_PX},
+        match_fn=prefix_match("/meshsub/1.1.0"),
+    )
+    assert m.level("/my-app/gossip/2.0.0") == 2
+    # the matchfn shape from gossipsub_matchfn_test.go: a versioned
+    # variant negotiates as its base protocol
+    assert m.level("/meshsub/1.1.0-beta2") == 2
+    with pytest.raises(ProtocolError):
+        m.level("/meshsub/0.9.0")  # prefix doesn't match
+
+
+def test_px_without_mesh_rejected():
+    with pytest.raises(ProtocolError):
+        ProtocolMatcher(features={"/bad/1.0.0": FEATURE_PX})
+
+
+def test_mixed_custom_and_floodsub_network_delivers():
+    """A network mixing a custom mesh protocol, a matchfn-admitted
+    meshsub variant, and plain floodsub peers: the mesh forms among the
+    mesh-capable speakers and every subscriber still gets every message
+    (the floodsub interop edges of gossipsub.go:973-978)."""
+    net = api.Network(
+        protocol_matcher=ProtocolMatcher(
+            features={"/my-app/gossip/2.0.0": FEATURE_MESH},
+            match_fn=prefix_match("/meshsub/1.1.0"),
+        ),
+        seed=3,
+    )
+    nodes = []
+    for i in range(18):
+        proto = (
+            "/my-app/gossip/2.0.0" if i % 3 == 0
+            else "/meshsub/1.1.0-custom" if i % 3 == 1
+            else "/floodsub/1.0.0"
+        )
+        nodes.append(net.add_node(protocol=proto))
+    net.dense_connect(d=6, seed=1)
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    net.start()
+    for _ in range(12):
+        net.run(1)
+    nodes[0].topics["t"].publish(b"a")
+    nodes[2].topics["t"].publish(b"b")  # floodsub origin
+    net.run(8)
+    got = [sum(1 for _ in s) for s in subs]
+    assert all(g == 2 for g in got), got
+
+    # floodsub speakers never enter anyone's mesh; mesh-capable peers do
+    mesh = np.asarray(net.state.mesh)  # [N,S,K]
+    nbr = np.asarray(net.net.nbr)
+    fs = {i for i in range(18) if i % 3 == 2}
+    in_mesh_peers = set()
+    for i in range(18):
+        for k in np.flatnonzero(mesh[i].any(axis=0)):
+            in_mesh_peers.add(int(nbr[i, k]))
+    assert not (in_mesh_peers & fs), in_mesh_peers & fs
+    assert in_mesh_peers  # and the custom-protocol mesh actually formed
+
+
+def test_unknown_protocol_fails_fast_at_add_node():
+    net = api.Network()
+    with pytest.raises(ProtocolError):
+        net.add_node(protocol="/my-app/gossip/2.0.0")
