@@ -166,6 +166,46 @@ def ring_lattice(n: int, d: int, max_degree: int | None = None) -> Topology:
     )
 
 
+def from_edges(n: int, edges, max_degree: int | None = None) -> Topology:
+    """Explicit dialed-edge list [(dialer, dialee), ...] — the analogue of
+    the reference tests' hand-wired `connect(t, hosts[a], hosts[b])`
+    sequences (e.g. gossipsub_test.go:903-911)."""
+    dialed: list[set[int]] = [set() for _ in range(n)]
+    for a, b in edges:
+        dialed[a].add(b)
+    return _from_edge_lists(n, dialed, max_degree)
+
+
+def line(n: int, max_degree: int | None = None) -> Topology:
+    """Path graph: i dials i+1 (TestGossipsubMultihops,
+    gossipsub_test.go:853-894 — a 6-host chain). Propagation hop count
+    equals graph distance."""
+    dialed = [({i + 1} if i + 1 < n else set()) for i in range(n)]
+    return _from_edge_lists(n, dialed, max_degree)
+
+
+def tree(n: int, branching: int = 3, max_degree: int | None = None) -> Topology:
+    """Rooted b-ary tree: each parent dials its children
+    (TestGossipsubTreeTopology, gossipsub_test.go:896-941 uses a hand-built
+    10-node tree; this is the generalized shape). Degree <= branching+1, so
+    with default Dlo the mesh retains every tree edge and hop counts equal
+    tree distance."""
+    dialed: list[set[int]] = [set() for _ in range(n)]
+    for i in range(1, n):
+        dialed[(i - 1) // branching].add(i)
+    return _from_edge_lists(n, dialed, max_degree)
+
+
+def star(n: int, max_degree: int | None = None) -> Topology:
+    """Hub-and-spoke: every leaf dials node 0 (TestGossipsubStarTopology,
+    gossipsub_test.go:945-1024 — overlay bootstrapping through PRUNE-with-PX
+    from a star)."""
+    dialed = [set() for _ in range(n)]
+    for i in range(1, n):
+        dialed[i].add(0)
+    return _from_edge_lists(n, dialed, max_degree)
+
+
 # ---------------------------------------------------------------------------
 # subscription construction
 

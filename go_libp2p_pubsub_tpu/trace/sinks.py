@@ -16,8 +16,8 @@ per round), so the cap bounds memory between flushes.
 
 from __future__ import annotations
 
-import gzip
 import io
+import zlib
 from typing import Callable, Iterable, Iterator
 
 from google.protobuf import json_format
@@ -27,6 +27,7 @@ from ..wire import framing
 
 TRACE_BUFFER_CAP = 1 << 16   # events held before the sink starts dropping
 MIN_REMOTE_BATCH = 16        # tracer.go: batch when >=16 pending
+_GZIP_WBITS = 31             # zlib window-bits selector for gzip framing
 
 
 class Tracer:
@@ -128,29 +129,181 @@ class PBTracer(Tracer):
             self._f.close()
 
 
-class RemoteTracer(Tracer):
-    """Collector-stream sink (tracer.go:186-303): pending events are packed
-    into TraceEventBatch frames, gzip-compressed, and handed to `send` (a
-    callable taking bytes — a socket write, a file, a test collector).
-    Framing inside the compressed stream is varint-delimited batches, as on
-    the reference's collector wire."""
+class _CollectorStream:
+    """One dialed collector stream: a persistent gzip stream into which
+    delimited TraceEventBatch frames are written, sync-flushed after each
+    batch (tracer.go:212-213 gzip.NewWriter once per stream; :239-249
+    WriteMsg + Flush per batch). The reference's collector therefore sees
+    one gzip member per connection, incrementally decompressible — not one
+    member per batch."""
 
-    def __init__(self, send: Callable[[bytes], None], min_batch: int = MIN_REMOTE_BATCH, **kw):
-        super().__init__(**kw)
+    def __init__(self, send: Callable[[bytes], None]):
         self._send = send
+        self._z = zlib.compressobj(6, zlib.DEFLATED, _GZIP_WBITS)
+
+    def write_batch(self, payload: bytes) -> None:
+        # may raise — the caller owns failure handling (batch loss + redial)
+        self._send(self._z.compress(payload) + self._z.flush(zlib.Z_SYNC_FLUSH))
+
+    def close(self) -> None:
+        # clean shutdown finishes the gzip member (tracer.go:261 gzipW.Close);
+        # a reset connection just abandons it (tracer.go:259 s.Reset)
+        try:
+            self._send(self._z.flush(zlib.Z_FINISH))
+        except Exception:
+            pass
+
+
+class RemoteTracer(Tracer):
+    """Collector-stream sink (tracer.go:186-303).
+
+    Connection semantics modeled from the reference writer loop
+    (tracer.go:201-301):
+
+      * `connect()` dials the collector and returns a byte-sink callable;
+        it raises on dial failure. Dialing never gives up until close —
+        the reference retries every minute (tracer.go:280-301); here a
+        failed dial retries after `redial_backoff` further flush attempts
+        (wall-clock has no meaning in the simulated loop).
+      * While disconnected, events keep accumulating in the lossy pending
+        buffer (cap 64Ki, then dropped — tracer.go:23-24,195 lossy).
+      * Each connection carries ONE persistent gzip stream; batches are
+        sync-flushed into it (_CollectorStream). A reconnect starts a
+        fresh gzip stream (tracer.go:275 gzipW.Reset).
+      * A batch whose write fails is LOST — the reference nils the buffer
+        whether or not the write succeeded (tracer.go:251-255) — and the
+        stream is reset + redialed (tracer.go:267-276).
+
+    Counters: `dials`, `dial_failures`, `write_failures`, `lost_events`
+    (failed-batch losses) and the inherited `dropped` (buffer-cap losses).
+
+    Backward-compatible: passing an infallible `send` callable as the
+    first argument models an always-up collector."""
+
+    def __init__(self, send: Callable[[bytes], None] | None = None,
+                 min_batch: int = MIN_REMOTE_BATCH, *,
+                 connect: Callable[[], Callable[[bytes], None]] | None = None,
+                 redial_backoff: int = 1, **kw):
+        super().__init__(**kw)
+        if (send is None) == (connect is None):
+            raise ValueError("exactly one of send / connect is required")
+        self._connect = connect if connect is not None else (lambda: send)
         self._min_batch = min_batch
+        self._redial_backoff = redial_backoff
+        self._stream: _CollectorStream | None = None
+        self._backoff_left = 0
+        self.dials = 0
+        self.dial_failures = 0
+        self.write_failures = 0
+        self.lost_events = 0
 
     def trace(self, ev):
+        if self.closed:
+            return
         super().trace(ev)
         if len(self._pending) >= self._min_batch:
             self.flush()
 
+    # -- connection management -------------------------------------------
+    def _try_dial(self) -> bool:
+        if self._stream is not None:
+            return True
+        if self._backoff_left > 0:
+            self._backoff_left -= 1
+            return False
+        self.dials += 1
+        try:
+            self._stream = _CollectorStream(self._connect())
+            return True
+        except Exception:
+            self.dial_failures += 1
+            self._backoff_left = self._redial_backoff
+            return False
+
+    def flush(self) -> None:
+        # connection check FIRST: while the collector is down, events stay
+        # buffered in place (lossy via the cap in trace()) — no per-event
+        # buffer churn, and a flush attempt costs one backoff tick
+        if not self._pending or not self._try_dial():
+            return
+        super().flush()
+
     def _write(self, evs):
+        # flush() guarantees a live stream here
         batch = trace_pb2.TraceEventBatch()
         batch.batch.extend(evs)
         raw = io.BytesIO()
         framing.write_delimited(raw, batch)
-        self._send(gzip.compress(raw.getvalue()))
+        try:
+            self._stream.write_batch(raw.getvalue())
+        except Exception:
+            # the batch is gone (tracer.go:251-255); reset + immediate redial
+            self.write_failures += 1
+            self.lost_events += len(evs)
+            self._stream = None
+            self._try_dial()
+
+    def _close(self):
+        if self._pending:
+            # close while the collector is down: whatever the final flush
+            # could not send is gone with the writer (tracer.go:257-264)
+            self.lost_events += len(self._pending)
+            self._pending = []
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+
+
+class MemoryCollector:
+    """In-process collector endpoint for tests/tools — the counterpart of
+    the reference's mockRemoteTracer (trace_test.go:266-300). Accumulates
+    the connection's byte stream and decodes it incrementally; failure
+    injection knobs simulate collector downtime."""
+
+    def __init__(self):
+        self.connections = 0
+        self.chunks: list[bytes] = []
+        self._streams: list[bytearray] = []
+        self.fail_dials = 0       # next N connect() calls raise
+        self.fail_writes = 0      # next N send() calls raise
+        self._down = False
+
+    # failure injection
+    def go_down(self) -> None:
+        self._down = True
+
+    def go_up(self) -> None:
+        self._down = False
+
+    def connect(self) -> Callable[[bytes], None]:
+        # downtime does not consume the injected-failure budget — a
+        # fail_dials scheduled for after go_up() still fires
+        if self._down:
+            raise ConnectionError("collector down")
+        if self.fail_dials > 0:
+            self.fail_dials -= 1
+            raise ConnectionError("collector unavailable")
+        self.connections += 1
+        buf = bytearray()
+        self._streams.append(buf)
+
+        def send(data: bytes) -> None:
+            if self._down:
+                raise ConnectionError("collector down")
+            if self.fail_writes > 0:
+                self.fail_writes -= 1
+                raise ConnectionError("collector stream reset")
+            buf.extend(data)
+            self.chunks.append(data)
+
+        return send
+
+    def events(self) -> list[trace_pb2.TraceEvent]:
+        """Decode every connection's (possibly unfinished) gzip stream."""
+        out: list[trace_pb2.TraceEvent] = []
+        for buf in self._streams:
+            out.extend(decode_remote_stream(bytes(buf)))
+        return out
 
 
 def read_json_trace(path: str) -> Iterator[trace_pb2.TraceEvent]:
@@ -166,11 +319,32 @@ def read_pb_trace(path: str) -> Iterator[trace_pb2.TraceEvent]:
         yield from framing.read_delimited_messages(f, trace_pb2.TraceEvent)
 
 
-def decode_remote_frame(frame: bytes) -> list[trace_pb2.TraceEvent]:
-    """Decompress + unframe one collector frame back into events."""
-    raw = gzip.decompress(frame)
-    stream = io.BytesIO(raw)
+def decode_remote_stream(data: bytes) -> list[trace_pb2.TraceEvent]:
+    """Decode a collector-side byte stream back into events.
+
+    Handles one or more concatenated gzip members (reconnects start fresh
+    members) including unfinished sync-flushed tails (a live or reset
+    connection never wrote Z_FINISH)."""
+    raw = bytearray()
+    while data:
+        if data[:2] != b"\x1f\x8b":
+            raise ValueError(
+                "not at a gzip member boundary — individual mid-connection "
+                "chunks are sync-flushed continuations of one per-connection "
+                "gzip stream and cannot be decoded alone; concatenate the "
+                "connection's chunks and decode the whole stream"
+            )
+        z = zlib.decompressobj(_GZIP_WBITS)
+        raw.extend(z.decompress(data))
+        raw.extend(z.flush())
+        data = z.unused_data  # next gzip member, if any
+    stream = io.BytesIO(bytes(raw))
     out: list[trace_pb2.TraceEvent] = []
     for batch in framing.read_delimited_messages(stream, trace_pb2.TraceEventBatch):
         out.extend(batch.batch)
     return out
+
+
+# historical name: round-1/2 frames were one complete gzip member per batch;
+# the stream decoder subsumes that format
+decode_remote_frame = decode_remote_stream

@@ -1,0 +1,127 @@
+"""Structural-topology tests — tier-2 analogues of the reference's
+chain/tree/star suites (gossipsub_test.go:853-1024).
+
+The line and tree graphs have degree < Dlo, so the heartbeat grafts every
+edge and the mesh IS the graph: propagation becomes deterministic and the
+hop law (first_round - birth == BFS distance) is assertable exactly —
+something the reference can only approximate with sleeps. The star test is
+the composed PX-bootstrapping scenario: a hub that over-subscribes prunes
+with PX, and the leaves must build a working overlay out of those PX
+suggestions (host-side pxConnect, the round-2 signed-record path).
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import api, graph, state
+from go_libp2p_pubsub_tpu.config import GossipSubParams
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.ops import bitset
+from go_libp2p_pubsub_tpu.state import Net
+
+from test_gossipsub import pub, run
+
+
+def _build(topo, n_topics=1, msg_slots=32, seed=0):
+    subs = graph.subscribe_all(topo.n_peers, n_topics)
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build()
+    st = GossipSubState.init(net, msg_slots, cfg, seed=seed)
+    step = make_gossipsub_step(cfg, net)
+    return net, cfg, st, step
+
+
+def _bfs_dist(topo, src):
+    n = topo.n_peers
+    dist = np.full(n, -1, np.int64)
+    dist[src] = 0
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for i in frontier:
+            for k in range(topo.max_degree):
+                if topo.nbr_ok[i, k]:
+                    j = int(topo.nbr[i, k])
+                    if dist[j] < 0:
+                        dist[j] = dist[i] + 1
+                        nxt.append(j)
+        frontier = nxt
+    return dist
+
+
+def test_multihop_line_hop_law():
+    # 6-host chain (gossipsub_test.go:853-894): the far end receives, and
+    # each node's arrival round is exactly its distance from the origin
+    topo = graph.line(6)
+    net, cfg, st, step = _build(topo)
+    st = run(step, st, 8)  # mesh warmup (grafts all edges: degree <= 2)
+    mesh = np.asarray(st.mesh[:, 0, :])
+    assert (mesh.sum(axis=1) == topo.degree).all(), "line mesh must be the line"
+    st = step(st, *pub([0], [0]))
+    st = run(step, st, 8)
+    h = np.asarray(state.hops(st.core.msgs, st.core.dlv))[:, 0]
+    assert (h == _bfs_dist(topo, 0)).all()
+
+
+def test_tree_topology_hop_law():
+    # the reference's hand-built 10-node tree (gossipsub_test.go:903-921)
+    edges = [(0, 1), (1, 2), (1, 4), (2, 3), (0, 5), (5, 6), (5, 8),
+             (6, 7), (8, 9)]
+    topo = graph.from_edges(10, edges)
+    net, cfg, st, step = _build(topo)
+    st = run(step, st, 8)
+    mesh = np.asarray(st.mesh[:, 0, :])
+    assert mesh.sum() == 2 * len(edges), "tree mesh must be the whole tree"
+    # checkMessageRouting publishes from 9 and 3 (gossipsub_test.go:940)
+    for origin, slot in ((9, 0), (3, 1)):
+        st = step(st, *pub([origin], [0]))
+        st = run(step, st, 8)
+        h = np.asarray(state.hops(st.core.msgs, st.core.dlv))[:, slot]
+        assert (h == _bfs_dist(topo, origin)).all()
+
+
+def test_tree_generator_shape():
+    topo = graph.tree(13, branching=3)
+    deg = topo.degree
+    assert deg[0] == 3            # root: 3 children
+    assert deg.max() == 4         # internal: parent + 3 children
+    assert (deg >= 1).all()
+    d = _bfs_dist(topo, 0)
+    assert d.max() == 2 and (d >= 0).all()
+
+
+@pytest.mark.slow
+def test_star_px_bootstrap():
+    """gossipsub_test.go:945-1024: start as a star; PRUNE-with-PX must grow
+    the overlay until leaves connect to each other, and publishes from
+    every corner still reach everyone."""
+    params = GossipSubParams(do_px=True, flood_publish=True)
+    net = api.Network(params=params, px_connect=True)
+    nodes = net.add_nodes(20)
+    for leaf in nodes[1:]:
+        net.connect(nodes[0], leaf)  # hub-and-spoke
+    for nd in nodes:
+        nd.join("test")
+    net.start()
+    net.run(16)
+
+    # every peer ends up with more than its single hub link
+    # (gossipsub_test.go:1009-1013)
+    deg = np.zeros(len(nodes), np.int64)
+    for a, b in net._edges:
+        deg[a] += 1
+        deg[b] += 1
+    assert (deg[1:] > 1).all(), f"leaves still hub-only: {deg.tolist()}"
+
+    # propagation from three corners of the overlay reaches all peers
+    subs = [nd.topics["test"].subscribe() for nd in nodes]
+    for origin in (0, 7, 19):
+        nodes[origin].topics["test"].publish(b"star-%d" % origin)
+        net.run(8)
+        got = sum(1 for s in subs if any(True for _ in s))
+        assert got == len(nodes), f"origin {origin}: {got}/{len(nodes)}"

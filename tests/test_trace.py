@@ -97,12 +97,84 @@ def test_remote_tracer_batching():
     evs = [_mk_event(i) for i in range(10)]
     t.trace_many(evs)  # two full batches sent eagerly
     assert len(frames) == 2
-    t.close()          # remainder flushed
-    assert len(frames) == 3
-    got = [e for f in frames for e in sinks.decode_remote_frame(f)]
+    t.close()          # remainder flushed + gzip stream finished
+    assert len(frames) == 4
+    got = sinks.decode_remote_stream(b"".join(frames))
     assert got == evs
-    # frames are really gzip
-    assert gzip.decompress(frames[0])
+    # the connection's stream is one real gzip member (header magic), and
+    # close() finished it so a plain one-shot gunzip also works
+    assert frames[0][:2] == b"\x1f\x8b"
+    assert gzip.decompress(b"".join(frames))
+
+
+def test_remote_tracer_reconnect_semantics():
+    """tracer.go:201-301: failed batch lost, redial, fresh gzip stream."""
+    col = sinks.MemoryCollector()
+    t = sinks.RemoteTracer(connect=col.connect, min_batch=4, redial_backoff=2)
+    evs = [_mk_event(i) for i in range(24)]
+
+    t.trace_many(evs[:4])           # batch 0 lands on connection 1
+    assert col.connections == 1 and t.dials == 1
+
+    col.fail_writes = 1             # collector resets the stream mid-write
+    t.trace_many(evs[4:8])          # batch 1 is LOST; immediate redial wins
+    assert t.write_failures == 1 and t.lost_events == 4
+    assert col.connections == 2     # fresh connection, fresh gzip stream
+
+    t.trace_many(evs[8:12])         # batch 2 lands on connection 2
+    got = col.events()
+    assert got == evs[:4] + evs[8:12]   # the failed batch is really gone
+
+    # collector goes down entirely: write fails AND redial fails
+    col.go_down()
+    t.trace_many(evs[12:16])        # batch 3 lost on write; dial fails
+    assert t.lost_events == 8 and t.dial_failures == 1
+
+    # while down, events are retained (lossy at cap), flushes back off
+    t.trace_many(evs[16:20])        # flush -> backoff tick, retained
+    assert len(t._pending) == 4 and col.connections == 2
+
+    col.go_up()
+    t.trace_many(evs[20:24])        # flush: backoff expires -> redial -> send
+    t.close()
+    assert col.connections == 3
+    # retained events arrive after downtime, in order, on the new stream
+    assert col.events() == evs[:4] + evs[8:12] + evs[16:24]
+
+
+def test_remote_tracer_closed_is_inert():
+    col = sinks.MemoryCollector()
+    t = sinks.RemoteTracer(connect=col.connect, min_batch=2)
+    t.trace_many([_mk_event(0), _mk_event(1)])
+    t.close()
+    dials = t.dials
+    t.trace_many([_mk_event(2), _mk_event(3)])  # post-close: no dial, no send
+    assert t.dials == dials and len(col.events()) == 2
+
+
+def test_remote_tracer_close_while_down_counts_losses():
+    col = sinks.MemoryCollector()
+    col.go_down()
+    t = sinks.RemoteTracer(connect=col.connect, min_batch=64, redial_backoff=0)
+    t.trace_many([_mk_event(i) for i in range(5)])
+    t.close()
+    # stranded events are accounted, not silently forgotten
+    assert t.lost_events == 5 and not t._pending
+
+
+def test_remote_tracer_buffer_cap_while_down():
+    col = sinks.MemoryCollector()
+    col.go_down()
+    t = sinks.RemoteTracer(connect=col.connect, min_batch=4,
+                           redial_backoff=0, buffer_cap=6)
+    for i in range(20):
+        t.trace(_mk_event(i))
+    # buffer holds at most cap events; the rest were dropped lossily
+    assert len(t._pending) <= 6 and t.dropped >= 14
+    col.go_up()
+    t.flush()
+    t.close()
+    assert len(col.events()) >= 6  # survivors land after the collector returns
 
 
 def test_tracer_lossy_buffer():
@@ -177,7 +249,7 @@ def test_traced_run_accounting(tmp_path):
     evs = list(sinks.read_pb_trace(ppath))
     # replay matches across sinks
     assert list(sinks.read_json_trace(jpath)) == evs
-    remote = [e for f in frames for e in sinks.decode_remote_frame(f)]
+    remote = sinks.decode_remote_stream(b"".join(frames))
     assert remote == evs
 
     types = {e.type for e in evs}
