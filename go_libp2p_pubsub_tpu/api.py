@@ -290,10 +290,16 @@ class Node:
 
     def __init__(self, network: "Network", idx: int, identity: Identity,
                  protocol: str, ip: str | None,
-                 sub_filter: SubscriptionFilter | None):
+                 sub_filter: SubscriptionFilter | None,
+                 author: Identity | None = None):
         self.network = network
         self.idx = idx
         self.identity = identity
+        # WithMessageAuthor (pubsub.go:372-383): the identity stamped as
+        # the author (`from` + signing key) of this node's published
+        # messages — e.g. a stable logical identity distinct from the
+        # transient host identity. None = the node's own identity.
+        self.author = author
         self.protocol = protocol
         self.ip = ip
         self.sub_filter = sub_filter
@@ -420,6 +426,7 @@ class Network:
         discovery: Discovery | None = None,
         track_tags: bool = False,
         protocol_matcher: "ProtocolMatcher | None" = None,
+        max_message_size: int | None = None,
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
@@ -460,6 +467,16 @@ class Network:
         self.validation_delay_rounds = validation_delay_rounds
         self.queue_cap = queue_cap
         self.px_connect = px_connect
+        # WithMaxMessageSize (pubsub.go:480-485; the reference defaults to
+        # 1 MiB): a publish whose serialized message exceeds the limit
+        # delivers locally and enters mcache/IHAVE, but every transmit
+        # drops it (the sendRPC fragmentRPC drop, gossipsub.go:1126-1140).
+        # Opt-in here (None = unchecked): enabling it adds the per-message
+        # wire_block plane to the device state, which the opt-in Pallas
+        # fast paths (PUBSUB_PALLAS/PUBSUB_FUSED) predate — pass
+        # max_message_size=1 << 20 for the reference's default behavior.
+        self.max_message_size = max_message_size
+        self.oversized_publishes = 0
         # the certified addr-book analogue: each peer's self-signed record,
         # what makePrune attaches to PX suggestions (gossipsub.go:1827-45).
         # Tests may override _px_record_source to model record forgery.
@@ -498,12 +515,13 @@ class Network:
 
     def add_node(self, protocol: str = "/meshsub/1.1.0", ip: str | None = None,
                  sub_filter: SubscriptionFilter | None = None,
-                 seed: int | None = None) -> Node:
+                 seed: int | None = None,
+                 author: Identity | None = None) -> Node:
         self._check_not_started("add_node")
         self.protocol_matcher.level(protocol)  # fail fast on unknown ids
         idx = len(self.nodes)
         ident = Identity.generate(self.seed * 1_000_003 + idx if seed is None else seed)
-        node = Node(self, idx, ident, protocol, ip, sub_filter)
+        node = Node(self, idx, ident, protocol, ip, sub_filter, author=author)
         self.nodes.append(node)
         return node
 
@@ -821,19 +839,24 @@ class Network:
                 queue_cap=self.queue_cap,
             )
             self.state = GossipSubState.init(
-                self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed
+                self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed,
+                wire_block=self.max_message_size is not None,
             )
             self._cfg = cfg
             self._recompile_gossipsub()
             self._dynamic = True
         elif self.router == "randomsub":
-            self.state = SimState.init(n, self.msg_slots, self.seed, k=self.net.max_degree)
+            self.state = SimState.init(n, self.msg_slots, self.seed,
+                                       k=self.net.max_degree,
+                                       wire_block=self.max_message_size is not None)
             self._step = make_randomsub_step(self.net)
             self._dynamic = False
         else:  # floodsub
             from .models.floodsub import floodsub_step
 
-            self.state = SimState.init(n, self.msg_slots, self.seed, k=self.net.max_degree)
+            self.state = SimState.init(n, self.msg_slots, self.seed,
+                                       k=self.net.max_degree,
+                                       wire_block=self.max_message_size is not None)
 
             def _fstep(st, po, pt, pv, _net=self.net):
                 return floodsub_step(_net, st, po, pt, pv)
@@ -861,6 +884,16 @@ class Network:
                 self.net, self.trace_sinks,
                 queue_cap=0 if self.queue_cap else 32,
                 topic_name=lambda t: self.topic_names.get(t, f"topic-{t}"),
+                # real identities on the trace: event peerIDs are the
+                # nodes' ed25519 ids, and messageIDs come from the actual
+                # published message (honoring WithMessageAuthor overrides
+                # and custom WithMessageIdFn) — run() records the slot ->
+                # message mapping before observe() runs
+                peer_id_of=lambda i: self.nodes[i].identity.peer_id,
+                mid_fn=lambda origin, sq, slot: (
+                    self.msg_id_fn(self._slot_msg[slot])
+                    if slot in self._slot_msg else b"?unknown"
+                ),
             )
             self._session.emit_init(snapshot(self.state))
 
@@ -871,15 +904,33 @@ class Network:
             raise APIError("publish before start()")
         msg = rpc_pb2.Message(data=data, topic=topic.name)
         if self.sign_policy in (SignPolicy.STRICT_SIGN, SignPolicy.LAX_SIGN):
-            setattr(msg, "from", node.identity.peer_id)
+            # author override (WithMessageAuthor, pubsub.go:372-383): the
+            # message is attributed to — and signed by — the configured
+            # author identity rather than the transient node identity
+            author = node.author or node.identity
+            setattr(msg, "from", author.peer_id)
             msg.seqno = node._seqno.to_bytes(8, "big")
             node._seqno += 1
             if self.sign_policy.signs:
-                sign_message(msg, node.identity)
+                sign_message(msg, author)
         # local validation front-end (PushLocal validation.go:216-226):
         # signing policy, then inline + async validators
         check_signing_policy(self.sign_policy, msg)
         verdict = self._run_validators(node, topic, msg, local=True)
+        if (self.max_message_size is not None
+                and msg.ByteSize() > self.max_message_size):
+            # oversized: local delivery + mcache/IHAVE presence, but the
+            # wire refuses it everywhere (WithMaxMessageSize pubsub.go:480;
+            # fragmentRPC single-message drop gossipsub.go:1126-1140)
+            from .state import VERDICT_WIRE_BLOCK
+
+            verdict = verdict | VERDICT_WIRE_BLOCK
+            self.oversized_publishes += 1
+            _log.warning(
+                "message from %d on %r exceeds max_message_size (%d > %d); "
+                "it will not be transmitted", node.idx, topic.name,
+                msg.ByteSize(), self.max_message_size,
+            )
         mid = self.msg_id_fn(msg)
         self._pub_queue.append((node.idx, topic.tid, verdict, msg, mid))
         # local delivery to the publisher's own subscriptions happens at

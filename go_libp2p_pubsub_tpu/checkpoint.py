@@ -27,7 +27,11 @@ import numpy as np
 # v3: MsgTable grew the `ignored` verdict plane (ValidationIgnore)
 # v4: GossipSubState grew `congested_in` [N,K] (queue-cap link saturation,
 #     read by the host announce-retry model)
-_FORMAT_VERSION = 4
+# v5: MsgTable optionally carries `wire_block` [M] bool (max-message-size
+#     transmit block; present only in states built with wire_block=True —
+#     leaf count differs between the two modes, so the restore template
+#     must be built with the same setting)
+_FORMAT_VERSION = 5
 
 
 def _is_key(leaf) -> bool:

@@ -187,7 +187,8 @@ def delivery_round(
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
 
     if (USE_PALLAS and net.band_off is not None and forward_mask is None
-            and val_delay == 0 and queue_cap == 0):
+            and val_delay == 0 and queue_cap == 0
+            and msgs.wire_block is None):  # kernel predates the block plane
         from ..ops.pallas_delivery import pallas_supported
 
         block = min(_pallas_block(), n)
@@ -209,6 +210,11 @@ def delivery_round(
 
     ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     not_mine = ~origin_msg_words(net, msgs)  # [N, W]
+    if msgs.wire_block is not None:
+        # oversized messages never cross any edge (sendRPC's fragmentRPC
+        # drop, gossipsub.go:1126-1140) — they still live in mcache and
+        # get IHAVE-advertised, like the reference's
+        not_mine = not_mine & ~bitset.pack(msgs.wire_block)[None, :]
 
     trans = fwd_gathered & ~echo_words & edge_mask & ok_words & not_mine[:, None, :]
 

@@ -290,6 +290,7 @@ class GossipSubState:
         seed: int = 0,
         app_score: np.ndarray | None = None,
         dormant: np.ndarray | None = None,
+        wire_block: bool = False,
     ) -> "GossipSubState":
         n, k = net.nbr.shape
         s = net.n_slots
@@ -305,7 +306,8 @@ class GossipSubState:
             p6 = jnp.zeros((n, k), jnp.float32)
         return cls(
             core=SimState.init(n, msg_slots, seed, k=k,
-                               val_delay=cfg.validation_delay_rounds),
+                               val_delay=cfg.validation_delay_rounds,
+                               wire_block=wire_block),
             mesh=jnp.zeros((n, s, k), bool),
             backoff_expire=jnp.zeros((n, s, k), jnp.int32),
             backoff_present=jnp.zeros((n, s, k), bool),
@@ -710,6 +712,12 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
     m = core.msgs.capacity
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
     extra = extra & ~origin_msg_words(net, core.msgs)[:, None, :]
+    if core.msgs.wire_block is not None:
+        # IWANT responses for oversized messages die at the wire too — but
+        # only after the retransmission counter ticked (mcache.GetForPeer
+        # counts the attempt before sendRPC drops it, mcache.go:66-80 ->
+        # gossipsub.go:1126-1140), which iwant_responses already did
+        extra = extra & ~bitset.pack(core.msgs.wire_block)[None, None, :]
     if queue_cap > 0:
         used = bitset.popcount(info.trans, axis=-1)  # [N,K]
         budget = jnp.maximum(queue_cap - used, 0)
@@ -1506,6 +1514,11 @@ def make_gossipsub_step(
         slotw = slot_topic_words(net_l, core.msgs.topic)
         pre_have = core.dlv.have
         if use_fused:
+            if core.msgs.wire_block is not None:
+                raise NotImplementedError(
+                    "the fused Pallas data plane predates the wire_block "
+                    "(max-message-size) plane — use the default XLA path"
+                )
             # 2+3+4 fused: IHAVE ingest first (it consumes nothing the
             # delivery kernel writes), then the whole delivery plane —
             # mesh/fanout/flood push, echo suppression, IWANT service with

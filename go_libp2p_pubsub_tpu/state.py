@@ -125,6 +125,13 @@ class Net:
 VERDICT_ACCEPT = 0
 VERDICT_REJECT = 1
 VERDICT_IGNORE = 2
+# flag bit OR-able onto a verdict code: the message exceeds the wire's
+# maxMessageSize (WithMaxMessageSize, pubsub.go:480-485). It is delivered
+# locally, enters mcache, and is IHAVE-advertised — but every transmit
+# (mesh/fanout/flood push AND IWANT responses) drops it, exactly like the
+# reference's sendRPC-side fragmentRPC drop of a single message larger
+# than the limit (gossipsub.go:1126-1140, fragmentRPC :1180-1187)
+VERDICT_WIRE_BLOCK = 4
 
 
 def decode_verdicts(pub_valid: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -132,10 +139,18 @@ def decode_verdicts(pub_valid: jax.Array) -> tuple[jax.Array, jax.Array]:
 
     `pub_valid` is either bool (True = accept, False = reject — the
     original two-verdict interface) or an integer VERDICT_* code array
-    (the three-verdict interface)."""
+    (the three-verdict interface, plus the VERDICT_WIRE_BLOCK flag bit)."""
     if pub_valid.dtype == jnp.bool_:
         return pub_valid, jnp.zeros_like(pub_valid)
-    return pub_valid == VERDICT_ACCEPT, pub_valid == VERDICT_IGNORE
+    base = pub_valid & ~VERDICT_WIRE_BLOCK
+    return base == VERDICT_ACCEPT, base == VERDICT_IGNORE
+
+
+def decode_wire_block(pub_valid: jax.Array) -> jax.Array:
+    """Bool plane of the VERDICT_WIRE_BLOCK flag (False for bool verdicts)."""
+    if pub_valid.dtype == jnp.bool_:
+        return jnp.zeros_like(pub_valid)
+    return (pub_valid & VERDICT_WIRE_BLOCK) != 0
 
 
 @struct.dataclass
@@ -149,9 +164,13 @@ class MsgTable:
     ignored: jax.Array  # [M] bool — ValidationIgnore (drop, no P4 penalty;
                         # validation.go:46-52, score.go:768-774)
     cursor: jax.Array   # i32 — next slot to allocate (monotonic, mod M)
+    wire_block: jax.Array | None = None  # [M] bool — oversized: never
+                        # transmitted on any edge (VERDICT_WIRE_BLOCK;
+                        # WithMaxMessageSize pubsub.go:480, sendRPC drop
+                        # gossipsub.go:1126-1140); None = feature unused
 
     @classmethod
-    def empty(cls, m: int) -> "MsgTable":
+    def empty(cls, m: int, wire_block: bool = False) -> "MsgTable":
         return cls(
             topic=jnp.full((m,), -1, jnp.int32),
             origin=jnp.full((m,), -1, jnp.int32),
@@ -159,6 +178,7 @@ class MsgTable:
             valid=jnp.zeros((m,), bool),
             ignored=jnp.zeros((m,), bool),
             cursor=jnp.int32(0),
+            wire_block=jnp.zeros((m,), bool) if wire_block else None,
         )
 
     @property
@@ -227,15 +247,17 @@ class SimState:
 
     @classmethod
     def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0,
-             val_delay: int = 0) -> "SimState":
+             val_delay: int = 0, wire_block: bool = False) -> "SimState":
         """`k` is the topology's padded max degree (net.max_degree) — it
         sizes the packed first-arrival-edge plane. k=0 is only for states
         that never enter a delivery round (e.g. checkpoint plumbing).
-        `val_delay` > 0 adds the async-validation pipeline stages."""
+        `val_delay` > 0 adds the async-validation pipeline stages.
+        `wire_block` enables the per-message oversized-transmit-block plane
+        (WithMaxMessageSize support — off by default, zero hot-path cost)."""
         return cls(
             tick=jnp.int32(0),
             key=jax.random.key(seed),
-            msgs=MsgTable.empty(msg_slots),
+            msgs=MsgTable.empty(msg_slots, wire_block=wire_block),
             dlv=Delivery.empty(n_peers, msg_slots, k, val_delay),
             events=zero_counters(),
         )
@@ -289,6 +311,10 @@ def allocate_publishes(
         valid=msgs.valid.at[sidx].set(accept, mode="drop"),
         ignored=msgs.ignored.at[sidx].set(ignored, mode="drop"),
         cursor=msgs.cursor + count,
+        wire_block=(
+            msgs.wire_block.at[sidx].set(decode_wire_block(pub_valid), mode="drop")
+            if msgs.wire_block is not None else None
+        ),
     )
 
     # origin peers: mark seen + schedule forwarding + record first_round

@@ -98,7 +98,7 @@ class TraceSession:
     """
 
     def __init__(self, net, sinks, tick_ns: int = 10**9, queue_cap: int = 32,
-                 topic_name=None):
+                 topic_name=None, peer_id_of=None, mid_fn=None):
         self.sinks = list(sinks)
         self.tick_ns = tick_ns
         self.queue_cap = queue_cap
@@ -108,7 +108,14 @@ class TraceSession:
         self.subscribed = np.asarray(net.subscribed)
         self.protocol = np.asarray(net.protocol)
         n = self.nbr.shape[0]
-        self.peer_ids = [peer_id(i) for i in range(n)]
+        # identity seams: a bare engine session reconstructs synthetic
+        # peer ids and from‖seqno message ids; an embedding layer with real
+        # identities (api.Network: ed25519 peer ids, WithMessageAuthor
+        # overrides, custom WithMessageIdFn) supplies both so traced ids
+        # match the wire's (trace.go events carry the real ids)
+        pid = peer_id_of or peer_id
+        self.peer_ids = [pid(i) for i in range(n)]
+        self.mid_fn = mid_fn  # (origin_idx, seqno, slot) -> bytes | None
         self.seqno = np.zeros(n, np.int64)       # per-origin counters
         m_cap = None  # learned from first snapshot
         self._m_cap = m_cap
@@ -174,7 +181,10 @@ class TraceSession:
             origin, slot = int(po[j]), int(slots[j])
             sq = int(self.seqno[origin])
             self.seqno[origin] += 1
-            mid = message_id(self.peer_ids[origin], sq)
+            if self.mid_fn is not None:
+                mid = self.mid_fn(origin, sq, slot)
+            else:
+                mid = message_id(self.peer_ids[origin], sq)
             self.slot_mid[slot] = mid
             ev = self._base(trace_pb2.TraceEvent.PUBLISH_MESSAGE, origin, tick)
             ev.publishMessage.messageID = mid

@@ -322,12 +322,22 @@ def read_pb_trace(path: str) -> Iterator[trace_pb2.TraceEvent]:
 def decode_remote_stream(data: bytes) -> list[trace_pb2.TraceEvent]:
     """Decode a collector-side byte stream back into events.
 
-    Handles one or more concatenated gzip members (reconnects start fresh
-    members) including unfinished sync-flushed tails (a live or reset
-    connection never wrote Z_FINISH)."""
-    raw = bytearray()
-    while data:
-        if data[:2] != b"\x1f\x8b":
+    Handles one or more concatenated gzip members — a reconnect starts a
+    fresh member — where any member may be unfinished (sync-flushed but
+    never Z_FINISHed: a live connection's tail, or a member abandoned by a
+    stream reset). An abandoned member followed by another member is
+    decoded up to its last complete sync-flush block; a handful of bytes
+    at the splice point can be unparseable and are skipped, like a
+    collector reading a reset stream loses its undelivered tail."""
+    data = bytes(data)
+    n = len(data)
+    # decoded bytes are parsed per SEGMENT: a truncated (abandoned) member
+    # ends its segment, so the next member's records never get misread as
+    # the continuation of a half-record
+    segments: list[bytearray] = [bytearray()]
+    pos = 0
+    while pos < n:
+        if data[pos:pos + 2] != b"\x1f\x8b":
             raise ValueError(
                 "not at a gzip member boundary — individual mid-connection "
                 "chunks are sync-flushed continuations of one per-connection "
@@ -335,13 +345,65 @@ def decode_remote_stream(data: bytes) -> list[trace_pb2.TraceEvent]:
                 "connection's chunks and decode the whole stream"
             )
         z = zlib.decompressobj(_GZIP_WBITS)
-        raw.extend(z.decompress(data))
-        raw.extend(z.flush())
-        data = z.unused_data  # next gzip member, if any
-    stream = io.BytesIO(bytes(raw))
+        cur = pos
+        member = bytearray()
+        spliced = False
+        while cur < n:
+            step = min(512, n - cur)
+            snap = z.copy()  # checkpoint: replay the failing step bytewise
+            try:
+                member.extend(z.decompress(data[cur:cur + step]))
+                cur += step
+            except zlib.error:
+                # an abandoned member spliced against the next member's
+                # header: replay from the checkpoint one byte at a time so
+                # every output byte before the corrupt point is salvaged
+                z = snap
+                fail_at = cur + step
+                for b in range(cur, cur + step):
+                    try:
+                        member.extend(z.decompress(data[b:b + 1]))
+                    except zlib.error:
+                        fail_at = b
+                        break
+                spliced = True
+                break
+            if z.unused_data:  # member finished; next begins right after
+                cur -= len(z.unused_data)
+                break
+        if spliced:
+            # close the segment (next member's records parse from a fresh
+            # boundary) and resume at the next plausible member header near
+            # the failure point (the next member's 10-byte gzip header sits
+            # at most a few bytes before where the error surfaced); a false
+            # magic inside compressed data just fails and re-scans
+            segments[-1].extend(member)
+            segments.append(bytearray())
+            nxt = data.find(b"\x1f\x8b", max(pos + 2, fail_at - 18))
+            if nxt < 0:
+                break
+            pos = nxt
+        else:
+            try:
+                member.extend(z.flush())
+            except zlib.error:
+                pass
+            segments[-1].extend(member)
+            pos = cur
+            if pos >= n:
+                break
     out: list[trace_pb2.TraceEvent] = []
-    for batch in framing.read_delimited_messages(stream, trace_pb2.TraceEventBatch):
-        out.extend(batch.batch)
+    for seg in segments:
+        stream = io.BytesIO(bytes(seg))
+        try:
+            for batch in framing.read_delimited_messages(
+                stream, trace_pb2.TraceEventBatch
+            ):
+                out.extend(batch.batch)
+        except (EOFError, ValueError):
+            # a salvaged abandoned member can end mid-record; everything
+            # before the truncation parsed cleanly and is kept
+            pass
     return out
 
 

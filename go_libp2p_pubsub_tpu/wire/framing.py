@@ -84,11 +84,27 @@ def _read_uvarint_stream(stream: BinaryIO) -> int | None:
             raise ValueError("uvarint too long")
 
 
-def read_delimited(stream: BinaryIO, msg_type):
-    """Read one length-prefixed message; None at clean EOF."""
+class FrameTooLargeError(ValueError):
+    """An inbound frame's declared length exceeds the reader's cap — the
+    reference bounds its delimited RPC readers at maxMessageSize
+    (comm.go:62,126: protoio.NewDelimitedReader(s, p.maxMessageSize)) so a
+    hostile peer can't demand an unbounded allocation; the read error kills
+    the stream (handleNewStream's error return, comm.go:67-76)."""
+
+
+def read_delimited(stream: BinaryIO, msg_type, max_size: int | None = None):
+    """Read one length-prefixed message; None at clean EOF.
+
+    `max_size` caps the declared frame length BEFORE any payload
+    allocation (FrameTooLargeError beyond it); None = unbounded (trusted
+    local files — trace replay etc.)."""
     size = _read_uvarint_stream(stream)
     if size is None:
         return None
+    if max_size is not None and size > max_size:
+        raise FrameTooLargeError(
+            f"frame of {size} bytes exceeds the {max_size}-byte reader cap"
+        )
     payload = stream.read(size)
     if len(payload) != size:
         raise EOFError("truncated frame")
@@ -97,10 +113,23 @@ def read_delimited(stream: BinaryIO, msg_type):
     return msg
 
 
-def read_delimited_messages(stream: BinaryIO, msg_type) -> Iterator:
+def read_delimited_messages(stream: BinaryIO, msg_type,
+                            max_size: int | None = None) -> Iterator:
     """Yield messages until EOF."""
     while True:
-        msg = read_delimited(stream, msg_type)
+        msg = read_delimited(stream, msg_type, max_size=max_size)
         if msg is None:
             return
         yield msg
+
+
+def read_rpc(stream: BinaryIO, max_size: int | None = None):
+    """Read one RPC frame off a peer stream with the reference's
+    maxMessageSize reader bound (comm.go:62)."""
+    from .fragment import DEFAULT_MAX_RPC_SIZE
+    from ..pb import rpc_pb2
+
+    return read_delimited(
+        stream, rpc_pb2.RPC,
+        max_size=DEFAULT_MAX_RPC_SIZE if max_size is None else max_size,
+    )

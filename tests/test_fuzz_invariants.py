@@ -1,0 +1,207 @@
+"""Randomized configuration fuzz: the protocol invariants that must hold
+for EVERY valid configuration, checked across randomly drawn topologies,
+parameter sets, and feature combinations.
+
+The parity suites pin exact behavior on fixed configs; this sweep guards
+the configuration space between them — the analogue of the reference's
+breadth of hand-written per-feature integration tests, compressed into
+properties (mesh containment/degree bounds, topic isolation, causal hop
+timing, backoff exclusion) that hold regardless of the drawn config.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+    no_publish,
+)
+from go_libp2p_pubsub_tpu.ops import bitset
+from go_libp2p_pubsub_tpu.state import Net
+
+M = 32
+N_CONFIGS = 6
+
+
+def _draw_config(rng):
+    """One random valid configuration (params validated by construction)."""
+    n = int(rng.integers(24, 72))
+    d = int(rng.integers(3, 9))
+    n_topics = int(rng.choice([1, 2, 4]))
+    tpp = 1 if n_topics == 1 else int(rng.integers(1, n_topics))
+    dlo = int(rng.integers(2, 5))
+    dd = dlo + int(rng.integers(1, 3))
+    dhi = dd + int(rng.integers(1, 5))
+    params = dataclasses.replace(
+        GossipSubParams(),
+        D=dd, Dlo=dlo, Dhi=dhi,
+        Dscore=int(rng.integers(0, dlo + 1)),
+        Dout=int(rng.integers(0, min(dlo - 1, dd // 2) + 1)),
+        Dlazy=int(rng.integers(2, 8)),
+        flood_publish=bool(rng.random() < 0.5),
+        gossip_factor=float(rng.uniform(0.1, 0.4)),
+        history_length=int(rng.integers(3, 6)),
+        history_gossip=3,
+    )
+    params = dataclasses.replace(
+        params, history_gossip=min(3, params.history_length)
+    )
+    score_on = bool(rng.random() < 0.5)
+    val_delay = int(rng.choice([0, 0, 1, 2]))
+    queue_cap = int(rng.choice([0, 0, 0, 8]))
+    return n, d, n_topics, tpp, params, score_on, val_delay, queue_cap
+
+
+def _build(seed):
+    rng = np.random.default_rng(seed)
+    n, d, n_topics, tpp, params, score_on, val_delay, queue_cap = _draw_config(rng)
+    topo = graph.random_connect(n, d, seed=seed)
+    if n_topics == 1:
+        subs = graph.subscribe_all(n, 1)
+    else:
+        subs = graph.subscribe_random(n, n_topics=n_topics,
+                                      topics_per_peer=tpp, seed=seed)
+    net = Net.build(topo, subs)
+    sp = None
+    if score_on:
+        sp = PeerScoreParams(
+            topics={t: TopicScoreParams(mesh_message_deliveries_weight=0.0,
+                                        mesh_failure_penalty_weight=0.0)
+                    for t in range(n_topics)},
+            skip_app_specific=True,
+            behaviour_penalty_weight=-1.0,
+            behaviour_penalty_threshold=1.0,
+            behaviour_penalty_decay=0.9,
+        )
+    cfg = GossipSubConfig.build(
+        params, PeerScoreThresholds(), score_enabled=score_on,
+        validation_delay_rounds=val_delay, queue_cap=queue_cap,
+    )
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    return topo, subs, net, cfg, st, step, rng
+
+
+def _check_invariants(topo, subs, cfg, st, tick_desc):
+    mesh = np.asarray(st.mesh)              # [N, S, K]
+    n, s_slots, k_dim = mesh.shape
+
+    # 1. mesh edges only on live topology edges
+    ok = np.asarray(topo.nbr_ok)
+    assert not mesh[~np.broadcast_to(ok[:, None, :], mesh.shape)].any(), (
+        f"{tick_desc}: mesh bit on a nonexistent edge"
+    )
+
+    # 2. mesh degree bounded by Dhi after a heartbeat settles
+    deg = mesh.sum(axis=2)
+    assert (deg <= cfg.Dhi).all(), (
+        f"{tick_desc}: mesh degree {deg.max()} exceeds Dhi={cfg.Dhi}"
+    )
+
+    # 3. mesh edges only toward peers subscribed to that topic slot
+    sub = subs.subscribed                   # [N, T]
+    mt = subs.my_topics                     # [N, S]
+    nbr = np.asarray(topo.nbr)
+    for s in range(s_slots):
+        t_of = mt[:, s]                     # my slot-s topic, -1 pad
+        for k in range(k_dim):
+            rows = mesh[:, s, k]
+            if not rows.any():
+                continue
+            js = np.nonzero(rows)[0]
+            ts = t_of[js]
+            assert (ts >= 0).all(), f"{tick_desc}: mesh on an empty topic slot"
+            assert sub[nbr[js, k], ts].all(), (
+                f"{tick_desc}: mesh edge toward a non-subscriber"
+            )
+
+    # 4. scores finite
+    if cfg.score_enabled:
+        sc = np.asarray(st.scores)
+        assert np.isfinite(sc).all(), f"{tick_desc}: non-finite score"
+
+    # 5. backoff excludes mesh (a pruned/backing-off edge must not be in
+    #    the mesh once the heartbeat has run)
+    bp = np.asarray(st.backoff_present)
+    be = np.asarray(st.backoff_expire)
+    live_backoff = bp & (be > int(st.core.tick))
+    assert not (mesh & live_backoff).any(), (
+        f"{tick_desc}: mesh edge under live backoff"
+    )
+
+
+def _check_delivery(topo, subs, st, slot, topic, origin, pub_tick, tick_desc):
+    have = np.asarray(bitset.unpack(st.core.dlv.have, M))[:, slot]
+    fr = np.asarray(st.core.dlv.first_round)[:, slot]
+    sub = subs.subscribed[:, topic]
+
+    # topic isolation: non-subscribers never hold the message
+    leaked = have & ~sub
+    leaked[origin] = False
+    assert not leaked.any(), f"{tick_desc}: delivery outside the topic"
+
+    # causality: receivers see it strictly after publish; origin exactly at
+    got = have.copy()
+    got[origin] = False
+    assert (fr[got] > pub_tick).all(), f"{tick_desc}: receipt before publish"
+    assert fr[origin] == pub_tick, f"{tick_desc}: origin first_round wrong"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(N_CONFIGS))
+def test_random_config_invariants(seed):
+    topo, subs, net, cfg, st, step, rng = _build(seed + 1000)
+    n = topo.n_peers
+
+    # warmup: mesh formation
+    for _ in range(12):
+        st = step(st, *no_publish())
+    _check_invariants(topo, subs, cfg, st, f"seed {seed} post-warmup")
+
+    # publish from three random subscribed origins on random topics
+    published = []
+    for _ in range(3):
+        t = int(rng.integers(0, subs.n_topics))
+        cands = np.nonzero(subs.subscribed[:, t])[0]
+        o = int(cands[rng.integers(0, len(cands))])
+        po = jnp.asarray(np.array([o, -1, -1, -1], np.int32))
+        pt = jnp.asarray(np.array([t, 0, 0, 0], np.int32))
+        pv = jnp.asarray(np.array([True, False, False, False]))
+        pub_tick = int(st.core.tick)
+        slot = int(st.core.msgs.cursor) % M
+        st = step(st, po, pt, pv)
+        published.append((slot, t, o, pub_tick))
+        for _ in range(4):
+            st = step(st, *no_publish())
+
+    # settle, then re-check everything
+    for _ in range(8):
+        st = step(st, *no_publish())
+    _check_invariants(topo, subs, cfg, st, f"seed {seed} post-publish")
+    for slot, t, o, pub_tick in published:
+        _check_delivery(topo, subs, st, slot, t, o, pub_tick,
+                        f"seed {seed} slot {slot}")
+
+    # lossless configs must reach every subscriber in the union-connected
+    # component; lossy (queue_cap) configs may genuinely drop
+    if cfg.queue_cap == 0:
+        for slot, t, o, pub_tick in published:
+            have = np.asarray(bitset.unpack(st.core.dlv.have, M))[:, slot]
+            sub = subs.subscribed[:, t]
+            cover = have[sub].mean() if sub.any() else 1.0
+            assert cover > 0.85, (
+                f"seed {seed}: coverage {cover:.0%} on topic {t} "
+                f"(subscribers {int(sub.sum())})"
+            )

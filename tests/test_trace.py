@@ -142,6 +142,24 @@ def test_remote_tracer_reconnect_semantics():
     assert col.events() == evs[:4] + evs[8:12] + evs[16:24]
 
 
+def test_decode_spliced_abandoned_member():
+    """A write-failed connection abandons its gzip member mid-stream; the
+    redial's fresh member is concatenated right after it (a plain `send`
+    byte sink has no per-connection segmentation). The decoder must
+    salvage the abandoned member's sync-flushed batches AND decode the
+    fresh member fully."""
+    chunks: list[bytes] = []
+    t = sinks.RemoteTracer(chunks.append, min_batch=4)
+    evs = [_mk_event(i) for i in range(12)]
+    t.trace_many(evs[:8])        # two sync-flushed batches on member 1
+    t._stream = None             # stream reset: member 1 never Z_FINISHed
+    t.trace_many(evs[8:12])      # redial -> fresh member, same byte sink
+    t.close()
+    got = sinks.decode_remote_stream(b"".join(chunks))
+    # everything was written at sync-flush boundaries, so nothing is lost
+    assert got == evs
+
+
 def test_remote_tracer_closed_is_inert():
     col = sinks.MemoryCollector()
     t = sinks.RemoteTracer(connect=col.connect, min_batch=2)
