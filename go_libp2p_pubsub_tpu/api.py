@@ -306,7 +306,6 @@ class Node:
         self.topics: dict[str, Topic] = {}
         self.blacklist = MapBlacklist()
         self.up = True
-        self._seqno = 0
 
     @property
     def peer_id(self) -> bytes:
@@ -477,6 +476,7 @@ class Network:
         # max_message_size=1 << 20 for the reference's default behavior.
         self.max_message_size = max_message_size
         self.oversized_publishes = 0
+        self._author_seqno: dict[bytes, int] = {}  # author id -> next seqno
         # the certified addr-book analogue: each peer's self-signed record,
         # what makePrune attaches to PX suggestions (gossipsub.go:1827-45).
         # Tests may override _px_record_source to model record forgery.
@@ -906,11 +906,17 @@ class Network:
         if self.sign_policy in (SignPolicy.STRICT_SIGN, SignPolicy.LAX_SIGN):
             # author override (WithMessageAuthor, pubsub.go:372-383): the
             # message is attributed to — and signed by — the configured
-            # author identity rather than the transient node identity
+            # author identity rather than the transient node identity.
+            # Seqnos are drawn from one counter per author id, so two
+            # nodes sharing an author never collide on from‖seqno message
+            # ids (the reference avoids this probabilistically with
+            # time-initialized counters, pubsub.go:1259-1264; a
+            # deterministic sim needs the counter shared outright)
             author = node.author or node.identity
             setattr(msg, "from", author.peer_id)
-            msg.seqno = node._seqno.to_bytes(8, "big")
-            node._seqno += 1
+            sq = self._author_seqno.setdefault(author.peer_id, 0)
+            self._author_seqno[author.peer_id] = sq + 1
+            msg.seqno = sq.to_bytes(8, "big")
             if self.sign_policy.signs:
                 sign_message(msg, author)
         # local validation front-end (PushLocal validation.go:216-226):

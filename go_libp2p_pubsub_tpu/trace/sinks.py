@@ -348,29 +348,39 @@ def decode_remote_stream(data: bytes) -> list[trace_pb2.TraceEvent]:
         cur = pos
         member = bytearray()
         spliced = False
-        while cur < n:
-            step = min(512, n - cur)
-            snap = z.copy()  # checkpoint: replay the failing step bytewise
-            try:
-                member.extend(z.decompress(data[cur:cur + step]))
-                cur += step
-            except zlib.error:
-                # an abandoned member spliced against the next member's
-                # header: replay from the checkpoint one byte at a time so
-                # every output byte before the corrupt point is salvaged
-                z = snap
-                fail_at = cur + step
-                for b in range(cur, cur + step):
-                    try:
-                        member.extend(z.decompress(data[b:b + 1]))
-                    except zlib.error:
-                        fail_at = b
-                        break
-                spliced = True
-                break
-            if z.unused_data:  # member finished; next begins right after
-                cur -= len(z.unused_data)
-                break
+        try:
+            # happy path: one decompress call over the whole remainder
+            member.extend(z.decompress(data[pos:]))
+            cur = n - len(z.unused_data)
+        except zlib.error:
+            # an abandoned member spliced against the next member's
+            # header. Replay from the member start in stepped chunks with
+            # checkpointing, dropping to bytewise on the failing step, so
+            # every output byte before the corrupt point is salvaged —
+            # O(member) work on this rare path only, zero on the happy one
+            z = zlib.decompressobj(_GZIP_WBITS)
+            member = bytearray()
+            fail_at = n
+            while cur < n:
+                step = min(512, n - cur)
+                snap = z.copy()
+                try:
+                    member.extend(z.decompress(data[cur:cur + step]))
+                    cur += step
+                except zlib.error:
+                    z = snap
+                    fail_at = cur + step
+                    for b in range(cur, cur + step):
+                        try:
+                            member.extend(z.decompress(data[b:b + 1]))
+                        except zlib.error:
+                            fail_at = b
+                            break
+                    break
+                if z.unused_data:
+                    cur -= len(z.unused_data)
+                    break
+            spliced = True
         if spliced:
             # close the segment (next member's records parse from a fresh
             # boundary) and resume at the next plausible member header near

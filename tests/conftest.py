@@ -19,4 +19,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent XLA compilation cache: the suite's dominant cost is repeated
+# jit compiles of near-identical step functions across test files; cached
+# executables cut a warm full-tier run roughly in half. Keyed by HLO +
+# platform + flags, so correctness is jax's problem, not ours. Repo-local
+# and gitignored; JAX_NO_TEST_CACHE=1 opts out (e.g. when bisecting a
+# suspected stale-cache issue).
+if os.environ.get("JAX_NO_TEST_CACHE", "") != "1":
+    _cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

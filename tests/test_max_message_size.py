@@ -12,15 +12,13 @@ Reference semantics being pinned:
     allocation with a huge length prefix.
 """
 
-import dataclasses
 import io
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from go_libp2p_pubsub_tpu import api, graph, state
-from go_libp2p_pubsub_tpu.config import GossipSubParams
+from go_libp2p_pubsub_tpu import api, graph
 from go_libp2p_pubsub_tpu.models.gossipsub import (
     GossipSubConfig,
     GossipSubState,
@@ -36,7 +34,7 @@ from go_libp2p_pubsub_tpu.state import (
 )
 from go_libp2p_pubsub_tpu.wire import framing
 
-from test_gossipsub import pub, run
+from test_gossipsub import run
 
 
 # ---------------------------------------------------------------------------
