@@ -392,3 +392,52 @@ def test_iwant_flood_served_at_most_retransmission_cap():
 
     assert served == cfg.gossip_retransmission, (
         served, cfg.gossip_retransmission)
+
+
+# ---------------------------------------------------------------------------
+# GRAFT for an unknown topic: silently ignored (spam hardening,
+# handleGraft gossipsub.go:727-733 — no mesh change, no PRUNE, no
+# backoff, no penalty; TestGossipsubAttackGRAFTNonExistentTopic,
+# gossipsub_spam_test.go:290)
+
+
+def test_graft_unknown_topic_ignored():
+    n = 16
+    topo = graph.random_connect(n, 5, seed=3)
+    mask = np.zeros((n, 2), bool)
+    mask[:, 0] = True          # everyone joins topic 0
+    attacker = 1
+    mask[attacker, 1] = True   # ONLY the attacker knows topic 1
+    subs = graph.subscribe_mask(mask)
+    net = Net.build(topo, subs)
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(GossipSubParams(), thr, score_enabled=True)
+    sp = p7_score_params()
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=3)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    st = run(step, st, 10)
+
+    s1 = int(subs.slot_of[attacker, 1])
+    assert s1 >= 0
+    pre_backoff = np.asarray(st.backoff_present).copy()
+    pre_scores = np.asarray(st.scores).copy()
+
+    for _ in range(5):
+        # GRAFT topic 1 toward every neighbor — none of them joined it
+        g = np.zeros(np.asarray(st.graft_out).shape, bool)
+        g[attacker, s1, :] = True
+        st = st.replace(graft_out=jnp.asarray(g))
+        st = step(st, *no_publish())
+
+    # no victim meshed the attacker on a slot it doesn't have, no backoff
+    # was created anywhere, and nobody's opinion of anyone moved
+    post_backoff = np.asarray(st.backoff_present)
+    assert (post_backoff == pre_backoff).all(), "unknown-topic GRAFT must not create backoff"
+    post_scores = np.asarray(st.scores)
+    assert np.array_equal(post_scores, pre_scores), "unknown-topic GRAFT must not move scores"
+    # attacker's own mesh for topic 1 stays empty (nobody to graft)
+    assert int(np.asarray(st.mesh)[attacker, s1].sum()) == 0
