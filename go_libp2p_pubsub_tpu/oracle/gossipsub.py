@@ -107,6 +107,7 @@ class OracleGossipSub:
         self.served = [dict() for _ in range(n)]     # (k, slot) -> count
         self.events = [0] * N_EVENTS
         self.adversary = self.adversary or set()
+        self._gossip_suppress = set()  # (i, k): congested outbound links
         # v1.1 composed plane
         if self.score_params is not None:
             self.oscore = [OracleScore(self.score_params) for _ in range(n)]
@@ -341,10 +342,17 @@ class OracleGossipSub:
         self.iwant_out = new_iwant
 
         # 4. delivery: senders push last round's fwd along mesh (+fanout,
-        # +flood-publish), adversary senders transmit nothing
+        # +flood-publish), adversary senders transmit nothing. With
+        # queue_cap each directed link carries at most cap messages per
+        # round — lowest slots kept, overflow genuinely LOST (the engine's
+        # prefix_cap_bits; doDropRPC gossipsub.go:1153-1160)
         arrivals = [dict() for _ in range(n)]  # slot -> [k,...]
         n_rpc = 0
+        cap = cfg.queue_cap
+        n_drop = 0
+        link_used = {}  # (i, k) -> push count on that link after the cap
         for i in range(n):
+            link_push: dict[int, list] = {}  # k -> [slot,...]
             for k, s, r in self._edges(i):
                 if s in self.adversary or not self._acc_ok(i, k):
                     continue
@@ -369,6 +377,14 @@ class OracleGossipSub:
                             carries = True
                     if not carries:
                         continue
+                    link_push.setdefault(k, []).append(slot)
+            for k, slots in link_push.items():
+                slots = sorted(slots)
+                if cap > 0 and len(slots) > cap:
+                    n_drop += len(slots) - cap
+                    slots = slots[:cap]
+                link_used[(i, k)] = len(slots)
+                for slot in slots:
                     arrivals[i].setdefault(slot, []).append(k)
                     n_rpc += 1
 
@@ -474,8 +490,15 @@ class OracleGossipSub:
                 n_new += 1
                 n_deliver += _arrive_new(i, slot, ks)
         # merge IWANT responses (merge_extra_tx: no echo exclusion,
-        # origin-exclusion only, mesh arrivals take first_edge precedence)
+        # origin-exclusion only, mesh arrivals take first_edge precedence).
+        # With queue_cap, responses share each link's budget with the mesh
+        # push that already claimed it (merge_extra_tx in
+        # models/gossipsub.py: used = trans popcount, budget = cap - used)
+        # — the retransmission counters in step 2 ticked regardless, like
+        # the reference's mcache.GetForPeer counting the attempt before
+        # sendRPC drops it
         for i in range(n):
+            live_by_slot: dict[int, list] = {}
             for slot, ks in sorted(extra[i].items()):
                 msg = self.msgs.get(slot)
                 live = [
@@ -483,14 +506,47 @@ class OracleGossipSub:
                     if msg is not None and msg.origin != i
                     and self._acc_ok(i, k)
                 ]
+                if live:
+                    live_by_slot[slot] = live
+            if cap > 0:
+                ex_link: dict[int, list] = {}
+                for slot, ks in live_by_slot.items():
+                    for k in ks:
+                        ex_link.setdefault(k, []).append(slot)
+                keep = set()
+                for k, slots in ex_link.items():
+                    b = max(cap - link_used.get((i, k), 0), 0)
+                    slots = sorted(slots)
+                    n_drop += len(slots) - min(len(slots), b)
+                    keep.update((slot, k) for slot in slots[:b])
+                live_by_slot = {
+                    slot: [k for k in ks if (slot, k) in keep]
+                    for slot, ks in live_by_slot.items()
+                }
+            for slot, live in sorted(live_by_slot.items()):
                 n_rpc += len(live)
                 if not live:
                     continue
+                for k in live:
+                    # responses occupy the link too: saturation (below) is
+                    # judged on the merged traffic, engine's trans | extra
+                    link_used[(i, k)] = link_used.get((i, k), 0) + 1
                 if slot in self.seen[i]:
                     _attribute(i, slot, live, first=False)
                     continue
                 n_new += 1
                 n_deliver += _arrive_new(i, slot, live)
+        self.events[EV.DROP_RPC] += n_drop
+        # congested links suppress the next heartbeat's IHAVE toward them
+        # (gossip is never retried — gossipsub.go:1757-1764, :1155-1160);
+        # sender-side view of each saturated inbound link, the engine's
+        # edge_gather(sat_recv) over the post-merge transmit set
+        self._gossip_suppress = set()
+        if cap > 0:
+            for i in range(n):
+                for k, s, r in self._edges(i):
+                    if link_used.get((i, k), 0) >= cap:
+                        self._gossip_suppress.add((s, r))
         self.events[EV.DELIVER_MESSAGE] += n_deliver
         if self.cfg.validation_delay_rounds > 0:
             self.events[EV.REJECT_MESSAGE] += n_reject_verdict
@@ -673,7 +729,8 @@ class OracleGossipSub:
             for t, m in self.mesh[i].items():
                 gcand = {
                     k for k in nbr_sub[t] - m
-                    if not scored or self._score(i, k) >= cfg.gossip_threshold
+                    if (not scored or self._score(i, k) >= cfg.gossip_threshold)
+                    and (i, k) not in self._gossip_suppress
                 }
                 target = max(cfg.Dlazy, int(cfg.gossip_factor * len(gcand)))
                 adv = {slot for slot in gwin if self.msgs[slot].topic == t}
@@ -688,6 +745,7 @@ class OracleGossipSub:
                     if self.subs.subscribed[s, t] and k not in f
                     and (not scored
                          or self._score(i, k) >= cfg.gossip_threshold)
+                    and (i, k) not in self._gossip_suppress
                 }
                 target = max(cfg.Dlazy, int(cfg.gossip_factor * len(gcand)))
                 adv = {slot for slot in gwin if self.msgs[slot].topic == t}
