@@ -21,7 +21,7 @@ import numpy as np
 
 
 def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "default",
-                heartbeat_every: int = 1):
+                heartbeat_every: int = 1, rounds_per_phase: int = 1):
     """Build (state, step) for a BENCH_CONFIG:
 
     default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
@@ -31,6 +31,11 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     sybil   — 20% sybil attackers (control-plane-only peers that never
               forward data), peer gater + deficit scoring enabled
               (BASELINE.json config #4; default BENCH_N 50k)
+
+    ``rounds_per_phase`` > 1 builds the multi-round phase engine
+    (models/gossipsub_phase.py): r delivery rounds per dispatch, control
+    once per phase — the reference's continuous-delivery / 1 Hz-heartbeat
+    timing shape (gossipsub.go:1278-1301).
     """
     import jax
     import jax.numpy as jnp
@@ -47,6 +52,9 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         GossipSubConfig,
         GossipSubState,
         make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+        make_gossipsub_phase_step,
     )
     from go_libp2p_pubsub_tpu.parallel import make_mesh, shard_state
     from go_libp2p_pubsub_tpu.state import Net
@@ -101,9 +109,15 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         fanout_slots=0 if config != "eth2" else cfg.fanout_slots,
     )
     st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=seed)
-    step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gater,
-                               adversary_no_forward=adversary,
-                               static_heartbeat=heartbeat_every > 1)
+    if rounds_per_phase > 1:
+        step = make_gossipsub_phase_step(
+            cfg, net, rounds_per_phase, score_params=sp, gater_params=gater,
+            adversary_no_forward=adversary,
+        )
+    else:
+        step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gater,
+                                   adversary_no_forward=adversary,
+                                   static_heartbeat=heartbeat_every > 1)
 
     n_dev = len(jax.devices())
     if n_dev > 1 and n_peers % n_dev == 0:
@@ -139,19 +153,27 @@ def main():
     default_n = 50_000 if config == "sybil" else 100_000
     n_peers = int(os.environ.get("BENCH_N", default_n))
     msg_slots = int(os.environ.get("BENCH_M", 64))
-    # BENCH_HB: rounds per heartbeat tick (the reference's 1 Hz heartbeat
-    # vs continuous delivery, gossipsub.go:1278-1301). The headline metric
-    # stays heartbeat_every=1 — a deliberately heavier tick (delivery +
-    # full maintenance every round); >1 measures the cond-gated heartbeat
-    # (BASELINE.md round-3 table)
-    heartbeat_every = int(os.environ.get("BENCH_HB", 1))
+    # BENCH_PHASE_R: rounds per phase — builds the multi-round phase
+    # engine (reference timing shape: continuous delivery, control every
+    # r rounds). BENCH_HB: rounds per heartbeat tick. The headline metric
+    # stays the per-round heartbeat_every=1 build — a deliberately heavier
+    # tick (delivery + full maintenance every round); the phase engine's
+    # rounds/s is the honest reference-cadence comparison (BASELINE.md
+    # round-4 table)
+    rounds_per_phase = int(os.environ.get("BENCH_PHASE_R", 1))
+    heartbeat_every = int(
+        os.environ.get("BENCH_HB", rounds_per_phase if rounds_per_phase > 1 else 1)
+    )
+    import math
+
+    group = math.lcm(heartbeat_every, rounds_per_phase)
     # long segments amortize the tunneled platform's per-call dispatch +
     # readback (~190 ms/segment observed): 100-round segments measured ~37%
     # below the device-limited rate, 1600-round segments within ~2% of it
     seg = int(os.environ.get("BENCH_ROUNDS", 1600))
-    # the static-heartbeat scan groups hb rounds per iteration; keep the
-    # executed round count and the rate denominator in sync
-    seg -= seg % heartbeat_every
+    # the fixed-schedule scan groups lcm(he, r) rounds per iteration; keep
+    # the executed round count and the rate denominator in sync
+    seg -= seg % group
     pubs_per_round = 4
 
     # always try the requested size; halve down to 10k as the OOM fallback
@@ -163,7 +185,8 @@ def main():
     for n in sizes:
         try:
             st, step, n_topics, honest = build_bench(
-                n, msg_slots, config=config, heartbeat_every=heartbeat_every
+                n, msg_slots, config=config, heartbeat_every=heartbeat_every,
+                rounds_per_phase=rounds_per_phase,
             )
             # publish schedule [R, P]
             rng = np.random.default_rng(0)
@@ -177,40 +200,25 @@ def main():
             pv = np.ones((seg, pubs_per_round), bool)
             po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
 
+            # unroll: adjacent iterations let XLA cancel the carry
+            # layout conversions the while-loop form pays per tick
+            # (profiled ~35% of device time); 4 rounds is the measured knee
             unroll = int(os.environ.get("BENCH_UNROLL", 4))
-            hb = heartbeat_every
+            from go_libp2p_pubsub_tpu.driver import make_scan
 
-            def run_seg(s, po=po_j, pt=pt_j, pv=pv_j):
-                if hb > 1:
-                    # static heartbeat cadence: group hb rounds per scan
-                    # iteration, only round 0 of each group traces the
-                    # heartbeat (no lax.cond state copies — make_
-                    # gossipsub_step(static_heartbeat=True) contract)
-                    g = po.shape[0] // hb
-                    gro = lambda a: a[: g * hb].reshape((g, hb) + a.shape[1:])
+            # the schedule-owning scan (driver.make_scan) drives all three
+            # builds: plain per-round, static-heartbeat, and phase
+            scan = make_scan(
+                step,
+                heartbeat_every=heartbeat_every,
+                rounds_per_phase=rounds_per_phase,
+                static_heartbeat=heartbeat_every > 1 or rounds_per_phase > 1,
+                unroll=max(1, unroll // group),
+            )
 
-                    def body(carry, xs):
-                        xo, xt, xv = xs
-                        for j in range(hb):
-                            carry = step(carry, xo[j], xt[j], xv[j],
-                                         do_heartbeat=(j == 0))
-                        return carry, None
+            def run_seg_j(s, po=po_j, pt=pt_j, pv=pv_j):
+                return scan(s, po, pt, pv)
 
-                    s, _ = jax.lax.scan(
-                        body, s, (gro(po), gro(pt), gro(pv)),
-                        unroll=max(1, unroll // hb),
-                    )
-                    return s
-
-                def body(carry, xs):
-                    return step(carry, *xs), None
-                # unroll: adjacent iterations let XLA cancel the carry
-                # layout conversions the while-loop form pays per tick
-                # (profiled ~35% of device time); 4 is the measured knee
-                s, _ = jax.lax.scan(body, s, (po, pt, pv), unroll=unroll)
-                return s
-
-            run_seg_j = jax.jit(run_seg, donate_argnums=0)
             st = run_seg_j(st)  # compile + warmup
             jax.block_until_ready(st)
             n_peers = n
@@ -241,12 +249,23 @@ def main():
     value = max(rates)
 
     tag = "" if config == "default" else f"_{config}"
+    if rounds_per_phase > 1:
+        # reference-cadence metric: delivery rounds/s with control every
+        # r rounds (heartbeat_every = r by default) — the honest
+        # comparison to the reference's continuous delivery + 1 Hz
+        # heartbeat shape; same 10k north-star denominator
+        metric = (
+            f"gossipsub_v1.1_delivery_rounds_per_sec_n{n_peers}{tag}"
+            f"_phase{rounds_per_phase}"
+        )
+    else:
+        metric = f"gossipsub_v1.1_heartbeat_ticks_per_sec_n{n_peers}{tag}"
     print(
         json.dumps(
             {
-                "metric": f"gossipsub_v1.1_heartbeat_ticks_per_sec_n{n_peers}{tag}",
+                "metric": metric,
                 "value": round(value, 2),
-                "unit": "ticks/s",
+                "unit": "ticks/s" if rounds_per_phase == 1 else "rounds/s",
                 "vs_baseline": round(value / 10_000.0, 4),
             }
         )

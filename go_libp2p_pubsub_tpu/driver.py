@@ -1,0 +1,134 @@
+"""Fixed-schedule round drivers: the scan owns the heartbeat cadence.
+
+`make_gossipsub_step(static_heartbeat=True)` and the phase engine
+(`make_gossipsub_phase_step`) both take a *static* ``do_heartbeat``
+argument — the jit-idiomatic form of the reference's 1 Hz heartbeat timer
+against continuous delivery (gossipsub.go:1278-1301): the cadence is
+known at trace time, so non-heartbeat rounds contain no heartbeat code at
+all (no lax.cond branch-materialization copies of the state).
+
+That made the cadence a *caller-owned contract*
+(``do_heartbeat == (tick % heartbeat_every == 0)``) with nothing
+enforcing it. This module is the enforcement: `make_scan` builds the
+scan, computes the schedule itself, and hands drivers a function that
+cannot desynchronize — callers supply only the publish schedule.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def heartbeat_schedule(heartbeat_every: int, rounds_per_phase: int) -> list[bool]:
+    """Static per-phase heartbeat flags over one schedule period.
+
+    Phase p covers ticks [p*r, (p+1)*r); it heartbeats iff that window
+    contains a tick ≡ 0 (mod heartbeat_every). The pattern repeats every
+    lcm(he, r) ticks = lcm(he, r)//r phases. With r == 1 this is the
+    per-round static-heartbeat contract (True on every he-th round)."""
+    he, r = int(heartbeat_every), int(rounds_per_phase)
+    assert he >= 1 and r >= 1
+    period = math.lcm(he, r) // r
+    return [
+        any(((p * r + i) % he) == 0 for i in range(r))
+        for p in range(period)
+    ]
+
+
+def make_scan(
+    step,
+    *,
+    heartbeat_every: int = 1,
+    rounds_per_phase: int = 1,
+    static_heartbeat: bool | None = None,
+    unroll: int = 1,
+    donate: bool = True,
+):
+    """Build ``run(state, pub_origin, pub_topic, pub_valid) -> state``
+    scanning a full publish schedule through ``step`` with the heartbeat
+    cadence owned here.
+
+    * per-round step, plain build (heartbeat decided on device or
+      heartbeat_every == 1): pub_* are [R, P]; plain scan.
+    * per-round step built with ``static_heartbeat=True``: pub_* are
+      [R, P]; rounds are grouped so ``do_heartbeat`` is True exactly on
+      ticks ≡ 0 (mod heartbeat_every).
+    * phase step (``rounds_per_phase`` = r > 1): pub_* are [R, P] and are
+      grouped into R//r phases of [r, P]; each phase's ``do_heartbeat``
+      is True iff its tick window contains a heartbeat tick.
+
+    Steps built with ``dynamic_peers=True`` take the liveness schedule as
+    ``run(st, po, pt, pv, up)`` with ``up`` a [R, N] bool plane; phase
+    steps consume one row per phase (the phase head's — transitions land
+    once per phase).
+
+    Contract: the state's tick at entry must be ≡ 0 (mod lcm(he, r)) —
+    any state freshly init'd (tick 0) or previously driven only through
+    this function qualifies. R must be a multiple of lcm(he, r).
+    """
+    he = int(heartbeat_every)
+    r = int(rounds_per_phase)
+    if static_heartbeat is None:
+        if r == 1 and he > 1:
+            # a per-round step at he > 1 is either a plain build (decides
+            # the heartbeat on device) or a static_heartbeat build (takes
+            # the do_heartbeat kwarg) — the two have different call
+            # signatures and nothing here can introspect a jitted wrapper
+            raise ValueError(
+                "make_scan: pass static_heartbeat=True/False explicitly "
+                "for a per-round step with heartbeat_every > 1 (True for "
+                "a make_gossipsub_step(static_heartbeat=True) build, "
+                "False for a plain build)"
+            )
+        static_heartbeat = r > 1
+    lcm = math.lcm(he, r)
+
+    if r == 1 and not static_heartbeat:
+        def run(st, po, pt, pv, up=None):
+            def body(carry, xs):
+                xo, xt, xv, xu = xs
+                args = (xo, xt, xv) if xu is None else (xo, xt, xv, xu)
+                return step(carry, *args), None
+            st, _ = jax.lax.scan(body, st, (po, pt, pv, up), unroll=unroll)
+            return st
+        return jax.jit(run, donate_argnums=0 if donate else ())
+
+    sched = heartbeat_schedule(he, r)
+    period = len(sched)
+
+    def run(st, po, pt, pv, up=None):
+        n_rounds = po.shape[0]
+        if n_rounds % lcm != 0:
+            raise ValueError(
+                f"schedule length {n_rounds} is not a multiple of "
+                f"lcm(heartbeat_every={he}, rounds_per_phase={r}) = {lcm}"
+            )
+        g = n_rounds // lcm
+        gro = lambda a: a.reshape((g, period, r) + a.shape[1:])
+        xo, xt, xv = gro(po), gro(pt), gro(pv)
+        xu = gro(up) if up is not None else None
+
+        def body(carry, xs):
+            bo, bt, bv, bu = xs
+            for j in range(period):
+                if r == 1:
+                    args = (bo[j, 0], bt[j, 0], bv[j, 0])
+                    if bu is not None:
+                        args += (bu[j, 0],)
+                else:
+                    args = (bo[j], bt[j], bv[j])
+                    if bu is not None:
+                        # a phase consumes ONE liveness plane (peer
+                        # transitions land once per phase, at its head) —
+                        # the first round's row of the [R, N] schedule
+                        args += (bu[j, 0],)
+                carry = step(carry, *args, do_heartbeat=sched[j])
+            return carry, None
+
+        st, _ = jax.lax.scan(body, st, (xo, xt, xv, xu),
+                             unroll=max(1, unroll))
+        return st
+    return jax.jit(run, donate_argnums=0 if donate else ())

@@ -217,6 +217,31 @@ def delivery_round(
         not_mine = not_mine & ~bitset.pack(msgs.wire_block)[None, :]
 
     trans = fwd_gathered & ~echo_words & edge_mask & ok_words & not_mine[:, None, :]
+    return finish_delivery(
+        net, msgs, dlv, trans, tick, forward_mask=forward_mask,
+        count_events=count_events, queue_cap=queue_cap,
+        val_delay_topic=val_delay_topic,
+    )
+
+
+def finish_delivery(
+    net: Net,
+    msgs: MsgTable,
+    dlv: Delivery,
+    trans: jax.Array,  # [N, K, W] u32: the round's (pre-cap) transmit tensor
+    tick: jax.Array,
+    forward_mask: jax.Array | None = None,
+    count_events: bool = True,
+    queue_cap: int = 0,
+    val_delay_topic: tuple | None = None,
+) -> tuple[Delivery, RoundInfo]:
+    """Cap + commit a computed transmit tensor: queue_cap backpressure,
+    seen-cache dedup, first-arrival attribution, validation pipeline,
+    forward-set update. Shared tail of the receiver-side `delivery_round`
+    above and the phase engine's sender-side transmit form
+    (gossipsub_phase.py) so the delivery semantics stay single-source."""
+    m = msgs.capacity
+    val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
 
     n_drop = jnp.int32(0)
     if queue_cap > 0:

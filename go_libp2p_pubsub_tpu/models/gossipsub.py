@@ -697,7 +697,7 @@ def update_fanout_on_publish(
     )
 
 
-def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
+def merge_extra_tx(net: Net, msgs, dlv, info, extra: jax.Array, tick,
                    count_events: bool = True, queue_cap: int = 0,
                    val_delay_topic: tuple | None = None):
     """Fold IWANT-response transmissions (not part of senders' fwd sets)
@@ -709,15 +709,15 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
     the mesh push already in `info.trans` — overflow is dropped and
     counted (IWANT responses are ordinary messages in the reference's
     per-peer writer queue, comm.go:139-170)."""
-    m = core.msgs.capacity
+    m = msgs.capacity
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
-    extra = extra & ~origin_msg_words(net, core.msgs)[:, None, :]
-    if core.msgs.wire_block is not None:
+    extra = extra & ~origin_msg_words(net, msgs)[:, None, :]
+    if msgs.wire_block is not None:
         # IWANT responses for oversized messages die at the wire too — but
         # only after the retransmission counter ticked (mcache.GetForPeer
         # counts the attempt before sendRPC drops it, mcache.go:66-80 ->
         # gossipsub.go:1126-1140), which iwant_responses already did
-        extra = extra & ~bitset.pack(core.msgs.wire_block)[None, None, :]
+        extra = extra & ~bitset.pack(msgs.wire_block)[None, None, :]
     if queue_cap > 0:
         used = bitset.popcount(info.trans, axis=-1)  # [N,K]
         budget = jnp.maximum(queue_cap - used, 0)
@@ -733,7 +733,7 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
     new_bits = bitset.unpack(new_words, m)
 
     fa_words = bitset.first_set_per_bit(extra, axis=1) & new_words[:, None, :]
-    valid_words = bitset.pack(core.msgs.valid)
+    valid_words = bitset.pack(msgs.valid)
 
     dlv = dlv.replace(
         have=dlv.have | new_words,
@@ -744,7 +744,7 @@ def merge_extra_tx(net: Net, core: SimState, dlv, info, extra: jax.Array, tick,
 
         dlv = dlv.replace(
             pending=pipeline_insert(
-                dlv.pending, new_words, core.msgs.topic, val_delay_topic
+                dlv.pending, new_words, msgs.topic, val_delay_topic
             )
         )
     else:
@@ -1134,6 +1134,346 @@ def apply_validation_throttle(dlv, info, cap: int, m: int, valid_words):
     return dlv, info, accepted, n_throttled
 
 
+class StepConsts:
+    """Static per-topology jit constants shared by the per-round step
+    (`make_gossipsub_step`) and the multi-round phase step
+    (`gossipsub_phase.make_gossipsub_phase_step`). Computed eagerly once
+    at build time."""
+
+    __slots__ = (
+        "score_params", "tp", "tpa", "window_rounds_t", "nbr_sub_const",
+        "flood_from", "i_am_floodsub", "nbr_sub_words", "sender_fwd_ok",
+    )
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+
+def prepare_step_consts(
+    cfg: GossipSubConfig,
+    net: Net,
+    score_params: PeerScoreParams | None,
+    heartbeat_interval: float,
+    gater_params,
+    sub_knowledge_holes: np.ndarray | None,
+    adversary_no_forward: np.ndarray | None,
+) -> StepConsts:
+    """Validate the configuration and build the static topology constants
+    (see the field comments inline — each maps a reference-side check)."""
+    if cfg.gater_enabled:
+        assert gater_params is not None
+        gater_params.validate()
+    if cfg.validation_delay_topic is not None and (
+        len(cfg.validation_delay_topic) != net.n_topics
+    ):
+        # the engine's per-message delay gather would silently clamp
+        # out-of-range topic ids; reject the mismatch at build time
+        raise ValueError(
+            f"validation_delay_topic has {len(cfg.validation_delay_topic)} "
+            f"entries for a {net.n_topics}-topic universe"
+        )
+    if cfg.score_enabled:
+        assert score_params is not None
+        score_params.validate()
+        tpa = TopicParamsArrays.build(score_params, net.n_topics, heartbeat_interval)
+    else:
+        score_params = PeerScoreParams(topics={}, skip_app_specific=True)
+        tpa = TopicParamsArrays.build(score_params, net.n_topics)
+    tp = tpa.gather(net.my_topics)
+    window_rounds_t = jnp.asarray(tpa.window_rounds)
+    # mesh candidates require a mesh-capable far end (gossipsub_feat.go
+    # GossipSubFeatureMesh; checked at gossipsub.go:1374,1692)
+    mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
+    nbr_sub_const = gather_nbr_subscribed(net) & mesh_capable[:, None, :]
+    # announce-visibility holes (pubsub.go:842-901): sub_knowledge_holes
+    # [N,K,T] marks (receiver i, edge k, topic t) triples whose SubOpts
+    # announcement has not yet arrived — the unannounced subscriber is
+    # invisible to mesh-candidate selection, gossip targeting, and fanout
+    # (the host's announce-retry model under queue_cap supplies the mask
+    # and recompiles as announcements land; api.Network._process_announces)
+    if sub_knowledge_holes is not None:
+        _holes = np.asarray(sub_knowledge_holes, bool)  # [N,K,T]
+        _mt = np.asarray(net.my_topics)                 # [N,S]
+        _hs = np.take_along_axis(
+            _holes, np.clip(_mt, 0, None)[:, None, :], axis=2
+        ).transpose(0, 2, 1)                            # [N,S,K]
+        _hs = _hs & (_mt >= 0)[:, :, None]
+        nbr_sub_const = nbr_sub_const & ~jnp.asarray(_hs)
+    # floodsub-semantics edges: the far end only speaks /floodsub/1.0.0
+    flood_from = (net.protocol[jnp.clip(net.nbr, 0)] == 0) & net.nbr_ok
+    i_am_floodsub = net.protocol == 0
+    # neighbors' full subscriptions as topic-bit words (for fanout checks)
+    subscribed_words_t = bitset.pack(net.subscribed)  # [N, Wt]
+    nbr_sub_words = jnp.where(
+        net.nbr_ok[:, :, None],
+        subscribed_words_t[jnp.clip(net.nbr, 0)],
+        jnp.uint32(0),
+    )  # [N,K,Wt]
+    if sub_knowledge_holes is not None:
+        # unannounced subscriptions are invisible to fanout selection too
+        nbr_sub_words = nbr_sub_words & ~bitset.pack(
+            jnp.asarray(np.asarray(sub_knowledge_holes, bool))
+        )
+    # adversary behavior vector: edge (j,k) carries data only if its sender
+    # nbr[j,k] forwards (static jit constant; None => all-honest fast path)
+    if adversary_no_forward is not None:
+        adv = jnp.asarray(adversary_no_forward, bool)
+        sender_fwd_ok = ~adv[jnp.clip(net.nbr, 0)] & net.nbr_ok  # [N,K]
+    else:
+        sender_fwd_ok = None
+    return StepConsts(
+        score_params=score_params, tp=tp, tpa=tpa,
+        window_rounds_t=window_rounds_t, nbr_sub_const=nbr_sub_const,
+        flood_from=flood_from, i_am_floodsub=i_am_floodsub,
+        nbr_sub_words=nbr_sub_words, sender_fwd_ok=sender_fwd_ok,
+    )
+
+
+def apply_peer_transitions(cfg: GossipSubConfig, net: Net, st: GossipSubState,
+                           up_next: jax.Array, tp: dict):
+    """Peer lifecycle transitions (dynamic_peers builds): disconnect
+    down/blacklisted peers with full dead-peer cleanup (handleDeadPeers
+    pubsub.go:648-689 + router RemovePeer gossipsub.go:545-562 + score
+    retention score.go:604-689). Returns (st, live-edge mask)."""
+    eff_next = up_next & ~st.blacklist
+    down_tr = st.up & ~eff_next
+    up_tr = ~st.up & eff_next
+    down_nbr = net.peer_gather(down_tr) & net.nbr_ok
+    # every edge touching a down peer dies (both directions; a
+    # restarting node comes back with fresh soft state)
+    down_edge = (down_nbr | down_tr[:, None]) & net.nbr_ok
+    de3 = down_edge[:, None, :]
+    score0 = st.score
+    if cfg.score_enabled:
+        # removePeer (score.go:604-637): first convert any standing
+        # P3 deficit on mesh edges of the departing peer into the
+        # one-shot sticky P3b penalty, then drop in-mesh status on
+        # every dead edge; only then delete stats — except retained
+        # (negative-score) neighbors, whose counters keep decaying
+        score0 = on_prune(score0, st.mesh & down_nbr[:, None, :], tp)
+        score0 = clear_mesh_status(score0, down_nbr)
+        clear_mask = (down_nbr & (st.scores >= 0)) | down_tr[:, None]
+        score0 = clear_edges(score0, clear_mask)
+    # a crashing node loses all soft state: seen-cache, forward set,
+    # receipt history (it will re-receive after restart), mcache
+    dlv0 = st.core.dlv.replace(
+        have=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.have),
+        fwd=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.fwd),
+        first_round=jnp.where(down_tr[:, None], -1, st.core.dlv.first_round),
+        fe_words=jnp.where(
+            down_tr[:, None, None], jnp.uint32(0), st.core.dlv.fe_words
+        ),
+        pending=jnp.where(
+            down_tr[:, None, None], jnp.uint32(0), st.core.dlv.pending
+        ) if st.core.dlv.pending is not None else None,
+    )
+    ev0 = st.core.events
+    if cfg.count_events:
+        ev0 = (
+            ev0
+            .at[EV.REMOVE_PEER].add(jnp.sum(down_tr.astype(jnp.int32)))
+            .at[EV.ADD_PEER].add(jnp.sum(up_tr.astype(jnp.int32)))
+        )
+    st = st.replace(
+        core=st.core.replace(dlv=dlv0, events=ev0),
+        mcache=jnp.where(down_tr[:, None, None], jnp.uint32(0), st.mcache),
+        mesh=st.mesh & ~de3,
+        fanout_peers=st.fanout_peers & ~de3,
+        graft_out=st.graft_out & ~de3,
+        prune_out=st.prune_out & ~de3,
+        ihave_out=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.ihave_out),
+        iwant_out=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.iwant_out),
+        served_lo=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.served_lo),
+        served_hi=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.served_hi),
+        peerhave=jnp.where(down_edge, 0, st.peerhave),
+        iasked=jnp.where(down_edge, 0, st.iasked),
+        promise_mid=jnp.where(down_edge, -1, st.promise_mid),
+        score=score0,
+        up=eff_next,
+    )
+    live = net.nbr_ok & st.up[:, None] & net.peer_gather(st.up)
+    return st, live
+
+
+def live_step_views(cfg: GossipSubConfig, net: Net, st: GossipSubState,
+                    live: jax.Array | None, consts: StepConsts):
+    """Apply the churn/PX edge-liveness mask to the static topology views.
+    Returns (net_l, nbr_sub_l, flood_from_l, nbr_sub_words_l)."""
+    if cfg.do_px:
+        # PX connection plane: dormant edges carry nothing until
+        # activated (edge_live kept symmetric, so one side suffices)
+        live = (net.nbr_ok if live is None else live) & st.edge_live
+    if live is not None:
+        net_l = net.replace(nbr_ok=live)
+        nbr_sub_l = consts.nbr_sub_const & live[:, None, :]
+        flood_from_l = consts.flood_from & live
+        nbr_sub_words_l = jnp.where(
+            live[:, :, None], consts.nbr_sub_words, jnp.uint32(0)
+        )
+    else:
+        net_l = net
+        nbr_sub_l = consts.nbr_sub_const
+        flood_from_l = consts.flood_from
+        nbr_sub_words_l = consts.nbr_sub_words
+    return net_l, nbr_sub_l, flood_from_l, nbr_sub_words_l
+
+
+def accept_gates(cfg: GossipSubConfig, net_l: Net, st: GossipSubState,
+                 gater_params, key, tick):
+    """AcceptFrom gate (gossipsub.go:583-594): direct always accepted;
+    graylisted dropped entirely; the gater's RED decision drops only
+    the message plane (AcceptControl, peer_gater.go:362).
+    Returns (acc_ok, acc_msg) [N,K] bool."""
+    if cfg.score_enabled:
+        acc_ok = (st.scores >= cfg.graylist_threshold) | net_l.direct
+    else:
+        acc_ok = net_l.nbr_ok
+    if cfg.gater_enabled:
+        # per-subsystem streams: double fold with a distinct tag so no
+        # round's stream collides with another subsystem's at any tick
+        # (heartbeat consumes fold_in(key, tick) directly)
+        gkey = jax.random.fold_in(jax.random.fold_in(key, tick), 0x6A7E)
+        acc_msg = acc_ok & (
+            gater_accept(st.gater, net_l, gater_params, cfg.gater_quiet_ticks,
+                         tick, gkey)
+            | net_l.direct
+        )
+    else:
+        acc_msg = acc_ok
+    return acc_ok, acc_msg
+
+
+def control_parts(cfg: GossipSubConfig, net: Net, st: GossipSubState,
+                  include_score: bool):
+    """The control-plane outboxes as named packed word tensors — the wire
+    format both exchange paths (XLA gather-merge and fused Pallas halo
+    kernel) transmit, kept single-source so the two cannot drift."""
+    named_parts = [
+        ("graft", edges.topic_pack(st.graft_out, net.my_topics, net.n_topics)),
+        ("prune", edges.topic_pack(st.prune_out, net.my_topics, net.n_topics)),
+        ("ihave", st.ihave_out),
+    ]
+    if cfg.do_px:
+        named_parts.append(
+            ("px", edges.topic_pack(st.prune_px_out, net.my_topics, net.n_topics))
+        )
+    if include_score and cfg.score_enabled:
+        named_parts.append(
+            ("score",
+             jax.lax.bitcast_convert_type(st.scores, jnp.uint32)[..., None])
+        )
+    return named_parts
+
+
+def control_unpack(cfg: GossipSubConfig, net: Net, net_l: Net, w_seg):
+    """Receiver-side split of the gathered control words (w_seg(i) = the
+    i-th part's edge view, ordered as control_parts lists them)."""
+    ok_slots = net_l.nbr_ok[:, None, :]
+    graft_in_raw = edges.topic_unpack(w_seg(0), net.my_topics) & ok_slots
+    prune_in_raw = edges.topic_unpack(w_seg(1), net.my_topics) & ok_slots
+    ihave_in_raw = w_seg(2)
+    px_in_raw = (
+        edges.topic_unpack(w_seg(3), net.my_topics) & ok_slots
+        if cfg.do_px else None
+    )
+    return graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw
+
+
+def control_exchange(cfg: GossipSubConfig, net: Net, net_l: Net,
+                     st: GossipSubState):
+    """Merged control-plane wire exchange (XLA path): every per-edge outbox
+    crosses the edge involution in as few gathers as the measured
+    gather-merge policy allows — the vectorized analogue of the reference
+    piggybacking all control into one RPC (gossipsub.go:1096-1141 sendRPC +
+    piggyback). Returns (graft_in_raw, prune_in_raw, ihave_in_raw,
+    px_in_raw, nbr_score_of_me)."""
+    named_parts = control_parts(cfg, net, st, include_score=True)
+    parts = [p for _, p in named_parts]
+    # Gather-merge policy (measured on the real chip, round 3).
+    # Each gathered tensor = one set of rolled halo permutes on
+    # the sharded mesh (test_collectives pins the total), so fewer
+    # gathers is better — UNLESS merging parts whose consumers
+    # want different layouts, which re-creates the monolithic
+    # relayout copy (1.2 ms/round when the f32-bitcast score
+    # column rode along in round 2; eth2 210 -> 168 when ihave
+    # merged with the 2-word topic parts). Measured policy: at
+    # wt == 1 ALL control words share one gather ([N,K,4] merged,
+    # 408 vs 400 ticks/s); at wt > 1 only the topic_unpack
+    # consumers (graft/prune/px) merge and ihave rides alone; the
+    # score plane ALWAYS rides alone. Grouping is by part name so
+    # the policy cannot drift from the parts list above.
+    ctrl_names = [nm for nm, _ in named_parts if nm != "score"]
+    wt_t = parts[0].shape[-1]
+    if wt_t == 1:
+        groups = [list(range(len(ctrl_names)))]
+    else:
+        topicish = [
+            i for i, nm in enumerate(ctrl_names) if nm != "ihave"
+        ]
+        groups = [topicish, [ctrl_names.index("ihave")]]
+    gathered = [None] * len(ctrl_names)
+    for grp in groups:
+        g = (
+            jnp.concatenate([parts[i] for i in grp], axis=-1)
+            if len(grp) > 1 else parts[grp[0]]
+        )
+        gg = jnp.where(
+            net_l.nbr_ok[:, :, None], net_l.edge_gather(g), jnp.uint32(0)
+        )
+        off = 0
+        for i in grp:
+            pw = parts[i].shape[-1]
+            gathered[i] = gg[..., off : off + pw]
+            off += pw
+    if cfg.score_enabled:
+        # the score plane always rides alone: its f32-bitcast
+        # consumer's layout caused the round-2 relayout copy
+        score_g = jnp.where(
+            net_l.nbr_ok[:, :, None],
+            net_l.edge_gather(dict(named_parts)["score"]),
+            jnp.uint32(0),
+        )
+        nbr_score_of_me = jnp.where(
+            net_l.nbr_ok,
+            jax.lax.bitcast_convert_type(score_g[..., 0], jnp.float32),
+            0.0,
+        )
+    else:
+        nbr_score_of_me = None
+    return (*control_unpack(cfg, net, net_l, lambda i: gathered[i]),
+            nbr_score_of_me)
+
+
+def px_connect(cfg: GossipSubConfig, net: Net, net_l: Net, st: GossipSubState,
+               px_ok, dynamic_peers: bool) -> jax.Array:
+    """PX connect (pxConnect gossipsub.go:861-941): a peer pruned with PX
+    activates its dormant provisioned edges to peers the pruner suggested —
+    the pruner's current mesh members for the topic (makePrune/getPeers
+    :1814-1872; here the union over the pruner's topics, one-round-stale by
+    the outbox model). The id match runs per prune-edge over the small K
+    axis. `net_l` is the live view (suggestions ride live edges); `net` the
+    static topology (dormant slots live there). Returns next edge_live."""
+    if not cfg.do_px:
+        return st.edge_live
+    sugg_ids = jnp.where(
+        jnp.any(st.mesh, axis=1) & net_l.nbr_ok, net_l.nbr, -1
+    )  # [N,C] each peer's suggestion list
+    sugg_g = net.peer_gather(sugg_ids)  # [N,K,C] per-edge pruner rows
+    dormant_avail = net.nbr_ok & ~st.edge_live & (net.nbr >= 0)
+    if dynamic_peers:
+        dormant_avail = dormant_avail & st.up[:, None] & net.peer_gather(st.up)
+    act = jnp.zeros_like(dormant_avail)
+    for kk in range(net.max_degree):
+        hit = jnp.any(
+            net.nbr[:, :, None] == sugg_g[:, kk, :][:, None, :], axis=-1
+        )  # [N,K']: my dormant-slot peer is among pruner kk's suggestions
+        act = act | (hit & px_ok[:, kk : kk + 1])
+    act = act & dormant_avail
+    act_sym = (act | net.edge_gather(act)) & net.nbr_ok
+    return st.edge_live | act_sym
+
+
 def make_gossipsub_step(
     cfg: GossipSubConfig,
     net: Net,
@@ -1180,68 +1520,18 @@ def make_gossipsub_step(
     their mesh neighbors, to be caught by the P3 mesh-delivery deficit and
     IWANT-promise (P7) machinery.
     """
-    if cfg.gater_enabled:
-        assert gater_params is not None
-        gater_params.validate()
-    if cfg.validation_delay_topic is not None and (
-        len(cfg.validation_delay_topic) != net.n_topics
-    ):
-        # the engine's per-message delay gather would silently clamp
-        # out-of-range topic ids; reject the mismatch at build time
-        raise ValueError(
-            f"validation_delay_topic has {len(cfg.validation_delay_topic)} "
-            f"entries for a {net.n_topics}-topic universe"
-        )
-    if cfg.score_enabled:
-        assert score_params is not None
-        score_params.validate()
-        tpa = TopicParamsArrays.build(score_params, net.n_topics, heartbeat_interval)
-    else:
-        score_params = PeerScoreParams(topics={}, skip_app_specific=True)
-        tpa = TopicParamsArrays.build(score_params, net.n_topics)
-    tp = tpa.gather(net.my_topics)
-    window_rounds_t = jnp.asarray(tpa.window_rounds)
-    # static per-topology constants (computed eagerly once; jit constants):
-    # mesh candidates require a mesh-capable far end (gossipsub_feat.go
-    # GossipSubFeatureMesh; checked at gossipsub.go:1374,1692)
-    mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
-    nbr_sub_const = gather_nbr_subscribed(net) & mesh_capable[:, None, :]
-    # announce-visibility holes (pubsub.go:842-901): sub_knowledge_holes
-    # [N,K,T] marks (receiver i, edge k, topic t) triples whose SubOpts
-    # announcement has not yet arrived — the unannounced subscriber is
-    # invisible to mesh-candidate selection, gossip targeting, and fanout
-    # (the host's announce-retry model under queue_cap supplies the mask
-    # and recompiles as announcements land; api.Network._process_announces)
-    if sub_knowledge_holes is not None:
-        _holes = np.asarray(sub_knowledge_holes, bool)  # [N,K,T]
-        _mt = np.asarray(net.my_topics)                 # [N,S]
-        _hs = np.take_along_axis(
-            _holes, np.clip(_mt, 0, None)[:, None, :], axis=2
-        ).transpose(0, 2, 1)                            # [N,S,K]
-        _hs = _hs & (_mt >= 0)[:, :, None]
-        nbr_sub_const = nbr_sub_const & ~jnp.asarray(_hs)
-    # floodsub-semantics edges: the far end only speaks /floodsub/1.0.0
-    flood_from = (net.protocol[jnp.clip(net.nbr, 0)] == 0) & net.nbr_ok
-    i_am_floodsub = net.protocol == 0
-    # neighbors' full subscriptions as topic-bit words (for fanout checks)
-    subscribed_words_t = bitset.pack(net.subscribed)  # [N, Wt]
-    nbr_sub_words = jnp.where(
-        net.nbr_ok[:, :, None],
-        subscribed_words_t[jnp.clip(net.nbr, 0)],
-        jnp.uint32(0),
-    )  # [N,K,Wt]
-    if sub_knowledge_holes is not None:
-        # unannounced subscriptions are invisible to fanout selection too
-        nbr_sub_words = nbr_sub_words & ~bitset.pack(
-            jnp.asarray(np.asarray(sub_knowledge_holes, bool))
-        )
-    # adversary behavior vector: edge (j,k) carries data only if its sender
-    # nbr[j,k] forwards (static jit constant; None => all-honest fast path)
-    if adversary_no_forward is not None:
-        adv = jnp.asarray(adversary_no_forward, bool)
-        sender_fwd_ok = ~adv[jnp.clip(net.nbr, 0)] & net.nbr_ok  # [N,K]
-    else:
-        sender_fwd_ok = None
+    consts = prepare_step_consts(
+        cfg, net, score_params, heartbeat_interval, gater_params,
+        sub_knowledge_holes, adversary_no_forward,
+    )
+    score_params = consts.score_params
+    tp = consts.tp
+    window_rounds_t = consts.window_rounds_t
+    nbr_sub_const = consts.nbr_sub_const
+    flood_from = consts.flood_from
+    i_am_floodsub = consts.i_am_floodsub
+    nbr_sub_words = consts.nbr_sub_words
+    sender_fwd_ok = consts.sender_fwd_ok
 
     # fused Pallas data plane (ops/fused_round.py): the whole edge-crossing
     # exchange + delivery as two kernels on banded topologies. Opt-in via
@@ -1275,102 +1565,19 @@ def make_gossipsub_step(
                do_heartbeat: bool = True) -> GossipSubState:
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
         if dynamic_peers:
-            eff_next = up_next & ~st.blacklist
-            down_tr = st.up & ~eff_next
-            up_tr = ~st.up & eff_next
-            down_nbr = net.peer_gather(down_tr) & net.nbr_ok
-            # every edge touching a down peer dies (both directions; a
-            # restarting node comes back with fresh soft state)
-            down_edge = (down_nbr | down_tr[:, None]) & net.nbr_ok
-            de3 = down_edge[:, None, :]
-            score0 = st.score
-            if cfg.score_enabled:
-                # removePeer (score.go:604-637): first convert any standing
-                # P3 deficit on mesh edges of the departing peer into the
-                # one-shot sticky P3b penalty, then drop in-mesh status on
-                # every dead edge; only then delete stats — except retained
-                # (negative-score) neighbors, whose counters keep decaying
-                score0 = on_prune(score0, st.mesh & down_nbr[:, None, :], tp)
-                score0 = clear_mesh_status(score0, down_nbr)
-                clear_mask = (down_nbr & (st.scores >= 0)) | down_tr[:, None]
-                score0 = clear_edges(score0, clear_mask)
-            # a crashing node loses all soft state: seen-cache, forward set,
-            # receipt history (it will re-receive after restart), mcache
-            dlv0 = st.core.dlv.replace(
-                have=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.have),
-                fwd=jnp.where(down_tr[:, None], jnp.uint32(0), st.core.dlv.fwd),
-                first_round=jnp.where(down_tr[:, None], -1, st.core.dlv.first_round),
-                fe_words=jnp.where(
-                    down_tr[:, None, None], jnp.uint32(0), st.core.dlv.fe_words
-                ),
-                pending=jnp.where(
-                    down_tr[:, None, None], jnp.uint32(0), st.core.dlv.pending
-                ) if st.core.dlv.pending is not None else None,
-            )
-            ev0 = st.core.events
-            if cfg.count_events:
-                ev0 = (
-                    ev0
-                    .at[EV.REMOVE_PEER].add(jnp.sum(down_tr.astype(jnp.int32)))
-                    .at[EV.ADD_PEER].add(jnp.sum(up_tr.astype(jnp.int32)))
-                )
-            st = st.replace(
-                core=st.core.replace(dlv=dlv0, events=ev0),
-                mcache=jnp.where(down_tr[:, None, None], jnp.uint32(0), st.mcache),
-                mesh=st.mesh & ~de3,
-                fanout_peers=st.fanout_peers & ~de3,
-                graft_out=st.graft_out & ~de3,
-                prune_out=st.prune_out & ~de3,
-                ihave_out=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.ihave_out),
-                iwant_out=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.iwant_out),
-                served_lo=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.served_lo),
-                served_hi=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.served_hi),
-                peerhave=jnp.where(down_edge, 0, st.peerhave),
-                iasked=jnp.where(down_edge, 0, st.iasked),
-                promise_mid=jnp.where(down_edge, -1, st.promise_mid),
-                score=score0,
-                up=eff_next,
-            )
-            live = net.nbr_ok & st.up[:, None] & net.peer_gather(st.up)
+            st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
         else:
             live = None
-        if cfg.do_px:
-            # PX connection plane: dormant edges carry nothing until
-            # activated (edge_live kept symmetric, so one side suffices)
-            live = (net.nbr_ok if live is None else live) & st.edge_live
-        if live is not None:
-            net_l = net.replace(nbr_ok=live)
-            nbr_sub_l = nbr_sub_const & live[:, None, :]
-            flood_from_l = flood_from & live
-            nbr_sub_words_l = jnp.where(live[:, :, None], nbr_sub_words, jnp.uint32(0))
-        else:
-            net_l = net
-            nbr_sub_l = nbr_sub_const
-            flood_from_l = flood_from
-            nbr_sub_words_l = nbr_sub_words
+        net_l, nbr_sub_l, flood_from_l, nbr_sub_words_l = live_step_views(
+            cfg, net, st, live, consts
+        )
 
         core = st.core
         tick = core.tick
         m = core.msgs.capacity
 
-        # AcceptFrom gate (gossipsub.go:583-594): direct always accepted;
-        # graylisted dropped entirely; the gater's RED decision drops only
-        # the message plane (AcceptControl, peer_gater.go:362)
-        if cfg.score_enabled:
-            acc_ok = (st.scores >= cfg.graylist_threshold) | net_l.direct
-        else:
-            acc_ok = net_l.nbr_ok
-        if cfg.gater_enabled:
-            # per-subsystem streams: double fold with a distinct tag so no
-            # round's stream collides with another subsystem's at any tick
-            # (heartbeat consumes fold_in(key, tick) directly)
-            gkey = jax.random.fold_in(jax.random.fold_in(core.key, tick), 0x6A7E)
-            acc_msg = acc_ok & (
-                gater_accept(st.gater, net_l, gater_params, cfg.gater_quiet_ticks, tick, gkey)
-                | net_l.direct
-            )
-        else:
-            acc_msg = acc_ok
+        acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
+                                       core.key, tick)
 
         # 0b. merged wire exchange: every per-edge outbox crosses the edge
         # involution in ONE gather. Separate gathers each pay a fixed
@@ -1381,25 +1588,13 @@ def make_gossipsub_step(
         # 1096-1141 sendRPC + piggyback). On banded topologies the gather
         # runs as a Pallas halo kernel (ops/fused_round.edge_exchange) and
         # the score plane rides as f32 instead of a bitcast word.
-        named_parts = [
-            ("graft", edges.topic_pack(st.graft_out, net.my_topics, net.n_topics)),
-            ("prune", edges.topic_pack(st.prune_out, net.my_topics, net.n_topics)),
-            ("ihave", st.ihave_out),
-        ]
-        if cfg.do_px:
-            named_parts.append(
-                ("px", edges.topic_pack(st.prune_px_out, net.my_topics, net.n_topics))
-            )
-        if not use_fused and cfg.score_enabled:
-            named_parts.append(
-                ("score",
-                 jax.lax.bitcast_convert_type(st.scores, jnp.uint32)[..., None])
-            )
-        parts = [p for _, p in named_parts]
-        sizes = np.cumsum([0] + [p.shape[-1] for p in parts])
         n_peers = net.n_peers
         k_dim = net.max_degree
         if use_fused:
+            # the score plane rides inside the kernel as f32, not a part
+            parts = [p for _, p in control_parts(cfg, net, st,
+                                                 include_score=False)]
+            sizes = np.cumsum([0] + [p.shape[-1] for p in parts])
             wc = int(sizes[-1])
             wire_flat, nbr_score_of_me = fr.edge_exchange(
                 jnp.concatenate(parts, axis=-1).reshape(n_peers, k_dim * wc),
@@ -1410,71 +1605,15 @@ def make_gossipsub_step(
                 interpret=fused_interp,
             )
             wire = wire_flat.reshape(n_peers, k_dim, wc)
+            if not cfg.score_enabled:
+                nbr_score_of_me = None
+            graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw = (
+                control_unpack(cfg, net, net_l,
+                               lambda i: wire[..., sizes[i] : sizes[i + 1]])
+            )
         else:
-            # Gather-merge policy (measured on the real chip, round 3).
-            # Each gathered tensor = one set of rolled halo permutes on
-            # the sharded mesh (test_collectives pins the total), so fewer
-            # gathers is better — UNLESS merging parts whose consumers
-            # want different layouts, which re-creates the monolithic
-            # relayout copy (1.2 ms/round when the f32-bitcast score
-            # column rode along in round 2; eth2 210 -> 168 when ihave
-            # merged with the 2-word topic parts). Measured policy: at
-            # wt == 1 ALL control words share one gather ([N,K,4] merged,
-            # 408 vs 400 ticks/s); at wt > 1 only the topic_unpack
-            # consumers (graft/prune/px) merge and ihave rides alone; the
-            # score plane ALWAYS rides alone. Grouping is by part name so
-            # the policy cannot drift from the parts list above.
-            ctrl_names = [nm for nm, _ in named_parts if nm != "score"]
-            wt_t = parts[0].shape[-1]
-            if wt_t == 1:
-                groups = [list(range(len(ctrl_names)))]
-            else:
-                topicish = [
-                    i for i, nm in enumerate(ctrl_names) if nm != "ihave"
-                ]
-                groups = [topicish, [ctrl_names.index("ihave")]]
-            gathered = [None] * len(ctrl_names)
-            for grp in groups:
-                g = (
-                    jnp.concatenate([parts[i] for i in grp], axis=-1)
-                    if len(grp) > 1 else parts[grp[0]]
-                )
-                gg = jnp.where(
-                    net_l.nbr_ok[:, :, None], net_l.edge_gather(g), jnp.uint32(0)
-                )
-                off = 0
-                for i in grp:
-                    pw = parts[i].shape[-1]
-                    gathered[i] = gg[..., off : off + pw]
-                    off += pw
-            wire = None
-            if cfg.score_enabled:
-                # the score plane always rides alone: its f32-bitcast
-                # consumer's layout caused the round-2 relayout copy
-                score_g = jnp.where(
-                    net_l.nbr_ok[:, :, None],
-                    net_l.edge_gather(dict(named_parts)["score"]),
-                    jnp.uint32(0),
-                )
-                nbr_score_of_me = jnp.where(
-                    net_l.nbr_ok,
-                    jax.lax.bitcast_convert_type(score_g[..., 0], jnp.float32),
-                    0.0,
-                )
-        if not cfg.score_enabled:
-            nbr_score_of_me = None
-        w_seg = (
-            (lambda i: wire[..., sizes[i] : sizes[i + 1]])
-            if wire is not None else (lambda i: gathered[i])
-        )
-        ok_slots = net_l.nbr_ok[:, None, :]
-        graft_in_raw = edges.topic_unpack(w_seg(0), net.my_topics) & ok_slots
-        prune_in_raw = edges.topic_unpack(w_seg(1), net.my_topics) & ok_slots
-        ihave_in_raw = w_seg(2)
-        px_in_raw = (
-            edges.topic_unpack(w_seg(3), net.my_topics) & ok_slots
-            if cfg.do_px else None
-        )
+            (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
+             nbr_score_of_me) = control_exchange(cfg, net, net_l, st)
 
         # 1. GRAFT/PRUNE ingest
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
@@ -1484,31 +1623,8 @@ def make_gossipsub_step(
         if cfg.count_events:
             events = events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
 
-        # 1b. PX connect (pxConnect gossipsub.go:861-941): a peer pruned
-        # with PX activates its dormant provisioned edges to peers the
-        # pruner suggested — the pruner's current mesh members for the
-        # topic (makePrune/getPeers :1814-1872; here the union over the
-        # pruner's topics, one-round-stale by the outbox model). The id
-        # match runs per prune-edge over the small K axis.
-        if cfg.do_px:
-            sugg_ids = jnp.where(
-                jnp.any(st.mesh, axis=1) & net_l.nbr_ok, net_l.nbr, -1
-            )  # [N,C] each peer's suggestion list
-            sugg_g = net.peer_gather(sugg_ids)  # [N,K,C] per-edge pruner rows
-            dormant_avail = net.nbr_ok & ~st.edge_live & (net.nbr >= 0)
-            if dynamic_peers:
-                dormant_avail = dormant_avail & st.up[:, None] & net.peer_gather(st.up)
-            act = jnp.zeros_like(dormant_avail)
-            for kk in range(net.max_degree):
-                hit = jnp.any(
-                    net.nbr[:, :, None] == sugg_g[:, kk, :][:, None, :], axis=-1
-                )  # [N,K']: my dormant-slot peer is among pruner kk's suggestions
-                act = act | (hit & px_ok[:, kk : kk + 1])
-            act = act & dormant_avail
-            act_sym = (act | net.edge_gather(act)) & net.nbr_ok
-            edge_live_next = st.edge_live | act_sym
-        else:
-            edge_live_next = st.edge_live
+        # 1b. PX connect (see px_connect)
+        edge_live_next = px_connect(cfg, net, net_l, st, px_ok, dynamic_peers)
 
         joined_words = joined_msg_words(net_l, core.msgs)
         slotw = slot_topic_words(net_l, core.msgs.topic)
@@ -1640,7 +1756,7 @@ def make_gossipsub_step(
                 val_delay_topic=cfg.validation_delay_topic,
             )
             iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
-            dlv, info = merge_extra_tx(net_l, core, dlv, info, iwant_resp, tick,
+            dlv, info = merge_extra_tx(net_l, core.msgs, dlv, info, iwant_resp, tick,
                                        count_events=cfg.count_events,
                                        queue_cap=cfg.queue_cap,
                                        val_delay_topic=cfg.validation_delay_topic)

@@ -1,0 +1,443 @@
+"""Multi-round phase engine: r delivery rounds per dispatch, control once.
+
+The reference runs *continuous* delivery (every RPC is forwarded the
+moment validation finishes) against a 1 Hz maintenance heartbeat
+(gossipsub.go:1278-1301) — message hops are ~ms apart while GRAFT/PRUNE/
+IHAVE/IWANT/score refresh happen ~1000x less often. The per-round step
+(`make_gossipsub_step`) compresses that to "control every hop": a
+deliberately *heavier* coupling than the reference's. This module builds
+the step the other way — faithful to the reference's timing shape — by
+batching ``rounds_per_phase`` (r) delivery rounds into ONE jitted phase:
+
+  * control plane (wire exchange, GRAFT/PRUNE ingest, PX connect, IHAVE
+    ingest, IWANT service, gater draw, score attribution, heartbeat) runs
+    once per phase — control latency becomes r rounds, the analogue of
+    the reference's heartbeat-granularity control;
+  * the data plane (publish allocation, mesh/fanout/flood push, seen-
+    cache dedup, first-arrival attribution, mcache insertion) runs every
+    sub-round, so per-hop delivery latency is UNCHANGED — the
+    propagation CDF keeps 1-round resolution via per-sub-round
+    ``first_round`` stamps.
+
+Perf shape: the sub-round body is computed *sender-side* — each sender
+composes what it pushes per edge (mesh/fanout carry & fwd & not-echo) so
+the whole data exchange crosses the edge involution in ONE [N,K,W]
+gather, vs three for the receiver-side form (fwd peer-gather + echo
+edge-gather + carry edge-gather). On the sharded mesh that is one set of
+halo permutes per sub-round. The two forms are boolean-algebra equal;
+tests/test_phase.py pins r=1 phase == per-round step bit-exactly.
+
+Score/gater attribution is folded over the phase in packed word planes:
+every (edge, msg) pair transmits at most once per phase (the fwd set is
+one-shot and IWANT retransmissions are capped per phase head), so OR
+accumulation preserves the exact transmission multiset. The P3 window
+gate is evaluated per sub-round against each arrival's own tick
+(on_deliveries(mesh_credit_words=...)), keeping window semantics at
+1-round resolution.
+
+Known deviations vs the per-round step, both bounded in PARITY.md:
+  * control actions (grafts taking effect, gossip emission, IWANT
+    service, score refresh, gater decisions) lag up to r-1 rounds — the
+    reference's own control lags up to a full heartbeat interval;
+  * deliveries of a message whose slot is recycled by a *later publish
+    in the same phase* earn no score/gater credit (per-round attribution
+    ran before each round's publishes; phase attribution runs at phase
+    end, after recycled columns are cleared). Slots live M/publish-rate
+    rounds, so this touches only messages already ~fully propagated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import bitset
+from ..score.engine import on_deliveries, slot_topic_words
+from ..score.gater import gater_on_round
+from ..state import Net, allocate_publishes
+from ..trace.events import EV
+from .common import RoundInfo, accumulate_round_events, finish_delivery
+from .gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    accept_gates,
+    apply_peer_transitions,
+    apply_validation_throttle,
+    control_exchange,
+    fanout_carry_words,
+    handle_graft_prune,
+    handle_ihave,
+    heartbeat,
+    iwant_responses,
+    joined_msg_words,
+    live_step_views,
+    merge_extra_tx,
+    origin_msg_words,
+    prepare_step_consts,
+    px_connect,
+    sender_carry_words,
+    update_fanout_on_publish,
+)
+
+
+def make_gossipsub_phase_step(
+    cfg: GossipSubConfig,
+    net: Net,
+    rounds_per_phase: int,
+    score_params=None,
+    heartbeat_interval: float = 1.0,
+    gater_params=None,
+    dynamic_peers: bool = False,
+    adversary_no_forward: np.ndarray | None = None,
+    sub_knowledge_holes: np.ndarray | None = None,
+):
+    """Build the jitted multi-round phase step.
+
+    phase_step(state, pub_origin[r,P], pub_topic[r,P], pub_valid[r,P],
+               [up_next], *, do_heartbeat) -> state     (tick advances by r)
+
+    ``do_heartbeat`` is a REQUIRED static bool: the caller owns the
+    heartbeat schedule (`driver.scan_rounds` does this for you — phases
+    whose tick window [t, t+r) contains a multiple of
+    ``cfg.heartbeat_every`` must pass True). The heartbeat runs at most
+    once per phase, at the phase tail, with the phase's last tick.
+
+    Publish batches land per sub-round: ``pub_*[i]`` is injected at tick
+    ``t + i`` exactly as the per-round step would, so workload timing and
+    the propagation CDF are directly comparable.
+
+    The fused Pallas data plane (PUBSUB_FUSED) is not applicable here —
+    the phase engine's sender-side form already collapses the exchange to
+    one gather per sub-round.
+    """
+    r = int(rounds_per_phase)
+    assert r >= 1
+    consts = prepare_step_consts(
+        cfg, net, score_params, heartbeat_interval, gater_params,
+        sub_knowledge_holes, adversary_no_forward,
+    )
+    tp = consts.tp
+    adv_self = (
+        jnp.asarray(adversary_no_forward, bool)
+        if adversary_no_forward is not None else None
+    )
+    n_peers, k_dim = net.nbr.shape
+    val_delay = cfg.validation_delay_rounds
+
+    def _phase(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
+               do_heartbeat: bool) -> GossipSubState:
+        # ---- control head (once per phase) ------------------------------
+        if dynamic_peers:
+            st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
+        else:
+            live = None
+        net_l, nbr_sub_l, flood_from_l, nbr_sub_words_l = live_step_views(
+            cfg, net, st, live, consts
+        )
+        core = st.core
+        tick0 = core.tick
+        m = core.msgs.capacity
+        w = bitset.n_words(m)
+
+        acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
+                                       core.key, tick0)
+        (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
+         nbr_score_of_me) = control_exchange(cfg, net, net_l, st)
+        st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
+            cfg, net_l, st, tp, acc_ok, graft_in_raw, prune_in_raw, px_in_raw
+        )
+        events = st.core.events
+        if cfg.count_events:
+            events = events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
+        edge_live_next = px_connect(cfg, net, net_l, st, px_ok, dynamic_peers)
+        st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me)
+        st2 = handle_ihave(cfg, net_l, st2, joined_msg_words(net_l, core.msgs),
+                           acc_ok, ihave_in_raw)
+        if consts.sender_fwd_ok is not None:
+            iwant_resp = jnp.where(
+                consts.sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0)
+            )
+        iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
+
+        # phase-fixed data-plane constants (the r-round control latency:
+        # mesh membership, scores, accept gates hold for the whole phase)
+        mesh2 = st2.mesh
+        if cfg.score_enabled:
+            send_score_ok = st.scores >= cfg.publish_threshold
+        else:
+            send_score_ok = net_l.nbr_ok
+        # floodsub-semantics edges, sender side: I speak only floodsub =>
+        # I push everything on every live edge (floodsub.go:76-100); my
+        # neighbor speaks only floodsub => I push everything I'd publish
+        # to it, score-gated (gossipsub.go:973-978)
+        flood_send = (
+            (consts.i_am_floodsub[:, None] & net_l.nbr_ok)
+            | (flood_from_l & send_score_ok)
+        )
+        recv_gate = net_l.nbr_ok & acc_msg  # [N,K] receiver-side edge gate
+        if cfg.flood_publish:
+            fp_ok = send_score_ok if cfg.score_enabled else net_l.nbr_ok
+
+        # ---- data loop: r delivery sub-rounds ---------------------------
+        msgs = core.msgs
+        dlv = core.dlv
+        mcache = st2.mcache
+        iwant_out = st2.iwant_out
+        served_lo, served_hi = st2.served_lo, st2.served_hi
+        promise_mid = st2.promise_mid
+        fanout_st = st2  # fanout_topic/peers/lastpub evolve per sub-round
+
+        zkw = jnp.zeros((n_peers, k_dim, w), jnp.uint32)
+        zw = jnp.zeros((n_peers, w), jnp.uint32)
+        trans_acc = zkw
+        new_acc = zw
+        recv_acc = zw
+        accepted_acc = zw
+        mcw_acc = zkw if cfg.score_enabled else None
+        if cfg.gater_enabled:
+            dup_acc = zkw
+            rejw_acc = zkw
+            ignw_acc = zkw
+            n_validated_acc = jnp.zeros((n_peers,), jnp.int32)
+            n_throttled_acc = jnp.zeros((n_peers,), jnp.int32)
+        if cfg.count_events:
+            cnt = dict(n_deliver=jnp.int32(0), n_reject=jnp.int32(0),
+                       n_duplicate=jnp.int32(0), n_rpc=jnp.int32(0),
+                       n_drop=jnp.int32(0))
+            n_pub = jnp.int32(0)
+        info = None
+
+        for i in range(r):
+            tick_i = tick0 + i
+            slotw = slot_topic_words(net_l, msgs.topic)
+            joined_w = joined_msg_words(net_l, msgs)
+            origin_w = origin_msg_words(net_l, msgs)
+
+            # sender-side transmit composition: ONE edge gather per
+            # sub-round carries the entire data plane
+            carry = sender_carry_words(mesh2, slotw)
+            if cfg.fanout_slots > 0:
+                carry = carry | fanout_carry_words(
+                    fanout_st.fanout_peers, fanout_st.fanout_topic, msgs.topic
+                )
+            carry = carry | jnp.where(
+                flood_send[:, :, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+            )
+            if cfg.flood_publish:
+                # v1.1 flood-publish, sender-side fold (== the receiver-side
+                # origin compare: nbr_score_of_me at the receiver IS the
+                # sender's score of that edge; gossipsub.go:957-963)
+                carry = carry | jnp.where(
+                    fp_ok[:, :, None], origin_w[:, None, :], jnp.uint32(0)
+                )
+            send = carry & dlv.fwd[:, None, :] & ~dlv.fe_words
+            if adv_self is not None:
+                # adversary behavior vector: marked peers run control but
+                # never transmit message data (sybilSquatter analogue)
+                send = jnp.where(
+                    adv_self[:, None, None], jnp.uint32(0), send
+                )
+            trans = jnp.where(
+                recv_gate[:, :, None], net_l.edge_gather(send), jnp.uint32(0)
+            )
+            nm = ~origin_w
+            if msgs.wire_block is not None:
+                nm = nm & ~bitset.pack(msgs.wire_block)[None, :]
+            trans = trans & (joined_w & nm)[:, None, :]
+
+            pre_have = dlv.have
+            dlv, info = finish_delivery(
+                net_l, msgs, dlv, trans, tick_i,
+                count_events=cfg.count_events, queue_cap=cfg.queue_cap,
+                val_delay_topic=cfg.validation_delay_topic,
+            )
+            if i == 0:
+                # IWANT responses computed at the phase head ride the first
+                # sub-round (r-round service latency, like the reference's
+                # heartbeat-batched gossip turnaround)
+                dlv, info = merge_extra_tx(
+                    net_l, msgs, dlv, info, iwant_resp, tick_i,
+                    count_events=cfg.count_events, queue_cap=cfg.queue_cap,
+                    val_delay_topic=cfg.validation_delay_topic,
+                )
+            valid_w_i = bitset.pack(msgs.valid)
+            if cfg.validation_capacity > 0:
+                dlv, info, accepted_new, n_thr = apply_validation_throttle(
+                    dlv, info, cfg.validation_capacity, m, valid_w_i
+                )
+            else:
+                accepted_new = info.new_words
+                n_thr = None
+
+            # ---- attribution accumulation (word planes; OR is exact —
+            # each (edge,msg) transmits at most once per phase) ----------
+            trans_acc = trans_acc | info.trans
+            new_acc = new_acc | info.new_words
+            recv_acc = recv_acc | info.recv_new_words
+            accepted_acc = accepted_acc | accepted_new
+            if cfg.score_enabled:
+                # P3 window gate at this arrival's own tick (score.go:
+                # 944-974 markDuplicateMessageDelivery window check)
+                msg_window = consts.window_rounds_t[jnp.clip(msgs.topic, 0)]
+                within_i = bitset.pack(
+                    (dlv.first_round >= 0)
+                    & ((tick_i - dlv.first_round) <= msg_window[None, :])
+                )
+                mcw_i = info.trans & within_i[:, None, :]
+                if val_delay > 0:
+                    # duplicates arriving while the message sits in the
+                    # validation pipeline (score.go:712-718); the fresh
+                    # first arrival earns credit at its verdict instead
+                    pend_post = bitset.word_or_reduce(dlv.pending, axis=1)
+                    fa_i = dlv.fe_words & info.recv_new_words[:, None, :]
+                    mcw_i = mcw_i | (
+                        info.trans & pend_post[:, None, :] & ~fa_i
+                    )
+                mcw_acc = mcw_acc | mcw_i
+            if cfg.gater_enabled:
+                dup_acc = dup_acc | (info.trans & pre_have[:, None, :])
+                ign_w_i = bitset.pack(msgs.ignored)
+                rejw_acc = rejw_acc | (
+                    info.trans & ~(valid_w_i | ign_w_i)[None, None, :]
+                )
+                ignw_acc = ignw_acc | (info.trans & ign_w_i[None, None, :])
+                n_validated_acc = n_validated_acc + bitset.popcount(
+                    accepted_new, axis=-1
+                )
+                if n_thr is not None:
+                    n_throttled_acc = n_throttled_acc + n_thr
+            if cfg.count_events:
+                for k in cnt:
+                    cnt[k] = cnt[k] + getattr(info, k)
+
+            # mcache insertion: validated receipts in joined topics
+            put = info.new_words & valid_w_i[None, :] & joined_w
+            mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | put)
+
+            # publishes for this sub-round + recycled-slot cleanup
+            msgs, dlv, _slots, is_pub, keep_w, pub_words = allocate_publishes(
+                msgs, dlv, tick_i, pub_origin[i], pub_topic[i], pub_valid[i]
+            )
+            mcache = mcache & keep_w[None, None, :]
+            mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)
+            iwant_out = iwant_out & keep_w[None, None, :]
+            served_lo = served_lo & keep_w[None, None, :]
+            served_hi = served_hi & keep_w[None, None, :]
+            promise_reused = bitset.bit_get(
+                (~keep_w)[None, None, :], promise_mid
+            )
+            promise_mid = jnp.where(
+                (promise_mid >= 0) & promise_reused, -1, promise_mid
+            )
+            # recycled slots drop out of the phase accumulators too — their
+            # columns now belong to a different message
+            kw3 = keep_w[None, None, :]
+            kw2 = keep_w[None, :]
+            trans_acc = trans_acc & kw3
+            new_acc = new_acc & kw2
+            recv_acc = recv_acc & kw2
+            accepted_acc = accepted_acc & kw2
+            if cfg.score_enabled:
+                mcw_acc = mcw_acc & kw3
+            if cfg.gater_enabled:
+                dup_acc = dup_acc & kw3
+                rejw_acc = rejw_acc & kw3
+                ignw_acc = ignw_acc & kw3
+            if cfg.count_events:
+                n_pub = n_pub + jnp.sum(is_pub.astype(jnp.int32))
+
+            if cfg.fanout_slots > 0:
+                fanout_st = update_fanout_on_publish(
+                    cfg, net_l,
+                    fanout_st.replace(core=fanout_st.core.replace(tick=tick_i)),
+                    pub_origin[i], pub_topic[i],
+                    jax.random.fold_in(
+                        jax.random.fold_in(core.key, tick_i), 0xFA40
+                    ),
+                    nbr_sub_words_l,
+                )
+
+        # ---- phase tail (once) ------------------------------------------
+        tick_last = tick0 + (r - 1)
+        score = st2.score
+        if cfg.score_enabled:
+            score = on_deliveries(
+                score, net_l, mesh2, tp, trans_acc, new_acc,
+                dlv.fe_words, dlv.first_round,
+                msgs.topic, msgs.valid, tick_last, consts.window_rounds_t,
+                msg_ignored=msgs.ignored,
+                slotw=slot_topic_words(net_l, msgs.topic),
+                recv_new_words=recv_acc,
+                mesh_credit_words=mcw_acc,
+            )
+        gater_state = st2.gater
+        if cfg.gater_enabled:
+            valid_w_end = bitset.pack(msgs.valid)
+            first_arrival = (
+                dlv.fe_words & accepted_acc[:, None, :]
+                & valid_w_end[None, None, :]
+            )
+            deliver_inc = bitset.popcount(first_arrival, axis=-1).astype(jnp.float32)
+            gater_state = gater_on_round(
+                gater_state, n_validated_acc, n_throttled_acc, deliver_inc,
+                bitset.popcount(dup_acc, axis=-1).astype(jnp.float32),
+                bitset.popcount(rejw_acc, axis=-1).astype(jnp.float32),
+                tick_last,
+                ignore_inc=bitset.popcount(ignw_acc, axis=-1).astype(jnp.float32),
+            )
+        if cfg.count_events:
+            info_sum = RoundInfo(
+                trans=trans_acc, new_words=new_acc,
+                new_bits=bitset.unpack(new_acc, m), recv_new_words=recv_acc,
+                **cnt,
+            )
+            events = accumulate_round_events(events, info_sum, n_pub)
+
+        st2 = st2.replace(
+            core=core.replace(msgs=msgs, dlv=dlv, events=events,
+                              tick=tick_last),
+            mcache=mcache,
+            ihave_out=jnp.zeros_like(st2.ihave_out),
+            iwant_out=iwant_out,
+            served_lo=served_lo,
+            served_hi=served_hi,
+            promise_mid=promise_mid,
+            graft_out=jnp.zeros_like(st2.graft_out),
+            prune_out=prune_resp,
+            prune_px_out=px_resp,
+            edge_live=edge_live_next,
+            score=score,
+            gater=gater_state,
+            fanout_topic=fanout_st.fanout_topic,
+            fanout_peers=fanout_st.fanout_peers,
+            fanout_lastpub=fanout_st.fanout_lastpub,
+        )
+
+        # congested links suppress this heartbeat's gossip toward them
+        # (queue_cap backpressure; last sub-round's saturation, like the
+        # per-round step's)
+        if cfg.queue_cap > 0:
+            sat_recv = bitset.popcount(info.trans, axis=-1) >= cfg.queue_cap
+            gossip_suppress = net_l.edge_gather(sat_recv) & net_l.nbr_ok
+            st2 = st2.replace(congested_in=sat_recv)
+        else:
+            gossip_suppress = None
+
+        if do_heartbeat:
+            st2 = heartbeat(
+                cfg, net_l, st2, tp, consts.score_params, nbr_sub_l,
+                gater_params, nbr_sub_words_l, present_ok=net.nbr_ok,
+                gossip_suppress=gossip_suppress,
+            )
+        return st2.replace(core=st2.core.replace(tick=tick0 + r))
+
+    if dynamic_peers:
+        def step(st, pub_origin, pub_topic, pub_valid, up_next, *, do_heartbeat):
+            return _phase(st, pub_origin, pub_topic, pub_valid, up_next,
+                          do_heartbeat)
+    else:
+        def step(st, pub_origin, pub_topic, pub_valid, *, do_heartbeat):
+            return _phase(st, pub_origin, pub_topic, pub_valid, None,
+                          do_heartbeat)
+    return jax.jit(step, donate_argnums=0, static_argnames=("do_heartbeat",))

@@ -335,6 +335,8 @@ def on_deliveries(
     msg_ignored: jax.Array | None = None,  # [M] bool — ValidationIgnore
     slotw: jax.Array | None = None,  # [N,S,W] — caller's slot_topic_words
                                      # for the same (pre-publish) msg table
+    mesh_credit_words: jax.Array | None = None,  # [N,K,W] caller-accumulated
+                                     # in-window mesh-credit base (phase mode)
 ) -> ScoreState:
     """Fold one delivery round into the counters.
 
@@ -384,12 +386,23 @@ def on_deliveries(
     # duplicates within the window; only on mesh edges, only valid msgs.
     # The window gate requires a set first_round (a message still awaiting
     # its verdict has first_round = -1, which must not pass the compare).
-    msg_window = window_rounds_t[t]  # [M]
-    within_w = bitset.pack(
-        (first_round >= 0) & ((tick - first_round) <= msg_window[None, :])
-    )  # [N,W]
-    mesh_credit = trans_words & valid_w[None, None, :] & within_w[:, None, :]
-    if pending_words is not None:
+    if mesh_credit_words is not None:
+        # phase mode (gossipsub_phase.py): the caller evaluated the window
+        # gate per sub-round against each arrival's own tick and OR-folded
+        # the result (exact — every (edge,msg) pair transmits at most once,
+        # so the fold loses no multiplicity); the pending-duplicate credit
+        # is likewise folded in per sub-round. Only the valid mask and the
+        # verdict-time first-arrival credit apply at phase end.
+        mesh_credit = (
+            (mesh_credit_words & valid_w[None, None, :]) | first_arrival
+        )
+    else:
+        msg_window = window_rounds_t[t]  # [M]
+        within_w = bitset.pack(
+            (first_round >= 0) & ((tick - first_round) <= msg_window[None, :])
+        )  # [N,W]
+        mesh_credit = trans_words & valid_w[None, None, :] & within_w[:, None, :]
+    if mesh_credit_words is None and pending_words is not None:
         # async pipeline (DeliverMessage's drec.peers loop, score.go:712-718):
         #  * the first-arrival edge earns its mesh credit at the verdict —
         #    its physical transmission happened rounds ago, so trans can't

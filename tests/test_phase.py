@@ -1,0 +1,297 @@
+"""Multi-round phase engine tests (models/gossipsub_phase.py).
+
+The load-bearing guarantee: a phase step with rounds_per_phase=1 is the
+per-round step — bit-exact across every state plane, for every feature
+combination. That pins the phase engine's sender-side transmit
+composition and accumulated attribution to the per-round semantics the
+oracle-parity suite already validates, so r>1 runs differ only by the
+designed r-round control latency.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerGaterParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.driver import heartbeat_schedule, make_scan
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import make_gossipsub_phase_step
+from go_libp2p_pubsub_tpu.ops import bitset
+from go_libp2p_pubsub_tpu.state import Net
+
+N, D, T, M, P = 48, 8, 3, 64, 4
+
+
+def score_params(n_topics=T):
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=-0.3,
+        mesh_message_deliveries_threshold=3.0,
+        mesh_message_deliveries_activation=6.0,
+        mesh_message_deliveries_window=2.0,
+    )
+    return PeerScoreParams(
+        topics={t: tp for t in range(n_topics)},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+
+
+def build(seed=0, he=1, n=N, **cfg_kw):
+    topo = graph.random_connect(n, D, seed=seed)
+    subs = graph.subscribe_random(n, n_topics=T, topics_per_peer=2, seed=seed)
+    net = Net.build(topo, subs)
+    sp = score_params()
+    params = dataclasses.replace(
+        GossipSubParams(), flood_publish=True, do_px=True
+    )
+    cfg = GossipSubConfig.build(
+        params, PeerScoreThresholds(), score_enabled=True,
+        heartbeat_every=he, **cfg_kw,
+    )
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=seed)
+    return net, cfg, sp, st
+
+
+def schedule(rounds, seed=0, n=N, codes=False):
+    """[R,P] publish schedule; with codes=True a few REJECT/IGNORE verdicts."""
+    rng = np.random.default_rng(seed)
+    po = rng.integers(0, n, size=(rounds, P)).astype(np.int32)
+    pt = rng.integers(0, T, size=(rounds, P)).astype(np.int32)
+    if codes:
+        pv = rng.choice([0, 0, 0, 0, 0, 1, 2], size=(rounds, P)).astype(np.int32)
+    else:
+        pv = np.ones((rounds, P), bool)
+    return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+
+def assert_states_equal(a, b, what=""):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, _ = jax.tree_util.tree_flatten(b)
+    paths = jax.tree_util.tree_flatten_with_path(a)[0]
+    for (path, xa), xb in zip(paths, lb):
+        if jnp.issubdtype(getattr(xa, "dtype", None), jax.dtypes.prng_key):
+            xa, xb = jax.random.key_data(xa), jax.random.key_data(xb)
+        xa, xb = np.asarray(xa), np.asarray(xb)
+        name = jax.tree_util.keystr(path)
+        if np.issubdtype(xa.dtype, np.floating):
+            np.testing.assert_allclose(
+                xa, xb, rtol=1e-5, atol=1e-6,
+                err_msg=f"{what}{name} differs",
+            )
+        else:
+            assert np.array_equal(xa, xb), f"{what}{name} differs"
+
+
+def run_per_round(step, st, po, pt, pv, he=1):
+    sched = heartbeat_schedule(he, 1)
+    for i in range(po.shape[0]):
+        if he == 1:
+            st = step(st, po[i], pt[i], pv[i])
+        else:
+            st = step(st, po[i], pt[i], pv[i],
+                      do_heartbeat=sched[i % len(sched)])
+    return st
+
+
+def run_phase(pstep, st, po, pt, pv, r, he=1):
+    sched = heartbeat_schedule(he, r)
+    g = po.shape[0] // r
+    gro = lambda a: a[: g * r].reshape((g, r) + a.shape[1:])
+    po, pt, pv = gro(po), gro(pt), gro(pv)
+    for p in range(g):
+        st = pstep(st, po[p], pt[p], pv[p], do_heartbeat=sched[p % len(sched)])
+    return st
+
+
+# ---------------------------------------------------------------------------
+# r=1 bit-exactness: the phase engine IS the per-round step
+
+
+def test_phase_r1_bitexact_rich_v11():
+    """score + flood_publish + PX + fanout + mixed verdicts, he=1.
+    16 rounds x 4 pubs < 64 slots => no recycling => every plane equal
+    including score counters."""
+    net, cfg, sp, st = build(seed=3)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp)
+    po, pt, pv = schedule(16, seed=3, codes=True)
+    sa = run_per_round(step, st, po, pt, pv)
+    net, cfg, sp, st2 = build(seed=3)
+    sb = run_phase(pstep, st2, po, pt, pv, 1)
+    assert_states_equal(sa, sb, "r1/")
+
+
+def test_phase_r1_bitexact_static_heartbeat_he2():
+    net, cfg, sp, st = build(seed=5, he=2)
+    step = make_gossipsub_step(cfg, net, score_params=sp, static_heartbeat=True)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp)
+    po, pt, pv = schedule(16, seed=5)
+    sa = run_per_round(step, st, po, pt, pv, he=2)
+    net, cfg, sp, st2 = build(seed=5, he=2)
+    sb = run_phase(pstep, st2, po, pt, pv, 1, he=2)
+    assert_states_equal(sa, sb, "r1-he2/")
+
+
+def test_phase_r1_bitexact_gater_throttle_queuecap_adversary():
+    gp = PeerGaterParams()
+    rng = np.random.default_rng(7)
+    adv = rng.random(N) < 0.2
+    net, cfg, sp, st = build(
+        seed=7, gater_params=gp, validation_capacity=3, queue_cap=3,
+    )
+    step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gp,
+                               adversary_no_forward=adv)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp,
+                                      gater_params=gp,
+                                      adversary_no_forward=adv)
+    po, pt, pv = schedule(14, seed=7, codes=True)
+    sa = run_per_round(step, st, po, pt, pv)
+    net, cfg, sp, st2 = build(seed=7, gater_params=gp, validation_capacity=3,
+                              queue_cap=3)
+    sb = run_phase(pstep, st2, po, pt, pv, 1)
+    assert_states_equal(sa, sb, "r1-gater/")
+
+
+def test_phase_r1_bitexact_validation_delay():
+    net, cfg, sp, st = build(
+        seed=11, validation_delay_rounds=2,
+        validation_delay_topic=(1, 2, 1),
+    )
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp)
+    po, pt, pv = schedule(14, seed=11, codes=True)
+    sa = run_per_round(step, st, po, pt, pv)
+    net, cfg, sp, st2 = build(seed=11, validation_delay_rounds=2,
+                              validation_delay_topic=(1, 2, 1))
+    sb = run_phase(pstep, st2, po, pt, pv, 1)
+    assert_states_equal(sa, sb, "r1-valdelay/")
+
+
+def test_phase_r1_bitexact_dynamic_peers():
+    net, cfg, sp, st = build(seed=13)
+    step = make_gossipsub_step(cfg, net, score_params=sp, dynamic_peers=True)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp,
+                                      dynamic_peers=True)
+    po, pt, pv = schedule(12, seed=13)
+    rng = np.random.default_rng(13)
+    ups = rng.random((12, N)) > 0.05  # ~5% churn per round
+    sa = st
+    for i in range(12):
+        sa = step(sa, po[i], pt[i], pv[i], jnp.asarray(ups[i]))
+    net, cfg, sp, sb = build(seed=13)
+    for i in range(12):
+        sb = pstep(sb, po[i : i + 1], pt[i : i + 1], pv[i : i + 1],
+                   jnp.asarray(ups[i]), do_heartbeat=True)
+    assert_states_equal(sa, sb, "r1-dyn/")
+
+
+# ---------------------------------------------------------------------------
+# r > 1: delivery still completes; control latency is the only difference
+
+
+@pytest.mark.parametrize("r", [2, 4])
+def test_phase_delivers_everywhere(r):
+    net, cfg, sp, st = build(seed=17)
+    pstep = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+    rounds = 24
+    po, pt, pv = schedule(rounds, seed=17)
+    # stop publishing after round 8 so the tail drains
+    po = po.at[8:].set(-1)
+    st = run_phase(pstep, st, po, pt, pv, r)
+    subs = np.asarray(net.subscribed)          # [N,T]
+    topic = np.asarray(st.core.msgs.topic)     # [M]
+    origin = np.asarray(st.core.msgs.origin)
+    have = np.asarray(bitset.unpack(st.core.dlv.have, M))  # [N,M]
+    fr_ = np.asarray(st.core.dlv.first_round)
+    for s in range(M):
+        if origin[s] < 0:
+            continue
+        subscribers = np.flatnonzero(subs[:, topic[s]])
+        cov = have[subscribers, s].mean() if len(subscribers) else 1.0
+        assert cov > 0.9, f"slot {s}: coverage {cov}"
+    # first_round stamps keep 1-round resolution: arrivals exist at
+    # non-phase-boundary ticks
+    arr = fr_[(fr_ >= 0) & (np.asarray(st.core.msgs.origin)[None, :] >= 0)]
+    assert (arr % r != 0).any()
+
+
+def test_phase_mesh_maintains():
+    net, cfg, sp, st = build(seed=19)
+    pstep = make_gossipsub_phase_step(cfg, net, 4, score_params=sp)
+    po, pt, pv = schedule(32, seed=19)
+    st = run_phase(pstep, st, po, pt, pv, 4)
+    deg = np.asarray(st.mesh.sum(axis=2))          # [N,S]
+    slot_live = np.asarray(net.my_topics) >= 0
+    assert (deg[slot_live] >= 1).all()
+    assert (deg[slot_live] <= cfg.Dhi).all()
+
+
+def test_phase_recycling_invariants():
+    """Slot recycling inside a phase: accumulators must drop recycled
+    columns (no cross-message attribution) and the engine must stay
+    consistent. 10 phases x 8 rounds x 4 pubs >> 64 slots."""
+    net, cfg, sp, st = build(seed=23)
+    pstep = make_gossipsub_phase_step(cfg, net, 8, score_params=sp)
+    po, pt, pv = schedule(80, seed=23)
+    st = run_phase(pstep, st, po, pt, pv, 8)
+    fr_ = np.asarray(st.core.dlv.first_round)
+    birth = np.asarray(st.core.msgs.birth)
+    have = np.asarray(bitset.unpack(st.core.dlv.have, M))
+    # no receipt can predate its message's birth (stale-bit leak check)
+    ok = (fr_ < 0) | (fr_ >= birth[None, :]) | ~have
+    assert ok.all()
+    # scores stay finite
+    assert np.isfinite(np.asarray(st.scores)).all()
+
+
+# ---------------------------------------------------------------------------
+# driver schedule + scan
+
+
+def test_heartbeat_schedule():
+    assert heartbeat_schedule(1, 1) == [True]
+    assert heartbeat_schedule(4, 1) == [True, False, False, False]
+    assert heartbeat_schedule(4, 2) == [True, False]
+    assert heartbeat_schedule(2, 4) == [True]
+    assert heartbeat_schedule(3, 2) == [True, True, False]
+
+
+def test_make_scan_matches_manual_phase():
+    net, cfg, sp, st = build(seed=29, he=2)
+    pstep = make_gossipsub_phase_step(cfg, net, 2, score_params=sp)
+    po, pt, pv = schedule(16, seed=29)
+    run = make_scan(pstep, heartbeat_every=2, rounds_per_phase=2, donate=False)
+    sa = run(st, po, pt, pv)
+    net, cfg, sp, st2 = build(seed=29, he=2)
+    sb = run_phase(pstep, st2, po, pt, pv, 2, he=2)
+    assert_states_equal(sa, sb, "scan/")
+
+
+def test_make_scan_per_round_static():
+    net, cfg, sp, st = build(seed=31, he=2)
+    step = make_gossipsub_step(cfg, net, score_params=sp, static_heartbeat=True)
+    po, pt, pv = schedule(12, seed=31)
+    run = make_scan(step, heartbeat_every=2, rounds_per_phase=1,
+                    static_heartbeat=True, donate=False)
+    sa = run(st, po, pt, pv)
+    net, cfg, sp, st2 = build(seed=31, he=2)
+    sb = run_per_round(step, st2, po, pt, pv, he=2)
+    assert_states_equal(sa, sb, "scan-r1/")
+    with pytest.raises(ValueError):
+        make_scan(step, heartbeat_every=2, rounds_per_phase=1)
