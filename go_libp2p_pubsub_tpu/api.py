@@ -890,9 +890,13 @@ class Network:
                 # and custom WithMessageIdFn) — run() records the slot ->
                 # message mapping before observe() runs
                 peer_id_of=lambda i: self.nodes[i].identity.peer_id,
+                # the defensive fallback is slot-unique: if it ever fired
+                # for two slots, a shared constant would alias their trace
+                # messageIDs and silently corrupt slot_mid-based
+                # DUPLICATE/DELIVER attribution downstream
                 mid_fn=lambda origin, sq, slot: (
                     self.msg_id_fn(self._slot_msg[slot])
-                    if slot in self._slot_msg else b"?unknown"
+                    if slot in self._slot_msg else b"?unknown-%d" % slot
                 ),
             )
             self._session.emit_init(snapshot(self.state))
@@ -927,7 +931,13 @@ class Network:
                 and msg.ByteSize() > self.max_message_size):
             # oversized: local delivery + mcache/IHAVE presence, but the
             # wire refuses it everywhere (WithMaxMessageSize pubsub.go:480;
-            # fragmentRPC single-message drop gossipsub.go:1126-1140)
+            # fragmentRPC single-message drop gossipsub.go:1126-1140).
+            # Boundary approximation: the reference gates on the full
+            # serialized RPC envelope (out.Size() < maxMessageSize), so a
+            # message within a few bytes of the limit can pass here yet be
+            # dropped by the reference once RPC framing overhead is added;
+            # the sim compares the bare Message size because its wire model
+            # never materializes per-RPC envelopes
             from .state import VERDICT_WIRE_BLOCK
 
             verdict = verdict | VERDICT_WIRE_BLOCK

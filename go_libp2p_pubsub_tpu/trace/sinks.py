@@ -385,11 +385,19 @@ def decode_remote_stream(data: bytes) -> list[trace_pb2.TraceEvent]:
             # close the segment (next member's records parse from a fresh
             # boundary) and resume at the next plausible member header near
             # the failure point (the next member's 10-byte gzip header sits
-            # at most a few bytes before where the error surfaced); a false
-            # magic inside compressed data just fails and re-scans
+            # at most a few bytes before where the error surfaced). A bare
+            # \x1f\x8b match inside compressed data is a false positive
+            # that would swallow the real header behind it, so candidates
+            # are screened: method byte must be 8 (deflate) and the three
+            # reserved FLG bits zero (RFC 1952 §2.3.1) — decode failure on
+            # a survivor still just fails and re-scans from past it
             segments[-1].extend(member)
             segments.append(bytearray())
             nxt = data.find(b"\x1f\x8b", max(pos + 2, fail_at - 18))
+            while nxt >= 0 and nxt + 3 < n and not (
+                data[nxt + 2] == 0x08 and (data[nxt + 3] & 0xE0) == 0
+            ):
+                nxt = data.find(b"\x1f\x8b", nxt + 2)
             if nxt < 0:
                 break
             pos = nxt
