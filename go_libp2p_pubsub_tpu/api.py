@@ -426,6 +426,7 @@ class Network:
         track_tags: bool = False,
         protocol_matcher: "ProtocolMatcher | None" = None,
         max_message_size: int | None = None,
+        trace_exact: bool = False,
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
@@ -435,6 +436,8 @@ class Network:
             )
         if queue_cap and router != "gossipsub":
             raise APIError("queue_cap is only modeled on the gossipsub router")
+        if trace_exact and router != "gossipsub":
+            raise APIError("trace_exact is only modeled on the gossipsub router")
         if px_connect:
             if router != "gossipsub":
                 raise APIError("px_connect requires the gossipsub router")
@@ -487,6 +490,10 @@ class Network:
         )
         self.seed = seed
         self.trace_sinks = trace_sinks
+        # exact per-event tracing (duplicates + control-only RPCs as
+        # individual events; trace.go:166-194, 341-414) — adds the
+        # per-round duplicate plane to the device state
+        self.trace_exact = trace_exact
         self.msg_id_fn = msg_id_fn or default_msg_id
         self.nodes: list[Node] = []
         self.topic_ids: dict[str, int] = {}
@@ -837,6 +844,7 @@ class Network:
                 gater_params=self.gater_params,
                 validation_delay_rounds=self.validation_delay_rounds,
                 queue_cap=self.queue_cap,
+                trace_exact=self.trace_exact,
             )
             self.state = GossipSubState.init(
                 self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed,
@@ -898,6 +906,7 @@ class Network:
                     self.msg_id_fn(self._slot_msg[slot])
                     if slot in self._slot_msg else b"?unknown-%d" % slot
                 ),
+                exact=self.trace_exact,
             )
             self._session.emit_init(snapshot(self.state))
 

@@ -142,6 +142,14 @@ class GossipSubConfig:
     # in the reference (WithEventTracer); False skips the event popcount
     # reductions — per-message delivery state stays exact
     count_events: bool = True
+    # exact per-event tracing support (trace.go:166-194, 341-414): the
+    # step additionally records this round's duplicate-arrival plane
+    # ([N,K,W] — arrivals beyond the first per (peer,msg)) in
+    # state.dup_trans so the drain can expand every DuplicateMessage and
+    # control-only RPC into an individual TraceEvent (drain.TraceSession
+    # exact mode) instead of aggregate counters. Off by default: costs one
+    # [N,K,W] store per round when on, zero when off
+    trace_exact: bool = False
     # thresholds (v1.1; zeros for v1.0)
     gossip_threshold: float = 0.0
     publish_threshold: float = 0.0
@@ -161,6 +169,7 @@ class GossipSubConfig:
         validation_delay_rounds: int = 0,
         validation_delay_topic: tuple | None = None,
         queue_cap: int = 0,
+        trace_exact: bool = False,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
@@ -200,6 +209,7 @@ class GossipSubConfig:
             validation_delay_rounds=validation_delay_rounds,
             validation_delay_topic=validation_delay_topic,
             queue_cap=queue_cap,
+            trace_exact=trace_exact,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if thresholds is not None:
@@ -279,6 +289,10 @@ class GossipSubState:
     # SubOpts announcement riding a full queue is dropped and retried
     # with jitter (pubsub.go:861-901)
     congested_in: jax.Array    # [N,K] bool
+    # exact-trace duplicate plane (cfg.trace_exact only, else None):
+    # this round's arrivals beyond the first per (peer, msg), per edge —
+    # the drain expands them to DuplicateMessage events (trace.go:186-194)
+    dup_trans: jax.Array | None = None  # [N,K,W] u32
 
     @classmethod
     def init(
@@ -341,6 +355,9 @@ class GossipSubState:
             else jnp.copy(net.nbr_ok),
             prune_px_out=jnp.zeros((n, s, k), bool),
             congested_in=jnp.zeros((n, k), bool),
+            dup_trans=(
+                jnp.zeros((n, k, w), jnp.uint32) if cfg.trace_exact else None
+            ),
         )
 
 
@@ -1761,6 +1778,17 @@ def make_gossipsub_step(
                                        queue_cap=cfg.queue_cap,
                                        val_delay_topic=cfg.validation_delay_topic)
 
+        # exact-trace duplicate plane: arrivals beyond the first per
+        # (peer, msg) — captured pre-throttle (throttled receipts are
+        # fresh, traced Reject, and the dup counter excludes them) and
+        # arrival-based under async validation (recv_new_words)
+        if cfg.trace_exact:
+            dup_plane = info.trans & ~(
+                dlv.fe_words & info.recv_new_words[:, None, :]
+            )
+        else:
+            dup_plane = None
+
         # 4b. validation front-end throttle (validation.go:230-244)
         valid_words_all = bitset.pack(core.msgs.valid)
         if cfg.validation_capacity > 0:
@@ -1874,6 +1902,12 @@ def make_gossipsub_step(
             edge_live=edge_live_next,
             score=score,
             gater=gater_state,
+            # NOT keep-masked: a dup bit always names the message the slot
+            # held when the arrival happened, so the drain attributes the
+            # plane against the PRE-publish slot->mid mapping — including
+            # arrivals in a message's own death round (which the device
+            # counter also counted)
+            dup_trans=dup_plane,
         )
 
         # congested links suppress next heartbeat's gossip toward them:

@@ -195,6 +195,7 @@ def make_gossipsub_phase_step(
         recv_acc = zw
         accepted_acc = zw
         mcw_acc = zkw if cfg.score_enabled else None
+        dup_trace_acc = zkw if cfg.trace_exact else None
         if cfg.gater_enabled:
             dup_acc = zkw
             rejw_acc = zkw
@@ -260,6 +261,18 @@ def make_gossipsub_phase_step(
                     net_l, msgs, dlv, info, iwant_resp, tick_i,
                     count_events=cfg.count_events, queue_cap=cfg.queue_cap,
                     val_delay_topic=cfg.validation_delay_topic,
+                )
+            if cfg.trace_exact:
+                # pre-throttle, like the per-round step: throttled receipts
+                # are fresh (traced Reject), not duplicates. Phase
+                # resolution coarsens timestamps; totals stay exact. NOT
+                # keep-masked below: a dup bit names the message its slot
+                # held at arrival, attributed against the phase-START
+                # slot->mid mapping (exact while slots outlive a phase —
+                # the M >> r*P sizing every tracing config satisfies)
+                dup_trace_acc = dup_trace_acc | (
+                    info.trans
+                    & ~(dlv.fe_words & info.recv_new_words[:, None, :])
                 )
             valid_w_i = bitset.pack(msgs.valid)
             if cfg.validation_capacity > 0:
@@ -412,6 +425,7 @@ def make_gossipsub_phase_step(
             fanout_topic=fanout_st.fanout_topic,
             fanout_peers=fanout_st.fanout_peers,
             fanout_lastpub=fanout_st.fanout_lastpub,
+            dup_trans=dup_trace_acc,
         )
 
         # congested links suppress this heartbeat's gossip toward them

@@ -14,11 +14,19 @@ Fidelity contract (documented, tested):
     SEND_RPC/RECV_RPC for every message-bearing first-delivery RPC,
     DROP_RPC from the outbound-queue model (overflow beyond `queue_cap`
     messages per edge per round — pubsub.go:240's 32-deep queue).
-  aggregate-only — duplicate arrivals and control-only RPCs are counted
-    exactly in the device event counters (state.core.events, see
-    events.py) but not expanded into per-event records; `counter_events()`
-    exposes those totals. Propagation analysis (latency CDFs — the north
-    star's tracestat parity) uses first-deliveries only, which are exact.
+  aggregate-only (default mode) — duplicate arrivals and control-only
+    RPCs are counted exactly in the device event counters
+    (state.core.events, see events.py) but not expanded into per-event
+    records; `counter_events()` exposes those totals. Propagation analysis
+    (latency CDFs — the north star's tracestat parity) uses
+    first-deliveries only, which are exact.
+  exact mode — a cfg.trace_exact build + TraceSession(exact=True) expands
+    duplicates and control-only RPCs into individual events too
+    (trace.go:166-194, 341-414), with RPC records grouped per
+    (sender, receiver, round) carrying full RPCMeta; the accounting test
+    (tests/test_trace_exact.py) reconciles every type against the device
+    counters in the style of trace_test.go's traceStats.check. Costs one
+    [N,K,W] plane store per round when on; nothing when off.
 
 Identity: peer ids are stable opaque bytes from the peer index; message ids
 follow DefaultMsgIdFn = from || seqno (pubsub.go:1041-1043) with per-origin
@@ -64,12 +72,23 @@ class Snapshot:
     events: np.ndarray       # [N_EVENTS]
     mesh: np.ndarray | None = None  # [N,S,K]
     up: np.ndarray | None = None    # [N]
+    # exact-trace extras (cfg.trace_exact states; None otherwise):
+    dup_trans: np.ndarray | None = None   # [N,K,W] u32 duplicate plane
+    # control outboxes pending their wire crossing NEXT round — a prev
+    # snapshot's outboxes are exactly the control the far end receives in
+    # the observed round (the engine's one-RTT outbox model)
+    graft_out: np.ndarray | None = None   # [N,S,K] bool
+    prune_out: np.ndarray | None = None   # [N,S,K] bool
+    ihave_out: np.ndarray | None = None   # [N,K,W] u32
+    iwant_out: np.ndarray | None = None   # [N,K,W] u32
+    edge_live: np.ndarray | None = None   # [N,K] bool
 
 
 def snapshot(st) -> Snapshot:
     """Pull a Snapshot from any router state: GossipSubState (exposes
     `.core`) or a bare SimState; mesh/up captured when present."""
     core = getattr(st, "core", st)
+    exact = getattr(st, "dup_trans", None) is not None
     return Snapshot(
         tick=int(core.tick),
         cursor=int(core.msgs.cursor),
@@ -82,6 +101,12 @@ def snapshot(st) -> Snapshot:
         events=np.asarray(core.events),
         mesh=np.asarray(st.mesh) if hasattr(st, "mesh") else None,
         up=np.asarray(st.up) if hasattr(st, "up") else None,
+        dup_trans=np.asarray(st.dup_trans) if exact else None,
+        graft_out=np.asarray(st.graft_out) if exact else None,
+        prune_out=np.asarray(st.prune_out) if exact else None,
+        ihave_out=np.asarray(st.ihave_out) if exact else None,
+        iwant_out=np.asarray(st.iwant_out) if exact else None,
+        edge_live=np.asarray(st.edge_live) if exact else None,
     )
 
 
@@ -98,10 +123,19 @@ class TraceSession:
     """
 
     def __init__(self, net, sinks, tick_ns: int = 10**9, queue_cap: int = 32,
-                 topic_name=None, peer_id_of=None, mid_fn=None):
+                 topic_name=None, peer_id_of=None, mid_fn=None,
+                 exact: bool = False):
+        """``exact=True`` (requires a cfg.trace_exact state so snapshots
+        carry the duplicate plane + control outboxes) expands every
+        DuplicateMessage and every control-only RPC into individual
+        TraceEvents, and groups RPC records per (sender, receiver, round)
+        with full RPCMeta — the reference's per-RPC granularity
+        (trace.go:166-194, 341-414). Default mode keeps those as exact
+        aggregate counters only (counter_events)."""
         self.sinks = list(sinks)
         self.tick_ns = tick_ns
         self.queue_cap = queue_cap
+        self.exact = exact
         self.topic_name = topic_name or (lambda t: f"topic-{t}")
         self.nbr = np.asarray(net.nbr)
         self.my_topics = np.asarray(net.my_topics)
@@ -169,6 +203,10 @@ class TraceSession:
                 pub_origin, pub_topic, pub_valid) -> None:
         tick = prev.tick  # the round just executed
         m = len(new.msg_topic)
+        # the slot->mid mapping as of the round's START: duplicate arrivals
+        # and control advertisements name the message a slot held BEFORE
+        # this round's publishes recycled it
+        prev_slot_mid = dict(self.slot_mid) if self.exact else None
 
         # publishes: replicate the allocator's slot assignment
         # (state.allocate_publishes: slots = cursor + running index, mod M)
@@ -196,9 +234,13 @@ class TraceSession:
         peers, mslots = np.nonzero(recv)
         # per-(sender,receiver) message counts for the queue model
         edge_count: dict[tuple[int, int], int] = {}
+        # exact mode: messages per directed edge, grouped into one RPC
+        edge_msgs: dict[tuple[int, int], list] = {}
         for p, s in zip(peers.tolist(), mslots.tolist()):
             sender = int(self.nbr[p, new.first_edge[p, s]])
-            mid = self.slot_mid.get(s, b"?unknown")
+            # slot-unique fallback: a shared constant would alias distinct
+            # messages in downstream messageID-keyed attribution
+            mid = self.slot_mid.get(s, b"?unknown-%d" % s)
             topic = self.topic_name(int(new.msg_topic[s]))
             if new.msg_valid[s]:
                 ev = self._base(trace_pb2.TraceEvent.DELIVER_MESSAGE, p, tick)
@@ -219,22 +261,29 @@ class TraceSession:
                 ev.rejectMessage.topic = topic
             self._emit(ev)
 
-            # the message-bearing RPC on this edge (exact for firsts)
-            sev = self._base(trace_pb2.TraceEvent.SEND_RPC, sender, tick)
-            sev.sendRPC.sendTo = self.peer_ids[p]
-            mm = sev.sendRPC.meta.messages.add()
-            mm.messageID = mid
-            mm.topic = topic
-            self._emit(sev)
-            rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, tick)
-            rev.recvRPC.receivedFrom = self.peer_ids[sender]
-            mm = rev.recvRPC.meta.messages.add()
-            mm.messageID = mid
-            mm.topic = topic
-            self._emit(rev)
+            if self.exact:
+                edge_msgs.setdefault((sender, p), []).append((mid, topic))
+            else:
+                # the message-bearing RPC on this edge (exact for firsts)
+                sev = self._base(trace_pb2.TraceEvent.SEND_RPC, sender, tick)
+                sev.sendRPC.sendTo = self.peer_ids[p]
+                mm = sev.sendRPC.meta.messages.add()
+                mm.messageID = mid
+                mm.topic = topic
+                self._emit(sev)
+                rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, tick)
+                rev.recvRPC.receivedFrom = self.peer_ids[sender]
+                mm = rev.recvRPC.meta.messages.add()
+                mm.messageID = mid
+                mm.topic = topic
+                self._emit(rev)
 
             key = (sender, p)
             edge_count[key] = edge_count.get(key, 0) + 1
+
+        if self.exact:
+            self._observe_exact(prev, new, tick, edge_msgs, edge_count,
+                                prev_slot_mid)
 
         # outbound-queue model: overflow beyond queue_cap msgs/edge/round
         # drops the RPC (comm.go:139-170 bounded chan; DropRPC trace at
@@ -277,6 +326,109 @@ class TraceSession:
                 ev = self._base(trace_pb2.TraceEvent.REMOVE_PEER, int(p), tick)
                 ev.removePeer.peerID = self.peer_ids[int(p)]
                 self._emit(ev)
+
+    # -- exact per-event expansion (trace.go:166-194, 341-414) -------------
+
+    def _observe_exact(self, prev: Snapshot, new: Snapshot, tick: int,
+                       edge_msgs, edge_count, prev_slot_mid) -> None:
+        """Expand duplicates + control into individual events and emit ONE
+        SendRPC/RecvRPC pair per (sender, receiver) with full RPCMeta —
+        the reference's per-RPC granularity. Duplicate/control content is
+        attributed against the round-START slot->mid mapping (a dup bit
+        names the message its slot held when the arrival happened, even in
+        the message's death round). Note the aggregate SEND_RPC/RECV_RPC
+        device counters stay (edge, message)-grained; in exact mode the
+        per-message total is instead the sum of RPCMeta.messages lengths
+        (tests/test_trace_exact.py pins both accountings)."""
+        nbr = self.nbr
+        m = len(new.msg_topic)
+
+        # duplicate arrivals (DuplicateMessage, trace.go:186-194)
+        if new.dup_trans is not None and new.dup_trans.any():
+            widx = np.arange(m) // 32
+            bpos = (np.arange(m) % 32).astype(np.uint32)
+            bits = ((new.dup_trans[:, :, widx] >> bpos) & 1).astype(bool)
+            for p, k, s in zip(*map(np.ndarray.tolist, np.nonzero(bits))):
+                sender = int(nbr[p, k])
+                mid = prev_slot_mid.get(s, b"?unknown-%d" % s)
+                topic = self.topic_name(int(prev.msg_topic[s]))
+                ev = self._base(trace_pb2.TraceEvent.DUPLICATE_MESSAGE, p, tick)
+                ev.duplicateMessage.messageID = mid
+                ev.duplicateMessage.receivedFrom = self.peer_ids[sender]
+                ev.duplicateMessage.topic = topic
+                self._emit(ev)
+                edge_msgs.setdefault((sender, p), []).append((mid, topic))
+                edge_count[(sender, p)] = edge_count.get((sender, p), 0) + 1
+
+        # control crossing this round: the PREV snapshot's outboxes (the
+        # engine's one-RTT outbox model — written last round, gathered by
+        # the far end this round)
+        live = (
+            prev.edge_live if prev.edge_live is not None else (nbr >= 0)
+        ) & (nbr >= 0)
+        if prev.up is not None:
+            live = live & prev.up[:, None] & prev.up[np.clip(nbr, 0, None)]
+        ctrl: dict[tuple[int, int], dict] = {}
+
+        def centry(s, p):
+            return ctrl.setdefault(
+                (s, p), {"graft": [], "prune": [], "ihave": {}, "iwant": []}
+            )
+
+        for name, outbox in (("graft", prev.graft_out),
+                             ("prune", prev.prune_out)):
+            if outbox is None or not outbox.any():
+                continue
+            for p, s_, k in zip(*map(np.ndarray.tolist, np.nonzero(outbox))):
+                if not live[p, k]:
+                    continue
+                centry(p, int(nbr[p, k]))[name].append(
+                    self.topic_name(int(self.my_topics[p, s_]))
+                )
+        widx = np.arange(m) // 32
+        bpos = (np.arange(m) % 32).astype(np.uint32)
+        for name, outbox in (("ihave", prev.ihave_out),
+                             ("iwant", prev.iwant_out)):
+            if outbox is None or not outbox.any():
+                continue
+            has = (outbox != 0).any(axis=-1) & live
+            for p, k in zip(*map(np.ndarray.tolist, np.nonzero(has))):
+                entry = centry(p, int(nbr[p, k]))
+                for s in np.nonzero((outbox[p, k, widx] >> bpos) & 1)[0].tolist():
+                    mid = prev_slot_mid.get(s, b"?unknown-%d" % s)
+                    if name == "iwant":
+                        entry["iwant"].append(mid)
+                    else:
+                        t = self.topic_name(int(prev.msg_topic[s]))
+                        entry["ihave"].setdefault(t, []).append(mid)
+
+        # one RPC record pair per directed edge with any content
+        for s, p in sorted(set(edge_msgs) | set(ctrl)):
+            meta = trace_pb2.TraceEvent.RPCMeta()
+            for mid, topic in edge_msgs.get((s, p), ()):
+                mm = meta.messages.add()
+                mm.messageID = mid
+                mm.topic = topic
+            c = ctrl.get((s, p))
+            if c is not None:
+                for t, mids in c["ihave"].items():
+                    ih = meta.control.ihave.add()
+                    ih.topic = t
+                    ih.messageIDs.extend(mids)
+                if c["iwant"]:
+                    meta.control.iwant.add().messageIDs.extend(c["iwant"])
+                for t in c["graft"]:
+                    meta.control.graft.add().topic = t
+                for t in c["prune"]:
+                    meta.control.prune.add().topic = t
+            sev = self._base(trace_pb2.TraceEvent.SEND_RPC, s, tick)
+            sev.sendRPC.sendTo = self.peer_ids[p]
+            sev.sendRPC.meta.CopyFrom(meta)
+            self._emit(sev)
+            rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, tick)
+            rev.recvRPC.receivedFrom = self.peer_ids[s]
+            rev.recvRPC.meta.CopyFrom(meta)
+            self._emit(rev)
 
     # -- aggregates --------------------------------------------------------
 

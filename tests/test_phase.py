@@ -295,3 +295,30 @@ def test_make_scan_per_round_static():
     assert_states_equal(sa, sb, "scan-r1/")
     with pytest.raises(ValueError):
         make_scan(step, heartbeat_every=2, rounds_per_phase=1)
+
+
+def test_phase_trace_exact_dup_plane_reconciles():
+    """cfg.trace_exact under the phase engine: the phase-end duplicate
+    plane's popcount equals the device duplicate-counter delta — including
+    with the validation throttle binding (throttled receipts are fresh
+    Rejects, never duplicates)."""
+    from go_libp2p_pubsub_tpu.ops import bitset as bs
+    from go_libp2p_pubsub_tpu.trace.events import EV
+
+    net, cfg, sp, st = build(seed=37, validation_capacity=3)
+    cfg = dataclasses.replace(cfg, trace_exact=True, count_events=True)
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=37)
+    pstep = make_gossipsub_phase_step(cfg, net, 4, score_params=sp)
+    po, pt, pv = schedule(16, seed=37)
+    sched = heartbeat_schedule(1, 4)
+    g = po.shape[0] // 4
+    gro = lambda a: a.reshape((g, 4) + a.shape[1:])
+    po, pt, pv = gro(po), gro(pt), gro(pv)
+    prev_dup = 0
+    for p in range(g):
+        st = pstep(st, po[p], pt[p], pv[p], do_heartbeat=sched[p % len(sched)])
+        dup_now = int(st.core.events[EV.DUPLICATE_MESSAGE])
+        plane = int(np.asarray(bs.popcount(st.dup_trans, axis=None)).sum())
+        assert plane == dup_now - prev_dup, (p, plane, dup_now - prev_dup)
+        prev_dup = dup_now
+    assert prev_dup > 0

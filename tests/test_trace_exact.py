@@ -1,0 +1,217 @@
+"""Exact per-event trace mode (cfg.trace_exact + TraceSession(exact=True)):
+full event accounting in the style of the reference's traceStats.check
+(trace_test.go:26-195) — every DuplicateMessage and every control-only RPC
+as an individual event, totals reconciled against the device counters.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import GossipSubParams, PeerScoreParams, \
+    PeerScoreThresholds, TopicScoreParams
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.pb import trace_pb2
+from go_libp2p_pubsub_tpu.state import Net
+from go_libp2p_pubsub_tpu.trace import drain
+from go_libp2p_pubsub_tpu.trace.events import EV
+
+T = trace_pb2.TraceEvent
+
+
+class MemSink:
+    def __init__(self):
+        self.events = []
+
+    def trace(self, ev):
+        self.events.append(type(ev).FromString(ev.SerializeToString()))
+
+    def close(self):
+        pass
+
+
+def run_traced(n=32, d=6, n_topics=2, m=32, rounds=14, seed=3, exact=True):
+    topo = graph.random_connect(n, d, seed=seed)
+    subs = graph.subscribe_random(n, n_topics=n_topics, topics_per_peer=2,
+                                  seed=seed)
+    net = Net.build(topo, subs)
+    cfg = dataclasses.replace(GossipSubConfig.build(), trace_exact=exact)
+    st = GossipSubState.init(net, m, cfg, seed=seed)
+    step = make_gossipsub_step(cfg, net)
+    sink = MemSink()
+    sess = drain.TraceSession(net, [sink], queue_cap=0, exact=exact)
+    sess.emit_init(drain.snapshot(st))
+    rng = np.random.default_rng(seed)
+    import jax.numpy as jnp
+
+    n_pub = 0
+    for i in range(rounds):
+        p = 3
+        po = rng.integers(0, n, size=p).astype(np.int32)
+        pt = rng.integers(0, n_topics, size=p).astype(np.int32)
+        pv = np.ones(p, bool)
+        if i >= rounds - 4:
+            po[:] = -1  # drain tail
+        else:
+            n_pub += p
+        prev = drain.snapshot(st)
+        st = step(st, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+        sess.observe(prev, drain.snapshot(st), po, pt, pv)
+    final = drain.snapshot(st)
+    sess.close(final)
+    return sink.events, final, n_pub
+
+
+def by_type(events):
+    out = {}
+    for ev in events:
+        out.setdefault(ev.type, []).append(ev)
+    return out
+
+
+def test_exact_accounting_vs_device_counters():
+    """The reference's traceStats.check: per-type event totals reconcile —
+    here against the exact device counters, which the per-event stream
+    must now match rather than summarize."""
+    events, final, n_pub = run_traced()
+    ev = by_type(events)
+    counters = drain.TraceSession.counter_events(final)
+
+    assert len(ev.get(T.PUBLISH_MESSAGE, [])) == n_pub
+    assert len(ev.get(T.PUBLISH_MESSAGE, [])) == counters["PUBLISH_MESSAGE"]
+    assert len(ev.get(T.DELIVER_MESSAGE, [])) == counters["DELIVER_MESSAGE"]
+    assert len(ev.get(T.REJECT_MESSAGE, [])) == counters["REJECT_MESSAGE"]
+    # the new guarantee: duplicates are individual events, total exact
+    assert len(ev.get(T.DUPLICATE_MESSAGE, [])) == counters["DUPLICATE_MESSAGE"]
+    assert counters["DUPLICATE_MESSAGE"] > 0  # workload actually has dups
+
+    # RPC records are per-(sender,receiver,round); the device counters are
+    # per-(edge,message): the message-entry sum must equal the counter
+    sent_msgs = sum(len(e.sendRPC.meta.messages)
+                    for e in ev.get(T.SEND_RPC, []))
+    recv_msgs = sum(len(e.recvRPC.meta.messages)
+                    for e in ev.get(T.RECV_RPC, []))
+    assert sent_msgs == counters["SEND_RPC"]
+    assert recv_msgs == counters["RECV_RPC"]
+    assert len(ev.get(T.SEND_RPC, [])) == len(ev.get(T.RECV_RPC, []))
+
+    # mesh-diff GRAFT/PRUNE events match the device's ingest+heartbeat
+    # accounting (both count every mesh-set mutation)
+    assert len(ev.get(T.GRAFT, [])) == counters["GRAFT"]
+    assert len(ev.get(T.PRUNE, [])) == counters["PRUNE"]
+
+
+def test_every_arrival_is_deliver_dup_or_reject():
+    """Conservation per message id: each transmitted instance lands as
+    exactly one of DELIVER / DUPLICATE / REJECT at its receiver (arrival
+    accounting over RecvRPC metas)."""
+    events, final, _ = run_traced()
+    ev = by_type(events)
+    arrivals = {}
+    for e in ev.get(T.RECV_RPC, []):
+        for mm in e.recvRPC.meta.messages:
+            arrivals[mm.messageID] = arrivals.get(mm.messageID, 0) + 1
+    outcomes = {}
+    for e in ev.get(T.DELIVER_MESSAGE, []):
+        mid = e.deliverMessage.messageID
+        outcomes[mid] = outcomes.get(mid, 0) + 1
+    for e in ev.get(T.DUPLICATE_MESSAGE, []):
+        mid = e.duplicateMessage.messageID
+        outcomes[mid] = outcomes.get(mid, 0) + 1
+    for e in ev.get(T.REJECT_MESSAGE, []):
+        mid = e.rejectMessage.messageID
+        outcomes[mid] = outcomes.get(mid, 0) + 1
+    assert arrivals == outcomes
+
+    # and every delivered/duplicated id was actually published
+    published = {e.publishMessage.messageID
+                 for e in ev.get(T.PUBLISH_MESSAGE, [])}
+    assert set(arrivals) <= published
+
+
+def test_control_rpcs_expand():
+    """Heartbeat gossip + mesh control cross as RPC records with full
+    RPCMeta: IHAVE advertisements name real published ids, IWANT asks are
+    a subset of what was advertised on that edge, GRAFT events have a
+    matching control entry crossing the following round."""
+    events, final, _ = run_traced()
+    ev = by_type(events)
+    published = {e.publishMessage.messageID
+                 for e in ev.get(T.PUBLISH_MESSAGE, [])}
+
+    ihave_edges = {}  # (sender, receiver) -> advertised mids
+    n_ihave = n_iwant = n_graft_meta = 0
+    for e in ev.get(T.SEND_RPC, []):
+        key = (e.peerID, e.sendRPC.sendTo)
+        for ih in e.sendRPC.meta.control.ihave:
+            n_ihave += 1
+            assert set(ih.messageIDs) <= published
+            ihave_edges.setdefault(key, set()).update(ih.messageIDs)
+        for iw in e.sendRPC.meta.control.iwant:
+            n_iwant += 1
+            # asks ride the reverse edge: I ask the peer who advertised
+            adv = ihave_edges.get((e.sendRPC.sendTo, e.peerID), set())
+            assert set(iw.messageIDs) <= adv
+        n_graft_meta += len(e.sendRPC.meta.control.graft)
+    assert n_ihave > 0 and n_iwant > 0 and n_graft_meta > 0
+
+    # initiator-side GRAFT events are followed by a graft control entry
+    # from that peer (the outbox crosses one round later)
+    graft_events = {(e.peerID, e.graft.peerID, e.graft.topic)
+                    for e in ev.get(T.GRAFT, [])}
+    graft_meta = set()
+    for e in ev.get(T.SEND_RPC, []):
+        for g in e.sendRPC.meta.control.graft:
+            graft_meta.add((e.peerID, e.sendRPC.sendTo, g.topic))
+    # every control graft corresponds to a mesh addition at the sender
+    assert graft_meta <= graft_events
+
+
+def test_exact_off_is_free():
+    """trace_exact=False keeps the state plane absent (zero hot-path cost)
+    and the session in aggregate mode."""
+    events, final, _ = run_traced(exact=False, rounds=8)
+    assert final.dup_trans is None
+    ev = by_type(events)
+    assert T.DUPLICATE_MESSAGE not in ev
+    counters = drain.TraceSession.counter_events(final)
+    assert counters["DUPLICATE_MESSAGE"] > 0  # still counted exactly
+
+
+def test_api_network_exact_trace():
+    """Exact mode through the L6 API: real ed25519 peer ids and real
+    message ids on duplicate + control events."""
+    import jax
+
+    from go_libp2p_pubsub_tpu import api
+
+    net = api.Network(trace_exact=True, trace_sinks=[MemSink()])
+    sink = net.trace_sinks[0]
+    nodes = net.add_nodes(16)
+    net.dense_connect(d=5, seed=1)
+    subs = [nd.join("x").subscribe() for nd in nodes]
+    net.start()
+    for i in range(3):
+        nodes[i].topics["x"].publish(b"m%d" % i)
+    net.run(8)
+    ev = by_type(sink.events)
+    assert all(sum(1 for _ in s) == 3 for s in subs)
+    counters = drain.TraceSession.counter_events(
+        drain.snapshot(net.state)
+    )
+    assert len(ev.get(T.DUPLICATE_MESSAGE, [])) == counters["DUPLICATE_MESSAGE"]
+    assert counters["DUPLICATE_MESSAGE"] > 0
+    pids = {nd.identity.peer_id for nd in nodes}
+    for e in ev[T.DUPLICATE_MESSAGE]:
+        assert e.peerID in pids
+        assert e.duplicateMessage.receivedFrom in pids
+    # control-only RPCs exist (heartbeat gossip/graft crossings)
+    assert any(
+        len(e.sendRPC.meta.messages) == 0 for e in ev.get(T.SEND_RPC, [])
+    )
