@@ -498,6 +498,7 @@ class Network:
         self.nodes: list[Node] = []
         self.topic_ids: dict[str, int] = {}
         self._edges: set[tuple[int, int]] = set()
+        self._dormant_pairs: set[tuple[int, int]] = set()
         self._validators: dict[str, _Validator] = {}
         self._pub_queue: deque = deque()
         self._slot_msg: dict[int, rpc_pb2.Message] = {}
@@ -535,12 +536,74 @@ class Network:
     def add_nodes(self, n: int, **kw) -> list[Node]:
         return [self.add_node(**kw) for _ in range(n)]
 
-    def connect(self, a: Node, b: Node) -> None:
-        """a dials b (direction recorded for the outbound quota)."""
-        self._check_not_started("connect")
+    def connect(self, a: Node, b: Node, dormant: bool = False) -> None:
+        """a dials b (direction recorded for the outbound quota).
+
+        Pre-start, records the edge in the assembly graph;
+        ``dormant=True`` provisions the K-slot pair but leaves it
+        inactive — the runtime-connect pool. Post-start, activates a
+        provisioned dormant pair ON THE LIVE STATE (notify.go:19-75
+        Connected / pubsub.go:614-646 newPeers): delivery flows the next
+        round, no recompile. Connecting an unprovisioned pair post-start
+        still requires restart() — the padded adjacency is a jit
+        constant."""
         if a.idx == b.idx:
             raise APIError("self connection")
-        self._edges.add((a.idx, b.idx))
+        if dormant and self.router != "gossipsub":
+            raise APIError(
+                "dormant provisioning requires the gossipsub router "
+                "(the edge-liveness plane)"
+            )
+        if not self.started:
+            self._edges.add((a.idx, b.idx))
+            pair = (min(a.idx, b.idx), max(a.idx, b.idx))
+            if dormant:
+                self._dormant_pairs.add(pair)
+            else:
+                # an explicit live connect overrides earlier dormant
+                # provisioning of the same pair (last instruction wins)
+                self._dormant_pairs.discard(pair)
+            return
+        if dormant:
+            raise APIError("dormant provisioning is pre-start assembly")
+        self._set_edge_live(a, b, True)
+
+    def disconnect_edge(self, a: Node, b: Node) -> None:
+        """Deactivate a live provisioned edge at runtime (the notify
+        Disconnected path) — it returns to the dormant pool and can be
+        re-activated by connect() or PX."""
+        if not self.started:
+            raise APIError("disconnect_edge is a runtime operation; "
+                           "assemble the graph with connect() pre-start")
+        self._set_edge_live(a, b, False)
+
+    def _set_edge_live(self, a: Node, b: Node, value: bool) -> None:
+        if self.router != "gossipsub":
+            raise APIError("runtime edge activation requires the gossipsub "
+                           "router (edge-liveness plane)")
+        if not (self._cfg.do_px or self._cfg.edge_liveness):
+            # the compiled step only consults state.edge_live when the
+            # liveness plane is enabled — writing it here would silently
+            # change nothing (messages would keep flowing)
+            raise APIError(
+                "this network was compiled without the edge-liveness "
+                "plane: provision at least one connect(a, b, dormant="
+                "True) pre-start (or enable px_connect) to make runtime "
+                "edge activation/deactivation effective"
+            )
+        nbr = np.asarray(self.net.nbr)
+        ok = np.asarray(self.net.nbr_ok)
+        ka = np.flatnonzero((nbr[a.idx] == b.idx) & ok[a.idx])
+        kb = np.flatnonzero((nbr[b.idx] == a.idx) & ok[b.idx])
+        if len(ka) == 0 or len(kb) == 0:
+            raise APIError(
+                "edge not provisioned: post-start connect() only activates "
+                "pairs provisioned pre-start (connect(a, b, dormant=True)) "
+                "or PX-dormant slots; use restart() to grow the topology"
+            )
+        el = np.array(self.state.edge_live)  # writable host copy
+        el[a.idx, ka[0]] = el[b.idx, kb[0]] = value
+        self.state = self.state.replace(edge_live=self._jnp.asarray(el))
 
     def connect_all(self) -> None:
         for i, a in enumerate(self.nodes):
@@ -846,9 +909,22 @@ class Network:
                 queue_cap=self.queue_cap,
                 trace_exact=self.trace_exact,
             )
+            dormant = None
+            if self._dormant_pairs:
+                # the runtime-connect pool: provisioned K-slot pairs that
+                # start inactive; post-start connect() flips them live on
+                # the device state without recompiling
+                cfg = dataclasses.replace(cfg, edge_liveness=True)
+                nbr_np = np.asarray(self.net.nbr)
+                ok_np = np.asarray(self.net.nbr_ok)
+                dormant = np.zeros(nbr_np.shape, bool)
+                for lo, hi in self._dormant_pairs:
+                    dormant[lo, (nbr_np[lo] == hi) & ok_np[lo]] = True
+                    dormant[hi, (nbr_np[hi] == lo) & ok_np[hi]] = True
             self.state = GossipSubState.init(
                 self.net, self.msg_slots, cfg, score_params=sp, seed=self.seed,
                 wire_block=self.max_message_size is not None,
+                dormant=dormant,
             )
             self._cfg = cfg
             self._recompile_gossipsub()

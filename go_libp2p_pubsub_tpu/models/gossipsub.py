@@ -113,6 +113,12 @@ class GossipSubConfig:
     score_enabled: bool = False
     flood_publish: bool = False
     do_px: bool = False
+    # edge-liveness gating without PX: dormant provisioned edges carry
+    # nothing until activated (state.edge_live). PX implies it; a build
+    # with pre-provisioned dormant pairs (api.Network.connect(dormant=
+    # True) — the runtime-connect pool, notify.go:19-75 Connected) sets
+    # it so post-start connects flip edges live with no recompile
+    edge_liveness: bool = False
     # outbound-queue backpressure: per-link message budget per round; the
     # overflow is genuinely lost and traced DROP_RPC (the reference's
     # 32-deep per-peer writer queue, pubsub.go:240 + comm.go:139-170).
@@ -1317,9 +1323,10 @@ def live_step_views(cfg: GossipSubConfig, net: Net, st: GossipSubState,
                     live: jax.Array | None, consts: StepConsts):
     """Apply the churn/PX edge-liveness mask to the static topology views.
     Returns (net_l, nbr_sub_l, flood_from_l, nbr_sub_words_l)."""
-    if cfg.do_px:
-        # PX connection plane: dormant edges carry nothing until
-        # activated (edge_live kept symmetric, so one side suffices)
+    if cfg.do_px or cfg.edge_liveness:
+        # edge-liveness plane: dormant edges carry nothing until
+        # activated (edge_live kept symmetric, so one side suffices) —
+        # by PX (pxConnect) or by a runtime connect() activation
         live = (net.nbr_ok if live is None else live) & st.edge_live
     if live is not None:
         net_l = net.replace(nbr_ok=live)

@@ -155,7 +155,22 @@ def decode_wire_block(pub_valid: jax.Array) -> jax.Array:
 
 @struct.dataclass
 class MsgTable:
-    """Rotating global message table (the interned message-id space)."""
+    """Rotating global message table (the interned message-id space).
+
+    Seen-cache TTL ↔ slot-recycling conversion (survey §7 hard-part (e)):
+    the reference's seen-cache is a 120 s first-seen TimeCache
+    (pubsub.go:30 TimeCacheDuration) — a message id re-arriving within
+    120 s is a duplicate; after expiry it would be treated as new. Here a
+    message's "seen" lifetime is its SLOT lifetime: M slots recycled at
+    publish rate p give a TTL of M/p rounds (the bench: 64/4 = 16 rounds;
+    at the reference cadence of ~8 rounds/heartbeat-second that is ~2 s
+    of simulated time). The conversion is conservative in the direction
+    that matters: a slot outlives every in-flight copy of its message
+    (propagation completes in ≤ ~8 hops = ≤ ~8 rounds < M/p), so no live
+    duplicate is ever re-admitted as new — the failure mode the
+    reference's 120 s figure exists to prevent. Configs that need a
+    longer memory scale M (the TTL is M/p by construction), not a
+    separate timer."""
 
     topic: jax.Array    # [M] i32, -1 = never used
     origin: jax.Array   # [M] i32

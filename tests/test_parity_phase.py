@@ -1,0 +1,146 @@
+"""Phase-engine parity row: the measured CDF impact of r-round control
+latency (VERDICT round-3 item 1's bound).
+
+The phase engine changes ONE thing vs the per-round step: control
+(grafts, gossip, IWANT service, score refresh, gater draws) acts every r
+rounds instead of every round — the reference's own timing shape, where
+control runs at 1 Hz against ~ms delivery hops (gossipsub.go:1278-1301).
+Delivery hops keep 1-round resolution, so the propagation-latency CDF
+difference vs r=1 *is* the control-latency effect, measured here over
+pooled seeds with both engines fed identical workloads and RNG streams
+(same seeds both sides — no formation-lottery noise in the comparison,
+unlike the engine-vs-oracle rows).
+
+Measured (CPU, N=192 d=8 v1.1 scoring, 5-seed pools, 64 msgs/seed —
+recorded in PARITY.md):
+  r=2 vs r=1: sup 2.60%    r=4: 3.09%    r=8: 3.58%   (coverage 100% all)
+The sup grows slowly with r: the bulk CDF shift comes from gossip
+recovery (IHAVE emission and IWANT service each lag up to r-1 rounds)
+and slower mesh repair between publishes; delivery hops themselves are
+unchanged. The bounds asserted below are the measured values + margin;
+they document the designed deviation rather than an error — at the
+reference's own cadence ratio (delivery hops per heartbeat >> 8) the
+per-round step is the outlier, not the phase engine.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import make_gossipsub_phase_step
+from go_libp2p_pubsub_tpu.driver import heartbeat_schedule
+
+N, D, M = 192, 8, 64
+WARMUP, PUB_ROUNDS, DRAIN, PUBS = 24, 16, 16, 4  # 56 rounds, 64 msgs
+MAX_H = 16
+
+
+def _score_params():
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=-0.3,
+        mesh_message_deliveries_threshold=3.0,
+        mesh_message_deliveries_activation=8.0,
+        mesh_message_deliveries_window=2.0,
+    )
+    return PeerScoreParams(topics={0: tp}, skip_app_specific=True,
+                           behaviour_penalty_weight=-1.0,
+                           behaviour_penalty_threshold=1.0,
+                           behaviour_penalty_decay=0.9)
+
+
+def _run(r: int, seed: int):
+    """One run at rounds_per_phase=r; returns (latency list, coverage)."""
+    topo = graph.random_connect(N, d=D, seed=seed)
+    subs = graph.subscribe_all(N, 1)
+    net = __import__("go_libp2p_pubsub_tpu.state", fromlist=["Net"]).Net.build(
+        topo, subs
+    )
+    sp = _score_params()
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True
+    )
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=seed)
+
+    total = WARMUP + PUB_ROUNDS + DRAIN
+    rng = np.random.default_rng(seed * 7 + 1)
+    po = np.full((total, PUBS), -1, np.int32)
+    pt = np.zeros((total, PUBS), np.int32)
+    pv = np.ones((total, PUBS), bool)
+    po[WARMUP : WARMUP + PUB_ROUNDS] = rng.integers(
+        0, N, size=(PUB_ROUNDS, PUBS)
+    )
+    po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+    if r == 1:
+        step = make_gossipsub_step(cfg, net, score_params=sp)
+        for i in range(total):
+            st = step(st, po_j[i], pt_j[i], pv_j[i])
+    else:
+        pstep = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+        sched = heartbeat_schedule(1, r)
+        g = total // r
+        gro = lambda a: a.reshape((g, r) + a.shape[1:])
+        xo, xt, xv = gro(po_j), gro(pt_j), gro(pv_j)
+        for p in range(g):
+            st = pstep(st, xo[p], xt[p], xv[p],
+                       do_heartbeat=sched[p % len(sched)])
+
+    origin = np.asarray(st.core.msgs.origin)
+    birth = np.asarray(st.core.msgs.birth)
+    fr = np.asarray(st.core.dlv.first_round)
+    lats, delivered, expected = [], 0, 0
+    for s in np.nonzero(origin >= 0)[0]:
+        got = fr[:, s] >= 0
+        delivered += int(got.sum())
+        expected += N
+        lats.extend((fr[got, s] - birth[s]).tolist())
+    return lats, delivered / expected
+
+
+def _pooled_cdf(per_seed_lats, denom):
+    hist = np.zeros(MAX_H + 1)
+    for lats in per_seed_lats:
+        for h in lats:
+            hist[min(int(h), MAX_H)] += 1
+    return np.cumsum(hist) / (len(per_seed_lats) * denom)
+
+
+SEEDS = (3, 4, 5, 6, 7)
+# measured sup + margin (see module docstring); these are the documented
+# control-latency deviations, not error bounds
+BOUNDS = {2: 0.035, 4: 0.04, 8: 0.045}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("r", [2, 4, 8])
+def test_phase_control_latency_cdf_impact(r):
+    denom = N * PUB_ROUNDS * PUBS
+    base, cov_base = [], []
+    phase, cov_phase = [], []
+    for seed in SEEDS:
+        l1, c1 = _run(1, seed)
+        lr, cr = _run(r, seed)
+        base.append(l1)
+        phase.append(lr)
+        cov_base.append(c1)
+        cov_phase.append(cr)
+    sup = float(np.max(np.abs(_pooled_cdf(base, denom)
+                              - _pooled_cdf(phase, denom))))
+    print(f"phase r={r}: CDF sup vs per-round = {100*sup:.2f}%  "
+          f"coverage {np.mean(cov_base):.4f} vs {np.mean(cov_phase):.4f}")
+    assert np.mean(cov_phase) > 0.995  # delivery still completes
+    assert sup < BOUNDS[r], f"r={r}: sup {100*sup:.2f}% above documented bound"

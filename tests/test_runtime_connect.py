@@ -1,0 +1,131 @@
+"""Runtime topology growth: post-start connect() via the dormant-edge
+pool (VERDICT round-3 item 7; notify.go:19-75 Connected, pubsub.go:614-646
+newPeers) — activation on the live device state, no restart/recompile.
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import api
+
+
+def two_islands(n_each=8, bridges=2):
+    """Two internally-dense clusters joined ONLY by dormant bridge pairs."""
+    net = api.Network()
+    a = net.add_nodes(n_each)
+    b = net.add_nodes(n_each)
+    for grp in (a, b):
+        for i, x in enumerate(grp):
+            for y in grp[i + 1 :]:
+                net.connect(x, y)
+    pairs = [(a[i], b[i]) for i in range(bridges)]
+    for x, y in pairs:
+        net.connect(x, y, dormant=True)
+    return net, a, b, pairs
+
+
+def drain_all(subs):
+    return [sum(1 for _ in s) for s in subs]
+
+
+def test_post_start_connect_activates_dormant_pair():
+    net, a, b, pairs = two_islands()
+    subs = [nd.join("t").subscribe() for nd in a + b]
+    net.start()
+    step_before = net._step
+
+    a[2].topics["t"].publish(b"pre")
+    net.run(6)
+    got = drain_all(subs)
+    assert all(g == 1 for g in got[: len(a)])      # island A delivered
+    assert all(g == 0 for g in got[len(a) :])      # island B unreachable
+
+    net.connect(*pairs[0])                          # runtime activation
+    net.run(4)                                      # mesh grafts across
+    a[3].topics["t"].publish(b"post")
+    net.run(6)
+    got = drain_all(subs)
+    assert all(g == 1 for g in got)                 # everyone got "post"
+    assert net._step is step_before                 # no recompile happened
+
+
+def test_post_start_connect_unprovisioned_raises():
+    net, a, b, _ = two_islands(bridges=1)
+    for nd in a + b:
+        nd.join("t")
+    net.start()
+    with pytest.raises(api.APIError, match="not provisioned"):
+        net.connect(a[5], b[5])
+
+
+def test_disconnect_edge_returns_to_dormant():
+    net, a, b, pairs = two_islands(bridges=1)
+    subs = [nd.join("t").subscribe() for nd in a + b]
+    net.start()
+    net.connect(*pairs[0])
+    net.run(4)
+    a[0].topics["t"].publish(b"one")
+    net.run(6)
+    assert all(g == 1 for g in drain_all(subs))
+
+    net.disconnect_edge(*pairs[0])                  # back to dormant
+    net.run(2)
+    a[0].topics["t"].publish(b"two")
+    net.run(8)
+    got = drain_all(subs)
+    assert all(g == 1 for g in got[: len(a)])
+    assert all(g == 0 for g in got[len(a) :])       # bridge is down again
+
+    net.connect(*pairs[0])                          # and up once more
+    net.run(4)
+    a[1].topics["t"].publish(b"three")
+    net.run(6)
+    assert all(g == 1 for g in drain_all(subs))
+
+
+def test_dormant_pool_invisible_before_activation():
+    """Dormant edges are not mesh/gossip candidates while inactive."""
+    net, a, b, pairs = two_islands()
+    for nd in a + b:
+        nd.join("t")
+    net.start()
+    net.run(8)
+    mesh = np.asarray(net.state.mesh)  # [N,S,K]
+    nbr = np.asarray(net.net.nbr)
+    n_each = len(a)
+    for p in range(mesh.shape[0]):
+        for k in np.flatnonzero(mesh[p].any(axis=0)):
+            q = nbr[p, k]
+            assert (p < n_each) == (q < n_each), "mesh crossed a dormant bridge"
+
+
+def test_runtime_ops_guarded_without_liveness_plane():
+    """A network compiled WITHOUT the edge-liveness plane must refuse
+    runtime edge ops instead of silently writing an unread mask."""
+    net = api.Network()
+    a, b = net.add_nodes(2)
+    for extra in net.add_nodes(6):
+        net.connect(a, extra)
+        net.connect(b, extra)
+    net.connect(a, b)
+    for nd in net.nodes:
+        nd.join("t")
+    net.start()
+    with pytest.raises(api.APIError, match="edge-liveness plane"):
+        net.disconnect_edge(a, b)
+
+
+def test_dormant_then_live_prestart_last_wins():
+    net = api.Network()
+    nodes = net.add_nodes(10)
+    net.dense_connect(d=4, seed=2)
+    net.connect(nodes[0], nodes[9], dormant=True)
+    net.connect(nodes[0], nodes[9])  # explicit live connect overrides
+    assert not net._dormant_pairs
+
+
+def test_dormant_rejected_on_non_gossipsub():
+    net = api.Network(router="floodsub")
+    a, b = net.add_nodes(2)
+    with pytest.raises(api.APIError, match="gossipsub"):
+        net.connect(a, b, dormant=True)
