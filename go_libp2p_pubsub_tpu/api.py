@@ -427,6 +427,7 @@ class Network:
         protocol_matcher: "ProtocolMatcher | None" = None,
         max_message_size: int | None = None,
         trace_exact: bool = False,
+        rounds_per_phase: int = 1,
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
@@ -438,6 +439,19 @@ class Network:
             raise APIError("queue_cap is only modeled on the gossipsub router")
         if trace_exact and router != "gossipsub":
             raise APIError("trace_exact is only modeled on the gossipsub router")
+        if rounds_per_phase > 1:
+            # the multi-round phase engine (models/gossipsub_phase.py):
+            # control every r rounds, the reference's continuous-delivery
+            # timing shape — the bench's production cadence, surfaced here
+            # for API workloads that don't need per-round observation
+            if router != "gossipsub":
+                raise APIError("rounds_per_phase requires the gossipsub router")
+            if trace_sinks or track_tags or trace_exact:
+                raise APIError(
+                    "rounds_per_phase > 1 is incompatible with per-round "
+                    "observers (trace_sinks / track_tags / trace_exact): "
+                    "the reconstructive drains diff consecutive rounds"
+                )
         if px_connect:
             if router != "gossipsub":
                 raise APIError("px_connect requires the gossipsub router")
@@ -494,6 +508,7 @@ class Network:
         # individual events; trace.go:166-194, 341-414) — adds the
         # per-round duplicate plane to the device state
         self.trace_exact = trace_exact
+        self.rounds_per_phase = int(rounds_per_phase)
         self.msg_id_fn = msg_id_fn or default_msg_id
         self.nodes: list[Node] = []
         self.topic_ids: dict[str, int] = {}
@@ -875,7 +890,16 @@ class Network:
         """(Re)build the compiled gossipsub step for the current net +
         score/gater params (start, runtime Join/Leave, SetScoreParams)."""
         from .models.gossipsub import make_gossipsub_step
+        from .models.gossipsub_phase import make_gossipsub_phase_step
 
+        if self.rounds_per_phase > 1:
+            self._step = make_gossipsub_phase_step(
+                self._cfg, self.net, self.rounds_per_phase,
+                score_params=self.score_params,
+                gater_params=self.gater_params, dynamic_peers=True,
+                sub_knowledge_holes=self._sub_holes,
+            )
+            return
         self._step = make_gossipsub_step(
             self._cfg, self.net, score_params=self.score_params,
             gater_params=self.gater_params, dynamic_peers=True,
@@ -1293,18 +1317,27 @@ class Network:
         """One protocol round with no publishes and full observation
         bookkeeping (traces, tags, membership, delivery drain) — but
         without run()'s publish-queue drain or validation-budget reset.
-        Used for internal transition rounds (e.g. Leave's PRUNE)."""
+        Used for internal transition rounds (e.g. Leave's PRUNE). In phase
+        mode the transition quantum is one full (publish-free) phase — the
+        step advances rounds_per_phase ticks."""
         jnp = self._jnp
-        po = np.full(self.pub_width, -1, np.int32)
-        pt = np.zeros(self.pub_width, np.int32)
-        pv = np.zeros(self.pub_width, np.int8)  # VERDICT_* codes
+        r = self.rounds_per_phase
+        if r > 1:
+            po = np.full((r, self.pub_width), -1, np.int32)
+            pt = np.zeros((r, self.pub_width), np.int32)
+            pv = np.zeros((r, self.pub_width), np.int8)
+        else:
+            po = np.full(self.pub_width, -1, np.int32)
+            pt = np.zeros(self.pub_width, np.int32)
+            pv = np.zeros(self.pub_width, np.int8)  # VERDICT_* codes
         prev = snapshot(self.state)
         args = (self.state, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+        kw = {"do_heartbeat": True} if r > 1 else {}
         if self._dynamic:
             up = np.array([nd.up and not self._blacklisted(nd) for nd in self.nodes])
-            self.state = self._step(*args, jnp.asarray(up))
+            self.state = self._step(*args, jnp.asarray(up), **kw)
         else:
-            self.state = self._step(*args)
+            self.state = self._step(*args, **kw)
         new = snapshot(self.state)
         if prev.up is not None and new.up is not None:
             self._emit_membership_events(prev.up, new.up)
@@ -1325,6 +1358,17 @@ class Network:
         # steady-state queue depths; one run() is our quantum)
         self._async_budget = self.validate_throttle
         self._topic_budget = {}
+
+        if self.rounds_per_phase > 1:
+            r = self.rounds_per_phase
+            if rounds % r:
+                raise APIError(
+                    f"run({rounds}) with rounds_per_phase={r}: the round "
+                    "count must be a multiple of the phase size"
+                )
+            for _ in range(rounds // r):
+                self._run_phase()
+            return
 
         for _ in range(rounds):
             _t0 = time.perf_counter()
@@ -1382,6 +1426,59 @@ class Network:
                     self.params.heartbeat_interval,
                 )
 
+    def _run_phase(self) -> None:
+        """One multi-round phase through the phase engine: r publish batches
+        land one per sub-round; deliveries drain at the phase boundary.
+
+        Publish admission is capped at msg_slots // 2 per phase: slots
+        recycled WITHIN a phase wipe their receipts before the boundary
+        drain can deliver them (allocate_publishes clears first_round on
+        recycle — the per-round path drains every round so never races
+        this). Half the table per phase leaves the other half for the
+        previous phases' delivery tails; excess publishes stay queued for
+        the next phase (the reference's publish path backpressures the
+        same way when its validation frontend saturates)."""
+        jnp = self._jnp
+        r = self.rounds_per_phase
+        po = np.full((r, self.pub_width), -1, np.int32)
+        pt = np.zeros((r, self.pub_width), np.int32)
+        pv = np.zeros((r, self.pub_width), np.int8)
+        batch = []  # (flat running index, msg, mid) in allocation order
+        flat = 0
+        cap = max(1, self.msg_slots // 2)
+        for i in range(r):
+            if flat >= cap:
+                break
+            for j in range(self.pub_width):
+                if not self._pub_queue or flat >= cap:
+                    break
+                origin, tid, verdict, msg, mid = self._pub_queue.popleft()
+                po[i, j], pt[i, j], pv[i, j] = origin, tid, verdict
+                batch.append((flat, msg, mid))
+                flat += 1
+        prev = snapshot(self.state)
+        args = (self.state, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+        if self._dynamic:
+            up = np.array([nd.up and not self._blacklisted(nd)
+                           for nd in self.nodes])
+            self.state = self._step(*args, jnp.asarray(up),
+                                    do_heartbeat=True)
+        else:
+            self.state = self._step(*args, do_heartbeat=True)
+        new = snapshot(self.state)
+        if prev.up is not None and new.up is not None:
+            self._emit_membership_events(prev.up, new.up)
+        # slot mapping replicates allocate_publishes' running cursor over
+        # the phase's flattened publish order
+        for flat_idx, msg, mid in batch:
+            slot = (prev.cursor + flat_idx) % self.msg_slots
+            self._slot_msg[slot] = msg
+            self._seen_mids[mid] = slot
+        self._drain_deliveries(prev, new)
+        if self.px_connect:
+            self._px_connect_pass()
+        self._process_announces()
+
     def _blacklisted(self, node: Node) -> bool:
         pid = node.identity.peer_id
         return any(other.blacklist.contains(pid) for other in self.nodes)
@@ -1406,8 +1503,9 @@ class Network:
     def _drain_deliveries(self, prev, new) -> None:
         """First receipts this round -> subscription queues (notifySubs,
         pubsub.go:905-916) + remote validator execution for visibility."""
-        recv = (new.first_round == prev.tick) & (new.first_edge >= 0) & \
-            new.msg_valid[None, :]
+        # range check (not ==): a phase step advances several ticks at once
+        recv = (new.first_round >= prev.tick) & (new.first_round < new.tick) \
+            & (new.first_edge >= 0) & new.msg_valid[None, :]
         peers, mslots = np.nonzero(recv)
         for p, s in zip(peers.tolist(), mslots.tolist()):
             msg = self._slot_msg.get(s)

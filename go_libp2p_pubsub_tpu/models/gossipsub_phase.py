@@ -48,12 +48,19 @@ Known deviations vs the per-round step, both bounded in PARITY.md:
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops import bitset
-from ..score.engine import on_deliveries, slot_topic_words
+from ..score.engine import (
+    apply_delivery_counts,
+    on_deliveries,
+    per_slot_counts,
+    slot_topic_words,
+)
 from ..score.gater import gater_on_round
 from ..state import Net, allocate_publishes
 from ..trace.events import EV
@@ -91,6 +98,7 @@ def make_gossipsub_phase_step(
     dynamic_peers: bool = False,
     adversary_no_forward: np.ndarray | None = None,
     sub_knowledge_holes: np.ndarray | None = None,
+    score_counts: bool | None = None,
 ):
     """Build the jitted multi-round phase step.
 
@@ -124,6 +132,10 @@ def make_gossipsub_phase_step(
     )
     n_peers, k_dim = net.nbr.shape
     val_delay = cfg.validation_delay_rounds
+    use_counts = (
+        score_counts if score_counts is not None
+        else os.environ.get("PUBSUB_PHASE_COUNTS", "") == "1"
+    )
 
     def _phase(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
                do_heartbeat: bool) -> GossipSubState:
@@ -190,11 +202,31 @@ def make_gossipsub_phase_step(
 
         zkw = jnp.zeros((n_peers, k_dim, w), jnp.uint32)
         zw = jnp.zeros((n_peers, w), jnp.uint32)
-        trans_acc = zkw
-        new_acc = zw
-        recv_acc = zw
-        accepted_acc = zw
-        mcw_acc = zkw if cfg.score_enabled else None
+        s_slots = net.my_topics.shape[1]
+        # Two score-attribution paths. The COUNT path (inline validation
+        # only) reduces each sub-round's transmit tensor to per-
+        # (peer,slot,edge) popcounts at arrival time — no [N,K,W]
+        # attribution plane survives the loop, and credit lands exactly
+        # when the per-round engine would land it, including a message's
+        # death round. Measured on the real chip (N=100k) it LOSES to the
+        # plane path (r=8: 1048 vs 1200 rounds/s; r=16: 1250 vs 1365):
+        # the r-per-phase popcount trees cost more VPU time than the
+        # plane ORs cost HBM stores on this libtpu. The PLANE path is
+        # therefore the default; the count path stays as an opt-in
+        # (score_counts=True / PUBSUB_PHASE_COUNTS=1) for workloads where
+        # within-phase slot recycling would otherwise shave score credit,
+        # and is required-off for the async-validation pipeline (pend_dup
+        # needs cross-sub-round word algebra).
+        count_score = cfg.score_enabled and val_delay == 0 and use_counts
+        plane_score = cfg.score_enabled and not count_score
+        trans_acc = zkw if plane_score else None
+        new_acc = zw if plane_score else None
+        recv_acc = zw if plane_score else None
+        accepted_acc = zw if (plane_score or cfg.gater_enabled) else None
+        mcw_acc = zkw if plane_score else None
+        if count_score:
+            zsc = jnp.zeros((n_peers, s_slots, k_dim), jnp.float32)
+            fmd_counts, mmd_counts, imd_counts = zsc, zsc, zsc
         dup_trace_acc = zkw if cfg.trace_exact else None
         if cfg.gater_enabled:
             dup_acc = zkw
@@ -283,12 +315,15 @@ def make_gossipsub_phase_step(
                 accepted_new = info.new_words
                 n_thr = None
 
-            # ---- attribution accumulation (word planes; OR is exact —
-            # each (edge,msg) transmits at most once per phase) ----------
-            trans_acc = trans_acc | info.trans
-            new_acc = new_acc | info.new_words
-            recv_acc = recv_acc | info.recv_new_words
-            accepted_acc = accepted_acc | accepted_new
+            # ---- attribution accumulation (OR of word planes, or direct
+            # per-slot count reduction; both exact — each (edge,msg)
+            # transmits at most once per phase) ---------------------------
+            if plane_score:
+                trans_acc = trans_acc | info.trans
+                new_acc = new_acc | info.new_words
+                recv_acc = recv_acc | info.recv_new_words
+            if accepted_acc is not None:
+                accepted_acc = accepted_acc | accepted_new
             if cfg.score_enabled:
                 # P3 window gate at this arrival's own tick (score.go:
                 # 944-974 markDuplicateMessageDelivery window check)
@@ -297,6 +332,17 @@ def make_gossipsub_phase_step(
                     (dlv.first_round >= 0)
                     & ((tick_i - dlv.first_round) <= msg_window[None, :])
                 )
+            if count_score:
+                valid3 = valid_w_i[None, None, :]
+                mesh_w = info.trans & valid3 & within_i[:, None, :]
+                fa_w = dlv.fe_words & info.new_words[:, None, :] & valid3
+                ign_i = bitset.pack(msgs.ignored)
+                inv_w = info.trans & ~(valid_w_i | ign_i)[None, None, :]
+
+                mmd_counts = mmd_counts + per_slot_counts(mesh_w, slotw)
+                fmd_counts = fmd_counts + per_slot_counts(fa_w, slotw)
+                imd_counts = imd_counts + per_slot_counts(inv_w, slotw)
+            elif plane_score:
                 mcw_i = info.trans & within_i[:, None, :]
                 if val_delay > 0:
                     # duplicates arriving while the message sits in the
@@ -344,15 +390,18 @@ def make_gossipsub_phase_step(
                 (promise_mid >= 0) & promise_reused, -1, promise_mid
             )
             # recycled slots drop out of the phase accumulators too — their
-            # columns now belong to a different message
+            # columns now belong to a different message (the count path
+            # needs no clearing: its credits were reduced at arrival time,
+            # when the slot still named the right message)
             kw3 = keep_w[None, None, :]
             kw2 = keep_w[None, :]
-            trans_acc = trans_acc & kw3
-            new_acc = new_acc & kw2
-            recv_acc = recv_acc & kw2
-            accepted_acc = accepted_acc & kw2
-            if cfg.score_enabled:
+            if plane_score:
+                trans_acc = trans_acc & kw3
+                new_acc = new_acc & kw2
+                recv_acc = recv_acc & kw2
                 mcw_acc = mcw_acc & kw3
+            if accepted_acc is not None:
+                accepted_acc = accepted_acc & kw2
             if cfg.gater_enabled:
                 dup_acc = dup_acc & kw3
                 rejw_acc = rejw_acc & kw3
@@ -374,7 +423,11 @@ def make_gossipsub_phase_step(
         # ---- phase tail (once) ------------------------------------------
         tick_last = tick0 + (r - 1)
         score = st2.score
-        if cfg.score_enabled:
+        if count_score:
+            score = apply_delivery_counts(
+                score, tp, fmd_counts, mmd_counts, imd_counts, mesh2
+            )
+        elif plane_score:
             score = on_deliveries(
                 score, net_l, mesh2, tp, trans_acc, new_acc,
                 dlv.fe_words, dlv.first_round,
@@ -400,9 +453,11 @@ def make_gossipsub_phase_step(
                 ignore_inc=bitset.popcount(ignw_acc, axis=-1).astype(jnp.float32),
             )
         if cfg.count_events:
+            # accumulate_round_events consumes only the scalar counters;
+            # the plane fields are placeholders (DCE'd when unaccumulated)
             info_sum = RoundInfo(
-                trans=trans_acc, new_words=new_acc,
-                new_bits=bitset.unpack(new_acc, m), recv_new_words=recv_acc,
+                trans=zkw, new_words=zw,
+                new_bits=bitset.unpack(zw, m), recv_new_words=zw,
                 **cnt,
             )
             events = accumulate_round_events(events, info_sum, n_pub)

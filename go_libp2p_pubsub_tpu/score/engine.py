@@ -296,6 +296,18 @@ def on_prune(st: ScoreState, prune_mask: jax.Array, tp: dict) -> ScoreState:
 # tensor
 
 
+def per_slot_counts(words: jax.Array, slotw: jax.Array) -> jax.Array:
+    """[N,K,W] packed words -> [N,S,K] f32 popcounts per topic slot —
+    the shared reduction kernel of on_deliveries and the phase engine's
+    count-fold path (single-source so the two score paths cannot
+    drift)."""
+    s_slots = slotw.shape[1]
+    return jnp.stack(
+        [bitset.popcount(words & slotw[:, s : s + 1, :], axis=-1)
+         for s in range(s_slots)], axis=1
+    ).astype(jnp.float32)
+
+
 def slot_topic_words(net: Net, msg_topic: jax.Array) -> jax.Array:
     """[N, S, W] packed: messages belonging to the topic of my slot s.
 
@@ -363,12 +375,7 @@ def on_deliveries(
     if slotw is None:
         slotw = slot_topic_words(net, msg_topic)  # [N,S,W]
 
-    def per_slot_counts(words):  # [N,K,W] -> [N,S,K] f32 popcounts
-        outs = [
-            bitset.popcount(words & slotw[:, s : s + 1, :], axis=-1)  # [N,K]
-            for s in range(s_slots)
-        ]
-        return jnp.stack(outs, axis=1).astype(jnp.float32)
+    _psc = per_slot_counts
 
     valid_w = bitset.pack(msg_valid)  # [W]
 
@@ -378,7 +385,7 @@ def on_deliveries(
     # validation the physical arrival was rounds ago; credit lands at the
     # verdict, the reference's DeliverMessage timing, score.go:695-719)
     first_arrival = fe_words & new_words[:, None, :] & valid_w[None, None, :]
-    fmd_inc = per_slot_counts(first_arrival)
+    fmd_inc = _psc(first_arrival, slotw)
     e = lambda a: a[..., None]
     fmd = jnp.minimum(st.fmd + fmd_inc, e(tp["cap2"]))
 
@@ -421,7 +428,7 @@ def on_deliveries(
             & ~exclude_first
         )
         mesh_credit = mesh_credit | pend_dup | first_arrival
-    mmd_inc = per_slot_counts(mesh_credit) * in_mesh.astype(jnp.float32)
+    mmd_inc = _psc(mesh_credit, slotw) * in_mesh.astype(jnp.float32)
     mmd = jnp.minimum(st.mmd + mmd_inc, e(tp["cap3"]))
 
     # -- P4 penalty for rejected messages -----------------------------------
@@ -429,9 +436,39 @@ def on_deliveries(
     if msg_ignored is not None:
         penalize_w = penalize_w & ~bitset.pack(msg_ignored)
     invalid_arrival = trans_words & penalize_w[None, None, :]
-    imd = st.imd + per_slot_counts(invalid_arrival)
+    imd = st.imd + _psc(invalid_arrival, slotw)
 
     # unscored slots track nothing (getTopicStats, score.go:881-884)
+    scored = e(tp["scored"])
+    return st.replace(
+        fmd=jnp.where(scored, fmd, st.fmd),
+        mmd=jnp.where(scored, mmd, st.mmd),
+        imd=jnp.where(scored, imd, st.imd),
+    )
+
+
+def apply_delivery_counts(
+    st: ScoreState,
+    tp: dict,
+    fmd_counts: jax.Array,  # [N,S,K] f32 — first-delivery credits
+    mmd_counts: jax.Array,  # [N,S,K] f32 — in-window mesh-delivery credits
+    imd_counts: jax.Array,  # [N,S,K] f32 — invalid-arrival penalties
+    in_mesh: jax.Array,     # [N,S,K] bool
+) -> ScoreState:
+    """Fold pre-reduced delivery counts into the counters — the phase
+    engine's count-accumulation path (gossipsub_phase.py): each sub-round
+    reduces its transmit tensor to per-(peer, slot, edge) popcounts at
+    arrival time (valid/window/first-arrival masks applied there, exactly
+    as on_deliveries would), so no [N,K,W] attribution plane survives the
+    loop. Caps apply once per fold like on_deliveries applies them once
+    per round; with multi-round folds the cap can bind up to r-1 rounds
+    late (caps are sized in the hundreds — parity rows cover it)."""
+    e = lambda a: a[..., None]
+    fmd = jnp.minimum(st.fmd + fmd_counts, e(tp["cap2"]))
+    mmd = jnp.minimum(
+        st.mmd + mmd_counts * in_mesh.astype(jnp.float32), e(tp["cap3"])
+    )
+    imd = st.imd + imd_counts
     scored = e(tp["scored"])
     return st.replace(
         fmd=jnp.where(scored, fmd, st.fmd),

@@ -249,3 +249,66 @@ def test_slow_heartbeat_warning(caplog):
     with caplog.at_level(logging.WARNING, logger="go_libp2p_pubsub_tpu"):
         net.run(1)
     assert any("slow heartbeat" in r.message for r in caplog.records)
+
+
+def test_network_rounds_per_phase():
+    """The phase engine through the L6 API: publishes land per sub-round,
+    deliveries drain at phase boundaries, full coverage."""
+    from go_libp2p_pubsub_tpu import api as api_mod
+
+    net = api_mod.Network(rounds_per_phase=4)
+    nodes = net.add_nodes(24)
+    net.dense_connect(d=6, seed=5)
+    subs = [nd.join("x").subscribe() for nd in nodes]
+    net.start()
+    net.run(8)  # 2 phases of mesh formation
+    for i in range(5):
+        nodes[i].topics["x"].publish(b"p%d" % i)
+    net.run(12)
+    got = [sum(1 for _ in s) for s in subs]
+    assert all(g == 5 for g in got), got
+    import pytest as _pytest
+
+    with _pytest.raises(api_mod.APIError, match="multiple of the phase"):
+        net.run(3)
+    with _pytest.raises(api_mod.APIError, match="incompatible"):
+        api_mod.Network(rounds_per_phase=4, track_tags=True)
+
+
+def test_network_phase_mode_no_delivery_loss_under_slot_pressure():
+    """Publish far more messages than msg_slots through a long phase: the
+    per-phase admission cap must prevent within-phase recycling from
+    wiping receipts before the boundary drain (round-4 review repro:
+    128 pubs at r=16 delivered only 32 without the cap)."""
+    from go_libp2p_pubsub_tpu import api as api_mod
+
+    net = api_mod.Network(rounds_per_phase=16, msg_slots=64)
+    nodes = net.add_nodes(24)
+    net.dense_connect(d=6, seed=7)
+    subs = [nd.join("x").subscribe(buffer=256) for nd in nodes]
+    net.start()
+    net.run(16)
+    for i in range(128):
+        nodes[i % 24].topics["x"].publish(b"m%d" % i)
+    net.run(16 * 8)
+    got = [sum(1 for _ in s) for s in subs]
+    assert all(g == 128 for g in got), got
+
+
+def test_network_phase_mode_runtime_leave():
+    """Runtime leave() in phase mode drives the transition through a full
+    publish-free phase (round-4 review repro: TypeError before)."""
+    from go_libp2p_pubsub_tpu import api as api_mod
+
+    net = api_mod.Network(rounds_per_phase=4)
+    nodes = net.add_nodes(16)
+    net.dense_connect(d=5, seed=9)
+    topics = [nd.join("x") for nd in nodes]
+    net.start()
+    net.run(8)
+    topics[0].close()  # leave
+    net.run(8)
+    subs = [nd.topics["x"].subscribe() for nd in nodes[1:]]
+    nodes[1].topics["x"].publish(b"after-leave")
+    net.run(12)
+    assert all(sum(1 for _ in s) == 1 for s in subs)

@@ -123,13 +123,16 @@ def run_phase(pstep, st, po, pt, pv, r, he=1):
 # r=1 bit-exactness: the phase engine IS the per-round step
 
 
-def test_phase_r1_bitexact_rich_v11():
+@pytest.mark.parametrize("score_counts", [False, True])
+def test_phase_r1_bitexact_rich_v11(score_counts):
     """score + flood_publish + PX + fanout + mixed verdicts, he=1.
     16 rounds x 4 pubs < 64 slots => no recycling => every plane equal
-    including score counters."""
+    including score counters — on BOTH score-attribution paths (plane
+    default and opt-in counts)."""
     net, cfg, sp, st = build(seed=3)
     step = make_gossipsub_step(cfg, net, score_params=sp)
-    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp,
+                                      score_counts=score_counts)
     po, pt, pv = schedule(16, seed=3, codes=True)
     sa = run_per_round(step, st, po, pt, pv)
     net, cfg, sp, st2 = build(seed=3)
@@ -322,3 +325,44 @@ def test_phase_trace_exact_dup_plane_reconciles():
         assert plane == dup_now - prev_dup, (p, plane, dup_now - prev_dup)
         prev_dup = dup_now
     assert prev_dup > 0
+
+
+def test_phase_count_vs_plane_score_paths_equal_no_recycle():
+    """r=4, no slot recycling: the count-fold and plane score paths are
+    bit-equal (integer popcounts are exact in f32; OR preserves the
+    transmission multiset)."""
+    net, cfg, sp, st = build(seed=41)
+    pa = make_gossipsub_phase_step(cfg, net, 4, score_params=sp,
+                                   score_counts=False)
+    pb = make_gossipsub_phase_step(cfg, net, 4, score_params=sp,
+                                   score_counts=True)
+    po, pt, pv = schedule(16, seed=41)
+    sa = run_phase(pa, st, po, pt, pv, 4)
+    net, cfg, sp, st2 = build(seed=41)
+    sb = run_phase(pb, st2, po, pt, pv, 4)
+    assert_states_equal(sa, sb, "count-vs-plane/")
+
+
+def test_phase_count_path_retains_recycled_credit():
+    """Under within-phase recycling the count path retains the score
+    credit the plane path sheds (its stated reason to exist): total P2
+    first-delivery credit count >= plane, strictly greater when recycling
+    actually bites; delivery planes stay identical (attribution never
+    affects propagation)."""
+    net, cfg, sp, st = build(seed=43)
+    pa = make_gossipsub_phase_step(cfg, net, 8, score_params=sp,
+                                   score_counts=False)
+    pb = make_gossipsub_phase_step(cfg, net, 8, score_params=sp,
+                                   score_counts=True)
+    po, pt, pv = schedule(80, seed=43)  # 320 pubs >> 64 slots: recycling
+    sa = run_phase(pa, st, po, pt, pv, 8)
+    net, cfg, sp, st2 = build(seed=43)
+    sb = run_phase(pb, st2, po, pt, pv, 8)
+    assert np.array_equal(np.asarray(sa.core.dlv.have),
+                          np.asarray(sb.core.dlv.have))
+    assert np.array_equal(np.asarray(sa.core.dlv.first_round),
+                          np.asarray(sb.core.dlv.first_round))
+    fa = float(np.asarray(sa.score.fmd).sum())
+    fb = float(np.asarray(sb.score.fmd).sum())
+    assert fb >= fa
+    assert fb > fa, "expected recycling to bite in this workload"
