@@ -219,6 +219,12 @@ def make_gossipsub_phase_step(
         # needs cross-sub-round word algebra).
         count_score = cfg.score_enabled and val_delay == 0 and use_counts
         plane_score = cfg.score_enabled and not count_score
+        # (an attempted round-4 optimization derived P4 from the
+        # first-edge plane, on the theory that invalid messages travel
+        # exactly one hop; FALSIFIED by the r=1 bit-exactness tests — an
+        # origin advertises and IWANT-serves its own invalid publishes
+        # from mcache, so invalid arrivals repeat across rounds on the
+        # same edge. The trans plane stays.)
         trans_acc = zkw if plane_score else None
         new_acc = zw if plane_score else None
         recv_acc = zw if plane_score else None
@@ -319,8 +325,8 @@ def make_gossipsub_phase_step(
             # per-slot count reduction; both exact — each (edge,msg)
             # transmits at most once per phase) ---------------------------
             if plane_score:
-                trans_acc = trans_acc | info.trans
                 new_acc = new_acc | info.new_words
+                trans_acc = trans_acc | info.trans
                 recv_acc = recv_acc | info.recv_new_words
             if accepted_acc is not None:
                 accepted_acc = accepted_acc | accepted_new
@@ -396,10 +402,10 @@ def make_gossipsub_phase_step(
             kw3 = keep_w[None, None, :]
             kw2 = keep_w[None, :]
             if plane_score:
-                trans_acc = trans_acc & kw3
                 new_acc = new_acc & kw2
-                recv_acc = recv_acc & kw2
                 mcw_acc = mcw_acc & kw3
+                trans_acc = trans_acc & kw3
+                recv_acc = recv_acc & kw2
             if accepted_acc is not None:
                 accepted_acc = accepted_acc & kw2
             if cfg.gater_enabled:
