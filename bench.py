@@ -201,10 +201,15 @@ def main():
             pv = np.ones((seg, pubs_per_round), bool)
             po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
 
-            # unroll: adjacent iterations let XLA cancel the carry
-            # layout conversions the while-loop form pays per tick
-            # (profiled ~35% of device time); 4 rounds is the measured knee
-            unroll = int(os.environ.get("BENCH_UNROLL", 4))
+            # unroll: adjacent iterations let XLA cancel the carry layout
+            # conversions the while-loop form pays per tick (profiled ~35%
+            # of device time); 4 rounds is the per-round knee, and phase
+            # mode gains another ~7-8% from unrolling TWO phases per scan
+            # iteration (r=8: 1200 -> 1296, r=16: 1365 -> 1460 rounds/s,
+            # round-4 measurements)
+            unroll = int(os.environ.get(
+                "BENCH_UNROLL", 2 * group if rounds_per_phase > 1 else 4
+            ))
             from go_libp2p_pubsub_tpu.driver import make_scan
 
             # the schedule-owning scan (driver.make_scan) drives all three

@@ -130,3 +130,98 @@ def test_soak_300_rounds_churn_and_adversary():
     for f in ("fmd", "mmd", "mfp", "imd"):
         arr = np.asarray(getattr(sc, f))
         assert np.isfinite(arr).all() and (arr >= 0).all(), f
+
+
+@pytest.mark.slow
+def test_soak_phase_engine_300_rounds():
+    """The phase engine under the same sustained load: 300 rounds as
+    ~38 phases of r=8 with churn + silent adversaries. Same standing
+    invariants — finite scores, healthy mesh, adversary deficit, live
+    delivery — plus continuity across hundreds of phase boundaries."""
+    from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+        make_gossipsub_phase_step,
+    )
+
+    n, m, r_phase, phases = 60, 64, 8, 38
+    rng = np.random.default_rng(42)
+    topo = graph.random_connect(n, d=6, seed=1)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    adversary = np.zeros(n, bool)
+    adversary[rng.choice(n, size=6, replace=False)] = True
+
+    tp = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.01,
+        time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=10.0,
+        first_message_deliveries_weight=1.0,
+        first_message_deliveries_cap=50.0,
+        first_message_deliveries_decay=0.9,
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_decay=0.9,
+        mesh_message_deliveries_threshold=2.0,
+        mesh_message_deliveries_cap=10.0,
+        mesh_message_deliveries_activation=10,
+        mesh_failure_penalty_weight=-1.0,
+        mesh_failure_penalty_decay=0.9,
+        invalid_message_deliveries_weight=-10.0,
+        invalid_message_deliveries_decay=0.9,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp}, skip_app_specific=True,
+        behaviour_penalty_weight=-10.0, behaviour_penalty_threshold=0.0,
+        behaviour_penalty_decay=0.9, ip_colocation_factor_weight=0.0,
+    )
+    thr = PeerScoreThresholds(
+        gossip_threshold=-10.0, publish_threshold=-20.0,
+        graylist_threshold=-40.0,
+    )
+    cfg = GossipSubConfig.build(
+        dataclasses.replace(GossipSubParams(), flood_publish=False), thr,
+        score_enabled=True,
+    )
+    st = GossipSubState.init(net, m, cfg, score_params=sp, seed=7)
+    pstep = make_gossipsub_phase_step(
+        cfg, net, r_phase, score_params=sp, dynamic_peers=True,
+        adversary_no_forward=adversary,
+    )
+
+    up = np.ones(n, bool)
+    honest = ~adversary
+    for p in range(phases):
+        flips = rng.random(n) < 0.05
+        cand = up.copy()
+        cand[flips & honest] = ~up[flips & honest]
+        if cand.sum() >= int(0.8 * n):
+            up = cand
+        po = np.full((r_phase, 4), -1, np.int32)
+        for i in range(r_phase):
+            k = rng.integers(1, 3)
+            po[i, :k] = rng.choice(np.flatnonzero(up & honest), size=k,
+                                   replace=False)
+        pt = np.where(po >= 0, 0, -1).astype(np.int32)
+        pv = po >= 0
+        st = pstep(st, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv),
+                   jnp.asarray(up), do_heartbeat=True)
+
+    scores = np.asarray(st.scores)
+    assert np.isfinite(scores).all(), "scores must stay finite"
+    mesh = np.asarray(st.mesh)
+    deg = mesh.sum(axis=(1, 2))
+    up_now = np.asarray(st.up)
+    # peers that flipped up in the last phase or two are still regrafting
+    # (grafts cross one phase after the heartbeat that issues them) — the
+    # overwhelming majority of up honest peers must be meshed
+    live_deg = deg[up_now & honest]
+    assert (live_deg >= 1).mean() > 0.85, (live_deg >= 1).mean()
+    assert live_deg.mean() >= cfg.Dlo / 2
+    assert (deg <= cfg.Dhi).all()
+    # adversary edges sit below honest edges on score (deficit + P7 bite)
+    nbr = np.asarray(net.nbr)
+    ok = np.asarray(net.nbr_ok)
+    adv_edge = adversary[np.clip(nbr, 0, None)] & ok
+    hon_edge = ~adversary[np.clip(nbr, 0, None)] & ok
+    assert scores[adv_edge].mean() < scores[hon_edge].mean() - 1.0
+    ev = np.asarray(st.core.events)
+    assert int(ev[EV.DELIVER_MESSAGE]) > 0
