@@ -136,6 +136,20 @@ def make_gossipsub_phase_step(
         score_counts if score_counts is not None
         else os.environ.get("PUBSUB_PHASE_COUNTS", "") == "1"
     )
+    # static weight elision: the topic score params are jit constants, so
+    # attribution planes whose consuming weights are zero EVERYWHERE can
+    # be skipped at build time. The mmd counter has TWO consumers: P3
+    # (deficit via w3, compute_scores) and the sticky P3b mesh-failure
+    # penalty (on_prune folds deficit^2 into mfp whenever w3b != 0 and
+    # thr3 > 0 — score/engine.py on_prune), so the in-window mesh-credit
+    # plane stays live if EITHER is weighted for any topic. The honest-
+    # net bench configs zero both, dropping one of the two [N,K,W]
+    # OR+store passes per sub-round. imd's only consumer is P4 via w4.
+    _w3 = np.asarray(consts.tpa.w3)
+    _w3b = np.asarray(consts.tpa.w3b)
+    _thr3 = np.asarray(consts.tpa.thr3)
+    p3_live = bool(np.any(_w3 != 0.0) or np.any((_w3b != 0.0) & (_thr3 > 0.0)))
+    p4_live = bool(np.any(np.asarray(consts.tpa.w4) != 0.0))
 
     def _phase(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
                do_heartbeat: bool) -> GossipSubState:
@@ -219,17 +233,23 @@ def make_gossipsub_phase_step(
         # needs cross-sub-round word algebra).
         count_score = cfg.score_enabled and val_delay == 0 and use_counts
         plane_score = cfg.score_enabled and not count_score
+        # elision keeps the score values bit-identical (the elided term
+        # multiplies by a zero weight everywhere) but changes what the
+        # unread counters show to introspection: imd reads 0; mmd still
+        # accrues first-arrival credit (on_deliveries adds it regardless)
+        # but not the near-first/window portion — an undercount, pinned
+        # by tests/test_phase.py::test_phase_static_weight_elision
         # (an attempted round-4 optimization derived P4 from the
         # first-edge plane, on the theory that invalid messages travel
         # exactly one hop; FALSIFIED by the r=1 bit-exactness tests — an
         # origin advertises and IWANT-serves its own invalid publishes
         # from mcache, so invalid arrivals repeat across rounds on the
         # same edge. The trans plane stays.)
-        trans_acc = zkw if plane_score else None
+        trans_acc = zkw if (plane_score and p4_live) else None
         new_acc = zw if plane_score else None
         recv_acc = zw if plane_score else None
         accepted_acc = zw if (plane_score or cfg.gater_enabled) else None
-        mcw_acc = zkw if plane_score else None
+        mcw_acc = zkw if (plane_score and p3_live) else None
         if count_score:
             zsc = jnp.zeros((n_peers, s_slots, k_dim), jnp.float32)
             fmd_counts, mmd_counts, imd_counts = zsc, zsc, zsc
@@ -326,11 +346,12 @@ def make_gossipsub_phase_step(
             # transmits at most once per phase) ---------------------------
             if plane_score:
                 new_acc = new_acc | info.new_words
-                trans_acc = trans_acc | info.trans
                 recv_acc = recv_acc | info.recv_new_words
+                if trans_acc is not None:
+                    trans_acc = trans_acc | info.trans
             if accepted_acc is not None:
                 accepted_acc = accepted_acc | accepted_new
-            if cfg.score_enabled:
+            if cfg.score_enabled and (p3_live or count_score):
                 # P3 window gate at this arrival's own tick (score.go:
                 # 944-974 markDuplicateMessageDelivery window check)
                 msg_window = consts.window_rounds_t[jnp.clip(msgs.topic, 0)]
@@ -348,7 +369,7 @@ def make_gossipsub_phase_step(
                 mmd_counts = mmd_counts + per_slot_counts(mesh_w, slotw)
                 fmd_counts = fmd_counts + per_slot_counts(fa_w, slotw)
                 imd_counts = imd_counts + per_slot_counts(inv_w, slotw)
-            elif plane_score:
+            elif plane_score and p3_live:
                 mcw_i = info.trans & within_i[:, None, :]
                 if val_delay > 0:
                     # duplicates arriving while the message sits in the
@@ -403,9 +424,11 @@ def make_gossipsub_phase_step(
             kw2 = keep_w[None, :]
             if plane_score:
                 new_acc = new_acc & kw2
-                mcw_acc = mcw_acc & kw3
-                trans_acc = trans_acc & kw3
                 recv_acc = recv_acc & kw2
+                if mcw_acc is not None:
+                    mcw_acc = mcw_acc & kw3
+                if trans_acc is not None:
+                    trans_acc = trans_acc & kw3
             if accepted_acc is not None:
                 accepted_acc = accepted_acc & kw2
             if cfg.gater_enabled:
@@ -435,13 +458,14 @@ def make_gossipsub_phase_step(
             )
         elif plane_score:
             score = on_deliveries(
-                score, net_l, mesh2, tp, trans_acc, new_acc,
+                score, net_l, mesh2, tp,
+                trans_acc if trans_acc is not None else zkw, new_acc,
                 dlv.fe_words, dlv.first_round,
                 msgs.topic, msgs.valid, tick_last, consts.window_rounds_t,
                 msg_ignored=msgs.ignored,
                 slotw=slot_topic_words(net_l, msgs.topic),
                 recv_new_words=recv_acc,
-                mesh_credit_words=mcw_acc,
+                mesh_credit_words=mcw_acc if mcw_acc is not None else zkw,
             )
         gater_state = st2.gater
         if cfg.gater_enabled:

@@ -366,3 +366,83 @@ def test_phase_count_path_retains_recycled_credit():
     fb = float(np.asarray(sb.score.fmd).sum())
     assert fb >= fa
     assert fb > fa, "expected recycling to bite in this workload"
+
+
+def test_phase_static_weight_elision_scores_exact():
+    """With mesh_message_deliveries_weight=0 everywhere (the honest-net
+    bench shape) the phase engine skips the in-window mesh-credit plane:
+    every state plane except the untracked mmd counter stays bit-exact vs
+    the per-round step at r=1, and the SCORES are identical (the elided
+    term multiplies by zero)."""
+    tp0 = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+    )
+    sp = PeerScoreParams(
+        topics={t: tp0 for t in range(T)}, skip_app_specific=True,
+        behaviour_penalty_weight=-1.0, behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    topo = graph.random_connect(N, D, seed=47)
+    subs = graph.subscribe_random(N, n_topics=T, topics_per_peer=2, seed=47)
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True
+    )
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=47)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp)
+    po, pt, pv = schedule(14, seed=47, codes=True)
+    sa = run_per_round(step, st, po, pt, pv)
+    sb = run_phase(pstep,
+                   GossipSubState.init(net, M, cfg, score_params=sp, seed=47),
+                   po, pt, pv, 1)
+    # scores identical; everything except the untracked mmd counter exact
+    np.testing.assert_allclose(np.asarray(sa.scores), np.asarray(sb.scores),
+                               rtol=1e-6)
+    assert np.array_equal(np.asarray(sa.core.dlv.have),
+                          np.asarray(sb.core.dlv.have))
+    assert np.array_equal(np.asarray(sa.core.dlv.first_round),
+                          np.asarray(sb.core.dlv.first_round))
+    assert np.array_equal(np.asarray(sa.score.imd), np.asarray(sb.score.imd))
+    assert np.array_equal(np.asarray(sa.score.fmd), np.asarray(sb.score.fmd))
+    # the elided in-window plane leaves mmd tracking first-arrival credit
+    # only (on_deliveries adds it regardless); near-first credit is the
+    # untracked part — the counter undercounts, the score is untouched
+    ma, mb = np.asarray(sa.score.mmd), np.asarray(sb.score.mmd)
+    assert (mb <= ma + 1e-6).all()
+    assert mb.sum() < ma.sum()
+
+
+def test_phase_no_elision_when_p3b_live():
+    """w3=0 but the sticky mesh-failure penalty live (default w3b=-1,
+    thr3>0): mmd feeds on_prune's deficit, so the mesh-credit plane must
+    NOT be elided — full bit-exactness vs per-round, mmd included (the
+    round-4 review's failure scenario)."""
+    tp0 = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0,
+        # mesh_failure_penalty_weight keeps its default (-1): P3b live
+        mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_activation=6.0,
+    )
+    sp = PeerScoreParams(
+        topics={t: tp0 for t in range(T)}, skip_app_specific=True,
+        behaviour_penalty_weight=-1.0, behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    topo = graph.random_connect(N, D, seed=53)
+    subs = graph.subscribe_random(N, n_topics=T, topics_per_peer=2, seed=53)
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True
+    )
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=53)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp)
+    po, pt, pv = schedule(14, seed=53)
+    sa = run_per_round(step, st, po, pt, pv)
+    sb = run_phase(pstep,
+                   GossipSubState.init(net, M, cfg, score_params=sp, seed=53),
+                   po, pt, pv, 1)
+    assert_states_equal(sa, sb, "p3b-live/")
+    assert float(np.asarray(sb.score.mmd).sum()) > 0.0  # plane tracked
