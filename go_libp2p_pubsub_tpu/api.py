@@ -898,6 +898,12 @@ class Network:
                 score_params=self.score_params,
                 gater_params=self.gater_params, dynamic_peers=True,
                 sub_knowledge_holes=self._sub_holes,
+                # the API owns the inspect surface (peer_score_snapshots,
+                # score.go:120-177's always-exact contract), so its builds
+                # never elide attribution planes — counters stay
+                # reference-faithful; the tracer-detached bench path
+                # (bench.py builds the step directly) keeps elision
+                exact_counters=True,
             )
             return
         self._step = make_gossipsub_step(
@@ -1437,7 +1443,20 @@ class Network:
         this). Half the table per phase leaves the other half for the
         previous phases' delivery tails; excess publishes stay queued for
         the next phase (the reference's publish path backpressures the
-        same way when its validation frontend saturates)."""
+        same way when its validation frontend saturates).
+
+        The cap protects exactly ONE phase of delivery tail: at sustained
+        cap-rate publishing a slot is recycled two phases after
+        allocation, so messages whose propagation spans 2+ phases (small
+        rounds_per_phase relative to network diameter) can still lose
+        their first_round stamp before the boundary drain sees it —
+        subscriber deliveries silently drop. That is the r-dependent slot
+        TTL constraint (state.py MsgTable documents the per-round form):
+        slots live ~msg_slots/publish-rate ROUNDS, and a phase consumes r
+        of them per drain opportunity. _run_phase warns when consecutive
+        phases saturate the cap; size msg_slots >= 2 * cap_rate *
+        ceil(diameter / r + 1) (or lower the publish rate) to keep tails
+        drainable."""
         jnp = self._jnp
         r = self.rounds_per_phase
         po = np.full((r, self.pub_width), -1, np.int32)
@@ -1456,6 +1475,22 @@ class Network:
                 po[i, j], pt[i, j], pv[i, j] = origin, tid, verdict
                 batch.append((flat, msg, mid))
                 flat += 1
+        # sustained cap-rate publishing shortens the slot TTL below the
+        # delivery tail (see docstring): surface it instead of silently
+        # dropping late receipts
+        if flat >= cap and self._pub_queue:
+            self._saturated_phases = getattr(self, "_saturated_phases", 0) + 1
+            if self._saturated_phases == 2:
+                _log.warning(
+                    "publish admission saturated the per-phase cap (%d = "
+                    "msg_slots // 2) for consecutive phases: slots now "
+                    "recycle two phases after allocation, and receipts of "
+                    "messages still propagating then are silently dropped. "
+                    "Raise msg_slots, raise rounds_per_phase, or lower the "
+                    "publish rate.", cap,
+                )
+        else:
+            self._saturated_phases = 0
         prev = snapshot(self.state)
         args = (self.state, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
         if self._dynamic:

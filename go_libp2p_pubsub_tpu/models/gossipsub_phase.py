@@ -35,7 +35,7 @@ gate is evaluated per sub-round against each arrival's own tick
 (on_deliveries(mesh_credit_words=...)), keeping window semantics at
 1-round resolution.
 
-Known deviations vs the per-round step, both bounded in PARITY.md:
+Known deviations vs the per-round step, all bounded in PARITY.md:
   * control actions (grafts taking effect, gossip emission, IWANT
     service, score refresh, gater decisions) lag up to r-1 rounds — the
     reference's own control lags up to a full heartbeat interval;
@@ -43,7 +43,19 @@ Known deviations vs the per-round step, both bounded in PARITY.md:
     in the same phase* earn no score/gater credit (per-round attribution
     ran before each round's publishes; phase attribution runs at phase
     end, after recycled columns are cleared). Slots live M/publish-rate
-    rounds, so this touches only messages already ~fully propagated.
+    rounds, so this touches only messages already ~fully propagated;
+  * heartbeat-tick quantization: the heartbeat always executes at the
+    phase TAIL with ``tick_last``, while the schedule owner
+    (driver.heartbeat_schedule) flags a phase when ANY tick in its
+    window [t, t+r) is ≡ 0 (mod heartbeat_every). When heartbeat_every
+    is a multiple of rounds_per_phase (every bench/driver default) the
+    nominal tick IS the phase tail and there is no drift; when it is
+    not, the executed heartbeat tick drifts up to r-1 rounds from the
+    nominal schedule tick, so backoff expiry and fanout-TTL expiry —
+    which compare against tick — quantize to phase tails. Callers
+    choosing ``heartbeat_every % rounds_per_phase != 0`` accept that
+    quantization (the reference's own timers are heartbeat-quantized
+    the same way: backoff slack, gossipsub.go:1596).
 """
 
 from __future__ import annotations
@@ -99,6 +111,7 @@ def make_gossipsub_phase_step(
     adversary_no_forward: np.ndarray | None = None,
     sub_knowledge_holes: np.ndarray | None = None,
     score_counts: bool | None = None,
+    exact_counters: bool = False,
 ):
     """Build the jitted multi-round phase step.
 
@@ -145,11 +158,22 @@ def make_gossipsub_phase_step(
     # plane stays live if EITHER is weighted for any topic. The honest-
     # net bench configs zero both, dropping one of the two [N,K,W]
     # OR+store passes per sub-round. imd's only consumer is P4 via w4.
+    #
+    # ``exact_counters=True`` disables elision outright: scores are
+    # bit-identical either way (the elided term multiplies by zero), but
+    # elision leaves the UNREAD counters non-reference-faithful (mmd
+    # undercounts near-first credit, mfp can overcount — see the loop
+    # comment below). The reference's inspect surface is exact always
+    # (score.go:120-177), so any build with a score inspector / snapshot
+    # consumer attached (api.Network: peer_score_snapshots) must pass
+    # this; the tracer-detached bench keeps elision.
     _w3 = np.asarray(consts.tpa.w3)
     _w3b = np.asarray(consts.tpa.w3b)
     _thr3 = np.asarray(consts.tpa.thr3)
-    p3_live = bool(np.any(_w3 != 0.0) or np.any((_w3b != 0.0) & (_thr3 > 0.0)))
-    p4_live = bool(np.any(np.asarray(consts.tpa.w4) != 0.0))
+    p3_live = exact_counters or bool(
+        np.any(_w3 != 0.0) or np.any((_w3b != 0.0) & (_thr3 > 0.0))
+    )
+    p4_live = exact_counters or bool(np.any(np.asarray(consts.tpa.w4) != 0.0))
 
     def _phase(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
                do_heartbeat: bool) -> GossipSubState:

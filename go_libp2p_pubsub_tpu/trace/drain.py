@@ -362,12 +362,19 @@ class TraceSession:
 
         # control crossing this round: the PREV snapshot's outboxes (the
         # engine's one-RTT outbox model — written last round, gathered by
-        # the far end this round)
+        # the far end this round). Liveness gates with NEW.up: the engine
+        # applies peer down-transitions — clearing down edges' outboxes
+        # and masking the gather — BEFORE the control exchange of the
+        # same round (apply_peer_transitions precedes control_exchange;
+        # live_step_views builds the exchange's net_l from eff_next), so
+        # a peer downed at round t neither sends nor receives control at
+        # round t. edge_live stays PREV: px_connect's edge_live_next is
+        # applied at the round tail, after the exchange.
         live = (
             prev.edge_live if prev.edge_live is not None else (nbr >= 0)
         ) & (nbr >= 0)
-        if prev.up is not None:
-            live = live & prev.up[:, None] & prev.up[np.clip(nbr, 0, None)]
+        if new.up is not None:
+            live = live & new.up[:, None] & new.up[np.clip(nbr, 0, None)]
         ctrl: dict[tuple[int, int], dict] = {}
 
         def centry(s, p):

@@ -446,3 +446,94 @@ def test_phase_no_elision_when_p3b_live():
                    po, pt, pv, 1)
     assert_states_equal(sa, sb, "p3b-live/")
     assert float(np.asarray(sb.score.mmd).sum()) > 0.0  # plane tracked
+
+
+def test_phase_exact_counters_disables_elision():
+    """exact_counters=True (the api.Network build flag): even with every
+    elidable weight zeroed, ALL counters stay bit-exact vs the per-round
+    step — the reference's always-exact inspect surface
+    (score.go:120-177). This is the introspection-safety contract:
+    peer_score_snapshots consumers never see elided counters."""
+    tp0 = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+    )
+    sp = PeerScoreParams(
+        topics={t: tp0 for t in range(T)}, skip_app_specific=True,
+        behaviour_penalty_weight=-1.0, behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    topo = graph.random_connect(N, D, seed=47)
+    subs = graph.subscribe_random(N, n_topics=T, topics_per_peer=2, seed=47)
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True
+    )
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=47)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp,
+                                      exact_counters=True)
+    po, pt, pv = schedule(14, seed=47, codes=True)
+    sa = run_per_round(step, st, po, pt, pv)
+    sb = run_phase(pstep,
+                   GossipSubState.init(net, M, cfg, score_params=sp, seed=47),
+                   po, pt, pv, 1)
+    # full bit-exactness INCLUDING the counters elision would corrupt
+    assert_states_equal(sa, sb, "exact-counters/")
+    # and the elidable planes actually accrued (the test would be vacuous
+    # on a workload where no near-first/invalid deliveries happen)
+    assert float(np.asarray(sb.score.mmd).sum()) > 0.0
+    assert float(np.asarray(sb.score.imd).sum()) > 0.0
+
+
+def test_phase_api_network_snapshots_exact_counters():
+    """api.Network(rounds_per_phase=r) builds with exact_counters: the
+    peer_score_snapshots surface shows reference-faithful counters even
+    on an all-weights-zero (maximally elidable) config."""
+    from go_libp2p_pubsub_tpu.api import Network
+
+    tp0 = TopicScoreParams(
+        mesh_message_deliveries_weight=0.0,
+        mesh_failure_penalty_weight=0.0,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp0}, skip_app_specific=True,
+        behaviour_penalty_weight=-1.0, behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+
+    def build_net(r):
+        netw = Network(score_params=sp, seed=11, rounds_per_phase=r,
+                       msg_slots=M)
+        nodes = netw.add_nodes(16)
+        netw.sparse_connect(d=4, seed=11)
+        subs = [n.join("t").subscribe() for n in nodes]
+        netw.start()
+        return netw, nodes
+
+    na, nodes_a = build_net(1)
+    nb, nodes_b = build_net(4)
+    for _ in range(3):
+        nodes_a[0].topics["t"].publish(b"x")
+        nodes_b[0].topics["t"].publish(b"x")
+        na.run(4)
+        nb.run(4)
+    for i in range(16):
+        snap_a = nodes_a[i].peer_score_snapshots()
+        snap_b = nodes_b[i].peer_score_snapshots()
+        assert snap_a.keys() == snap_b.keys()
+        for pid, ss_a in snap_a.items():
+            ss_b = snap_b[pid]
+            for t_name, ts_a in ss_a.topics.items():
+                ts_b = ss_b.topics[t_name]
+                # the phase build must not elide: fmd/mmd/imd all tracked
+                # (values can differ by the designed r-round control
+                # latency, but an elided counter would be identically 0
+                # network-wide while the r=1 run accrues)
+                assert ts_b.mesh_message_deliveries >= 0.0
+    # elision would zero mmd network-wide; exact_counters keeps it live.
+    # compare network totals within the control-latency tolerance
+    mmd_a = float(np.asarray(na.state.score.mmd).sum())
+    mmd_b = float(np.asarray(nb.state.score.mmd).sum())
+    if mmd_a > 0:
+        assert mmd_b > 0, "phase build elided the mmd plane"

@@ -215,3 +215,81 @@ def test_api_network_exact_trace():
     assert any(
         len(e.sendRPC.meta.messages) == 0 for e in ev.get(T.SEND_RPC, [])
     )
+
+
+def test_exact_control_rpcs_respect_churn():
+    """A peer downed at round t gets NO control-only RPC events at round
+    t: the engine applies peer down-transitions — clearing down edges'
+    outboxes and masking the gather — BEFORE the same round's control
+    exchange (apply_peer_transitions precedes control_exchange), so the
+    drain must gate the prev-outbox expansion with POST-transition
+    liveness. The round-4 advisor repro: with prev.up gating, a downed
+    peer still showed SEND_RPC/RECV_RPC (IHAVE) events the device never
+    transmitted."""
+    import jax.numpy as jnp
+
+    n, d, n_topics, m, seed = 32, 6, 2, 32, 3
+    topo = graph.random_connect(n, d, seed=seed)
+    subs = graph.subscribe_random(n, n_topics=n_topics, topics_per_peer=2,
+                                  seed=seed)
+    net = Net.build(topo, subs)
+    cfg = dataclasses.replace(GossipSubConfig.build(), trace_exact=True)
+    st = GossipSubState.init(net, m, cfg, seed=seed)
+    step = make_gossipsub_step(cfg, net, dynamic_peers=True)
+    sink = MemSink()
+    sess = drain.TraceSession(net, [sink], queue_cap=0, exact=True)
+    sess.emit_init(drain.snapshot(st))
+    rng = np.random.default_rng(seed)
+    up = np.ones(n, bool)
+    down_peer, down_round = 0, 8
+    for i in range(14):
+        p = 3
+        po = rng.integers(0, n, size=p).astype(np.int32)
+        pt = rng.integers(0, n_topics, size=p).astype(np.int32)
+        pv = np.ones(p, bool)
+        if i >= 10:
+            po[:] = -1
+        if i == down_round:
+            # the interesting case: the downed peer must have control
+            # pending in its outboxes (heartbeat_every=1 repopulates
+            # IHAVE each round) — otherwise there is no control to
+            # phantom-emit and the test is vacuous
+            prev_snap = drain.snapshot(st)
+            assert (prev_snap.ihave_out[down_peer].any()
+                    or prev_snap.graft_out[down_peer].any()), \
+                "precondition: downed peer needs pending control"
+            up[down_peer] = False
+        prev = drain.snapshot(st)
+        st = step(st, jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv),
+                  jnp.asarray(up))
+        sess.observe(prev, drain.snapshot(st), po, pt, pv)
+    final = drain.snapshot(st)
+    sess.close(final)
+    ev = by_type(sink.events)
+
+    down_pid = drain.peer_id(down_peer)
+    down_ts = down_round * sess.tick_ns
+    # no RPC traffic involving the downed peer from its down round on
+    # (it stays down; its edges died with it)
+    for e in ev.get(T.SEND_RPC, []):
+        if e.timestamp >= down_ts:
+            assert e.peerID != down_pid, \
+                "downed peer emitted a phantom SEND_RPC"
+            assert e.sendRPC.sendTo != down_pid, \
+                "downed peer received a phantom RPC (send side)"
+    for e in ev.get(T.RECV_RPC, []):
+        if e.timestamp >= down_ts:
+            assert e.peerID != down_pid
+            assert e.recvRPC.receivedFrom != down_pid
+    # and the downed peer delivers/duplicates nothing after going down
+    for typ, field in ((T.DELIVER_MESSAGE, "deliverMessage"),
+                       (T.DUPLICATE_MESSAGE, "duplicateMessage")):
+        for e in ev.get(typ, []):
+            if e.timestamp >= down_ts:
+                assert e.peerID != down_pid
+    # accounting still reconciles under churn (message-grained)
+    counters = drain.TraceSession.counter_events(final)
+    sent_msgs = sum(len(e.sendRPC.meta.messages)
+                    for e in ev.get(T.SEND_RPC, []))
+    assert sent_msgs == counters["SEND_RPC"]
+    assert len(ev.get(T.DUPLICATE_MESSAGE, [])) == counters["DUPLICATE_MESSAGE"]
