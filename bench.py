@@ -308,6 +308,10 @@ def main():
             cont = measure(n_peers, 1, 1, min(seg, 800), reps=2)
             if cont is not None:
                 out["continuity_r1_ticks_per_sec"] = round(cont[0], 2)
+                # the r=1 build has different buffer shapes and may OOM-
+                # fall back to a smaller N than the headline — record the
+                # size the continuity rate was actually measured at
+                out["continuity_r1_n"] = cont[1]
     print(json.dumps(out))
 
 

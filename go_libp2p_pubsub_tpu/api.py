@@ -442,16 +442,16 @@ class Network:
         if rounds_per_phase > 1:
             # the multi-round phase engine (models/gossipsub_phase.py):
             # control every r rounds, the reference's continuous-delivery
-            # timing shape — the bench's production cadence, surfaced here
-            # for API workloads that don't need per-round observation
+            # timing shape — the bench's production cadence. All observers
+            # (trace_sinks / track_tags / trace_exact) work at this
+            # cadence too: the drains consume phase-boundary snapshots,
+            # reconstructing per-sub-round DELIVER/PUBLISH timestamps
+            # from the device's first_round stamps and emitting control/
+            # duplicate/mesh events at boundary resolution (trace/drain
+            # module docstring). The reference never turns its router
+            # observers off for cadence reasons (trace.go:63-530).
             if router != "gossipsub":
                 raise APIError("rounds_per_phase requires the gossipsub router")
-            if trace_sinks or track_tags or trace_exact:
-                raise APIError(
-                    "rounds_per_phase > 1 is incompatible with per-round "
-                    "observers (trace_sinks / track_tags / trace_exact): "
-                    "the reconstructive drains diff consecutive rounds"
-                )
         if px_connect:
             if router != "gossipsub":
                 raise APIError("px_connect requires the gossipsub router")
@@ -514,6 +514,7 @@ class Network:
         self.topic_ids: dict[str, int] = {}
         self._edges: set[tuple[int, int]] = set()
         self._dormant_pairs: set[tuple[int, int]] = set()
+        self._spare_pool: list[Node] = []  # provision_spare_nodes rows
         self._validators: dict[str, _Validator] = {}
         self._pub_queue: deque = deque()
         self._slot_msg: dict[int, rpc_pb2.Message] = {}
@@ -540,7 +541,39 @@ class Network:
                  sub_filter: SubscriptionFilter | None = None,
                  seed: int | None = None,
                  author: Identity | None = None) -> Node:
-        self._check_not_started("add_node")
+        """Add a node. Pre-start: grows the assembly graph. POST-start:
+        claims a pre-provisioned spare row (provision_spare_nodes) — the
+        jit-constant analogue of the reference admitting unknown peers at
+        any moment (pubsub.go:614-646, notify.go:19-75): the row's padded
+        adjacency, subscription template, and score/gater planes were
+        compiled in at start(); claiming flips its liveness, with NO
+        recompile. The claimed node keeps its provisioned identity,
+        protocol, and topic template (join new topics via the runtime
+        Join path, which does rebuild). Raises when the pool is empty —
+        restart() is then the capacity-growing path."""
+        if self.started:
+            if not self._spare_pool:
+                raise APIError(
+                    "add_node after start(): the spare-node pool is empty "
+                    "— provision capacity pre-start with "
+                    "provision_spare_nodes(n), or restart() to grow the "
+                    "topology (jit-constant adjacency)"
+                )
+            if (protocol != "/meshsub/1.1.0" or ip is not None
+                    or sub_filter is not None or seed is not None
+                    or author is not None):
+                # a claim returns the PROVISIONED row; silently dropping
+                # a requested configuration would hand back a node with
+                # the wrong protocol/identity
+                raise APIError(
+                    "add_node after start() claims a pre-provisioned "
+                    "spare row and cannot honor per-node arguments — "
+                    "configure rows at provision_spare_nodes() time"
+                )
+            node = self._spare_pool.pop(0)
+            node._spare = False
+            node.up = True  # the liveness plane applies it next round
+            return node
         self.protocol_matcher.level(protocol)  # fail fast on unknown ids
         idx = len(self.nodes)
         ident = Identity.generate(self.seed * 1_000_003 + idx if seed is None else seed)
@@ -550,6 +583,54 @@ class Network:
 
     def add_nodes(self, n: int, **kw) -> list[Node]:
         return [self.add_node(**kw) for _ in range(n)]
+
+    def provision_spare_nodes(self, count: int, topics=(), degree: int = 4,
+                              candidates: "list[Node] | None" = None,
+                              seed: int = 0, **node_kw) -> "list[Node]":
+        """Pre-start capacity pool for post-start add_node() (round-4
+        review item 9: dormant PEER rows, not just edge slots).
+
+        Each spare is a real row in the compiled state: DOWN at start
+        (liveness plane), with `topics` pre-joined as its subscription
+        template (invisible while down — down peers neither transmit nor
+        receive, and mesh selection skips them) and `degree` dormant
+        edges provisioned to random `candidates` (default: all current
+        non-spare nodes). Claiming via add_node() post-start flips the
+        row up; connect() then activates its dormant pairs on the live
+        state — delivery flows the next round, zero recompiles, and the
+        next heartbeat grafts it into its topics' meshes (the runtime-
+        Join formation the reference gets from handleNewPeer + Join).
+
+        The capacity contract is explicit where the reference's is
+        implicit (memory): rows, their candidate edges, and their topic
+        template are sized pre-start; anything outside the template goes
+        through the rebuild paths (runtime Join / restart)."""
+        self._check_not_started("provision_spare_nodes")
+        if self.router != "gossipsub":
+            raise APIError("spare rows require the gossipsub router "
+                           "(liveness + edge-liveness planes)")
+        rng = np.random.default_rng(seed ^ 0x5BA2E)
+        cand = [
+            nd for nd in (candidates if candidates is not None else self.nodes)
+            if not getattr(nd, "_spare", False)
+        ]
+        if not cand:
+            raise APIError("provision_spare_nodes needs existing non-spare "
+                           "candidate neighbors")
+        spares = []
+        for _ in range(count):
+            nd = self.add_node(**node_kw)
+            nd._spare = True
+            nd.up = False
+            for t in topics:
+                nd.join(t)
+            picks = rng.choice(len(cand), size=min(degree, len(cand)),
+                               replace=False)
+            for j in picks:
+                self.connect(nd, cand[int(j)], dormant=True)
+            spares.append(nd)
+        self._spare_pool.extend(spares)
+        return spares
 
     def connect(self, a: Node, b: Node, dormant: bool = False) -> None:
         """a dials b (direction recorded for the outbound quota).
@@ -1015,6 +1096,17 @@ class Network:
                 exact=self.trace_exact,
             )
             self._session.emit_init(snapshot(self.state))
+        if self.rounds_per_phase > 1:
+            # formation prelude (driver-owned cold start): the phase
+            # engine's first heartbeat fires at the first phase TAIL, so
+            # a publish in phase 0 would find no mesh and lose most of
+            # the network. One publish-free phase here forms the mesh
+            # (tail heartbeat = Join selection; the next phase's control
+            # head ingests the GRAFTs), so publishing right after
+            # start() behaves like the reference's immediate Join
+            # (gossipsub.go:1015-1064). Costs rounds_per_phase ticks of
+            # simulated time before round 0 of user traffic.
+            self._advance_empty_round()
 
     # -- publish path ------------------------------------------------------
 
@@ -1504,11 +1596,16 @@ class Network:
         if prev.up is not None and new.up is not None:
             self._emit_membership_events(prev.up, new.up)
         # slot mapping replicates allocate_publishes' running cursor over
-        # the phase's flattened publish order
+        # the phase's flattened publish order — recorded BEFORE observe()
+        # so the trace session's mid_fn sees the real messages
         for flat_idx, msg, mid in batch:
             slot = (prev.cursor + flat_idx) % self.msg_slots
             self._slot_msg[slot] = msg
             self._seen_mids[mid] = slot
+        if self._session is not None:
+            self._session.observe(prev, new, po, pt, pv)
+        if self.tag_tracer is not None:
+            self.tag_tracer.observe(prev, new)
         self._drain_deliveries(prev, new)
         if self.px_connect:
             self._px_connect_pass()

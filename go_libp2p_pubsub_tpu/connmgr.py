@@ -131,8 +131,12 @@ class TagTracer:
         self.slot_of = np.asarray(net.slot_of)
 
     def observe(self, prev, new) -> None:
-        """Consume one round transition (Snapshot pair from trace.drain)."""
-        first = (new.first_round == prev.tick) & (new.first_edge >= 0) \
+        """Consume one step transition (Snapshot pair from trace.drain).
+        Range check, not ==: a phase step (rounds_per_phase > 1) advances
+        several ticks at once and stamps first_round per sub-round — all
+        of a phase's first deliveries bump at the boundary."""
+        first = (new.first_round >= prev.tick) \
+            & (new.first_round < new.tick) & (new.first_edge >= 0) \
             & new.msg_valid[None, :]
         peers, msgs = np.nonzero(first)
         if peers.size:

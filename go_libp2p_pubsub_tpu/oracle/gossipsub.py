@@ -73,7 +73,19 @@ class OracleGossipSub:
         assert self.cfg.score_enabled == (self.score_params is not None), (
             "score_params must accompany score_enabled"
         )
-        assert self.cfg.heartbeat_every == 1
+        # heartbeat_every = h > 1 is the reference's ACTUAL timing shape
+        # (gossipsub.go:1278-1301): delivery + control PROCESSING stay
+        # continuous (every round — the reference handles GRAFT/PRUNE/
+        # IHAVE/IWANT on RPC arrival), while the heartbeat batch — score
+        # refresh + memoization, promise penalties, backoff clear, mesh
+        # maintenance, fanout maintenance, gossip EMISSION, mcache shift
+        # — runs only at ticks ≡ h-1 (mod h), the same executed ticks as
+        # the phase engine's tail heartbeat at rounds_per_phase = h. This
+        # is the oracle anchor for the phase-vs-reference parity rows
+        # (tests/test_parity_phase_oracle.py): unlike the phase engine it
+        # does NOT defer control ingest/service, so the measured distance
+        # includes the phase engine's extra control-batching latency.
+        assert self.cfg.heartbeat_every >= 1
         if self.cfg.validation_delay_topic is not None:
             assert len(self.cfg.validation_delay_topic) == self.subs.n_topics, (
                 "validation_delay_topic must cover every topic"
@@ -568,9 +580,23 @@ class OracleGossipSub:
         for pub in publishes:
             self.publish(*pub)
 
-        # 7. heartbeat
+        # 7. heartbeat — every h-th round only (h = cfg.heartbeat_every).
+        # The one-shot outboxes written by the LAST heartbeat were
+        # ingested by neighbors in steps 1-3 above, so they clear now
+        # either way (the engine zeroes graft_out/ihave_out every step
+        # the same way); prune responses to rejected grafts go out every
+        # round (the reference PRUNEs inline in handleGraft,
+        # gossipsub.go:785-808). Heartbeats execute at ticks ≡ h-1
+        # (mod h) — the phase engine's tail-heartbeat ticks — so the two
+        # cadences' timers (backoff expiry, opportunistic-graft schedule,
+        # promise deadlines) compare identical tick values.
         self.prune_out = prune_resp
-        self._heartbeat()
+        self.graft_out = [set() for _ in range(n)]
+        hbe = cfg.heartbeat_every
+        if self.tick % hbe == hbe - 1:
+            self._heartbeat()
+        else:
+            self.ihave_out = [dict() for _ in range(n)]
         self.tick += 1
 
     # -- heartbeat ----------------------------------------------------------

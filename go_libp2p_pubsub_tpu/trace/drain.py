@@ -33,6 +33,17 @@ follow DefaultMsgIdFn = from || seqno (pubsub.go:1041-1043) with per-origin
 monotone seqnos (pubsub.go:1259-1264) assigned host-side at publish.
 Timestamps are tick * tick_ns (integer time base — survey §7: the reference
 already quantizes to heartbeat ticks).
+
+Phase cadence: the same session consumes phase steps (rounds_per_phase =
+r > 1) — one observe() per PHASE. The device stamps `first_round` per
+sub-round and the reconstructive diff recovers per-sub-round timestamps
+for PUBLISH/DELIVER/REJECT (the CDF-bearing events keep 1-round
+resolution, like the engine itself); duplicates, control-only RPCs,
+GRAFT/PRUNE and liveness diffs emit at phase-boundary resolution, stamped
+at the phase head — which for control and peer transitions is the exact
+crossing round (the phase gathers prev outboxes and applies transitions
+once, at its head). The reference traces at its production cadence always
+(trace.go:63-530); this is that contract at the phase engine's cadence.
 """
 
 from __future__ import annotations
@@ -197,26 +208,49 @@ class TraceSession:
         for s in self.sinks:
             s.close()
 
-    # -- per-round observation --------------------------------------------
+    # -- per-round / per-phase observation ---------------------------------
 
     def observe(self, prev: Snapshot, new: Snapshot,
                 pub_origin, pub_topic, pub_valid) -> None:
-        tick = prev.tick  # the round just executed
+        """Consume one step transition. Accepts BOTH cadences:
+
+        * per-round step: pub_* are [P]; ``new.tick - prev.tick == 1``.
+        * phase step (rounds_per_phase = r > 1): pub_* are [r, P];
+          ``new.tick - prev.tick == r``. DELIVER/REJECT events keep
+          per-sub-round timestamps (the device stamps ``first_round`` per
+          sub-round) and PUBLISH events land at their sub-round's tick;
+          duplicate expansion, control-only RPCs, GRAFT/PRUNE mesh diffs
+          and liveness diffs are PHASE-BOUNDARY resolution, stamped at
+          the phase head — which is when control actually crosses (the
+          phase gathers prev outboxes once, at its head) and when peer
+          transitions apply. Boundary coarsening is the drain-side
+          analogue of the engine's r-round control latency; totals stay
+          exact (the accounting suite reconciles them at r > 1 too). One
+          caveat: a mesh edge grafted at the phase head and pruned at
+          the same phase's tail heartbeat (or vice versa) cancels in the
+          boundary diff, so GRAFT/PRUNE *event streams* can undercount
+          the device's mutation counters at r > 1 (rare: requires ingest
+          + immediate heartbeat reversal within one phase).
+        """
+        tick = prev.tick  # the step's first executed round
         m = len(new.msg_topic)
-        # the slot->mid mapping as of the round's START: duplicate arrivals
+        # the slot->mid mapping as of the step's START: duplicate arrivals
         # and control advertisements name the message a slot held BEFORE
-        # this round's publishes recycled it
+        # this step's publishes recycled it
         prev_slot_mid = dict(self.slot_mid) if self.exact else None
 
         # publishes: replicate the allocator's slot assignment
-        # (state.allocate_publishes: slots = cursor + running index, mod M)
+        # (state.allocate_publishes: slots = cursor + running index, mod
+        # M — per sub-round in phase mode, flattened in allocation order)
         po = np.asarray(pub_origin)
         pt = np.asarray(pub_topic)
+        if po.ndim == 1:
+            po, pt = po[None], pt[None]
         is_pub = po >= 0
-        pos = np.cumsum(is_pub) - 1
+        pos = (np.cumsum(is_pub.ravel()) - 1).reshape(is_pub.shape)
         slots = (prev.cursor + pos) % m
-        for j in np.nonzero(is_pub)[0]:
-            origin, slot = int(po[j]), int(slots[j])
+        for i, j in zip(*map(np.ndarray.tolist, np.nonzero(is_pub))):
+            origin, slot = int(po[i, j]), int(slots[i, j])
             sq = int(self.seqno[origin])
             self.seqno[origin] += 1
             if self.mid_fn is not None:
@@ -224,31 +258,35 @@ class TraceSession:
             else:
                 mid = message_id(self.peer_ids[origin], sq)
             self.slot_mid[slot] = mid
-            ev = self._base(trace_pb2.TraceEvent.PUBLISH_MESSAGE, origin, tick)
+            ev = self._base(trace_pb2.TraceEvent.PUBLISH_MESSAGE, origin,
+                            tick + i)
             ev.publishMessage.messageID = mid
-            ev.publishMessage.topic = self.topic_name(int(pt[j]))
+            ev.publishMessage.topic = self.topic_name(int(pt[i, j]))
             self._emit(ev)
 
-        # first receipts this round: first_round == tick with an arrival edge
-        recv = (new.first_round == tick) & (new.first_edge >= 0)
+        # first receipts this step: first_round in [tick, new.tick) with
+        # an arrival edge; each receipt's own stamp is its timestamp
+        recv = (new.first_round >= tick) & (new.first_round < new.tick) \
+            & (new.first_edge >= 0)
         peers, mslots = np.nonzero(recv)
-        # per-(sender,receiver) message counts for the queue model
-        edge_count: dict[tuple[int, int], int] = {}
-        # exact mode: messages per directed edge, grouped into one RPC
-        edge_msgs: dict[tuple[int, int], list] = {}
+        # per-(sender,receiver,round) message counts for the queue model
+        edge_count: dict[tuple[int, int, int], int] = {}
+        # exact mode: messages per directed edge+round, grouped per RPC
+        edge_msgs: dict[tuple[int, int, int], list] = {}
         for p, s in zip(peers.tolist(), mslots.tolist()):
             sender = int(self.nbr[p, new.first_edge[p, s]])
+            t_arr = int(new.first_round[p, s])
             # slot-unique fallback: a shared constant would alias distinct
             # messages in downstream messageID-keyed attribution
             mid = self.slot_mid.get(s, b"?unknown-%d" % s)
             topic = self.topic_name(int(new.msg_topic[s]))
             if new.msg_valid[s]:
-                ev = self._base(trace_pb2.TraceEvent.DELIVER_MESSAGE, p, tick)
+                ev = self._base(trace_pb2.TraceEvent.DELIVER_MESSAGE, p, t_arr)
                 ev.deliverMessage.messageID = mid
                 ev.deliverMessage.topic = topic
                 ev.deliverMessage.receivedFrom = self.peer_ids[sender]
             else:
-                ev = self._base(trace_pb2.TraceEvent.REJECT_MESSAGE, p, tick)
+                ev = self._base(trace_pb2.TraceEvent.REJECT_MESSAGE, p, t_arr)
                 ev.rejectMessage.messageID = mid
                 ev.rejectMessage.receivedFrom = self.peer_ids[sender]
                 # rejection-reason string table (tracer.go:27-39):
@@ -262,28 +300,31 @@ class TraceSession:
             self._emit(ev)
 
             if self.exact:
-                edge_msgs.setdefault((sender, p), []).append((mid, topic))
+                edge_msgs.setdefault((sender, p, t_arr), []).append(
+                    (mid, topic)
+                )
             else:
                 # the message-bearing RPC on this edge (exact for firsts)
-                sev = self._base(trace_pb2.TraceEvent.SEND_RPC, sender, tick)
+                sev = self._base(trace_pb2.TraceEvent.SEND_RPC, sender, t_arr)
                 sev.sendRPC.sendTo = self.peer_ids[p]
                 mm = sev.sendRPC.meta.messages.add()
                 mm.messageID = mid
                 mm.topic = topic
                 self._emit(sev)
-                rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, tick)
+                rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, t_arr)
                 rev.recvRPC.receivedFrom = self.peer_ids[sender]
                 mm = rev.recvRPC.meta.messages.add()
                 mm.messageID = mid
                 mm.topic = topic
                 self._emit(rev)
 
-            key = (sender, p)
+            key = (sender, p, t_arr)
             edge_count[key] = edge_count.get(key, 0) + 1
 
         if self.exact:
             self._observe_exact(prev, new, tick, edge_msgs, edge_count,
-                                prev_slot_mid)
+                                prev_slot_mid,
+                                published_slots=set(slots[is_pub].tolist()))
 
         # outbound-queue model: overflow beyond queue_cap msgs/edge/round
         # drops the RPC (comm.go:139-170 bounded chan; DropRPC trace at
@@ -291,11 +332,15 @@ class TraceSession:
         # unaffected. When the ENGINE enforces real backpressure
         # (GossipSubConfig.queue_cap > 0) construct the session with
         # queue_cap=0 to disable this model; engine drops then show in
-        # counter_events()[DROP_RPC].
+        # counter_events()[DROP_RPC]. Duplicate arrivals (exact mode)
+        # count toward this cap only at r=1 — the phase-accumulated dup
+        # plane has no sub-round info, and folding a phase's dups into
+        # one round would fabricate drops (_observe_exact).
         if self.queue_cap:
-            for (sender, p), cnt in edge_count.items():
+            for (sender, p, t_arr), cnt in edge_count.items():
                 for _ in range(max(0, cnt - self.queue_cap)):
-                    ev = self._base(trace_pb2.TraceEvent.DROP_RPC, sender, tick)
+                    ev = self._base(trace_pb2.TraceEvent.DROP_RPC, sender,
+                                    t_arr)
                     ev.dropRPC.sendTo = self.peer_ids[p]
                     self._emit(ev)
 
@@ -330,35 +375,68 @@ class TraceSession:
     # -- exact per-event expansion (trace.go:166-194, 341-414) -------------
 
     def _observe_exact(self, prev: Snapshot, new: Snapshot, tick: int,
-                       edge_msgs, edge_count, prev_slot_mid) -> None:
+                       edge_msgs, edge_count, prev_slot_mid,
+                       published_slots=frozenset()) -> None:
         """Expand duplicates + control into individual events and emit ONE
-        SendRPC/RecvRPC pair per (sender, receiver) with full RPCMeta —
-        the reference's per-RPC granularity. Duplicate/control content is
-        attributed against the round-START slot->mid mapping (a dup bit
-        names the message its slot held when the arrival happened, even in
-        the message's death round). Note the aggregate SEND_RPC/RECV_RPC
-        device counters stay (edge, message)-grained; in exact mode the
-        per-message total is instead the sum of RPCMeta.messages lengths
-        (tests/test_trace_exact.py pins both accountings)."""
+        SendRPC/RecvRPC pair per (sender, receiver, round) with full
+        RPCMeta — the reference's per-RPC granularity. Duplicate/control
+        content is attributed against the step-START slot->mid mapping (a
+        dup bit names the message its slot held when the arrival
+        happened, even in the message's death round). Note the aggregate
+        SEND_RPC/RECV_RPC device counters stay (edge, message)-grained;
+        in exact mode the per-message total is instead the sum of
+        RPCMeta.messages lengths (tests/test_trace_exact.py pins both
+        accountings).
+
+        Phase cadence (``new.tick - prev.tick`` = r > 1): first-delivery
+        messages group at their own sub-round (their first_round stamp);
+        duplicates — whose plane is phase-accumulated and carries no
+        sub-round info — and control-only RPCs group at the phase-head
+        round ``tick``. For control that stamp is EXACT, not coarsened:
+        the phase engine gathers the prev outboxes once, at its head."""
         nbr = self.nbr
         m = len(new.msg_topic)
 
-        # duplicate arrivals (DuplicateMessage, trace.go:186-194)
+        # duplicate arrivals (DuplicateMessage, trace.go:186-194).
+        # Attribution per slot: the step-START mapping names slots whose
+        # occupant predates this step — exact at r=1 (a message published
+        # this round transmits next round, so it cannot be its own
+        # round's duplicate). At phase cadence a slot PUBLISHED this
+        # phase can collect duplicates of its NEW message from sub-round
+        # publish+2 on, so published slots resolve against the CURRENT
+        # (end-of-phase) mapping instead; the residual ambiguity — an
+        # old occupant of a recycled slot duplicating in its death phase
+        # — picks the new mid, the dominant reading (the admission cap
+        # guarantees recycled occupants are >= 2 phases old, i.e. ~fully
+        # propagated, while the fresh message is actively flooding).
+        per_round = (new.tick - prev.tick) == 1
         if new.dup_trans is not None and new.dup_trans.any():
             widx = np.arange(m) // 32
             bpos = (np.arange(m) % 32).astype(np.uint32)
             bits = ((new.dup_trans[:, :, widx] >> bpos) & 1).astype(bool)
             for p, k, s in zip(*map(np.ndarray.tolist, np.nonzero(bits))):
                 sender = int(nbr[p, k])
-                mid = prev_slot_mid.get(s, b"?unknown-%d" % s)
-                topic = self.topic_name(int(prev.msg_topic[s]))
+                if not per_round and s in published_slots:
+                    mid = self.slot_mid.get(s, b"?unknown-%d" % s)
+                    topic = self.topic_name(int(new.msg_topic[s]))
+                else:
+                    mid = prev_slot_mid.get(s, b"?unknown-%d" % s)
+                    topic = self.topic_name(int(prev.msg_topic[s]))
                 ev = self._base(trace_pb2.TraceEvent.DUPLICATE_MESSAGE, p, tick)
                 ev.duplicateMessage.messageID = mid
                 ev.duplicateMessage.receivedFrom = self.peer_ids[sender]
                 ev.duplicateMessage.topic = topic
                 self._emit(ev)
-                edge_msgs.setdefault((sender, p), []).append((mid, topic))
-                edge_count[(sender, p)] = edge_count.get((sender, p), 0) + 1
+                edge_msgs.setdefault((sender, p, tick), []).append((mid, topic))
+                if per_round:
+                    # the queue model is per-round; at phase cadence the
+                    # dup plane has no sub-round info, and folding r
+                    # rounds of dup traffic into the head round would
+                    # fabricate drops — dups count toward the session
+                    # cap only at r=1 (engine-enforced queue_cap is the
+                    # real backpressure path either way)
+                    edge_count[(sender, p, tick)] = \
+                        edge_count.get((sender, p, tick), 0) + 1
 
         # control crossing this round: the PREV snapshot's outboxes (the
         # engine's one-RTT outbox model — written last round, gathered by
@@ -375,11 +453,13 @@ class TraceSession:
         ) & (nbr >= 0)
         if new.up is not None:
             live = live & new.up[:, None] & new.up[np.clip(nbr, 0, None)]
-        ctrl: dict[tuple[int, int], dict] = {}
+        ctrl: dict[tuple[int, int, int], dict] = {}
 
         def centry(s, p):
+            # control crosses at the step head (one-RTT outbox model)
             return ctrl.setdefault(
-                (s, p), {"graft": [], "prune": [], "ihave": {}, "iwant": []}
+                (s, p, tick),
+                {"graft": [], "prune": [], "ihave": {}, "iwant": []},
             )
 
         for name, outbox in (("graft", prev.graft_out),
@@ -409,14 +489,14 @@ class TraceSession:
                         t = self.topic_name(int(prev.msg_topic[s]))
                         entry["ihave"].setdefault(t, []).append(mid)
 
-        # one RPC record pair per directed edge with any content
-        for s, p in sorted(set(edge_msgs) | set(ctrl)):
+        # one RPC record pair per (directed edge, round) with any content
+        for s, p, t_rpc in sorted(set(edge_msgs) | set(ctrl)):
             meta = trace_pb2.TraceEvent.RPCMeta()
-            for mid, topic in edge_msgs.get((s, p), ()):
+            for mid, topic in edge_msgs.get((s, p, t_rpc), ()):
                 mm = meta.messages.add()
                 mm.messageID = mid
                 mm.topic = topic
-            c = ctrl.get((s, p))
+            c = ctrl.get((s, p, t_rpc))
             if c is not None:
                 for t, mids in c["ihave"].items():
                     ih = meta.control.ihave.add()
@@ -428,11 +508,11 @@ class TraceSession:
                     meta.control.graft.add().topic = t
                 for t in c["prune"]:
                     meta.control.prune.add().topic = t
-            sev = self._base(trace_pb2.TraceEvent.SEND_RPC, s, tick)
+            sev = self._base(trace_pb2.TraceEvent.SEND_RPC, s, t_rpc)
             sev.sendRPC.sendTo = self.peer_ids[p]
             sev.sendRPC.meta.CopyFrom(meta)
             self._emit(sev)
-            rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, tick)
+            rev = self._base(trace_pb2.TraceEvent.RECV_RPC, p, t_rpc)
             rev.recvRPC.receivedFrom = self.peer_ids[s]
             rev.recvRPC.meta.CopyFrom(meta)
             self._emit(rev)

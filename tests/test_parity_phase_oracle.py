@@ -1,0 +1,211 @@
+"""Phase engine vs a heartbeat-cadence ORACLE — the reference's actual
+timing shape as the parity anchor (round-4 review item 2).
+
+Until round 4 the phase engine's parity row was engine-vs-engine (r vs
+r=1, same seeds), bounding its distance from the PER-ROUND step — which
+is itself a deviation from the reference's cadence (control after every
+hop). The oracle now speaks the reference's shape directly
+(OracleGossipSub with cfg.heartbeat_every = h > 1): delivery and control
+PROCESSING stay continuous — the reference handles GRAFT/PRUNE/IHAVE/
+IWANT on RPC arrival (gossipsub.go:596-613) — while the heartbeat batch
+(score refresh, promise penalties, mesh maintenance, fanout maintenance,
+gossip EMISSION, mcache shift) runs every h-th round
+(gossipsub.go:1278-1301), at the same executed ticks as the phase
+engine's tail heartbeat.
+
+What the measured distance contains: the phase engine additionally
+defers control ingest + IWANT service to phase heads (the oracle, like
+the reference, does not), so phase(r=h) vs oracle(h) includes the phase
+engine's extra control-batching latency — the honest gap vs the
+reference's shape, in a way phase-vs-per-round never measured.
+
+Measured (CPU, N=192 d=8, v1.1 scoring, 8 seeds/side, 64 msgs/seed,
+leave-one-out jackknife over all 64 drop-one pool pairs — recorded in
+PARITY.md):
+  h=4: pooled sup 0.48% (jk mean 0.50% / max 0.96%)  coverage 100%/100%
+  h=8: pooled sup 0.40% (jk mean 0.47% / max 0.91%)  coverage 100%/100%
+  (5-seed pools measured 1.29%/1.52% with jk max ~2.35% — the distance
+  shrinks with pool size, i.e. it is sampling noise, not structure)
+UNDER the 2% north-star envelope at both cadences including jackknife
+max — the flagship mode is reference-anchored, proving the round-4 "the
+per-round step is the outlier" claim with a measurement: against the
+correctly-shaped target the distance drops from the engine-vs-engine
+rows' 3.09%/3.58% (r=4/8) to well under 1% — that old distance was the
+PER-ROUND comparison side's over-tight control, as predicted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import make_gossipsub_phase_step
+from go_libp2p_pubsub_tpu.oracle.gossipsub import OracleGossipSub
+from go_libp2p_pubsub_tpu.state import Net, hops
+
+N, D, M = 192, 8, 64
+WARMUP, PUB_ROUNDS, DRAIN, PUBS = 24, 16, 16, 4  # 56 rounds, 64 msgs
+MAX_H = 16
+SEEDS_V = (3, 4, 5, 6, 7, 8, 9, 10)
+SEEDS_O = (11, 12, 13, 14, 15, 16, 17, 18)
+
+
+def _score_params():
+    tp = TopicScoreParams(
+        mesh_message_deliveries_weight=-0.3,
+        mesh_message_deliveries_threshold=3.0,
+        mesh_message_deliveries_activation=8.0,
+        mesh_message_deliveries_window=2.0,
+    )
+    return PeerScoreParams(topics={0: tp}, skip_app_specific=True,
+                           behaviour_penalty_weight=-1.0,
+                           behaviour_penalty_threshold=1.0,
+                           behaviour_penalty_decay=0.9)
+
+
+def _cfg(h):
+    return GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True,
+        heartbeat_every=h,
+    )
+
+
+def _schedule(seed):
+    """Publish schedule [total, PUBS] shared by both sides of a seed."""
+    total = WARMUP + PUB_ROUNDS + DRAIN
+    rng = np.random.default_rng(seed * 7 + 1)
+    po = np.full((total, PUBS), -1, np.int32)
+    po[WARMUP : WARMUP + PUB_ROUNDS] = rng.integers(
+        0, N, size=(PUB_ROUNDS, PUBS)
+    )
+    return po, total
+
+
+def _run_phase_engine(h, seed):
+    """Phase engine at r = h, heartbeat once per phase (tail)."""
+    topo = graph.random_connect(N, d=D, seed=seed)
+    subs = graph.subscribe_all(N, 1)
+    net = Net.build(topo, subs)
+    sp = _score_params()
+    cfg = _cfg(h)
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=seed)
+    po, total = _schedule(seed)
+    pt = np.zeros_like(po)
+    pv = np.ones(po.shape, bool)
+    pstep = make_gossipsub_phase_step(cfg, net, h, score_params=sp)
+    g = total // h
+    gro = lambda a: jnp.asarray(a).reshape((g, h) + a.shape[1:])
+    xo, xt, xv = gro(po), gro(pt), gro(pv)
+    for p in range(g):
+        st = pstep(st, xo[p], xt[p], xv[p], do_heartbeat=True)
+    hv = np.asarray(hops(st.core.msgs, st.core.dlv))
+    return [int(x) for x in hv[hv >= 0]]
+
+
+def _run_oracle(h, seed):
+    """Heartbeat-cadence oracle: continuous control, heartbeat every h."""
+    topo = graph.random_connect(N, d=D, seed=seed)
+    subs = graph.subscribe_all(N, 1)
+    o = OracleGossipSub(topo, subs, _cfg(h), msg_slots=M, seed=seed + 100,
+                        score_params=_score_params())
+    po, total = _schedule(seed)
+    for i in range(total):
+        o.step([(int(p), 0, True) for p in po[i] if p >= 0])
+    return [int(x) for x in o.hops().values()]
+
+
+def _sup_with_jackknife(hv_per_seed, ho_per_seed, denom_per_run):
+    sv, so = len(hv_per_seed), len(ho_per_seed)
+
+    def pooled(per_seed, skip):
+        hist = np.zeros(MAX_H + 1)
+        for i, hs in enumerate(per_seed):
+            if i == skip:
+                continue
+            for hh in hs:
+                hist[min(int(hh), MAX_H)] += 1
+        runs = len(per_seed) - (1 if skip is not None else 0)
+        return np.cumsum(hist) / (runs * denom_per_run)
+
+    full = float(np.max(np.abs(pooled(hv_per_seed, None)
+                               - pooled(ho_per_seed, None))))
+    jk = [
+        float(np.max(np.abs(pooled(hv_per_seed, i) - pooled(ho_per_seed, j))))
+        for i in range(sv) for j in range(so)
+    ]
+    return full, float(np.mean(jk)), float(np.max(jk))
+
+
+def measure(h, seeds_v=SEEDS_V, seeds_o=SEEDS_O):
+    denom = N * PUB_ROUNDS * PUBS
+    hv = [_run_phase_engine(h, s) for s in seeds_v]
+    ho = [_run_oracle(h, s) for s in seeds_o]
+    cov_v = np.mean([len(x) / denom for x in hv])
+    cov_o = np.mean([len(x) / denom for x in ho])
+    sup, jk_mean, jk_max = _sup_with_jackknife(hv, ho, denom)
+    return sup, jk_mean, jk_max, cov_v, cov_o
+
+
+# pooled bound = the 2% north-star envelope (measured 0.48/0.40% at 8
+# seeds); jk max enforced under the same envelope (measured 0.96/0.91%)
+# — a margin that only holds for one lucky seed set is not parity
+POOLED_BOUND = 0.02
+JK_MAX_BOUND = 0.02
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("h", [4, 8])
+def test_phase_vs_heartbeat_cadence_oracle(h):
+    sup, jk_mean, jk_max, cov_v, cov_o = measure(h)
+    print(f"phase(r={h}) vs oracle(h={h}): sup={100*sup:.2f}% "
+          f"(jk {100*jk_mean:.2f}/{100*jk_max:.2f}%) "
+          f"cov {cov_v:.4f}/{cov_o:.4f}")
+    assert cov_v > 0.995 and cov_o > 0.995
+    assert sup <= POOLED_BOUND, (
+        f"h={h}: pooled sup {100*sup:.2f}% above the 2% envelope"
+    )
+    assert jk_max <= JK_MAX_BOUND, (
+        f"h={h}: jackknife max {100*jk_max:.2f}% above bound"
+    )
+
+
+def test_oracle_heartbeat_cadence_mode_basics():
+    """Cheap structural checks of the h>1 oracle (quick tier): gossip
+    emission only at heartbeat ticks, continuous delivery in between,
+    full coverage on a small net."""
+    topo = graph.random_connect(48, d=6, seed=2)
+    subs = graph.subscribe_all(48, 1)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=False,
+        heartbeat_every=4,
+    )
+    cfg = dataclasses.replace(cfg, fanout_slots=0)
+    o = OracleGossipSub(topo, subs, cfg, msg_slots=32, seed=7)
+    # heartbeat ticks are ≡ 3 (mod 4): ihave_out is empty right after
+    # non-heartbeat rounds (one-shot, cleared after ingest)
+    for i in range(12):
+        o.step([(0, 0, True)] if i == 6 else [])
+        has_ihave = any(o.ihave_out[j] for j in range(48))
+        # tick already incremented: ihave_out may be nonzero only right
+        # after a heartbeat round (tick % 4 == 0 post-increment)
+        if o.tick % 4 != 0:
+            assert not has_ihave
+    for _ in range(12):
+        o.step()
+    # the publish reached everyone despite h=4 (mesh formed at tick 3)
+    cov = sum(1 for (i, s), r in o.first_round.items() if s == 0)
+    assert cov >= 47, cov

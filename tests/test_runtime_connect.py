@@ -129,3 +129,89 @@ def test_dormant_rejected_on_non_gossipsub():
     a, b = net.add_nodes(2)
     with pytest.raises(api.APIError, match="gossipsub"):
         net.connect(a, b, dormant=True)
+
+
+def test_spare_node_post_start_add_node_zero_recompiles():
+    """Dormant PEER rows (round-4 review item 9): provision_spare_nodes
+    pre-start, then post-start add_node() claims a row — connect,
+    subscribe, and delivery all work with ZERO recompiles (the reference
+    admits unknown peers at any moment, pubsub.go:614-646/notify.go:19-75;
+    the jit-constant design pre-provisions the capacity)."""
+    from go_libp2p_pubsub_tpu import api as api_mod
+
+    net = api_mod.Network(seed=3)
+    nodes = net.add_nodes(20)
+    net.dense_connect(d=6, seed=3)
+    subs = [nd.join("x").subscribe() for nd in nodes]
+    spares = net.provision_spare_nodes(2, topics=("x",), degree=4, seed=3)
+    net.start()
+    net.run(4)  # mesh forms among the 20 live nodes
+
+    recompiles = 0
+    orig = net._recompile_gossipsub
+
+    def counting():
+        nonlocal recompiles
+        recompiles += 1
+        orig()
+
+    net._recompile_gossipsub = counting
+
+    # spares are invisible while down: no deliveries to them
+    nodes[0].topics["x"].publish(b"before")
+    net.run(4)
+    assert all(sum(1 for _ in s) >= 1 for s in subs)
+
+    # claim a spare: up + activate its dormant edges + subscribe
+    newcomer = net.add_node()
+    assert newcomer is spares[0]
+    assert newcomer.up
+    sub_new = newcomer.topics["x"].subscribe()
+    nbr = np.asarray(net.net.nbr)[newcomer.idx]
+    ok = np.asarray(net.net.nbr_ok)[newcomer.idx]
+    neighbors = [net.nodes[int(j)] for j in nbr[ok]]
+    for nb in neighbors:
+        net.connect(newcomer, nb)
+
+    # membership + delivery: the newcomer receives the next publishes
+    nodes[1].topics["x"].publish(b"after-join")
+    net.run(6)  # heartbeat grafts the claimed row into the mesh
+    got_new = [m.data for m in iter(sub_new)]
+    assert b"after-join" in got_new, got_new
+    # and the newcomer can publish to the whole network
+    newcomer.topics["x"].publish(b"from-newcomer")
+    net.run(4)
+    for s in subs:
+        datas = [m.data for m in iter(s)]
+        assert b"from-newcomer" in datas, datas
+    assert recompiles == 0, f"claimed spare row triggered {recompiles} recompiles"
+
+    # pool exhaustion is an explicit error pointing at the capacity path
+    net.add_node()  # second spare
+    with pytest.raises(api_mod.APIError, match="spare-node pool is empty"):
+        net.add_node()
+
+
+def test_spare_node_invisible_while_down():
+    """Provisioned-but-unclaimed rows take no part in the protocol: no
+    deliveries, no mesh membership, no gossip — the subscription template
+    is inert until the row comes up."""
+    from go_libp2p_pubsub_tpu import api as api_mod
+
+    net = api_mod.Network(seed=5)
+    nodes = net.add_nodes(16)
+    net.dense_connect(d=5, seed=5)
+    subs = [nd.join("x").subscribe() for nd in nodes]
+    spare = net.provision_spare_nodes(1, topics=("x",), degree=3, seed=5)[0]
+    spare_sub = spare.topics["x"].subscribe()
+    net.start()
+    for i in range(3):
+        nodes[i].topics["x"].publish(b"m%d" % i)
+    net.run(10)
+    assert all(sum(1 for _ in s) == 3 for s in subs)
+    assert sum(1 for _ in spare_sub) == 0  # down row saw nothing
+    mesh = np.asarray(net.state.mesh)
+    assert not mesh[spare.idx].any()  # and sits in no mesh
+    # nobody meshes TOWARD the down row either
+    toward = np.asarray(net.net.nbr) == spare.idx  # [N, K]
+    assert not (mesh & toward[:, None, :]).any()
