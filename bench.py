@@ -34,7 +34,14 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
               north-star workload the driver measures)
     eth2    — 100k-peer Eth2 attestation-subnet geometry: 64 topics, each
-              peer subscribed to 2 random subnets (BASELINE.json config #5)
+              peer subscribed to 2 random subnets (BASELINE.json config #5).
+              A THROUGHPUT workload, not a coverage one: over the banded
+              ring-lattice adjacency a topic's 3%-density induced subgraph
+              fragments into segments (1-D lattices don't percolate under
+              dilution), so publishes propagate within their segment only —
+              coverage claims live in the parity suite's random-graph
+              configs (PARITY.md eth2 row: reachability structurally
+              attributed)
     sybil   — 20% sybil attackers (control-plane-only peers that never
               forward data), peer gater + deficit scoring enabled
               (BASELINE.json config #4; default BENCH_N 50k)

@@ -599,6 +599,46 @@ def fanout_carry_words(fanout_peers: jax.Array, fanout_topic: jax.Array,
     return bitset.word_or_reduce(contrib, axis=1)
 
 
+# -- packed fanout-peer form (phase-loop internal) --------------------------
+# The [N, F, K] bool peers plane is a pathological write target on TPU —
+# bit-packed pred tiles make every sub-round update a read-modify-write
+# over layout-padded tiles (the 2-axis scatter measured 670 us/round at
+# eth2 N=100k, the P-step where-chain still 226 us). K <= 32, so the K
+# axis packs into ONE u32 per (peer, slot): updates become [N, F] u32
+# selects and the carry consumer extracts bits on the fly. The phase
+# engine packs at its head and unpacks at its tail, so the state
+# dataclass, the heartbeat, peer transitions, and every external consumer
+# keep the bool plane.
+
+def pack_fanout_peers(fanout_peers: jax.Array) -> jax.Array:
+    """[N,F,K] bool -> [N,F] u32 edge bitmask (K <= 32)."""
+    k = fanout_peers.shape[-1]
+    assert k <= 32, "packed fanout form needs max_degree <= 32"
+    w = jnp.uint32(1) << jnp.arange(k, dtype=jnp.uint32)
+    return jnp.sum(
+        jnp.where(fanout_peers, w, jnp.uint32(0)), axis=-1, dtype=jnp.uint32
+    )
+
+
+def unpack_fanout_peers(fp_pack: jax.Array, k: int) -> jax.Array:
+    """[N,F] u32 -> [N,F,K] bool."""
+    return (
+        (fp_pack[:, :, None] >> jnp.arange(k, dtype=jnp.uint32)) & 1
+    ).astype(bool)
+
+
+def fanout_carry_words_packed(fp_pack: jax.Array, k: int,
+                              fanout_topic: jax.Array,
+                              msg_topic: jax.Array) -> jax.Array:
+    """fanout_carry_words on the packed [N,F] u32 peers form (the
+    on-the-fly unpack fuses into the carry fold — same XLA graph, but
+    the loop reads 0.8 MB of packed words instead of the padded bool
+    plane)."""
+    return fanout_carry_words(
+        unpack_fanout_peers(fp_pack, k), fanout_topic, msg_topic
+    )
+
+
 def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
                      joined_words: jax.Array, acc_ok: jax.Array,
                      slotw: jax.Array, msg_topic: jax.Array,
@@ -651,9 +691,15 @@ def update_fanout_on_publish(
     pub_topic: jax.Array,   # [P] i32
     key: jax.Array,
     nbr_sub_words: jax.Array,  # [N,K,Wt] static: neighbors' topic-bit subs
-) -> "GossipSubState":
+    fp_pack: jax.Array | None = None,
+):
     """Publishing to an unjoined topic creates/refreshes a fanout slot with
-    D random eligible peers (gossipsub.go:983-998) and stamps lastpub."""
+    D random eligible peers (gossipsub.go:983-998) and stamps lastpub.
+
+    Returns the updated state — or, when ``fp_pack`` (the phase loop's
+    packed [N,F] u32 peers form) is given, ``(state, fp_pack)`` with
+    ``state.fanout_peers`` left untouched (stale; the phase tail unpacks
+    the packed form back into it)."""
     tick = st.core.tick
     p_dim = pub_origin.shape[0]
     f_dim = cfg.fanout_slots
@@ -685,9 +731,14 @@ def update_fanout_on_publish(
     # a matched slot whose peer set has emptied (churn, threshold filtering)
     # is repopulated like a fresh one (gossipsub.go:983-989: empty fanout
     # map entry => select peers anew)
-    match_empty = has_match & (
-        count_true(jnp.take_along_axis(st.fanout_peers[o], slot[:, None, None], axis=1)[:, 0, :]) == 0
-    )
+    if fp_pack is not None:
+        match_empty = has_match & (
+            jnp.take_along_axis(fp_pack[o], slot[:, None], axis=1)[:, 0] == 0
+        )
+    else:
+        match_empty = has_match & (
+            count_true(jnp.take_along_axis(st.fanout_peers[o], slot[:, None, None], axis=1)[:, 0, :]) == 0
+        )
     fresh = fresh | match_empty
 
     # candidates for a fresh slot: connected, mesh-capable, subscribed to
@@ -705,14 +756,42 @@ def update_fanout_on_publish(
         cand = cand & (st.scores[o] >= cfg.publish_threshold)
     sel = select_random_mask(key, cand, cfg.D)  # [P,K]
 
-    # scatter: new slots take the fresh selection; matched slots keep theirs
-    po = jnp.where(need, o, net.n_peers)  # OOB drop for non-fanout entries
-    fanout_topic = st.fanout_topic.at[po, slot].set(t, mode="drop")
-    fanout_lastpub = st.fanout_lastpub.at[po, slot].set(
-        jnp.broadcast_to(tick, t.shape), mode="drop"
-    )
-    po_fresh = jnp.where(fresh, o, net.n_peers)
-    fanout_peers = st.fanout_peers.at[po_fresh, slot].set(sel, mode="drop")
+    # commit: new slots take the fresh selection; matched slots keep
+    # theirs. A static fold of P masked selects over the [N, F] planes —
+    # NOT a 2-axis scatter: .at[po, slot].set lowered to ~670 us/round
+    # on the real chip at N=100k (47% of the whole eth2 phase round,
+    # round-5 profile) to write <=P rows, while the P fused where-passes
+    # cost plane bandwidth (~3 MB) once. Ascending-j overwrite keeps the
+    # scatter's last-update-wins semantics for duplicate (origin, slot)
+    # pairs in one batch.
+    rows = jnp.arange(net.n_peers, dtype=jnp.int32)
+    fslots = jnp.arange(f_dim, dtype=jnp.int32)
+    fanout_topic = st.fanout_topic
+    fanout_lastpub = st.fanout_lastpub
+    # (a winner-index fold that touches the [N, F, K] plane once was
+    # tried and measured WORSE — eth2 961 -> 555 rounds/s: the extra
+    # [N, F] winner plane + two-chain combine broke the single loop
+    # fusion XLA builds for this direct P-step where-chain)
+    packed = fp_pack is not None
+    sel_pack = pack_fanout_peers(sel) if packed else None  # [P] u32
+    fanout_peers = st.fanout_peers
+    for j in range(p_dim):
+        mask = ((rows == jnp.where(need[j], o[j], net.n_peers))[:, None]
+                & (fslots == slot[j])[None, :])  # [N, F]
+        fanout_topic = jnp.where(mask, t[j], fanout_topic)
+        fanout_lastpub = jnp.where(mask, tick, fanout_lastpub)
+        if packed:
+            fp_pack = jnp.where(mask & fresh[j], sel_pack[j], fp_pack)
+        else:
+            fanout_peers = jnp.where(
+                (mask & fresh[j])[:, :, None], sel[j][None, None, :],
+                fanout_peers,
+            )
+    if packed:
+        return st.replace(
+            fanout_topic=fanout_topic,
+            fanout_lastpub=fanout_lastpub,
+        ), fp_pack
     return st.replace(
         fanout_topic=fanout_topic,
         fanout_peers=fanout_peers,

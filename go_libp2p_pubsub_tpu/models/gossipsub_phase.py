@@ -85,7 +85,10 @@ from .gossipsub import (
     apply_validation_throttle,
     control_exchange,
     fanout_carry_words,
+    fanout_carry_words_packed,
     handle_graft_prune,
+    pack_fanout_peers,
+    unpack_fanout_peers,
     handle_ihave,
     heartbeat,
     iwant_responses,
@@ -236,10 +239,19 @@ def make_gossipsub_phase_step(
         iwant_out = st2.iwant_out
         served_lo, served_hi = st2.served_lo, st2.served_hi
         promise_mid = st2.promise_mid
-        fanout_st = st2  # fanout_topic/peers/lastpub evolve per sub-round
+        fanout_st = st2  # fanout_topic/lastpub evolve per sub-round
+        # fanout peers ride the loop in packed [N,F] u32 form (the bool
+        # [N,F,K] plane is a pathological per-sub-round write target —
+        # see pack_fanout_peers); unpacked back at the phase tail. The
+        # packing needs K <= 32; wider-degree nets keep the bool path.
+        fp_pack = (
+            pack_fanout_peers(st2.fanout_peers)
+            if cfg.fanout_slots > 0 and k_dim <= 32 else None
+        )
 
         zkw = jnp.zeros((n_peers, k_dim, w), jnp.uint32)
         zw = jnp.zeros((n_peers, w), jnp.uint32)
+        keep_acc = jnp.full((w,), 0xFFFFFFFF, jnp.uint32)
         s_slots = net.my_topics.shape[1]
         # Two score-attribution paths. The COUNT path (inline validation
         # only) reduces each sub-round's transmit tensor to per-
@@ -316,7 +328,11 @@ def make_gossipsub_phase_step(
             # sender-side transmit composition: ONE edge gather per
             # sub-round carries the entire data plane
             carry = sender_carry_words(mesh2, slotw)
-            if cfg.fanout_slots > 0:
+            if fp_pack is not None:
+                carry = carry | fanout_carry_words_packed(
+                    fp_pack, k_dim, fanout_st.fanout_topic, msgs.topic
+                )
+            elif cfg.fanout_slots > 0:
                 carry = carry | fanout_carry_words(
                     fanout_st.fanout_peers, fanout_st.fanout_topic, msgs.topic
                 )
@@ -441,9 +457,12 @@ def make_gossipsub_phase_step(
             put = info.new_words & valid_w_i[None, :] & joined_w
             mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | put)
 
-            # publishes for this sub-round + recycled-slot cleanup
+            # publishes for this sub-round + recycled-slot cleanup (the
+            # scatter form wins in the phase sub-round at N >= 20k —
+            # state.py allocate_publishes docstring has the measurements)
             msgs, dlv, _slots, is_pub, keep_w, pub_words = allocate_publishes(
-                msgs, dlv, tick_i, pub_origin[i], pub_topic[i], pub_valid[i]
+                msgs, dlv, tick_i, pub_origin[i], pub_topic[i], pub_valid[i],
+                scatter_form=n_peers >= 20_000,
             )
             # incremental membership-plane maintenance (narrow universes):
             # recycled columns clear, then each publish ORs its one-hot
@@ -473,15 +492,17 @@ def make_gossipsub_phase_step(
                     )
             mcache = mcache & keep_w[None, None, :]
             mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)
-            iwant_out = iwant_out & keep_w[None, None, :]
-            served_lo = served_lo & keep_w[None, None, :]
-            served_hi = served_hi & keep_w[None, None, :]
-            promise_reused = bitset.bit_get(
-                (~keep_w)[None, None, :], promise_mid
-            )
-            promise_mid = jnp.where(
-                (promise_mid >= 0) & promise_reused, -1, promise_mid
-            )
+            # iwant_out / served / promise recycled-slot clears DEFER to
+            # the phase tail (keep_acc): nothing inside the loop reads or
+            # writes them (asks and service budgets are written at the
+            # control head only, promises created at the head only), and
+            # a recycled slot is never re-allocated within the same phase
+            # (the admission cap bounds publishes at msg_slots // 2), so
+            # one tail application of the accumulated mask is exact —
+            # saving three [N,K,W] AND passes + a bit_get per sub-round
+            # (mcache CANNOT defer: its clear must precede the same
+            # sub-round's put of the slot's NEW message)
+            keep_acc = keep_acc & keep_w
             # recycled slots drop out of the phase accumulators too — their
             # columns now belong to a different message (the count path
             # needs no clearing: its credits were reduced at arrival time,
@@ -505,7 +526,7 @@ def make_gossipsub_phase_step(
                 n_pub = n_pub + jnp.sum(is_pub.astype(jnp.int32))
 
             if cfg.fanout_slots > 0:
-                fanout_st = update_fanout_on_publish(
+                upd = update_fanout_on_publish(
                     cfg, net_l,
                     fanout_st.replace(core=fanout_st.core.replace(tick=tick_i)),
                     pub_origin[i], pub_topic[i],
@@ -513,9 +534,24 @@ def make_gossipsub_phase_step(
                         jax.random.fold_in(core.key, tick_i), 0xFA40
                     ),
                     nbr_sub_words_l,
+                    fp_pack=fp_pack,
                 )
+                if fp_pack is not None:
+                    fanout_st, fp_pack = upd
+                else:
+                    fanout_st = upd
 
         # ---- phase tail (once) ------------------------------------------
+        # deferred recycled-slot clears (see the loop comment)
+        iwant_out = iwant_out & keep_acc[None, None, :]
+        served_lo = served_lo & keep_acc[None, None, :]
+        served_hi = served_hi & keep_acc[None, None, :]
+        promise_reused = bitset.bit_get(
+            (~keep_acc)[None, None, :], promise_mid
+        )
+        promise_mid = jnp.where(
+            (promise_mid >= 0) & promise_reused, -1, promise_mid
+        )
         tick_last = tick0 + (r - 1)
         score = st2.score
         if count_score:
@@ -574,7 +610,10 @@ def make_gossipsub_phase_step(
             score=score,
             gater=gater_state,
             fanout_topic=fanout_st.fanout_topic,
-            fanout_peers=fanout_st.fanout_peers,
+            fanout_peers=(
+                unpack_fanout_peers(fp_pack, k_dim)
+                if fp_pack is not None else fanout_st.fanout_peers
+            ),
             fanout_lastpub=fanout_st.fanout_lastpub,
             dup_trans=dup_trace_acc,
         )

@@ -289,13 +289,35 @@ def allocate_publishes(
     pub_origin: jax.Array,  # [P] i32, -1 pad
     pub_topic: jax.Array,   # [P] i32
     pub_valid: jax.Array,   # [P] bool accept, or int VERDICT_* codes
+    scatter_form: bool | None = None,
 ):
     """Intern this round's publishes into table slots (rotating cursor),
     clearing recycled slots' bit columns everywhere.
 
     Returns (msgs, dlv, slots, is_pub): `slots[P]` the assigned slot per
     publish (undefined where ~is_pub).
+
+    Two exact-equivalent forms for the first_round/pub_words updates
+    (PUBSUB_PUB_SCATTER=0/1 overrides both callers, for the equivalence
+    test — tests/test_ops.py):
+
+      * scatter form: the recycled-column clear + origin stamp as ONE
+        <=P-column scatter, pub_words as a P-element word scatter. The
+        plane form's where(reused)/one-hot+pack reads and writes the
+        whole [N, M] s32 plane (~50 MB of HBM traffic at N=100k/M=64)
+        to touch at most P columns — profiled 42 us/sub-round, 7% of
+        the phase round. The PHASE engine selects it at N >= 20k:
+        +6-11% on the N=100k bench (r=8: 1424 -> 1559; r=16: 1691 ->
+        1882 rounds/s, round 5).
+      * plane form (default): scatters carry a fixed per-op cost that
+        dominates below ~20k peers (the 12.5k shard bench loses ~9%
+        under scatters), and the PER-ROUND step prefers the plane form
+        even at N=100k (405 vs 378 ticks/s) — its [N, M] selects fuse
+        with the surrounding per-round [N, M] work that the phase
+        sub-round doesn't have. Callers that profile a win opt in.
     """
+    import os
+
     m = msgs.capacity
     pub_valid = jnp.asarray(pub_valid)
     accept, ignored = decode_verdicts(pub_valid)
@@ -307,14 +329,34 @@ def allocate_publishes(
     # scatter index M (out of bounds, mode=drop) for padding entries
     sidx = jnp.where(is_pub, slots, m)
 
+    n_peers = dlv.have.shape[0]
+    env = os.environ.get("PUBSUB_PUB_SCATTER")
+    if env is not None:
+        scatter_form = env == "1"
+    elif scatter_form is None:
+        scatter_form = False
+
     # clear recycled slots: bit columns in have/fwd/fe, rows in first_round
     reused = jnp.zeros((m,), bool).at[sidx].set(True, mode="drop")
     reused_words = bitset.pack(reused)
     keep = ~reused_words
+    if scatter_form:
+        # ONE column scatter does both the recycled-column clear and the
+        # origin stamp: column j of the update is -1 everywhere except
+        # the publishing origin's row, which takes the tick (the
+        # composition of the plane form's clear-then-stamp pair)
+        row = jnp.where(is_pub, pub_origin, n_peers)
+        col_vals = jnp.where(
+            jnp.arange(n_peers, dtype=jnp.int32)[:, None] == row[None, :],
+            jnp.broadcast_to(tick, (n_peers, sidx.shape[0])), -1,
+        )
+        first_round = dlv.first_round.at[:, sidx].set(col_vals, mode="drop")
+    else:
+        first_round = jnp.where(reused[None, :], -1, dlv.first_round)
     dlv = dlv.replace(
         have=dlv.have & keep[None, :],
         fwd=dlv.fwd & keep[None, :],
-        first_round=jnp.where(reused[None, :], -1, dlv.first_round),
+        first_round=first_round,
         fe_words=dlv.fe_words & keep[None, None, :],
         pending=dlv.pending & keep[None, None, :] if dlv.pending is not None else None,
     )
@@ -332,17 +374,36 @@ def allocate_publishes(
         ),
     )
 
-    # origin peers: mark seen + schedule forwarding + record first_round
-    pub_bits = jnp.zeros((dlv.have.shape[0], m), bool).at[pub_origin, sidx].set(
-        True, mode="drop"
-    )
-    pub_words = bitset.pack(pub_bits)
-    dlv = dlv.replace(
-        have=dlv.have | pub_words,
-        fwd=dlv.fwd | pub_words,
-        first_round=jnp.where(pub_bits, jnp.broadcast_to(tick, pub_bits.shape), dlv.first_round),
-        # first_edge stays -1 for local publishes
-    )
+    # origin peers: mark seen + schedule forwarding (+ the first_round
+    # stamp in the plane form; the scatter form's stamp rode the column
+    # scatter above). Scatter form: distinct slots => distinct bits, so
+    # the word add is exact even when two publishes of one origin share
+    # a word; padding drops via the OOB row (sidx alone can be in-bounds
+    # when m % 32 != 0).
+    if scatter_form:
+        bit = jnp.uint32(1) << (sidx % bitset.WORD).astype(jnp.uint32)
+        pub_words = jnp.zeros((n_peers, bitset.n_words(m)), jnp.uint32).at[
+            row, sidx // bitset.WORD
+        ].add(bit, mode="drop")
+        dlv = dlv.replace(
+            have=dlv.have | pub_words,
+            fwd=dlv.fwd | pub_words,
+            # first_edge stays -1 for local publishes
+        )
+    else:
+        pub_bits = jnp.zeros((n_peers, m), bool).at[pub_origin, sidx].set(
+            True, mode="drop"
+        )
+        pub_words = bitset.pack(pub_bits)
+        dlv = dlv.replace(
+            have=dlv.have | pub_words,
+            fwd=dlv.fwd | pub_words,
+            first_round=jnp.where(
+                pub_bits, jnp.broadcast_to(tick, pub_bits.shape),
+                dlv.first_round,
+            ),
+            # first_edge stays -1 for local publishes
+        )
     # keep-mask for recycled slots so routers can clear their own per-slot
     # state (mcache windows, gossip outboxes, promises)
     return msgs, dlv, slots, is_pub, keep, pub_words

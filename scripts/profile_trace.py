@@ -28,9 +28,16 @@ def main():
     from bench import build_bench
 
     config = os.environ.get("BENCH_CONFIG", "default")
+    # BENCH_PHASE_R > 1 profiles the phase engine at that cadence (the
+    # bench default is r=8); BENCH_PHASE_R=1 profiles the per-round step
+    r = int(os.environ.get("BENCH_PHASE_R", 1))
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 50
-    st, step, n_topics, honest = build_bench(n, 64, config=config)
+    rounds = max(rounds - rounds % max(r, 1), r)  # never truncate to an empty run
+    st, step, n_topics, honest = build_bench(
+        n, 64, config=config, heartbeat_every=r if r > 1 else 1,
+        rounds_per_phase=r,
+    )
 
     rng = np.random.default_rng(0)
     if honest is not None:
@@ -41,13 +48,24 @@ def main():
     pt = jnp.asarray(rng.integers(0, n_topics, size=(rounds, 4)).astype(np.int32))
     pv = jnp.asarray(np.ones((rounds, 4), bool))
 
-    def run_seg(s):
-        def body(carry, xs):
-            return step(carry, *xs), None
-        s, _ = jax.lax.scan(body, s, (po, pt, pv))
-        return s
+    if r > 1:
+        from go_libp2p_pubsub_tpu.driver import make_scan
 
-    run = jax.jit(run_seg, donate_argnums=0)
+        unroll = int(os.environ.get("BENCH_UNROLL", 2 * r))
+        scan = make_scan(step, heartbeat_every=r, rounds_per_phase=r,
+                         static_heartbeat=True, unroll=max(1, unroll // r))
+
+        def run_seg(s):
+            return scan(s, po, pt, pv)
+        run = jax.jit(run_seg, donate_argnums=0)
+    else:
+        def run_seg(s):
+            def body(carry, xs):
+                return step(carry, *xs), None
+            s, _ = jax.lax.scan(body, s, (po, pt, pv))
+            return s
+
+        run = jax.jit(run_seg, donate_argnums=0)
     st = run(st)
     jax.block_until_ready(st)
 

@@ -188,3 +188,56 @@ def test_lowest_bit_matches_naive():
             assert has[i] and idx[i] == min(flat), i
         else:
             assert not has[i] and idx[i] == 0, i
+
+
+def test_allocate_publishes_scatter_plane_equivalence(monkeypatch):
+    """allocate_publishes has two trace-time forms (N-gated: plane
+    selects below ~20k peers, column/word scatters above — measured
+    crossover on the real chip, see state.py docstring). They must be
+    bit-identical; this drives a full gossipsub sim under each via the
+    PUBSUB_PUB_SCATTER override and compares every state plane."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    n, m, rounds = 48, 32, 12
+    topo = graph.random_connect(n, 6, seed=9)
+    subs = graph.subscribe_random(n, n_topics=2, topics_per_peer=2, seed=9)
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=False
+    )
+    rng = np.random.default_rng(9)
+    po = rng.integers(-1, n, size=(rounds, 4)).astype(np.int32)
+    pt = rng.integers(0, 2, size=(rounds, 4)).astype(np.int32)
+    pv = np.ones((rounds, 4), bool)
+
+    def run(form):
+        monkeypatch.setenv("PUBSUB_PUB_SCATTER", form)
+        st = GossipSubState.init(net, m, cfg, seed=9)
+        step = make_gossipsub_step(cfg, net)
+        for i in range(rounds):
+            st = step(st, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                      jnp.asarray(pv[i]))
+        return st
+
+    sa, sb = run("0"), run("1")
+    lb, _ = jax.tree_util.tree_flatten(sb)
+    paths = jax.tree_util.tree_flatten_with_path(sa)[0]
+    for (path, xa), xb in zip(paths, lb):
+        if jnp.issubdtype(getattr(xa, "dtype", None), jax.dtypes.prng_key):
+            xa, xb = jax.random.key_data(xa), jax.random.key_data(xb)
+        assert np.array_equal(np.asarray(xa), np.asarray(xb)), \
+            jax.tree_util.keystr(path)
