@@ -381,6 +381,11 @@ def allocate_publishes(
     # a word; padding drops via the OOB row (sidx alone can be in-bounds
     # when m % 32 != 0).
     if scatter_form:
+        # (a fused [N, W] P-step compare-fold for pub_words was tried
+        # against this word scatter and measured WORSE — r=8 bench 1754
+        # -> 1695: the fold's per-row compares ride every consumer of
+        # the have/fwd ORs, while the scatter's ~35 us launch cost is
+        # paid once and its output fuses cleanly)
         bit = jnp.uint32(1) << (sidx % bitset.WORD).astype(jnp.uint32)
         pub_words = jnp.zeros((n_peers, bitset.n_words(m)), jnp.uint32).at[
             row, sidx // bitset.WORD

@@ -356,3 +356,50 @@ def test_tracestat_cli(tmp_path):
         assert stats["counts"]["GRAFT"] > 0
     # both formats describe the same run
     assert results[jpath] == results[ppath]
+
+
+def test_tracestat_cli_phase_cadence(tmp_path):
+    """The north star's "tracestat analysis is unchanged" at the FLAGSHIP
+    cadence: a rounds_per_phase > 1 network writes the same trace schema
+    and the summarizer's propagation analysis works unmodified (delays
+    carry per-sub-round resolution from the device's first_round
+    stamps)."""
+    import json as jsonlib
+    import pathlib
+    import subprocess
+    import sys
+
+    from go_libp2p_pubsub_tpu import api
+    from go_libp2p_pubsub_tpu.trace import sinks
+
+    ppath = tmp_path / "phase.pb"
+    net = api.Network(rounds_per_phase=4, trace_exact=True,
+                      trace_sinks=[sinks.PBTracer(str(ppath))], seed=3)
+    nodes = net.add_nodes(20)
+    net.dense_connect(d=6, seed=3)
+    for nd in nodes:
+        nd.join("x").subscribe()
+    net.start()
+    for i in range(4):
+        nodes[i].topics["x"].publish(b"m%d" % i)
+    net.run(12)
+    net.stop()
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, "scripts/tracestat.py", str(ppath), "--json"],
+        capture_output=True, text=True, check=True, cwd=str(repo),
+    )
+    stats = jsonlib.loads(out.stdout)
+    assert stats["published"] == 4
+    assert stats["delivered"] == 4 * 19  # full coverage, every non-origin
+    assert stats["deliveries_per_publish"] == 19.0
+    assert stats["counts"]["DUPLICATE_MESSAGE"] > 0  # exact mode expanded
+    # per-sub-round timestamp resolution survives the pipeline: if a
+    # regression quantized DELIVER timestamps to phase boundaries, every
+    # delay would be a multiple of the 4-round phase duration
+    phase_ns = 4 * 10**9  # rounds_per_phase * tick_ns
+    assert any(
+        stats["delay_ns"][q] % phase_ns != 0
+        for q in ("p50", "p90", "p99", "max")
+    ), stats["delay_ns"]
