@@ -131,6 +131,46 @@ def test_dormant_rejected_on_non_gossipsub():
         net.connect(a, b, dormant=True)
 
 
+def _claim_spare_and_deliver(net, nodes, subs, spare, graft_rounds,
+                             deliver_rounds):
+    """Shared spare-claim flow: count recompiles around claim + edge
+    activation + bidirectional delivery; returns (newcomer, recompiles).
+    Used by the per-round and phase-cadence variants below so the claim
+    semantics can't drift between them."""
+    recompiles = 0
+    orig = net._recompile_gossipsub
+
+    def counting():
+        nonlocal recompiles
+        recompiles += 1
+        orig()
+
+    net._recompile_gossipsub = counting
+
+    newcomer = net.add_node()
+    assert newcomer is spare
+    assert newcomer.up
+    sub_new = newcomer.topics["x"].subscribe()
+    nbr = np.asarray(net.net.nbr)[newcomer.idx]
+    ok = np.asarray(net.net.nbr_ok)[newcomer.idx]
+    for nb in [net.nodes[int(j)] for j in nbr[ok]]:
+        net.connect(newcomer, nb)
+
+    # membership + delivery: the newcomer receives the next publishes
+    net.run(graft_rounds)  # heartbeat grafts the claimed row in
+    nodes[1].topics["x"].publish(b"to-newcomer")
+    net.run(deliver_rounds)
+    got_new = [m.data for m in iter(sub_new)]
+    assert b"to-newcomer" in got_new, got_new
+    # and the newcomer can publish to the whole network
+    newcomer.topics["x"].publish(b"from-newcomer")
+    net.run(deliver_rounds)
+    for s in subs:
+        datas = [m.data for m in iter(s)]
+        assert b"from-newcomer" in datas, datas
+    return newcomer, recompiles
+
+
 def test_spare_node_post_start_add_node_zero_recompiles():
     """Dormant PEER rows (round-4 review item 9): provision_spare_nodes
     pre-start, then post-start add_node() claims a row — connect,
@@ -147,43 +187,14 @@ def test_spare_node_post_start_add_node_zero_recompiles():
     net.start()
     net.run(4)  # mesh forms among the 20 live nodes
 
-    recompiles = 0
-    orig = net._recompile_gossipsub
-
-    def counting():
-        nonlocal recompiles
-        recompiles += 1
-        orig()
-
-    net._recompile_gossipsub = counting
-
     # spares are invisible while down: no deliveries to them
     nodes[0].topics["x"].publish(b"before")
     net.run(4)
     assert all(sum(1 for _ in s) >= 1 for s in subs)
 
-    # claim a spare: up + activate its dormant edges + subscribe
-    newcomer = net.add_node()
-    assert newcomer is spares[0]
-    assert newcomer.up
-    sub_new = newcomer.topics["x"].subscribe()
-    nbr = np.asarray(net.net.nbr)[newcomer.idx]
-    ok = np.asarray(net.net.nbr_ok)[newcomer.idx]
-    neighbors = [net.nodes[int(j)] for j in nbr[ok]]
-    for nb in neighbors:
-        net.connect(newcomer, nb)
-
-    # membership + delivery: the newcomer receives the next publishes
-    nodes[1].topics["x"].publish(b"after-join")
-    net.run(6)  # heartbeat grafts the claimed row into the mesh
-    got_new = [m.data for m in iter(sub_new)]
-    assert b"after-join" in got_new, got_new
-    # and the newcomer can publish to the whole network
-    newcomer.topics["x"].publish(b"from-newcomer")
-    net.run(4)
-    for s in subs:
-        datas = [m.data for m in iter(s)]
-        assert b"from-newcomer" in datas, datas
+    _, recompiles = _claim_spare_and_deliver(
+        net, nodes, subs, spares[0], graft_rounds=2, deliver_rounds=4
+    )
     assert recompiles == 0, f"claimed spare row triggered {recompiles} recompiles"
 
     # pool exhaustion is an explicit error pointing at the capacity path
@@ -215,3 +226,24 @@ def test_spare_node_invisible_while_down():
     # nobody meshes TOWARD the down row either
     toward = np.asarray(net.net.nbr) == spare.idx  # [N, K]
     assert not (mesh & toward[:, None, :]).any()
+
+
+def test_spare_node_claim_under_phase_cadence():
+    """Spare-row claiming composes with the phase engine: the same claim
+    flow as the per-round variant (shared helper) at rounds_per_phase=4
+    — zero recompiles at the flagship cadence; the graft/delivery
+    windows widen to whole phases."""
+    from go_libp2p_pubsub_tpu import api as api_mod
+
+    net = api_mod.Network(seed=7, rounds_per_phase=4)
+    nodes = net.add_nodes(20)
+    net.dense_connect(d=6, seed=7)
+    subs = [nd.join("x").subscribe() for nd in nodes]
+    spare = net.provision_spare_nodes(1, topics=("x",), degree=4, seed=7)[0]
+    net.start()
+    net.run(4)
+
+    _, recompiles = _claim_spare_and_deliver(
+        net, nodes, subs, spare, graft_rounds=8, deliver_rounds=8
+    )
+    assert recompiles == 0
