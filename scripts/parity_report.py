@@ -27,7 +27,12 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    sys.path.insert(0, ".")
+    # repo-root anchored (not cwd): the script must import the package
+    # and read/write PARITY.md correctly from any working directory
+    import os as _os
+
+    sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
     import jax.numpy as jnp
 
     from go_libp2p_pubsub_tpu import graph
@@ -248,7 +253,7 @@ def main():
     # ---- v1.1 composed rows (score plane live in the loop) --------------
     def v11_row(label, n, deg, sp, thr, adversary=None, n_topics=1,
                 subs=None, warmup=24, pub_rounds=18, drain=12, seed=5,
-                fanout=False, topic_sched=None):
+                fanout=False, topic_sched=None, extra_note=""):
         import dataclasses as _dc
 
         from go_libp2p_pubsub_tpu.config import (
@@ -323,11 +328,14 @@ def main():
         mean_rel = abs(np.mean(hv) - np.mean(ho)) / np.mean(ho)
         cov_v = len(hv) / (len(SEEDS_V) * total)
         cov_o = len(ho) / (len(SEEDS_O) * total)
+        note = "composed v1.1: scoring+thresholds live in the loop"
+        if extra_note:
+            note = note + "; " + extra_note
         rows.append((label,
                      f"{100*sup:.2f}% (jk {100*jk_mean:.2f}/{100*jk_max:.2f}%)",
                      f"{100*mean_rel:.2f}%",
                      f"{cov_v*100:.1f}% / {cov_o*100:.1f}%",
-                     "composed v1.1: scoring+thresholds live in the loop"))
+                     note))
 
     from go_libp2p_pubsub_tpu.config import (
         PeerScoreParams,
@@ -377,6 +385,7 @@ def main():
         fanout=True,
         topic_sched=_t_rng.integers(0, 8, size=(18, 2)).astype(np.int32),
         seed=9,
+        extra_note="coverage hole structurally attributed below",
     )
 
     # ---- write report ---------------------------------------------------
@@ -407,18 +416,14 @@ def main():
         "512/d=10: engine 8.13-8.45, oracle 8.18-8.53 — overlapping, no",
         "bias).",
         "",
-        "Round 3: the round-2 review flagged the 1.80%/1.68% margins as",
-        "evidence-free without spread. Re-measured with 5 seeds per side:",
-        "the v1.0 pooled sup drops to ~1.0% (jk max 1.43%) and the sybil",
-        "v1.1 row to ~0.6% (jk max 1.21%) — the thin round-2 margins were",
-        "3-seed/single-seed sampling noise, not a hidden bug (the means",
-        "agree to <0.6% throughout). Method: every gossipsub row pools 5",
-        "RNG seeds",
+        "Round 3: every gossipsub row (v1.0 AND v1.1) pools 5 RNG seeds",
         "per side, and the sup column carries leave-one-out jackknife",
         "error bars: `pooled (jk mean/max)` over all 25 (drop-one-engine,",
-        "drop-one-oracle) pool pairs. Both the pooled sup and the",
-        "jackknife max are enforced <= 2% — a margin that only holds for",
-        "one lucky seed set is not parity. The mixed-validation-latency",
+        "drop-one-oracle) pool pairs. For the LOSSLESS rows both the",
+        "pooled sup and the jackknife max are enforced <= 2% — a margin",
+        "that only holds for one lucky seed set is not parity. The lossy",
+        "queue_cap row's bound is noise-derived (3.5%; see its residual",
+        "note below) because whole-message deaths quantize its CDF. The mixed-validation-latency",
         "row runs per-topic async verdict delays (survey §7 hard-part c;",
         "tests/test_parity_valdelay.py pins the same bound plus the",
         "deterministic hop law in CI).",
@@ -428,8 +433,46 @@ def main():
     ]
     for r in rows:
         lines.append("| " + " | ".join(str(x) for x in r) + " |")
+
+    # preserve hand-curated content from the existing PARITY.md: table
+    # rows this script does not generate (the phase-engine rows are
+    # maintained by tests/test_parity_phase.py and
+    # tests/test_parity_phase_oracle.py, which print their measurements)
+    # and every "## " analysis section after the table — regenerating
+    # the oracle rows must not clobber them. Anchored to the repo root,
+    # not the cwd, so running from scripts/ (or CI) can't silently write
+    # a stripped file.
+    from pathlib import Path as _Path
+
+    parity_path = _Path(__file__).resolve().parent.parent / "PARITY.md"
+    extra_rows, tail = [], []
+    if parity_path.exists():
+        own = {str(r[0]) for r in rows}
+        in_tail = False
+        for ln in parity_path.read_text().splitlines():
+            if ln.startswith("## "):
+                in_tail = True
+            if in_tail:
+                tail.append(ln)
+            elif ln.startswith("|"):
+                cells = ln.split("|")
+                label = cells[1].strip() if len(cells) > 1 else ""
+                if (label and label != "config"
+                        and not set(label) <= {"-"}
+                        and label not in own):
+                    extra_rows.append(ln)
+    if extra_rows:
+        # visibility guard: a preserved row whose label SHOULD have been
+        # regenerated (e.g. after renaming a config label above) would
+        # linger here as a stale duplicate that enforcement never checks
+        # — the list below is what a reviewer must eyeball
+        print("preserved hand-curated rows (not re-enforced by this run):")
+        for ln in extra_rows:
+            print("  " + ln.split("|")[1].strip())
+    lines.extend(extra_rows)
     lines.append("")
-    open("PARITY.md", "w").write("\n".join(lines))
+    lines.extend(tail)
+    parity_path.write_text("\n".join(lines) + ("\n" if tail else ""))
     print("\n".join(lines))
 
     # enforce the documented tolerances: bit-exactness for floodsub, the

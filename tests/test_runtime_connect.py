@@ -156,11 +156,15 @@ def _claim_spare_and_deliver(net, nodes, subs, spare, graft_rounds,
     for nb in [net.nodes[int(j)] for j in nbr[ok]]:
         net.connect(newcomer, nb)
 
-    # membership + delivery: the newcomer receives the next publishes
+    # a message published INSIDE the claim window (before any heartbeat
+    # grafts the row) must still arrive via gossip recovery — the
+    # IHAVE/IWANT path serves not-yet-meshed rows
+    nodes[0].topics["x"].publish(b"during-claim")
     net.run(graft_rounds)  # heartbeat grafts the claimed row in
     nodes[1].topics["x"].publish(b"to-newcomer")
     net.run(deliver_rounds)
     got_new = [m.data for m in iter(sub_new)]
+    assert b"during-claim" in got_new, got_new
     assert b"to-newcomer" in got_new, got_new
     # and the newcomer can publish to the whole network
     newcomer.topics["x"].publish(b"from-newcomer")
