@@ -97,6 +97,13 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         tp = TopicScoreParams(
             mesh_message_deliveries_weight=0.0,  # deficit off: honest net
             mesh_failure_penalty_weight=0.0,
+            # honest net continued: every publish is valid (pv all-True),
+            # so P4 provably never fires — zero weight lets the phase
+            # engine's static elision drop the [N,K,W] trans-accumulation
+            # plane, the second of the two OR+store passes the round-4
+            # elision note identified (sybil keeps the default weight:
+            # its adversary vector is what P4 exists to catch)
+            invalid_message_deliveries_weight=0.0,
         )
     sp = PeerScoreParams(
         topics={t: tp for t in range(n_topics)},
