@@ -251,9 +251,7 @@ def finish_delivery(
         # is full (doDropRPC gossipsub.go:1155-1160, comm.go:139-170).
         # Lowest slots first models "queue fills, later sends dropped".
         want = trans
-        trans = bitset.prefix_cap_bits(
-            want, jnp.full(want.shape[:2], queue_cap, jnp.int32), m
-        )
+        trans = bitset.keep_lowest_bits(want, queue_cap, m)  # static cap
         n_drop = bitset.popcount(want & ~trans, axis=None).sum().astype(jnp.int32)
 
     recv_words = bitset.word_or_reduce(trans, axis=1)  # [N, W]

@@ -1195,8 +1195,10 @@ def apply_validation_throttle(dlv, info, cap: int, m: int, valid_words):
     Returns (dlv, info, accepted_new_words, n_throttled[N])."""
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
     entry = info.recv_new_words
-    counts = bitset.popcount(entry, axis=-1)  # [N]
-    accepted = _prefix_cap_bits(entry, jnp.full_like(counts, cap), m)
+    # static cap: the clear-lowest-bit chain, not the unpack+cumsum form
+    # (this runs per SUB-ROUND under the phase engine — the cumsum was
+    # 55% of the sybil phase round, bitset.keep_lowest_bits docstring)
+    accepted = bitset.keep_lowest_bits(entry, cap, m)
     refused = entry & ~accepted
     n_throttled = bitset.popcount(refused, axis=-1)
     n_ref = n_throttled.sum().astype(jnp.int32)

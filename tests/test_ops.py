@@ -241,3 +241,33 @@ def test_allocate_publishes_scatter_plane_equivalence(monkeypatch):
             xa, xb = jax.random.key_data(xa), jax.random.key_data(xb)
         assert np.array_equal(np.asarray(xa), np.asarray(xb)), \
             jax.tree_util.keystr(path)
+
+
+def test_keep_lowest_bits_equals_prefix_cap_bits():
+    """The static-cap clear-lowest-bit chain (keep_lowest_bits) must
+    match prefix_cap_bits with a full(cap) plane for every cap, shape,
+    and bit density — including empty rows, rows with fewer set bits
+    than the cap, the >64 fallback, and DIRTY PADDING (m % 32 != 0 with
+    the last word's pad bits set: prefix_cap_bits' unpack(m) drops
+    pads, so keep_lowest_bits must mask them via its m parameter)."""
+    from go_libp2p_pubsub_tpu.ops import bitset
+
+    rng = np.random.default_rng(5)
+    for shape, m in (((17,), 64), ((9, 5), 96), ((4, 3), 32), ((7,), 48)):
+        for density in (0.0, 0.1, 0.5, 0.95):
+            bits = rng.random(shape + (m,)) < density
+            words = bitset.pack(jnp.asarray(bits))
+            if m % 32 != 0:
+                # dirty pads: set bits >= m in the last word
+                words = words.at[..., -1].set(
+                    words[..., -1] | jnp.uint32(0xFFFF0000)
+                )
+            for cap in (0, 1, 3, 8, 31, 32, 63, 64, 65, 100, m):
+                ref = bitset.prefix_cap_bits(
+                    words, jnp.full(shape, cap, jnp.int32), m
+                )
+                # prefix_cap_bits' output has clean pads by construction;
+                # compare on the valid region
+                got = bitset.keep_lowest_bits(words, cap, m)
+                assert np.array_equal(np.asarray(ref), np.asarray(got)), \
+                    (shape, m, density, cap)
