@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick native go-example
+.PHONY: bench audit test quick perf-smoke sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -16,12 +16,28 @@ bench:
 audit:
 	python scripts/scaling_cpu_mesh.py
 
+# CPU regression gate (go_libp2p_pubsub_tpu/perf/regress.py): committed
+# artifact-trajectory integrity + the round-5 projection invariant + a
+# CPU mini-bench compared against PERF_SMOKE.json (structural check: the
+# phase engine must keep amortizing over the per-round step). Env knobs:
+# PERF_SMOKE_TOL (regression tolerance), PERF_SMOKE_UPDATE=1 (rewrite
+# the baseline), PERF_SMOKE_N / _R / _ROUNDS (shape). docs/PERF.md.
+perf-smoke:
+	python -m go_libp2p_pubsub_tpu.perf.regress
+
+# declarative (config x N x r) sweep — e.g. the eth2 shard table:
+#   make sweep SWEEP_ARGS='--config eth2 --n 12500,25000,50000 --r 16'
+sweep:
+	python -m go_libp2p_pubsub_tpu.perf.sweep $(SWEEP_ARGS)
+
 test:
 	python -m pytest tests/ -q
 
-# quick tier only (skips tests marked `slow` — see tests/conftest.py)
+# quick tier: the sub-10-minute CI gate — `not slow` tests plus the CPU
+# perf-smoke regression gate (fast once the compile cache is warm)
 quick:
 	python -m pytest tests/ -q -m "not slow"
+	python -m go_libp2p_pubsub_tpu.perf.regress
 
 native:
 	$(MAKE) -C native

@@ -5,150 +5,30 @@ North-star metric (BASELINE.json): simulated heartbeat-ticks/sec for a
 on a v5e-8. This runs on however many chips are visible (the driver runs
 it on one), with the peer axis sharded across them.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
-where vs_baseline is value / 10_000 (the north-star target rate). The
-unit of both the value and the target is SIMULATED DELIVERY ROUNDS
-(hop-quanta) per wall second — see BASELINE.md "The tick <-> delivery-
-round equivalence rule". In phase mode (the default, r=8) the line also
-carries `heartbeats_per_sec` (= value / r, the control cadence — NOT the
-headline unit) and `continuity_r1_ticks_per_sec` (the rounds-1..3
-heavy-tick engine re-measured in the same session, BENCH_CONTINUITY=0
-to skip), so the artifact is self-describing and cross-round comparable.
+Prints ONE JSON line — a perf.artifacts SCHEMA V2 record: the v1 fields
+{"metric", "value", "unit", "vs_baseline", ...} plus "schema": 2 and a
+"fingerprint" object (config knobs incl. the score-weight elision flags,
+cadence, shard shape, engine gating) so the artifact alone says what was
+measured. The unit of both the value and the 10k target is SIMULATED
+DELIVERY ROUNDS (hop-quanta) per wall second — see BASELINE.md "The tick
+<-> delivery-round equivalence rule". In phase mode (the default, r=8)
+the line also carries `heartbeats_per_sec` (= value / r, the control
+cadence — NOT the headline unit) and `continuity_r1_ticks_per_sec` (the
+rounds-1..3 heavy-tick engine re-measured in the same session,
+BENCH_CONTINUITY=0 to skip), so the artifact is cross-round comparable.
+
+The workload builder and measurement loop live in
+go_libp2p_pubsub_tpu/perf/sweep.py (this file is the driver-facing CLI);
+``build_bench`` stays importable from here for scripts/tests.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import json
+import math
 import os
-import sys
-import time
 
-import numpy as np
-
-
-def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "default",
-                heartbeat_every: int = 1, rounds_per_phase: int = 1):
-    """Build (state, step) for a BENCH_CONFIG:
-
-    default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
-              north-star workload the driver measures)
-    eth2    — 100k-peer Eth2 attestation-subnet geometry: 64 topics, each
-              peer subscribed to 2 random subnets (BASELINE.json config #5).
-              A THROUGHPUT workload, not a coverage one: over the banded
-              ring-lattice adjacency a topic's 3%-density induced subgraph
-              fragments into segments (1-D lattices don't percolate under
-              dilution), so publishes propagate within their segment only —
-              coverage claims live in the parity suite's random-graph
-              configs (PARITY.md eth2 row: reachability structurally
-              attributed)
-    sybil   — 20% sybil attackers (control-plane-only peers that never
-              forward data), peer gater + deficit scoring enabled
-              (BASELINE.json config #4; default BENCH_N 50k)
-
-    ``rounds_per_phase`` > 1 builds the multi-round phase engine
-    (models/gossipsub_phase.py): r delivery rounds per dispatch, control
-    once per phase — the reference's continuous-delivery / 1 Hz-heartbeat
-    timing shape (gossipsub.go:1278-1301).
-    """
-    import jax
-    import jax.numpy as jnp
-
-    from go_libp2p_pubsub_tpu import graph
-    from go_libp2p_pubsub_tpu.config import (
-        GossipSubParams,
-        PeerGaterParams,
-        PeerScoreParams,
-        PeerScoreThresholds,
-        TopicScoreParams,
-    )
-    from go_libp2p_pubsub_tpu.models.gossipsub import (
-        GossipSubConfig,
-        GossipSubState,
-        make_gossipsub_step,
-    )
-    from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
-        make_gossipsub_phase_step,
-    )
-    from go_libp2p_pubsub_tpu.parallel import make_mesh, shard_state
-    from go_libp2p_pubsub_tpu.state import Net
-
-    # bounded-degree topology (K stays small and static for the compiler)
-    topo = graph.ring_lattice(n_peers, d=8)  # degree 16, K=16
-    if config == "eth2":
-        n_topics = 64  # attestation subnet count
-        subs = graph.subscribe_random(n_peers, n_topics=n_topics,
-                                      topics_per_peer=2, seed=seed)
-    else:
-        n_topics = 1
-        subs = graph.subscribe_all(n_peers, 1)
-    net = Net.build(topo, subs)
-
-    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
-    if config == "sybil":
-        # deficit penalties on: the sybils are what scoring must catch
-        tp = TopicScoreParams(
-            mesh_message_deliveries_weight=-0.5,
-            mesh_message_deliveries_threshold=4.0,
-            mesh_message_deliveries_activation=10.0,
-            mesh_message_deliveries_window=2.0,
-        )
-    else:
-        tp = TopicScoreParams(
-            mesh_message_deliveries_weight=0.0,  # deficit off: honest net
-            mesh_failure_penalty_weight=0.0,
-            # honest net continued: every publish is valid (pv all-True),
-            # so P4 provably never fires — zero weight lets the phase
-            # engine's static elision drop the [N,K,W] trans-accumulation
-            # plane, the second of the two OR+store passes the round-4
-            # elision note identified (sybil keeps the default weight:
-            # its adversary vector is what P4 exists to catch)
-            invalid_message_deliveries_weight=0.0,
-        )
-    sp = PeerScoreParams(
-        topics={t: tp for t in range(n_topics)},
-        skip_app_specific=True,
-        behaviour_penalty_weight=-1.0,
-        behaviour_penalty_threshold=1.0,
-        behaviour_penalty_decay=0.9,
-    )
-    gater = PeerGaterParams() if config == "sybil" else None
-    adversary = None
-    if config == "sybil":
-        rng = np.random.default_rng(seed)
-        adversary = rng.random(n_peers) < 0.2
-    cfg = GossipSubConfig.build(
-        params, PeerScoreThresholds(), score_enabled=True, gater_params=gater,
-        validation_capacity=8 if config == "sybil" else 0,
-        heartbeat_every=heartbeat_every,
-    )
-    # tracer-detached configuration (tracing is opt-in in the reference):
-    # no aggregate event counters; no fanout slots when every peer
-    # subscribes the topic (fanout provably can't occur in that workload)
-    cfg = dataclasses.replace(
-        cfg, count_events=False,
-        fanout_slots=0 if config != "eth2" else cfg.fanout_slots,
-    )
-    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=seed)
-    if rounds_per_phase > 1:
-        step = make_gossipsub_phase_step(
-            cfg, net, rounds_per_phase, score_params=sp, gater_params=gater,
-            adversary_no_forward=adversary,
-        )
-    else:
-        step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gater,
-                                   adversary_no_forward=adversary,
-                                   static_heartbeat=heartbeat_every > 1)
-
-    n_dev = len(jax.devices())
-    if n_dev > 1 and n_peers % n_dev == 0:
-        mesh = make_mesh(n_dev)
-        st = shard_state(st, mesh, n_peers)
-
-    # honest peers only as publish origins: a sybil origin would silently
-    # drop its own publish (adversary peers never transmit message data)
-    honest = np.flatnonzero(~adversary) if adversary is not None else None
-    return st, step, n_topics, honest
+from go_libp2p_pubsub_tpu.perf.sweep import build_bench  # noqa: F401 — re-export
 
 
 def main():
@@ -168,7 +48,13 @@ def main():
     prng = os.environ.get("BENCH_PRNG", "unsafe_rbg")
     if prng:
         jax.config.update("jax_default_prng_impl", prng)
-    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.perf.artifacts import NORTH_STAR_RATE, SCHEMA_VERSION
+    from go_libp2p_pubsub_tpu.perf.sweep import (
+        measure_rate,
+        metric_name,
+        workload_fingerprint,
+    )
 
     config = os.environ.get("BENCH_CONFIG", "default")
     default_n = 50_000 if config == "sybil" else 100_000
@@ -186,8 +72,6 @@ def main():
     heartbeat_every = int(
         os.environ.get("BENCH_HB", rounds_per_phase if rounds_per_phase > 1 else 1)
     )
-    import math
-
     group = math.lcm(heartbeat_every, rounds_per_phase)
     # long segments amortize the tunneled platform's per-call dispatch +
     # readback (~190 ms/segment observed): 100-round segments measured ~37%
@@ -196,114 +80,22 @@ def main():
     # the fixed-schedule scan groups lcm(he, r) rounds per iteration; keep
     # the executed round count and the rate denominator in sync
     seg -= seg % group
-    pubs_per_round = 4
+    unroll_env = os.environ.get("BENCH_UNROLL")
+    unroll = int(unroll_env) if unroll_env else None
 
-    def measure(n_req, he, r, seg_rounds, reps=3):
-        """Build + run one configuration; returns (rate, n_used) or None.
-
-        Tries n_req, halving down to 10k as the OOM fallback."""
-        import jax
-
-        group_m = math.lcm(he, r)
-        seg_m = seg_rounds - seg_rounds % group_m
-        sizes, nn = [n_req], n_req // 2
-        while nn >= 10_000:
-            sizes.append(nn)
-            nn //= 2
-        for n in sizes:
-            try:
-                st, step, n_topics, honest = build_bench(
-                    n, msg_slots, config=config, heartbeat_every=he,
-                    rounds_per_phase=r,
-                )
-                # publish schedule [R, P]
-                rng = np.random.default_rng(0)
-                if honest is not None:
-                    po = honest[
-                        rng.integers(0, len(honest), size=(seg_m, pubs_per_round))
-                    ].astype(np.int32)
-                else:
-                    po = rng.integers(
-                        0, n, size=(seg_m, pubs_per_round)
-                    ).astype(np.int32)
-                pt = rng.integers(
-                    0, n_topics, size=(seg_m, pubs_per_round)
-                ).astype(np.int32)
-                pv = np.ones((seg_m, pubs_per_round), bool)
-                po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
-
-                # unroll: adjacent iterations let XLA cancel the carry layout
-                # conversions the while-loop form pays per tick (profiled ~35%
-                # of device time); 4 rounds is the per-round knee, and phase
-                # mode gains another ~7-8% from unrolling TWO phases per scan
-                # iteration (r=8: 1200 -> 1296, r=16: 1365 -> 1460 rounds/s,
-                # round-4 measurements)
-                unroll = int(os.environ.get(
-                    "BENCH_UNROLL", 2 * group_m if r > 1 else 4
-                ))
-                from go_libp2p_pubsub_tpu.driver import make_scan
-
-                # the schedule-owning scan (driver.make_scan) drives all
-                # three builds: per-round, static-heartbeat, and phase
-                scan = make_scan(
-                    step,
-                    heartbeat_every=he,
-                    rounds_per_phase=r,
-                    static_heartbeat=he > 1 or r > 1,
-                    unroll=max(1, unroll // group_m),
-                )
-
-                st = scan(st, po_j, pt_j, pv_j)  # compile + warmup
-                jax.block_until_ready(st)
-                rates = []
-                for _ in range(reps):
-                    t0 = time.perf_counter()
-                    st = scan(st, po_j, pt_j, pv_j)
-                    # force a device->host readback inside the timed region:
-                    # jax.block_until_ready on the axon remote platform has
-                    # been observed to return before execution completes
-                    # (async handles report ready), inflating rates ~1000x.
-                    # Fetching a scalar that depends on the full step (the
-                    # tick counter + a score checksum) is the honest
-                    # completion barrier.
-                    _ = (int(st.core.tick), float(jnp.sum(st.scores)))
-                    dt = time.perf_counter() - t0
-                    rates.append(seg_m / dt)
-                return max(rates), n
-            except Exception as e:  # noqa: BLE001 — smaller N on OOM
-                msg = str(e)
-                if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                        or "exceeds" in msg):
-                    continue
-                raise
-        return None
-
-    res = measure(n_peers, heartbeat_every, rounds_per_phase, seg)
+    res = measure_rate(config, n_peers, msg_slots, heartbeat_every,
+                       rounds_per_phase, seg, reps=3, unroll=unroll)
     if res is None:
         print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": 0}))
         return
-    value, n_peers = res
+    value, n_peers, unroll_used = res
 
-    tag = "" if config == "default" else f"_{config}"
-    if rounds_per_phase > 1:
-        # reference-cadence metric: delivery rounds/s with control every
-        # r rounds (heartbeat_every = r by default) — the honest
-        # comparison to the reference's continuous delivery + 1 Hz
-        # heartbeat shape; same 10k north-star denominator. See
-        # BASELINE.md "The tick <-> delivery-round equivalence rule":
-        # the value counts simulated hop-quanta per second, the same
-        # unit the r=1 tick counts and the 10k target is denominated in.
-        metric = (
-            f"gossipsub_v1.1_delivery_rounds_per_sec_n{n_peers}{tag}"
-            f"_phase{rounds_per_phase}"
-        )
-    else:
-        metric = f"gossipsub_v1.1_heartbeat_ticks_per_sec_n{n_peers}{tag}"
     out = {
-        "metric": metric,
+        "schema": SCHEMA_VERSION,
+        "metric": metric_name(config, n_peers, rounds_per_phase),
         "value": round(value, 2),
         "unit": "ticks/s" if rounds_per_phase == 1 else "delivery-rounds/s",
-        "vs_baseline": round(value / 10_000.0, 4),
+        "vs_baseline": round(value / NORTH_STAR_RATE, 4),
     }
     if rounds_per_phase > 1:
         # the derived control-cadence rate, so nobody reads the headline
@@ -323,13 +115,19 @@ def main():
             # device-limited rate (the dispatch-amortization bias the
             # round-1 notes quantify), which would misread as a
             # continuity regression
-            cont = measure(n_peers, 1, 1, seg, reps=2)
+            cont = measure_rate(config, n_peers, msg_slots, 1, 1, seg, reps=2)
             if cont is not None:
                 out["continuity_r1_ticks_per_sec"] = round(cont[0], 2)
                 # the r=1 build has different buffer shapes and may OOM-
                 # fall back to a smaller N than the headline — record the
                 # size the continuity rate was actually measured at
                 out["continuity_r1_n"] = cont[1]
+    # the self-description (ADVICE round 5: the artifact itself must
+    # record the elision-enabling config, not just BASELINE.md prose)
+    out["fingerprint"] = workload_fingerprint(
+        config, n_peers, msg_slots, heartbeat_every, rounds_per_phase,
+        seg_rounds=seg, unroll=unroll_used,
+    )
     print(json.dumps(out))
 
 

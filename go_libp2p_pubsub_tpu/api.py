@@ -417,6 +417,7 @@ class Network:
         max_publishes_per_round: int = 8,
         validate_throttle: int = DEFAULT_VALIDATE_THROTTLE,
         validation_delay_rounds: int = 0,
+        validator_timeout_rounds: int = 0,
         queue_cap: int = 0,
         px_connect: bool = False,
         seed: int = 0,
@@ -431,12 +432,13 @@ class Network:
     ):
         if router not in ("gossipsub", "floodsub", "randomsub"):
             raise APIError(f"unknown router {router!r}")
-        if validation_delay_rounds and router != "gossipsub":
-            raise APIError(
-                "validation_delay_rounds is only modeled on the gossipsub router"
-            )
-        if queue_cap and router != "gossipsub":
-            raise APIError("queue_cap is only modeled on the gossipsub router")
+        # validation_delay_rounds and queue_cap apply to EVERY router: in
+        # the reference both sit below the router — the async validation
+        # pipeline (validation.go:65-83) and the per-peer outbound writer
+        # queues (comm.go:139-170; floodsub's drop at floodsub.go:91-98)
+        # serve floodsub/randomsub exactly as they serve gossipsub, and
+        # the shared delivery engine (models/common.py) models both
+        # router-agnostically
         if trace_exact and router != "gossipsub":
             raise APIError("trace_exact is only modeled on the gossipsub router")
         if rounds_per_phase > 1:
@@ -481,6 +483,15 @@ class Network:
         self.pub_width = max_publishes_per_round
         self.validate_throttle = validate_throttle
         self.validation_delay_rounds = validation_delay_rounds
+        # WithValidatorTimeout (validation.go:522-529): an async verdict
+        # that cannot land within T rounds of arrival times out and the
+        # message resolves to Ignore (dropped, no sender penalty). The
+        # knob composes with per-topic delays at the config layer
+        # (GossipSubConfig.validation_timed_out); at the API layer the
+        # effective delay is the uniform validation_delay_rounds.
+        if validator_timeout_rounds < 0:
+            raise APIError("validator_timeout_rounds must be >= 0")
+        self.validator_timeout_rounds = validator_timeout_rounds
         self.queue_cap = queue_cap
         self.px_connect = px_connect
         # WithMaxMessageSize (pubsub.go:480-485; the reference defaults to
@@ -953,12 +964,12 @@ class Network:
         elif self.router == "randomsub":
             from .models.randomsub import make_randomsub_step
 
-            self._step = make_randomsub_step(self.net)
+            self._step = make_randomsub_step(self.net, queue_cap=self.queue_cap)
         else:
             from .models.floodsub import floodsub_step
 
-            def _fstep(st, po, pt, pv, _net=self.net):
-                return floodsub_step(_net, st, po, pt, pv)
+            def _fstep(st, po, pt, pv, _net=self.net, _cap=self.queue_cap):
+                return floodsub_step(_net, st, po, pt, pv, queue_cap=_cap)
 
             self._step = _fstep
 
@@ -985,6 +996,9 @@ class Network:
                 # reference-faithful; the tracer-detached bench path
                 # (bench.py builds the step directly) keeps elision
                 exact_counters=True,
+                # _run_phase enforces the msg_slots//2 flat admission cap,
+                # so the engine-layer capacity warning would be noise here
+                admission_capped=True,
             )
             return
         self._step = make_gossipsub_step(
@@ -1017,6 +1031,7 @@ class Network:
                 score_enabled=score_enabled,
                 gater_params=self.gater_params,
                 validation_delay_rounds=self.validation_delay_rounds,
+                validator_timeout_rounds=self.validator_timeout_rounds,
                 queue_cap=self.queue_cap,
                 trace_exact=self.trace_exact,
             )
@@ -1041,20 +1056,25 @@ class Network:
             self._recompile_gossipsub()
             self._dynamic = True
         elif self.router == "randomsub":
+            # the validation pipeline + outbound queues sit below the
+            # router in the reference (validation.go:65-83,
+            # comm.go:139-170) — same knobs as gossipsub
             self.state = SimState.init(n, self.msg_slots, self.seed,
                                        k=self.net.max_degree,
+                                       val_delay=self.validation_delay_rounds,
                                        wire_block=self.max_message_size is not None)
-            self._step = make_randomsub_step(self.net)
+            self._step = make_randomsub_step(self.net, queue_cap=self.queue_cap)
             self._dynamic = False
         else:  # floodsub
             from .models.floodsub import floodsub_step
 
             self.state = SimState.init(n, self.msg_slots, self.seed,
                                        k=self.net.max_degree,
+                                       val_delay=self.validation_delay_rounds,
                                        wire_block=self.max_message_size is not None)
 
-            def _fstep(st, po, pt, pv, _net=self.net):
-                return floodsub_step(_net, st, po, pt, pv)
+            def _fstep(st, po, pt, pv, _net=self.net, _cap=self.queue_cap):
+                return floodsub_step(_net, st, po, pt, pv, queue_cap=_cap)
 
             self._step = _fstep
             self._dynamic = False
@@ -1386,6 +1406,7 @@ class Network:
         v = self._validators.get(topic.name)
         if v is None:
             return VERDICT_ACCEPT
+        timed_out = False
         if not v.inline:
             tb = self._topic_budget.setdefault(topic.name, v.throttle)
             if self._async_budget <= 0 or tb <= 0:
@@ -1393,7 +1414,23 @@ class Network:
                 raise ValidationError("validation throttled")
             self._async_budget -= 1
             self._topic_budget[topic.name] = tb - 1
+            # WithValidatorTimeout (validation.go:522-529): the verdict
+            # of an async validator whose pipeline delay exceeds the
+            # timeout never lands — the expired context resolves to
+            # Ignore. The validator still RUNS (the reference cancels
+            # the context, not the goroutine); its result is discarded.
+            if self.validator_timeout_rounds > 0:
+                cfg = getattr(self, "_cfg", None)  # gossipsub-only per-topic
+                if cfg is not None:
+                    timed_out = cfg.validation_timed_out(topic.tid)
+                else:
+                    timed_out = (self.validation_delay_rounds
+                                 > self.validator_timeout_rounds)
         res = v.fn(node.identity.peer_id, msg)
+        if timed_out:
+            if local:
+                raise ValidationError("validation timed out")
+            return VERDICT_IGNORE
         # bool returns keep the original two-verdict interface. Normalize
         # by type first: bools (incl. numpy bools) overlap the int codes
         # 1/0, so a truthiness check must precede the code comparison

@@ -28,18 +28,27 @@ def flood_edge_mask(net: Net, msgs) -> jax.Array:
     return jnp.broadcast_to(sub_words[:, None, :], (net.n_peers, net.max_degree, sub_words.shape[-1]))
 
 
-@functools.partial(jax.jit, donate_argnums=1)
+@functools.partial(jax.jit, donate_argnums=1, static_argnames=("queue_cap",))
 def floodsub_step(
     net: Net,
     state: SimState,
     pub_origin: jax.Array,  # [P] i32, -1 pad
     pub_topic: jax.Array,   # [P] i32
     pub_valid: jax.Array,   # [P] bool
+    queue_cap: int = 0,     # per-edge outbound budget (comm.go:139-170;
+                            # floodsub's own drop is floodsub.go:91-98)
 ) -> SimState:
     """One synchronous round: deliver in-flight messages one hop, then
-    intern this round's publishes (they start propagating next round)."""
+    intern this round's publishes (they start propagating next round).
+
+    The async-validation pipeline and the outbound-queue cap both live
+    BELOW the router in the reference, so they apply here exactly as in
+    gossipsub: build the state with ``SimState.init(val_delay=...)`` for
+    the pipeline (its presence in ``state.dlv.pending`` is the
+    configuration), pass ``queue_cap`` for lossy backpressure."""
     edge_mask = flood_edge_mask(net, state.msgs)
-    dlv, info = delivery_round(net, state.msgs, state.dlv, edge_mask, state.tick)
+    dlv, info = delivery_round(net, state.msgs, state.dlv, edge_mask, state.tick,
+                               queue_cap=queue_cap)
 
     msgs, dlv, _slots, is_pub, _keep, _pub_words = allocate_publishes(
         state.msgs, dlv, state.tick, pub_origin, pub_topic, pub_valid

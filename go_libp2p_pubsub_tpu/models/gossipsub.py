@@ -141,6 +141,13 @@ class GossipSubConfig:
     # different topics interleave out of arrival order. None = uniform
     # validation_delay_rounds for every topic.
     validation_delay_topic: tuple | None = None
+    # WithValidatorTimeout analogue (validation.go:522-529): an async
+    # validator whose verdict would land more than this many rounds after
+    # arrival times out, and the message is IGNORED (dropped without the
+    # P4 sender penalty — the reference's expired validation context).
+    # Composes with the per-topic delays above: a topic whose effective
+    # delay exceeds the timeout never produces an Accept. 0 = no timeout.
+    validator_timeout_rounds: int = 0
     # fanout (publishing to unjoined topics, gossipsub.go:981-1002,1517-1554)
     fanout_slots: int = 2         # concurrent unjoined publish topics/peer
     fanout_ttl_ticks: int = 60
@@ -174,11 +181,16 @@ class GossipSubConfig:
         validation_capacity: int = 0,
         validation_delay_rounds: int = 0,
         validation_delay_topic: tuple | None = None,
+        validator_timeout_rounds: int = 0,
         queue_cap: int = 0,
         trace_exact: bool = False,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
+        if validator_timeout_rounds < 0:
+            raise ValueError(
+                f"validator_timeout_rounds must be >= 0, got {validator_timeout_rounds}"
+            )
         if validation_delay_topic is not None:
             validation_delay_topic = tuple(int(d) for d in validation_delay_topic)
             if validation_delay_rounds <= 0:
@@ -214,6 +226,7 @@ class GossipSubConfig:
             validation_capacity=validation_capacity,
             validation_delay_rounds=validation_delay_rounds,
             validation_delay_topic=validation_delay_topic,
+            validator_timeout_rounds=validator_timeout_rounds,
             queue_cap=queue_cap,
             trace_exact=trace_exact,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
@@ -228,6 +241,19 @@ class GossipSubConfig:
                 opportunistic_graft_threshold=thresholds.opportunistic_graft_threshold,
             )
         return cls(**kw)
+
+    def validation_timed_out(self, topic: int) -> bool:
+        """True when this topic's async verdict can never land inside the
+        validator timeout (effective delay > validator_timeout_rounds):
+        its messages resolve to ValidationIgnore, the reference's
+        expired-context outcome (validation.go:522-529)."""
+        if self.validator_timeout_rounds <= 0:
+            return False
+        if self.validation_delay_topic is not None:
+            delay = self.validation_delay_topic[topic]
+        else:
+            delay = self.validation_delay_rounds
+        return delay > self.validator_timeout_rounds
 
 
 # ---------------------------------------------------------------------------

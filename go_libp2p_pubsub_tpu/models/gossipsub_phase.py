@@ -115,6 +115,7 @@ def make_gossipsub_phase_step(
     sub_knowledge_holes: np.ndarray | None = None,
     score_counts: bool | None = None,
     exact_counters: bool = False,
+    admission_capped: bool = False,
 ):
     """Build the jitted multi-round phase step.
 
@@ -134,6 +135,18 @@ def make_gossipsub_phase_step(
     The fused Pallas data plane (PUBSUB_FUSED) is not applicable here —
     the phase engine's sender-side form already collapses the exchange to
     one gather per sub-round.
+
+    **Admission invariant** (enforced here since round 6): a phase may
+    admit at most ``msg_slots // 2`` publishes — slots recycled WITHIN a
+    phase wipe their in-flight receipts before the boundary drain can
+    observe them, and the deferred recycled-slot clears below additionally
+    assume a slot is never re-allocated within its phase. The API layer
+    caps admission (api.Network._run_phase); direct drivers feeding full
+    ``[r, P]`` schedules can exceed it silently (e.g. pub_width=4, r=32,
+    M=64 = 128 potential publishes/phase), so the built step WARNS at
+    trace time when ``rounds_per_phase * pub_width > msg_slots // 2``.
+    ``admission_capped=True`` (the API's builds) suppresses the warning —
+    the caller certifies it enforces the flat cap itself.
     """
     r = int(rounds_per_phase)
     assert r >= 1
@@ -192,6 +205,22 @@ def make_gossipsub_phase_step(
         tick0 = core.tick
         m = core.msgs.capacity
         w = bitset.n_words(m)
+
+        # the admission invariant, checked at trace time (shapes are
+        # static): see the builder docstring. ADVICE round 5 item 2.
+        if not admission_capped and r * pub_origin.shape[-1] > m // 2:
+            import warnings
+
+            warnings.warn(
+                f"phase publish capacity rounds_per_phase*pub_width = "
+                f"{r}*{pub_origin.shape[-1]} exceeds msg_slots//2 = {m // 2}: "
+                "slots recycled within a phase silently wipe in-flight "
+                "receipts (and the deferred recycled-slot clears assume no "
+                "within-phase re-allocation). Cap admitted publishes at "
+                f"{m // 2} per phase (api.Network._run_phase does), raise "
+                "msg_slots, or lower the publish rate.",
+                stacklevel=3,
+            )
 
         acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
                                        core.key, tick0)

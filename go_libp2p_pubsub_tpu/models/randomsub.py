@@ -28,7 +28,8 @@ RANDOMSUB_D = 6  # randomsub.go:17
 
 
 def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
-                        size_estimate: int | None = None):
+                        size_estimate: int | None = None,
+                        queue_cap: int = 0):
     """Build the jitted per-round RandomSub step.
 
     `size_estimate` mirrors the reference's static network-size parameter:
@@ -39,7 +40,13 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
     since a node doesn't know the topic's global size; parity claims
     against the Go reference should pass the same size estimate the Go
     node was constructed with). Floodsub-only peers are split out before
-    sampling either way (randomsub.go:107-116)."""
+    sampling either way (randomsub.go:107-116).
+
+    ``queue_cap`` is the sub-router outbound-queue budget (comm.go:
+    139-170 — the writer queues sit below every router); the async
+    validation pipeline likewise rides in the state
+    (``SimState.init(val_delay=...)``), both shared with floodsub and
+    gossipsub through the common delivery engine."""
     protocol = np.asarray(net.protocol)
     if size_estimate is not None:
         gs_size = np.full((net.n_topics,), size_estimate, np.int64)
@@ -83,7 +90,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
         )
         edge_mask = carried & joined_msg_words(net, st.msgs)[:, None, :]
 
-        dlv, info = delivery_round(net, st.msgs, st.dlv, edge_mask, tick)
+        dlv, info = delivery_round(net, st.msgs, st.dlv, edge_mask, tick,
+                                   queue_cap=queue_cap)
         msgs, dlv, _slots, is_pub, _keep, _pw = allocate_publishes(
             st.msgs, dlv, tick, pub_origin, pub_topic, pub_valid
         )

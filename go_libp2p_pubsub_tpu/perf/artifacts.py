@@ -1,0 +1,196 @@
+"""Versioned, self-describing bench artifacts (schema v2) + readers.
+
+Motivation (ADVICE round 5, item 1): the round-5 headline gains partly
+came from a *workload* change — the honest-net configs zeroed
+``invalid_message_deliveries_weight`` so the phase engine statically
+elides the P4 trans plane — but the emitted JSON recorded only the
+number, so cross-round comparison depended on reading a BASELINE.md
+addendum. Schema v2 makes every bench line carry a config fingerprint
+(score weights incl. the elision flags, cadence, shard shape, engine
+gating), so an artifact alone answers "what exactly was measured".
+
+Three on-disk shapes are normalized here:
+
+  * **v2 line** — what bench.py now prints: the v1 metric fields plus
+    ``"schema": 2`` and ``"fingerprint": {...}``;
+  * **v1 line** — rounds 1–5 bench output: bare
+    ``{"metric", "value", "unit", "vs_baseline", ...}``;
+  * **driver wrapper** — the committed ``BENCH_r0*.json`` files:
+    ``{"n": round, "cmd", "rc", "tail", "parsed": <line>}`` where
+    ``parsed`` is a v1 or v2 line (``MULTICHIP_r0*.json`` wrappers carry
+    ``{"n_devices", "rc", "ok", "skipped", "tail"}`` instead).
+
+``load_bench_artifact`` accepts any of the three and returns a
+:class:`BenchRecord`; ``load_bench_trajectory`` globs a repo checkout
+for the committed ``BENCH_r*.json`` series in round order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import re
+
+SCHEMA_VERSION = 2
+
+#: the north-star denominator every ``vs_baseline`` in the series uses
+#: (BASELINE.json: >= 10k simulated delivery rounds / heartbeat ticks
+#: per wall second on a v5e-8)
+NORTH_STAR_RATE = 10_000.0
+
+
+@dataclasses.dataclass
+class BenchRecord:
+    """One normalized bench measurement.
+
+    ``schema`` is 1 for legacy lines (no fingerprint), 2 for
+    self-describing lines. ``round_index`` is the driver round number
+    when the record came from a committed ``BENCH_r0N.json`` wrapper
+    (None for a raw line). ``extras`` keeps every field the schema does
+    not model (heartbeats_per_sec, continuity metrics, unit notes) so a
+    v2 round-trip is lossless."""
+
+    metric: str
+    value: float
+    unit: str
+    vs_baseline: float
+    schema: int = 1
+    fingerprint: dict | None = None
+    round_index: int | None = None
+    extras: dict = dataclasses.field(default_factory=dict)
+
+    # -- derived views ----------------------------------------------------
+
+    @property
+    def rounds_per_phase(self) -> int:
+        """Cadence of the headline metric (1 = per-round heavy tick).
+        v2 reads the fingerprint; v1 falls back to the ``_phaseR`` metric
+        name suffix rounds 4-5 used."""
+        if self.fingerprint and "rounds_per_phase" in self.fingerprint:
+            return int(self.fingerprint["rounds_per_phase"])
+        m = re.search(r"_phase(\d+)$", self.metric)
+        return int(m.group(1)) if m else 1
+
+    @property
+    def n_peers(self) -> int | None:
+        if self.fingerprint and "n_peers" in self.fingerprint:
+            return int(self.fingerprint["n_peers"])
+        m = re.search(r"_n(\d+)", self.metric)
+        return int(m.group(1)) if m else None
+
+    @property
+    def config(self) -> str:
+        if self.fingerprint and "config" in self.fingerprint:
+            return str(self.fingerprint["config"])
+        for tag in ("eth2", "sybil"):
+            if f"_{tag}" in self.metric:
+                return tag
+        return "default"
+
+    @property
+    def ms_per_round(self) -> float:
+        return 1000.0 / self.value
+
+    def to_line(self) -> dict:
+        """The v2 JSON-line object (what bench.py prints)."""
+        out = {
+            "schema": SCHEMA_VERSION,
+            "metric": self.metric,
+            "value": self.value,
+            "unit": self.unit,
+            "vs_baseline": self.vs_baseline,
+        }
+        out.update(self.extras)
+        if self.fingerprint is not None:
+            out["fingerprint"] = self.fingerprint
+        return out
+
+
+def dump_record(rec: BenchRecord) -> str:
+    """Serialize one record as the single bench JSON line."""
+    return json.dumps(rec.to_line())
+
+
+def record_from_line(obj: dict, round_index: int | None = None) -> BenchRecord:
+    """Normalize a parsed v1/v2 metric line into a BenchRecord."""
+    if "metric" not in obj:
+        raise ValueError(f"not a bench metric line: keys={sorted(obj)}")
+    known = {"schema", "metric", "value", "unit", "vs_baseline", "fingerprint"}
+    return BenchRecord(
+        metric=str(obj["metric"]),
+        value=float(obj["value"]),
+        unit=str(obj.get("unit", "")),
+        vs_baseline=float(obj.get("vs_baseline", float(obj["value"]) / NORTH_STAR_RATE)),
+        schema=int(obj.get("schema", 1)),
+        fingerprint=obj.get("fingerprint"),
+        round_index=round_index,
+        extras={k: v for k, v in obj.items() if k not in known},
+    )
+
+
+def _last_json_line(text: str) -> dict | None:
+    """The driver captures stderr warnings around the one JSON line; take
+    the last parseable object line of a tail blob."""
+    out = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                out = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def load_bench_artifact(path: str) -> BenchRecord:
+    """Read one bench artifact file (raw line, JSON-lines, or driver
+    wrapper) into a BenchRecord."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        # JSON-lines: last metric line wins (bench prints exactly one)
+        obj = _last_json_line(text)
+        if obj is None:
+            raise ValueError(f"{path}: no parseable JSON line")
+    if isinstance(obj, dict) and "parsed" in obj:  # driver wrapper
+        return record_from_line(obj["parsed"], round_index=obj.get("n"))
+    if isinstance(obj, dict) and "metric" not in obj and "tail" in obj:
+        # wrapper whose parse failed driver-side; recover from the tail
+        inner = _last_json_line(obj["tail"])
+        if inner is None:
+            raise ValueError(f"{path}: wrapper has no parseable tail line")
+        return record_from_line(inner, round_index=obj.get("n"))
+    return record_from_line(obj)
+
+
+def load_bench_trajectory(repo_root: str | None = None) -> list[BenchRecord]:
+    """All committed ``BENCH_r*.json`` records, in round order."""
+    root = repo_root or _repo_root()
+    recs = [
+        load_bench_artifact(p)
+        for p in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    ]
+    recs.sort(key=lambda r: (r.round_index is None, r.round_index))
+    return recs
+
+
+def load_multichip_artifact(path: str) -> dict:
+    """Read a ``MULTICHIP_r0N.json`` driver wrapper: ``{"n_devices",
+    "rc", "ok", "skipped", "tail"}``. The ``ok`` flag is what the
+    projection engine gates on — it certifies the sharded step (incl.
+    the phase engine) ran on the virtual mesh, which is what validates
+    the collective-count model the ICI term is built from."""
+    with open(path) as f:
+        obj = json.load(f)
+    for key in ("ok", "rc"):
+        if key not in obj:
+            raise ValueError(f"{path}: not a multichip artifact (no {key!r})")
+    return obj
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

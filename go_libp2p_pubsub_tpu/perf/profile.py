@@ -1,0 +1,399 @@
+"""Library-ified per-op profiler for the bench workloads.
+
+Extracted from scripts/profile_trace.py (which is now a thin CLI over
+this module) so ANY (N, r, config) shape can be profiled and the result
+consumed as data — the round-5 BASELINE.md table was hand-transcribed
+from script stdout; the round-6 ask ("per-op profile of the 12.5k
+shard") lands as a :class:`ProfileTable`.
+
+Capture runs the EXACT bench workload (perf.sweep.build_bench) under
+``jax.profiler.trace`` so op attribution maps 1:1 onto what
+BENCH_r*.json measures. Three summarization backends, tried in order:
+
+  1. ``xprof.convert`` hlo_stats — the driver image's converter (what
+     produced the round-5 table);
+  2. ``tensorboard_plugin_profile.convert`` hlo_stats — same tool data,
+     older packaging;
+  3. direct ``*.xplane.pb`` parsing — no converter at all: walks the
+     XSpace event trees (per-line interval nesting -> self times) and
+     aggregates per-op self time. This is the backend that works on the
+     bare-CPU test image, and it is what makes ``parse_xspace_bytes``
+     unit-testable with a synthetic XSpace.
+
+The backends see the same trace; they differ only in who does the
+self-time bookkeeping. ``ProfileTable.backend`` records which ran.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import re
+from collections import defaultdict
+
+
+@dataclasses.dataclass
+class OpRow:
+    """One HLO op's attributed cost in the profiled segment."""
+
+    name: str
+    category: str
+    self_us_per_round: float
+    occurrences: int = 0
+    source: str = ""
+    text: str = ""
+
+
+@dataclasses.dataclass
+class ProfileTable:
+    """Attributed per-op table for one profiled workload segment."""
+
+    rows: list            # [OpRow], sorted by self time desc
+    total_us_per_round: float
+    rounds: int
+    backend: str
+    fingerprint: dict | None = None
+
+    @property
+    def by_category(self) -> dict:
+        out = defaultdict(float)
+        for r in self.rows:
+            out[r.category] += r.self_us_per_round
+        return dict(out)
+
+    def top(self, n: int = 30) -> list:
+        return self.rows[:n]
+
+
+# ---------------------------------------------------------------------------
+# backend 3: direct xplane parsing (no converter dependency)
+
+
+def _import_xplane_pb2():
+    """The XSpace proto ships under several package roots depending on
+    which profiler wheel is installed; take the first importable."""
+    import importlib
+
+    for mod in (
+        "xprof.protobuf.xplane_pb2",
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2",
+        "tsl.profiler.protobuf.xplane_pb2",
+        "tensorboard_plugin_profile.protobuf.xplane_pb2",
+    ):
+        try:
+            return importlib.import_module(mod)
+        except ImportError:
+            continue
+    return None
+
+
+def _self_times(events):
+    """(start_ps, dur_ps, key) intervals -> [(key, self_ps)] with each
+    interval's children (strictly nested, same line) subtracted."""
+    evs = sorted(events, key=lambda t: (t[0], -t[1]))
+    out = []
+    stack = []  # [start, end, key, child_sum]
+
+    def finish():
+        s, e, key, child = stack.pop()
+        out.append((key, (e - s) - child))
+        if stack:
+            stack[-1][3] += e - s
+
+    for s, d, key in evs:
+        while stack and stack[-1][1] <= s:
+            finish()
+        stack.append([s, s + d, key, 0])
+    while stack:
+        finish()
+    return out
+
+
+_CATEGORY_RE = re.compile(r"^[a-zA-Z-]+")
+
+
+def _category_of(name: str, explicit: str | None) -> str:
+    """Fallback category when the plane carries no ``hlo_category`` stat
+    (XLA:CPU): fused computations are named ``<roots>_fusion.N[.clone]``
+    — bucket them all as "fusion" (what the TPU hlo_stats tool reports);
+    plain ops keep their leading mnemonic."""
+    if explicit:
+        return explicit
+    if "fusion" in name:
+        return "fusion"
+    m = _CATEGORY_RE.match(name)
+    return m.group(0) if m else name
+
+
+def parse_xspace_bytes(blobs, rounds: int) -> ProfileTable:
+    """Aggregate per-op self times from serialized XSpace protos.
+
+    Takes HLO-op events from two plane shapes: device planes (plane name
+    contains "device"/"TPU" — TPU runs), and host planes' executor lines
+    whose events carry an ``hlo_op`` stat (XLA:CPU runs). Python/trace
+    bookkeeping lines carry no hlo stats and are skipped."""
+    xplane_pb2 = _import_xplane_pb2()
+    if xplane_pb2 is None:
+        raise RuntimeError(
+            "no xplane proto module importable (xprof, tensorflow.tsl, "
+            "tsl, or tensorboard_plugin_profile)"
+        )
+    agg = {}  # name -> [self_ps, count, category, source]
+    for blob in blobs:
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(blob)
+        for plane in xs.planes:
+            is_device = ("device" in plane.name.lower()
+                         or "tpu" in plane.name.lower())
+            emeta = plane.event_metadata
+            smeta = plane.stat_metadata
+            for line in plane.lines:
+                intervals = []
+                info = {}
+                for ev in line.events:
+                    stats = {}
+                    for st in ev.stats:
+                        sname = smeta[st.metadata_id].name
+                        if st.str_value:
+                            stats[sname] = st.str_value
+                        elif st.ref_value:
+                            stats[sname] = smeta[st.ref_value].name
+                    name = stats.get("hlo_op") or emeta[ev.metadata_id].name
+                    if "hlo_op" not in stats and not (
+                            is_device and line.name.startswith("XLA")):
+                        continue
+                    if ev.duration_ps <= 0:
+                        continue
+                    intervals.append((ev.offset_ps, ev.duration_ps, name))
+                    if name not in info:
+                        info[name] = (
+                            stats.get("hlo_category"),
+                            stats.get("source") or stats.get("source_info", ""),
+                        )
+                for name, self_ps in _self_times(intervals):
+                    cat, src = info.get(name, (None, ""))
+                    row = agg.setdefault(
+                        name, [0, 0, _category_of(name, cat), src])
+                    row[0] += self_ps
+                    row[1] += 1
+    rows = [
+        OpRow(name=k, category=v[2],
+              self_us_per_round=v[0] / 1e6 / max(rounds, 1),
+              occurrences=v[1], source=v[3])
+        for k, v in agg.items()
+    ]
+    rows.sort(key=lambda r: -r.self_us_per_round)
+    return ProfileTable(
+        rows=rows,
+        total_us_per_round=sum(r.self_us_per_round for r in rows),
+        rounds=rounds,
+        backend="xplane",
+    )
+
+
+# ---------------------------------------------------------------------------
+# backends 1-2: hlo_stats converters
+
+
+def _hlo_stats_converter():
+    try:
+        from xprof.convert import raw_to_tool_data  # noqa: PLC0415
+
+        return raw_to_tool_data, "xprof"
+    except Exception:  # noqa: BLE001 — optional dependency seam
+        pass
+    try:
+        from tensorboard_plugin_profile.convert import (  # noqa: PLC0415
+            raw_to_tool_data,
+        )
+
+        return raw_to_tool_data, "tensorboard_plugin_profile"
+    except Exception:  # noqa: BLE001
+        return None, None
+
+
+def parse_hlo_stats_obj(obj: dict, rounds: int, backend: str = "hlo_stats"
+                        ) -> ProfileTable:
+    """Normalize an hlo_stats tool-data object (the converter output
+    scripts/profile_trace.py consumed: column 2 = category, 3 = op name,
+    4 = HLO text, 9 = self time us, 25 = source) into a ProfileTable."""
+    rows_in = [r["c"] if isinstance(r, dict) else r for r in obj["rows"]]
+
+    def val(r, i):
+        v = r[i] if i < len(r) else None
+        return v.get("v") if isinstance(v, dict) else v
+
+    agg = {}
+    for r in rows_in:
+        selft = float(val(r, 9) or 0)
+        name = str(val(r, 3) or "?")
+        src = re.sub(r"<[^>]+>", "", str(val(r, 25) or "")).strip()
+        row = agg.setdefault(
+            name, [0.0, 0, str(val(r, 2) or ""), src, str(val(r, 4) or "")])
+        row[0] += selft
+        row[1] += 1
+    rows = [
+        OpRow(name=k, category=v[2],
+              self_us_per_round=v[0] / max(rounds, 1),
+              occurrences=v[1], source=v[3], text=v[4])
+        for k, v in agg.items()
+    ]
+    rows.sort(key=lambda r: -r.self_us_per_round)
+    return ProfileTable(
+        rows=rows,
+        total_us_per_round=sum(r.self_us_per_round for r in rows),
+        rounds=rounds,
+        backend=backend,
+    )
+
+
+# ---------------------------------------------------------------------------
+# capture + summarize
+
+
+def summarize_logdir(logdir: str, rounds: int) -> ProfileTable:
+    """Summarize a captured ``jax.profiler.trace`` logdir with the first
+    working backend."""
+    paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    if not paths:
+        raise RuntimeError(f"no xplane.pb under {logdir}")
+    conv, conv_name = _hlo_stats_converter()
+    if conv is not None:
+        try:
+            import json
+
+            data, _ = conv.xspace_to_tool_data(paths, "hlo_stats", {})
+            obj = data if isinstance(data, dict) else json.loads(data)
+            return parse_hlo_stats_obj(obj, rounds, backend=conv_name)
+        except Exception:  # noqa: BLE001 — converter wheels break often;
+            pass           # the direct parse below reads the same trace
+    blobs = [open(p, "rb").read() for p in paths]
+    return parse_xspace_bytes(blobs, rounds)
+
+
+def profile_workload(
+    n_peers: int,
+    rounds: int = 50,
+    config: str = "default",
+    rounds_per_phase: int = 1,
+    msg_slots: int = 64,
+    heartbeat_every: int | None = None,
+    unroll: int | None = None,
+    logdir: str = "/tmp/pubsub_prof",
+    seed: int = 0,
+) -> ProfileTable:
+    """Capture + summarize one profiled segment of the exact bench
+    workload at an arbitrary (N, r, config) shape.
+
+    ``rounds`` is truncated down to a whole number of phases (never to
+    zero). The returned table carries the workload fingerprint so a
+    recorded profile is as self-describing as a schema-v2 bench line."""
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .sweep import PUBS_PER_ROUND, build_bench, workload_fingerprint
+
+    r = max(int(rounds_per_phase), 1)
+    he = heartbeat_every if heartbeat_every is not None else (r if r > 1 else 1)
+    rounds = max(rounds - rounds % r, r)
+    st, step, n_topics, honest = build_bench(
+        n_peers, msg_slots, seed=seed, config=config, heartbeat_every=he,
+        rounds_per_phase=r,
+    )
+
+    rng = np.random.default_rng(0)
+    if honest is not None:
+        po = honest[
+            rng.integers(0, len(honest), size=(rounds, PUBS_PER_ROUND))
+        ].astype(np.int32)
+    else:
+        po = rng.integers(0, n_peers, size=(rounds, PUBS_PER_ROUND)).astype(np.int32)
+    po = jnp.asarray(po)
+    pt = jnp.asarray(rng.integers(
+        0, n_topics, size=(rounds, PUBS_PER_ROUND)).astype(np.int32))
+    pv = jnp.asarray(np.ones((rounds, PUBS_PER_ROUND), bool))
+
+    from ..driver import make_scan
+
+    u = unroll if unroll is not None else (2 * r if r > 1 else 4)
+    scan = make_scan(step, heartbeat_every=he, rounds_per_phase=r,
+                     static_heartbeat=he > 1 or r > 1,
+                     unroll=max(1, u // max(r, 1)))
+    st = scan(st, po, pt, pv)  # compile + warmup
+    jax.block_until_ready(st)
+
+    shutil.rmtree(logdir, ignore_errors=True)
+    with jax.profiler.trace(logdir):
+        st = scan(st, po, pt, pv)
+        jax.block_until_ready(st)
+
+    table = summarize_logdir(logdir, rounds)
+    table.fingerprint = workload_fingerprint(
+        config, n_peers, msg_slots, he, r, seg_rounds=rounds, unroll=u)
+    return table
+
+
+def format_table(table: ProfileTable, top: int = 30) -> str:
+    """Render the BASELINE.md-style attribution table."""
+    lines = [
+        f"total device self time: {table.total_us_per_round * table.rounds / 1e3:.1f} ms;"
+        f" per round: {table.total_us_per_round:.0f} us"
+        f"  (backend: {table.backend}, rounds: {table.rounds})",
+        "",
+        "by category:",
+    ]
+    total = table.total_us_per_round or 1.0
+    for k, v in sorted(table.by_category.items(), key=lambda x: -x[1]):
+        lines.append(f"  {v:8.1f} us/rd {100 * v / total:5.1f}%  {k}")
+    lines.append("")
+    lines.append(f"top {top} ops:")
+    for r in table.top(top):
+        lines.append(
+            f"  {r.self_us_per_round:7.1f} us/rd {r.name:<30} "
+            f"{r.source[:80]}"
+        )
+        if r.text:
+            lines.append(f"      {r.text[:140]}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    """CLI twin of the old scripts/profile_trace.py."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="per-op device profile of the bench workload")
+    ap.add_argument("n", nargs="?", type=int, default=100_000)
+    ap.add_argument("rounds", nargs="?", type=int, default=50)
+    ap.add_argument("--config", default=os.environ.get("BENCH_CONFIG", "default"))
+    ap.add_argument("--r", type=int,
+                    default=int(os.environ.get("BENCH_PHASE_R", 1)),
+                    help="rounds per phase (1 = per-round step)")
+    ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM"))
+    ap.add_argument("--top", type=int, default=30)
+    # honor the bench's unroll override so the captured op attribution
+    # maps 1:1 onto a BENCH run measured with the same BENCH_UNROLL
+    unroll_env = os.environ.get("BENCH_UNROLL")
+    ap.add_argument("--unroll", type=int,
+                    default=int(unroll_env) if unroll_env else None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    prng = os.environ.get("BENCH_PRNG", "unsafe_rbg")
+    if prng:
+        jax.config.update("jax_default_prng_impl", prng)
+
+    table = profile_workload(args.n, args.rounds, config=args.config,
+                             rounds_per_phase=args.r, unroll=args.unroll)
+    print(format_table(table, top=args.top))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""perf-smoke: the CPU-feasible regression gate (``make perf-smoke``).
+
+The committed BENCH_r*.json trajectory is TPU-measured; a CPU container
+cannot reproduce those rates, but it CAN catch the failure modes that
+have actually bitten this repo:
+
+  * artifact rot — a bench/schema change that breaks the committed
+    trajectory's readability (the round-3/4 "uncommitted artifact"
+    hygiene notes; ADVICE round 5 item 1);
+  * structural regressions — the phase engine losing its amortization
+    win over the per-round step. That ratio (phase r=8 vs per-round) is
+    machine-independent in direction: rounds 4-5 measured 3.5-4.5x on
+    TPU and it holds well above 1 on XLA:CPU, so a fresh mini-bench
+    where the phase engine fails to beat the per-round step signals a
+    real engine regression, not machine noise;
+  * absolute collapse — the mini-bench falling below a generous
+    fraction of the committed smoke baseline (PERF_SMOKE.json, recorded
+    on the image this gate first ran on). Machines vary; the tolerance
+    is deliberately loose and env-overridable.
+
+Checks, in order (any failure -> exit 1):
+  1. trajectory integrity: every BENCH_r*.json + MULTICHIP_r*.json
+     parses through perf.artifacts; values positive; round order sane.
+  2. projection engine: the committed round-5 projection reproduces
+     (central 44-45% of the north star) — the same invariant
+     tests/test_perf.py pins, enforced here so a bare ``make
+     perf-smoke`` needs no pytest.
+  3. mini-bench: run (default config, PERF_SMOKE_N peers) at r=1 and
+     r=8 on CPU; require phase_rate > PHASE_MIN_RATIO * per_round_rate
+     and rate >= PERF_SMOKE_TOL * committed baseline (when present).
+
+Emits one schema-v2 JSON line per mini-bench cell, then a PASS/FAIL
+summary line. ``PERF_SMOKE_UPDATE=1`` rewrites PERF_SMOKE.json from
+this run (use when the gate machine changes).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+#: mini-bench shape: big enough that the phase engine's control
+#:   amortization is visible over fixed overhead, small enough that the
+#:   whole gate (2 compiles + 2 timed segments) stays ~a minute on CPU
+PERF_SMOKE_N = 2048
+PERF_SMOKE_ROUNDS = 128
+PERF_SMOKE_R = 8
+
+#: the phase engine must beat the per-round engine by at least this
+#: factor at the mini-bench shape (TPU: 3.5-4.5x; CPU measures lower
+#: because XLA:CPU multithreads the big fusions the per-round step is
+#: made of — the floor is set from measured CPU headroom, not TPU's)
+PHASE_MIN_RATIO = 1.15
+
+#: absolute floor: fraction of the committed PERF_SMOKE.json rate the
+#: fresh run must reach (override: PERF_SMOKE_TOL=0.25 etc.)
+DEFAULT_TOL = 0.4
+
+BASELINE_NAME = "PERF_SMOKE.json"
+
+
+def repo_root() -> str:
+    from .artifacts import _repo_root
+
+    return _repo_root()
+
+
+def check_trajectory(root: str) -> list[str]:
+    """Integrity of the committed artifact series; returns error strings."""
+    from .artifacts import load_bench_artifact, load_multichip_artifact
+
+    errors = []
+    bench_paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    if not bench_paths:
+        errors.append("no committed BENCH_r*.json artifacts found")
+    last_round = 0
+    for p in bench_paths:
+        try:
+            rec = load_bench_artifact(p)
+            if rec.value <= 0:
+                errors.append(f"{os.path.basename(p)}: non-positive value {rec.value}")
+            if rec.round_index is not None:
+                if rec.round_index < last_round:
+                    errors.append(f"{os.path.basename(p)}: round index out of order")
+                last_round = rec.round_index
+        except Exception as e:  # noqa: BLE001 — every parse error is a finding
+            errors.append(f"{os.path.basename(p)}: {e}")
+    for p in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        try:
+            load_multichip_artifact(p)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{os.path.basename(p)}: {e}")
+    return errors
+
+
+def check_projection(root: str) -> list[str]:
+    """The committed round-5 projection must reproduce from code."""
+    from .projection import project_from_artifacts
+
+    bench = os.path.join(root, "BENCH_r05.json")
+    multi = os.path.join(root, "MULTICHIP_r05.json")
+    if not (os.path.exists(bench) and os.path.exists(multi)):
+        return []  # nothing committed to check against (fresh clone subset)
+    try:
+        proj = project_from_artifacts(bench, multi)
+    except Exception as e:  # noqa: BLE001
+        return [f"projection from round-5 artifacts failed: {e}"]
+    frac = proj.central / 10_000.0
+    if not 0.44 <= frac <= 0.455:
+        return [
+            f"round-5 projection drifted: central {proj.central:.0f} "
+            f"rounds/s ({100 * frac:.1f}% of north star; committed: 44-45%)"
+        ]
+    return []
+
+
+def run_mini_bench(emit=None) -> dict:
+    """The CPU mini-bench: per-round and phase rates at the smoke shape.
+    Returns {"per_round": rate, "phase": rate, "records": [...]}."""
+    from .sweep import measure_record
+
+    n = int(os.environ.get("PERF_SMOKE_N", PERF_SMOKE_N))
+    rounds = int(os.environ.get("PERF_SMOKE_ROUNDS", PERF_SMOKE_ROUNDS))
+    r = int(os.environ.get("PERF_SMOKE_R", PERF_SMOKE_R))
+    out = {"records": []}
+    for mode, rr in (("per_round", 1), ("phase", r)):
+        rec = measure_record("default", n, 64, rr if rr > 1 else 1, rr,
+                             rounds, reps=2)
+        if rec is None:
+            raise RuntimeError(f"mini-bench {mode} failed to run at N={n}")
+        out[mode] = rec.value
+        out["records"].append(rec)
+        if emit is not None:
+            emit(rec)
+    return out
+
+
+def check_mini_bench(root: str, res: dict) -> list[str]:
+    errors = []
+    per_round, phase = res["per_round"], res["phase"]
+    ratio = phase / per_round if per_round else 0.0
+    if ratio < PHASE_MIN_RATIO:
+        errors.append(
+            f"phase engine no longer amortizes: r={PERF_SMOKE_R} measured "
+            f"{phase:.1f} vs per-round {per_round:.1f} rounds/s "
+            f"(ratio {ratio:.2f} < {PHASE_MIN_RATIO})"
+        )
+    base_path = os.path.join(root, BASELINE_NAME)
+    tol = float(os.environ.get("PERF_SMOKE_TOL", DEFAULT_TOL))
+    if os.path.exists(base_path) and not os.environ.get("PERF_SMOKE_UPDATE"):
+        with open(base_path) as f:
+            base = json.load(f)
+        for key in ("per_round", "phase"):
+            if key in base and res[key] < tol * base[key]:
+                errors.append(
+                    f"mini-bench {key} regressed: {res[key]:.1f} < "
+                    f"{tol:.2f} x committed {base[key]:.1f} rounds/s "
+                    f"({BASELINE_NAME}; PERF_SMOKE_TOL overrides)"
+                )
+    return errors
+
+
+def write_baseline(root: str, res: dict) -> str:
+    path = os.path.join(root, BASELINE_NAME)
+    payload = {
+        "schema": 2,
+        "per_round": round(res["per_round"], 2),
+        "phase": round(res["phase"], 2),
+        "n_peers": int(os.environ.get("PERF_SMOKE_N", PERF_SMOKE_N)),
+        "rounds_per_phase": int(os.environ.get("PERF_SMOKE_R", PERF_SMOKE_R)),
+        "note": (
+            "CPU mini-bench baseline for make perf-smoke "
+            "(perf/regress.py); PERF_SMOKE_UPDATE=1 rewrites"
+        ),
+        "fingerprint": res["records"][-1].fingerprint,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    import jax
+
+    # the gate is CPU-only by contract: it must be runnable (and mean
+    # the same thing) on any dev box / CI runner, TPU present or not
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+    # same persistent compile cache (and jax-version safety gate) the
+    # test tier uses — ../compile_cache.py: the mini-bench is
+    # compile-dominated cold (~2 min) and ~25 s warm
+    from ..compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(os.path.join(repo_root(), ".jax_cache"))
+
+    root = repo_root()
+    errors = check_trajectory(root)
+    errors += check_projection(root)
+
+    from .artifacts import dump_record
+
+    skip_bench = "--no-bench" in (argv or sys.argv[1:])
+    if not skip_bench:
+        try:
+            res = run_mini_bench(emit=lambda r: print(dump_record(r), flush=True))
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"mini-bench crashed: {e}")
+            res = None
+        if res is not None:
+            if os.environ.get("PERF_SMOKE_UPDATE"):
+                print("wrote", write_baseline(root, res))
+            errors += check_mini_bench(root, res)
+
+    if errors:
+        for e in errors:
+            print(f"perf-smoke FAIL: {e}", file=sys.stderr)
+        print(json.dumps({"perf_smoke": "FAIL", "errors": len(errors)}))
+        return 1
+    print(json.dumps({"perf_smoke": "PASS"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,454 @@
+"""Bench workloads + the declarative (config × N × r) sweep runner.
+
+This module owns the workload the numbers are measured on: ``build_bench``
+(moved here from bench.py, which now re-exports it) builds the exact
+BASELINE.json configurations, ``workload_fingerprint`` derives the
+schema-v2 self-description from the same decision table, and
+``run_sweep`` drives a declarative shard/cadence grid — e.g. the eth2
+{12.5k, 25k, 50k} shard table the round-5 review asked for:
+
+    python -m go_libp2p_pubsub_tpu.perf.sweep --config eth2 \\
+        --n 12500,25000,50000 --r 16
+
+Each sweep cell is emitted as one schema-v2 JSON line (perf.artifacts),
+so sweep output is directly comparable against the committed BENCH_r*
+trajectory.
+
+jax is imported inside functions (CLI entry points configure platform /
+PRNG first — see main()).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+
+import numpy as np
+
+#: publish batch width every bench/sweep cell uses ([R, 4] schedules)
+PUBS_PER_ROUND = 4
+
+#: the phase engine flips allocate_publishes to its scatter form at this
+#: peer count (models/gossipsub_phase.py; state.py has the measurements)
+SCATTER_ALLOC_MIN_N = 20_000
+
+#: incremental membership planes are a narrow-universe optimization
+#: (gossipsub_phase.py round-4 addendum 4)
+INCR_MEMBERS_MAX_TOPICS = 8
+
+
+def bench_score_params(config: str, n_topics: int):
+    """The per-config score parameterization (single source for the
+    workload builder AND the fingerprint).
+
+    Returns (TopicScoreParams, PeerScoreParams)."""
+    from ..config import PeerScoreParams, TopicScoreParams
+
+    if config == "sybil":
+        # deficit penalties on: the sybils are what scoring must catch
+        tp = TopicScoreParams(
+            mesh_message_deliveries_weight=-0.5,
+            mesh_message_deliveries_threshold=4.0,
+            mesh_message_deliveries_activation=10.0,
+            mesh_message_deliveries_window=2.0,
+        )
+    else:
+        tp = TopicScoreParams(
+            mesh_message_deliveries_weight=0.0,  # deficit off: honest net
+            mesh_failure_penalty_weight=0.0,
+            # honest net continued: every publish is valid (pv all-True),
+            # so P4 provably never fires — zero weight lets the phase
+            # engine's static elision drop the [N,K,W] trans-accumulation
+            # plane (sybil keeps the default weight: its adversary vector
+            # is what P4 exists to catch)
+            invalid_message_deliveries_weight=0.0,
+        )
+    sp = PeerScoreParams(
+        topics={t: tp for t in range(n_topics)},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-1.0,
+        behaviour_penalty_threshold=1.0,
+        behaviour_penalty_decay=0.9,
+    )
+    return tp, sp
+
+
+def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "default",
+                heartbeat_every: int = 1, rounds_per_phase: int = 1):
+    """Build (state, step, n_topics, honest) for a BENCH_CONFIG:
+
+    default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
+              north-star workload the driver measures)
+    eth2    — 100k-peer Eth2 attestation-subnet geometry: 64 topics, each
+              peer subscribed to 2 random subnets (BASELINE.json config #5).
+              A THROUGHPUT workload, not a coverage one: over the banded
+              ring-lattice adjacency a topic's 3%-density induced subgraph
+              fragments into segments (1-D lattices don't percolate under
+              dilution), so publishes propagate within their segment only —
+              coverage claims live in the parity suite's random-graph
+              configs (PARITY.md eth2 row: reachability structurally
+              attributed)
+    sybil   — 20% sybil attackers (control-plane-only peers that never
+              forward data), peer gater + deficit scoring enabled
+              (BASELINE.json config #4; default BENCH_N 50k)
+
+    ``rounds_per_phase`` > 1 builds the multi-round phase engine
+    (models/gossipsub_phase.py): r delivery rounds per dispatch, control
+    once per phase — the reference's continuous-delivery / 1 Hz-heartbeat
+    timing shape (gossipsub.go:1278-1301).
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    from .. import graph
+    from ..config import GossipSubParams, PeerGaterParams, PeerScoreThresholds
+    from ..models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from ..models.gossipsub_phase import make_gossipsub_phase_step
+    from ..parallel import make_mesh, shard_state
+    from ..state import Net
+
+    # bounded-degree topology (K stays small and static for the compiler)
+    topo = graph.ring_lattice(n_peers, d=8)  # degree 16, K=16
+    if config == "eth2":
+        n_topics = 64  # attestation subnet count
+        subs = graph.subscribe_random(n_peers, n_topics=n_topics,
+                                      topics_per_peer=2, seed=seed)
+    else:
+        n_topics = 1
+        subs = graph.subscribe_all(n_peers, 1)
+    net = Net.build(topo, subs)
+
+    params = _dc.replace(GossipSubParams(), flood_publish=False)
+    _tp, sp = bench_score_params(config, n_topics)
+    gater = PeerGaterParams() if config == "sybil" else None
+    adversary = None
+    if config == "sybil":
+        rng = np.random.default_rng(seed)
+        adversary = rng.random(n_peers) < 0.2
+    cfg = GossipSubConfig.build(
+        params, PeerScoreThresholds(), score_enabled=True, gater_params=gater,
+        validation_capacity=8 if config == "sybil" else 0,
+        heartbeat_every=heartbeat_every,
+    )
+    # tracer-detached configuration (tracing is opt-in in the reference):
+    # no aggregate event counters; no fanout slots when every peer
+    # subscribes the topic (fanout provably can't occur in that workload)
+    cfg = _dc.replace(
+        cfg, count_events=False,
+        fanout_slots=0 if config != "eth2" else cfg.fanout_slots,
+    )
+    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=seed)
+    if rounds_per_phase > 1:
+        step = make_gossipsub_phase_step(
+            cfg, net, rounds_per_phase, score_params=sp, gater_params=gater,
+            adversary_no_forward=adversary,
+        )
+    else:
+        step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gater,
+                                   adversary_no_forward=adversary,
+                                   static_heartbeat=heartbeat_every > 1)
+
+    n_dev = len(jax.devices())
+    if n_dev > 1 and n_peers % n_dev == 0:
+        mesh = make_mesh(n_dev)
+        st = shard_state(st, mesh, n_peers)
+
+    # honest peers only as publish origins: a sybil origin would silently
+    # drop its own publish (adversary peers never transmit message data)
+    honest = np.flatnonzero(~adversary) if adversary is not None else None
+    return st, step, n_topics, honest
+
+
+def workload_fingerprint(
+    config: str,
+    n_peers: int,
+    msg_slots: int,
+    heartbeat_every: int,
+    rounds_per_phase: int,
+    seg_rounds: int | None = None,
+    unroll: int | None = None,
+) -> dict:
+    """The schema-v2 self-description of a bench cell: everything a
+    future reader needs to know what the number measured, derived from
+    the SAME decision table ``build_bench`` uses.
+
+    The elision flags are the ADVICE-round-5 ask: whether the phase
+    engine's static weight elision dropped the mesh-credit (P3/mmd) and
+    invalid-delivery (P4/imd) attribution planes for this config — a
+    workload property that changes what the headline prices."""
+    n_topics = 64 if config == "eth2" else 1
+    tp, sp = bench_score_params(config, n_topics)
+    phase = rounds_per_phase > 1
+    p3_elided = (
+        tp.mesh_message_deliveries_weight == 0.0
+        and (tp.mesh_failure_penalty_weight == 0.0
+             or tp.mesh_message_deliveries_threshold <= 0.0)
+    )
+    p4_elided = tp.invalid_message_deliveries_weight == 0.0
+    fp = {
+        "config": config,
+        "n_peers": int(n_peers),
+        "msg_slots": int(msg_slots),
+        "degree": 16,  # ring_lattice(d=8) — K = 2d
+        "n_topics": n_topics,
+        "topics_per_peer": 2 if config == "eth2" else 1,
+        "adversary_fraction": 0.2 if config == "sybil" else 0.0,
+        "rounds_per_phase": int(rounds_per_phase),
+        "heartbeat_every": int(heartbeat_every),
+        "pubs_per_round": PUBS_PER_ROUND,
+        "score_weights": {
+            "mesh_message_deliveries_weight": tp.mesh_message_deliveries_weight,
+            "mesh_failure_penalty_weight": tp.mesh_failure_penalty_weight,
+            "invalid_message_deliveries_weight":
+                tp.invalid_message_deliveries_weight,
+            "first_message_deliveries_weight":
+                tp.first_message_deliveries_weight,
+            "time_in_mesh_weight": tp.time_in_mesh_weight,
+            "behaviour_penalty_weight": sp.behaviour_penalty_weight,
+        },
+        # static weight elision is phase-engine-only (per-round engines
+        # never elide — BASELINE.md round-5 addendum)
+        "elides_mesh_message_deliveries": bool(phase and p3_elided),
+        "elides_invalid_message_deliveries": bool(phase and p4_elided),
+        "engine": {
+            "mode": "phase" if phase else "per_round",
+            "gater": config == "sybil",
+            "validation_capacity": 8 if config == "sybil" else 0,
+            "count_events": False,
+            "fanout_slots": 2 if config == "eth2" else 0,
+            "scatter_publish_alloc": bool(phase and n_peers >= SCATTER_ALLOC_MIN_N),
+            # incremental membership planes exist only in the phase
+            # engine (gossipsub_phase.py round-4 addendum 4)
+            "incr_members": bool(phase and n_topics <= INCR_MEMBERS_MAX_TOPICS),
+        },
+    }
+    if seg_rounds is not None:
+        fp["seg_rounds"] = int(seg_rounds)
+    if unroll is not None:
+        fp["unroll"] = int(unroll)
+    try:
+        import jax
+
+        fp["platform"] = jax.default_backend()
+        fp["prng_impl"] = str(jax.config.jax_default_prng_impl)
+        fp["n_devices"] = len(jax.devices())
+    except Exception:  # pragma: no cover — jax not initializable
+        pass
+    return fp
+
+
+def measure_rate(config: str, n_req: int, msg_slots: int, heartbeat_every: int,
+                 rounds_per_phase: int, seg_rounds: int, reps: int = 3,
+                 unroll: int | None = None):
+    """Build + run one bench cell; returns (rounds_per_sec, n_used,
+    unroll_used) or None. Tries n_req, halving down to 10k as the OOM
+    fallback (below 10k the request is run as-is — CPU sweeps use small
+    N deliberately)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..driver import make_scan
+
+    he, r = int(heartbeat_every), int(rounds_per_phase)
+    group = math.lcm(he, r)
+    seg = seg_rounds - seg_rounds % group
+    if seg <= 0:
+        raise ValueError(
+            f"seg_rounds={seg_rounds} < one lcm(heartbeat_every, "
+            f"rounds_per_phase) group ({group})"
+        )
+    sizes, nn = [n_req], n_req // 2
+    while nn >= 10_000:
+        sizes.append(nn)
+        nn //= 2
+    for n in sizes:
+        try:
+            st, step, n_topics, honest = build_bench(
+                n, msg_slots, config=config, heartbeat_every=he,
+                rounds_per_phase=r,
+            )
+            # publish schedule [R, P]
+            rng = np.random.default_rng(0)
+            if honest is not None:
+                po = honest[
+                    rng.integers(0, len(honest), size=(seg, PUBS_PER_ROUND))
+                ].astype(np.int32)
+            else:
+                po = rng.integers(
+                    0, n, size=(seg, PUBS_PER_ROUND)
+                ).astype(np.int32)
+            pt = rng.integers(
+                0, n_topics, size=(seg, PUBS_PER_ROUND)
+            ).astype(np.int32)
+            pv = np.ones((seg, PUBS_PER_ROUND), bool)
+            po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+            # unroll: adjacent iterations let XLA cancel the carry layout
+            # conversions the while-loop form pays per tick (profiled ~35%
+            # of device time); 4 rounds is the per-round knee, and phase
+            # mode gains another ~7-8% from unrolling TWO phases per scan
+            # iteration (round-4/5 measurements in BASELINE.md)
+            u = unroll if unroll is not None else (2 * group if r > 1 else 4)
+            scan = make_scan(
+                step,
+                heartbeat_every=he,
+                rounds_per_phase=r,
+                static_heartbeat=he > 1 or r > 1,
+                unroll=max(1, u // group),
+            )
+
+            st = scan(st, po_j, pt_j, pv_j)  # compile + warmup
+            jax.block_until_ready(st)
+            rates = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                st = scan(st, po_j, pt_j, pv_j)
+                # force a device->host readback inside the timed region:
+                # jax.block_until_ready on the axon remote platform has
+                # been observed to return before execution completes
+                # (async handles report ready), inflating rates ~1000x.
+                # Fetching a scalar that depends on the full step (the
+                # tick counter + a score checksum) is the honest
+                # completion barrier.
+                _ = (int(st.core.tick), float(jnp.sum(st.scores)))
+                dt = time.perf_counter() - t0
+                rates.append(seg / dt)
+            return max(rates), n, u
+        except Exception as e:  # noqa: BLE001 — smaller N on OOM
+            msg = str(e)
+            if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                    or "exceeds" in msg):
+                continue
+            raise
+    return None
+
+
+def metric_name(config: str, n_peers: int, rounds_per_phase: int) -> str:
+    """The metric naming convention rounds 1-5 established (BASELINE.md
+    equivalence rule: phase metrics carry the cadence in the name)."""
+    tag = "" if config == "default" else f"_{config}"
+    if rounds_per_phase > 1:
+        return (
+            f"gossipsub_v1.1_delivery_rounds_per_sec_n{n_peers}{tag}"
+            f"_phase{rounds_per_phase}"
+        )
+    return f"gossipsub_v1.1_heartbeat_ticks_per_sec_n{n_peers}{tag}"
+
+
+def measure_record(config: str, n_peers: int, msg_slots: int,
+                   heartbeat_every: int, rounds_per_phase: int,
+                   seg_rounds: int, reps: int = 3,
+                   unroll: int | None = None):
+    """One sweep cell -> a schema-v2 BenchRecord (or None on total OOM)."""
+    from .artifacts import NORTH_STAR_RATE, BenchRecord
+
+    res = measure_rate(config, n_peers, msg_slots, heartbeat_every,
+                       rounds_per_phase, seg_rounds, reps=reps, unroll=unroll)
+    if res is None:
+        return None
+    value, n_used, u = res
+    r = rounds_per_phase
+    extras = {}
+    if r > 1:
+        extras["heartbeats_per_sec"] = round(value / heartbeat_every, 2)
+    return BenchRecord(
+        metric=metric_name(config, n_used, r),
+        value=round(value, 2),
+        unit="ticks/s" if r == 1 else "delivery-rounds/s",
+        vs_baseline=round(value / NORTH_STAR_RATE, 4),
+        schema=2,
+        fingerprint=workload_fingerprint(
+            config, n_used, msg_slots, heartbeat_every, r,
+            seg_rounds=seg_rounds, unroll=u,
+        ),
+        extras=extras,
+    )
+
+
+@dataclasses.dataclass
+class SweepSpec:
+    """A declarative (config × N × r) grid. ``heartbeat_every`` defaults
+    to r per cell (the phase engine's standard cadence) when None."""
+
+    configs: tuple = ("default",)
+    ns: tuple = (100_000,)
+    rs: tuple = (8,)
+    msg_slots: int = 64
+    seg_rounds: int = 1600
+    reps: int = 3
+    heartbeat_every: int | None = None
+
+    def cells(self):
+        for c in self.configs:
+            for n in self.ns:
+                for r in self.rs:
+                    he = self.heartbeat_every
+                    yield c, int(n), int(r), int(he if he else max(r, 1))
+
+
+def run_sweep(spec: SweepSpec, emit=None) -> list:
+    """Run every cell of the grid; returns the BenchRecords (skipping
+    cells that OOM at every fallback size). ``emit`` is called with each
+    record as it completes (the CLI prints JSON lines — long TPU sweeps
+    keep partial results if the tunnel dies)."""
+    out = []
+    for config, n, r, he in spec.cells():
+        rec = measure_record(config, n, spec.msg_slots, he, r,
+                             spec.seg_rounds, reps=spec.reps)
+        if rec is None:
+            continue
+        out.append(rec)
+        if emit is not None:
+            emit(rec)
+    return out
+
+
+def main(argv=None):
+    import argparse
+
+    from .artifacts import dump_record
+
+    ap = argparse.ArgumentParser(
+        description="declarative (config x N x r) bench sweep; one "
+        "schema-v2 JSON line per cell")
+    ap.add_argument("--config", default="default",
+                    help="comma-separated: default,eth2,sybil")
+    ap.add_argument("--n", default="100000", help="comma-separated peer counts")
+    ap.add_argument("--r", default="8", help="comma-separated rounds-per-phase")
+    ap.add_argument("--msg-slots", type=int, default=64)
+    ap.add_argument("--rounds", type=int, default=1600,
+                    help="segment length (rounds) per timed rep")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM"),
+                    help="jax platform override (e.g. cpu)")
+    ap.add_argument("--prng", default=os.environ.get("BENCH_PRNG", "unsafe_rbg"),
+                    help="jax PRNG impl ('' keeps threefry)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.prng:
+        jax.config.update("jax_default_prng_impl", args.prng)
+
+    spec = SweepSpec(
+        configs=tuple(args.config.split(",")),
+        ns=tuple(int(x) for x in args.n.split(",")),
+        rs=tuple(int(x) for x in args.r.split(",")),
+        msg_slots=args.msg_slots,
+        seg_rounds=args.rounds,
+        reps=args.reps,
+    )
+    run_sweep(spec, emit=lambda rec: print(dump_record(rec), flush=True))
+
+
+if __name__ == "__main__":
+    main()

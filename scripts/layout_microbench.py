@@ -10,15 +10,22 @@ update, pairwise rank, popcount reduce) over a [N,K]-shaped state:
      into free layout assignments),
   C. native [K,N] compute (the full-refactor endpoint).
 
+Prints one human line per variant plus a final schema-v2 JSON line
+(perf.artifacts) so microbench runs are recordable artifacts like the
+bench proper.
+
 Usage: python scripts/layout_microbench.py [N] [ITERS]
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -85,10 +92,12 @@ def main():
     c0 = jnp.asarray(rng.standard_normal((n, k)).astype(np.float32))
     w0 = jnp.asarray(rng.integers(0, 2**32, size=(n, 2), dtype=np.uint64).astype(np.uint32))
 
-    for name, fn, st in [
-        ("A row-major [N,K] carry", scan_a, (s0, c0, w0)),
-        ("B [K,N] storage + transposed body", scan_b, (s0.T, c0.T, w0.T)),
-        ("C native [K,N] compute", scan_c, (s0.T, c0.T, w0.T)),
+    results = {}
+    for key, name, fn, st in [
+        ("row_major_nk", "A row-major [N,K] carry", scan_a, (s0, c0, w0)),
+        ("transposed_body", "B [K,N] storage + transposed body", scan_b,
+         (s0.T, c0.T, w0.T)),
+        ("native_kn", "C native [K,N] compute", scan_c, (s0.T, c0.T, w0.T)),
     ]:
         run = jax.jit(fn)
         out = run(st)
@@ -97,7 +106,26 @@ def main():
         out = run(st)
         _ = float(jnp.sum(out[0]))
         dt = (time.perf_counter() - t0) / iters
+        results[key] = round(dt * 1e6, 1)
         print(f"{name:36s} {dt * 1e6:9.1f} us/iter")
+
+    # recordable artifact line (headline = the production convention A)
+    import json
+
+    from go_libp2p_pubsub_tpu.perf.artifacts import SCHEMA_VERSION
+
+    print(json.dumps({
+        "schema": SCHEMA_VERSION,
+        "metric": f"layout_microbench_us_per_iter_n{n}",
+        "value": results["row_major_nk"],
+        "unit": "us/iter",
+        "vs_baseline": 0.0,  # not a north-star metric
+        "variants": results,
+        "fingerprint": {
+            "n_peers": n, "k": k, "iters": iters,
+            "platform": jax.default_backend(),
+        },
+    }))
 
 
 if __name__ == "__main__":

@@ -1,122 +1,27 @@
 """Device-op profile of the scanned GossipSub step (bench configuration).
 
-Captures a jax.profiler trace of one scanned segment and prints the top HLO
-ops by self time — the attribution the ablation timer can't give on the
-tunneled platform (per-call dispatch RTT swamps isolated-phase timings).
+Thin CLI over go_libp2p_pubsub_tpu/perf/profile.py — the library-ified
+profiler that captures a jax.profiler trace of one scanned segment and
+prints the top HLO ops by self time (the attribution the ablation timer
+can't give on the tunneled platform, where per-call dispatch RTT swamps
+isolated-phase timings).
 
-Builds the EXACT bench workload (bench.build_bench) so op attribution maps
-1:1 onto what BENCH_r*.json measures; BENCH_CONFIG selects the variant.
+Builds the EXACT bench workload (perf.sweep.build_bench) so op
+attribution maps 1:1 onto what BENCH_r*.json measures; BENCH_CONFIG
+selects the variant, BENCH_PHASE_R the cadence (the bench default is
+r=8; BENCH_PHASE_R=1 profiles the per-round step).
 
 Usage: python scripts/profile_trace.py [N] [ROUNDS]
 """
 
 from __future__ import annotations
 
-import glob
 import os
 import sys
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def main():
-    import jax
-    import jax.numpy as jnp
-
-    sys.path.insert(0, ".")
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from bench import build_bench
-
-    config = os.environ.get("BENCH_CONFIG", "default")
-    # BENCH_PHASE_R > 1 profiles the phase engine at that cadence (the
-    # bench default is r=8); BENCH_PHASE_R=1 profiles the per-round step
-    r = int(os.environ.get("BENCH_PHASE_R", 1))
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
-    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 50
-    rounds = max(rounds - rounds % max(r, 1), r)  # never truncate to an empty run
-    st, step, n_topics, honest = build_bench(
-        n, 64, config=config, heartbeat_every=r if r > 1 else 1,
-        rounds_per_phase=r,
-    )
-
-    rng = np.random.default_rng(0)
-    if honest is not None:
-        po = honest[rng.integers(0, len(honest), size=(rounds, 4))].astype(np.int32)
-    else:
-        po = rng.integers(0, n, size=(rounds, 4)).astype(np.int32)
-    po = jnp.asarray(po)
-    pt = jnp.asarray(rng.integers(0, n_topics, size=(rounds, 4)).astype(np.int32))
-    pv = jnp.asarray(np.ones((rounds, 4), bool))
-
-    if r > 1:
-        from go_libp2p_pubsub_tpu.driver import make_scan
-
-        unroll = int(os.environ.get("BENCH_UNROLL", 2 * r))
-        scan = make_scan(step, heartbeat_every=r, rounds_per_phase=r,
-                         static_heartbeat=True, unroll=max(1, unroll // r))
-
-        def run_seg(s):
-            return scan(s, po, pt, pv)
-        run = jax.jit(run_seg, donate_argnums=0)
-    else:
-        def run_seg(s):
-            def body(carry, xs):
-                return step(carry, *xs), None
-            s, _ = jax.lax.scan(body, s, (po, pt, pv))
-            return s
-
-        run = jax.jit(run_seg, donate_argnums=0)
-    st = run(st)
-    jax.block_until_ready(st)
-
-    logdir = "/tmp/pubsub_prof"
-    os.system(f"rm -rf {logdir}")
-    with jax.profiler.trace(logdir):
-        st = run(st)
-        jax.block_until_ready(st)
-
-    # ---- summarize: top ops by self time -------------------------------
-    # (xprof's converter works where tensorboard_plugin_profile 2.13 fails)
-    paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
-    print("xplane:", paths)
-    from xprof.convert import raw_to_tool_data
-
-    data, _ = raw_to_tool_data.xspace_to_tool_data(paths, "hlo_stats", {})
-    import json
-
-    obj = data if isinstance(data, dict) else json.loads(data)
-    out_path = "/tmp/pubsub_prof/hlo_stats.json"
-    with open(out_path, "w") as f:
-        json.dump(obj, f, default=lambda o: o.decode() if isinstance(o, bytes) else str(o))
-    print("wrote", out_path)
-    rows = [r["c"] if isinstance(r, dict) else r for r in obj["rows"]]
-
-    def val(r, i):
-        v = r[i]
-        return v.get("v") if isinstance(v, dict) else v
-
-    items, total = [], 0.0
-    from collections import defaultdict
-
-    bycat = defaultdict(float)
-    for r in rows:
-        selft = float(val(r, 9) or 0)
-        total += selft
-        bycat[val(r, 2)] += selft
-        items.append((selft, val(r, 3), (val(r, 4) or ""), (val(r, 25) or "")))
-    items.sort(reverse=True)
-    print(f"total device self time: {total/1e3:.1f} ms; per round: {total/rounds:.0f} us")
-    print("\nby category:")
-    for k, v in sorted(bycat.items(), key=lambda x: -x[1]):
-        print(f"  {v/rounds:8.1f} us/rd {100*v/total:5.1f}%  {k}")
-    print("\ntop 30 ops:")
-    for selft, name, text, src in items[:30]:
-        import re
-
-        s = re.sub(r"<[^>]+>", "", src)
-        print(f"  {selft/rounds:7.1f} us/rd {name:<30} {s.strip()[:80]}")
-        print(f"      {text[:140]}")
-
+from go_libp2p_pubsub_tpu.perf.profile import main  # noqa: E402
 
 if __name__ == "__main__":
     main()

@@ -55,11 +55,59 @@ def percentile(sorted_vals, q):
     return sorted_vals[i]
 
 
+def _gcd_of_gaps(sorted_ts: list[int]) -> int:
+    import math
+
+    g = 0
+    for a, b in zip(sorted_ts, sorted_ts[1:]):
+        g = math.gcd(g, b - a)
+    return g
+
+
+def _cadence_note(data_ts: set, control_ts: set) -> dict | None:
+    """Detect a phase-cadence trace (rounds_per_phase > 1) from timestamp
+    granularity: data events (PUBLISH/DELIVER) carry per-sub-round
+    resolution while control events (GRAFT/PRUNE) are emitted at phase
+    boundaries — which sit at tick multiples of the phase length — so
+    the gcd of the ABSOLUTE control timestamps is a multiple of the data
+    tick. Absolute alignment (not gap stride) is what makes the
+    heuristic robust to sparse control activity: an r=1 trace whose only
+    two GRAFT batches land 4 ticks apart at ticks 5 and 9 has gcd 1 tick
+    (no false positive), while real phase traces graft at boundary ticks
+    {0, r, 2r, ...} however few of them fire. When detected, surface the
+    r>1 accounting caveats that otherwise live only in trace/drain.py
+    docstrings (ADVICE round 5)."""
+    import math
+
+    base = _gcd_of_gaps(sorted(data_ts))
+    ctrl = 0
+    for t in control_ts:
+        ctrl = math.gcd(ctrl, t)
+    if len(control_ts) < 2 or not base or not ctrl or ctrl <= base or ctrl % base:
+        return None
+    return {
+        "tick_ns": base,
+        "control_stride_ns": ctrl,
+        "rounds_per_phase_estimate": ctrl // base,
+        "note": (
+            "phase-cadence trace (control events land at phase "
+            "boundaries): GRAFT/PRUNE event streams can undercount the "
+            "device mutation counters (graft+prune cancellation within "
+            "one phase); the synthesized DROP_RPC queue model excludes "
+            "duplicate arrivals; a late duplicate of a slot recycled "
+            "within its death phase resolves against the end-of-phase "
+            "message id. See trace/drain.py \"Phase cadence\"."
+        ),
+    }
+
+
 def summarize(events) -> dict:
     counts = Counter()
     publish_ts: dict[bytes, int] = {}
     delays: list[int] = []
     peers = set()
+    data_ts: set[int] = set()
+    control_ts: set[int] = set()
 
     for ev in events:
         tname = trace_pb2.TraceEvent.Type.Name(ev.type)
@@ -67,15 +115,21 @@ def summarize(events) -> dict:
         peers.add(bytes(ev.peerID))
         if ev.type == trace_pb2.TraceEvent.PUBLISH_MESSAGE:
             publish_ts[bytes(ev.publishMessage.messageID)] = ev.timestamp
+            data_ts.add(ev.timestamp)
         elif ev.type == trace_pb2.TraceEvent.DELIVER_MESSAGE:
             t0 = publish_ts.get(bytes(ev.deliverMessage.messageID))
             if t0 is not None:
                 delays.append(ev.timestamp - t0)
+            data_ts.add(ev.timestamp)
+        elif ev.type in (trace_pb2.TraceEvent.GRAFT, trace_pb2.TraceEvent.PRUNE):
+            control_ts.add(ev.timestamp)
 
     delays.sort()
     pub = counts.get("PUBLISH_MESSAGE", 0)
     dlv = counts.get("DELIVER_MESSAGE", 0)
+    cadence = _cadence_note(data_ts, control_ts)
     return {
+        **({"cadence": cadence} if cadence else {}),
         "events": sum(counts.values()),
         "peers": len(peers),
         "counts": dict(sorted(counts.items())),
@@ -119,6 +173,12 @@ def main():
         f"propagation delay (ms): p50={ms(d['p50'])} p90={ms(d['p90'])} "
         f"p99={ms(d['p99'])} max={ms(d['max'])} (n={d['samples']})"
     )
+    if "cadence" in stats:
+        c = stats["cadence"]
+        print(
+            f"cadence: phase trace, ~{c['rounds_per_phase_estimate']} "
+            f"rounds/phase — {c['note']}"
+        )
 
 
 if __name__ == "__main__":

@@ -23,15 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 # jit compiles of near-identical step functions across test files; cached
 # executables cut a warm full-tier run roughly in half. Keyed by HLO +
 # platform + flags, so correctness is jax's problem, not ours. Repo-local
-# and gitignored; JAX_NO_TEST_CACHE=1 opts out (e.g. when bisecting a
-# suspected stale-cache issue).
-if os.environ.get("JAX_NO_TEST_CACHE", "") != "1":
-    _cache_dir = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ".jax_cache",
-    )
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# and gitignored. The version gate + JAX_NO_TEST_CACHE opt-out live in
+# go_libp2p_pubsub_tpu/compile_cache.py (jax 0.4.x segfaults LOADING
+# cached executables; perf/regress.py applies the same policy).
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _root)
+
+from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(os.path.join(_root, ".jax_cache"))

@@ -1,0 +1,295 @@
+"""perf/ subsystem: schema-v2 artifacts, the projection engine, the
+profiler's parsing layers, and the workload fingerprint.
+
+The projection test is the load-bearing one (ISSUE round 6): the v5e-8
+feasibility number that BASELINE.md rounds 3-5 computed by hand must
+reproduce from code + committed artifacts, so future rounds change it by
+changing inputs, not prose.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from go_libp2p_pubsub_tpu.perf import artifacts, profile, projection, sweep
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# artifacts: schema v2 + legacy readers
+
+
+def test_v2_readers_parse_all_committed_bench_artifacts():
+    """Every in-tree BENCH_r0*.json (v1 driver wrappers, rounds 1-5)
+    must normalize through the v2 reader — the artifact trajectory is
+    the regression gate's ground truth."""
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    assert len(paths) >= 5, paths
+    recs = [artifacts.load_bench_artifact(p) for p in paths]
+    for rec in recs:
+        assert rec.value > 0
+        assert rec.metric.startswith("gossipsub_v1.1_")
+        assert rec.schema in (1, 2)
+        assert rec.n_peers == 100_000
+        assert rec.config == "default"
+    # the metric-name fallbacks recover cadence for v1 lines
+    assert [r.rounds_per_phase for r in recs[:5]] == [1, 1, 1, 8, 8]
+    # trajectory ordering by driver round
+    assert [r.round_index for r in recs[:5]] == [1, 2, 3, 4, 5]
+
+
+def test_v2_round_trip_is_lossless():
+    fp = sweep.workload_fingerprint("default", 100_000, 64, 8, 8,
+                                    seg_rounds=1600, unroll=16)
+    rec = artifacts.BenchRecord(
+        metric="gossipsub_v1.1_delivery_rounds_per_sec_n100000_phase8",
+        value=1835.84, unit="delivery-rounds/s", vs_baseline=0.1836,
+        schema=2, fingerprint=fp,
+        extras={"heartbeats_per_sec": 229.48, "continuity_r1_ticks_per_sec": 403.89},
+    )
+    back = artifacts.record_from_line(json.loads(artifacts.dump_record(rec)))
+    assert back == rec
+
+
+def test_wrapper_with_unparsed_tail_recovers_line(tmp_path):
+    """Driver wrappers whose parse failed driver-side still carry the
+    line in `tail`; the reader recovers it."""
+    line = {"schema": 2, "metric": "gossipsub_v1.1_heartbeat_ticks_per_sec_n100000",
+            "value": 400.0, "unit": "ticks/s", "vs_baseline": 0.04}
+    p = tmp_path / "BENCH_rXX.json"
+    p.write_text(json.dumps({
+        "n": 9, "cmd": "python bench.py", "rc": 0,
+        "tail": "WARNING: something\n" + json.dumps(line) + "\n",
+    }))
+    rec = artifacts.load_bench_artifact(str(p))
+    assert rec.value == 400.0 and rec.round_index == 9 and rec.schema == 2
+
+
+def test_multichip_reader_and_bad_artifact(tmp_path):
+    m = artifacts.load_multichip_artifact(os.path.join(ROOT, "MULTICHIP_r05.json"))
+    assert m["ok"] is True and m["rc"] == 0
+    bad = tmp_path / "x.json"
+    bad.write_text(json.dumps({"foo": 1}))
+    with pytest.raises(ValueError):
+        artifacts.load_multichip_artifact(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# fingerprint: the self-description must match the workload decisions
+
+
+def test_fingerprint_records_elision_flags():
+    # honest-net phase configs elide BOTH attribution planes
+    fp = sweep.workload_fingerprint("default", 100_000, 64, 8, 8)
+    assert fp["elides_invalid_message_deliveries"] is True
+    assert fp["elides_mesh_message_deliveries"] is True
+    assert fp["score_weights"]["invalid_message_deliveries_weight"] == 0.0
+    # sybil keeps full weights — its adversary vector is what P4 catches
+    fp = sweep.workload_fingerprint("sybil", 50_000, 64, 16, 16)
+    assert fp["elides_invalid_message_deliveries"] is False
+    assert fp["engine"]["gater"] is True
+    assert fp["adversary_fraction"] == 0.2
+    # elision is phase-engine-only: the r=1 continuity metric never elides
+    fp = sweep.workload_fingerprint("default", 100_000, 64, 1, 1)
+    assert fp["elides_invalid_message_deliveries"] is False
+    assert fp["engine"]["mode"] == "per_round"
+
+
+def test_fingerprint_records_engine_gating():
+    # the scatter publish-allocation gate (state.py: phase + N >= 20k)
+    assert sweep.workload_fingerprint("default", 100_000, 64, 8, 8)[
+        "engine"]["scatter_publish_alloc"] is True
+    assert sweep.workload_fingerprint("default", 12_500, 64, 16, 16)[
+        "engine"]["scatter_publish_alloc"] is False
+    # incremental membership planes: narrow universes, phase engine only
+    assert sweep.workload_fingerprint("default", 100_000, 64, 8, 8)[
+        "engine"]["incr_members"] is True
+    assert sweep.workload_fingerprint("eth2", 100_000, 64, 8, 8)[
+        "engine"]["incr_members"] is False
+    assert sweep.workload_fingerprint("default", 100_000, 64, 1, 1)[
+        "engine"]["incr_members"] is False
+
+
+# ---------------------------------------------------------------------------
+# projection engine
+
+
+def test_projection_reproduces_round5_number():
+    """The committed round-5 projection — "~3,700-5,200 rounds/s,
+    central ~4,500 ≈ 45% of the 10k north star" (BASELINE.md round-5
+    addendum) — must come out of the code given the round-5 artifacts:
+    BENCH_r05 (the session the 12.5k r=16 shard rate of 5,823 was
+    measured in) and MULTICHIP_r05 (the collective audit whose permute
+    counts the ICI term is built from)."""
+    proj = projection.project_from_artifacts(
+        os.path.join(ROOT, "BENCH_r05.json"),
+        os.path.join(ROOT, "MULTICHIP_r05.json"),
+    )
+    lo, central, hi = proj.rounds_per_sec
+    assert 0.44 <= central / 10_000.0 <= 0.455, proj.summary()
+    assert 3_600 <= lo <= 3_800, proj.summary()
+    assert 5_100 <= hi <= 5_300, proj.summary()
+    # the ICI band is the 0.02-0.10 ms/round the BASELINE projections used
+    assert proj.ici_ms[0] == pytest.approx(0.02)
+    assert proj.ici_ms[2] == pytest.approx(0.10)
+
+
+def test_projection_refuses_failed_multichip(tmp_path):
+    """A projection built on a failed collective audit would be fiction;
+    the round-1 MULTICHIP artifact (libtpu mismatch, ok=false) must be
+    rejected."""
+    with pytest.raises(ValueError, match="not ok"):
+        projection.project_from_artifacts(
+            os.path.join(ROOT, "BENCH_r01.json"),
+            os.path.join(ROOT, "MULTICHIP_r01.json"),
+            shard_rate=5_823.0,
+        )
+
+
+def test_permute_model_matches_collective_audit():
+    """The ICI term's permute counts are the ones tests/test_collectives.py
+    pins on the virtual mesh: 16·(r+4) per phase."""
+    assert projection.permutes_per_round(8) == pytest.approx(16 * 12 / 8)  # 24
+    assert projection.permutes_per_round(16) == pytest.approx(20.0)
+    # at r=16 the launch-latency band gives the canonical 0.02-0.10 ms
+    assert projection.ici_serialized_ms(16, 1.0) == pytest.approx(0.02)
+    assert projection.ici_serialized_ms(16, 5.0) == pytest.approx(0.10)
+
+
+def test_projection_input_validation():
+    with pytest.raises(ValueError):
+        projection.project(0.0, 16)
+    with pytest.raises(ValueError):
+        projection.permutes_per_round(0)
+    # the committed shard table is r=16: a conflicting explicit cadence
+    # without its own shard rate must refuse, not silently project r=16
+    with pytest.raises(ValueError, match="rounds_per_phase=16"):
+        projection.project_from_artifacts(
+            os.path.join(ROOT, "BENCH_r05.json"),
+            os.path.join(ROOT, "MULTICHIP_r05.json"),
+            rounds_per_phase=8,
+        )
+
+
+# ---------------------------------------------------------------------------
+# profiler parsing layers (pure — no trace capture)
+
+
+def test_self_times_nesting():
+    # a[0,100) contains b[10,30) (contains d[12,17)) and c[40,50)
+    got = dict(profile._self_times(
+        [(0, 100, "a"), (10, 20, "b"), (40, 10, "c"), (12, 5, "d")]))
+    assert got == {"a": 70, "b": 15, "c": 10, "d": 5}
+
+
+def test_parse_hlo_stats_obj():
+    """The converter-backend normalizer must aggregate the hlo_stats
+    column layout scripts/profile_trace.py consumed (cat=2, name=3,
+    text=4, self-us=9, src=25)."""
+    def row(cat, name, text, selft, src):
+        r = [None] * 26
+        r[2], r[3], r[4], r[9], r[25] = cat, name, text, selft, src
+        return {"c": r}
+
+    obj = {"rows": [
+        row("fusion", "fusion.1", "f32[8] fusion(...)", 100.0, "a.py:1"),
+        row("fusion", "fusion.1", "f32[8] fusion(...)", 50.0, "a.py:1"),
+        row("copy", "copy.2", "copy(...)", 30.0, "<a href='x'>b.py:2</a>"),
+    ]}
+    table = profile.parse_hlo_stats_obj(obj, rounds=10)
+    assert table.rows[0].name == "fusion.1"
+    assert table.rows[0].self_us_per_round == pytest.approx(15.0)
+    assert table.rows[0].occurrences == 2
+    assert table.rows[1].source == "b.py:2"  # html stripped
+    assert table.total_us_per_round == pytest.approx(18.0)
+    assert table.by_category == {"fusion": 15.0, "copy": 3.0}
+
+
+def test_parse_xspace_bytes_synthetic():
+    """The direct-proto backend must attribute self times from a
+    synthetic XSpace shaped like an XLA:CPU executor trace."""
+    xplane_pb2 = profile._import_xplane_pb2()
+    if xplane_pb2 is None:
+        pytest.skip("no xplane proto module available")
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add(name="/host:CPU")
+    em_call = plane.event_metadata[1]
+    em_call.id, em_call.name = 1, "call"
+    em_op = plane.event_metadata[2]
+    em_op.id, em_op.name = 2, "fusion.7"
+    sm = plane.stat_metadata[1]
+    sm.id, sm.name = 1, "hlo_op"
+    line = plane.lines.add(name="tf_XLATfrtCpuClient/1")
+    ev = line.events.add(metadata_id=1, offset_ps=0, duration_ps=1_000_000)
+    ev.stats.add(metadata_id=1, str_value="call")
+    ev2 = line.events.add(metadata_id=2, offset_ps=100, duration_ps=600_000)
+    ev2.stats.add(metadata_id=1, str_value="fusion.7")
+    # a python-bookkeeping line with no hlo stats must be ignored
+    pl = plane.lines.add(name="python")
+    pl.events.add(metadata_id=1, offset_ps=0, duration_ps=5_000_000)
+
+    table = profile.parse_xspace_bytes([xs.SerializeToString()], rounds=2)
+    got = {r.name: r for r in table.rows}
+    assert set(got) == {"call", "fusion.7"}
+    assert got["fusion.7"].self_us_per_round == pytest.approx(0.3)
+    assert got["call"].self_us_per_round == pytest.approx(0.2)
+    assert got["fusion.7"].category == "fusion"
+    assert "fusion.7" in profile.format_table(table)
+
+
+@pytest.mark.slow
+def test_profile_workload_end_to_end(tmp_path):
+    """Capture + summarize a real (tiny) phase-engine segment on CPU:
+    the 12.5k-shard table in docs/PERF.md is produced by this exact
+    path at (12500, r=16)."""
+    table = profile.profile_workload(
+        256, rounds=8, config="default", rounds_per_phase=2,
+        logdir=str(tmp_path / "prof"))
+    assert table.rows, "no ops attributed"
+    assert table.total_us_per_round > 0
+    assert table.fingerprint["n_peers"] == 256
+    assert table.fingerprint["rounds_per_phase"] == 2
+    txt = profile.format_table(table, top=5)
+    assert "by category" in txt
+
+
+# ---------------------------------------------------------------------------
+# sweep + regress plumbing (cheap paths only; the mini-bench itself is
+# exercised by `make perf-smoke`)
+
+
+def test_sweep_spec_cells():
+    spec = sweep.SweepSpec(configs=("default", "eth2"), ns=(12_500, 25_000),
+                           rs=(16,))
+    cells = list(spec.cells())
+    assert len(cells) == 4
+    assert cells[0] == ("default", 12_500, 16, 16)  # he defaults to r
+
+
+def test_metric_name_convention():
+    assert sweep.metric_name("default", 100_000, 8) == \
+        "gossipsub_v1.1_delivery_rounds_per_sec_n100000_phase8"
+    assert sweep.metric_name("eth2", 12_500, 16) == \
+        "gossipsub_v1.1_delivery_rounds_per_sec_n12500_eth2_phase16"
+    assert sweep.metric_name("default", 100_000, 1) == \
+        "gossipsub_v1.1_heartbeat_ticks_per_sec_n100000"
+
+
+def test_regress_trajectory_and_projection_checks():
+    from go_libp2p_pubsub_tpu.perf import regress
+
+    assert regress.check_trajectory(ROOT) == []
+    assert regress.check_projection(ROOT) == []
+
+
+def test_regress_catches_corrupt_artifact(tmp_path):
+    from go_libp2p_pubsub_tpu.perf import regress
+
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    errs = regress.check_trajectory(str(tmp_path))
+    assert any("BENCH_r01" in e for e in errs)

@@ -140,6 +140,7 @@ def test_phase_r1_bitexact_rich_v11(score_counts):
     assert_states_equal(sa, sb, "r1/")
 
 
+@pytest.mark.slow
 def test_phase_r1_bitexact_static_heartbeat_he2():
     net, cfg, sp, st = build(seed=5, he=2)
     step = make_gossipsub_step(cfg, net, score_params=sp, static_heartbeat=True)
@@ -151,6 +152,7 @@ def test_phase_r1_bitexact_static_heartbeat_he2():
     assert_states_equal(sa, sb, "r1-he2/")
 
 
+@pytest.mark.slow
 def test_phase_r1_bitexact_gater_throttle_queuecap_adversary():
     gp = PeerGaterParams()
     rng = np.random.default_rng(7)
@@ -327,6 +329,7 @@ def test_phase_trace_exact_dup_plane_reconciles():
     assert prev_dup > 0
 
 
+@pytest.mark.slow
 def test_phase_count_vs_plane_score_paths_equal_no_recycle():
     """r=4, no slot recycling: the count-fold and plane score paths are
     bit-equal (integer popcounts are exact in f32; OR preserves the
@@ -343,6 +346,7 @@ def test_phase_count_vs_plane_score_paths_equal_no_recycle():
     assert_states_equal(sa, sb, "count-vs-plane/")
 
 
+@pytest.mark.slow
 def test_phase_count_path_retains_recycled_credit():
     """Under within-phase recycling the count path retains the score
     credit the plane path sheds (its stated reason to exist): total P2
@@ -414,6 +418,7 @@ def test_phase_static_weight_elision_scores_exact():
     assert mb.sum() < ma.sum()
 
 
+@pytest.mark.slow
 def test_phase_no_elision_when_p3b_live():
     """w3=0 but the sticky mesh-failure penalty live (default w3b=-1,
     thr3>0): mmd feeds on_prune's deficit, so the mesh-credit plane must
@@ -537,3 +542,41 @@ def test_phase_api_network_snapshots_exact_counters():
     mmd_b = float(np.asarray(nb.state.score.mmd).sum())
     if mmd_a > 0:
         assert mmd_b > 0, "phase build elided the mmd plane"
+
+
+def test_admission_invariant_warns_direct_drivers():
+    """The phase engine's publish-capacity invariant (ADVICE round 5,
+    item 2): rounds_per_phase * pub_width > msg_slots // 2 means a
+    direct driver can recycle slots WITHIN a phase, silently wiping
+    in-flight receipts. The built step must warn at trace time; API
+    builds (which enforce the flat admission cap) suppress it via
+    admission_capped=True."""
+    import warnings
+
+    n = 16
+    topo = graph.random_connect(n, 4, seed=3)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds())
+    r = 4
+    st = GossipSubState.init(net, 8, cfg, seed=3)  # M=8: cap is 4 < r*P=16
+    po = jnp.full((r, P), -1, jnp.int32)
+    pt = jnp.zeros((r, P), jnp.int32)
+    pv = jnp.zeros((r, P), bool)
+
+    pstep = make_gossipsub_phase_step(cfg, net, r)
+    with pytest.warns(UserWarning, match="phase publish capacity"):
+        pstep(st, po, pt, pv, do_heartbeat=True)
+
+    # the API-certified build stays silent on the same shapes
+    st2 = GossipSubState.init(net, 8, cfg, seed=3)
+    pcapped = make_gossipsub_phase_step(cfg, net, r, admission_capped=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        pcapped(st2, po, pt, pv, do_heartbeat=True)
+
+    # within-capacity shapes never warn
+    st3 = GossipSubState.init(net, 64, cfg, seed=3)  # cap 32 >= 16
+    pok = make_gossipsub_phase_step(cfg, net, r)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        pok(st3, po, pt, pv, do_heartbeat=True)

@@ -354,6 +354,9 @@ def test_tracestat_cli(tmp_path):
         assert stats["delivered"] >= 3 * 11  # every other node got each one
         assert stats["delay_ns"]["p50"] is not None
         assert stats["counts"]["GRAFT"] > 0
+        # per-round cadence: control and data share the tick stride, so
+        # no phase-cadence caveat is emitted
+        assert "cadence" not in stats
     # both formats describe the same run
     assert results[jpath] == results[ppath]
 
@@ -403,3 +406,8 @@ def test_tracestat_cli_phase_cadence(tmp_path):
         stats["delay_ns"][q] % phase_ns != 0
         for q in ("p50", "p90", "p99", "max")
     ), stats["delay_ns"]
+    # the r>1 accounting caveats surface in the output itself (ADVICE
+    # round 5 item 3), detected from the control-timestamp stride
+    assert "cadence" in stats, stats.keys()
+    assert stats["cadence"]["rounds_per_phase_estimate"] % 4 == 0
+    assert "undercount" in stats["cadence"]["note"]

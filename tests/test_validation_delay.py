@@ -133,11 +133,14 @@ def test_api_network_with_validation_delay():
     assert late > 0  # some deliveries arrived only after validation drain
 
 
-def test_api_rejects_delay_on_other_routers():
-    import pytest
-
-    with pytest.raises(api.APIError):
-        api.Network(router="floodsub", validation_delay_rounds=1)
+def test_api_accepts_delay_on_all_routers():
+    """Round 6 lifted the gossipsub-only restriction: the validation
+    pipeline sits below the router in the reference (validation.go:65-83),
+    so floodsub/randomsub accept the knob too. Behavior coverage lives in
+    tests/test_pipeline_all_routers.py."""
+    for router in ("floodsub", "randomsub"):
+        net = api.Network(router=router, validation_delay_rounds=1)
+        assert net.validation_delay_rounds == 1
 
 
 def test_p3_mesh_credit_survives_pipeline():

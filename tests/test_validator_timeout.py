@@ -1,0 +1,122 @@
+"""Validator timeout (WithValidatorTimeout, validation.go:522-529).
+
+An async validator whose verdict cannot land within the timeout has its
+context expire: the message resolves to IGNORE — dropped without the P4
+sender penalty, exactly like an explicit ValidationIgnore. The knob
+composes with per-topic validation delays: a topic whose effective
+pipeline delay exceeds the timeout never produces an Accept; faster
+topics are untouched.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from go_libp2p_pubsub_tpu.models.gossipsub import GossipSubConfig
+
+try:  # the API layer needs the crypto dep; config-layer tests don't
+    from go_libp2p_pubsub_tpu import api
+except ModuleNotFoundError:  # pragma: no cover — crippled sandbox images
+    api = None
+
+needs_api = pytest.mark.skipif(api is None, reason="api needs cryptography")
+
+
+# ---------------------------------------------------------------------------
+# config layer: per-topic composition
+
+
+def test_config_composes_with_per_topic_delays():
+    cfg = GossipSubConfig.build(
+        validation_delay_topic=(1, 3), validator_timeout_rounds=2)
+    assert not cfg.validation_timed_out(0)  # delay 1 <= timeout 2
+    assert cfg.validation_timed_out(1)      # delay 3 > timeout 2
+
+
+def test_config_uniform_delay_and_disabled():
+    cfg = GossipSubConfig.build(
+        validation_delay_rounds=3, validator_timeout_rounds=2)
+    assert cfg.validation_timed_out(0)
+    # timeout 0 = disabled, whatever the delay
+    cfg = GossipSubConfig.build(
+        validation_delay_rounds=9, validator_timeout_rounds=0)
+    assert not cfg.validation_timed_out(0)
+    with pytest.raises(ValueError):
+        GossipSubConfig.build(validator_timeout_rounds=-1)
+
+
+# ---------------------------------------------------------------------------
+# API layer: end-to-end ignore semantics
+
+
+def _net(**kw):
+    net = api.Network(**kw)
+    nodes = net.add_nodes(6)
+    net.connect_all()
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    return net, nodes, subs
+
+
+@needs_api
+def test_timed_out_async_validator_ignores():
+    """delay 3 > timeout 2: the async verdict expires. Local publishes
+    surface the ignore as ValidationError (the reference returns the
+    validation error to Publish); the validator itself still ran."""
+    net, nodes, subs = _net(validation_delay_rounds=3,
+                            validator_timeout_rounds=2)
+    calls = []
+    nodes[0].register_topic_validator(
+        "t", lambda pid, msg: calls.append(pid) or True)
+    net.start()
+    with pytest.raises(api.ValidationError, match="timed out"):
+        nodes[1].topics["t"].publish(b"never lands")
+    assert calls, "the validator goroutine still runs; only its verdict expires"
+    net.run(12)
+    assert all(s.next() is None for s in subs)
+
+
+@pytest.mark.slow
+@needs_api
+def test_fast_pipeline_unaffected_by_timeout():
+    """delay 2 <= timeout 2: verdicts land in time; deliveries complete
+    (late, per the pipeline) exactly as without the knob."""
+    net, nodes, subs = _net(validation_delay_rounds=2,
+                            validator_timeout_rounds=2)
+    nodes[0].register_topic_validator("t", lambda pid, msg: True)
+    net.start()
+    nodes[1].topics["t"].publish(b"lands")
+    net.run(12)
+    # every node delivers: 5 remote + the publisher's local copy
+    got = sum(1 for s in subs if s.next() is not None)
+    assert got == len(nodes)
+
+
+@needs_api
+def test_inline_validators_never_time_out():
+    """WithValidatorTimeout bounds ASYNC validators only — inline ones
+    run synchronously on the caller (validation.go:305-316)."""
+    net, nodes, subs = _net(validation_delay_rounds=3,
+                            validator_timeout_rounds=1)
+    nodes[0].register_topic_validator("t", lambda pid, msg: True, inline=True)
+    net.start()
+    nodes[1].topics["t"].publish(b"inline ok")
+    net.run(14)
+    # every node delivers (incl. the publisher's local copy)
+    assert sum(1 for s in subs if s.next() is not None) == len(nodes)
+
+
+@needs_api
+def test_timeout_applies_below_router_floodsub():
+    """The validation pipeline sits below the router; the timeout knob
+    rides with it on floodsub too (uniform delay at the API layer)."""
+    net = api.Network(router="floodsub", validation_delay_rounds=2,
+                      validator_timeout_rounds=1)
+    nodes = net.add_nodes(4)
+    net.connect_all()
+    subs = [nd.join("t").subscribe() for nd in nodes]
+    nodes[0].register_topic_validator("t", lambda pid, msg: True)
+    net.start()
+    with pytest.raises(api.ValidationError, match="timed out"):
+        nodes[1].topics["t"].publish(b"x")
+    net.run(8)
+    assert all(s.next() is None for s in subs)
