@@ -28,7 +28,8 @@ def flood_edge_mask(net: Net, msgs) -> jax.Array:
     return jnp.broadcast_to(sub_words[:, None, :], (net.n_peers, net.max_degree, sub_words.shape[-1]))
 
 
-@functools.partial(jax.jit, donate_argnums=1, static_argnames=("queue_cap",))
+@functools.partial(jax.jit, donate_argnums=1,
+                   static_argnames=("queue_cap", "stacked"))
 def floodsub_step(
     net: Net,
     state: SimState,
@@ -37,6 +38,8 @@ def floodsub_step(
     pub_valid: jax.Array,   # [P] bool
     queue_cap: int = 0,     # per-edge outbound budget (comm.go:139-170;
                             # floodsub's own drop is floodsub.go:91-98)
+    stacked: bool = True,   # stacked recycled-slot clears (round-7;
+                            # False = legacy per-plane kernels for A/B)
 ) -> SimState:
     """One synchronous round: deliver in-flight messages one hop, then
     intern this round's publishes (they start propagating next round).
@@ -51,7 +54,8 @@ def floodsub_step(
                                queue_cap=queue_cap)
 
     msgs, dlv, _slots, is_pub, _keep, _pub_words = allocate_publishes(
-        state.msgs, dlv, state.tick, pub_origin, pub_topic, pub_valid
+        state.msgs, dlv, state.tick, pub_origin, pub_topic, pub_valid,
+        stacked_clears=stacked,
     )
     events = accumulate_round_events(state.events, info, jnp.sum(is_pub.astype(jnp.int32)))
 

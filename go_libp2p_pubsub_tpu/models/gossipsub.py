@@ -155,6 +155,16 @@ class GossipSubConfig:
     # in the reference (WithEventTracer); False skips the event popcount
     # reductions — per-message delivery state stays exact
     count_events: bool = True
+    # coalesced stacked wire exchange (phase engine only): the whole
+    # control head — control outboxes, score plane, IWANT-service window,
+    # P5 app plane — crosses the edge involution in ONE gather (one halo
+    # permute set per phase on the sharded mesh), and the phase's
+    # attribution accumulators fold as leading-axis-stacked tensors.
+    # False selects the legacy per-plane path (round-3..6 structure) for
+    # A/B; the bench fingerprint records the choice
+    # (engine.wire_coalesced) and the measured permute_sets_per_phase.
+    # Bit-identical either way (tests/test_phase_stacked.py).
+    wire_coalesced: bool = True
     # exact per-event tracing support (trace.go:166-194, 341-414): the
     # step additionally records this round's duplicate-arrival plane
     # ([N,K,W] — arrivals beyond the first per (peer,msg)) in
@@ -184,6 +194,7 @@ class GossipSubConfig:
         validator_timeout_rounds: int = 0,
         queue_cap: int = 0,
         trace_exact: bool = False,
+        wire_coalesced: bool = True,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
@@ -229,6 +240,7 @@ class GossipSubConfig:
             validator_timeout_rounds=validator_timeout_rounds,
             queue_cap=queue_cap,
             trace_exact=trace_exact,
+            wire_coalesced=wire_coalesced,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if thresholds is not None:
@@ -560,20 +572,23 @@ def _served_capped(cfg: GossipSubConfig, lo: jax.Array, hi: jax.Array) -> jax.Ar
 
 
 def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState,
-                    nbr_score_of_me):
+                    nbr_score_of_me, window_g: jax.Array | None = None):
     """The IWANT-response carry for this round's delivery + retransmission
     counter update (handleIWant gossipsub.go:679-716). `st.iwant_out` holds
     what I asked each neighbor last round; the neighbor serves from its full
     mcache history window subject to the per-(edge,msg) cap.
     `nbr_score_of_me` [N,K] comes from the step's merged wire exchange
-    (None only when scoring is disabled)."""
+    (None only when scoring is disabled). ``window_g`` is the neighbors'
+    gathered mcache-window plane when the coalesced wire exchange already
+    carried it (None: gather here, the legacy extra permute set)."""
     asked = st.iwant_out
-    sender_window = bitset.word_or_reduce(st.mcache, axis=1)       # [N,W]
-    window_g = jnp.where(
-        net.nbr_ok[:, :, None],
-        net.peer_gather(sender_window),                             # [N,K,W]
-        jnp.uint32(0),
-    )
+    if window_g is None:
+        sender_window = bitset.word_or_reduce(st.mcache, axis=1)   # [N,W]
+        window_g = jnp.where(
+            net.nbr_ok[:, :, None],
+            net.peer_gather(sender_window),                         # [N,K,W]
+            jnp.uint32(0),
+        )
     capped = _served_capped(cfg, st.served_lo, st.served_hi)
     resp = asked & window_g & ~capped
 
@@ -917,12 +932,16 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               nbr_sub: jax.Array, gater_params=None,
               nbr_sub_words: jax.Array | None = None,
               present_ok: jax.Array | None = None,
-              gossip_suppress: jax.Array | None = None) -> GossipSubState:
+              gossip_suppress: jax.Array | None = None,
+              app_gathered: jax.Array | None = None) -> GossipSubState:
     """`net` is the live view (nbr_ok masked by churn/edge-liveness);
     `present_ok` is the static edge-presence mask, needed by directConnect
     to re-dial edges that are currently dormant (defaults to net.nbr_ok).
     `gossip_suppress` [N,K] marks congested outbound links whose IHAVE
-    batch is dropped this heartbeat (queue_cap backpressure)."""
+    batch is dropped this heartbeat (queue_cap backpressure).
+    ``app_gathered`` is the pre-gathered P5 plane when the coalesced wire
+    exchange carried it (app_score is phase-invariant, so the head gather
+    equals the tail gather bit-for-bit)."""
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -953,7 +972,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     # refreshScores + memoized score cache (gossipsub.go:1333-1341)
     if cfg.score_enabled:
         score = refresh_scores(score, st.mesh, tick, tp, score_params)
-        scores = compute_scores(score, st.mesh, tp, score_params, st.p6, st.app_score, net)
+        scores = compute_scores(score, st.mesh, tp, score_params, st.p6,
+                                st.app_score, net, app_gathered=app_gathered)
     else:
         scores = st.scores
 
@@ -1576,6 +1596,85 @@ def control_exchange(cfg: GossipSubConfig, net: Net, net_l: Net,
             nbr_score_of_me)
 
 
+def control_exchange_coalesced(cfg: GossipSubConfig, net: Net, net_l: Net,
+                               st: GossipSubState, include_app: bool = False):
+    """ONE stacked wire exchange for the whole phase control head (round-7
+    tentpole): every control outbox, the score plane, the IWANT-service
+    mcache window — and, when ``include_app``, the P5 app-score plane the
+    heartbeat tail consumes — cross the edge involution in a single
+    gather, so the sharded lowering emits ONE halo-permute set for the
+    entire control head instead of three-plus-one (16·(r+4) →
+    16·(r+1) permutes per phase; perf/projection.py charges 1–5 µs
+    launch latency per permute).
+
+    The [N]-shaped planes (mcache window, app score) broadcast over the
+    edge axis before the concat, turning their peer gather into the same
+    edge involution (x[n,k] = v[n] ⇒ gathered[j,k] = v[nbr[j,k]]) —
+    byte-wasteful per direction but launch-free, the right trade in the
+    launch-dominated halo regime the projection models.
+
+    The round-3 measured merge policy (control_exchange above) deliberately
+    kept the score column and the ihave words on separate gathers: their
+    consumers' layouts forced a relayout copy per ROUND on the real chip.
+    The phase engine pays the control head once per PHASE, so a once-per-
+    phase relayout buys r rounds of avoided launches — the opposite
+    tradeoff; the per-round step keeps the round-3 policy, and the legacy
+    phase path stays selectable (cfg.wire_coalesced=False) for A/B.
+
+    Returns (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
+    nbr_score_of_me, window_g, app_g)."""
+    named_parts = control_parts(cfg, net, st, include_score=True)
+    names = [nm for nm, _ in named_parts]
+    parts = [p for _, p in named_parts]
+    n_ctrl = len([nm for nm in names if nm != "score"])
+    n_peers, k_dim = net.nbr.shape
+    sender_window = bitset.word_or_reduce(st.mcache, axis=1)       # [N,W]
+    w = sender_window.shape[-1]
+    names.append("window")
+    parts.append(jnp.broadcast_to(
+        sender_window[:, None, :], (n_peers, k_dim, w)))
+    if include_app:
+        names.append("app")
+        parts.append(jnp.broadcast_to(
+            jax.lax.bitcast_convert_type(st.app_score, jnp.uint32)[:, None, None],
+            (n_peers, k_dim, 1)))
+    sizes = np.cumsum([0] + [p.shape[-1] for p in parts])
+    gg = jnp.where(
+        net_l.nbr_ok[:, :, None],
+        net_l.edge_gather(jnp.concatenate(parts, axis=-1)),
+        jnp.uint32(0),
+    )
+
+    def seg(i):
+        return gg[..., int(sizes[i]) : int(sizes[i + 1])]
+
+    def seg_named(nm):
+        return seg(names.index(nm))
+
+    # control parts lead the concat in control_parts order (score is
+    # always appended last by control_parts), so the plain index view
+    # feeds control_unpack directly
+    assert "score" not in names[:n_ctrl]
+    graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw = control_unpack(
+        cfg, net, net_l, seg
+    )
+    if cfg.score_enabled:
+        nbr_score_of_me = jnp.where(
+            net_l.nbr_ok,
+            jax.lax.bitcast_convert_type(seg_named("score")[..., 0], jnp.float32),
+            0.0,
+        )
+    else:
+        nbr_score_of_me = None
+    window_g = seg_named("window")
+    app_g = (
+        jax.lax.bitcast_convert_type(seg_named("app")[..., 0], jnp.float32)
+        if include_app else None
+    )
+    return (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
+            nbr_score_of_me, window_g, app_g)
+
+
 def px_connect(cfg: GossipSubConfig, net: Net, net_l: Net, st: GossipSubState,
                px_ok, dynamic_peers: bool) -> jax.Array:
     """PX connect (pxConnect gossipsub.go:861-941): a peer pruned with PX
@@ -1967,7 +2066,8 @@ def make_gossipsub_step(
 
         # 7. publishes + slot-recycle cleanup
         msgs, dlv, _slots, is_pub, keep_words, pub_words = allocate_publishes(
-            core.msgs, dlv, tick, pub_origin, pub_topic, pub_valid
+            core.msgs, dlv, tick, pub_origin, pub_topic, pub_valid,
+            stacked_clears=cfg.wire_coalesced,
         )
         # recycled-slot clearing must precede the put: the fresh publishes
         # land on exactly the recycled slots, and clearing after the OR
@@ -1981,9 +2081,14 @@ def make_gossipsub_step(
         # (the reference sends IHAVE once, at the heartbeat) — emitGossip
         # below repopulates on heartbeat rounds
         ihave_out = jnp.zeros_like(st2.ihave_out)
-        iwant_out = st2.iwant_out & keep_words[None, None, :]
-        served_lo = st2.served_lo & keep_words[None, None, :]
-        served_hi = st2.served_hi & keep_words[None, None, :]
+        if cfg.wire_coalesced:
+            iwant_out, served_lo, served_hi = bitset.masked_keep(
+                [st2.iwant_out, st2.served_lo, st2.served_hi], keep_words
+            )
+        else:
+            iwant_out = st2.iwant_out & keep_words[None, None, :]
+            served_lo = st2.served_lo & keep_words[None, None, :]
+            served_hi = st2.served_hi & keep_words[None, None, :]
         # one-hot word pick instead of an [N,K,M] compare-reduce
         promise_reused = bitset.bit_get((~keep_words)[None, None, :], st2.promise_mid)
         promise_mid = jnp.where(

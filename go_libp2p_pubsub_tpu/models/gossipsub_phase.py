@@ -27,6 +27,32 @@ edge-gather + carry edge-gather). On the sharded mesh that is one set of
 halo permutes per sub-round. The two forms are boolean-algebra equal;
 tests/test_phase.py pins r=1 phase == per-round step bit-exactly.
 
+Round 7 (cfg.wire_coalesced, the default) restructures the rest of the
+phase the same way — launch count over everything else, because at the
+12.5k shard BOTH terms of rate = 1/(shard_ms + ici_ms) are
+launch-overhead, not bytes:
+  * the CONTROL HEAD coalesces into one stacked wire exchange
+    (gossipsub.control_exchange_coalesced): control outboxes + score
+    plane + IWANT mcache window + (when weighted) the P5 app plane
+    cross the edge involution in ONE gather — the phase's halo budget
+    drops from 16·(r+4) to 16·(r+1) permutes (the number the v5e-8
+    projection charges; tests/test_collectives.py pins it exactly);
+  * the per-sub-round PUBLISH ALLOCATION hoists to the head
+    (state.PhasePubPlan): slot/index math, recycled-slot keep masks,
+    origin pub words and message-table snapshots precompute as wide
+    ops, replacing r allocate_publishes calls' tiny-kernel swarm
+    ([M]-table scatters, cursor scalar chains — the round-6 profile's
+    dominant launch pool);
+  * the ATTRIBUTION ACCUMULATORS fold as one leading-axis-stacked
+    tensor (_AccStack) — one OR + one keep-AND per sub-round for every
+    live plane — and the shared keep-clears go through
+    bitset.masked_keep.
+Measured on this image's XLA:CPU at N=12.5k r=16: 410.9 -> 85.1
+executed kernels/round (docs/PERF.md round-7 table). The legacy
+per-plane path stays selectable (cfg.wire_coalesced=False) and
+bit-identical (tests/test_phase_stacked.py compares full state trees
+across gossipsub/floodsub/randomsub at r in {1, 8, 16}).
+
 Score/gater attribution is folded over the phase in packed word planes:
 every (edge, msg) pair transmits at most once per phase (the fwd set is
 one-shot and IWANT retransmissions are capped per phase head), so OR
@@ -74,7 +100,7 @@ from ..score.engine import (
     slot_topic_words,
 )
 from ..score.gater import gater_on_round
-from ..state import Net, allocate_publishes
+from ..state import Net, PhasePubPlan, allocate_publishes
 from ..trace.events import EV
 from .common import RoundInfo, accumulate_round_events, finish_delivery
 from .gossipsub import (
@@ -84,6 +110,7 @@ from .gossipsub import (
     apply_peer_transitions,
     apply_validation_throttle,
     control_exchange,
+    control_exchange_coalesced,
     fanout_carry_words,
     fanout_carry_words_packed,
     handle_graft_prune,
@@ -101,6 +128,93 @@ from .gossipsub import (
     sender_carry_words,
     update_fanout_on_publish,
 )
+
+
+class _AccStack:
+    """The phase's attribution accumulators as ONE edge-axis-stacked
+    ``[N, C, W]`` tensor (round-7 tentpole): every live plane — [N, W]
+    planes contribute one lane, [N, K, W] planes K lanes — shares the
+    same two word-algebra folds per sub-round (OR the sub-round's update
+    in, AND the recycled-slot keep mask), so the stacked form runs each
+    fold as one wide kernel instead of one small kernel per plane. At
+    the 12.5k shard the phase engine is fusion-count-bound (docs/PERF.md
+    round-6 table: 94% of device time in many small ``not_and``/
+    ``broadcast_and`` fusions), so lanes are cheaper than launches.
+
+    ``stacked=False`` keeps every plane a separate array with separate
+    folds — the legacy round-4..6 kernel structure — selected by
+    ``cfg.wire_coalesced=False`` for A/B; both paths run the same
+    updates in the same order, so they are bit-identical by
+    construction (pinned by tests/test_phase_stacked.py)."""
+
+    def __init__(self, specs, n: int, w: int, stacked: bool):
+        # specs: (name, lanes, keep_masked); lanes=1 packs an [N, W]
+        # plane, lanes=k an [N, k, W] plane
+        self.specs = tuple(specs)
+        self.stacked = stacked
+        self.offs = {}
+        off = 0
+        for name, lanes, _ in self.specs:
+            self.offs[name] = (off, lanes)
+            off += lanes
+        self.c = off
+        if stacked:
+            self.buf = jnp.zeros((n, off, w), jnp.uint32) if off else None
+        else:
+            self.planes = {
+                name: jnp.zeros((n, w) if lanes == 1 else (n, lanes, w),
+                                jnp.uint32)
+                for name, lanes, _ in self.specs
+            }
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.offs
+
+    def or_(self, updates: dict) -> "_AccStack":
+        """OR the sub-round's updates in — one wide op when stacked.
+        Every live plane must have an update (all accumulation sites run
+        every sub-round)."""
+        if self.stacked:
+            if self.buf is not None:
+                n, _, w = self.buf.shape
+                upd = jnp.concatenate(
+                    [updates[name].reshape(n, lanes, w)
+                     for name, lanes, _ in self.specs], axis=1)
+                self.buf = self.buf | upd
+        else:
+            for name, _, _ in self.specs:
+                self.planes[name] = self.planes[name] | updates[name]
+        return self
+
+    def keep(self, keep_w: jax.Array) -> "_AccStack":
+        """AND the recycled-slot keep mask into every keep-masked plane —
+        one wide op when stacked (planes that must survive recycling,
+        e.g. the exact-trace dup plane, ride an all-ones lane mask)."""
+        if self.stacked:
+            if self.buf is not None:
+                lane_masked = jnp.asarray(
+                    [m for _, lanes, m in self.specs for _ in range(lanes)],
+                    bool)
+                mask = jnp.where(
+                    lane_masked[:, None], keep_w[None, :],
+                    jnp.uint32(0xFFFFFFFF))
+                self.buf = self.buf & mask[None]
+        else:
+            for name, lanes, masked in self.specs:
+                if masked:
+                    km = keep_w[None, :] if lanes == 1 else keep_w[None, None, :]
+                    self.planes[name] = self.planes[name] & km
+        return self
+
+    def get(self, name: str, default=None):
+        if name not in self.offs:
+            return default
+        if not self.stacked:
+            return self.planes[name]
+        off, lanes = self.offs[name]
+        if lanes == 1:
+            return self.buf[:, off, :]
+        return self.buf[:, off : off + lanes, :]
 
 
 def make_gossipsub_phase_step(
@@ -135,6 +249,11 @@ def make_gossipsub_phase_step(
     The fused Pallas data plane (PUBSUB_FUSED) is not applicable here —
     the phase engine's sender-side form already collapses the exchange to
     one gather per sub-round.
+
+    ``cfg.wire_coalesced`` (default True) selects the round-7 stacked
+    data plane — coalesced control-head exchange, head publish plan,
+    stacked accumulator folds (see the module docstring); False builds
+    the legacy per-plane structure, bit-identical, for A/B.
 
     **Admission invariant** (enforced here since round 6): a phase may
     admit at most ``msg_slots // 2`` publishes — slots recycled WITHIN a
@@ -224,8 +343,23 @@ def make_gossipsub_phase_step(
 
         acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
                                        core.key, tick0)
-        (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
-         nbr_score_of_me) = control_exchange(cfg, net, net_l, st)
+        if cfg.wire_coalesced:
+            # ONE stacked gather for the whole control head: control
+            # outboxes + score plane + IWANT window (+ the P5 app plane
+            # when its weight is live) — the phase's halo budget drops
+            # from 16·(r+4) to 16·(r+1) permutes (perf/projection.py)
+            include_app = (
+                cfg.score_enabled
+                and consts.score_params.app_specific_weight != 0.0
+            )
+            (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
+             nbr_score_of_me, window_g, app_g) = control_exchange_coalesced(
+                cfg, net, net_l, st, include_app=include_app
+            )
+        else:
+            (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
+             nbr_score_of_me) = control_exchange(cfg, net, net_l, st)
+            window_g = app_g = None
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
             cfg, net_l, st, tp, acc_ok, graft_in_raw, prune_in_raw, px_in_raw
         )
@@ -233,7 +367,8 @@ def make_gossipsub_phase_step(
         if cfg.count_events:
             events = events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
         edge_live_next = px_connect(cfg, net, net_l, st, px_ok, dynamic_peers)
-        st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me)
+        st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me,
+                                          window_g=window_g)
         st2 = handle_ihave(cfg, net_l, st2, joined_msg_words(net_l, core.msgs),
                            acc_ok, ihave_in_raw)
         if consts.sender_fwd_ok is not None:
@@ -312,19 +447,30 @@ def make_gossipsub_phase_step(
         # origin advertises and IWANT-serves its own invalid publishes
         # from mcache, so invalid arrivals repeat across rounds on the
         # same edge. The trans plane stays.)
-        trans_acc = zkw if (plane_score and p4_live) else None
-        new_acc = zw if plane_score else None
-        recv_acc = zw if plane_score else None
-        accepted_acc = zw if (plane_score or cfg.gater_enabled) else None
-        mcw_acc = zkw if (plane_score and p3_live) else None
+        # the live attribution planes, folded through _AccStack: one OR +
+        # one keep-AND per sub-round over the whole stack when
+        # cfg.wire_coalesced, per-plane folds (the legacy kernel
+        # structure) otherwise. The exact-trace dup plane is the one
+        # NON-keep-masked lane — see the dup_trace comment below.
+        acc_specs = []
+        if plane_score:
+            acc_specs += [("new", 1, True), ("recv", 1, True)]
+        if plane_score or cfg.gater_enabled:
+            acc_specs += [("accepted", 1, True)]
+        if plane_score and p4_live:
+            acc_specs += [("trans", k_dim, True)]
+        if plane_score and p3_live:
+            acc_specs += [("mcw", k_dim, True)]
+        if cfg.gater_enabled:
+            acc_specs += [("dup", k_dim, True), ("rejw", k_dim, True),
+                          ("ignw", k_dim, True)]
+        if cfg.trace_exact:
+            acc_specs += [("dupt", k_dim, False)]
+        accs = _AccStack(acc_specs, n_peers, w, stacked=cfg.wire_coalesced)
         if count_score:
             zsc = jnp.zeros((n_peers, s_slots, k_dim), jnp.float32)
             fmd_counts, mmd_counts, imd_counts = zsc, zsc, zsc
-        dup_trace_acc = zkw if cfg.trace_exact else None
         if cfg.gater_enabled:
-            dup_acc = zkw
-            rejw_acc = zkw
-            ignw_acc = zkw
             n_validated_acc = jnp.zeros((n_peers,), jnp.int32)
             n_throttled_acc = jnp.zeros((n_peers,), jnp.int32)
         if cfg.count_events:
@@ -333,6 +479,17 @@ def make_gossipsub_phase_step(
                        n_drop=jnp.int32(0))
             n_pub = jnp.int32(0)
         info = None
+
+        # phase-head batched publish allocation (state.PhasePubPlan): the
+        # whole [r, P] schedule's slot/index math, keep masks, origin pub
+        # words, and message-table snapshots as one set of wide head ops,
+        # replacing r calls to allocate_publishes (~15 tiny kernels each
+        # — the dominant launch swarm at the 12.5k shard)
+        plan = (
+            PhasePubPlan(msgs, n_peers, tick0, pub_origin, pub_topic,
+                         pub_valid)
+            if cfg.wire_coalesced else None
+        )
 
         # membership word planes: on NARROW topic universes (T <= 8) the
         # planes are carried incrementally — a sub-round changes the
@@ -346,13 +503,25 @@ def make_gossipsub_phase_step(
         if incr_members:
             slotw = slot_topic_words(net_l, msgs.topic)
             joined_w = joined_msg_words(net_l, msgs)
+        if plan is not None:
+            # the origin word plane rides the loop incrementally on the
+            # plan path: (origin_w & keep) | pub_words IS the next
+            # sub-round's origin_msg_words (the recycled columns now
+            # belong to the new publishes), replacing an [M]-scatter per
+            # sub-round with one wide fold
+            origin_w = origin_msg_words(net_l, msgs)
 
         for i in range(r):
             tick_i = tick0 + i
+            if plan is not None:
+                # the table as allocate_publishes would have left it after
+                # sub-rounds < i (bit-identical snapshot; see PhasePubPlan)
+                msgs = plan.msgs_at(i)
             if not incr_members:
                 slotw = slot_topic_words(net_l, msgs.topic)
                 joined_w = joined_msg_words(net_l, msgs)
-            origin_w = origin_msg_words(net_l, msgs)
+            if plan is None:
+                origin_w = origin_msg_words(net_l, msgs)
 
             # sender-side transmit composition: ONE edge gather per
             # sub-round carries the entire data plane
@@ -405,6 +574,7 @@ def make_gossipsub_phase_step(
                     count_events=cfg.count_events, queue_cap=cfg.queue_cap,
                     val_delay_topic=cfg.validation_delay_topic,
                 )
+            acc_upd = {}
             if cfg.trace_exact:
                 # pre-throttle, like the per-round step: throttled receipts
                 # are fresh (traced Reject), not duplicates. Phase
@@ -413,11 +583,14 @@ def make_gossipsub_phase_step(
                 # held at arrival, attributed against the phase-START
                 # slot->mid mapping (exact while slots outlive a phase —
                 # the M >> r*P sizing every tracing config satisfies)
-                dup_trace_acc = dup_trace_acc | (
+                acc_upd["dupt"] = (
                     info.trans
                     & ~(dlv.fe_words & info.recv_new_words[:, None, :])
                 )
-            valid_w_i = bitset.pack(msgs.valid)
+            valid_w_i = (
+                plan.valid_words[i] if plan is not None
+                else bitset.pack(msgs.valid)
+            )
             if cfg.validation_capacity > 0:
                 dlv, info, accepted_new, n_thr = apply_validation_throttle(
                     dlv, info, cfg.validation_capacity, m, valid_w_i
@@ -426,16 +599,17 @@ def make_gossipsub_phase_step(
                 accepted_new = info.new_words
                 n_thr = None
 
-            # ---- attribution accumulation (OR of word planes, or direct
-            # per-slot count reduction; both exact — each (edge,msg)
-            # transmits at most once per phase) ---------------------------
+            # ---- attribution accumulation (ONE stacked OR of word
+            # planes when cfg.wire_coalesced, per-plane ORs otherwise, or
+            # the direct per-slot count reduction; all exact — each
+            # (edge,msg) transmits at most once per phase) ----------------
             if plane_score:
-                new_acc = new_acc | info.new_words
-                recv_acc = recv_acc | info.recv_new_words
-                if trans_acc is not None:
-                    trans_acc = trans_acc | info.trans
-            if accepted_acc is not None:
-                accepted_acc = accepted_acc | accepted_new
+                acc_upd["new"] = info.new_words
+                acc_upd["recv"] = info.recv_new_words
+                if "trans" in accs:
+                    acc_upd["trans"] = info.trans
+            if "accepted" in accs:
+                acc_upd["accepted"] = accepted_new
             if cfg.score_enabled and (p3_live or count_score):
                 # P3 window gate at this arrival's own tick (score.go:
                 # 944-974 markDuplicateMessageDelivery window check)
@@ -448,7 +622,10 @@ def make_gossipsub_phase_step(
                 valid3 = valid_w_i[None, None, :]
                 mesh_w = info.trans & valid3 & within_i[:, None, :]
                 fa_w = dlv.fe_words & info.new_words[:, None, :] & valid3
-                ign_i = bitset.pack(msgs.ignored)
+                ign_i = (
+                    plan.ignored_words[i] if plan is not None
+                    else bitset.pack(msgs.ignored)
+                )
                 inv_w = info.trans & ~(valid_w_i | ign_i)[None, None, :]
 
                 mmd_counts = mmd_counts + per_slot_counts(mesh_w, slotw)
@@ -465,41 +642,98 @@ def make_gossipsub_phase_step(
                     mcw_i = mcw_i | (
                         info.trans & pend_post[:, None, :] & ~fa_i
                     )
-                mcw_acc = mcw_acc | mcw_i
+                acc_upd["mcw"] = mcw_i
             if cfg.gater_enabled:
-                dup_acc = dup_acc | (info.trans & pre_have[:, None, :])
-                ign_w_i = bitset.pack(msgs.ignored)
-                rejw_acc = rejw_acc | (
+                acc_upd["dup"] = info.trans & pre_have[:, None, :]
+                ign_w_i = (
+                    plan.ignored_words[i] if plan is not None
+                    else bitset.pack(msgs.ignored)
+                )
+                acc_upd["rejw"] = (
                     info.trans & ~(valid_w_i | ign_w_i)[None, None, :]
                 )
-                ignw_acc = ignw_acc | (info.trans & ign_w_i[None, None, :])
+                acc_upd["ignw"] = info.trans & ign_w_i[None, None, :]
                 n_validated_acc = n_validated_acc + bitset.popcount(
                     accepted_new, axis=-1
                 )
                 if n_thr is not None:
                     n_throttled_acc = n_throttled_acc + n_thr
+            accs = accs.or_(acc_upd)
             if cfg.count_events:
                 for k in cnt:
                     cnt[k] = cnt[k] + getattr(info, k)
 
             # mcache insertion: validated receipts in joined topics
             put = info.new_words & valid_w_i[None, :] & joined_w
-            mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | put)
+            if not cfg.wire_coalesced:
+                mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | put)
 
             # publishes for this sub-round + recycled-slot cleanup (the
             # scatter form wins in the phase sub-round at N >= 20k —
             # state.py allocate_publishes docstring has the measurements)
-            msgs, dlv, _slots, is_pub, keep_w, pub_words = allocate_publishes(
-                msgs, dlv, tick_i, pub_origin[i], pub_topic[i], pub_valid[i],
-                scatter_form=n_peers >= 20_000,
-            )
+            if plan is not None:
+                # the table half already lives in the head snapshots
+                # (msgs_at(i+1) is read at the next iteration's top); only
+                # the delivery-state folds run here, fed by the
+                # precomputed masks
+                _slots, is_pub = plan.sidx[i], plan.is_pub[i]
+                keep_w, pub_words = plan.keep_w[i], plan.pub_words[i]
+                dlv = plan.apply_to_delivery(
+                    dlv, i, tick_i, scatter_form=n_peers >= 20_000
+                )
+                origin_w = (origin_w & keep_w[None, :]) | pub_words
+            else:
+                msgs, dlv, _slots, is_pub, keep_w, pub_words = \
+                    allocate_publishes(
+                        msgs, dlv, tick_i, pub_origin[i], pub_topic[i],
+                        pub_valid[i], scatter_form=n_peers >= 20_000,
+                    )
             # incremental membership-plane maintenance (narrow universes):
             # recycled columns clear, then each publish ORs its one-hot
             # word column where the peer/slot matches the new topic
-            if incr_members:
+            p_dim = pub_origin.shape[-1]
+            if incr_members and cfg.wire_coalesced:
+                # batched form of the per-publish loop below: the P one-hot
+                # word columns are built at once and OR-reduced into the
+                # planes — ~4 wide kernels instead of ~4 small ones per
+                # publish slot (OR is associative: identical bits land)
+                slotw, joined_w, mcache = bitset.masked_keep(
+                    [slotw, joined_w, mcache], keep_w
+                )
+                t_p = jnp.clip(pub_topic[i], 0)  # [P]
+                warange = jnp.arange(w, dtype=jnp.int32)
+                colw = jnp.where(
+                    (warange[None, :] == _slots[:, None] // bitset.WORD)
+                    & is_pub[:, None],
+                    jnp.uint32(1)
+                    << (_slots[:, None] % bitset.WORD).astype(jnp.uint32),
+                    jnp.uint32(0),
+                )  # [P, W] one-hot word columns
+                # subscribed[:, t_p] without the [N]-row gather: a compare
+                # +any over the narrow (T <= 8) topic axis fuses to vector
+                # work (same finding as slot_topic_words)
+                t_onehot = (
+                    jnp.arange(net.n_topics, dtype=jnp.int32)[None, :, None]
+                    == t_p[None, None, :]
+                )  # [1, T, P]
+                sub_p = jnp.any(
+                    net_l.subscribed[:, :, None] & t_onehot, axis=1
+                )  # [N, P]
+                joined_w = joined_w | bitset.word_or_reduce(
+                    jnp.where(sub_p[:, :, None], colw[None], jnp.uint32(0)),
+                    axis=1,
+                )
+                slot_match = (
+                    net_l.my_topics[:, :, None] == t_p[None, None, :]
+                )  # [N, S, P]
+                slotw = slotw | bitset.word_or_reduce(
+                    jnp.where(slot_match[..., None], colw[None, None],
+                              jnp.uint32(0)),
+                    axis=2,
+                )
+            elif incr_members:
                 slotw = slotw & keep_w[None, None, :]
                 joined_w = joined_w & keep_w[None, :]
-                p_dim = pub_origin.shape[-1]
                 warange = jnp.arange(w, dtype=jnp.int32)
                 for j in range(p_dim):
                     s_j = _slots[j]
@@ -519,8 +753,19 @@ def make_gossipsub_phase_step(
                         (net_l.my_topics == t_j)[:, :, None],
                         colw[None, None, :], jnp.uint32(0),
                     )
-            mcache = mcache & keep_w[None, None, :]
-            mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)
+            if cfg.wire_coalesced:
+                if not incr_members:
+                    mcache = mcache & keep_w[None, None, :]
+                # one window-0 update for this sub-round's put AND the
+                # publish stamps: ((m|put)&keep)|pub == (m&keep)|(put&keep)
+                # |pub — the mcache clear already ran (masked_keep above /
+                # the & keep_w line), so fold put through keep_w here
+                mcache = mcache.at[:, 0, :].set(
+                    mcache[:, 0, :] | (put & keep_w[None, :]) | pub_words
+                )
+            else:
+                mcache = mcache & keep_w[None, None, :]
+                mcache = mcache.at[:, 0, :].set(mcache[:, 0, :] | pub_words)
             # iwant_out / served / promise recycled-slot clears DEFER to
             # the phase tail (keep_acc): nothing inside the loop reads or
             # writes them (asks and service budgets are written at the
@@ -535,22 +780,9 @@ def make_gossipsub_phase_step(
             # recycled slots drop out of the phase accumulators too — their
             # columns now belong to a different message (the count path
             # needs no clearing: its credits were reduced at arrival time,
-            # when the slot still named the right message)
-            kw3 = keep_w[None, None, :]
-            kw2 = keep_w[None, :]
-            if plane_score:
-                new_acc = new_acc & kw2
-                recv_acc = recv_acc & kw2
-                if mcw_acc is not None:
-                    mcw_acc = mcw_acc & kw3
-                if trans_acc is not None:
-                    trans_acc = trans_acc & kw3
-            if accepted_acc is not None:
-                accepted_acc = accepted_acc & kw2
-            if cfg.gater_enabled:
-                dup_acc = dup_acc & kw3
-                rejw_acc = rejw_acc & kw3
-                ignw_acc = ignw_acc & kw3
+            # when the slot still named the right message; the exact-trace
+            # dup lane is deliberately NOT cleared — see its comment)
+            accs = accs.keep(keep_w)
             if cfg.count_events:
                 n_pub = n_pub + jnp.sum(is_pub.astype(jnp.int32))
 
@@ -571,10 +803,18 @@ def make_gossipsub_phase_step(
                     fanout_st = upd
 
         # ---- phase tail (once) ------------------------------------------
-        # deferred recycled-slot clears (see the loop comment)
-        iwant_out = iwant_out & keep_acc[None, None, :]
-        served_lo = served_lo & keep_acc[None, None, :]
-        served_hi = served_hi & keep_acc[None, None, :]
+        if plan is not None:
+            msgs = plan.msgs_at(r)  # the phase-final message table
+        # deferred recycled-slot clears (see the loop comment) — one
+        # stacked fold over the three [N,K,W] planes on the coalesced path
+        if cfg.wire_coalesced:
+            iwant_out, served_lo, served_hi = bitset.masked_keep(
+                [iwant_out, served_lo, served_hi], keep_acc
+            )
+        else:
+            iwant_out = iwant_out & keep_acc[None, None, :]
+            served_lo = served_lo & keep_acc[None, None, :]
+            served_hi = served_hi & keep_acc[None, None, :]
         promise_reused = bitset.bit_get(
             (~keep_acc)[None, None, :], promise_mid
         )
@@ -590,28 +830,30 @@ def make_gossipsub_phase_step(
         elif plane_score:
             score = on_deliveries(
                 score, net_l, mesh2, tp,
-                trans_acc if trans_acc is not None else zkw, new_acc,
+                accs.get("trans", zkw), accs.get("new"),
                 dlv.fe_words, dlv.first_round,
                 msgs.topic, msgs.valid, tick_last, consts.window_rounds_t,
                 msg_ignored=msgs.ignored,
                 slotw=slot_topic_words(net_l, msgs.topic),
-                recv_new_words=recv_acc,
-                mesh_credit_words=mcw_acc if mcw_acc is not None else zkw,
+                recv_new_words=accs.get("recv"),
+                mesh_credit_words=accs.get("mcw", zkw),
             )
         gater_state = st2.gater
         if cfg.gater_enabled:
             valid_w_end = bitset.pack(msgs.valid)
             first_arrival = (
-                dlv.fe_words & accepted_acc[:, None, :]
+                dlv.fe_words & accs.get("accepted")[:, None, :]
                 & valid_w_end[None, None, :]
             )
             deliver_inc = bitset.popcount(first_arrival, axis=-1).astype(jnp.float32)
             gater_state = gater_on_round(
                 gater_state, n_validated_acc, n_throttled_acc, deliver_inc,
-                bitset.popcount(dup_acc, axis=-1).astype(jnp.float32),
-                bitset.popcount(rejw_acc, axis=-1).astype(jnp.float32),
+                bitset.popcount(accs.get("dup"), axis=-1).astype(jnp.float32),
+                bitset.popcount(accs.get("rejw"), axis=-1).astype(jnp.float32),
                 tick_last,
-                ignore_inc=bitset.popcount(ignw_acc, axis=-1).astype(jnp.float32),
+                ignore_inc=bitset.popcount(
+                    accs.get("ignw"), axis=-1
+                ).astype(jnp.float32),
             )
         if cfg.count_events:
             # accumulate_round_events consumes only the scalar counters;
@@ -644,7 +886,7 @@ def make_gossipsub_phase_step(
                 if fp_pack is not None else fanout_st.fanout_peers
             ),
             fanout_lastpub=fanout_st.fanout_lastpub,
-            dup_trans=dup_trace_acc,
+            dup_trans=accs.get("dupt"),
         )
 
         # congested links suppress this heartbeat's gossip toward them
@@ -661,7 +903,7 @@ def make_gossipsub_phase_step(
             st2 = heartbeat(
                 cfg, net_l, st2, tp, consts.score_params, nbr_sub_l,
                 gater_params, nbr_sub_words_l, present_ok=net.nbr_ok,
-                gossip_suppress=gossip_suppress,
+                gossip_suppress=gossip_suppress, app_gathered=app_g,
             )
         return st2.replace(core=st2.core.replace(tick=tick0 + r))
 

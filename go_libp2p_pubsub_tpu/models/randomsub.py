@@ -29,7 +29,8 @@ RANDOMSUB_D = 6  # randomsub.go:17
 
 def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
                         size_estimate: int | None = None,
-                        queue_cap: int = 0):
+                        queue_cap: int = 0,
+                        stacked: bool = True):
     """Build the jitted per-round RandomSub step.
 
     `size_estimate` mirrors the reference's static network-size parameter:
@@ -46,7 +47,9 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
     139-170 — the writer queues sit below every router); the async
     validation pipeline likewise rides in the state
     (``SimState.init(val_delay=...)``), both shared with floodsub and
-    gossipsub through the common delivery engine."""
+    gossipsub through the common delivery engine. ``stacked`` selects
+    the round-7 stacked recycled-slot clears in allocate_publishes
+    (False = legacy per-plane kernels, bit-identical — A/B only)."""
     protocol = np.asarray(net.protocol)
     if size_estimate is not None:
         gs_size = np.full((net.n_topics,), size_estimate, np.int64)
@@ -93,7 +96,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
         dlv, info = delivery_round(net, st.msgs, st.dlv, edge_mask, tick,
                                    queue_cap=queue_cap)
         msgs, dlv, _slots, is_pub, _keep, _pw = allocate_publishes(
-            st.msgs, dlv, tick, pub_origin, pub_topic, pub_valid
+            st.msgs, dlv, tick, pub_origin, pub_topic, pub_valid,
+            stacked_clears=stacked,
         )
         events = accumulate_round_events(st.events, info, jnp.sum(is_pub.astype(jnp.int32)))
         return st.replace(tick=tick + 1, msgs=msgs, dlv=dlv, events=events)

@@ -167,6 +167,34 @@ def keep_lowest_bits(words: jax.Array, cap: int,
     return words & ~overflow
 
 
+def masked_keep(planes: list, keep: jax.Array) -> list:
+    """AND the same ``[W]`` keep mask into several ``[N, ..., W]`` planes
+    through ONE concatenated fold (the recycled-slot clear every router
+    applies around ``allocate_publishes``): each plane is viewed as
+    ``[N, c, W]``, the views concatenated on the middle axis, masked with
+    one wide AND, and sliced back. Returns the cleared planes in order;
+    ``None`` entries pass through. Bit-identical to the per-plane ANDs
+    (elementwise; planes never interact)."""
+    live = [(i, p) for i, p in enumerate(planes) if p is not None]
+    out = list(planes)
+    if not live:
+        return out
+    if len(live) == 1:
+        i, p = live[0]
+        out[i] = p & keep.reshape((1,) * (p.ndim - 1) + (-1,))
+        return out
+    n = live[0][1].shape[0]
+    w = keep.shape[-1]
+    flat = [p.reshape(n, -1, w) for _, p in live]
+    sizes = [f.shape[1] for f in flat]
+    cat = jnp.concatenate(flat, axis=1) & keep[None, None, :]
+    off = 0
+    for (i, p), sz in zip(live, sizes):
+        out[i] = jax.lax.slice_in_dim(cat, off, off + sz, axis=1).reshape(p.shape)
+        off += sz
+    return out
+
+
 def first_set_per_bit(words: jax.Array, axis: int = 1) -> jax.Array:
     """Isolate, per bit, the lowest index along `axis` whose word carries
     it: out has exactly the bits of `words` that are each bit's first

@@ -17,11 +17,43 @@ per-topic control messages).
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 WORD = 32
+
+# ---------------------------------------------------------------------------
+# halo-gather tally: each cross-peer gather below is ONE "gather set" — on a
+# banded topology it lowers to len(offsets) rolled halo collective-permutes
+# under GSPMD (parallel/sharding.py), so counting gather calls at trace time
+# IS measuring the per-phase permute budget the v5e-8 projection charges
+# (perf/projection.py). The counter is None outside `tally_halo_gathers`,
+# keeping the hot path untouched.
+
+_TALLY: list | None = None
+
+
+def _tally(kind: str) -> None:
+    if _TALLY is not None:
+        _TALLY.append(kind)
+
+
+@contextlib.contextmanager
+def tally_halo_gathers(out: list):
+    """Collect one entry per cross-peer gather traced inside the block
+    (``"edge"``/``"peer"`` tags). Use with ``jax.eval_shape`` to measure a
+    step's gather-set count without compiling; ``len(out)`` × the band
+    direction count is the permute count the sharded lowering will emit."""
+    global _TALLY
+    prev = _TALLY
+    _TALLY = out
+    try:
+        yield out
+    finally:
+        _TALLY = prev
 
 
 def n_topic_words(n_topics: int) -> int:
@@ -39,6 +71,7 @@ def build_edge_perm(nbr: np.ndarray, rev: np.ndarray, nbr_ok: np.ndarray) -> np.
 
 def edge_permute(x: jax.Array, perm: jax.Array) -> jax.Array:
     """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] as a flat row gather."""
+    _tally("edge")
     n, k = perm.shape
     flat = x.reshape((n * k,) + x.shape[2:])
     return flat[perm.reshape(-1)].reshape(x.shape)
@@ -64,6 +97,7 @@ def edge_permute_banded(
     x: jax.Array, off: tuple[int, ...], rev: tuple[int, ...]
 ) -> jax.Array:
     """Banded-regular edge_permute: out[j,k] = x[(j+off[k]) % N, rev[k]]."""
+    _tally("edge")
     cols = [jnp.roll(x[:, r], -o, axis=0) for o, r in zip(off, rev)]
     return jnp.stack(cols, axis=1)
 
@@ -102,6 +136,7 @@ def edge_permute_banded_flat(
 
 def peer_gather_banded(v: jax.Array, off: tuple[int, ...]) -> jax.Array:
     """Banded-regular v[nbr]: out[j,k] = v[(j+off[k]) % N]."""
+    _tally("peer")
     return jnp.stack([jnp.roll(v, -o, axis=0) for o in off], axis=1)
 
 

@@ -47,6 +47,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 import numpy as np
 
+from . import edges as _edges
+
 WORD = 32
 # plain numpy scalars: jnp constants at module scope would be captured by
 # kernel closures as device arrays, which pallas_call rejects
@@ -160,6 +162,11 @@ def edge_exchange(
     Runs before GRAFT/PRUNE ingest — the ingest result feeds the delivery
     kernel's sender mesh, which is why exchange and delivery are two
     pallas calls, not one."""
+    # one halo-exchange set (the kernel's block-neighbor DMAs move the
+    # same band-edge rows a rolled gather would) — counted so the
+    # permute-budget measurement (edges.tally_halo_gathers) stays honest
+    # on fused builds
+    _edges._tally("edge")
     n = wire_pack.shape[0]
     b = block
     nb = n // b
@@ -341,6 +348,9 @@ def fused_delivery(
     fe, served_lo, served_hi, new, have, fwd (all post-round), plus
     mesh_trans/extra cohorts when want_cohorts (event accounting needs
     per-cohort popcounts to match the XLA path's split counters)."""
+    # the kernel's carry/fe/hp block-neighbor views are one coalesced
+    # halo-exchange set (see edge_exchange's tally note)
+    _edges._tally("edge")
     n = fwd.shape[0]
     b = block
     nb = n // b

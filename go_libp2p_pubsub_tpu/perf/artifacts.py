@@ -93,6 +93,24 @@ class BenchRecord:
     def ms_per_round(self) -> float:
         return 1000.0 / self.value
 
+    @property
+    def wire_coalesced(self) -> bool | None:
+        """The engine's round-7 stacked/coalesced data-plane switch;
+        None for artifacts that predate the field (rounds 1-6)."""
+        fp = self.fingerprint or {}
+        eng = fp.get("engine") or {}
+        v = eng.get("wire_coalesced")
+        return None if v is None else bool(v)
+
+    @property
+    def permute_sets_per_phase(self) -> int | None:
+        """MEASURED halo gather sets per phase (16 rolled permutes each)
+        recorded by round-7+ fingerprints; None for legacy artifacts —
+        the projection then falls back to its 16·(r+4) formula."""
+        fp = self.fingerprint or {}
+        v = fp.get("permute_sets_per_phase")
+        return None if v is None else int(v)
+
     def to_line(self) -> dict:
         """The v2 JSON-line object (what bench.py prints)."""
         out = {

@@ -62,6 +62,29 @@ class ProfileTable:
             out[r.category] += r.self_us_per_round
         return dict(out)
 
+    @property
+    def n_kernels_per_round(self) -> float:
+        """Executed kernels (op occurrences) per simulated round — the
+        launch-overhead metric the round-7 stacked-plane work optimizes
+        (the 12.5k shard is fusion-COUNT-bound, not bandwidth-bound:
+        docs/PERF.md round-6/7 tables). xplane backend: every executed
+        thunk event; converter backends: row occurrences (same trace,
+        same trend)."""
+        return sum(r.occurrences for r in self.rows) / max(self.rounds, 1)
+
+    @property
+    def kernels_by_category(self) -> dict:
+        """Per-round executed-kernel counts by op category, largest
+        first (fusion / copy / call / reduce / ...)."""
+        out = defaultdict(int)
+        for r in self.rows:
+            out[r.category] += r.occurrences
+        rd = max(self.rounds, 1)
+        return {
+            k: round(v / rd, 2)
+            for k, v in sorted(out.items(), key=lambda x: -x[1])
+        }
+
     def top(self, n: int = 30) -> list:
         return self.rows[:n]
 
@@ -190,6 +213,78 @@ def parse_xspace_bytes(blobs, rounds: int) -> ProfileTable:
         rounds=rounds,
         backend="xplane",
     )
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO kernel census (no execution — the perf-smoke gate's input)
+
+#: top-level instructions that never launch a kernel
+_NON_KERNEL_OPS = frozenset(
+    {"parameter", "get-tuple-element", "constant", "tuple", "bitcast"}
+)
+
+
+def hlo_kernel_census(hlo_text: str) -> dict:
+    """Thunk-level kernel counts of a compiled HLO module, by op.
+
+    Counts instructions of every computation EXCEPT fusion bodies
+    (``fused_computation*`` — their ops run inside the enclosing fusion
+    kernel) and reduction/scatter combiner regions (``region*``), and
+    skips the no-kernel bookkeeping ops (parameters, GTEs, constants,
+    tuples, bitcasts). The result approximates the executed launch count
+    of one invocation on XLA:CPU — the number ``make perf-smoke``'s
+    kernel-count gate pins (perf/regress.py), with the per-op breakdown
+    for diagnosis. Returns {"total": int, "by_op": {op: count}}."""
+    import collections
+
+    counts = collections.Counter()
+    for comp in re.split(r"\n(?=%|ENTRY)", hlo_text):
+        header = comp.split("\n", 1)[0]
+        m = re.match(r"(ENTRY )?%?([\w.\-]+)", header)
+        if (m is None or "fused_computation" in m.group(2)
+                or m.group(2).startswith("region")):
+            continue
+        # result type is a single token OR a tuple "(s32[], u32[2]{0})"
+        # — while loops and multi-output fusions use the tuple form
+        counts.update(
+            re.findall(r"= (?:\([^)]*\)|\S+?) ([\w\-]+)\(", comp)
+        )
+    by_op = {
+        k: v for k, v in counts.most_common() if k not in _NON_KERNEL_OPS
+    }
+    return {"total": sum(by_op.values()), "by_op": by_op}
+
+
+def compiled_phase_kernel_count(n_peers: int, rounds_per_phase: int,
+                                config: str = "default",
+                                msg_slots: int = 64) -> dict:
+    """Compile the bench phase step at (n_peers, r) on the current
+    platform and census its kernels (hlo_kernel_census). Adds
+    ``per_round`` — the gate's headline number."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .sweep import PUBS_PER_ROUND, build_bench
+
+    r = max(int(rounds_per_phase), 1)
+    st, step, _, _ = build_bench(
+        n_peers, msg_slots, config=config, heartbeat_every=max(r, 1),
+        rounds_per_phase=r,
+    )
+    shape = (r, PUBS_PER_ROUND) if r > 1 else (PUBS_PER_ROUND,)
+    po = jnp.asarray(np.full(shape, -1, np.int32))
+    pt = jnp.asarray(np.zeros(shape, np.int32))
+    pv = jnp.asarray(np.ones(shape, bool))
+    if r > 1:
+        lowered = step.lower(st, po, pt, pv, do_heartbeat=True)
+    else:
+        lowered = step.lower(st, po, pt, pv)
+    census = hlo_kernel_census(lowered.compile().as_text())
+    census["per_round"] = round(census["total"] / r, 2)
+    census["n_peers"] = int(n_peers)
+    census["rounds_per_phase"] = r
+    return census
 
 
 # ---------------------------------------------------------------------------
@@ -339,10 +434,14 @@ def profile_workload(
 
 def format_table(table: ProfileTable, top: int = 30) -> str:
     """Render the BASELINE.md-style attribution table."""
+    kcat = ", ".join(
+        f"{k}: {v:g}" for k, v in list(table.kernels_by_category.items())[:6]
+    )
     lines = [
         f"total device self time: {table.total_us_per_round * table.rounds / 1e3:.1f} ms;"
         f" per round: {table.total_us_per_round:.0f} us"
         f"  (backend: {table.backend}, rounds: {table.rounds})",
+        f"kernels/round: {table.n_kernels_per_round:.1f}  ({kcat})",
         "",
         "by category:",
     ]

@@ -36,12 +36,18 @@ import dataclasses
 
 from .artifacts import NORTH_STAR_RATE, load_bench_artifact, load_multichip_artifact
 
-#: collective-permutes per phase for the phase engine — 16 × (r + 4):
-#: 16 rolled-permute directions × (r data sub-round gathers + 4 control
-#: gather sets). Pinned in CI by tests/test_collectives.py.
+#: rolled-permute directions (the banded bench topology's band width —
+#: degree 16). Each halo gather SET costs one permute per direction.
 PERMUTE_SETS = 16
 
-PERMUTES_PER_PHASE_CONTROL = 4  # wire/score/fe/window gather sets
+#: LEGACY control gather sets per phase — the rounds-3..6 engine's
+#: merged-control-wire / score / IWANT-window / P5-app gathers. Used only
+#: as the fallback for committed artifacts whose fingerprint predates the
+#: measured ``permute_sets_per_phase`` field (round 7): current builds
+#: record the measured count (perf.sweep.measure_phase_gather_sets), and
+#: the coalesced wire exchange runs ONE control gather set (16·(r+1)
+#: permutes per phase, pinned by tests/test_collectives.py).
+PERMUTES_PER_PHASE_CONTROL = 4  # wire/score/window/app gather sets (legacy)
 
 #: ICI collective-permute launch latency band, µs (BASELINE.md round-3
 #: hardware cost model; the central value is the band midpoint the
@@ -62,21 +68,38 @@ ROUND5_SHARD_RATES_R16 = {
 }
 
 
-def permutes_per_round(rounds_per_phase: int) -> float:
-    """Halo collective-permutes per delivery round at phase cadence r
-    (16·(r+4)/r; the r=1 per-round engine's 112 = 16×7 is the same
-    formula with its 7 gather sets)."""
+def permutes_per_round(rounds_per_phase: int,
+                       permute_sets_per_phase: int | None = None) -> float:
+    """Halo collective-permutes per delivery round at phase cadence r.
+
+    ``permute_sets_per_phase`` is the MEASURED gather-set count from the
+    artifact fingerprint (one set = 16 rolled permutes; the coalesced
+    engine measures r+1). None — a legacy artifact — falls back to the
+    rounds-3..6 hard-coded 16·(r+4)/r formula (the r=1 per-round
+    engine's 112 = 16×7 is the same formula with its 7 gather sets)."""
     r = int(rounds_per_phase)
     if r < 1:
         raise ValueError(f"rounds_per_phase must be >= 1, got {r}")
-    return PERMUTE_SETS * (r + PERMUTES_PER_PHASE_CONTROL) / r
+    if permute_sets_per_phase is None:
+        sets = r + PERMUTES_PER_PHASE_CONTROL
+    else:
+        sets = int(permute_sets_per_phase)
+        if sets < r:
+            raise ValueError(
+                f"permute_sets_per_phase {sets} < rounds_per_phase {r}: "
+                "every sub-round costs at least its own data gather set"
+            )
+    return PERMUTE_SETS * sets / r
 
 
-def ici_serialized_ms(rounds_per_phase: int, launch_us: float) -> float:
+def ici_serialized_ms(rounds_per_phase: int, launch_us: float,
+                      permute_sets_per_phase: int | None = None) -> float:
     """Serialized ICI cost per round: every halo permute pays launch
     latency; data volume (≤ ~4 KiB band-edge rows per permute) is
     negligible against it at ICI bandwidth."""
-    return permutes_per_round(rounds_per_phase) * launch_us / 1000.0
+    return permutes_per_round(
+        rounds_per_phase, permute_sets_per_phase
+    ) * launch_us / 1000.0
 
 
 @dataclasses.dataclass
@@ -88,6 +111,8 @@ class Projection:
     n_shards: int
     ici_ms: tuple          # (lo, central, hi)
     rounds_per_sec: tuple  # (lo, central, hi) — note lo pairs with hi ICI
+    #: gather sets/phase the ICI term used (None = legacy 16·(r+4) model)
+    permute_sets_per_phase: int | None = None
 
     @property
     def central(self) -> float:
@@ -102,6 +127,7 @@ class Projection:
         return {
             "shard_ms_per_round": round(self.shard_ms_per_round, 4),
             "rounds_per_phase": self.rounds_per_phase,
+            "permute_sets_per_phase": self.permute_sets_per_phase,
             "n_shards": self.n_shards,
             "ici_ms_lo_central_hi": tuple(round(v, 4) for v in self.ici_ms),
             "rounds_per_sec_lo_central_hi": (
@@ -111,17 +137,20 @@ class Projection:
 
 
 def project(shard_ms_per_round: float, rounds_per_phase: int,
-            n_shards: int = 8) -> Projection:
+            n_shards: int = 8,
+            permute_sets_per_phase: int | None = None) -> Projection:
     """Project the n-chip rate from one shard's measured round time.
 
     The peer axis is sharded; every shard advances the same round in
     lockstep (peer-axis data parallelism, parallel/sharding.py), so the
     projected rate is the shard rate degraded by the serialized ICI
-    fraction — shard count enters only through the shard's N."""
+    fraction — shard count enters only through the shard's N.
+    ``permute_sets_per_phase``: the measured gather-set count (artifact
+    fingerprint); None keeps the legacy 16·(r+4) model."""
     if shard_ms_per_round <= 0:
         raise ValueError(f"shard_ms_per_round must be > 0, got {shard_ms_per_round}")
     ici = tuple(
-        ici_serialized_ms(rounds_per_phase, us)
+        ici_serialized_ms(rounds_per_phase, us, permute_sets_per_phase)
         for us in (ICI_LAUNCH_US_LO, ICI_LAUNCH_US_CENTRAL, ICI_LAUNCH_US_HI)
     )
     rates = (
@@ -135,13 +164,19 @@ def project(shard_ms_per_round: float, rounds_per_phase: int,
         n_shards=int(n_shards),
         ici_ms=ici,
         rounds_per_sec=rates,
+        permute_sets_per_phase=(
+            int(permute_sets_per_phase)
+            if permute_sets_per_phase is not None else None
+        ),
     )
 
 
 def project_from_artifacts(bench_path: str, multichip_path: str,
                            shard_rate: float | None = None,
                            rounds_per_phase: int | None = None,
-                           n_shards: int = 8) -> Projection:
+                           n_shards: int = 8,
+                           permute_sets_per_phase: int | None = None
+                           ) -> Projection:
     """The committed-round projection: gate on the round's multichip
     dryrun, then project from the shard rate.
 
@@ -151,6 +186,13 @@ def project_from_artifacts(bench_path: str, multichip_path: str,
     used — the headline BENCH artifact measures the full-N rate, not the
     shard's, so the shard term rides as a recorded constant until a
     committed sweep artifact carries it (perf.sweep produces those).
+
+    The ICI term uses the bench fingerprint's MEASURED
+    ``permute_sets_per_phase`` when the artifact carries one (round 7+;
+    the coalesced engine records r+1); committed rounds 1-6 artifacts
+    have no such field and keep the legacy 16·(r+4) formula their
+    projections were built with — so the round-5 44-45% reproduces
+    unchanged. Pass ``permute_sets_per_phase`` to override.
 
     Raises ValueError when the multichip artifact says the sharded step
     did not run clean — a projection built on a failed collective audit
@@ -182,4 +224,13 @@ def project_from_artifacts(bench_path: str, multichip_path: str,
         rounds_per_phase = 16
     elif rounds_per_phase is None:
         rounds_per_phase = 16
-    return project(1000.0 / shard_rate, rounds_per_phase, n_shards=n_shards)
+    if permute_sets_per_phase is None:
+        recorded = bench.permute_sets_per_phase
+        if recorded is not None:
+            # the fingerprint records sets at the ARTIFACT's cadence
+            # (r_bench data gathers + control); translate the control
+            # count to the projection cadence
+            control = max(int(recorded) - bench.rounds_per_phase, 0)
+            permute_sets_per_phase = int(rounds_per_phase) + control
+    return project(1000.0 / shard_rate, rounds_per_phase, n_shards=n_shards,
+                   permute_sets_per_phase=permute_sets_per_phase)

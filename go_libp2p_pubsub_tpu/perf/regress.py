@@ -25,13 +25,22 @@ Checks, in order (any failure -> exit 1):
      (central 44-45% of the north star) — the same invariant
      tests/test_perf.py pins, enforced here so a bare ``make
      perf-smoke`` needs no pytest.
-  3. mini-bench: run (default config, PERF_SMOKE_N peers) at r=1 and
+  3. kernel-count gate (round 7): the compiled HLO kernel count of the
+     N=PERF_SMOKE_N default-config phase step (r=PERF_SMOKE_R) must not
+     exceed the committed ``hlo_kernels`` baseline in PERF_SMOKE.json
+     by more than PERF_SMOKE_KERNEL_TOL (default 1.05) — the structural
+     guard for the stacked-plane/coalesced-wire fusion-count win (the
+     12.5k shard is launch-bound; a change that re-inflates the kernel
+     swarm regresses the headline even if rates on THIS machine look
+     fine). Skipped when the committed baseline predates the field.
+  4. mini-bench: run (default config, PERF_SMOKE_N peers) at r=1 and
      r=8 on CPU; require phase_rate > PHASE_MIN_RATIO * per_round_rate
      and rate >= PERF_SMOKE_TOL * committed baseline (when present).
 
 Emits one schema-v2 JSON line per mini-bench cell, then a PASS/FAIL
 summary line. ``PERF_SMOKE_UPDATE=1`` rewrites PERF_SMOKE.json from
-this run (use when the gate machine changes).
+this run — rates AND kernel baseline (use when the gate machine or the
+engine deliberately changes).
 """
 
 from __future__ import annotations
@@ -57,6 +66,13 @@ PHASE_MIN_RATIO = 1.15
 #: absolute floor: fraction of the committed PERF_SMOKE.json rate the
 #: fresh run must reach (override: PERF_SMOKE_TOL=0.25 etc.)
 DEFAULT_TOL = 0.4
+
+#: kernel-count ceiling: fresh compiled kernel total may exceed the
+#: committed baseline by at most this factor (override:
+#: PERF_SMOKE_KERNEL_TOL) — slack for XLA-version fusion jitter, tight
+#: enough that a reintroduced per-sub-round launch swarm (~10+ kernels
+#: per sub-round) trips it
+KERNEL_TOL = 1.05
 
 BASELINE_NAME = "PERF_SMOKE.json"
 
@@ -116,6 +132,48 @@ def check_projection(root: str) -> list[str]:
     return []
 
 
+def run_kernel_census() -> dict:
+    """Compile the smoke-shape phase step and census its kernels."""
+    from .profile import compiled_phase_kernel_count
+
+    n = int(os.environ.get("PERF_SMOKE_N", PERF_SMOKE_N))
+    r = int(os.environ.get("PERF_SMOKE_R", PERF_SMOKE_R))
+    return compiled_phase_kernel_count(n, r)
+
+
+def check_kernel_count(root: str, census: dict) -> list[str]:
+    """The round-7 structural gate: compiled kernel total vs committed
+    baseline. Empty when no baseline is committed yet (legacy
+    PERF_SMOKE.json shapes stay accepted)."""
+    base_path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(base_path) or os.environ.get("PERF_SMOKE_UPDATE"):
+        return []
+    with open(base_path) as f:
+        base = json.load(f)
+    committed = (base.get("hlo_kernels") or {}).get("total")
+    if committed is None:
+        return []
+    # the baseline is shape-specific: a PERF_SMOKE_N/_R reshape compiles
+    # a different program, so comparing against the committed shape's
+    # count would deterministically fail a healthy build — skip instead
+    # (the reshape knobs are for ad-hoc exploration; the committed gate
+    # runs at the committed shape)
+    if (int(base.get("n_peers", census["n_peers"])) != census["n_peers"]
+            or int(base.get("rounds_per_phase", census["rounds_per_phase"]))
+            != census["rounds_per_phase"]):
+        return []
+    tol = float(os.environ.get("PERF_SMOKE_KERNEL_TOL", KERNEL_TOL))
+    if census["total"] > tol * committed:
+        return [
+            f"compiled kernel count regressed: {census['total']} > "
+            f"{tol:.2f} x committed {committed} "
+            f"(N={census['n_peers']}, r={census['rounds_per_phase']}; "
+            f"top ops: {dict(list(census['by_op'].items())[:5])}; "
+            f"{BASELINE_NAME}; PERF_SMOKE_KERNEL_TOL overrides)"
+        ]
+    return []
+
+
 def run_mini_bench(emit=None) -> dict:
     """The CPU mini-bench: per-round and phase rates at the smoke shape.
     Returns {"per_round": rate, "phase": rate, "records": [...]}."""
@@ -162,7 +220,7 @@ def check_mini_bench(root: str, res: dict) -> list[str]:
     return errors
 
 
-def write_baseline(root: str, res: dict) -> str:
+def write_baseline(root: str, res: dict, kernels: dict | None = None) -> str:
     path = os.path.join(root, BASELINE_NAME)
     payload = {
         "schema": 2,
@@ -176,6 +234,24 @@ def write_baseline(root: str, res: dict) -> str:
         ),
         "fingerprint": res["records"][-1].fingerprint,
     }
+    if kernels is not None:
+        payload["hlo_kernels"] = {
+            "total": int(kernels["total"]),
+            "per_round": kernels["per_round"],
+            "by_op": kernels["by_op"],
+        }
+    elif os.path.exists(path):
+        # a crashed census must not silently disarm the kernel gate:
+        # keep the previously committed block and say so
+        with open(path) as f:
+            prev = json.load(f)
+        if prev.get("hlo_kernels") is not None:
+            payload["hlo_kernels"] = prev["hlo_kernels"]
+            print(
+                "perf-smoke: kernel census did not run; keeping the "
+                "previously committed hlo_kernels baseline",
+                file=sys.stderr,
+            )
     with open(path, "w") as f:
         json.dump(payload, f, indent=2)
         f.write("\n")
@@ -204,6 +280,18 @@ def main(argv=None) -> int:
 
     skip_bench = "--no-bench" in (argv or sys.argv[1:])
     if not skip_bench:
+        census = None
+        try:
+            census = run_kernel_census()
+            print(json.dumps({
+                "kernel_census": {
+                    "total": census["total"],
+                    "per_round": census["per_round"],
+                }
+            }), flush=True)
+            errors += check_kernel_count(root, census)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"kernel census crashed: {e}")
         try:
             res = run_mini_bench(emit=lambda r: print(dump_record(r), flush=True))
         except Exception as e:  # noqa: BLE001
@@ -211,7 +299,7 @@ def main(argv=None) -> int:
             res = None
         if res is not None:
             if os.environ.get("PERF_SMOKE_UPDATE"):
-                print("wrote", write_baseline(root, res))
+                print("wrote", write_baseline(root, res, kernels=census))
             errors += check_mini_bench(root, res)
 
     if errors:

@@ -21,6 +21,7 @@ PRNG first — see main()).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 import os
 import time
@@ -75,8 +76,19 @@ def bench_score_params(config: str, n_topics: int):
     return tp, sp
 
 
+def bench_wire_coalesced(wire_coalesced: bool | None = None) -> bool:
+    """The bench's engine-path switch (round-7 A/B knob): the coalesced
+    stacked wire exchange is the default; BENCH_WIRE_COALESCED=0 selects
+    the legacy per-plane path. Single source for the workload builder
+    AND the fingerprint."""
+    if wire_coalesced is not None:
+        return bool(wire_coalesced)
+    return os.environ.get("BENCH_WIRE_COALESCED", "1") != "0"
+
+
 def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "default",
-                heartbeat_every: int = 1, rounds_per_phase: int = 1):
+                heartbeat_every: int = 1, rounds_per_phase: int = 1,
+                wire_coalesced: bool | None = None):
     """Build (state, step, n_topics, honest) for a BENCH_CONFIG:
 
     default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
@@ -136,6 +148,7 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         params, PeerScoreThresholds(), score_enabled=True, gater_params=gater,
         validation_capacity=8 if config == "sybil" else 0,
         heartbeat_every=heartbeat_every,
+        wire_coalesced=bench_wire_coalesced(wire_coalesced),
     )
     # tracer-detached configuration (tracing is opt-in in the reference):
     # no aggregate event counters; no fanout slots when every peer
@@ -166,6 +179,81 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     return st, step, n_topics, honest
 
 
+def measure_phase_gather_sets(
+    config: str,
+    rounds_per_phase: int,
+    wire_coalesced: bool | None = None,
+    heartbeat_every: int | None = None,
+) -> int | None:
+    # resolve the env-dependent default BEFORE the memo key (a flipped
+    # BENCH_WIRE_COALESCED mid-process must not hit a stale cache), and
+    # catch failures OUTSIDE it (a transient trace error must not be
+    # memoized into "no measurement for the rest of the process")
+    try:
+        return _measure_phase_gather_sets(
+            config, int(rounds_per_phase),
+            bench_wire_coalesced(wire_coalesced), heartbeat_every,
+        )
+    except Exception as e:  # noqa: BLE001 — measurement is best-effort,
+        import warnings       # but never silently: a missing field makes
+                              # the projection fall back to the legacy
+                              # 16·(r+4) formula
+        warnings.warn(
+            f"permute_sets_per_phase measurement failed for "
+            f"(config={config}, r={rounds_per_phase}): {e!r}; the "
+            "fingerprint will omit the field and projections will use "
+            "the legacy formula",
+            stacklevel=2,
+        )
+        return None
+
+
+@functools.lru_cache(maxsize=64)
+def _measure_phase_gather_sets(
+    config: str,
+    rounds_per_phase: int,
+    wire_coalesced: bool,
+    heartbeat_every: int | None,
+) -> int | None:
+    """MEASURE the phase engine's halo gather-set count per phase — the
+    number the v5e-8 projection's ICI term is built from (each set is one
+    cross-peer gather, lowering to one rolled collective-permute per band
+    direction under GSPMD; parallel/sharding.py).
+
+    Counts real gather CALLS at trace time (ops/edges.tally_halo_gathers
+    under ``jax.eval_shape`` — no compile) on a tiny banded replica of
+    the bench config, so the fingerprint records what THIS build of the
+    engine actually does instead of the hard-coded 16·(r+4) formula the
+    rounds-3..6 projections assumed (the coalesced wire exchange makes
+    it r+1). Gather structure is shape-independent, so the tiny N stands
+    in for any shard size. Raises when the step cannot be traced — the
+    public wrapper above warns and returns None WITHOUT memoizing the
+    failure."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import edges
+
+    r = max(int(rounds_per_phase), 1)
+    he = heartbeat_every if heartbeat_every is not None else max(r, 1)
+    st, step, _, _ = build_bench(
+        64, 64, config=config, heartbeat_every=he, rounds_per_phase=r,
+        wire_coalesced=wire_coalesced,
+    )
+    shape = (r, PUBS_PER_ROUND) if r > 1 else (PUBS_PER_ROUND,)
+    po = jnp.zeros(shape, jnp.int32)
+    pt = jnp.zeros(shape, jnp.int32)
+    pv = jnp.ones(shape, bool)
+    if r > 1 or he > 1:
+        fn = functools.partial(step, do_heartbeat=True)
+    else:
+        fn = step
+    tally: list = []
+    with edges.tally_halo_gathers(tally):
+        jax.eval_shape(fn, st, po, pt, pv)
+    return len(tally)
+
+
 def workload_fingerprint(
     config: str,
     n_peers: int,
@@ -174,6 +262,7 @@ def workload_fingerprint(
     rounds_per_phase: int,
     seg_rounds: int | None = None,
     unroll: int | None = None,
+    wire_coalesced: bool | None = None,
 ) -> dict:
     """The schema-v2 self-description of a bench cell: everything a
     future reader needs to know what the number measured, derived from
@@ -186,6 +275,7 @@ def workload_fingerprint(
     n_topics = 64 if config == "eth2" else 1
     tp, sp = bench_score_params(config, n_topics)
     phase = rounds_per_phase > 1
+    coalesced = bench_wire_coalesced(wire_coalesced)
     p3_elided = (
         tp.mesh_message_deliveries_weight == 0.0
         and (tp.mesh_failure_penalty_weight == 0.0
@@ -219,6 +309,10 @@ def workload_fingerprint(
         "elides_invalid_message_deliveries": bool(phase and p4_elided),
         "engine": {
             "mode": "phase" if phase else "per_round",
+            # the round-7 stacked/coalesced data plane (phase wire
+            # exchange + accumulator stacking + head publish plan);
+            # False = the legacy per-plane A/B path
+            "wire_coalesced": coalesced,
             "gater": config == "sybil",
             "validation_capacity": 8 if config == "sybil" else 0,
             "count_events": False,
@@ -233,6 +327,16 @@ def workload_fingerprint(
         fp["seg_rounds"] = int(seg_rounds)
     if unroll is not None:
         fp["unroll"] = int(unroll)
+    if phase:
+        # MEASURED halo gather sets per phase (16 rolled permutes each on
+        # the banded bench topology) — the projection's ICI input; legacy
+        # artifacts without this field fall back to the 16·(r+4) formula
+        sets = measure_phase_gather_sets(
+            config, rounds_per_phase, wire_coalesced=coalesced,
+            heartbeat_every=heartbeat_every,
+        )
+        if sets is not None:
+            fp["permute_sets_per_phase"] = int(sets)
     try:
         import jax
 

@@ -164,6 +164,7 @@ def compute_scores(
     p6: jax.Array,        # [N,K] precomputed colocation surplus^2
     app_score: jax.Array,  # [N] per-peer P5 value (gathered at nbr)
     net: Net,
+    app_gathered: jax.Array | None = None,  # [N,K] pre-gathered P5 plane
 ) -> jax.Array:
     """[N, K] f32 — peer n's score of neighbor slot k."""
     e = lambda a: a[..., None]  # [N,S] -> [N,S,1] broadcast over K
@@ -190,8 +191,18 @@ def compute_scores(
     if params.topic_score_cap > 0:
         score = jnp.minimum(score, params.topic_score_cap)
 
-    # P5 (score.go:320-321)
-    score = score + net.peer_gather(app_score) * params.app_specific_weight
+    # P5 (score.go:320-321) — statically elided when the weight is zero
+    # everywhere (the same build-time zero-weight elision the phase engine
+    # applies to P3/P4 planes: the term multiplies finite app scores by
+    # 0.0, so scores are bit-identical and the cross-peer gather — one
+    # full halo-permute set on the sharded mesh — never lowers). When
+    # live, the phase engine's coalesced wire exchange pre-gathers the
+    # plane at its control head (app_score is phase-invariant) and passes
+    # it as ``app_gathered`` so the heartbeat tail adds no extra halo.
+    if params.app_specific_weight != 0.0:
+        app_g = (app_gathered if app_gathered is not None
+                 else net.peer_gather(app_score))
+        score = score + app_g * params.app_specific_weight
 
     # P6 (score.go:324-325)
     score = score + p6 * params.ip_colocation_factor_weight

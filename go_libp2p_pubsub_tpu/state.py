@@ -63,6 +63,7 @@ class Net:
         contract as edge_gather."""
         if self.band_off is not None:
             return edges.peer_gather_banded(v, self.band_off)
+        edges._tally("peer")
         return v[jnp.clip(self.nbr, 0)]
 
     @classmethod
@@ -282,6 +283,160 @@ class SimState:
 # publish-slot allocation
 
 
+class PhasePubPlan:
+    """Phase-head batched publish allocation (round-7 tentpole).
+
+    ``allocate_publishes`` called once per sub-round pays ~15 tiny
+    kernels each time — the [M]-table scatters, the cursor scalar chain,
+    the cumsum/remainder index math — and at the 12.5k shard that swarm
+    of launches IS the round budget (docs/PERF.md: fixed per-fusion
+    overhead dominates below ~25k). The phase engine knows its whole
+    ``[r, P]`` schedule at the head, and slot assignment depends only on
+    (cursor, schedule), so every per-sub-round quantity is computable
+    up front as ONE set of wide ops:
+
+      * ``sidx/is_pub [r, P]`` — slot per publish (``m`` on padding);
+      * ``keep_w [r, W]`` / ``reused [r, M]`` — recycled-slot masks;
+      * ``pub_words [r, N, W]`` — origin seen/fwd bits, one batched
+        scatter for the whole phase;
+      * message-table SNAPSHOTS ``[r+1, M]`` (last-write-wins over the
+        flattened schedule): ``msgs_at(i)`` is bit-identical to the
+        table ``allocate_publishes`` would have produced after the
+        publishes of sub-rounds ``< i`` — the loop reads ``msgs_at(i)``
+        during sub-round ``i`` and the tail commits ``msgs_at(r)``.
+
+    The delivery-state folds (have/fwd/fe/pending keep-clears, the
+    first_round stamp) still run per sub-round — they mix with evolving
+    delivery state — but as wide word ops fed by the precomputed masks,
+    not as fresh index math. Exactness: the snapshot recurrence IS the
+    scatter recurrence (last write wins, pads dropped), pinned by
+    tests/test_phase_stacked.py against the legacy path."""
+
+    def __init__(self, msgs: MsgTable, n_peers: int, tick0,
+                 pub_origin: jax.Array, pub_topic: jax.Array,
+                 pub_valid: jax.Array):
+        r, p = pub_origin.shape
+        m = msgs.capacity
+        # distinct slots within one sub-round keep the batched word
+        # scatter add-exact (same precondition allocate_publishes'
+        # scatter form documents)
+        assert m >= p, f"msg_slots {m} < publish width {p}"
+        w = bitset.n_words(m)
+        self.r, self.m, self.w = r, m, w
+        self.msgs0 = msgs
+        pub_valid = jnp.asarray(pub_valid)
+        accept, ignored = decode_verdicts(pub_valid)       # [r, P]
+        self.accept = accept
+        rp = r * p
+        flat_pub = (pub_origin >= 0).reshape(-1)           # [rP]
+        self.is_pub = flat_pub.reshape(r, p)
+        gpos = jnp.cumsum(flat_pub.astype(jnp.int32)) - 1
+        sidx_flat = jnp.where(flat_pub, (msgs.cursor + gpos) % m, m)
+        self.sidx = sidx_flat.reshape(r, p)
+        counts = jnp.sum(self.is_pub.astype(jnp.int32), axis=1)  # [r]
+        self.cursor_at = msgs.cursor + jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]
+        )  # [r+1]
+
+        # last-write-wins snapshots over the flattened schedule
+        eq = sidx_flat[:, None] == jnp.arange(m, dtype=jnp.int32)[None, :]
+        jidx = jnp.where(eq, jnp.arange(rp, dtype=jnp.int32)[:, None], -1)
+        incl = jax.lax.cummax(jnp.max(jidx.reshape(r, p, m), axis=1), axis=0)
+        # lastw[i]: last flat writer of each slot among sub-rounds < i
+        self._lastw = jnp.concatenate(
+            [jnp.full((1, m), -1, jnp.int32), incl], axis=0
+        )  # [r+1, M]
+        self.reused = jnp.any(eq.reshape(r, p, m), axis=1)  # [r, M]
+        self.keep_w = ~bitset.pack(self.reused)             # [r, W]
+
+        flat_tick = tick0 + jnp.arange(rp, dtype=jnp.int32) // p
+        self._topic = self._snap(msgs.topic, pub_topic.reshape(-1))
+        self._origin = self._snap(msgs.origin, pub_origin.reshape(-1))
+        self._birth = self._snap(msgs.birth, flat_tick)
+        self._valid = self._snap(msgs.valid, accept.reshape(-1))
+        self._ignored = self._snap(msgs.ignored, ignored.reshape(-1))
+        self._wire_block = (
+            self._snap(msgs.wire_block, decode_wire_block(pub_valid).reshape(-1))
+            if msgs.wire_block is not None else None
+        )
+        # per-sub-round packed planes every loop iteration reads
+        self.valid_words = bitset.pack(self._valid)         # [r+1, W]
+        self.ignored_words = bitset.pack(self._ignored)
+
+        # origin publish-bit planes, ONE batched scatter for the phase
+        # (distinct slots per sub-round => distinct bits, add == or)
+        row_flat = jnp.where(flat_pub, pub_origin.reshape(-1), n_peers)
+        self.rows = row_flat.reshape(r, p)  # [r, P], N on padding
+        i_flat = jnp.arange(rp, dtype=jnp.int32) // p
+        bit = jnp.uint32(1) << (sidx_flat % bitset.WORD).astype(jnp.uint32)
+        self.pub_words = jnp.zeros((r, n_peers, w), jnp.uint32).at[
+            i_flat, row_flat, sidx_flat // bitset.WORD
+        ].add(bit, mode="drop")  # [r, N, W]
+
+    def _snap(self, tbl0: jax.Array, vals_flat: jax.Array) -> jax.Array:
+        picked = vals_flat[jnp.clip(self._lastw, 0)]        # [r+1, M]
+        return jnp.where(self._lastw >= 0, picked, tbl0[None, :])
+
+    def msgs_at(self, i: int) -> MsgTable:
+        """The message table as of sub-round ``i`` (after the publishes
+        of sub-rounds < i); ``msgs_at(r)`` is the phase-final table."""
+        return self.msgs0.replace(
+            topic=self._topic[i],
+            origin=self._origin[i],
+            birth=self._birth[i],
+            valid=self._valid[i],
+            ignored=self._ignored[i],
+            cursor=self.cursor_at[i],
+            wire_block=(
+                self._wire_block[i] if self._wire_block is not None else None
+            ),
+        )
+
+    def apply_to_delivery(self, dlv: "Delivery", i: int, tick_i,
+                          scatter_form: bool) -> "Delivery":
+        """Sub-round ``i``'s recycled-slot clears + origin seen/fwd/
+        first_round stamps on the delivery state — the dlv half of
+        ``allocate_publishes``, fed by the precomputed masks (wide word
+        folds only; bit-identical to the per-sub-round scatter path).
+        ``scatter_form`` honors the same PUBSUB_PUB_SCATTER A/B override
+        as allocate_publishes (both forms are exact-equivalent)."""
+        import os
+
+        env = os.environ.get("PUBSUB_PUB_SCATTER")
+        if env is not None:
+            scatter_form = env == "1"
+        keep = self.keep_w[i]
+        pw = self.pub_words[i]
+        n_peers = dlv.have.shape[0]
+        if scatter_form:
+            # the column scatter composing clear + stamp (see
+            # allocate_publishes' scatter-form measurements)
+            col_vals = jnp.where(
+                jnp.arange(n_peers, dtype=jnp.int32)[:, None]
+                == self.rows[i][None, :],
+                jnp.broadcast_to(tick_i, (n_peers, self.sidx.shape[1])), -1,
+            )
+            first_round = dlv.first_round.at[:, self.sidx[i]].set(
+                col_vals, mode="drop"
+            )
+        else:
+            pub_bits = bitset.unpack(pw, self.m)            # [N, M]
+            reused_b = self.reused[i]
+            first_round = jnp.where(
+                pub_bits, jnp.broadcast_to(tick_i, pub_bits.shape),
+                jnp.where(reused_b[None, :], -1, dlv.first_round),
+            )
+        fe_words, pending = bitset.masked_keep(
+            [dlv.fe_words, dlv.pending], keep
+        )
+        return dlv.replace(
+            have=(dlv.have & keep[None, :]) | pw,
+            fwd=(dlv.fwd & keep[None, :]) | pw,
+            first_round=first_round,
+            fe_words=fe_words,
+            pending=pending,
+        )
+
 def allocate_publishes(
     msgs: MsgTable,
     dlv: Delivery,
@@ -290,9 +445,18 @@ def allocate_publishes(
     pub_topic: jax.Array,   # [P] i32
     pub_valid: jax.Array,   # [P] bool accept, or int VERDICT_* codes
     scatter_form: bool | None = None,
+    stacked_clears: bool = False,
 ):
     """Intern this round's publishes into table slots (rotating cursor),
     clearing recycled slots' bit columns everywhere.
+
+    ``stacked_clears`` runs the four recycled-slot keep-ANDs (have / fwd
+    / fe_words / pending) as ONE concatenated fold (bitset.masked_keep)
+    instead of four kernels — the round-7 stacked-plane form, on by
+    default for every router step (floodsub, randomsub, the per-round
+    gossipsub step via ``cfg.wire_coalesced``); False keeps the legacy
+    per-plane kernels for A/B (bit-identical either way — the parity
+    suite tests/test_phase_stacked.py compares full state trees).
 
     Returns (msgs, dlv, slots, is_pub): `slots[P]` the assigned slot per
     publish (undefined where ~is_pub).
@@ -353,12 +517,24 @@ def allocate_publishes(
         first_round = dlv.first_round.at[:, sidx].set(col_vals, mode="drop")
     else:
         first_round = jnp.where(reused[None, :], -1, dlv.first_round)
+    if stacked_clears:
+        have_c, fwd_c, fe_c, pending_c = bitset.masked_keep(
+            [dlv.have, dlv.fwd, dlv.fe_words, dlv.pending], keep
+        )
+    else:
+        have_c = dlv.have & keep[None, :]
+        fwd_c = dlv.fwd & keep[None, :]
+        fe_c = dlv.fe_words & keep[None, None, :]
+        pending_c = (
+            dlv.pending & keep[None, None, :]
+            if dlv.pending is not None else None
+        )
     dlv = dlv.replace(
-        have=dlv.have & keep[None, :],
-        fwd=dlv.fwd & keep[None, :],
+        have=have_c,
+        fwd=fwd_c,
         first_round=first_round,
-        fe_words=dlv.fe_words & keep[None, None, :],
-        pending=dlv.pending & keep[None, None, :] if dlv.pending is not None else None,
+        fe_words=fe_c,
+        pending=pending_c,
     )
 
     msgs = msgs.replace(

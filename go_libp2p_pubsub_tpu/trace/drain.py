@@ -408,7 +408,12 @@ class TraceSession:
         # old occupant of a recycled slot duplicating in its death phase
         # — picks the new mid, the dominant reading (the admission cap
         # guarantees recycled occupants are >= 2 phases old, i.e. ~fully
-        # propagated, while the fresh message is actively flooding).
+        # propagated, while the fresh message is actively flooding), but
+        # since round 7 the event says so instead of staying silent: a
+        # recycled slot whose PREVIOUS occupant was a different message
+        # is emitted with ``ambiguousMid = true`` (sim-only proto field;
+        # ADVICE round-5 item 4), so a consumer reconciling mids can
+        # discount exactly the arrivals whose attribution is a choice.
         per_round = (new.tick - prev.tick) == 1
         if new.dup_trans is not None and new.dup_trans.any():
             widx = np.arange(m) // 32
@@ -416,9 +421,12 @@ class TraceSession:
             bits = ((new.dup_trans[:, :, widx] >> bpos) & 1).astype(bool)
             for p, k, s in zip(*map(np.ndarray.tolist, np.nonzero(bits))):
                 sender = int(nbr[p, k])
+                ambiguous = False
                 if not per_round and s in published_slots:
                     mid = self.slot_mid.get(s, b"?unknown-%d" % s)
                     topic = self.topic_name(int(new.msg_topic[s]))
+                    old_mid = prev_slot_mid.get(s)
+                    ambiguous = old_mid is not None and old_mid != mid
                 else:
                     mid = prev_slot_mid.get(s, b"?unknown-%d" % s)
                     topic = self.topic_name(int(prev.msg_topic[s]))
@@ -426,6 +434,8 @@ class TraceSession:
                 ev.duplicateMessage.messageID = mid
                 ev.duplicateMessage.receivedFrom = self.peer_ids[sender]
                 ev.duplicateMessage.topic = topic
+                if ambiguous:
+                    ev.duplicateMessage.ambiguousMid = True
                 self._emit(ev)
                 edge_msgs.setdefault((sender, p, tick), []).append((mid, topic))
                 if per_round:

@@ -43,9 +43,27 @@ from go_libp2p_pubsub_tpu.parallel import (
 from go_libp2p_pubsub_tpu.state import Net
 
 
+def _bench_prng():
+    """Pin the audits to the bench's PRNG (bench.py BENCH_PRNG default):
+    threefry's sharded lowering emits 24 extra rng collective-permutes
+    inside the heartbeat's selection passes on this image's XLA — launch
+    traffic the measured configuration never pays. Returns a restore fn."""
+    old = str(jax.config.jax_default_prng_impl)
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+    return lambda: jax.config.update("jax_default_prng_impl", old)
+
+
 def test_sharded_step_collective_profile():
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-virtual-device CPU harness")
+    restore = _bench_prng()
+    try:
+        _run_sharded_step_collective_profile()
+    finally:
+        restore()
+
+
+def _run_sharded_step_collective_profile():
     n = 4096
     topo = graph.ring_lattice(n, d=8)
     net = Net.build(topo, graph.subscribe_all(n, 1))
@@ -85,12 +103,14 @@ def test_sharded_step_collective_profile():
     # replicate or per-pair-permute would blow past this).
     # Pinned at 112 (round 3): 16 ring offsets x 7 gathers (merged
     # control wire, score plane, fwd, fe, window, + heartbeat's
-    # direct/suppress gathers). Round-2 history: 96 with the score column
-    # folded into the wire gather (cost 1.2 ms/round single-chip), 144
-    # with fully per-part gathers (the bf9cbc9 regression). The merge
-    # policy in models/gossipsub.py trades one extra halo exchange
-    # (+16 permutes, ~K*W halo rows each) for the measured single-chip
-    # win; BASELINE.md "round 3" records the deliberate tradeoff.
+    # direct/suppress gathers); 96 since round 7 (the weight-elided P5
+    # app gather no longer lowers on zero-weight configs like this one).
+    # Round-2 history: 96 with the score column folded into the wire
+    # gather (cost 1.2 ms/round single-chip), 144 with fully per-part
+    # gathers (the bf9cbc9 regression). The merge policy in
+    # models/gossipsub.py trades one extra halo exchange (+16 permutes,
+    # ~K*W halo rows each) for the measured single-chip win; BASELINE.md
+    # "round 3" records the deliberate tradeoff.
     assert 0 < prof["collective-permute"] <= 116, prof
     assert prof["all-reduce"] <= 10, prof
 
@@ -101,10 +121,21 @@ def test_sharded_step_collective_profile():
 
 
 def test_phase_step_collective_profile():
-    """The phase engine's ICI profile: ONE halo-exchange set per sub-round
-    (the sender-side fused data gather) + a fixed control head/tail —
-    24 permutes/round at r=8 vs the per-round step's 112 (round-4
-    measurement). Still zero all-gathers."""
+    """The phase engine's ICI profile at the BENCH configuration (incl.
+    its unsafe_rbg PRNG — threefry's sharded lowering adds 24 rng
+    permutes the bench never pays): ONE halo-exchange set per sub-round
+    (the sender-side fused data gather) + ONE coalesced control set
+    (round-7 stacked wire exchange) = exactly 16·(r+1) permutes/phase,
+    the projection engine's new measured input; the legacy A/B path
+    (cfg.wire_coalesced=False) compiles to its 16·(r+3) (wire + score +
+    window sets; the P5 app gather is weight-elided since round 7 —
+    the committed rounds-3..6 artifacts' 16·(r+4) stays as the
+    projection's legacy-artifact fallback only). Still zero all-gathers.
+
+    This is also the pytest mirror of the multichip-dryrun audit
+    (__graft_entry__.dryrun_multichip asserts the same equalities): the
+    trace-time gather tally — what perf.sweep.measure_phase_gather_sets
+    records into the bench fingerprint — must equal what GSPMD emits."""
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-virtual-device CPU harness")
     import os
@@ -115,22 +146,48 @@ def test_phase_step_collective_profile():
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from bench import build_bench
 
+    from go_libp2p_pubsub_tpu.ops import edges
+    from go_libp2p_pubsub_tpu.perf import projection
+
     r = 8
     n = 4096
-    st, step, _, _ = build_bench(n, 64, config="default", rounds_per_phase=r)
-    st = shard_state(st, make_mesh(8), n)
     po = jnp.asarray(np.full((r, 4), -1, np.int32)).at[0, 0].set(3)
     pt = jnp.asarray(np.zeros((r, 4), np.int32))
     pv = jnp.asarray(np.ones((r, 4), bool))
-    compiled = step.lower(st, po, pt, pv, do_heartbeat=True).compile()
-    prof = collective_profile(compiled.as_text())
-    assert prof["all-gather"] == 0, prof
-    assert prof["all-to-all"] == 0, prof
-    # 16 ring offsets x (r data gathers + 4 control head/tail gather-sets)
-    assert 0 < prof["collective-permute"] <= 16 * (r + 4), prof
-    out = compiled(st, po, pt, pv)
-    jax.block_until_ready(out)
-    assert int(out.core.tick) == r
+    restore = _bench_prng()
+    try:
+        st, step, _, _ = build_bench(n, 64, config="default", rounds_per_phase=r)
+        st = shard_state(st, make_mesh(8), n)
+        tally = []
+        with edges.tally_halo_gathers(tally):
+            lowered = step.lower(st, po, pt, pv, do_heartbeat=True)
+        compiled = lowered.compile()
+        prof = collective_profile(compiled.as_text())
+        assert prof["all-gather"] == 0, prof
+        assert prof["all-to-all"] == 0, prof
+        # 16 ring offsets x (r data gathers + 1 coalesced control set)
+        assert prof["collective-permute"] == 16 * (r + 1), prof
+        # the fingerprint's measurement mechanism equals the GSPMD truth
+        assert len(tally) == r + 1, tally
+        assert projection.permutes_per_round(r, len(tally)) * r == \
+            prof["collective-permute"]
+        out = compiled(st, po, pt, pv)
+        jax.block_until_ready(out)
+        assert int(out.core.tick) == r
+
+        # legacy A/B path: wire + score + window control sets
+        st_l, step_l, _, _ = build_bench(
+            n, 64, config="default", rounds_per_phase=r, wire_coalesced=False
+        )
+        st_l = shard_state(st_l, make_mesh(8), n)
+        prof_l = collective_profile(
+            step_l.lower(st_l, po, pt, pv, do_heartbeat=True)
+            .compile().as_text()
+        )
+        assert prof_l["all-gather"] == 0, prof_l
+        assert prof_l["collective-permute"] == 16 * (r + 3), prof_l
+    finally:
+        restore()
 
 
 @pytest.mark.slow
@@ -150,16 +207,20 @@ def test_bench_shape_sharded_step():
     from bench import build_bench
 
     n = 100_000
-    st, step, _, _ = build_bench(n, 64, config="default")
-    st = shard_state(st, make_mesh(8), n)
-    po = jnp.asarray(np.array([3, -1, -1, -1], np.int32))
-    pt = jnp.asarray(np.zeros(4, np.int32))
-    pv = jnp.asarray(np.ones(4, bool))
-    compiled = step.lower(st, po, pt, pv).compile()
-    prof = collective_profile(compiled.as_text())
-    assert prof["all-gather"] == 0, prof
-    assert prof["all-to-all"] == 0, prof
-    assert 0 < prof["collective-permute"] <= 116, prof
-    out = compiled(st, po, pt, pv)
-    jax.block_until_ready(out)
-    assert int(out.core.tick) == 1
+    restore = _bench_prng()
+    try:
+        st, step, _, _ = build_bench(n, 64, config="default")
+        st = shard_state(st, make_mesh(8), n)
+        po = jnp.asarray(np.array([3, -1, -1, -1], np.int32))
+        pt = jnp.asarray(np.zeros(4, np.int32))
+        pv = jnp.asarray(np.ones(4, bool))
+        compiled = step.lower(st, po, pt, pv).compile()
+        prof = collective_profile(compiled.as_text())
+        assert prof["all-gather"] == 0, prof
+        assert prof["all-to-all"] == 0, prof
+        assert 0 < prof["collective-permute"] <= 116, prof
+        out = compiled(st, po, pt, pv)
+        jax.block_until_ready(out)
+        assert int(out.core.tick) == 1
+    finally:
+        restore()

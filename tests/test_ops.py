@@ -271,3 +271,29 @@ def test_keep_lowest_bits_equals_prefix_cap_bits():
                 got = bitset.keep_lowest_bits(words, cap, m)
                 assert np.array_equal(np.asarray(ref), np.asarray(got)), \
                     (shape, m, density, cap)
+
+
+def test_masked_keep_matches_per_plane():
+    """The round-7 stacked recycled-slot clear == per-plane ANDs, for
+    mixed [N,W]/[N,K,W]/[N,V,W] planes, None passthrough, and the
+    single-plane fast path."""
+    from go_libp2p_pubsub_tpu.ops import bitset
+
+    rng = np.random.default_rng(0)
+    n, k, v, w = 5, 3, 2, 4
+    keep = jnp.asarray(rng.integers(0, 2**32, size=(w,), dtype=np.uint32))
+    a = jnp.asarray(rng.integers(0, 2**32, size=(n, w), dtype=np.uint32))
+    b = jnp.asarray(rng.integers(0, 2**32, size=(n, k, w), dtype=np.uint32))
+    c = jnp.asarray(rng.integers(0, 2**32, size=(n, v, w), dtype=np.uint32))
+    got = bitset.masked_keep([a, None, b, c], keep)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(a & keep[None, :]))
+    assert got[1] is None
+    np.testing.assert_array_equal(
+        np.asarray(got[2]), np.asarray(b & keep[None, None, :]))
+    np.testing.assert_array_equal(
+        np.asarray(got[3]), np.asarray(c & keep[None, None, :]))
+    # single live plane takes the direct path
+    (only,) = bitset.masked_keep([b], keep)
+    np.testing.assert_array_equal(
+        np.asarray(only), np.asarray(b & keep[None, None, :]))
+    assert bitset.masked_keep([None, None], keep) == [None, None]

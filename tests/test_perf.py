@@ -152,13 +152,127 @@ def test_projection_refuses_failed_multichip(tmp_path):
 
 
 def test_permute_model_matches_collective_audit():
-    """The ICI term's permute counts are the ones tests/test_collectives.py
-    pins on the virtual mesh: 16·(r+4) per phase."""
+    """The ICI term's LEGACY fallback stays the 16·(r+4) formula the
+    committed rounds-3..6 artifacts were projected with."""
     assert projection.permutes_per_round(8) == pytest.approx(16 * 12 / 8)  # 24
     assert projection.permutes_per_round(16) == pytest.approx(20.0)
     # at r=16 the launch-latency band gives the canonical 0.02-0.10 ms
     assert projection.ici_serialized_ms(16, 1.0) == pytest.approx(0.02)
     assert projection.ici_serialized_ms(16, 5.0) == pytest.approx(0.10)
+
+
+def test_permute_model_measured_sets():
+    """Round 7: a MEASURED gather-set count parameterizes the ICI term —
+    the coalesced engine's r+1 sets replace the hard-coded r+4."""
+    assert projection.permutes_per_round(16, 17) == pytest.approx(17.0)
+    assert projection.permutes_per_round(8, 9) == pytest.approx(18.0)
+    # fewer sets -> strictly cheaper ICI -> strictly higher rate
+    legacy = projection.project(0.172, 16)
+    coalesced = projection.project(0.172, 16, permute_sets_per_phase=17)
+    assert coalesced.central > legacy.central
+    assert coalesced.permute_sets_per_phase == 17
+    with pytest.raises(ValueError, match="permute_sets_per_phase"):
+        projection.permutes_per_round(16, 8)  # fewer sets than sub-rounds
+
+
+def test_projection_uses_fingerprint_permute_sets(tmp_path):
+    """A v2 artifact carrying the measured count must project strictly
+    higher than the same artifact without it (legacy fallback), with the
+    dryrun gate behavior intact — and the control-set count translates
+    across cadences (artifact r=8, projection r=16)."""
+    import json as _json
+
+    with open(os.path.join(ROOT, "BENCH_r05.json")) as f:
+        wrapper = _json.load(f)
+    multi = os.path.join(ROOT, "MULTICHIP_r05.json")
+    legacy = projection.project_from_artifacts(
+        os.path.join(ROOT, "BENCH_r05.json"), multi)
+
+    wrapper["parsed"]["schema"] = 2
+    wrapper["parsed"]["fingerprint"] = {
+        "rounds_per_phase": 8,
+        "n_peers": 100_000,
+        "engine": {"wire_coalesced": True},
+        "permute_sets_per_phase": 9,  # the coalesced r+1 at r=8
+    }
+    p = tmp_path / "BENCH_r07.json"
+    p.write_text(_json.dumps(wrapper))
+    coalesced = projection.project_from_artifacts(str(p), multi)
+    # r=8 artifact -> 1 control set -> 17 sets at the r=16 projection
+    assert coalesced.permute_sets_per_phase == 17
+    assert coalesced.central > legacy.central
+    assert legacy.permute_sets_per_phase is None
+
+    # reader properties
+    rec = artifacts.load_bench_artifact(str(p))
+    assert rec.wire_coalesced is True
+    assert rec.permute_sets_per_phase == 9
+    legacy_rec = artifacts.load_bench_artifact(
+        os.path.join(ROOT, "BENCH_r05.json"))
+    assert legacy_rec.wire_coalesced is None
+    assert legacy_rec.permute_sets_per_phase is None
+
+    # the dryrun gate still guards the measured-input path
+    with pytest.raises(ValueError, match="not ok"):
+        projection.project_from_artifacts(
+            str(p), os.path.join(ROOT, "MULTICHIP_r01.json"))
+
+
+def test_measured_gather_sets_coalesced_vs_legacy():
+    """The fingerprint's trace-time measurement: the coalesced engine
+    traces exactly r+1 halo gather sets, the legacy A/B path r+3 (wire,
+    score, window; the P5 app set is weight-elided on the bench)."""
+    assert sweep.measure_phase_gather_sets(
+        "default", 8, wire_coalesced=True) == 9
+    assert sweep.measure_phase_gather_sets(
+        "default", 8, wire_coalesced=False) == 11
+
+
+def test_fingerprint_records_wire_coalesced_and_permute_sets():
+    fp = sweep.workload_fingerprint("default", 12_500, 64, 16, 16)
+    assert fp["engine"]["wire_coalesced"] is True
+    assert fp["permute_sets_per_phase"] == 17
+    fp = sweep.workload_fingerprint("default", 12_500, 64, 16, 16,
+                                    wire_coalesced=False)
+    assert fp["engine"]["wire_coalesced"] is False
+    assert fp["permute_sets_per_phase"] == 19
+    # per-round cells record the engine switch but no phase permute count
+    fp = sweep.workload_fingerprint("default", 100_000, 64, 1, 1)
+    assert "permute_sets_per_phase" not in fp
+
+
+def test_hlo_kernel_census():
+    """The perf-smoke kernel gate's parser: fusion bodies and reduction
+    regions don't count; bookkeeping ops don't count."""
+    hlo = """\
+HloModule m
+
+%fused_computation.1 (p: u32[8]) -> u32[8] {
+  %p = u32[8]{0} parameter(0)
+  %a = u32[8]{0} and(u32[8]{0} %p, u32[8]{0} %p)
+  ROOT %b = u32[8]{0} or(u32[8]{0} %a, u32[8]{0} %a)
+}
+
+%region_0.2 (x: u32[], y: u32[]) -> u32[] {
+  %x = u32[] parameter(0)
+  %y = u32[] parameter(1)
+  ROOT %o = u32[] or(u32[] %x, u32[] %y)
+}
+
+ENTRY %main (i: u32[8]) -> u32[8] {
+  %i = u32[8]{0} parameter(0)
+  %c = u32[] constant(0)
+  %f = u32[8]{0} fusion(u32[8]{0} %i), kind=kLoop, calls=%fused_computation.1
+  %w = (s32[], u32[8]{0}) while((s32[], u32[8]{0}) %t), condition=%cond, body=%body
+  %r = u32[] reduce(u32[8]{0} %f, u32[] %c), dimensions={0}, to_apply=%region_0.2
+  %bc = u32[8]{0} bitcast(u32[8]{0} %f)
+  ROOT %cp = u32[8]{0} copy(u32[8]{0} %bc)
+}
+"""
+    census = profile.hlo_kernel_census(hlo)
+    # tuple-result kernels (while, multi-output fusions) count too
+    assert census["by_op"] == {"fusion": 1, "while": 1, "reduce": 1, "copy": 1}
+    assert census["total"] == 4
 
 
 def test_projection_input_validation():
@@ -239,7 +353,12 @@ def test_parse_xspace_bytes_synthetic():
     assert got["fusion.7"].self_us_per_round == pytest.approx(0.3)
     assert got["call"].self_us_per_round == pytest.approx(0.2)
     assert got["fusion.7"].category == "fusion"
-    assert "fusion.7" in profile.format_table(table)
+    # the round-7 launch-count summary: 2 executed kernels over 2 rounds
+    assert table.n_kernels_per_round == pytest.approx(1.0)
+    assert table.kernels_by_category == {"fusion": 0.5, "call": 0.5}
+    txt = profile.format_table(table)
+    assert "fusion.7" in txt
+    assert "kernels/round" in txt
 
 
 @pytest.mark.slow

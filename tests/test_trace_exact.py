@@ -448,3 +448,44 @@ def test_api_network_phase_trace_and_tags():
                for e in ev.get(T.SEND_RPC, []))
     # connmgr tags bumped by phase-boundary first deliveries
     assert net.tag_tracer.cm.tags.sum() > 0
+
+
+def test_ambiguous_recycled_slot_dup_carries_flag():
+    """Round-7 (ADVICE round-5 item 4): at phase cadence, a duplicate on
+    a slot recycled WITHIN the observed phase resolves to the
+    end-of-phase mid as before, but the event now says so —
+    ``duplicateMessage.ambiguousMid`` is set exactly when the slot's
+    previous occupant was a different message (a freshly-used slot stays
+    unflagged)."""
+    n, d, m = 8, 2, 64
+    topo = graph.random_connect(n, d, seed=1)
+    subs = graph.subscribe_random(n, n_topics=1, topics_per_peer=1, seed=1)
+    net = Net.build(topo, subs)
+    sink = MemSink()
+    sess = drain.TraceSession(net, [sink], exact=True)
+
+    w = (m + 31) // 32
+    dup = np.zeros((n, net.max_degree, w), np.uint32)
+    dup[0, 0, 0] = (1 << 2) | (1 << 3)  # dup arrivals on slots 2 and 3
+    mk = lambda tick: drain.Snapshot(
+        tick=tick, cursor=0,
+        msg_topic=np.zeros(m, np.int32), msg_origin=np.zeros(m, np.int32),
+        msg_valid=np.ones(m, bool), msg_ignored=np.zeros(m, bool),
+        first_round=np.full((n, m), -1, np.int32),
+        first_edge=np.full((n, m), -1, np.int8),
+        events=np.zeros(32, np.int64),
+        dup_trans=None,
+    )
+    prev, new = mk(0), mk(4)  # phase cadence: r = 4
+    new.dup_trans = dup
+    # slot 2: recycled this phase over an OLD occupant -> ambiguous;
+    # slot 3: first-ever use this phase -> not ambiguous
+    sess.slot_mid = {2: b"new-mid", 3: b"fresh-mid"}
+    prev_slot_mid = {2: b"old-mid"}
+    sess._observe_exact(prev, new, 0, {}, {}, prev_slot_mid,
+                        published_slots={2, 3})
+    dups = {ev.duplicateMessage.messageID: ev.duplicateMessage
+            for ev in sink.events if ev.type == T.DUPLICATE_MESSAGE}
+    assert set(dups) == {b"new-mid", b"fresh-mid"}
+    assert dups[b"new-mid"].ambiguousMid is True
+    assert dups[b"fresh-mid"].ambiguousMid is False
