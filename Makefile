@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -25,6 +25,16 @@ audit:
 perf-smoke:
 	python -m go_libp2p_pubsub_tpu.perf.regress
 
+# chaos-plane recovery gate (scripts/chaos_report.py --smoke): under
+# i.i.d. link-flap loss gossipsub's delivery ratio must exceed
+# floodsub's (IWANT-recovery share reported); after a 2-group partition
+# heals, mesh-repair latency must be finite and partition-era messages
+# must fully deliver; and the CHAOS-OFF compiled HLO kernel census must
+# EQUAL the committed PERF_SMOKE.json baseline (the elision-when-off
+# contract). ~30 s warm on CPU. docs/DESIGN.md §8.
+chaos-smoke:
+	python scripts/chaos_report.py --smoke
+
 # declarative (config x N x r) sweep — e.g. the eth2 shard table:
 #   make sweep SWEEP_ARGS='--config eth2 --n 12500,25000,50000 --r 16'
 sweep:
@@ -34,10 +44,12 @@ test:
 	python -m pytest tests/ -q
 
 # quick tier: the sub-10-minute CI gate — `not slow` tests plus the CPU
-# perf-smoke regression gate (fast once the compile cache is warm)
+# perf-smoke regression gate and the chaos-smoke recovery gate (both
+# fast once the compile cache is warm)
 quick:
 	python -m pytest tests/ -q -m "not slow"
 	python -m go_libp2p_pubsub_tpu.perf.regress
+	python scripts/chaos_report.py --smoke
 
 native:
 	$(MAKE) -C native

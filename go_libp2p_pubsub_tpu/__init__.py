@@ -26,6 +26,8 @@ Layout:
   ops       — kernel building blocks: packed bitsets, masked top-k,
               random-k selection, segment counts
   score     — batched peer-score engine + peer gater + promise tracking
+  chaos     — link-fault injection (iid / Gilbert–Elliott flap
+              generators, partition/heal scenarios) + recovery metrics
   trace     — trace event schema (trace.pb-compatible) + host drain
   parallel  — device-mesh sharding of the peer axis
   oracle    — scalar pure-Python reference node used as the golden oracle

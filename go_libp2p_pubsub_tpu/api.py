@@ -1482,12 +1482,42 @@ class Network:
             self.tag_tracer.observe(prev, new)
         self._drain_deliveries(prev, new)
 
-    def run(self, rounds: int = 1) -> None:
+    def run(self, rounds: int = 1, checkpoint_every: int | None = None,
+            checkpoint_path: str | None = None) -> None:
         """Advance the simulation; distributes queued publishes over the
         first rounds (pub_width per round) and drains deliveries into
-        subscriptions after each round."""
+        subscriptions after each round.
+
+        ``checkpoint_every=k, checkpoint_path=p`` auto-snapshots the
+        DEVICE state through the npz checkpoint backend every k simulated
+        rounds (atomically overwriting ``p``), so long soaks — chaos
+        runs especially — are resumable after a host crash:
+        ``load_checkpoint(p)`` on an identically-built Network restores
+        the snapshot, and the resumed run continues the exact PRNG —
+        and therefore the exact chaos fault — stream (the generators are
+        functions of (key, tick), both in the snapshot; a GE chain's
+        state plane rides the pytree). In phase mode the snapshot
+        cadence quantizes up to phase boundaries. Host-side observation
+        state (subscription queues, trace sessions, message-id maps) is
+        NOT in the snapshot — resume on a freshly built Network."""
+        # argument validation precedes start(): a bad call must not have
+        # the irreversible side effect of compiling/freezing the topology
+        if (checkpoint_every is None) != (checkpoint_path is None):
+            raise APIError(
+                "checkpoint_every and checkpoint_path must be passed "
+                "together"
+            )
+        if checkpoint_every is not None and checkpoint_every < 1:
+            raise APIError("checkpoint_every must be >= 1")
         if not self.started:
             self.start()
+        if checkpoint_every is not None and not hasattr(self, "_last_ckpt_tick"):
+            # cadence anchors at this run()'s entry tick; later runs (and
+            # a load_checkpoint) keep the anchor so snapshots land every
+            # k simulated rounds across run() calls
+            self._last_ckpt_tick = int(
+                getattr(self.state, "core", self.state).tick
+            )
         jnp = self._jnp
         # per-run validation throttle budgets (the reference's are
         # steady-state queue depths; one run() is our quantum)
@@ -1503,6 +1533,7 @@ class Network:
                 )
             for _ in range(rounds // r):
                 self._run_phase()
+                self._maybe_checkpoint(checkpoint_every, checkpoint_path)
             return
 
         for _ in range(rounds):
@@ -1546,6 +1577,7 @@ class Network:
             if self.px_connect:
                 self._px_connect_pass()
             self._process_announces()
+            self._maybe_checkpoint(checkpoint_every, checkpoint_path)
 
             # slow-heartbeat warning (gossipsub.go:133-135,1305-1312): a
             # real-time co-simulation can't keep up when a tick's wall
@@ -1647,6 +1679,57 @@ class Network:
         if self.px_connect:
             self._px_connect_pass()
         self._process_announces()
+
+    def _maybe_checkpoint(self, every: int | None, path: str | None) -> None:
+        """Auto-snapshot support for run(): save when >= ``every`` rounds
+        of simulated time have passed since the last snapshot (phase mode
+        quantizes the cadence up to phase boundaries)."""
+        if every is None:
+            return
+        tick = int(getattr(self.state, "core", self.state).tick)
+        last = getattr(self, "_last_ckpt_tick", None)
+        if last is not None and tick - last < every:
+            return
+        self.save_checkpoint(path)
+        self._last_ckpt_tick = tick
+
+    def save_checkpoint(self, path: str) -> str:
+        """Snapshot the device state through the npz checkpoint backend,
+        atomically (tmp + rename — a host crash mid-write never corrupts
+        the previous snapshot). Returns the final path."""
+        from . import checkpoint as _ckpt
+
+        if not self.started:
+            raise APIError("save_checkpoint before start(): no device state")
+        final = path if str(path).endswith(".npz") else str(path) + ".npz"
+        tmp = str(final) + ".tmp.npz"
+        _ckpt.save(tmp, self.state)
+        import os as _os
+
+        _os.replace(tmp, final)
+        return final
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a snapshot taken by ``save_checkpoint`` / the
+        ``run(checkpoint_every=...)`` auto-snapshots into THIS network's
+        compiled state (the current state is the restore template, so
+        the network must be built and started with the same configs and
+        topology — mismatches raise with the offending pytree paths).
+
+        Only the device state is restored: the PRNG key and tick come
+        with it, so the continued run replays the exact random — and
+        chaos-fault — stream of an uninterrupted one. Host-side message
+        bodies and trace sessions are not part of the snapshot; restore
+        into a fresh Network when those matter."""
+        from . import checkpoint as _ckpt
+
+        if not self.started:
+            raise APIError("load_checkpoint before start(): build the "
+                           "template state first")
+        self.state = _ckpt.restore(path, self.state)
+        self._last_ckpt_tick = int(
+            getattr(self.state, "core", self.state).tick
+        )
 
     def _blacklisted(self, node: Node) -> bool:
         pid = node.identity.peer_id

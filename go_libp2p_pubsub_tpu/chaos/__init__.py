@@ -1,0 +1,31 @@
+"""Chaos plane: vectorized link-fault injection, partition/heal
+scenarios, and measured recovery (docs/DESIGN.md §8).
+
+  faults    — ChaosConfig + the i.i.d. / Gilbert–Elliott link-flap
+              generators (symmetric per-link masks drawn from the sim
+              PRNG stream; checkpoint-exact resume)
+  scenario  — declarative partition + crash-storm schedules compiled
+              to per-round/per-phase mask arguments
+  metrics   — recovery metrics: delivery ratio under loss, IWANT-
+              recovery share, mesh-repair latency, time-to-recover
+
+The runner lives in scripts/chaos_report.py (``make chaos-smoke``).
+"""
+
+from .faults import ChaosConfig, ChaosConfigError, resolve  # noqa: F401
+from .metrics import (  # noqa: F401
+    DeliveryStats,
+    cross_group_mesh_count,
+    delivery_stats,
+    iwant_recovery_share,
+    links_down_total,
+    mesh_repair_latency,
+    time_to_recover,
+)
+from .scenario import (  # noqa: F401
+    CrashStorm,
+    Partition,
+    Scenario,
+    halves,
+    two_group_partition,
+)

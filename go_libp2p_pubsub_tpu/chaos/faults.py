@@ -1,0 +1,253 @@
+"""Vectorized link-fault injection (the chaos plane's generators).
+
+GossipSub exists to stay reliable on unreliable networks — the IHAVE/
+IWANT lazy-gossip machinery recovers eagerly-lost messages and the mesh
+self-heals after failure — yet the simulator's wire was perfectly
+lossless outside queue-cap overflow. This module supplies the missing
+network faults as batched array programs:
+
+  * **link flaps** — a per-link per-round outage mask applied once at
+    the receiver gather on the edge involution. TCP semantics: the
+    WHOLE link (data plane + control head, both directions) drops for
+    the round — a link is a connection, not a per-message lottery; the
+    reference's transport either delivers an RPC or the connection
+    stalls for the whole exchange.
+  * **generators** — i.i.d. (each link down with prob ``loss_rate``
+    per round, memoryless) and Gilbert–Elliott (a two-state good/bad
+    Markov chain per link: ``ge_p_down`` good→bad, ``ge_p_up``
+    bad→good; the bad state is a full outage — bursty, correlated
+    loss, the degraded-network shape the v1.1 evaluation methodology
+    (arxiv 2007.02754) is built on).
+  * **schedules** — ``scheduled=True`` steps additionally take a
+    ``link_deny [N, K]`` bool argument (True = forced down), the
+    hook the Scenario compiler (chaos/scenario.py) feeds partition/
+    heal windows through.
+
+Randomness: masks are pure functions of (sim PRNG key, tick) — a
+counter-mode integer hash over the **canonical undirected link id**
+(min(i, j), max(i, j)) seeded from ``jax.random.key_data(fold_in(key,
+CHAOS_TAG))``. Consequences, all deliberate:
+
+  * **symmetric by construction**: both directions of a link compute
+    the same (lo, hi, tick) input, so the whole link drops — no extra
+    cross-peer gather to symmetrize (the mask adds ZERO halo permutes
+    to the sharded step; the projection's permute budget is unchanged
+    even with chaos on).
+  * **checkpoint-exact resume**: the key and tick are both in every
+    checkpoint, so a restored run reproduces the exact fault sequence
+    — the i.i.d. generator needs no device state at all, and the
+    Gilbert–Elliott chain's only state is its [N, K] bad plane
+    (state.ChaosState, carried in SimState and checkpointed).
+
+Static elision contract: a build whose ``ChaosConfig`` is ``None`` (or
+``enabled`` is False) traces exactly the code it traced before the
+chaos plane existed — no masks, no counters, no extra ops. Pinned by
+tests/test_chaos.py (bit-exact state trees) and ``make chaos-smoke``
+(compiled HLO kernel census vs the committed PERF_SMOKE baseline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+#: fold_in tag deriving the chaos seed from the sim PRNG key — distinct
+#: from the gater (0x6A7E) and fanout (0xFA40) subsystem tags
+CHAOS_TAG = 0xC4A05
+
+_C1 = 0x85EBCA6B
+_C2 = 0xC2B2AE35
+_GOLD = 0x9E3779B9
+
+
+class ChaosConfigError(ValueError):
+    """Raised by ChaosConfig.validate() on invalid parameters."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Static (build-time) chaos-plane configuration.
+
+    ``generator`` selects the random fault process:
+      * ``"iid"`` — each live link is down with prob ``loss_rate``
+        each round, independently (memoryless flaps);
+      * ``"ge"`` — Gilbert–Elliott: per-link two-state chain, good→bad
+        with ``ge_p_down`` and bad→good with ``ge_p_up`` per round;
+        a bad link is fully down (bursty outages whose mean burst
+        length is 1/ge_p_up rounds).
+
+    ``scheduled=True`` makes the built step take an extra trailing
+    ``link_deny [N, K]`` bool argument (True = link forced down this
+    round/phase) — the Scenario partition/heal hook. It composes with
+    either generator (deny OR generator-down drops the link).
+    """
+
+    generator: str = "iid"
+    loss_rate: float = 0.0
+    ge_p_down: float = 0.0
+    ge_p_up: float = 0.25
+    scheduled: bool = False
+
+    def validate(self) -> None:
+        if self.generator not in ("iid", "ge"):
+            raise ChaosConfigError(
+                f"unknown chaos generator {self.generator!r}; "
+                "expected 'iid' or 'ge'"
+            )
+        for name in ("loss_rate", "ge_p_down", "ge_p_up"):
+            v = getattr(self, name)
+            if not (0.0 <= v <= 1.0):
+                raise ChaosConfigError(f"{name} must be in [0, 1], got {v}")
+        if self.generator == "ge" and self.ge_p_down > 0 and self.ge_p_up <= 0:
+            raise ChaosConfigError(
+                "ge_p_up must be > 0 when ge_p_down > 0 (links would "
+                "never recover)"
+            )
+
+    @property
+    def generator_enabled(self) -> bool:
+        if self.generator == "ge":
+            return self.ge_p_down > 0.0
+        return self.loss_rate > 0.0
+
+    @property
+    def enabled(self) -> bool:
+        """False ⇒ the build elides the chaos plane entirely."""
+        return self.generator_enabled or self.scheduled
+
+    @property
+    def needs_state(self) -> bool:
+        """The Gilbert–Elliott chain carries a per-link [N, K] bad
+        plane in the state (state.ChaosState); i.i.d. and pure-schedule
+        chaos are stateless."""
+        return self.generator == "ge" and self.generator_enabled
+
+    def fingerprint(self) -> dict:
+        """The schema-v2 artifact self-description of this generator
+        (perf/artifacts.py chaos block; scenario hash added by the
+        runner)."""
+        fp = {"generator": self.generator if self.generator_enabled else "off",
+              "loss_rate": float(self.loss_rate),
+              "scheduled": bool(self.scheduled)}
+        if self.generator == "ge" and self.generator_enabled:
+            fp["ge_p_down"] = float(self.ge_p_down)
+            fp["ge_p_up"] = float(self.ge_p_up)
+        return fp
+
+
+def resolve(chaos: ChaosConfig | None) -> ChaosConfig | None:
+    """Normalize a config to None when the plane is off (the single
+    elision decision every engine shares). Validation runs FIRST — a
+    typo'd generator name must raise, not silently elide the plane and
+    run the experiment on a lossless wire."""
+    if chaos is None:
+        return None
+    chaos.validate()
+    return chaos if chaos.enabled else None
+
+
+# ---------------------------------------------------------------------------
+# counter-mode hash (murmur3 finalizer composition, uint32 wraparound)
+
+
+def _mix(h: jax.Array) -> jax.Array:
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(_C1)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(_C2)
+    h = h ^ (h >> 16)
+    return h
+
+
+def chaos_seed(key: jax.Array) -> jax.Array:
+    """Scalar u32 seed from the sim PRNG key (works under both threefry
+    and unsafe_rbg key layouts; traced-safe)."""
+    kd = jax.random.key_data(jax.random.fold_in(key, CHAOS_TAG))
+    kd = kd.astype(jnp.uint32).reshape(-1)
+    s = jnp.uint32(_GOLD)
+    for i in range(kd.shape[0]):  # static, tiny (2 or 4 words)
+        s = _mix(s ^ kd[i])
+    return s
+
+
+def link_uniform(seed: jax.Array, nbr: jax.Array, tick, salt: int) -> jax.Array:
+    """[N, K] u32 per-LINK uniform draw for one round: both directions
+    of an edge hash the same canonical (lo, hi) endpoint pair, so the
+    result is symmetric over the edge involution by construction —
+    no cross-peer gather needed. ``salt`` separates the independent
+    streams (iid vs the two GE transition draws)."""
+    n = nbr.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)[:, None]
+    j = jnp.clip(nbr, 0)
+    lo = jnp.minimum(i, j).astype(jnp.uint32)
+    hi = jnp.maximum(i, j).astype(jnp.uint32)
+    h = _mix(seed ^ jnp.uint32(salt))
+    h = h ^ (jnp.asarray(tick).astype(jnp.uint32) * jnp.uint32(_GOLD))
+    u = _mix(h ^ (lo * jnp.uint32(_C1)))
+    u = _mix(u ^ (hi * jnp.uint32(_C2)))
+    return u
+
+
+def _threshold(p: float) -> jnp.uint32:
+    """u32 compare threshold for P(u < t) == p (clamped)."""
+    return jnp.uint32(min(int(round(p * 4294967296.0)), 0xFFFFFFFF))
+
+
+def iid_link_down(seed, nbr, tick, loss_rate: float) -> jax.Array:
+    """[N, K] bool: link down this round under the i.i.d. generator."""
+    return link_uniform(seed, nbr, tick, salt=0x11D) < _threshold(loss_rate)
+
+
+def ge_advance(seed, nbr, tick, bad: jax.Array,
+               p_down: float, p_up: float) -> jax.Array:
+    """One Gilbert–Elliott transition for every link: returns the new
+    [N, K] bad plane (symmetric whenever ``bad`` is — transitions use
+    symmetric per-link draws)."""
+    go_down = link_uniform(seed, nbr, tick, salt=0x6E0D) < _threshold(p_down)
+    go_up = link_uniform(seed, nbr, tick, salt=0x75E1) < _threshold(p_up)
+    return jnp.where(bad, ~go_up, go_down)
+
+
+def round_link_ok(chaos: ChaosConfig, seed, nbr, tick,
+                  ge_bad: jax.Array | None,
+                  link_deny: jax.Array | None):
+    """The per-round link mask: ``(link_ok [N, K] bool, ge_bad')``.
+
+    ``link_ok`` is True where the link carries traffic this round;
+    callers AND it into the receiver-side gather masks (data plane and
+    control head — TCP semantics: the whole link drops). ``ge_bad'``
+    is the advanced chain state (unchanged input for non-GE
+    generators). The composition order is deny ∨ generator-down."""
+    down = None
+    if chaos.generator == "ge" and chaos.generator_enabled:
+        assert ge_bad is not None, (
+            "GE chaos needs ChaosState in the sim state — build it with "
+            "SimState.init(..., chaos_ge=True) (GossipSubState.init does "
+            "this from cfg.chaos)"
+        )
+        ge_bad = ge_advance(seed, nbr, tick, ge_bad,
+                            chaos.ge_p_down, chaos.ge_p_up)
+        down = ge_bad
+    elif chaos.generator_enabled:
+        down = iid_link_down(seed, nbr, tick, chaos.loss_rate)
+    if link_deny is not None:
+        deny = jnp.asarray(link_deny, bool)
+        down = deny if down is None else (down | deny)
+    if down is None:
+        # scheduled build driven with link_deny=None this round
+        link_ok = jnp.ones(nbr.shape, bool)
+    else:
+        link_ok = ~down
+    return link_ok, ge_bad
+
+
+def count_links_down(nbr: jax.Array, nbr_ok: jax.Array,
+                     link_ok: jax.Array) -> jax.Array:
+    """i32 scalar: UNDIRECTED live links down this round (each link
+    counted once, at its lower-id endpoint) — the LINK_DOWN counter."""
+    n = nbr.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)[:, None]
+    und = nbr_ok & ~link_ok & (i < nbr)
+    return jnp.sum(und.astype(jnp.int32))

@@ -31,7 +31,15 @@ import numpy as np
 #     transmit block; present only in states built with wire_block=True —
 #     leaf count differs between the two modes, so the restore template
 #     must be built with the same setting)
-_FORMAT_VERSION = 5
+# v6: chaos plane — SimState optionally carries `chaos.ge_bad` [N,K] bool
+#     (the Gilbert–Elliott link-fault chain; present only in states built
+#     with chaos_ge=True / a ChaosConfig whose needs_state is True, same
+#     leaf-count contract as wire_block), and the event-counter vector
+#     grew the LINK_DOWN / IWANT_RECOVER chaos counters (13 -> 15
+#     entries). i.i.d./scheduled chaos adds NO state: fault masks are
+#     functions of (key, tick), both checkpointed since v1, so a restored
+#     run resumes the exact fault sequence.
+_FORMAT_VERSION = 6
 
 
 def _is_key(leaf) -> bool:
@@ -52,11 +60,21 @@ def save(path: str, state) -> None:
     np.savez_compressed(path, **out)
 
 
+def _leaf_paths(template) -> list[str]:
+    """Human-readable pytree path per template leaf (keystr form, e.g.
+    ``.core.dlv.fe_words``) — mismatch errors name the offending FIELD,
+    not just a flat leaf index, so "leaf 7 differs" becomes actionable
+    ("you built the template without the validation pipeline")."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(template)
+    return [jax.tree_util.keystr(path) or "<root>" for path, _ in flat]
+
+
 def restore(path: str, template):
     """Rebuild a state pytree from `path` using `template`'s structure.
 
     The template provides the treedef (and expected shapes/dtypes); its
-    array values are ignored. Raises ValueError on any mismatch.
+    array values are ignored. Raises ValueError on any mismatch; the
+    message carries the PYTREE PATHS of every mismatching leaf.
     """
     with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as data:
         if "__version__" not in data.files or "__n_leaves__" not in data.files:
@@ -68,50 +86,71 @@ def restore(path: str, template):
                     f"checkpoint format v{version} predates the current "
                     f"v{_FORMAT_VERSION} (state leaves changed shape/"
                     "meaning — see the version history at the top of "
-                    "checkpoint.py); re-create the checkpoint from source "
-                    "state — no migration path is provided"
+                    "checkpoint.py; v6 grew the event-counter vector with "
+                    "the chaos-plane counters and added the optional "
+                    "Gilbert–Elliott generator state); re-create the "
+                    "checkpoint from source state — no migration path is "
+                    "provided"
                 )
             raise ValueError(
                 f"checkpoint format v{version} is newer than this build's "
                 f"v{_FORMAT_VERSION}"
             )
         t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        paths = _leaf_paths(template)
         n = int(data["__n_leaves__"])
         if n != len(t_leaves):
             raise ValueError(
                 f"checkpoint has {n} leaves, template has {len(t_leaves)} "
-                "(different configs/topology?)"
+                "(different configs/topology? optional planes — chaos_ge / "
+                "wire_block / the validation pipeline — change the leaf "
+                f"count); template leaves: {', '.join(paths)}"
             )
         leaves = []
+        errors = []
         for i, tmpl in enumerate(t_leaves):
             arr = data[f"leaf_{i}"]
+            where = f"{paths[i]} (leaf {i})"
             if f"leaf_{i}__is_key" in data.files:
                 if not _is_key(tmpl):
-                    raise ValueError(
-                        f"leaf {i}: checkpoint holds a PRNG key, template does not"
+                    errors.append(
+                        f"{where}: checkpoint holds a PRNG key, template "
+                        "does not"
                     )
+                    continue
                 want = tuple(np.asarray(jax.random.key_data(tmpl)).shape)
                 if tuple(arr.shape) != want:
-                    raise ValueError(
-                        f"leaf {i}: key data shape {tuple(arr.shape)} != "
+                    errors.append(
+                        f"{where}: key data shape {tuple(arr.shape)} != "
                         f"template {want}"
                     )
+                    continue
                 leaf = jax.random.wrap_key_data(jnp.asarray(arr))
             else:
                 if _is_key(tmpl):
-                    raise ValueError(
-                        f"leaf {i}: template expects a PRNG key, checkpoint "
+                    errors.append(
+                        f"{where}: template expects a PRNG key, checkpoint "
                         "holds a plain array"
                     )
+                    continue
                 leaf = jnp.asarray(arr)
                 if tuple(tmpl.shape) != tuple(leaf.shape):
-                    raise ValueError(
-                        f"leaf {i}: shape {tuple(leaf.shape)} != template "
+                    errors.append(
+                        f"{where}: shape {tuple(leaf.shape)} != template "
                         f"{tuple(tmpl.shape)}"
                     )
+                    continue
                 if tmpl.dtype != leaf.dtype:
-                    raise ValueError(f"leaf {i}: dtype {leaf.dtype} != {tmpl.dtype}")
+                    errors.append(
+                        f"{where}: dtype {leaf.dtype} != {tmpl.dtype}"
+                    )
+                    continue
             leaves.append(leaf)
+        if errors:
+            raise ValueError(
+                "checkpoint/template mismatch at "
+                f"{len(errors)} leaf path(s): " + "; ".join(errors)
+            )
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -138,22 +177,33 @@ def restore_orbax(path: str, template):
     ckptr = ocp.PyTreeCheckpointer()
     raw = ckptr.restore(path, item=jax.tree.map(unkey, template))
     t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    paths = _leaf_paths(template)
     r_leaves = jax.tree_util.tree_leaves(raw)
     if len(r_leaves) != len(t_leaves):
         raise ValueError(
             f"checkpoint has {len(r_leaves)} leaves, template has "
-            f"{len(t_leaves)} (different configs/topology?)"
+            f"{len(t_leaves)} (different configs/topology?); template "
+            f"leaves: {', '.join(paths)}"
         )
     out = []
+    errors = []
     for i, (tmpl, leaf) in enumerate(zip(t_leaves, r_leaves)):
         leaf = jnp.asarray(leaf)
         want = jax.random.key_data(tmpl) if _is_key(tmpl) else tmpl
+        where = f"{paths[i]} (leaf {i})"
         if tuple(want.shape) != tuple(leaf.shape):
-            raise ValueError(
-                f"leaf {i}: shape {tuple(leaf.shape)} != template "
+            errors.append(
+                f"{where}: shape {tuple(leaf.shape)} != template "
                 f"{tuple(want.shape)}"
             )
+            continue
         if want.dtype != leaf.dtype:
-            raise ValueError(f"leaf {i}: dtype {leaf.dtype} != {want.dtype}")
+            errors.append(f"{where}: dtype {leaf.dtype} != {want.dtype}")
+            continue
         out.append(jax.random.wrap_key_data(leaf) if _is_key(tmpl) else leaf)
+    if errors:
+        raise ValueError(
+            "checkpoint/template mismatch at "
+            f"{len(errors)} leaf path(s): " + "; ".join(errors)
+        )
     return jax.tree_util.tree_unflatten(treedef, out)

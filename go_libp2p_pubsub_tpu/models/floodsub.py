@@ -16,7 +16,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..chaos import faults as chaos_faults
 from ..state import Net, SimState, allocate_publishes
+from ..trace.events import EV
 from .common import accumulate_round_events, delivery_round, subscribed_msg_words
 
 
@@ -29,7 +31,7 @@ def flood_edge_mask(net: Net, msgs) -> jax.Array:
 
 
 @functools.partial(jax.jit, donate_argnums=1,
-                   static_argnames=("queue_cap", "stacked"))
+                   static_argnames=("queue_cap", "stacked", "chaos"))
 def floodsub_step(
     net: Net,
     state: SimState,
@@ -40,6 +42,10 @@ def floodsub_step(
                             # floodsub's own drop is floodsub.go:91-98)
     stacked: bool = True,   # stacked recycled-slot clears (round-7;
                             # False = legacy per-plane kernels for A/B)
+    chaos=None,             # ChaosConfig | None — link-fault injection
+                            # (chaos/faults.py); None/off elides statically
+    link_deny: jax.Array | None = None,  # [N,K] bool scheduled outages
+                            # (ChaosConfig.scheduled scenarios)
 ) -> SimState:
     """One synchronous round: deliver in-flight messages one hop, then
     intern this round's publishes (they start propagating next round).
@@ -48,8 +54,19 @@ def floodsub_step(
     BELOW the router in the reference, so they apply here exactly as in
     gossipsub: build the state with ``SimState.init(val_delay=...)`` for
     the pipeline (its presence in ``state.dlv.pending`` is the
-    configuration), pass ``queue_cap`` for lossy backpressure."""
+    configuration), pass ``queue_cap`` for lossy backpressure. The chaos
+    plane likewise sits below every router: the same generators that
+    flap gossipsub links flap floodsub's (a GE-generator config needs
+    ``SimState.init(chaos_ge=True)``)."""
+    chaos = chaos_faults.resolve(chaos)
     edge_mask = flood_edge_mask(net, state.msgs)
+    if chaos is not None:
+        ge_bad = state.chaos.ge_bad if state.chaos is not None else None
+        link_ok, ge_bad_next = chaos_faults.round_link_ok(
+            chaos, chaos_faults.chaos_seed(state.key), net.nbr, state.tick,
+            ge_bad, link_deny,
+        )
+        edge_mask = jnp.where(link_ok[:, :, None], edge_mask, jnp.uint32(0))
     dlv, info = delivery_round(net, state.msgs, state.dlv, edge_mask, state.tick,
                                queue_cap=queue_cap)
 
@@ -58,6 +75,12 @@ def floodsub_step(
         stacked_clears=stacked,
     )
     events = accumulate_round_events(state.events, info, jnp.sum(is_pub.astype(jnp.int32)))
+    if chaos is not None:
+        events = events.at[EV.LINK_DOWN].add(
+            chaos_faults.count_links_down(net.nbr, net.nbr_ok, link_ok)
+        )
+        if chaos.needs_state:
+            state = state.replace(chaos=state.chaos.replace(ge_bad=ge_bad_next))
 
     return state.replace(tick=state.tick + 1, msgs=msgs, dlv=dlv, events=events)
 

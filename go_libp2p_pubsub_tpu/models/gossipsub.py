@@ -44,6 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..chaos import faults as chaos_faults
+from ..chaos.faults import ChaosConfig
 from ..config import (
     GossipSubParams,
     PeerScoreParams,
@@ -165,6 +167,14 @@ class GossipSubConfig:
     # (engine.wire_coalesced) and the measured permute_sets_per_phase.
     # Bit-identical either way (tests/test_phase_stacked.py).
     wire_coalesced: bool = True
+    # chaos plane (chaos/faults.py): link-fault injection — i.i.d. or
+    # Gilbert–Elliott flap generators drawn from the sim PRNG stream,
+    # plus (scheduled=True) a per-round link_deny argument fed by the
+    # Scenario partition compiler. None (or an all-zero config) elides
+    # the plane STATICALLY: the traced program is identical to a build
+    # without it (bit-exactness + the PERF_SMOKE kernel census pinned
+    # by tests/test_chaos.py and `make chaos-smoke`)
+    chaos: "ChaosConfig | None" = None
     # exact per-event tracing support (trace.go:166-194, 341-414): the
     # step additionally records this round's duplicate-arrival plane
     # ([N,K,W] — arrivals beyond the first per (peer,msg)) in
@@ -195,6 +205,7 @@ class GossipSubConfig:
         queue_cap: int = 0,
         trace_exact: bool = False,
         wire_coalesced: bool = True,
+        chaos: "ChaosConfig | None" = None,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
@@ -241,8 +252,11 @@ class GossipSubConfig:
             queue_cap=queue_cap,
             trace_exact=trace_exact,
             wire_coalesced=wire_coalesced,
+            chaos=chaos,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
+        if chaos is not None:
+            chaos.validate()
         if thresholds is not None:
             thresholds.validate()
             kw.update(
@@ -365,7 +379,9 @@ class GossipSubState:
         return cls(
             core=SimState.init(n, msg_slots, seed, k=k,
                                val_delay=cfg.validation_delay_rounds,
-                               wire_block=wire_block),
+                               wire_block=wire_block,
+                               chaos_ge=(cfg.chaos is not None
+                                         and cfg.chaos.needs_state)),
             mesh=jnp.zeros((n, s, k), bool),
             backoff_expire=jnp.zeros((n, s, k), jnp.int32),
             backoff_present=jnp.zeros((n, s, k), bool),
@@ -1773,6 +1789,12 @@ def make_gossipsub_step(
     # keeps the XLA path (pending stages live outside the kernel).
     from .common import USE_PALLAS as _old_pallas
 
+    # chaos plane (chaos/faults.py): None elides it statically — every
+    # chaos branch below disappears from the trace and the program is
+    # the pre-chaos one, bit for bit (tests/test_chaos.py)
+    chaos = chaos_faults.resolve(cfg.chaos)
+    chaos_sched = chaos is not None and chaos.scheduled
+
     fused_env = os.environ.get("PUBSUB_FUSED", "")
     fused_eligible = (
         net.band_off is not None
@@ -1780,6 +1802,7 @@ def make_gossipsub_step(
         and cfg.validation_delay_rounds == 0
         and cfg.queue_cap == 0
         and not _old_pallas
+        and chaos is None  # the fused halo kernel predates the chaos plane
     )
     fused_interp = jax.default_backend() != "tpu"
     use_fused = fused_eligible and fused_env == "1"
@@ -1792,7 +1815,8 @@ def make_gossipsub_step(
     )
 
     def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
-               do_heartbeat: bool = True) -> GossipSubState:
+               do_heartbeat: bool = True,
+               link_deny=None) -> GossipSubState:
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
         if dynamic_peers:
             st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
@@ -1808,6 +1832,27 @@ def make_gossipsub_step(
 
         acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
                                        core.key, tick)
+
+        # ---- chaos plane: this round's link outages ---------------------
+        # TCP-flap semantics — the WHOLE link (control head + data, both
+        # directions) drops for the round, with no endpoint state cleanup
+        # (the peers don't learn the link flapped; outboxes written into
+        # the outage are genuinely lost, which is exactly the loss the
+        # IHAVE/IWANT machinery exists to recover). net_w is the wire
+        # view: the one-round-masked net_l every receiver gather uses.
+        if chaos is not None:
+            ge_bad0 = core.chaos.ge_bad if core.chaos is not None else None
+            link_ok, ge_bad_next = chaos_faults.round_link_ok(
+                chaos, chaos_faults.chaos_seed(core.key), net.nbr, tick,
+                ge_bad0, link_deny,
+            )
+            net_w = net_l.replace(nbr_ok=net_l.nbr_ok & link_ok)
+            # data-plane gate: acc_msg feeds gossip_edge_mask and the
+            # IWANT-response mask — one AND covers every data transmit
+            acc_msg = acc_msg & link_ok
+        else:
+            link_ok = ge_bad_next = None
+            net_w = net_l
 
         # 0b. merged wire exchange: every per-edge outbox crosses the edge
         # involution in ONE gather. Separate gathers each pay a fixed
@@ -1843,7 +1888,7 @@ def make_gossipsub_step(
             )
         else:
             (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
-             nbr_score_of_me) = control_exchange(cfg, net, net_l, st)
+             nbr_score_of_me) = control_exchange(cfg, net, net_w, st)
 
         # 1. GRAFT/PRUNE ingest
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
@@ -1957,8 +2002,11 @@ def make_gossipsub_step(
                 n_duplicate=n_duplicate, n_rpc=n_rpc,
             )
         else:
-            # 2. IWANT service (requests sent to me last round -> delivery carry)
-            st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me)
+            # 2. IWANT service (requests sent to me last round -> delivery
+            # carry) — the mcache-window gather rides the wire view, so a
+            # flapped link's responses are lost (and its retransmission
+            # counters don't tick: the data never arrived)
+            st2, iwant_resp = iwant_responses(cfg, net_w, st2, nbr_score_of_me)
 
             # 3. IHAVE ingest (advertisements -> next round's requests)
             st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok, ihave_in_raw)
@@ -1986,10 +2034,22 @@ def make_gossipsub_step(
                 val_delay_topic=cfg.validation_delay_topic,
             )
             iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
+            have_pre_merge = dlv.have
             dlv, info = merge_extra_tx(net_l, core.msgs, dlv, info, iwant_resp, tick,
                                        count_events=cfg.count_events,
                                        queue_cap=cfg.queue_cap,
                                        val_delay_topic=cfg.validation_delay_topic)
+            if chaos is not None and cfg.count_events:
+                # IWANT-recovery attribution: receipts whose FIRST arrival
+                # rode the IWANT service rather than an eager push (the
+                # chaos metrics' recovery-efficacy numerator; valid-plane
+                # membership read at arrival — under async validation the
+                # verdict lands later, same arrival-cohort convention as
+                # the duplicate counter)
+                n_iwant_rec = bitset.popcount(
+                    (dlv.have & ~have_pre_merge)
+                    & bitset.pack(core.msgs.valid)[None, :], axis=None,
+                ).sum().astype(jnp.int32)
 
         # exact-trace duplicate plane: arrivals beyond the first per
         # (peer, msg) — captured pre-throttle (throttled receipts are
@@ -2107,8 +2167,18 @@ def make_gossipsub_step(
             events = accumulate_round_events(
                 events, info, jnp.sum(is_pub.astype(jnp.int32))
             )
+            if chaos is not None:
+                events = events.at[EV.LINK_DOWN].add(
+                    chaos_faults.count_links_down(net.nbr, net_l.nbr_ok,
+                                                  link_ok)
+                ).at[EV.IWANT_RECOVER].add(n_iwant_rec)
+        core_next = core.replace(msgs=msgs, dlv=dlv, events=events)
+        if chaos is not None and chaos.needs_state:
+            core_next = core_next.replace(
+                chaos=core.chaos.replace(ge_bad=ge_bad_next)
+            )
         st2 = st2.replace(
-            core=core.replace(msgs=msgs, dlv=dlv, events=events),
+            core=core_next,
             mcache=mcache,
             ihave_out=ihave_out,
             iwant_out=iwant_out,
@@ -2165,11 +2235,25 @@ def make_gossipsub_step(
     use_static_hb = static_heartbeat and cfg.heartbeat_every > 1
     if use_static_hb:
         # do_heartbeat is REQUIRED here: a default would let a driver
-        # silently heartbeat every round (or never) against the cadence
-        if dynamic_peers:
+        # silently heartbeat every round (or never) against the cadence.
+        # A scheduled-chaos build likewise takes the Scenario's forced-
+        # down link mask as a REQUIRED trailing positional ([N, K] bool,
+        # True = link down this round) — a default would silently run
+        # the scenario with no partitions.
+        if dynamic_peers and chaos_sched:
+            def step(st, pub_origin, pub_topic, pub_valid, up_next,
+                     link_deny, *, do_heartbeat):
+                return _round(st, pub_origin, pub_topic, pub_valid, up_next,
+                              do_heartbeat, link_deny)
+        elif dynamic_peers:
             def step(st, pub_origin, pub_topic, pub_valid, up_next, *, do_heartbeat):
                 return _round(st, pub_origin, pub_topic, pub_valid, up_next,
                               do_heartbeat)
+        elif chaos_sched:
+            def step(st, pub_origin, pub_topic, pub_valid, link_deny,
+                     *, do_heartbeat):
+                return _round(st, pub_origin, pub_topic, pub_valid, None,
+                              do_heartbeat, link_deny)
         else:
             def step(st, pub_origin, pub_topic, pub_valid, *, do_heartbeat):
                 return _round(st, pub_origin, pub_topic, pub_valid, None,
@@ -2177,9 +2261,17 @@ def make_gossipsub_step(
         return jax.jit(step, donate_argnums=0,
                        static_argnames=("do_heartbeat",))
 
-    if dynamic_peers:
+    if dynamic_peers and chaos_sched:
+        def step(st, pub_origin, pub_topic, pub_valid, up_next, link_deny):
+            return _round(st, pub_origin, pub_topic, pub_valid, up_next,
+                          link_deny=link_deny)
+    elif dynamic_peers:
         def step(st, pub_origin, pub_topic, pub_valid, up_next):
             return _round(st, pub_origin, pub_topic, pub_valid, up_next)
+    elif chaos_sched:
+        def step(st, pub_origin, pub_topic, pub_valid, link_deny):
+            return _round(st, pub_origin, pub_topic, pub_valid, None,
+                          link_deny=link_deny)
     else:
         def step(st, pub_origin, pub_topic, pub_valid):
             return _round(st, pub_origin, pub_topic, pub_valid, None)

@@ -92,6 +92,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import faults as chaos_faults
 from ..ops import bitset
 from ..score.engine import (
     apply_delivery_counts,
@@ -274,6 +275,17 @@ def make_gossipsub_phase_step(
         sub_knowledge_holes, adversary_no_forward,
     )
     tp = consts.tp
+    # chaos plane: None elides it statically (the traced program is the
+    # pre-chaos one — tests/test_chaos.py pins bit-exactness and `make
+    # chaos-smoke` pins the compiled kernel census). When on, the control
+    # head's outage mask is ONE AND on the stacked wire gather (net_w),
+    # and each data sub-round applies its own round's link mask; the
+    # Gilbert–Elliott chain advances once per sub-round, so fault
+    # sequences match the per-round engine's cadence. Scheduled builds
+    # take ONE link_deny per phase — partitions quantize to phase
+    # boundaries, exactly like the churn plane's peer transitions.
+    chaos = chaos_faults.resolve(cfg.chaos)
+    chaos_sched = chaos is not None and chaos.scheduled
     adv_self = (
         jnp.asarray(adversary_no_forward, bool)
         if adversary_no_forward is not None else None
@@ -311,7 +323,7 @@ def make_gossipsub_phase_step(
     p4_live = exact_counters or bool(np.any(np.asarray(consts.tpa.w4) != 0.0))
 
     def _phase(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
-               do_heartbeat: bool) -> GossipSubState:
+               do_heartbeat: bool, link_deny=None) -> GossipSubState:
         # ---- control head (once per phase) ------------------------------
         if dynamic_peers:
             st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
@@ -343,6 +355,29 @@ def make_gossipsub_phase_step(
 
         acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
                                        core.key, tick0)
+
+        # ---- chaos plane: the phase-head round's link outages ----------
+        # The control head crosses the wire ONCE, at round tick0 — its
+        # outage mask is round tick0's, applied as a single AND on the
+        # (stacked) wire gather via net_w. Data sub-rounds each apply
+        # their own round's mask below (gate_i); the GE chain advances
+        # once per sub-round so the fault cadence matches the per-round
+        # engine's.
+        if chaos is not None:
+            chaos_seed = chaos_faults.chaos_seed(core.key)
+            ge_bad = core.chaos.ge_bad if core.chaos is not None else None
+            link_ok0, ge_bad = chaos_faults.round_link_ok(
+                chaos, chaos_seed, net.nbr, tick0, ge_bad, link_deny,
+            )
+            net_w = net_l.replace(nbr_ok=net_l.nbr_ok & link_ok0)
+            n_link_down = (
+                chaos_faults.count_links_down(net.nbr, net_l.nbr_ok, link_ok0)
+                if cfg.count_events else None
+            )
+        else:
+            link_ok0 = ge_bad = n_link_down = None
+            net_w = net_l
+
         if cfg.wire_coalesced:
             # ONE stacked gather for the whole control head: control
             # outboxes + score plane + IWANT window (+ the P5 app plane
@@ -354,11 +389,11 @@ def make_gossipsub_phase_step(
             )
             (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
              nbr_score_of_me, window_g, app_g) = control_exchange_coalesced(
-                cfg, net, net_l, st, include_app=include_app
+                cfg, net, net_w, st, include_app=include_app
             )
         else:
             (graft_in_raw, prune_in_raw, ihave_in_raw, px_in_raw,
-             nbr_score_of_me) = control_exchange(cfg, net, net_l, st)
+             nbr_score_of_me) = control_exchange(cfg, net, net_w, st)
             window_g = app_g = None
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
             cfg, net_l, st, tp, acc_ok, graft_in_raw, prune_in_raw, px_in_raw
@@ -367,7 +402,10 @@ def make_gossipsub_phase_step(
         if cfg.count_events:
             events = events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
         edge_live_next = px_connect(cfg, net, net_l, st, px_ok, dynamic_peers)
-        st2, iwant_resp = iwant_responses(cfg, net_l, st2, nbr_score_of_me,
+        # the IWANT-service window gather rides the wire view (net_w):
+        # responses on a flapped link are lost and the retransmission
+        # counters don't tick (the data never arrived)
+        st2, iwant_resp = iwant_responses(cfg, net_w, st2, nbr_score_of_me,
                                           window_g=window_g)
         st2 = handle_ihave(cfg, net_l, st2, joined_msg_words(net_l, core.msgs),
                            acc_ok, ihave_in_raw)
@@ -511,8 +549,25 @@ def make_gossipsub_phase_step(
             # sub-round with one wide fold
             origin_w = origin_msg_words(net_l, msgs)
 
+        n_iwant_rec = None
         for i in range(r):
             tick_i = tick0 + i
+            # chaos: this sub-round's link mask (round tick0's was already
+            # computed at the head — the control head shares it)
+            if chaos is not None:
+                if i == 0:
+                    link_ok_i = link_ok0
+                else:
+                    link_ok_i, ge_bad = chaos_faults.round_link_ok(
+                        chaos, chaos_seed, net.nbr, tick_i, ge_bad, link_deny,
+                    )
+                    if cfg.count_events:
+                        n_link_down = n_link_down + chaos_faults.count_links_down(
+                            net.nbr, net_l.nbr_ok, link_ok_i
+                        )
+                gate_i = recv_gate & link_ok_i
+            else:
+                gate_i = recv_gate
             if plan is not None:
                 # the table as allocate_publishes would have left it after
                 # sub-rounds < i (bit-identical snapshot; see PhasePubPlan)
@@ -552,7 +607,7 @@ def make_gossipsub_phase_step(
                     adv_self[:, None, None], jnp.uint32(0), send
                 )
             trans = jnp.where(
-                recv_gate[:, :, None], net_l.edge_gather(send), jnp.uint32(0)
+                gate_i[:, :, None], net_l.edge_gather(send), jnp.uint32(0)
             )
             nm = ~origin_w
             if msgs.wire_block is not None:
@@ -569,11 +624,24 @@ def make_gossipsub_phase_step(
                 # IWANT responses computed at the phase head ride the first
                 # sub-round (r-round service latency, like the reference's
                 # heartbeat-batched gossip turnaround)
+                have_pre_merge = dlv.have
                 dlv, info = merge_extra_tx(
                     net_l, msgs, dlv, info, iwant_resp, tick_i,
                     count_events=cfg.count_events, queue_cap=cfg.queue_cap,
                     val_delay_topic=cfg.validation_delay_topic,
                 )
+                if chaos is not None and cfg.count_events:
+                    # IWANT-recovery attribution (same arrival-cohort
+                    # convention as the per-round step): first arrivals
+                    # that rode the IWANT service
+                    valid_w_head = (
+                        plan.valid_words[0] if plan is not None
+                        else bitset.pack(msgs.valid)
+                    )
+                    n_iwant_rec = bitset.popcount(
+                        (dlv.have & ~have_pre_merge)
+                        & valid_w_head[None, :], axis=None,
+                    ).sum().astype(jnp.int32)
             acc_upd = {}
             if cfg.trace_exact:
                 # pre-throttle, like the per-round step: throttled receipts
@@ -864,10 +932,19 @@ def make_gossipsub_phase_step(
                 **cnt,
             )
             events = accumulate_round_events(events, info_sum, n_pub)
+            if chaos is not None:
+                events = events.at[EV.LINK_DOWN].add(n_link_down)
+                if n_iwant_rec is not None:
+                    events = events.at[EV.IWANT_RECOVER].add(n_iwant_rec)
 
+        core_next = core.replace(msgs=msgs, dlv=dlv, events=events,
+                                 tick=tick_last)
+        if chaos is not None and chaos.needs_state:
+            core_next = core_next.replace(
+                chaos=core.chaos.replace(ge_bad=ge_bad)
+            )
         st2 = st2.replace(
-            core=core.replace(msgs=msgs, dlv=dlv, events=events,
-                              tick=tick_last),
+            core=core_next,
             mcache=mcache,
             ihave_out=jnp.zeros_like(st2.ihave_out),
             iwant_out=iwant_out,
@@ -907,10 +984,23 @@ def make_gossipsub_phase_step(
             )
         return st2.replace(core=st2.core.replace(tick=tick0 + r))
 
-    if dynamic_peers:
+    # scheduled-chaos builds take the Scenario's forced-down link mask as
+    # a REQUIRED trailing positional — ONE [N, K] plane per phase (like
+    # the churn plane's one liveness row: partitions land at phase heads)
+    if dynamic_peers and chaos_sched:
+        def step(st, pub_origin, pub_topic, pub_valid, up_next, link_deny,
+                 *, do_heartbeat):
+            return _phase(st, pub_origin, pub_topic, pub_valid, up_next,
+                          do_heartbeat, link_deny)
+    elif dynamic_peers:
         def step(st, pub_origin, pub_topic, pub_valid, up_next, *, do_heartbeat):
             return _phase(st, pub_origin, pub_topic, pub_valid, up_next,
                           do_heartbeat)
+    elif chaos_sched:
+        def step(st, pub_origin, pub_topic, pub_valid, link_deny,
+                 *, do_heartbeat):
+            return _phase(st, pub_origin, pub_topic, pub_valid, None,
+                          do_heartbeat, link_deny)
     else:
         def step(st, pub_origin, pub_topic, pub_valid, *, do_heartbeat):
             return _phase(st, pub_origin, pub_topic, pub_valid, None,

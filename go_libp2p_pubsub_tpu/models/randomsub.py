@@ -18,9 +18,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import faults as chaos_faults
 from ..ops.select import select_random_mask
 from ..score.engine import slot_topic_words
 from ..state import Net, SimState, allocate_publishes
+from ..trace.events import EV
 from .common import accumulate_round_events, delivery_round
 from .gossipsub import gather_nbr_subscribed, joined_msg_words, sender_carry_words
 
@@ -30,7 +32,8 @@ RANDOMSUB_D = 6  # randomsub.go:17
 def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
                         size_estimate: int | None = None,
                         queue_cap: int = 0,
-                        stacked: bool = True):
+                        stacked: bool = True,
+                        chaos: "chaos_faults.ChaosConfig | None" = None):
     """Build the jitted per-round RandomSub step.
 
     `size_estimate` mirrors the reference's static network-size parameter:
@@ -49,7 +52,15 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
     (``SimState.init(val_delay=...)``), both shared with floodsub and
     gossipsub through the common delivery engine. ``stacked`` selects
     the round-7 stacked recycled-slot clears in allocate_publishes
-    (False = legacy per-plane kernels, bit-identical — A/B only)."""
+    (False = legacy per-plane kernels, bit-identical — A/B only).
+
+    ``chaos`` enables the link-fault plane (chaos/faults.py — same
+    generators and elision contract as the other routers); a
+    ``scheduled=True`` config makes the step take a trailing
+    ``link_deny [N, K]`` argument, and a GE generator needs
+    ``SimState.init(chaos_ge=True)``."""
+    chaos = chaos_faults.resolve(chaos)
+    chaos_sched = chaos is not None and chaos.scheduled
     protocol = np.asarray(net.protocol)
     if size_estimate is not None:
         gs_size = np.full((net.n_topics,), size_estimate, np.int64)
@@ -74,7 +85,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
     # it forwards to every subscribed neighbor (floodsub.go:76-100)
     i_am_floodsub = jnp.asarray(protocol == 0)
 
-    def step(st: SimState, pub_origin, pub_topic, pub_valid) -> SimState:
+    def _round(st: SimState, pub_origin, pub_topic, pub_valid,
+               link_deny=None) -> SimState:
         tick = st.tick
         m = st.msgs.capacity
 
@@ -92,6 +104,13 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
             jnp.uint32(0),
         )
         edge_mask = carried & joined_msg_words(net, st.msgs)[:, None, :]
+        if chaos is not None:
+            ge_bad = st.chaos.ge_bad if st.chaos is not None else None
+            link_ok, ge_bad_next = chaos_faults.round_link_ok(
+                chaos, chaos_faults.chaos_seed(st.key), net.nbr, tick,
+                ge_bad, link_deny,
+            )
+            edge_mask = jnp.where(link_ok[:, :, None], edge_mask, jnp.uint32(0))
 
         dlv, info = delivery_round(net, st.msgs, st.dlv, edge_mask, tick,
                                    queue_cap=queue_cap)
@@ -100,6 +119,19 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
             stacked_clears=stacked,
         )
         events = accumulate_round_events(st.events, info, jnp.sum(is_pub.astype(jnp.int32)))
+        if chaos is not None:
+            events = events.at[EV.LINK_DOWN].add(
+                chaos_faults.count_links_down(net.nbr, net.nbr_ok, link_ok)
+            )
+            if chaos.needs_state:
+                st = st.replace(chaos=st.chaos.replace(ge_bad=ge_bad_next))
         return st.replace(tick=tick + 1, msgs=msgs, dlv=dlv, events=events)
+
+    if chaos_sched:
+        def step(st, pub_origin, pub_topic, pub_valid, link_deny):
+            return _round(st, pub_origin, pub_topic, pub_valid, link_deny)
+    else:
+        def step(st, pub_origin, pub_topic, pub_valid):
+            return _round(st, pub_origin, pub_topic, pub_valid)
 
     return jax.jit(step, donate_argnums=0)

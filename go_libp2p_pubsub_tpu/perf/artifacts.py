@@ -40,6 +40,27 @@ SCHEMA_VERSION = 2
 #: per wall second on a v5e-8)
 NORTH_STAR_RATE = 10_000.0
 
+#: the chaos-plane defaults every artifact WITHOUT a chaos block reads
+#: back as (self-describing, per the ADVICE round-5 pattern): the whole
+#: committed BENCH_r* trajectory was measured on a lossless wire
+CHAOS_OFF = {"generator": "off", "loss_rate": 0.0, "scheduled": False,
+             "scenario": None}
+
+
+def chaos_fingerprint(chaos=None, scenario=None) -> dict:
+    """The schema-v2 ``fingerprint["chaos"]`` block: generator kind +
+    rates (from a chaos.ChaosConfig — duck-typed via its
+    ``fingerprint()`` so this module stays jax-free) and the scenario
+    schedule hash (from a chaos.Scenario). ``chaos_fingerprint()`` with
+    no arguments is the explicit off block new lossless artifacts
+    carry."""
+    out = dict(CHAOS_OFF)
+    if chaos is not None and getattr(chaos, "enabled", False):
+        out.update(chaos.fingerprint())
+    if scenario is not None:
+        out["scenario"] = scenario.scenario_hash()
+    return out
+
 
 @dataclasses.dataclass
 class BenchRecord:
@@ -101,6 +122,24 @@ class BenchRecord:
         eng = fp.get("engine") or {}
         v = eng.get("wire_coalesced")
         return None if v is None else bool(v)
+
+    @property
+    def chaos(self) -> dict:
+        """The chaos-plane block of the fingerprint. LEGACY artifacts
+        (rounds 1-7 — every line that predates the chaos plane) read
+        back with the chaos=off defaults, so readers can filter or
+        group the whole trajectory on fault parameters without
+        special-casing age."""
+        fp = self.fingerprint or {}
+        out = dict(CHAOS_OFF)
+        out.update(fp.get("chaos") or {})
+        return out
+
+    @property
+    def chaos_off(self) -> bool:
+        c = self.chaos
+        return (c["generator"] == "off" and c["scenario"] is None
+                and not c.get("scheduled", False))
 
     @property
     def permute_sets_per_phase(self) -> int | None:

@@ -254,6 +254,12 @@ def _measure_phase_gather_sets(
     return len(tally)
 
 
+def _chaos_fingerprint():
+    from .artifacts import chaos_fingerprint
+
+    return chaos_fingerprint()
+
+
 def workload_fingerprint(
     config: str,
     n_peers: int,
@@ -322,6 +328,11 @@ def workload_fingerprint(
             # engine (gossipsub_phase.py round-4 addendum 4)
             "incr_members": bool(phase and n_topics <= INCR_MEMBERS_MAX_TOPICS),
         },
+        # the bench wire is lossless; the explicit off block keeps new
+        # artifacts self-describing (chaos runs — scripts/chaos_report.py
+        # — emit their generator/scenario here instead). Legacy artifacts
+        # without the field read back as off (artifacts.BenchRecord.chaos)
+        "chaos": _chaos_fingerprint(),
     }
     if seg_rounds is not None:
         fp["seg_rounds"] = int(seg_rounds)

@@ -252,6 +252,24 @@ class Delivery:
 
 
 @struct.dataclass
+class ChaosState:
+    """Device state of the chaos plane's Gilbert–Elliott link-fault
+    generator (chaos/faults.py): the per-link two-state chain's bad
+    plane. Kept symmetric over the edge involution by construction
+    (transitions draw symmetric per-link uniforms from a symmetric
+    init). Present only in states built for a GE generator
+    (``ChaosConfig.needs_state``) — the i.i.d. generator and pure
+    schedules are stateless (masks are functions of (key, tick), both
+    already checkpointed)."""
+
+    ge_bad: jax.Array  # [N, K] bool — link currently in the bad state
+
+    @classmethod
+    def empty(cls, n: int, k: int) -> "ChaosState":
+        return cls(ge_bad=jnp.zeros((n, k), bool))
+
+
+@struct.dataclass
 class SimState:
     """Carry for the jitted step loop (router-agnostic core)."""
 
@@ -260,22 +278,31 @@ class SimState:
     msgs: MsgTable
     dlv: Delivery
     events: jax.Array    # [N_EVENTS] i64 cumulative trace counters
+    # chaos plane: Gilbert–Elliott generator state (None = stateless
+    # chaos or chaos off — the common case; like wire_block, presence
+    # changes the pytree leaf count, so checkpoint templates must be
+    # built with the same setting)
+    chaos: ChaosState | None = None
 
     @classmethod
     def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0,
-             val_delay: int = 0, wire_block: bool = False) -> "SimState":
+             val_delay: int = 0, wire_block: bool = False,
+             chaos_ge: bool = False) -> "SimState":
         """`k` is the topology's padded max degree (net.max_degree) — it
         sizes the packed first-arrival-edge plane. k=0 is only for states
         that never enter a delivery round (e.g. checkpoint plumbing).
         `val_delay` > 0 adds the async-validation pipeline stages.
         `wire_block` enables the per-message oversized-transmit-block plane
-        (WithMaxMessageSize support — off by default, zero hot-path cost)."""
+        (WithMaxMessageSize support — off by default, zero hot-path cost).
+        `chaos_ge` adds the Gilbert–Elliott link-fault chain plane
+        (required iff the build's ChaosConfig.needs_state)."""
         return cls(
             tick=jnp.int32(0),
             key=jax.random.key(seed),
             msgs=MsgTable.empty(msg_slots, wire_block=wire_block),
             dlv=Delivery.empty(n_peers, msg_slots, k, val_delay),
             events=zero_counters(),
+            chaos=ChaosState.empty(n_peers, k) if chaos_ge else None,
         )
 
 

@@ -30,6 +30,13 @@ class EV(enum.IntEnum):
     LEAVE = 10
     GRAFT = 11
     PRUNE = 12
+    # --- sim-only chaos-plane counters (no trace.proto counterpart; the
+    # per-event trace stream has no LinkDown record — these are the
+    # "counter equivalents at phase cadence", docs/DESIGN.md §8). Both
+    # are statically elided from the step unless a chaos-enabled build
+    # counts events, so non-chaos accounting is unchanged.
+    LINK_DOWN = 13       # undirected live links down (flap/partition) per round, summed
+    IWANT_RECOVER = 14   # validated deliveries whose FIRST arrival rode IWANT service
 
 
 N_EVENTS = len(EV)

@@ -1,0 +1,421 @@
+"""Chaos scenario runner + the ``make chaos-smoke`` gate.
+
+Runs the chaos plane's two canonical degraded-network experiments
+(the v1.1 evaluation methodology's shape, arxiv 2007.02754) end to end
+and emits one schema-v2 JSON line per measurement, each carrying the
+chaos fingerprint (generator kind, loss rate, scenario hash —
+perf/artifacts.chaos_fingerprint):
+
+  * **flap** — i.i.d. link-flap loss on the same topology, subscription
+    set, publish schedule and fault seed for gossipsub v1.1 AND
+    floodsub: delivery ratio under loss per router, plus gossipsub's
+    IWANT-recovery share (the lazy-gossip machinery's measured
+    contribution — floodsub has no recovery path, so under enough loss
+    its single-shot forwarding strands peers that gossipsub's
+    IHAVE/IWANT retries reach). A phase-engine (r > 1, coalesced
+    stacked wire) cell runs the same generator through the flagship
+    cadence.
+  * **partition** — a scheduled 2-group partition with P3
+    deficit-scoring live: cross-group mesh edges starve and are pruned
+    during the window; after heal the mesh re-grafts (measured
+    mesh-repair latency) and messages published DURING the partition
+    cross over via IWANT service from mcache (measured
+    time-to-recover; the publish window sits inside the mcache history
+    so recovery is possible at all — the experiment the chaos plane
+    exists for).
+
+``--smoke`` additionally asserts the acceptance invariants and that
+the CHAOS-OFF compiled HLO kernel census still equals the committed
+PERF_SMOKE.json baseline (the elision-when-off contract at the
+compiler level; rates are perf-smoke's job, structure is ours), and
+exits non-zero on any failure. The gate is CPU-only by contract, like
+perf-smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: smoke-shape defaults: big enough for a measurable cut and a real
+#: recovery tail, small enough that the whole gate is tens of seconds
+#: warm (the kernel census dominates, and `make quick` runs perf-smoke
+#: first so its compile cache is hot)
+SMOKE_N = 128
+FLAP_LOSS = 0.6
+FLAP_ROUNDS = 80
+PARTITION_START = 12
+PARTITION_ROUNDS = 24
+PARTITION_TAIL = 40  # rounds after heal
+
+
+def _flap_params():
+    """Low-degree v1.1 overlay so the mesh (D=3) leaves non-mesh
+    neighbors for IHAVE gossip — the recovery path under test."""
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+
+    return GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1,
+                           history_length=6, history_gossip=4)
+
+
+def _score_params():
+    """Honest-net live scoring (deficit off), like the bench default."""
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+
+    return bench_score_params("default", 1)[1]
+
+
+def _publish_schedule(rng, n, rounds, pub_rounds, width=4):
+    po = np.full((rounds, width), -1, np.int32)
+    po[:pub_rounds] = rng.integers(0, n, size=(pub_rounds, width))
+    pt = np.zeros((rounds, width), np.int32)
+    pv = np.ones((rounds, width), bool)
+    return po, pt, pv
+
+
+def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
+             rounds_per_phase=1):
+    """One flap cell: (gossipsub ratio, iwant share, floodsub ratio,
+    chaos cfg). Same topology / schedule / fault stream for both
+    routers (the chaos hash keys on the canonical link id and the sim
+    key, which both runs share)."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.chaos import ChaosConfig, delivery_stats, \
+        iwant_recovery_share
+    from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
+    from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+        make_gossipsub_phase_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net, SimState
+
+    topo = graph.random_connect(n, d=4, seed=seed)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    cc = ChaosConfig(loss_rate=loss)
+    rng = np.random.default_rng(seed)
+    po, pt, pv = _publish_schedule(rng, n, rounds, pub_rounds=3)
+
+    sp = _score_params()
+    cfg = GossipSubConfig.build(
+        _flap_params(), PeerScoreThresholds(), score_enabled=True,
+        chaos=cc,
+    )
+    r = int(rounds_per_phase)
+    gs = GossipSubState.init(net, 64, cfg, score_params=sp, seed=seed)
+    if r > 1:
+        step = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+        assert rounds % r == 0
+        for p in range(rounds // r):
+            gs = step(gs, jnp.asarray(po[p * r:(p + 1) * r]),
+                      jnp.asarray(pt[p * r:(p + 1) * r]),
+                      jnp.asarray(pv[p * r:(p + 1) * r]),
+                      do_heartbeat=True)
+    else:
+        step = make_gossipsub_step(cfg, net, score_params=sp)
+        for i in range(rounds):
+            gs = step(gs, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                      jnp.asarray(pv[i]))
+    g_stats = delivery_stats(
+        np.asarray(gs.core.dlv.first_round), np.asarray(gs.core.msgs.birth),
+        np.asarray(gs.core.msgs.topic), np.asarray(gs.core.msgs.origin),
+        np.asarray(net.subscribed),
+    )
+    g_events = np.asarray(gs.core.events)
+
+    fs = SimState.init(n, 64, seed=seed, k=net.max_degree)
+    for i in range(rounds):
+        fs = floodsub_step(net, fs, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                           jnp.asarray(pv[i]), chaos=cc)
+    f_stats = delivery_stats(
+        np.asarray(fs.dlv.first_round), np.asarray(fs.msgs.birth),
+        np.asarray(fs.msgs.topic), np.asarray(fs.msgs.origin),
+        np.asarray(net.subscribed),
+    )
+    return {
+        "gossipsub_ratio": g_stats.ratio,
+        "iwant_share": iwant_recovery_share(g_events),
+        "floodsub_ratio": f_stats.ratio,
+        "chaos": cc,
+        "n": n,
+        "rounds": rounds,
+        "rounds_per_phase": r,
+    }
+
+
+def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
+                  window=PARTITION_ROUNDS, tail=PARTITION_TAIL):
+    """Partition/heal cell: scheduled 2-group split with P3 deficit
+    scoring live (cross-group mesh edges starve -> pruned during the
+    window; short prune backoff so post-heal re-grafting is visible in
+    the tail). Publishes land DURING the partition, inside the mcache
+    window before heal, so recovery crosses via IWANT."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.chaos import (
+        ChaosConfig,
+        cross_group_mesh_count,
+        delivery_stats,
+        halves,
+        mesh_repair_latency,
+        time_to_recover,
+        two_group_partition,
+    )
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreParams,
+        PeerScoreThresholds,
+        TopicScoreParams,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    topo = graph.random_connect(n, d=4, seed=seed)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    heal = start + window
+    rounds = heal + tail
+    scenario = two_group_partition(n, start=start, rounds=window)
+    groups = np.asarray(halves(n))
+
+    # P3 deficit live — and DOMINANT (time-in-mesh off) — so partition
+    # starvation actually prunes cross-group mesh edges while steady
+    # in-group traffic keeps in-group edges clean; the deficit penalty
+    # (threshold² · weight · topic_weight = -4.5) stays above the
+    # gossip threshold (-10) so IHAVE toward pruned peers keeps flowing
+    # (that's the recovery path). Sticky P3b off and a short backoff so
+    # the post-heal re-graft is visible inside the tail; P3 stops
+    # counting at prune (mesh-only in the reference too), so pruned
+    # cross peers return to ~0 score and are re-graftable after heal.
+    tp = TopicScoreParams(
+        time_in_mesh_weight=0.0,
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_threshold=3.0,
+        mesh_message_deliveries_activation=5.0,
+        mesh_message_deliveries_window=2.0,
+        mesh_message_deliveries_decay=0.9,
+        mesh_failure_penalty_weight=0.0,
+    )
+    sp = PeerScoreParams(topics={0: tp}, skip_app_specific=True)
+    params = GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1,
+                             history_length=12, history_gossip=10,
+                             prune_backoff=4.0)
+    cc = ChaosConfig(scheduled=True)
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
+                                score_enabled=True, chaos=cc)
+    st = GossipSubState.init(net, 64, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+
+    rng = np.random.default_rng(seed)
+    nbr = np.asarray(net.nbr)
+    width = 2
+    mesh_series = []
+    # steady traffic from BOTH groups from warmup through heal: in-group
+    # mesh edges keep delivering (P3-clean) while cross-group edges
+    # starve and get pruned; the publishes of the last pre-heal rounds
+    # (the born window below) sit inside the mcache history at heal so
+    # IWANT recovery across the healed cut is possible at all. Traffic
+    # stops at heal — publish volume after the born window stays far
+    # below msg_slots, so the measured messages never recycle.
+    pub_rounds = range(2, heal - 1)
+    for t in range(rounds):
+        po = np.full((width,), -1, np.int32)
+        if t in pub_rounds:
+            po[:] = rng.integers(0, n, size=width)
+        deny = scenario.link_deny_at(t, nbr)
+        if deny is None:
+            deny = np.zeros(nbr.shape, bool)
+        st = step(st, jnp.asarray(po), jnp.asarray(np.zeros(width, np.int32)),
+                  jnp.asarray(np.ones(width, bool)), jnp.asarray(deny))
+        mesh_series.append((t + 1, cross_group_mesh_count(
+            np.asarray(st.mesh), nbr, np.asarray(net.nbr_ok), groups)))
+
+    pre = dict(mesh_series)[start] if start >= 1 else None
+    during = dict(mesh_series)[heal - 1]
+    repair = mesh_repair_latency(
+        [(t, c) for t, c in mesh_series],
+        heal_tick=heal, min_edges=max(1, during + 1),
+    )
+    born = (heal - 4, heal - 1)
+    stats = delivery_stats(
+        np.asarray(st.core.dlv.first_round), np.asarray(st.core.msgs.birth),
+        np.asarray(st.core.msgs.topic), np.asarray(st.core.msgs.origin),
+        np.asarray(net.subscribed), born_in=born,
+    )
+    ttr = time_to_recover(
+        np.asarray(st.core.dlv.first_round), np.asarray(st.core.msgs.birth),
+        np.asarray(st.core.msgs.topic), np.asarray(st.core.msgs.origin),
+        np.asarray(net.subscribed), heal_tick=heal, born_in=born,
+    )
+    return {
+        "cross_mesh_pre_partition": pre,
+        "cross_mesh_at_heal": during,
+        "mesh_repair_latency": repair,
+        "partition_delivery_ratio": stats.ratio,
+        "time_to_recover": ttr,
+        "scenario": scenario,
+        "chaos": cc,
+        "n": n,
+        "rounds": rounds,
+        "heal": heal,
+    }
+
+
+def check_census() -> dict:
+    """CHAOS-OFF structural gate: the compiled phase-step kernel census
+    at the PERF_SMOKE shape must EQUAL the committed baseline — the
+    elision-when-off contract, checked at the compiler level."""
+    from go_libp2p_pubsub_tpu.perf.profile import compiled_phase_kernel_count
+    from go_libp2p_pubsub_tpu.perf.regress import (
+        BASELINE_NAME,
+        PERF_SMOKE_N,
+        PERF_SMOKE_R,
+        repo_root,
+    )
+
+    base_path = os.path.join(repo_root(), BASELINE_NAME)
+    committed = None
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            committed = (json.load(f).get("hlo_kernels") or {}).get("total")
+    census = compiled_phase_kernel_count(
+        int(os.environ.get("PERF_SMOKE_N", PERF_SMOKE_N)),
+        int(os.environ.get("PERF_SMOKE_R", PERF_SMOKE_R)),
+    )
+    return {"total": census["total"], "committed": committed,
+            "equal": committed is None or census["total"] == committed}
+
+
+def _emit(metric, value, chaos=None, scenario=None, extras=None):
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        BenchRecord,
+        chaos_fingerprint,
+        dump_record,
+    )
+
+    rec = BenchRecord(
+        metric=metric, value=float(value), unit="ratio", vs_baseline=0.0,
+        schema=2,
+        fingerprint={"chaos": chaos_fingerprint(chaos, scenario)},
+        extras=extras or {},
+    )
+    print(dump_record(rec), flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the acceptance invariants; exit 1 on failure")
+    ap.add_argument("--n", type=int, default=SMOKE_N)
+    ap.add_argument("--loss", type=float, default=FLAP_LOSS)
+    ap.add_argument("--rounds", type=int, default=FLAP_ROUNDS)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-census", action="store_true",
+                    help="skip the chaos-off kernel-census gate")
+    args = ap.parse_args(argv)
+
+    # CPU-only by contract (like perf-smoke): same platform + PRNG +
+    # persistent compile cache, so the gate means the same thing on any
+    # dev box or CI runner
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    enable_persistent_cache(os.path.join(repo_root(), ".jax_cache"))
+
+    failures = []
+
+    flap = run_flap(n=args.n, loss=args.loss, rounds=args.rounds,
+                    seed=args.seed)
+    _emit("chaos_flap_delivery_ratio_gossipsub", flap["gossipsub_ratio"],
+          chaos=flap["chaos"],
+          extras={"n_peers": flap["n"], "rounds": flap["rounds"],
+                  "iwant_recovery_share": round(flap["iwant_share"], 4)})
+    _emit("chaos_flap_delivery_ratio_floodsub", flap["floodsub_ratio"],
+          chaos=flap["chaos"],
+          extras={"n_peers": flap["n"], "rounds": flap["rounds"]})
+    if flap["gossipsub_ratio"] <= flap["floodsub_ratio"]:
+        failures.append(
+            f"flap: gossipsub delivery ratio {flap['gossipsub_ratio']:.4f} "
+            f"does not exceed floodsub's {flap['floodsub_ratio']:.4f} at "
+            f"loss={args.loss}"
+        )
+    if flap["iwant_share"] <= 0.0:
+        failures.append("flap: IWANT-recovery share is zero — the lazy "
+                        "gossip path recovered nothing")
+
+    # the same generator through the phase engine's coalesced stacked
+    # wire path (r=4: chaos masks per sub-round, control head masked once)
+    flap_phase = run_flap(n=args.n, loss=args.loss, rounds=args.rounds,
+                          seed=args.seed, rounds_per_phase=4)
+    _emit("chaos_flap_delivery_ratio_gossipsub_phase4",
+          flap_phase["gossipsub_ratio"], chaos=flap_phase["chaos"],
+          extras={"n_peers": flap_phase["n"], "rounds": flap_phase["rounds"],
+                  "iwant_recovery_share":
+                      round(flap_phase["iwant_share"], 4)})
+
+    part = run_partition(n=args.n, seed=args.seed + 1)
+    _emit("chaos_partition_delivery_ratio", part["partition_delivery_ratio"],
+          chaos=part["chaos"], scenario=part["scenario"],
+          extras={
+              "n_peers": part["n"], "rounds": part["rounds"],
+              "mesh_repair_latency": part["mesh_repair_latency"],
+              "time_to_recover": part["time_to_recover"],
+              "cross_mesh_pre_partition": part["cross_mesh_pre_partition"],
+              "cross_mesh_at_heal": part["cross_mesh_at_heal"],
+          })
+    if part["mesh_repair_latency"] is None:
+        failures.append("partition: mesh never repaired after heal "
+                        "(infinite mesh-repair latency)")
+    if part["time_to_recover"] is None:
+        failures.append("partition: delivery of partition-era messages "
+                        "never completed after heal")
+    if part["partition_delivery_ratio"] < 1.0:
+        failures.append(
+            f"partition: eventual delivery incomplete "
+            f"({part['partition_delivery_ratio']:.4f} < 1.0)"
+        )
+
+    if not args.no_census:
+        census = check_census()
+        print(json.dumps({"chaos_off_kernel_census": census}), flush=True)
+        if not census["equal"]:
+            failures.append(
+                f"chaos-off kernel census {census['total']} != committed "
+                f"PERF_SMOKE baseline {census['committed']} — the "
+                "elision-when-off contract broke"
+            )
+
+    if args.smoke and failures:
+        for f in failures:
+            print(f"chaos-smoke FAIL: {f}", file=sys.stderr)
+        print(json.dumps({"chaos_smoke": "FAIL", "errors": len(failures)}))
+        return 1
+    print(json.dumps({"chaos_smoke": "PASS" if not failures else "REPORT",
+                      "warnings": failures}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

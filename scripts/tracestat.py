@@ -96,7 +96,13 @@ def _cadence_note(data_ts: set, control_ts: set) -> dict | None:
             "one phase); the synthesized DROP_RPC queue model excludes "
             "duplicate arrivals; a late duplicate of a slot recycled "
             "within its death phase resolves against the end-of-phase "
-            "message id. See trace/drain.py \"Phase cadence\"."
+            "message id. The chaos-plane counters (LINK_DOWN / "
+            "IWANT_RECOVER, trace/events.py) are exact totals but "
+            "accumulate at phase cadence too — latencies derived from "
+            "them quantize to multiples of r (the delivery plane's "
+            "first_round stamps keep 1-round resolution at every "
+            "cadence). See trace/drain.py \"Phase cadence\" and "
+            "chaos/metrics.py."
         ),
     }
 

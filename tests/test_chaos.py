@@ -1,0 +1,475 @@
+"""Chaos plane tests (chaos/faults.py, chaos/scenario.py,
+chaos/metrics.py + the engine threading).
+
+The two load-bearing contracts:
+
+  * **elision when off** — a build with ``cfg.chaos=None`` and a build
+    with a disabled ``ChaosConfig`` produce BIT-IDENTICAL state trees
+    on every router and both phase paths (the chaos plane must cost
+    literally nothing when off; `make chaos-smoke` additionally pins
+    the compiled HLO kernel census against the committed PERF_SMOKE
+    baseline);
+  * **reproducible faults** — masks are symmetric per-link functions
+    of (sim key, tick), so the same seed + the same Scenario replays
+    the identical fault sequence, a checkpoint resumed mid-scenario
+    continues it exactly, and the per-round engine and the r=1 phase
+    engine flap the same links.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import checkpoint, graph
+from go_libp2p_pubsub_tpu.chaos import (
+    ChaosConfig,
+    ChaosConfigError,
+    delivery_stats,
+    halves,
+    iwant_recovery_share,
+    two_group_partition,
+)
+from go_libp2p_pubsub_tpu.chaos import faults
+from go_libp2p_pubsub_tpu.config import GossipSubParams, PeerScoreThresholds
+from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import make_gossipsub_phase_step
+from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+from go_libp2p_pubsub_tpu.state import Net, SimState
+from go_libp2p_pubsub_tpu.trace.events import EV
+
+from test_phase import assert_states_equal, build, run_phase, schedule
+
+IID = ChaosConfig(loss_rate=0.35)
+GE = ChaosConfig(generator="ge", ge_p_down=0.15, ge_p_up=0.4)
+OFF_CONFIGS = (None, ChaosConfig(), ChaosConfig(generator="ge"))
+
+
+def _net(n=32, d=6, seed=0, n_topics=1):
+    topo = graph.random_connect(n, d=d, seed=seed)
+    subs = graph.subscribe_all(n, n_topics)
+    return Net.build(topo, subs)
+
+
+# ---------------------------------------------------------------------------
+# config + generators
+
+
+def test_chaos_config_validation():
+    with pytest.raises(ChaosConfigError):
+        ChaosConfig(loss_rate=1.5).validate()
+    with pytest.raises(ChaosConfigError):
+        ChaosConfig(generator="nope").validate()
+    with pytest.raises(ChaosConfigError):
+        ChaosConfig(generator="ge", ge_p_down=0.2, ge_p_up=0.0).validate()
+    assert not ChaosConfig().enabled
+    assert not ChaosConfig(generator="ge").enabled  # ge_p_down == 0
+    assert ChaosConfig(scheduled=True).enabled
+    assert IID.enabled and not IID.needs_state
+    assert GE.enabled and GE.needs_state
+    # an invalid config that is ENABLED must be rejected at build time
+    with pytest.raises(ChaosConfigError):
+        GossipSubConfig.build(
+            GossipSubParams(), PeerScoreThresholds(),
+            chaos=ChaosConfig(loss_rate=2.0),
+        )
+    # resolve() validates BEFORE the elision decision: a typo'd
+    # generator must raise, not silently run a lossless experiment
+    with pytest.raises(ChaosConfigError):
+        faults.resolve(ChaosConfig(generator="gilbert", loss_rate=0.3))
+    assert faults.resolve(ChaosConfig()) is None
+    assert faults.resolve(None) is None
+
+
+def _mask_at(net, seed_key, tick, p=0.3):
+    seed = faults.chaos_seed(seed_key)
+    return np.asarray(faults.iid_link_down(seed, net.nbr, tick, p))
+
+
+def test_iid_masks_symmetric_deterministic_and_rated():
+    net = _net(n=64, d=6)
+    key = jax.random.key(7)
+    nbr = np.asarray(net.nbr)
+    rev = np.asarray(net.rev)
+    ok = np.asarray(net.nbr_ok)
+    downs = []
+    for tick in range(40):
+        m = _mask_at(net, key, tick, p=0.3)
+        # symmetry over the edge involution: m[j,k] == m[nbr[j,k], rev[j,k]]
+        jj, kk = np.nonzero(ok)
+        assert np.array_equal(m[jj, kk], m[nbr[jj, kk], rev[jj, kk]])
+        downs.append(m[ok].mean())
+        # deterministic: same (key, tick) -> same mask
+        np.testing.assert_array_equal(m, _mask_at(net, key, tick, p=0.3))
+    rate = float(np.mean(downs))
+    assert 0.25 < rate < 0.35, rate  # ~p with hash-quality tolerance
+    # a different sim key gives a different stream
+    assert not np.array_equal(_mask_at(net, key, 3),
+                              _mask_at(net, jax.random.key(8), 3))
+
+
+def test_ge_chain_symmetric_and_bursty():
+    net = _net(n=64, d=6)
+    seed = faults.chaos_seed(jax.random.key(3))
+    nbr = np.asarray(net.nbr)
+    rev = np.asarray(net.rev)
+    ok = np.asarray(net.nbr_ok)
+    bad = jnp.zeros(nbr.shape, bool)
+    seq = []
+    for tick in range(60):
+        bad = faults.ge_advance(seed, net.nbr, tick, bad,
+                                p_down=0.1, p_up=0.3)
+        b = np.asarray(bad)
+        jj, kk = np.nonzero(ok)
+        assert np.array_equal(b[jj, kk], b[nbr[jj, kk], rev[jj, kk]])
+        seq.append(b)
+    seq = np.stack(seq)  # [T, N, K]
+    frac = seq[:, ok].mean()
+    # stationary bad fraction ~ p_down / (p_down + p_up) = 0.25
+    assert 0.15 < frac < 0.35, frac
+    # burstiness: P(bad_t | bad_{t-1}) = 1 - p_up = 0.7 >> marginal
+    prev, cur = seq[:-1][:, ok], seq[1:][:, ok]
+    stay = cur[prev].mean()
+    assert stay > 0.55, stay
+
+
+# ---------------------------------------------------------------------------
+# elision when off: bit-exact state trees on every router
+
+
+def test_chaos_off_bitexact_per_round_gossipsub():
+    po, pt, pv = schedule(8, seed=5, codes=True)
+    outs = []
+    for chaos in OFF_CONFIGS:
+        net, cfg, sp, st = build(seed=5, chaos=chaos)
+        step = make_gossipsub_step(cfg, net, score_params=sp)
+        for i in range(8):
+            st = step(st, po[i], pt[i], pv[i])
+        outs.append(st)
+    assert_states_equal(outs[0], outs[1], "off-per-round/")
+    assert_states_equal(outs[0], outs[2], "off-per-round-ge0/")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("r", [1, 8])
+def test_chaos_off_bitexact_phase(r):
+    rounds = 16
+    po, pt, pv = schedule(rounds, seed=6, codes=True)
+    outs = []
+    for chaos in (None, ChaosConfig()):
+        net, cfg, sp, st = build(seed=6, chaos=chaos)
+        pstep = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+        st = run_phase(pstep, st, po, pt, pv, r)
+        outs.append(st)
+    assert_states_equal(outs[0], outs[1], f"off-phase-r{r}/")
+
+
+@pytest.mark.slow
+def test_chaos_off_bitexact_phase_r16():
+    po, pt, pv = schedule(32, seed=6, codes=True)
+    outs = []
+    for chaos in (None, ChaosConfig()):
+        net, cfg, sp, st = build(seed=6, chaos=chaos)
+        pstep = make_gossipsub_phase_step(cfg, net, 16, score_params=sp)
+        st = run_phase(pstep, st, po, pt, pv, 16)
+        outs.append(st)
+    assert_states_equal(outs[0], outs[1], "off-phase-r16/")
+
+
+def test_chaos_off_bitexact_floodsub_randomsub():
+    net = _net(seed=2)
+    po = jnp.asarray(np.array([1, -1, -1, -1], np.int32))
+    pt = jnp.zeros((4,), jnp.int32)
+    pv = jnp.ones((4,), bool)
+    outs = []
+    for chaos in (None, ChaosConfig()):
+        st = SimState.init(32, 32, seed=2, k=net.max_degree)
+        for i in range(6):
+            st = floodsub_step(net, st, po if i == 0 else jnp.full((4,), -1, jnp.int32),
+                               pt, pv, chaos=chaos)
+        outs.append(st)
+    assert_states_equal(outs[0], outs[1], "off-flood/")
+    outs = []
+    for chaos in (None, ChaosConfig()):
+        step = make_randomsub_step(net, chaos=chaos)
+        st = SimState.init(32, 32, seed=3, k=net.max_degree)
+        for i in range(6):
+            st = step(st, po if i == 0 else jnp.full((4,), -1, jnp.int32),
+                      pt, pv)
+        outs.append(st)
+    assert_states_equal(outs[0], outs[1], "off-randomsub/")
+
+
+# ---------------------------------------------------------------------------
+# chaos ON: engine agreement + A/B parity
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chaos", [IID, GE], ids=["iid", "ge"])
+def test_phase_r1_equals_per_round_under_chaos(chaos):
+    """The r=1 identity extends to the chaos plane: the phase engine's
+    head-masked control + per-sub-round data masks reduce to exactly
+    the per-round step's masking (same links flap, same losses)."""
+    po, pt, pv = schedule(8, seed=9, codes=True)
+    net, cfg, sp, st1 = build(seed=9, chaos=chaos)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    for i in range(8):
+        st1 = step(st1, po[i], pt[i], pv[i])
+    net, cfg, sp, st2 = build(seed=9, chaos=chaos)
+    pstep = make_gossipsub_phase_step(cfg, net, 1, score_params=sp)
+    st2 = run_phase(pstep, st2, po, pt, pv, 1)
+    assert_states_equal(st1, st2, "chaos-r1/")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chaos", [IID, GE], ids=["iid", "ge"])
+def test_phase_stacked_vs_legacy_under_chaos(chaos):
+    """The coalesced stacked wire path and the legacy per-plane path
+    must flap identically (the chaos mask is one AND on the stacked
+    gather vs per-plane ANDs — bit-identical by algebra)."""
+    r, rounds = 4, 16
+    po, pt, pv = schedule(rounds, seed=11, codes=True)
+    outs = []
+    for coalesced in (True, False):
+        net, cfg, sp, st = build(seed=11, chaos=chaos)
+        cfg = dataclasses.replace(cfg, wire_coalesced=coalesced)
+        pstep = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+        st = run_phase(pstep, st, po, pt, pv, r)
+        outs.append(st)
+    assert_states_equal(outs[0], outs[1], "chaos-AB/")
+
+
+def test_flap_counters_and_recovery():
+    """Under i.i.d. loss the LINK_DOWN counter counts undirected flapped
+    link-rounds, IWANT_RECOVER attributes lazy-gossip recoveries, and
+    the delivery plane still converges (the machinery under test)."""
+    n = 48
+    net = _net(n=n, d=4, seed=4)
+    params = GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1)
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
+                                chaos=ChaosConfig(loss_rate=0.4))
+    st = GossipSubState.init(net, 64, cfg, seed=4)
+    step = make_gossipsub_step(cfg, net)
+    rng = np.random.default_rng(4)
+    for i in range(40):
+        po = np.full((4,), -1, np.int32)
+        if i < 2:
+            po[:] = rng.integers(0, n, size=4)
+        st = step(st, jnp.asarray(po), jnp.asarray(np.zeros(4, np.int32)),
+                  jnp.asarray(np.ones(4, bool)))
+    ev = np.asarray(st.core.events)
+    assert ev[EV.LINK_DOWN] > 0
+    assert ev[EV.IWANT_RECOVER] > 0
+    assert 0.0 < iwant_recovery_share(ev) <= 1.0
+    stats = delivery_stats(
+        np.asarray(st.core.dlv.first_round), np.asarray(st.core.msgs.birth),
+        np.asarray(st.core.msgs.topic), np.asarray(st.core.msgs.origin),
+        np.asarray(net.subscribed),
+    )
+    assert stats.ratio > 0.9, stats
+
+
+def test_scheduled_partition_blocks_and_heals():
+    """A 2-group partition carries nothing across the cut while active;
+    after heal, partition-era messages cross (IWANT recovery from
+    mcache) — the engine-level version of the chaos-smoke assertion."""
+    n, r = 32, 4
+    net = _net(n=n, d=6, seed=1)
+    groups = np.asarray(halves(n))
+    sc = two_group_partition(n, start=0, rounds=8)
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds(),
+                                chaos=ChaosConfig(scheduled=True))
+    st = GossipSubState.init(net, 64, cfg, seed=1)
+    pstep = make_gossipsub_phase_step(cfg, net, r)
+    deny = jnp.asarray(sc.link_deny_at(0, np.asarray(net.nbr)))
+    zeros = jnp.zeros((n, net.max_degree), bool)
+    po0 = jnp.full((r, 4), -1, jnp.int32).at[1, 0].set(2)  # group-0 origin
+    pt = jnp.zeros((r, 4), jnp.int32)
+    pv = jnp.ones((r, 4), bool)
+    none = jnp.full((r, 4), -1, jnp.int32)
+    st = pstep(st, po0, pt, pv, deny, do_heartbeat=True)
+    st = pstep(st, none, pt, pv, deny, do_heartbeat=True)
+    fr = np.asarray(st.core.dlv.first_round)
+    slot = 0  # first publish lands on slot 0 (fresh table)
+    assert (fr[groups == 1, slot] < 0).all(), "partition leaked"
+    for _ in range(8):
+        st = pstep(st, none, pt, pv, zeros, do_heartbeat=True)
+    fr = np.asarray(st.core.dlv.first_round)
+    assert (fr[groups == 1, slot] >= 0).all(), "no recovery after heal"
+
+
+def test_scenario_compilation_and_hash():
+    n = 16
+    sc = two_group_partition(n, start=5, rounds=10)
+    sc.validate()
+    net = _net(n=n, d=3, seed=0)
+    nbr = np.asarray(net.nbr)
+    assert sc.link_deny_at(4, nbr) is None
+    deny = sc.link_deny_at(5, nbr)
+    g = np.asarray(halves(n))
+    jj, kk = np.nonzero(np.asarray(net.nbr_ok))
+    np.testing.assert_array_equal(
+        deny[jj, kk], g[jj] != g[nbr[jj, kk]]
+    )
+    assert sc.link_deny_at(15, nbr) is None  # healed
+    assert sc.scenario_hash() == two_group_partition(
+        n, start=5, rounds=10).scenario_hash()
+    assert sc.scenario_hash() != two_group_partition(
+        n, start=5, rounds=11).scenario_hash()
+    ev = sc.events()
+    assert [e[1] for e in ev] == ["PartitionStart", "PartitionHeal"]
+    # crash storms compose through the churn plane's up vector
+    from go_libp2p_pubsub_tpu.chaos import CrashStorm, Scenario
+
+    s2 = Scenario(n_peers=n, crashes=(CrashStorm(start=2, rounds=3,
+                                                 peers=(1, 4)),))
+    s2.validate()
+    assert s2.up_at(1)[1]       # up before the window
+    assert not s2.up_at(2)[1]   # crashed inside it
+    assert s2.up_at(5)[1]       # restarted after
+    assert s2.dynamic and not s2.scheduled
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume mid-scenario
+
+
+def _chaos_build(n=32, seed=3):
+    net = _net(n=n, d=6, seed=seed)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(),
+        chaos=ChaosConfig(generator="ge", ge_p_down=0.2, ge_p_up=0.4,
+                          scheduled=True),
+    )
+    st = GossipSubState.init(net, 64, cfg, seed=seed)
+    step = make_gossipsub_step(cfg, net)
+    return net, cfg, st, step
+
+
+def test_checkpoint_mid_scenario_resumes_exact_fault_stream(tmp_path):
+    """A checkpoint taken mid-scenario restores and continues to a state
+    (and therefore trace) identical to the uninterrupted run — the GE
+    chain state rides the pytree and the i.i.d./schedule masks are
+    functions of the checkpointed (key, tick)."""
+    n = 32
+    net, cfg, st, step = _chaos_build(n=n)
+    sc = two_group_partition(n, start=4, rounds=12)
+    nbr = np.asarray(net.nbr)
+    zeros = np.zeros(nbr.shape, bool)
+
+    def drive(st, t0, t1):
+        rng = np.random.default_rng(100)  # schedule indexed by tick
+        for t in range(t1):
+            po = np.full((4,), -1, np.int32)
+            po[0] = rng.integers(0, n)
+            if t < t0:
+                continue  # burn the rng to keep the schedule tick-indexed
+            deny = sc.link_deny_at(t, nbr)
+            st = step(st, jnp.asarray(po),
+                      jnp.asarray(np.zeros(4, np.int32)),
+                      jnp.asarray(np.ones(4, bool)),
+                      jnp.asarray(zeros if deny is None else deny))
+        return st
+
+    mid = drive(st, 0, 8)  # checkpoint INSIDE the partition window
+    path = str(tmp_path / "chaos_ckpt.npz")
+    checkpoint.save(path, mid)
+    _, _, template, _ = _chaos_build(n=n)
+    resumed_mid = checkpoint.restore(path, template)
+    assert_states_equal(mid, resumed_mid, "ckpt-mid/")
+
+    direct = drive(mid, 8, 20)
+    resumed = drive(resumed_mid, 8, 20)
+    assert_states_equal(direct, resumed, "ckpt-resume/")
+
+
+def test_same_seed_same_scenario_identical_trace(tmp_path):
+    """Determinism: the same seed + the same Scenario produce the exact
+    same serialized trace twice (TraceSession over a chaos run)."""
+    from go_libp2p_pubsub_tpu.trace.drain import TraceSession, snapshot
+    from go_libp2p_pubsub_tpu.trace.sinks import Tracer
+
+    class ListSink(Tracer):
+        def __init__(self):
+            super().__init__()
+            self.events = []
+
+        def _write(self, evs):
+            self.events.extend(e.SerializeToString() for e in evs)
+
+    def run_once():
+        n = 24
+        net = _net(n=n, d=4, seed=6)
+        cfg = GossipSubConfig.build(
+            GossipSubParams(), PeerScoreThresholds(),
+            chaos=ChaosConfig(loss_rate=0.3, scheduled=True),
+        )
+        st = GossipSubState.init(net, 64, cfg, seed=6)
+        step = make_gossipsub_step(cfg, net)
+        sc = two_group_partition(n, start=3, rounds=5)
+        nbr = np.asarray(net.nbr)
+        zeros = np.zeros(nbr.shape, bool)
+        sink = ListSink()
+        sess = TraceSession(net, [sink])
+        sess.emit_init(snapshot(st))
+        for t in range(12):
+            po = np.full((4,), -1, np.int32)
+            if t < 2:
+                po[0] = t
+            deny = sc.link_deny_at(t, nbr)
+            prev = snapshot(st)
+            st = step(st, jnp.asarray(po),
+                      jnp.asarray(np.zeros(4, np.int32)),
+                      jnp.asarray(np.ones(4, bool)),
+                      jnp.asarray(zeros if deny is None else deny))
+            sess.observe(prev, snapshot(st), po, np.zeros(4, np.int32),
+                         np.ones(4, bool))
+        sess.close()
+        return sink.events, np.asarray(st.core.events)
+
+    ev_a, cnt_a = run_once()
+    ev_b, cnt_b = run_once()
+    assert ev_a == ev_b
+    np.testing.assert_array_equal(cnt_a, cnt_b)
+    assert cnt_a[EV.LINK_DOWN] > 0
+
+
+# ---------------------------------------------------------------------------
+# artifacts: chaos fingerprint + legacy off-defaults
+
+
+def test_artifact_chaos_fingerprint_roundtrip_and_legacy_defaults():
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        BenchRecord,
+        chaos_fingerprint,
+        record_from_line,
+    )
+
+    sc = two_group_partition(16, start=1, rounds=2)
+    fp = chaos_fingerprint(IID, sc)
+    assert fp["generator"] == "iid" and fp["loss_rate"] == 0.35
+    assert fp["scenario"] == sc.scenario_hash()
+    rec = BenchRecord(metric="m", value=1.0, unit="ratio", vs_baseline=0.0,
+                      schema=2, fingerprint={"chaos": fp})
+    line = rec.to_line()
+    back = record_from_line(line)
+    assert back.chaos == {**fp}
+    assert not back.chaos_off
+    # legacy v1/v2 lines (no chaos block) read back as chaos off
+    legacy = record_from_line({"metric": "m", "value": 2.0, "unit": "x",
+                               "vs_baseline": 0.1})
+    assert legacy.chaos["generator"] == "off"
+    assert legacy.chaos["scenario"] is None
+    assert legacy.chaos_off
+    # the sweep fingerprint now carries the explicit off block
+    from go_libp2p_pubsub_tpu.perf.sweep import workload_fingerprint
+
+    wf = workload_fingerprint("default", 64, 64, 1, 1)
+    assert wf["chaos"]["generator"] == "off"

@@ -118,6 +118,80 @@ def test_orbax_restore_shape_mismatch_rejected(tmp_path):
         checkpoint.restore_orbax(path, SimState.init(16, 16, seed=0, k=4))
 
 
+def test_restore_mismatch_error_names_pytree_paths(tmp_path):
+    """Template mismatches must name the offending pytree PATHS (not
+    just flat leaf indexes) — shape mismatches list every bad leaf."""
+    net, st, _ = _setup(n=16)
+    path = str(tmp_path / "gs.npz")
+    checkpoint.save(path, st)
+    _, template, _ = _setup(n=8)
+    with pytest.raises(ValueError) as ei:
+        checkpoint.restore(path, template)
+    msg = str(ei.value)
+    # the delivery plane differs in N: its path must be spelled out
+    assert "have" in msg or "mesh" in msg
+    assert "leaf path" in msg
+    assert ".core." in msg or ".dlv" in msg or "mesh" in msg
+
+
+def test_restore_old_version_clear_error(tmp_path):
+    """A pre-v6 checkpoint must fail with the version-history pointer
+    (the chaos-plane format bump)."""
+    st = SimState.init(8, 16, seed=0, k=4)
+    path = str(tmp_path / "old.npz")
+    checkpoint.save(path, st)
+    import numpy as _np
+
+    with _np.load(path) as data:
+        stale = {k: data[k] for k in data.files}
+    stale["__version__"] = _np.int64(5)
+    _np.savez_compressed(path, **stale)
+    with pytest.raises(ValueError, match="predates.*v6|v5 predates"):
+        checkpoint.restore(path, SimState.init(8, 16, seed=0, k=4))
+
+
+@pytest.mark.parametrize("coalesced", [True, False])
+def test_phase_coalesced_roundtrip_resume_r8_mid_run(tmp_path, coalesced):
+    """Checkpoint at a phase boundary MID-RUN of an r=8 stacked-path
+    build (the round-7 coalesced wire path postdates the original
+    checkpoint tests): restore must continue bit-exactly on both the
+    coalesced and the legacy path."""
+    from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+        make_gossipsub_phase_step,
+    )
+
+    n, r = 32, 8
+    topo = graph.random_connect(n, d=6, seed=4)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds(),
+                                score_enabled=False,
+                                wire_coalesced=coalesced)
+    st0 = GossipSubState.init(net, 64, cfg, seed=4)
+    pstep = make_gossipsub_phase_step(cfg, net, r)
+
+    def drive(st, phases, seed_off):
+        for p in range(phases):
+            po = np.full((r, 4), -1, np.int32)
+            po[p % r, 0] = (p + seed_off) % n
+            st = pstep(st, jnp.asarray(po),
+                       jnp.asarray(np.zeros((r, 4), np.int32)),
+                       jnp.asarray(np.ones((r, 4), bool)),
+                       do_heartbeat=True)
+        return st
+
+    mid = drive(st0, 2, 0)  # tick = 16: an r>1 mid-run phase boundary
+    assert int(mid.core.tick) == 2 * r
+    path = str(tmp_path / f"phase8_{coalesced}.npz")
+    checkpoint.save(path, mid)
+    template = GossipSubState.init(net, 64, cfg, seed=4)
+    resumed_mid = checkpoint.restore(path, template)
+    _assert_tree_equal(mid, resumed_mid)
+    direct = drive(mid, 2, 5)
+    resumed = drive(resumed_mid, 2, 5)
+    _assert_tree_equal(direct, resumed)
+
+
 def test_phase_engine_roundtrip_resume(tmp_path):
     """Checkpoint/resume at the flagship cadence: a phase-engine run
     restored from a checkpoint continues bit-exactly (the dup_trans /
