@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke analyze sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -35,6 +35,18 @@ perf-smoke:
 chaos-smoke:
 	python scripts/chaos_report.py --smoke
 
+# analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
+# — the repo-specific AST lint pass (traced branches, host syncs, PRNG
+# discipline, packed-word dtype hygiene, import-time execution, static-
+# config hashability, EV-counter completeness; exceptions in
+# analysis/ALLOWLIST) — plus the trace-time guard harness: all four
+# engines re-traced under strict dtype promotion + transfer guard +
+# jax_enable_checks, exactly one compile per multi-round run, buffer
+# donation audited, and every state leaf pinned against the committed
+# STATE_SCHEMA.json (ANALYZE_UPDATE=1 rewrites). CPU-only by contract.
+analyze:
+	python scripts/analyze.py
+
 # declarative (config x N x r) sweep — e.g. the eth2 shard table:
 #   make sweep SWEEP_ARGS='--config eth2 --n 12500,25000,50000 --r 16'
 sweep:
@@ -44,12 +56,13 @@ test:
 	python -m pytest tests/ -q
 
 # quick tier: the sub-10-minute CI gate — `not slow` tests plus the CPU
-# perf-smoke regression gate and the chaos-smoke recovery gate (both
-# fast once the compile cache is warm)
+# perf-smoke regression gate, the chaos-smoke recovery gate and the
+# analysis-plane gate (all fast once the compile cache is warm)
 quick:
 	python -m pytest tests/ -q -m "not slow"
 	python -m go_libp2p_pubsub_tpu.perf.regress
 	python scripts/chaos_report.py --smoke
+	python scripts/analyze.py
 
 native:
 	$(MAKE) -C native

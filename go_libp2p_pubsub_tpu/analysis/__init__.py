@@ -1,0 +1,25 @@
+"""Analysis plane: source- and trace-level invariant checks.
+
+PRs 1-3 pinned the simulator's *outputs* (HLO kernel census, bit-exact
+state trees, recovery metrics); this package pins the *source and trace
+invariants* that make those outputs trustworthy as the codebase grows:
+
+  * ``simlint`` — an AST-level lint pass with repo-specific rules for
+    the classic silent killers of hand-vectorized JAX: Python branching
+    on traced arrays, host syncs inside jitted steps, PRNG key reuse,
+    bare-int dtype promotion on packed-bitset words, import-time device
+    execution, unhashable static configs, and EV-counter completeness.
+    Intentional exceptions live in the committed ``ALLOWLIST`` file.
+  * ``guards`` — a trace-time harness that re-traces all four engines
+    (gossipsub, phase incl. the stacked wire path, floodsub, randomsub)
+    under strict dtype promotion + transfer guard + jax_enable_checks,
+    asserts the recompile sentinel (exactly one compile per engine over
+    a multi-round run), audits buffer donation, and diffs every state
+    leaf against the committed ``STATE_SCHEMA.json`` baseline
+    (``ANALYZE_UPDATE=1`` rewrites — the PERF_SMOKE pattern).
+
+Entry point: ``scripts/analyze.py`` / ``make analyze`` (wired into
+``make quick``). docs/DESIGN.md §9 has the rule catalog.
+"""
+
+from __future__ import annotations

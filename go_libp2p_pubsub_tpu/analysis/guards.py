@@ -1,0 +1,386 @@
+"""Trace-time guard harness: re-trace every engine under JAX's paranoid
+modes and pin what the trace is allowed to look like.
+
+simlint (the AST half of the analysis plane) catches what source text
+can prove; this half catches what only a trace can: silent weak-type
+promotion paths, hidden host↔device transfers at dispatch, recompiles
+inside the round loop, dropped buffer donation, and state-tree dtype/
+shape drift. Per engine (gossipsub per-round, gossipsub phase with the
+stacked coalesced wire path, floodsub, randomsub):
+
+  strict-dtype   the full step traces under
+                 ``jax.numpy_dtype_promotion('strict')`` +
+                 ``jax_enable_checks`` — every cross-dtype op in the
+                 program is an explicit cast, so a refactor that mixes
+                 int32 into the uint32 word planes fails HERE, not as
+                 a corrupted bitset three PRs later.
+  schema         every leaf of the step's output state tree matches the
+                 committed ``STATE_SCHEMA.json`` baseline (path, dtype,
+                 shape, weak_type). ``ANALYZE_UPDATE=1`` rewrites — the
+                 PERF_SMOKE/BASELINE pattern. A weak-typed leaf is
+                 rejected even on update: a weak output leaf re-traced
+                 as an input next call IS the classic recompile-per-
+                 round bug.
+  donation       the lowered step carries buffer-donation markers for
+                 its state argument (``jax.buffer_donor`` /
+                 ``tf.aliasing_output`` in the StableHLO) — losing
+                 donation doubles resident state HBM at the 100k-peer
+                 shapes.
+  recompile      executing a multi-round run (fresh publish args every
+                 round) under ``jax.transfer_guard('disallow')``
+                 compiles EXACTLY once. The transfer guard turns any
+                 implicit host array sneaking into the loop into an
+                 error; the compile sentinel turns weak-type/shape
+                 wobble or an unhashable static into a failure instead
+                 of a silent 100x slowdown.
+
+The harness shapes are deliberately small (N=192, K=16, M=64, r=4 —
+compile-bound, ~seconds warm via the shared .jax_cache); the invariants
+they pin are shape-independent. Entry: ``scripts/analyze.py`` /
+``make analyze``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+
+#: harness shape: big enough that every plane (mesh, mcache, score,
+#: fanout-free default config) is live, small enough to compile in
+#: seconds on the tier-1 CPU container
+GUARD_N = 192
+GUARD_M = 64
+GUARD_R = 4          # phase-engine sub-rounds
+GUARD_ROUNDS = 6     # executed steps for the recompile sentinel
+PUB_WIDTH = 4
+
+SCHEMA_NAME = "STATE_SCHEMA.json"
+
+ENGINES = ("gossipsub", "gossipsub_phase", "floodsub", "randomsub")
+
+#: StableHLO markers proving the state argument is donated
+_DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
+
+
+class GuardViolation(Exception):
+    """One failed guard; .engine and .guard say which."""
+
+    def __init__(self, engine: str, guard: str, msg: str):
+        super().__init__(f"[{engine}] {guard}: {msg}")
+        self.engine = engine
+        self.guard = guard
+
+
+@dataclasses.dataclass
+class EngineHarness:
+    """One engine under test: a fresh jitted step plus everything the
+    guards need to drive it."""
+
+    name: str
+    jit_fn: object          # the jitted callable (cache-fresh)
+    state: object           # initial state pytree
+    make_args: object       # round_index -> positional args after state
+    static_kwargs: dict     # constant static kwargs for every call
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _pub_args(shape, i: int):
+    """Round-i publish batch: one valid publish from peer ``i`` so the
+    traced program includes live allocator + delivery work."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    po = np.full(shape, -1, np.int32)
+    po.reshape(-1)[0] = i % GUARD_N
+    pt = np.zeros(shape, np.int32)
+    pv = np.ones(shape, bool)
+    return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+
+def build_engine(name: str) -> EngineHarness:
+    """Construct a fresh-jit harness for one of ENGINES. Fresh jit
+    objects make the recompile sentinel exact: the cache starts empty
+    regardless of what else ran in this process."""
+    import jax
+
+    from .. import graph
+    from ..state import Net, SimState
+
+    if name in ("gossipsub", "gossipsub_phase"):
+        from ..perf.sweep import build_bench
+
+        r = GUARD_R if name == "gossipsub_phase" else 1
+        st, step, _, _ = build_bench(
+            GUARD_N, GUARD_M, heartbeat_every=max(r, 1), rounds_per_phase=r,
+        )
+        shape = (r, PUB_WIDTH) if r > 1 else (PUB_WIDTH,)
+        kwargs = {"do_heartbeat": True} if r > 1 else {}
+        return EngineHarness(
+            name, step, st, lambda i: _pub_args(shape, i), kwargs
+        )
+
+    topo = graph.ring_lattice(GUARD_N, d=8)
+    subs = graph.subscribe_all(GUARD_N, 1)
+    net = Net.build(topo, subs)
+    st = SimState.init(GUARD_N, GUARD_M, k=net.max_degree)
+    if name == "floodsub":
+        from ..models import floodsub
+
+        # re-jit the raw step so the compile cache is this harness's own
+        step = jax.jit(
+            floodsub.floodsub_step.__wrapped__, donate_argnums=1,
+            static_argnames=("queue_cap", "stacked", "chaos"),
+        )
+        return EngineHarness(
+            name,
+            step,
+            st,
+            lambda i: _pub_args((PUB_WIDTH,), i),
+            {"net": net},
+        )
+    if name == "randomsub":
+        from ..models.randomsub import make_randomsub_step
+
+        step = make_randomsub_step(net)
+        return EngineHarness(
+            name, step, st, lambda i: _pub_args((PUB_WIDTH,), i), {}
+        )
+    raise ValueError(f"unknown engine {name!r}; expected one of {ENGINES}")
+
+
+def _call(h: EngineHarness, state, i: int):
+    kw = dict(h.static_kwargs)
+    net = kw.pop("net", None)
+    args = h.make_args(i)
+    if net is not None:
+        return h.jit_fn(net, state, *args, **kw)
+    return h.jit_fn(state, *args, **kw)
+
+
+@contextlib.contextmanager
+def _enable_checks():
+    import jax
+
+    prev = jax.config.jax_enable_checks
+    jax.config.update("jax_enable_checks", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_checks", prev)
+
+
+# ---------------------------------------------------------------------------
+# individual guards (each usable standalone — the negative tests do)
+
+
+def strict_trace(h: EngineHarness):
+    """Abstractly evaluate the step under strict dtype promotion +
+    enable_checks; returns the output state avals (schema input)."""
+    import jax
+
+    with _enable_checks(), jax.numpy_dtype_promotion("strict"):
+        try:
+            return jax.eval_shape(lambda s, i=0: _call(h, s, i), h.state)
+        except Exception as e:
+            raise GuardViolation(
+                h.name, "strict-dtype",
+                f"{type(e).__name__}: {str(e)[:400]}",
+            ) from e
+
+
+def schema_of(out_tree) -> list:
+    """Flatten an aval tree into the committed leaf-schema rows. PRNG
+    key dtypes are normalized to "key" so the baseline is independent
+    of the ambient jax_default_prng_impl."""
+    import jax
+
+    rows = []
+    leaves = jax.tree_util.tree_flatten_with_path(out_tree)[0]
+    for path, leaf in leaves:
+        dt = str(leaf.dtype)
+        if dt.startswith("key<"):
+            dt = "key"
+        rows.append({
+            "path": jax.tree_util.keystr(path),
+            "dtype": dt,
+            "shape": list(leaf.shape),
+            "weak_type": bool(getattr(leaf, "weak_type", False)),
+        })
+    return rows
+
+
+def diff_schema(engine: str, got: list, want: list) -> list:
+    """Human-readable mismatch lines between two leaf-schema lists."""
+    gm = {r["path"]: r for r in got}
+    wm = {r["path"]: r for r in want}
+    out = []
+    for path in sorted(set(gm) | set(wm)):
+        g, w = gm.get(path), wm.get(path)
+        if g is None:
+            out.append(f"{path}: leaf disappeared (baseline {w})")
+        elif w is None:
+            out.append(f"{path}: new leaf {g} not in baseline")
+        elif g != w:
+            out.append(f"{path}: {g} != baseline {w}")
+    return out
+
+
+def check_schema(h: EngineHarness, out_tree, baseline: dict | None) -> list:
+    """Compare the step's output state tree against the committed
+    baseline; returns this engine's fresh rows (for ANALYZE_UPDATE
+    rewrites). Weak-typed leaves fail regardless of baseline."""
+    rows = schema_of(out_tree)
+    weak = [r["path"] for r in rows if r["weak_type"]]
+    if weak:
+        raise GuardViolation(
+            h.name, "schema",
+            f"weak-typed state leaves {weak[:4]} — a weak output leaf "
+            "re-traced as next round's input recompiles every call",
+        )
+    if baseline is not None:
+        want = (baseline.get("engines", {}).get(h.name) or {}).get("leaves")
+        if want is None:
+            raise GuardViolation(
+                h.name, "schema",
+                f"no committed baseline for engine {h.name!r} in "
+                f"{SCHEMA_NAME} (ANALYZE_UPDATE=1 to record)",
+            )
+        mism = diff_schema(h.name, rows, want)
+        if mism:
+            raise GuardViolation(
+                h.name, "schema",
+                f"{len(mism)} state-leaf drift(s) vs {SCHEMA_NAME} "
+                f"(ANALYZE_UPDATE=1 rewrites): " + "; ".join(mism[:5]),
+            )
+    return rows
+
+
+def check_donation(h: EngineHarness):
+    """The lowered step must donate its state buffers."""
+    lowered = _lower(h)
+    txt = lowered.as_text()
+    if not any(m in txt for m in _DONATION_MARKERS):
+        raise GuardViolation(
+            h.name, "donation",
+            "no buffer-donation markers in the lowered step — state "
+            "buffers are copied every round (donate_argnums lost?)",
+        )
+
+
+def _lower(h: EngineHarness):
+    kw = dict(h.static_kwargs)
+    net = kw.pop("net", None)
+    args = h.make_args(0)
+    if net is not None:
+        return h.jit_fn.lower(net, h.state, *args, **kw)
+    return h.jit_fn.lower(h.state, *args, **kw)
+
+
+def run_rounds_guarded(h: EngineHarness, rounds: int = GUARD_ROUNDS):
+    """Execute ``rounds`` steps with fresh per-round publish args under
+    transfer_guard('disallow'); assert exactly one compile."""
+    import jax
+
+    # per-round args built OUTSIDE the guard: only the loop is pinned
+    all_args = [h.make_args(i) for i in range(rounds)]
+    kw = dict(h.static_kwargs)
+    net = kw.pop("net", None)
+    state = h.state
+    before = h.jit_fn._cache_size()
+    with jax.transfer_guard("disallow"):
+        try:
+            for args in all_args:
+                if net is not None:
+                    state = h.jit_fn(net, state, *args, **kw)
+                else:
+                    state = h.jit_fn(state, *args, **kw)
+        except Exception as e:
+            raise GuardViolation(
+                h.name, "transfer",
+                f"round loop tripped the transfer guard: "
+                f"{type(e).__name__}: {str(e)[:300]}",
+            ) from e
+    compiles = h.jit_fn._cache_size() - before
+    if compiles != 1:
+        raise GuardViolation(
+            h.name, "recompile",
+            f"{compiles} compiles across a {rounds}-round run (expected "
+            "exactly 1) — static-arg wobble, weak-type drift, or an "
+            "unhashable config is cache-busting the step",
+        )
+    return state
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def load_baseline(root: str | None = None) -> dict | None:
+    path = os.path.join(root or _repo_root(), SCHEMA_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_baseline(schemas: dict, root: str | None = None) -> str:
+    path = os.path.join(root or _repo_root(), SCHEMA_NAME)
+    payload = {
+        "schema": 1,
+        "note": (
+            "state-tree leaf baseline for make analyze "
+            "(analysis/guards.py); ANALYZE_UPDATE=1 rewrites"
+        ),
+        "shape": {"n_peers": GUARD_N, "msg_slots": GUARD_M,
+                  "rounds_per_phase": GUARD_R},
+        "engines": {
+            name: {"leaves": rows} for name, rows in schemas.items()
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def run_engine(name: str, baseline: dict | None) -> list:
+    """All guards for one engine; returns its schema rows."""
+    h = build_engine(name)
+    out_tree = strict_trace(h)
+    rows = check_schema(h, out_tree, baseline)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
+def run(update: bool | None = None, root: str | None = None) -> list:
+    """The full harness over every engine. Returns a list of failure
+    strings (empty = pass). ``update`` (default: env ANALYZE_UPDATE)
+    rewrites the schema baseline from this run instead of comparing."""
+    if update is None:
+        update = bool(os.environ.get("ANALYZE_UPDATE"))
+    baseline = None if update else load_baseline(root)
+    if baseline is None and not update:
+        return [
+            f"{SCHEMA_NAME} missing — run ANALYZE_UPDATE=1 "
+            "scripts/analyze.py to record the baseline"
+        ]
+    failures: list[str] = []
+    schemas: dict[str, list] = {}
+    for name in ENGINES:
+        try:
+            schemas[name] = run_engine(name, baseline)
+        except GuardViolation as e:
+            failures.append(str(e))
+        except Exception as e:  # noqa: BLE001 — any crash is a finding
+            failures.append(f"[{name}] harness crashed: "
+                            f"{type(e).__name__}: {str(e)[:300]}")
+    if update and not failures:
+        write_baseline(schemas, root)
+    return failures

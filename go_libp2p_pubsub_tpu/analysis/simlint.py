@@ -1,0 +1,654 @@
+"""simlint — repo-specific AST lint for the vectorized simulator.
+
+pytest can only catch what a test executes; these rules catch the
+failure modes that *trace fine and run wrong* (or run fine today and
+recompile/corrupt silently after the next refactor). Every rule is
+calibrated against this repo's idioms — plane tensors, packed uint32
+word algebra, the fold_in/counter-mode key discipline — so the clean
+state is enforceable: the repo lints clean (tests/test_analysis.py
+pins it) and intentional exceptions are committed to ``ALLOWLIST``.
+
+Rule catalog (ids are stable; docs/DESIGN.md §9):
+
+  traced-branch  Python ``if``/``while``/``assert`` whose test calls
+                 ``jnp.*`` / ``jax.lax.*`` / ``jax.random.*`` in device
+                 scope (models/, ops/, score/, chaos/, state.py).
+                 Branching on a traced value either fails at trace time
+                 or — worse — silently bakes one branch into the
+                 compiled program. Host-side numpy branching (e.g.
+                 ops/edges.detect_banded) is untouched: the rule keys
+                 on jnp-rooted calls, not method syntax. (Method-form
+                 ``x.any()`` on tracers is the guard harness's job —
+                 it raises at trace time.)
+  host-sync      ``.item()`` / ``.tolist()`` / ``.block_until_ready()``
+                 anywhere in device scope, plus ``np.asarray`` /
+                 ``np.array`` / ``float()`` / ``int()`` / ``bool()``
+                 inside *traced* functions (jit-decorated, jit-wrapped,
+                 or the step/_round/_phase/body closures a ``make_*``
+                 builder returns). Each is a device→host sync that
+                 serializes the round loop — the reference's event-loop
+                 equivalent of blocking the single writer goroutine.
+  prng-key       ``jax.random`` sampler calls in device scope whose key
+                 does not flow from ``fold_in``/``split`` (of the sim
+                 key or a key-named parameter), fresh ``jax.random.key``
+                 / ``PRNGKey`` constants inside traced functions, and
+                 the same key name fed to two samplers in one function
+                 (key reuse — correlated draws, the bug class the
+                 counter-mode fault-hash scheme exists to avoid).
+  word-dtype     bare Python-int literals in packed-word bitwise ops
+                 (``& | ^ << >>``) in ops/bitset.py or any function
+                 with word-plane parameters. Weak-int mixing is where
+                 silent promotion corrupts uint32 planes the moment
+                 someone swaps an operand to a strong int32 array; the
+                 committed fix is explicit ``jnp.uint32`` literals.
+  import-exec    ``jnp.*`` / ``jax.lax.*`` / ``jax.random.*`` executed
+                 at import time (module or class body, outside any
+                 function/lambda) anywhere in the package. Import-time
+                 device execution breaks JAX_PLATFORMS forcing and the
+                 virtual-device test harness, and hides compile cost in
+                 import.
+  config-hash    ``*Config`` dataclasses in device scope must be
+                 ``frozen=True`` with hashable field types (no list/
+                 dict/set/ndarray annotations): configs ride jit
+                 ``static_argnames`` (floodsub_step's ``chaos``) and an
+                 unhashable config turns every call into a TypeError —
+                 or, with ``eq`` but broken ``hash``, a silent
+                 recompile per call.
+  ev-drain       every ``EV.*`` counter in trace/events.py must be (a)
+                 referenced outside trace/ (someone accumulates it),
+                 and (b) either emitted by trace/drain.py as a
+                 ``TraceEvent.<NAME>`` record (proto-backed events) or
+                 named in drain.py's counter-only documentation
+                 (sim-only counters) — so no counter can silently stop
+                 being drained or documented.
+
+Allowlist: ``analysis/ALLOWLIST`` lines of ``<rule> <relpath>`` or
+``<rule> <relpath>::<qualname>`` (``#`` comments). Entries match every
+violation of that rule in that file (or function). Keep it short — an
+allowlist entry is a reviewed, documented exception, not a mute button.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+#: device-scope prefixes (package-relative): the code that runs inside
+#: jitted steps or builds their constants
+DEVICE_SCOPE = ("models/", "ops/", "score/", "chaos/", "state.py")
+
+#: call roots that mean "this expression executes on device"
+_JNP_ROOTS = ("jnp.", "jax.numpy.", "jax.lax.", "jax.random.", "lax.")
+
+#: jax.random callables that produce/derive keys rather than sample
+_KEY_FNS = {
+    "key", "PRNGKey", "fold_in", "split", "key_data", "wrap_key_data",
+    "key_impl", "clone",
+}
+
+#: nested-def names a make_* builder returns as its traced step
+_TRACED_NESTED = {"step", "_round", "_phase", "body", "hb"}
+
+#: host→device conversion callables flagged inside traced functions
+_HOST_CONVERSIONS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "float", "int", "bool",
+}
+
+#: attribute calls that force a device→host sync wherever they appear
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+#: files never linted (generated code)
+_SKIP_DIRS = ("pb", "__pycache__")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    rel: str       # package-relative path, e.g. "models/gossipsub.py"
+    line: int
+    qual: str      # enclosing def chain, "" at module level
+    msg: str
+
+    def format(self) -> str:
+        where = f"{self.rel}:{self.line}"
+        if self.qual:
+            where += f" ({self.qual})"
+        return f"[{self.rule}] {where}: {self.msg}"
+
+
+def _walk_shallow(fn: ast.AST):
+    """ast.walk that does NOT descend into nested function bodies — each
+    def is analyzed exactly once, in its own scope (nested defs are
+    yielded by _iter_functions separately)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _call_root(node: ast.AST) -> str:
+    """Dotted-source prefix of a call's func, '' when not a plain chain."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse of exotic nodes
+        return ""
+
+
+def _in_device_scope(rel: str) -> bool:
+    return rel.startswith(DEVICE_SCOPE)
+
+
+def _iter_functions(tree: ast.Module):
+    """Yield (qualname, FunctionDef, parents) for every def, outermost
+    first."""
+    stack: list[tuple[str, ast.AST]] = [("", tree)]
+    while stack:
+        prefix, node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield qual, child
+                stack.append((qual, child))
+            elif isinstance(child, ast.ClassDef):
+                cq = f"{prefix}.{child.name}" if prefix else child.name
+                stack.append((cq, child))
+
+
+def _jitted_names(tree: ast.Module) -> set:
+    """Function names wrapped by jax.jit at module level:
+    ``jax.jit(step...)`` / ``jit(step...)`` call args."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            root = _call_root(node.func)
+            if root in ("jax.jit", "jit") and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Name):
+                    names.add(a0.id)
+    return names
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        src = _call_root(dec)
+        if "jit" in src:
+            return True
+    return False
+
+
+def _traced_functions(tree: ast.Module):
+    """The functions whose bodies trace into compiled steps: jit-
+    decorated defs, defs passed to jax.jit, and the conventional
+    step/_round/_phase/body closures inside make_* builders (the repo's
+    builder idiom — make_gossipsub_step returns ``step``)."""
+    jit_wrapped = _jitted_names(tree)
+    out = []
+    for qual, fn in _iter_functions(tree):
+        if _is_jit_decorated(fn) or fn.name in jit_wrapped:
+            out.append((qual, fn))
+        elif fn.name in _TRACED_NESTED and "." in qual:
+            outer = qual.split(".")[0]
+            if outer.startswith("make_") or outer in _TRACED_NESTED:
+                out.append((qual, fn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-file rules
+
+
+def _rule_traced_branch(rel, tree, out):
+    if not _in_device_scope(rel):
+        return
+    for qual, fn in _iter_functions(tree):
+        for node in _walk_shallow(fn):
+            if not isinstance(node, (ast.If, ast.While, ast.Assert)):
+                continue
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Call):
+                    root = _call_root(sub.func)
+                    if root.startswith(_JNP_ROOTS):
+                        out.append(Violation(
+                            "traced-branch", rel, node.lineno, qual,
+                            f"Python {type(node).__name__.lower()} on a "
+                            f"device expression: {ast.unparse(node.test)[:80]}"
+                            " — use jnp.where/lax.cond or hoist to host",
+                        ))
+                        break
+
+
+def _rule_host_sync(rel, tree, out):
+    if not _in_device_scope(rel):
+        return
+    # sync methods: anywhere in device scope
+    for qual, fn in _iter_functions(tree):
+        for node in _walk_shallow(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_METHODS):
+                out.append(Violation(
+                    "host-sync", rel, node.lineno, qual,
+                    f".{node.func.attr}() forces a device->host sync",
+                ))
+    # conversions: only inside traced-function bodies (builders
+    # legitimately run numpy on static data before the trace), and only
+    # when the argument can actually reference a traced value — the
+    # function's own parameters or locals assigned from jnp-rooted
+    # calls. ``float(cfg.threshold)`` / ``int(np_static[-1])`` in a
+    # step body are host statics evaluated once at trace time, not
+    # per-call syncs.
+    for qual, fn in _traced_functions(tree):
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+                  if a.arg not in ("self",)}
+        jnp_locals = set()
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.Assign):
+                rooted = any(
+                    isinstance(c, ast.Call)
+                    and _call_root(c.func).startswith(_JNP_ROOTS)
+                    for c in ast.walk(node.value)
+                )
+                if rooted:
+                    for tgt in node.targets:
+                        for t in ast.walk(tgt):
+                            if isinstance(t, ast.Name):
+                                jnp_locals.add(t.id)
+        traced_names = params | jnp_locals
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.Call):
+                root = _call_root(node.func)
+                if root in _HOST_CONVERSIONS and node.args and any(
+                    isinstance(n, ast.Name) and n.id in traced_names
+                    for n in ast.walk(node.args[0])
+                ):
+                    out.append(Violation(
+                        "host-sync", rel, node.lineno, qual,
+                        f"{root}(...) of a traced value inside a jitted "
+                        "step — a host round-trip per call (keep it jnp)",
+                    ))
+
+
+def _key_derived_names(fn: ast.FunctionDef) -> set:
+    """Names assigned (incl. tuple-unpacked) from fold_in/split calls."""
+    derived = set()
+    for node in _walk_shallow(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            root = _call_root(node.value.func)
+            if root.endswith((".fold_in", ".split")) or root in ("fold_in", "split"):
+                for tgt in node.targets:
+                    for t in ([tgt] if isinstance(tgt, ast.Name)
+                              else list(ast.walk(tgt))):
+                        if isinstance(t, ast.Name):
+                            derived.add(t.id)
+    return derived
+
+
+def _is_keyish_name(name: str) -> bool:
+    low = name.lower()
+    return "key" in low or "rng" in low or re.fullmatch(r"k[a-z]?\d*", low) is not None
+
+
+def _rule_prng_key(rel, tree, out):
+    if not _in_device_scope(rel):
+        return
+    traced_ids = {id(fn) for _, fn in _traced_functions(tree)}
+    fns = list(_iter_functions(tree))
+    by_qual = dict(fns)
+    for qual, fn in fns:
+        # lexical scoping: keys split/folded in an ENCLOSING function are
+        # legitimately closed over by nested defs (heartbeat's k1..k6
+        # feeding _over_subscribed/_oppo_grafts)
+        derived = set()
+        params = set()
+        parts = qual.split(".")
+        for i in range(len(parts)):
+            anc = by_qual.get(".".join(parts[: i + 1]))
+            if anc is not None:
+                derived |= _key_derived_names(anc)
+                params |= {a.arg for a in anc.args.args + anc.args.kwonlyargs}
+        key_uses: dict[str, int] = {}
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            root = _call_root(node.func)
+            m = re.fullmatch(r"(?:jax\.)?random\.(\w+)", root)
+            if m is None:
+                continue
+            name = m.group(1)
+            if name in ("key", "PRNGKey"):
+                if id(fn) in traced_ids and node.args and isinstance(
+                        node.args[0], ast.Constant):
+                    out.append(Violation(
+                        "prng-key", rel, node.lineno, qual,
+                        f"fresh constant key jax.random.{name}(...) inside "
+                        "a traced step — every round draws the same stream; "
+                        "fold_in(sim_key, tick) instead",
+                    ))
+                continue
+            if name in _KEY_FNS or not node.args:
+                continue
+            key_arg = node.args[0]
+            ok = False
+            if isinstance(key_arg, ast.Call):
+                kroot = _call_root(key_arg.func)
+                ok = kroot.endswith((".fold_in", ".split")) or kroot in (
+                    "fold_in", "split")
+            elif isinstance(key_arg, ast.Subscript) and isinstance(
+                    key_arg.value, ast.Name):
+                nm = key_arg.value.id
+                ok = nm in derived or (nm in params and _is_keyish_name(nm))
+            elif isinstance(key_arg, ast.Name):
+                # provenance, not naming: a local must be ASSIGNED from
+                # fold_in/split — ``key = st.key`` does not qualify; only
+                # key-named *parameters* are trusted (the builder passes
+                # a derived key in — callers are linted at their level)
+                nm = key_arg.id
+                ok = nm in derived or (nm in params and _is_keyish_name(nm))
+                if ok:
+                    key_uses[nm] = key_uses.get(nm, 0) + 1
+                    if key_uses[nm] > 1:
+                        out.append(Violation(
+                            "prng-key", rel, node.lineno, qual,
+                            f"key {nm!r} feeds a second sampler in this "
+                            "function — split() it (reused keys correlate "
+                            "draws)",
+                        ))
+                        continue
+            if not ok:
+                out.append(Violation(
+                    "prng-key", rel, node.lineno, qual,
+                    f"jax.random.{name} key {ast.unparse(key_arg)[:40]!r} "
+                    "does not flow from fold_in/split of the sim key",
+                ))
+
+
+def _words_scope_functions(rel, tree):
+    """Functions subject to word-dtype: everything in ops/bitset.py,
+    plus any function whose name or parameters mention word planes."""
+    for qual, fn in _iter_functions(tree):
+        if rel == "ops/bitset.py":
+            yield qual, fn
+            continue
+        names = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        names.add(fn.name)
+        if any("word" in n or n in ("planes",) for n in names):
+            yield qual, fn
+
+
+_BITWISE = (ast.BitAnd, ast.BitOr, ast.BitXor, ast.LShift, ast.RShift)
+
+
+def _rule_word_dtype(rel, tree, out):
+    if not _in_device_scope(rel):
+        return
+    for qual, fn in _words_scope_functions(rel, tree):
+        for node in _walk_shallow(fn):
+            if isinstance(node, ast.AugAssign) and isinstance(
+                    node.op, _BITWISE):
+                sides = (node.value,)
+            elif isinstance(node, ast.BinOp) and isinstance(
+                    node.op, _BITWISE):
+                sides = (node.left, node.right)
+            else:
+                continue
+            for side in sides:
+                if isinstance(side, ast.Constant) and isinstance(
+                        side.value, int) and not isinstance(side.value, bool):
+                    out.append(Violation(
+                        "word-dtype", rel, node.lineno, qual,
+                        f"bare int {side.value!r} in packed-word "
+                        f"{type(node.op).__name__} — wrap in jnp.uint32() "
+                        "(weak-int mixing promotes uint32 planes the moment "
+                        "an operand turns strongly typed)",
+                    ))
+
+
+def _rule_import_exec(rel, tree, out):
+    def scan(body, qual):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.ClassDef):
+                scan(node.body, f"{qual}.{node.name}" if qual else node.name)
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Lambda):
+                    # default_factory=lambda: jnp.int32(0) runs at call
+                    # time, not import — skipped via the walk below
+                    continue
+                if isinstance(sub, ast.Call):
+                    in_lambda = False
+                    root = _call_root(sub.func)
+                    if root.startswith(_JNP_ROOTS):
+                        # re-check: is this call inside a Lambda subtree?
+                        for lam in ast.walk(node):
+                            if isinstance(lam, ast.Lambda) and any(
+                                    s is sub for s in ast.walk(lam)):
+                                in_lambda = True
+                                break
+                        if not in_lambda:
+                            out.append(Violation(
+                                "import-exec", rel, sub.lineno, qual,
+                                f"{root}(...) executes on device at import "
+                                "time — breaks platform forcing; build "
+                                "lazily (function or default_factory)",
+                            ))
+    scan(tree.body, "")
+
+
+_UNHASHABLE_ANN = re.compile(
+    r"\b(list|dict|set|List|Dict|Set|ndarray|jax\.Array|jnp\.ndarray)\b"
+)
+
+
+def _rule_config_hash(rel, tree, out):
+    if not _in_device_scope(rel):
+        return
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Config")):
+            continue
+        is_dc, frozen = False, False
+        for dec in node.decorator_list:
+            src = _call_root(dec)
+            if "struct.dataclass" in src:
+                is_dc = False  # flax state trees are not static configs
+                break
+            if "dataclass" in src:
+                is_dc = True
+                if isinstance(dec, ast.Call):
+                    for kw in dec.keywords:
+                        if kw.arg == "frozen" and isinstance(
+                                kw.value, ast.Constant) and kw.value.value:
+                            frozen = True
+        if not is_dc:
+            continue
+        if not frozen:
+            out.append(Violation(
+                "config-hash", rel, node.lineno, node.name,
+                f"{node.name} is a mutable dataclass — static jit args "
+                "must be frozen=True (hashable)",
+            ))
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and _UNHASHABLE_ANN.search(
+                    ast.unparse(stmt.annotation)):
+                out.append(Violation(
+                    "config-hash", rel, stmt.lineno, node.name,
+                    f"field {ast.unparse(stmt.target)}: "
+                    f"{ast.unparse(stmt.annotation)} is unhashable — use "
+                    "tuple/frozenset so the config can ride static_argnames",
+                ))
+
+
+_FILE_RULES = (
+    _rule_traced_branch,
+    _rule_host_sync,
+    _rule_prng_key,
+    _rule_word_dtype,
+    _rule_import_exec,
+    _rule_config_hash,
+)
+
+
+# ---------------------------------------------------------------------------
+# package rule: EV-counter completeness
+
+
+def _ev_members(events_src: str) -> list:
+    tree = ast.parse(events_src)
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "EV":
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and isinstance(
+                        stmt.targets[0], ast.Name):
+                    out.append(stmt.targets[0].id)
+    return out
+
+
+def _proto_event_names(proto_src: str) -> set:
+    m = re.search(r"enum\s+Type\s*\{(.*?)\}", proto_src, re.S)
+    if not m:
+        return set()
+    return set(re.findall(r"^\s*(\w+)\s*=\s*\d+\s*;", m.group(1), re.M))
+
+
+def check_ev_drain(ev_names, proto_names, drain_src: str,
+                   package_refs: set) -> list:
+    """The ev-drain rule on explicit inputs (unit-testable)."""
+    out = []
+    for name in ev_names:
+        if name not in package_refs:
+            out.append(Violation(
+                "ev-drain", "trace/events.py", 1, "EV",
+                f"EV.{name} is never accumulated or consumed outside "
+                "trace/events.py — dead counter or missing wiring",
+            ))
+        if name in proto_names:
+            if f"TraceEvent.{name}" not in drain_src:
+                out.append(Violation(
+                    "ev-drain", "trace/drain.py", 1, "",
+                    f"proto event EV.{name} has no TraceEvent.{name} "
+                    "emission in the drain — the reconstructive tracer "
+                    "silently drops it",
+                ))
+        elif name not in drain_src:
+            out.append(Violation(
+                "ev-drain", "trace/drain.py", 1, "",
+                f"sim-only counter EV.{name} is not documented in the "
+                "drain (counter_events exposes it, but the drain contract "
+                "must say so by name)",
+            ))
+    return out
+
+
+def _rule_ev_drain(pkg_root: str) -> list:
+    events_p = os.path.join(pkg_root, "trace", "events.py")
+    drain_p = os.path.join(pkg_root, "trace", "drain.py")
+    proto_p = os.path.join(pkg_root, "pb", "pubsub_trace.proto")
+    with open(events_p) as f:
+        ev_names = _ev_members(f.read())
+    with open(drain_p) as f:
+        drain_src = f.read()
+    proto_names = set()
+    if os.path.exists(proto_p):
+        with open(proto_p) as f:
+            proto_names = _proto_event_names(f.read())
+    refs = set()
+    for rel, src in _iter_package_sources(pkg_root):
+        # the whole trace/ package is excluded from the accumulation
+        # sweep: the drain naming a counter (COUNTER_ONLY_EVENTS, the
+        # generic counter_events loop) is consumption, not accumulation
+        # — counting it would make the check vacuous for exactly the
+        # counters it protects
+        if rel.startswith("trace/"):
+            continue
+        for m in re.finditer(r"\bEV\.(\w+)", src):
+            refs.add(m.group(1))
+    return check_ev_drain(ev_names, proto_names, drain_src, refs)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+
+def _iter_package_sources(pkg_root: str):
+    for dirpath, dirs, files in os.walk(pkg_root):
+        dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, f)
+            rel = os.path.relpath(p, pkg_root).replace(os.sep, "/")
+            with open(p) as fh:
+                yield rel, fh.read()
+
+
+def lint_source(src: str, rel: str) -> list:
+    """Per-file rules on a source string (the negative-test surface)."""
+    tree = ast.parse(src)
+    out: list[Violation] = []
+    for rule in _FILE_RULES:
+        rule(rel, tree, out)
+    return out
+
+
+def lint_package(pkg_root: str) -> list:
+    out: list[Violation] = []
+    for rel, src in _iter_package_sources(pkg_root):
+        try:
+            out.extend(lint_source(src, rel))
+        except SyntaxError as e:  # pragma: no cover
+            out.append(Violation("parse", rel, e.lineno or 1, "", str(e)))
+    out.extend(_rule_ev_drain(pkg_root))
+    return sorted(out, key=lambda v: (v.rel, v.line, v.rule))
+
+
+# ---------------------------------------------------------------------------
+# allowlist
+
+
+def load_allowlist(path: str) -> list:
+    """Parse ALLOWLIST lines: ``<rule> <relpath>[::<qualname>]``."""
+    entries = []
+    if not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"{path}:{ln}: expected '<rule> "
+                                 f"<relpath>[::<qual>]', got {line!r}")
+            rule, target = parts
+            rel, _, qual = target.partition("::")
+            entries.append((rule, rel, qual or None))
+    return entries
+
+
+def filter_allowed(violations, allowlist):
+    """(kept, allowed) after applying allowlist entries."""
+    kept, allowed = [], []
+    for v in violations:
+        hit = any(
+            r == v.rule and rel == v.rel and (q is None or q == v.qual)
+            for r, rel, q in allowlist
+        )
+        (allowed if hit else kept).append(v)
+    return kept, allowed
+
+
+def run(pkg_root: str | None = None) -> tuple:
+    """Lint the package with the committed allowlist applied. Returns
+    (violations, allowed)."""
+    if pkg_root is None:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    allow = load_allowlist(os.path.join(pkg_root, "analysis", "ALLOWLIST"))
+    return filter_allowed(lint_package(pkg_root), allow)

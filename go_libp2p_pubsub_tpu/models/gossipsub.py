@@ -1155,7 +1155,11 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     if cfg.score_enabled:
         gossip_cand = gossip_cand & (scores_b >= cfg.gossip_threshold)
     n_cand = count_true(gossip_cand)
-    target = jnp.maximum(cfg.Dlazy, (cfg.gossip_factor * n_cand).astype(jnp.int32))
+    target = jnp.maximum(
+        cfg.Dlazy,
+        (jnp.float32(cfg.gossip_factor) * n_cand.astype(jnp.float32))
+        .astype(jnp.int32),
+    )
     chosen = select_random_mask(k6, gossip_cand, target)  # [N,S,K]
 
     slot_tw = slot_topic_words(net, st.core.msgs.topic)  # [N,S,W]
@@ -1174,7 +1178,11 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         n_cand_f = count_true(gossip_cand_f)
         target_f = jnp.where(
             (ft >= 0),
-            jnp.maximum(cfg.Dlazy, (cfg.gossip_factor * n_cand_f).astype(jnp.int32)),
+            jnp.maximum(
+                cfg.Dlazy,
+                (jnp.float32(cfg.gossip_factor) * n_cand_f.astype(jnp.float32))
+                .astype(jnp.int32),
+            ),
             0,
         )
         chosen_f = select_random_mask(kf2, gossip_cand_f, target_f)  # [N,F,K]

@@ -57,7 +57,7 @@ def bit_get(words: jax.Array, idx: jax.Array) -> jax.Array:
     """Gather single bits: words uint32[..., W], idx int[...] -> bool[...]."""
     w = idx // WORD
     s = (idx % WORD).astype(jnp.uint32)
-    return ((take_word(words, w) >> s) & 1).astype(bool)
+    return ((take_word(words, w) >> s) & jnp.uint32(1)).astype(bool)
 
 
 def bit_set(words: jax.Array, idx: jax.Array, on: jax.Array) -> jax.Array:
@@ -109,7 +109,7 @@ def lowest_bit(words: jax.Array) -> tuple[jax.Array, jax.Array]:
         axis=-1, dtype=jnp.int32,
     )
     # lowest set bit position within the word: popcount((w-1) & ~w)
-    lsb = jax.lax.population_count((word - 1) & ~word)
+    lsb = jax.lax.population_count((word - jnp.uint32(1)) & ~word)
     idx = widx * WORD + lsb.astype(jnp.int32)
     return jnp.where(any_set, idx, 0), any_set
 

@@ -57,6 +57,15 @@ from .events import EV
 
 PROTOCOL_NAMES = {0: "/floodsub/1.0.0", 1: "/meshsub/1.0.0", 2: "/meshsub/1.1.0"}
 
+#: sim-only counters with NO trace.proto record type: never expanded
+#: into per-event TraceEvents (not even in exact mode — the reference's
+#: event stream has no LinkDown/IwantRecover records to emit), exposed
+#: exclusively through ``counter_events()`` at phase-cadence resolution
+#: (docs/DESIGN.md §8). Every other EV.* member maps 1:1 to a
+#: TraceEvent emission below; the ``ev-drain`` simlint rule
+#: (analysis/simlint.py) pins both halves of that contract.
+COUNTER_ONLY_EVENTS = (EV.LINK_DOWN, EV.IWANT_RECOVER)
+
 
 def peer_id(i: int) -> bytes:
     """Stable opaque peer-id bytes for a peer index."""

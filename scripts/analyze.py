@@ -1,0 +1,81 @@
+"""``make analyze`` — the analysis-plane gate (docs/DESIGN.md §9).
+
+Two halves, either of which failing exits non-zero:
+
+  1. **simlint** (analysis/simlint.py): AST lint over the whole package
+     with the repo-specific rule set; intentional exceptions live in
+     the committed ``analysis/ALLOWLIST``.
+  2. **trace guards** (analysis/guards.py): re-trace + run all four
+     engines under strict dtype promotion, jax_enable_checks and the
+     transfer guard; assert one compile per engine, buffer donation,
+     and the committed ``STATE_SCHEMA.json`` state-leaf baseline
+     (``ANALYZE_UPDATE=1`` rewrites it — the PERF_SMOKE pattern).
+
+CPU-only by contract, like perf-smoke/chaos-smoke: it must mean the
+same thing on any dev box or CI runner. Emits one JSON summary line;
+human-readable findings go to stderr.
+
+Flags: ``--lint-only`` / ``--guards-only``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    lint_only = "--lint-only" in argv
+    guards_only = "--guards-only" in argv
+
+    failures: list[str] = []
+    summary: dict = {}
+
+    if not guards_only:
+        from go_libp2p_pubsub_tpu.analysis import simlint
+
+        violations, allowed = simlint.run()
+        for v in violations:
+            failures.append(v.format())
+        summary["lint"] = {
+            "violations": len(violations), "allowed": len(allowed),
+        }
+
+    if not lint_only:
+        import jax
+
+        # CPU + the bench PRNG + the shared persistent compile cache —
+        # identical policy to perf/regress.py, so the guard shapes
+        # compile once per container, not once per run
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+        from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(os.path.join(_ROOT, ".jax_cache"))
+
+        from go_libp2p_pubsub_tpu.analysis import guards
+
+        guard_failures = guards.run()
+        failures.extend(guard_failures)
+        summary["guards"] = {
+            "engines": list(guards.ENGINES),
+            "failures": len(guard_failures),
+            "updated": bool(os.environ.get("ANALYZE_UPDATE")),
+        }
+
+    if failures:
+        for f in failures:
+            print(f"analyze FAIL: {f}", file=sys.stderr)
+        print(json.dumps({"analyze": "FAIL", **summary}))
+        return 1
+    print(json.dumps({"analyze": "PASS", **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,384 @@
+"""Analysis-plane tests: every simlint rule and every trace guard must
+FIRE on a deliberately broken snippet/config (negative), and the repo
+itself must pass clean (positive) — so `make analyze` is demonstrably a
+live gate, not a rubber stamp. docs/DESIGN.md §9."""
+
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.analysis import guards, simlint
+from go_libp2p_pubsub_tpu.analysis.guards import (
+    EngineHarness,
+    GuardViolation,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "go_libp2p_pubsub_tpu")
+
+
+def lint(src, rel="models/broken.py"):
+    return simlint.lint_source(textwrap.dedent(src), rel)
+
+
+def rules_of(violations):
+    return {v.rule for v in violations}
+
+
+# ---------------------------------------------------------------------------
+# simlint rules: each fires on a seeded violation
+
+
+def test_traced_branch_fires():
+    vs = lint("""
+        import jax.numpy as jnp
+        def step(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+    """)
+    assert "traced-branch" in rules_of(vs)
+
+
+def test_traced_branch_ignores_host_numpy():
+    # the calibrated exception: eager numpy branching (detect_banded,
+    # chaos/metrics) is host-side and must NOT fire
+    vs = lint("""
+        import numpy as np
+        def detect(nbr, ok):
+            if not ok.all():
+                return None
+            return np.where(ok, nbr, -1)
+    """, rel="ops/edges.py")
+    assert vs == []
+
+
+def test_host_sync_item_fires():
+    vs = lint("""
+        def drain(state):
+            return state.events.item()
+    """)
+    assert "host-sync" in rules_of(vs)
+
+
+def test_host_sync_nested_fn_fires_once():
+    # scoped walking: a violation in a nested def is reported exactly
+    # once (in its own scope), not re-reported per enclosing function
+    vs = lint("""
+        def make_step():
+            def step(state):
+                return state.events.item()
+            return step
+    """)
+    assert len([v for v in vs if v.rule == "host-sync"]) == 1
+    assert vs[0].qual == "make_step.step"
+
+
+def test_host_sync_conversion_in_traced_step_fires():
+    vs = lint("""
+        import jax, numpy as np
+        @jax.jit
+        def step(state, pub):
+            cap = int(state.tick)
+            return np.asarray(pub)
+    """)
+    assert sum(v.rule == "host-sync" for v in vs) == 2
+
+
+def test_host_sync_static_conversion_ok():
+    # float()/int() of closure statics inside a traced step are
+    # trace-time constants, not per-call syncs
+    vs = lint("""
+        import jax, numpy as np
+        cfg_threshold = 0.5
+        sizes = np.cumsum([1, 2, 3])
+        def make_step(cfg):
+            @jax.jit
+            def step(state, pub):
+                thr = float(cfg_threshold)
+                w = int(sizes[-1])
+                return state
+            return step
+    """)
+    assert vs == []
+
+
+def test_prng_key_underived_fires():
+    vs = lint("""
+        import jax
+        def make_step():
+            def step(st, pub):
+                return jax.random.uniform(st.key, (4,))
+            return step
+    """)
+    assert "prng-key" in rules_of(vs)
+
+
+def test_prng_key_reuse_fires():
+    vs = lint("""
+        import jax
+        def pick(key, shape):
+            a = jax.random.uniform(key, shape)
+            b = jax.random.normal(key, shape)
+            return a + b
+    """)
+    assert any(v.rule == "prng-key" and "second sampler" in v.msg for v in vs)
+
+
+def test_prng_key_constant_in_step_fires():
+    vs = lint("""
+        import jax
+        @jax.jit
+        def step(st):
+            k = jax.random.key(0)
+            return st
+    """)
+    assert "prng-key" in rules_of(vs)
+
+
+def test_prng_key_local_alias_of_state_key_fires():
+    # provenance, not naming: 'key = st.key' is still raw-key reuse
+    vs = lint("""
+        import jax
+        def make_step():
+            def step(st, pub):
+                key = st.key
+                return jax.random.uniform(key, (4,))
+            return step
+    """)
+    assert "prng-key" in rules_of(vs)
+
+
+def test_prng_key_disciplined_ok():
+    vs = lint("""
+        import jax
+        def heartbeat(st, tick):
+            key = jax.random.fold_in(st.key, tick)
+            k1, k2 = jax.random.split(key)
+            noise = jax.random.uniform(k1, (4,))
+            more = jax.random.uniform(k2, (4,))
+            return noise + more
+    """)
+    assert vs == []
+
+
+def test_word_dtype_fires():
+    vs = lint("""
+        import jax.numpy as jnp
+        def bit_probe(words):
+            return words & 1
+    """, rel="ops/bitset.py")
+    assert "word-dtype" in rules_of(vs)
+
+
+def test_word_dtype_augassign_fires():
+    vs = lint("""
+        import jax.numpy as jnp
+        def bit_probe(words):
+            words &= 1
+            return words
+    """, rel="ops/bitset.py")
+    assert "word-dtype" in rules_of(vs)
+
+
+def test_word_dtype_wrapped_ok():
+    vs = lint("""
+        import jax.numpy as jnp
+        def bit_probe(words):
+            return words & jnp.uint32(1)
+    """, rel="ops/bitset.py")
+    assert vs == []
+
+
+def test_import_exec_fires():
+    vs = lint("""
+        import jax.numpy as jnp
+        TABLE = jnp.zeros((4,))
+    """, rel="score/tables.py")
+    assert "import-exec" in rules_of(vs)
+
+
+def test_import_exec_lambda_factory_ok():
+    vs = lint("""
+        import jax.numpy as jnp
+        from flax import struct
+        class Info:
+            n: int = struct.field(default_factory=lambda: jnp.int32(0))
+    """, rel="models/info.py")
+    assert vs == []
+
+
+def test_config_hash_fires():
+    vs = lint("""
+        import dataclasses
+        @dataclasses.dataclass
+        class FlapConfig:
+            rates: list = dataclasses.field(default_factory=list)
+    """, rel="chaos/flap.py")
+    got = [v for v in vs if v.rule == "config-hash"]
+    assert len(got) == 2  # not frozen + unhashable field
+
+
+def test_ev_drain_fires():
+    vs = simlint.check_ev_drain(
+        ["DELIVER_MESSAGE", "LINK_DOWN", "ORPHANED"],
+        {"DELIVER_MESSAGE"},
+        drain_src="TraceEvent.DELIVER_MESSAGE ... EV.LINK_DOWN counter-only",
+        package_refs={"DELIVER_MESSAGE", "LINK_DOWN"},
+    )
+    msgs = " | ".join(v.msg for v in vs)
+    assert "ORPHANED" in msgs                      # undrained + unreferenced
+    assert "DELIVER_MESSAGE" not in msgs           # fully wired
+    assert sum("LINK_DOWN" in v.msg for v in vs) == 0  # documented counter
+
+
+def test_allowlist_filters_by_qual(tmp_path):
+    vs = lint("""
+        def drain(state):
+            return state.events.item()
+    """)
+    assert vs
+    allow = [("host-sync", "models/broken.py", "drain")]
+    kept, allowed = simlint.filter_allowed(vs, allow)
+    assert kept == [] and len(allowed) == len(vs)
+    # a different qualname does not match
+    kept2, _ = simlint.filter_allowed(
+        vs, [("host-sync", "models/broken.py", "other")])
+    assert kept2 == vs
+
+
+def test_allowlist_parse_rejects_garbage(tmp_path):
+    p = tmp_path / "ALLOWLIST"
+    p.write_text("host-sync models/x.py::f extra-token\n")
+    with pytest.raises(ValueError):
+        simlint.load_allowlist(str(p))
+
+
+def test_repo_lints_clean():
+    """The enforced state: zero unallowed violations on the package."""
+    kept, _allowed = simlint.run(PKG)
+    assert kept == [], "\n".join(v.format() for v in kept)
+
+
+# ---------------------------------------------------------------------------
+# trace guards: each fires on a deliberately broken harness
+
+
+def _harness(fn, state, args_of=None, **jit_kw):
+    return EngineHarness(
+        name="broken",
+        jit_fn=jax.jit(fn, **jit_kw),
+        state=state,
+        make_args=args_of or (lambda i: (jnp.ones((4,), jnp.int32),)),
+        static_kwargs={},
+    )
+
+
+def test_guard_strict_dtype_fires():
+    # int32 state mixed with a uint32 operand: standard mode silently
+    # promotes, strict mode is the gate
+    h = _harness(
+        lambda s, a: {"x": s["x"] + a.astype(jnp.uint32)},
+        {"x": jnp.zeros((4,), jnp.int32)},
+    )
+    with pytest.raises(GuardViolation) as ei:
+        guards.strict_trace(h)
+    assert ei.value.guard == "strict-dtype"
+
+
+def test_guard_schema_weak_type_fires():
+    # a pure python-scalar constant in the carry is a weak-typed leaf:
+    # next call re-traces it as an input with a DIFFERENT aval -> the
+    # recompile-per-round bug the schema guard exists to catch
+    h = _harness(lambda s, a: {"x": s["x"], "t": jnp.asarray(0.0)},
+                 {"x": jnp.zeros((4,), jnp.float32)})
+    out = jax.eval_shape(lambda s: h.jit_fn(s, jnp.ones((4,), jnp.int32)),
+                         h.state)
+    assert any(r["weak_type"] for r in guards.schema_of(out))
+    with pytest.raises(GuardViolation) as ei:
+        guards.check_schema(h, out, None)
+    assert ei.value.guard == "schema"
+
+
+def test_guard_schema_drift_fires():
+    h = _harness(lambda s, a: s, {"x": jnp.zeros((4,), jnp.int32)})
+    out = jax.eval_shape(lambda s: s, h.state)
+    rows = guards.schema_of(out)
+    doctored = json.loads(json.dumps(rows))
+    doctored[0]["dtype"] = "int64"
+    baseline = {"engines": {"broken": {"leaves": doctored}}}
+    with pytest.raises(GuardViolation) as ei:
+        guards.check_schema(h, out, baseline)
+    assert ei.value.guard == "schema"
+    assert guards.diff_schema("broken", rows, doctored)
+
+
+def test_guard_schema_missing_engine_fires():
+    h = _harness(lambda s, a: s, {"x": jnp.zeros((4,), jnp.int32)})
+    out = jax.eval_shape(lambda s: s, h.state)
+    with pytest.raises(GuardViolation):
+        guards.check_schema(h, out, {"engines": {}})
+
+
+def test_guard_donation_fires_and_passes():
+    state = {"x": jnp.zeros((8,), jnp.float32)}
+    undonated = _harness(lambda s, a: {"x": s["x"] + 1.0}, state)
+    with pytest.raises(GuardViolation) as ei:
+        guards.check_donation(undonated)
+    assert ei.value.guard == "donation"
+    donated = _harness(lambda s, a: {"x": s["x"] + 1.0}, state,
+                       donate_argnums=0)
+    guards.check_donation(donated)
+
+
+def test_guard_recompile_sentinel_fires():
+    # growing arg shapes cache-bust: one compile per round
+    h = _harness(
+        lambda s, a: s,
+        {"x": jnp.zeros((4,), jnp.int32)},
+        args_of=lambda i: (jnp.ones((4 + i,), jnp.int32),),
+    )
+    with pytest.raises(GuardViolation) as ei:
+        guards.run_rounds_guarded(h, rounds=3)
+    assert ei.value.guard == "recompile"
+
+
+def test_guard_transfer_fires():
+    # a numpy array sneaking into the round loop = an implicit
+    # host->device transfer per call; the guard turns it into an error
+    h = _harness(
+        lambda s, a: {"x": s["x"] + a},
+        {"x": jnp.zeros((4,), jnp.int32)},
+        args_of=lambda i: (np.ones((4,), np.int32),),
+    )
+    with pytest.raises(GuardViolation) as ei:
+        guards.run_rounds_guarded(h, rounds=2)
+    assert ei.value.guard == "transfer"
+
+
+# ---------------------------------------------------------------------------
+# positive: one real engine end-to-end + the committed baseline
+
+
+def test_floodsub_guards_end_to_end():
+    h = guards.build_engine("floodsub")
+    out = guards.strict_trace(h)
+    rows = guards.check_schema(h, out, None)
+    guards.check_donation(h)
+    guards.run_rounds_guarded(h)
+    # the committed STATE_SCHEMA.json matches what this container traces
+    baseline = guards.load_baseline(ROOT)
+    assert baseline is not None, "STATE_SCHEMA.json not committed"
+    want = baseline["engines"]["floodsub"]["leaves"]
+    assert guards.diff_schema("floodsub", rows, want) == []
+
+
+def test_schema_engines_complete():
+    baseline = guards.load_baseline(ROOT)
+    assert baseline is not None
+    assert set(baseline["engines"]) == set(guards.ENGINES)
