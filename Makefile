@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke analyze sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke analyze sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -25,15 +25,29 @@ audit:
 perf-smoke:
 	python -m go_libp2p_pubsub_tpu.perf.regress
 
-# chaos-plane recovery gate (scripts/chaos_report.py --smoke): under
-# i.i.d. link-flap loss gossipsub's delivery ratio must exceed
-# floodsub's (IWANT-recovery share reported); after a 2-group partition
-# heals, mesh-repair latency must be finite and partition-era messages
-# must fully deliver; and the CHAOS-OFF compiled HLO kernel census must
-# EQUAL the committed PERF_SMOKE.json baseline (the elision-when-off
-# contract). ~30 s warm on CPU. docs/DESIGN.md §8.
+# chaos-plane recovery gate (scripts/chaos_report.py --smoke), Monte
+# Carlo since round 10: every cell runs --seeds 8 sims as ONE vmapped
+# program (ensemble plane) and reports median/IQR bands. Asserts: the
+# lazy-gossip machinery lifts delivery in EVERY sim (paired on fault
+# stream vs a Dlazy=0 ablation; IWANT share > 0 per sim); after a
+# 2-group partition heals, the cross mesh re-forms (finite
+# mesh-reform latency per sim) and partition-era messages fully
+# deliver in every sim; and the CHAOS-OFF compiled HLO kernel census
+# must EQUAL the committed PERF_SMOKE.json baseline (the
+# elision-when-off contract). ~50 s warm on CPU. docs/DESIGN.md §8, §10.
 chaos-smoke:
 	python scripts/chaos_report.py --smoke
+
+# ensemble-plane gate (scripts/ensemble_report.py --smoke): the S=8
+# chaos-flap scenario as ONE vmapped XLA program — exactly one compile
+# (cache sentinel), every sim's final state bit-identical to its
+# single-sim run under fold_in(sim_key, i) [threefry pinned], the
+# schema-v2 fingerprint["ensemble"] block round-trips, and aggregate
+# sim-rounds/s stays above the committed ENSEMBLE_SMOKE.json floor
+# (ENSEMBLE_SMOKE_UPDATE=1 rewrites; the sequential 8-run rate is
+# measured alongside for docs/PERF.md). ~30 s on CPU. docs/DESIGN.md §10.
+ensemble-smoke:
+	python scripts/ensemble_report.py --smoke
 
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
@@ -56,12 +70,14 @@ test:
 	python -m pytest tests/ -q
 
 # quick tier: the sub-10-minute CI gate — `not slow` tests plus the CPU
-# perf-smoke regression gate, the chaos-smoke recovery gate and the
-# analysis-plane gate (all fast once the compile cache is warm)
+# perf-smoke regression gate, the chaos-smoke recovery gate, the
+# ensemble-plane gate and the analysis-plane gate (all fast once the
+# compile cache is warm)
 quick:
 	python -m pytest tests/ -q -m "not slow"
 	python -m go_libp2p_pubsub_tpu.perf.regress
 	python scripts/chaos_report.py --smoke
+	python scripts/ensemble_report.py --smoke
 	python scripts/analyze.py
 
 native:

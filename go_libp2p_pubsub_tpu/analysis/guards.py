@@ -60,6 +60,17 @@ SCHEMA_NAME = "STATE_SCHEMA.json"
 
 ENGINES = ("gossipsub", "gossipsub_phase", "floodsub", "randomsub")
 
+#: the batched path (round 10): one ensemble engine — the gossipsub
+#: bench step lifted through ensemble.lift_step at S=ENSEMBLE_S — runs
+#: the same guard set. Its schema is NOT committed separately: every
+#: leaf must be the base engine's leaf with a leading S axis, so the
+#: check STRIPS the leading dim and compares against the committed
+#: ``gossipsub`` rows (ANALYZE_UPDATE=1 refreshes those; the ensemble
+#: rows are always derived, never duplicated into the baseline).
+ENSEMBLE_ENGINE = "ensemble"
+ENSEMBLE_BASE = "gossipsub"
+ENSEMBLE_S = 2
+
 #: StableHLO markers proving the state argument is donated
 _DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
@@ -152,6 +163,27 @@ def build_engine(name: str) -> EngineHarness:
             name, step, st, lambda i: _pub_args((PUB_WIDTH,), i), {}
         )
     raise ValueError(f"unknown engine {name!r}; expected one of {ENGINES}")
+
+
+def build_ensemble_harness() -> EngineHarness:
+    """The batched-path harness: the ENSEMBLE_BASE bench step lifted to
+    S=ENSEMBLE_S sims (ensemble.lift_step — a fresh jit, so the
+    recompile sentinel covers the LIFTED program), driven with tiled
+    publish args. Same guard set as the per-sim engines."""
+    from ..ensemble import batch as ebatch
+    from ..perf.sweep import build_bench
+
+    st, step, _, _ = build_bench(
+        GUARD_N, GUARD_M, heartbeat_every=1, rounds_per_phase=1,
+    )
+    states = ebatch.batch_states(st, ENSEMBLE_S)
+    ens = ebatch.lift_step(step)
+
+    def make_args(i):
+        return tuple(ebatch.tile(a, ENSEMBLE_S)
+                     for a in _pub_args((PUB_WIDTH,), i))
+
+    return EngineHarness(ENSEMBLE_ENGINE, ens, states, make_args, {})
 
 
 def _call(h: EngineHarness, state, i: int):
@@ -261,6 +293,50 @@ def check_schema(h: EngineHarness, out_tree, baseline: dict | None) -> list:
     return rows
 
 
+def strip_leading_sims(engine: str, rows: list, n_sims: int) -> list:
+    """Validate + strip the leading S axis from a batched engine's
+    schema rows: every leaf must carry ``shape[0] == n_sims``; the
+    stripped rows are then comparable to the BASE engine's committed
+    baseline — no duplicated ensemble baseline to rot."""
+    out = []
+    for r in rows:
+        shape = list(r["shape"])
+        if not shape or shape[0] != n_sims:
+            raise GuardViolation(
+                engine, "schema",
+                f"leaf {r['path']} shape {shape} does not carry the "
+                f"leading S={n_sims} sim axis — the vmap lift dropped "
+                "or reordered a batch dimension",
+            )
+        out.append({**r, "shape": shape[1:]})
+    return out
+
+
+def check_schema_batched(h: EngineHarness, out_tree,
+                         base_rows: list | None) -> list:
+    """Schema guard for the ensemble engine: weak-type audit, then the
+    leading-S strip, then comparison against the BASE engine's rows
+    (committed or freshly computed on update runs)."""
+    rows = schema_of(out_tree)
+    weak = [r["path"] for r in rows if r["weak_type"]]
+    if weak:
+        raise GuardViolation(
+            h.name, "schema",
+            f"weak-typed state leaves {weak[:4]} in the batched step",
+        )
+    stripped = strip_leading_sims(h.name, rows, ENSEMBLE_S)
+    if base_rows is not None:
+        mism = diff_schema(h.name, stripped, base_rows)
+        if mism:
+            raise GuardViolation(
+                h.name, "schema",
+                f"{len(mism)} per-sim leaf drift(s) vs the "
+                f"{ENSEMBLE_BASE!r} baseline after stripping the "
+                f"S={ENSEMBLE_S} axis: " + "; ".join(mism[:5]),
+            )
+    return stripped
+
+
 def check_donation(h: EngineHarness):
     """The lowered step must donate its state buffers."""
     lowered = _lower(h)
@@ -359,6 +435,20 @@ def run_engine(name: str, baseline: dict | None) -> list:
     return rows
 
 
+def run_ensemble_engine(base_rows: list | None) -> list:
+    """All guards for the batched path: strict-dtype trace of the S=2
+    lifted step, leading-S schema validation against the base engine's
+    rows, buffer-donation audit of the lifted program, and the
+    GUARD_ROUNDS execution under transfer_guard with the one-compile
+    cache sentinel. Returns the stripped (per-sim) rows."""
+    h = build_ensemble_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_batched(h, out_tree, base_rows)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
 def run(update: bool | None = None, root: str | None = None) -> list:
     """The full harness over every engine. Returns a list of failure
     strings (empty = pass). ``update`` (default: env ANALYZE_UPDATE)
@@ -380,6 +470,34 @@ def run(update: bool | None = None, root: str | None = None) -> list:
             failures.append(str(e))
         except Exception as e:  # noqa: BLE001 — any crash is a finding
             failures.append(f"[{name}] harness crashed: "
+                            f"{type(e).__name__}: {str(e)[:300]}")
+    # the batched path validates against the BASE engine's rows — the
+    # committed ones normally, this run's fresh ones on update (so a
+    # deliberate state change updates ONE baseline and the ensemble
+    # check follows it automatically)
+    if update:
+        base_rows = schemas.get(ENSEMBLE_BASE)
+    else:
+        base_rows = ((baseline or {}).get("engines", {})
+                     .get(ENSEMBLE_BASE) or {}).get("leaves")
+    if base_rows is None:
+        # a hard failure, like check_schema's missing-baseline case —
+        # otherwise per-sim leaf drift in the batched path would pass
+        # silently whenever the gossipsub rows are absent (truncated
+        # baseline, or its harness crashed on an update run)
+        failures.append(
+            f"[{ENSEMBLE_ENGINE}] no {ENSEMBLE_BASE!r} schema rows to "
+            "validate the batched path against (committed baseline "
+            "missing the engine, or its harness failed on this update "
+            "run)"
+        )
+    else:
+        try:
+            run_ensemble_engine(base_rows)
+        except GuardViolation as e:
+            failures.append(str(e))
+        except Exception as e:  # noqa: BLE001 — any crash is a finding
+            failures.append(f"[{ENSEMBLE_ENGINE}] harness crashed: "
                             f"{type(e).__name__}: {str(e)[:300]}")
     if update and not failures:
         write_baseline(schemas, root)

@@ -14,11 +14,14 @@ The runner lives in scripts/chaos_report.py (``make chaos-smoke``).
 
 from .faults import ChaosConfig, ChaosConfigError, resolve  # noqa: F401
 from .metrics import (  # noqa: F401
+    batched_cross_group_mesh_counts,
+    batched_iwant_shares,
     DeliveryStats,
     cross_group_mesh_count,
     delivery_stats,
     iwant_recovery_share,
     links_down_total,
+    mesh_reform_latency,
     mesh_repair_latency,
     time_to_recover,
 )

@@ -102,17 +102,46 @@ def links_down_total(events: np.ndarray) -> int:
     return int(np.asarray(events)[EV.LINK_DOWN])
 
 
+def batched_iwant_shares(events) -> np.ndarray:
+    """[S] per-sim IWANT-recovery shares from BATCHED ensemble event
+    counters (``events [S, N_EVENTS]``) — iwant_recovery_share per
+    sim, one vectorized reduction."""
+    ev = np.asarray(events)
+    deliver = ev[:, EV.DELIVER_MESSAGE].astype(np.float64)
+    return np.where(deliver > 0,
+                    ev[:, EV.IWANT_RECOVER] / np.maximum(deliver, 1.0),
+                    0.0)
+
+
 # ---------------------------------------------------------------------------
 # partition recovery
+
+
+def _cross_edge_mask(nbr, nbr_ok, groups) -> np.ndarray:
+    """[N, K] bool: neighbor-slot positions whose edge crosses the
+    group boundary — the ONE definition of "cross edge" every
+    partition metric (single-sim and batched) counts with."""
+    g = np.asarray(groups, np.int32)
+    return ((g[:, None] != g[np.clip(np.asarray(nbr), 0, None)])
+            & np.asarray(nbr_ok))
 
 
 def cross_group_mesh_count(mesh: np.ndarray, nbr: np.ndarray,
                            nbr_ok: np.ndarray, groups) -> int:
     """Directed cross-group mesh edges in a mesh snapshot ([N, S, K])."""
-    g = np.asarray(groups, np.int32)
-    cross = (g[:, None] != g[np.clip(np.asarray(nbr), 0, None)]) \
-        & np.asarray(nbr_ok)
+    cross = _cross_edge_mask(nbr, nbr_ok, groups)
     return int((np.asarray(mesh) & cross[:, None, :]).sum())
+
+
+def batched_cross_group_mesh_counts(mesh: np.ndarray, nbr: np.ndarray,
+                                    nbr_ok: np.ndarray,
+                                    groups) -> np.ndarray:
+    """[S] directed cross-group mesh edge counts for a BATCHED
+    ensemble mesh snapshot ([S, N, SL, K]) — cross_group_mesh_count
+    per sim, one vectorized reduction."""
+    cross = _cross_edge_mask(nbr, nbr_ok, groups)
+    return (np.asarray(mesh) & cross[None, :, None, :]).sum(
+        axis=(1, 2, 3)).astype(np.int64)
 
 
 def mesh_repair_latency(mesh_series, heal_tick: int,
@@ -127,6 +156,45 @@ def mesh_repair_latency(mesh_series, heal_tick: int,
     for tick, count in sorted(mesh_series):
         if tick >= heal_tick and count >= min_edges:
             return int(tick - heal_tick)
+    return None
+
+
+def mesh_reform_latency(mesh_series, heal_tick: int,
+                        prune_floor: int = 2,
+                        min_edges: int = 6) -> int | None:
+    """Rounds from ``heal_tick`` until cross-group connectivity is
+    RE-ESTABLISHED after the post-heal starvation prune — the
+    band-robust repair metric (round 10).
+
+    The raw ``count >= min_edges`` reading (mesh_repair_latency) is
+    ambiguous right after heal: the mesh map still lists partition-era
+    ZOMBIE edges (entries that carried no traffic through the window;
+    pruning their accumulated P3 deficit is heartbeat-rate-limited, so
+    they drain over ~tens of rounds). Measured from the Monte Carlo
+    band, the real arc is: zombie edges drain to ~zero, then the prune
+    backoff expires and the reference's lazy 15-tick backoff-presence
+    clear (gossipsub.go:1585-1604) releases a re-graft wave. This
+    metric reports that arc: the first tick at/after the count drops
+    to ``prune_floor`` or below (the trough — full starvation prune)
+    where a LATER count reaches ``min_edges`` (re-formed), as
+    ``tick - heal_tick``. A sim whose count never troughs — the
+    starvation prune never completed, so cross connectivity never
+    collapsed — reports 0 provided it stays above ``prune_floor`` for
+    the whole post-heal window and ends re-formed (``>= min_edges``);
+    None when the mesh troughs but never re-forms, or hovers below
+    ``min_edges`` without ever recovering."""
+    post = [(t, c) for t, c in sorted(mesh_series) if t >= heal_tick]
+    troughed = False
+    for tick, count in post:
+        if not troughed:
+            if count <= prune_floor:
+                troughed = True
+            continue
+        if count >= min_edges:
+            return int(tick - heal_tick)
+    # never troughed == every post-heal count stayed above prune_floor
+    if not troughed and post and post[-1][1] >= min_edges:
+        return 0
     return None
 
 

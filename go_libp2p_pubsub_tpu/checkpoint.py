@@ -42,8 +42,15 @@ import numpy as np
 _FORMAT_VERSION = 6
 
 
-def _is_key(leaf) -> bool:
+def is_prng_key(leaf) -> bool:
+    """True for typed PRNG-key array leaves — THE key predicate, shared
+    by the checkpoint backend, the ensemble plane's key-leaf handling
+    (ensemble/batch.py), and the bit-parity comparisons in tests/gates,
+    so all of them agree on what counts as a key."""
     return isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key)
+
+
+_is_key = is_prng_key
 
 
 def save(path: str, state) -> None:

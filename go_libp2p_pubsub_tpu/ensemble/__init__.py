@@ -1,0 +1,50 @@
+"""Ensemble plane: vmapped many-sim execution (docs/DESIGN.md §10).
+
+One simulation at a time leaves statistical power on the table: every
+chaos_report number, BENCH artifact, and parity CDF is a single-seed
+sample, while the GossipSub evaluation methodology (arxiv 2007.02754)
+and Topiary (arxiv 2312.06800) report attack/recovery results as
+distributions over many randomized trials. A leading sim axis driven
+by ``jax.vmap`` is the TPU-native way to get that power: S independent
+simulations become ONE XLA program — one compile, the chip kept full.
+
+  batch   — vmap lifting of the jitted ``make_*_step`` closures plus
+            batched state builders: tiled init trees with per-sim PRNG
+            keys via ``fold_in(sim_key, sim_idx)``, so chaos's
+            counter-mode fault hashes and every sampler stream are
+            automatically independent per sim
+  stats   — cross-sim reductions on device (delivery-ratio and
+            recovery quantiles, pooled latency-CDF percentile bands)
+            plus host-side bootstrap CIs from per-sim summaries
+  runner  — the sweep / Monte Carlo driver: one compile per
+            (config, shape), S sims executed together, composing with
+            parallel/sharding (peer axis sharded as today, sim axis
+            vmapped per shard — or mapped across chips for
+            embarrassingly parallel scaling)
+
+Entry points: ``scripts/ensemble_report.py`` (``make ensemble-smoke``)
+and ``scripts/chaos_report.py --seeds S``.
+"""
+
+from .batch import (  # noqa: F401
+    batch_states,
+    lift_floodsub,
+    lift_step,
+    sim_keys,
+    tile,
+    unbatch,
+    with_sim_key,
+)
+from .runner import (  # noqa: F401
+    EnsembleRun,
+    run_rounds,
+    shard_ensemble_state,
+)
+from .stats import (  # noqa: F401
+    batched_iwant_shares,
+    bootstrap_ci,
+    cdf_bands,
+    latency_cdf_counts,
+    quantile_band,
+    sim_delivery_ratios,
+)

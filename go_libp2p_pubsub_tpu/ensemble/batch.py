@@ -1,0 +1,164 @@
+"""Batched-simulation state builders + vmap lifting of engine steps.
+
+Lifting contract (pinned by tests/test_ensemble.py):
+
+  * **state**: every leaf of the (flax struct) state tree grows a
+    leading S axis. The PRNG key leaf is NOT tiled — sim ``i`` gets
+    ``fold_in(sim_key, i)`` where ``sim_key`` is the unbatched state's
+    key. Everything downstream that derives randomness from the state
+    key — the chaos plane's counter-mode fault hashes
+    (``chaos_seed(key)``), the heartbeat shuffle, randomsub's
+    per-round fanout draw, the gater/fanout subsystem streams — is
+    therefore automatically independent per sim, with no per-subsystem
+    plumbing.
+  * **config stays static**: the lifted step closes over the same
+    ``cfg``/``net``/score tables the unbatched step compiled against —
+    one trace, one compile, S sims.
+  * **per-sim array inputs grow a leading S axis**: publish schedules,
+    churn ``up`` rows, chaos ``link_deny`` masks. One program can run S
+    *different scenarios*, not just S seeds — tile with :func:`tile`
+    when every sim shares an input.
+  * **bit-exactness**: vmapping is elementwise for every op these
+    engines trace *under the threefry PRNG* (the jax default), so sim
+    ``i`` of a batched run equals the unbatched run built with
+    ``with_sim_key(state, sim_key, i)`` bit for bit, at any S. Under
+    ``unsafe_rbg`` the sims are still independent (fold_in separates
+    the keys) but batched sampler draws are NOT bit-identical to
+    single-sim draws — its RngBitGenerator batching rule is not
+    elementwise. Parity gates (ensemble-smoke, the S=1 tests) pin
+    threefry; distribution consumers (chaos_report --seeds) may use
+    either. Chaos fault streams are hash-based and bit-exact under
+    both.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+
+from ..checkpoint import is_prng_key as _is_key
+
+
+def sim_keys(base_key: jax.Array, n_sims: int) -> jax.Array:
+    """[S] per-sim PRNG keys: ``fold_in(base_key, i)`` for each sim."""
+    return jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+        jnp.arange(n_sims, dtype=jnp.int32)
+    )
+
+
+def with_sim_key(state, base_key: jax.Array, sim_idx: int):
+    """The UNBATCHED state whose run sim ``sim_idx`` of a batched run
+    reproduces bit-exactly: every PRNG-key leaf replaced by
+    ``fold_in(base_key, sim_idx)`` (states carry exactly one)."""
+    folded = jax.random.fold_in(base_key, sim_idx)
+    return jax.tree_util.tree_map(
+        lambda x: folded if _is_key(x) else x, state
+    )
+
+
+def tile(x, n_sims: int):
+    """Tile one shared per-sim input to the leading S axis ([...] ->
+    [S, ...]) — for schedules every sim shares; per-sim *scenarios*
+    build the [S, ...] array directly instead."""
+    x = jnp.asarray(x)
+    return jnp.broadcast_to(x[None], (n_sims,) + x.shape)
+
+
+def batch_states(state, n_sims: int, base_key: jax.Array | None = None):
+    """Lift one state tree to S sims: every leaf tiled to a leading S
+    axis, except PRNG keys which become ``fold_in(base_key, i)`` per
+    sim (``base_key`` defaults to the state's own key, so the
+    unbatched state IS the sim-key source of truth)."""
+
+    def g(leaf):
+        if _is_key(leaf):
+            return sim_keys(base_key if base_key is not None else leaf,
+                            n_sims)
+        return tile(leaf, n_sims)
+
+    return jax.tree_util.tree_map(g, state)
+
+
+def unbatch(states, sim_idx: int):
+    """Slice sim ``sim_idx`` out of a batched state tree (host/analysis
+    view; also the per-sim checkpoint-v6 compatibility path — the slice
+    is a plain unbatched state)."""
+    return jax.tree_util.tree_map(lambda x: x[sim_idx], states)
+
+
+def _takes_heartbeat(raw) -> bool:
+    try:
+        params = inspect.signature(raw).parameters
+    except (TypeError, ValueError):  # pragma: no cover — C callables
+        return False
+    p = params.get("do_heartbeat")
+    return p is not None and p.kind == inspect.Parameter.KEYWORD_ONLY
+
+
+def lift_step(step, *, net=None, static_kwargs: dict | None = None,
+              donate: bool = True):
+    """Lift a jitted engine step to an S-leading-axis ensemble step.
+
+    ``step`` is anything the ``make_*_step`` factories return (or a
+    raw jitted function like ``floodsub_step``); the underlying
+    unjitted callable is recovered via ``__wrapped__`` so the ensemble
+    owns a single fresh jit — its compile-cache size IS the ensemble's
+    one-compile sentinel.
+
+    ``net`` closes over an unbatched leading positional (floodsub's
+    calling convention: ``step(net, state, ...)``) so the topology is
+    shared across sims, not vmapped. ``static_kwargs`` are trace-time
+    constants forwarded to every per-sim call (e.g. floodsub's
+    ``chaos=cfg``). Steps whose raw signature carries a keyword-only
+    ``do_heartbeat`` (the phase engine, static-heartbeat builds) keep
+    it as a static kwarg on the lifted step.
+
+    The lifted step maps EVERY positional argument at axis 0: states
+    and all per-round arrays must carry the leading S axis (see
+    :func:`tile`). State buffers are donated like the unbatched steps'.
+    """
+    raw = getattr(step, "__wrapped__", step)
+    sk = dict(static_kwargs or {})
+    has_hb = _takes_heartbeat(raw)
+
+    def ens(states, *args, do_heartbeat=None):
+        kw = dict(sk)
+        if do_heartbeat is not None:
+            kw["do_heartbeat"] = do_heartbeat
+
+        def one(s, *a):
+            if net is not None:
+                return raw(net, s, *a, **kw)
+            return raw(s, *a, **kw)
+
+        return jax.vmap(one)(states, *args)
+
+    jit_kw = {"static_argnames": ("do_heartbeat",)} if has_hb else {}
+    if donate:
+        jit_kw["donate_argnums"] = 0
+    return jax.jit(ens, **jit_kw)
+
+
+def lift_floodsub(net, chaos=None, queue_cap: int = 0):
+    """Convenience lift of the floodsub router (its step is a module-
+    level jitted function taking ``net`` first, unlike the factories).
+    Scheduled-chaos runs pass the per-round ``link_deny`` mask as a
+    trailing positional (the gossipsub scheduled-build convention) —
+    the adapter routes it to floodsub's keyword slot so it vmaps with
+    the other per-sim arrays instead of colliding with ``queue_cap``."""
+    from ..models import floodsub
+
+    raw = getattr(floodsub.floodsub_step, "__wrapped__",
+                  floodsub.floodsub_step)
+
+    def adapter(net_, s, po, pt, pv, *deny):
+        kw = {"queue_cap": queue_cap}
+        if chaos is not None:
+            kw["chaos"] = chaos
+        if deny:
+            kw["link_deny"] = deny[0]
+        return raw(net_, s, po, pt, pv, **kw)
+
+    return lift_step(adapter, net=net)

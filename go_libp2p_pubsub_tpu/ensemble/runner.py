@@ -1,0 +1,143 @@
+"""The ensemble sweep / Monte Carlo driver.
+
+One compile per (config, shape): the lifted step (batch.lift_step) is
+a single fresh jit whose compile-cache size is the ONE-COMPILE
+sentinel — ``run_rounds`` records it, and the ensemble-smoke gate
+(scripts/ensemble_report.py) asserts it equals exactly 1 for the S=8
+chaos smoke scenario. S sims execute together in each dispatch; a
+sweep that used to run S seeds sequentially (S compiles + S runs, or
+one compile amortized over S cold loops) becomes one program whose
+arrays are S× wider — the shape XLA is built to keep a chip full with.
+
+Sharding composition (docs/DESIGN.md §10): two layouts, both through
+:func:`shard_ensemble_state`.
+
+  * ``axis="peers"`` (default) — the peer dimension (now axis 1, after
+    the leading S) is sharded exactly as the unbatched state was
+    (parallel/sharding.py), and the sim axis is vmapped WITHIN each
+    shard: cross-peer halo permutes are unchanged in count, just S×
+    wider — the right layout when one sim's peer axis is what needs
+    the memory of multiple chips.
+  * ``axis="sims"`` — the sim axis is sharded across chips and the
+    peer axis stays local: embarrassingly parallel scaling with ZERO
+    cross-chip collectives in the steady state (each chip runs S/D
+    whole sims). The right layout when a single sim fits one chip —
+    Monte Carlo at fleet width.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class EnsembleRun:
+    """Result of one ensemble segment: the final batched state tree,
+    the compile-count sentinel, and wall-clock aggregates."""
+
+    states: object
+    n_sims: int
+    rounds: int          # simulated rounds PER SIM (ticks advanced)
+    compiles: int        # jit-cache growth across the segment
+                         # (-1 = unknown: the cache-size API is gone)
+    seconds: float
+
+    @property
+    def aggregate_rounds_per_sec(self) -> float:
+        """Total sim-rounds per wall second (S × rounds / time) — the
+        Monte Carlo throughput number docs/PERF.md's ensemble row
+        reports against S sequential runs."""
+        return (self.n_sims * self.rounds / self.seconds
+                if self.seconds > 0 else float("inf"))
+
+
+def _cache_size(jit_fn) -> int | None:
+    """The jit compile-cache size (jax 0.4.x private API — the same
+    sentinel analysis/guards.py and the analyze gate rely on); None
+    when unavailable so compile deltas degrade to 'unknown' (-1), not
+    to a spurious count the one-compile gates would hard-fail on."""
+    try:
+        return int(jit_fn._cache_size())
+    except Exception:  # pragma: no cover — newer-jax fallback
+        return None
+
+
+def run_rounds(ens_step, states, make_args, n_steps: int, *,
+               rounds_per_phase: int = 1, heartbeat_fn=None,
+               observe=None) -> EnsembleRun:
+    """Drive ``n_steps`` dispatches of a lifted ensemble step.
+
+    ``make_args(i)`` returns the tuple of per-step positional arrays
+    after the state, each carrying the leading S axis (publish batches
+    [S, P] / [S, r, P], churn rows [S, N], scheduled-chaos deny masks
+    [S, N, K] — batch.tile for shared inputs). ``heartbeat_fn(i)``
+    returns the static ``do_heartbeat`` bool for steps that take one
+    (phase / static-heartbeat builds); None omits the kwarg.
+    ``observe(i, states)`` is called after each dispatch with the live
+    batched state (measurement hook — e.g. per-round mesh snapshots;
+    readbacks here are host-side analysis, not part of the program).
+
+    The state buffers are donated each dispatch (the lifted step's
+    contract), so callers must not reuse the passed-in ``states``.
+    Returns an :class:`EnsembleRun` carrying the compile-count
+    sentinel for this segment."""
+    import jax
+
+    n_sims = jax.tree_util.tree_leaves(states)[0].shape[0]
+    before = _cache_size(ens_step)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        kw = {}
+        if heartbeat_fn is not None:
+            kw["do_heartbeat"] = bool(heartbeat_fn(i))
+        states = ens_step(states, *make_args(i), **kw)
+        if observe is not None:
+            observe(i, states)
+    jax.block_until_ready(states)
+    dt = time.perf_counter() - t0
+    after = _cache_size(ens_step)
+    return EnsembleRun(
+        states=states,
+        n_sims=int(n_sims),
+        rounds=n_steps * int(rounds_per_phase),
+        compiles=(-1 if before is None or after is None
+                  else after - before),
+        seconds=dt,
+    )
+
+
+def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers"):
+    """Place a BATCHED state tree onto a device mesh (see the module
+    docstring for the two layouts). ``axis="peers"`` shards dim 1 of
+    every leaf whose dim-1 extent is ``n_peers`` (the batched analogue
+    of parallel.shard_state); ``axis="sims"`` shards the leading sim
+    axis and replicates nothing else — every leaf carries it."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.sharding import peer_spec
+
+    if axis == "sims":
+        # peer_spec is "all mesh axes on one dim" — reused here for the
+        # SIM dim: each chip owns S/D whole sims, peer axis local
+        sims = NamedSharding(mesh, peer_spec(mesh))
+        return jax.device_put(states, jax.tree_util.tree_map(
+            lambda _: sims, states))
+    if axis != "peers":
+        raise ValueError(f"axis must be 'peers' or 'sims', got {axis!r}")
+    peer = NamedSharding(
+        mesh, P(None, *(
+            (tuple(mesh.axis_names),) if len(mesh.axis_names) > 1
+            else (mesh.axis_names[0],)
+        ))
+    )
+    repl = NamedSharding(mesh, P())
+
+    def choose(leaf):
+        if (hasattr(leaf, "shape") and leaf.ndim >= 2
+                and leaf.shape[1] == n_peers):
+            return peer
+        return repl
+
+    return jax.device_put(states, jax.tree_util.tree_map(choose, states))

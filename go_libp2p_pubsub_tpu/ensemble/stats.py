@@ -1,0 +1,144 @@
+"""Cross-sim reductions over an ensemble's final states.
+
+The per-sim *summaries* (delivery counts, latency histograms, event
+counters) reduce on device with one vmapped kernel over the existing
+counters/EV planes — the [S, N, M] delivery plane never crosses to the
+host. The *bands* (quantiles, pooled CDF percentile envelopes) are
+tiny [S]- or [S, L]-shaped reductions; bootstrap CIs resample the
+per-sim summaries host-side (numpy — S values, not S states).
+
+Everything takes the raw batched planes (``first_round [S, N, M]``,
+``birth/topic/origin [S, M]``, ``events [S, N_EVENTS]``) rather than a
+state object, so the same functions serve every engine's state layout
+— mirroring chaos/metrics.py, whose unbatched host versions these
+reproduce per sim (pinned by tests/test_ensemble.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# the batched chaos-metric analogues live with their unbatched
+# siblings in chaos/metrics.py; re-exported here because callers reach
+# every cross-sim reduction through ensemble.stats
+from ..chaos.metrics import batched_iwant_shares  # noqa: F401
+
+
+def _expected_mask(birth, topic, origin, subscribed, born_lo, born_hi):
+    """[N, M] bool: the (subscriber, message) pairs a delivery is
+    expected for — ONE sim. The single source of the eligibility
+    semantics (chaos.metrics.delivery_stats's exclusions: only live /
+    in-window slots count, and the origin has its own copy), shared by
+    the ratio and latency-histogram reductions so they can never
+    disagree about which pairs count."""
+    birth = birth.astype(jnp.int32)
+    live = (birth >= 0) & (birth >= born_lo) & (birth < born_hi)
+    n = subscribed.shape[0]
+    exp = subscribed[:, jnp.clip(topic, 0)] & live[None, :]   # [N, M]
+    is_origin = (
+        jnp.arange(n, dtype=jnp.int32)[:, None]
+        == jnp.clip(origin, 0, n - 1)[None, :]
+    ) & live[None, :]
+    return exp & ~is_origin
+
+
+def _delivery_counts(first_round, birth, topic, origin, subscribed,
+                     born_lo, born_hi):
+    """(delivered, expected) i32 scalars for ONE sim — the device form
+    of chaos.metrics.delivery_stats."""
+    exp = _expected_mask(birth, topic, origin, subscribed,
+                         born_lo, born_hi)
+    got = (first_round >= 0) & exp
+    return (jnp.sum(got.astype(jnp.int32)),
+            jnp.sum(exp.astype(jnp.int32)))
+
+
+def sim_delivery_ratios(first_round, birth, topic, origin, subscribed,
+                        born_in: tuple | None = None):
+    """[S] f32 per-sim delivery ratios, computed on device with one
+    vmapped reduction. ``subscribed [N, T]`` is shared (static across
+    sims); the message planes carry the leading S axis. ``born_in``
+    restricts to messages born in ``[lo, hi)`` (static)."""
+    lo, hi = born_in if born_in is not None else (0, 2**31 - 1)
+    sub = jnp.asarray(subscribed, bool)
+
+    def one(fr, b, t, o):
+        got, exp = _delivery_counts(fr, b, t, o, sub,
+                                    jnp.int32(lo), jnp.int32(hi))
+        ratio = got.astype(jnp.float32) / jnp.maximum(exp, 1).astype(jnp.float32)
+        return jnp.where(exp > 0, ratio, jnp.float32(1.0))
+
+    return jax.vmap(one)(jnp.asarray(first_round), jnp.asarray(birth),
+                         jnp.asarray(topic), jnp.asarray(origin))
+
+
+def latency_cdf_counts(first_round, birth, topic, origin, subscribed,
+                       max_lat: int, born_in: tuple | None = None):
+    """[S, max_lat + 1] i32 per-sim delivery-latency histograms over
+    expected (subscriber, message) pairs; bucket ``l`` counts first
+    deliveries ``l`` rounds after publish (clipped into the last
+    bucket). Feed :func:`cdf_bands`."""
+    lo, hi = born_in if born_in is not None else (0, 2**31 - 1)
+    sub = jnp.asarray(subscribed, bool)
+
+    def one(fr, b, t, o):
+        exp = _expected_mask(b, t, o, sub, jnp.int32(lo), jnp.int32(hi))
+        got = (fr >= 0) & exp
+        lat = jnp.clip(fr - b.astype(jnp.int32)[None, :], 0, max_lat)
+        return jnp.zeros((max_lat + 1,), jnp.int32).at[lat].add(
+            got.astype(jnp.int32)
+        )
+
+    return jax.vmap(one)(jnp.asarray(first_round), jnp.asarray(birth),
+                         jnp.asarray(topic), jnp.asarray(origin))
+
+
+def cdf_bands(counts, qs=(0.1, 0.5, 0.9)):
+    """Latency-CDF percentile bands across sims.
+
+    ``counts [S, L]`` are per-sim latency histograms. Returns a dict:
+      * ``pooled [L]`` — the CDF of all sims' deliveries pooled (the
+        many-trial estimate a single-seed run approximates);
+      * ``bands [len(qs), L]`` — at each latency, the ``qs`` quantiles
+        of the per-sim CDF values: the confidence envelope the
+        evaluation literature draws around its percentile plots.
+    Host-side numpy (inputs are [S, L] summaries, not state planes)."""
+    c = np.asarray(counts, np.float64)
+    tot = c.sum(axis=1, keepdims=True)
+    per_sim = np.cumsum(c, axis=1) / np.maximum(tot, 1.0)   # [S, L]
+    pooled = np.cumsum(c.sum(axis=0)) / max(float(c.sum()), 1.0)
+    bands = np.quantile(per_sim, np.asarray(qs), axis=0)
+    return {"pooled": pooled, "bands": bands, "qs": tuple(qs)}
+
+
+def quantile_band(values, qs=(0.25, 0.5, 0.75)) -> dict:
+    """Median/IQR-style summary of one per-sim metric: ``{q: value}``
+    plus ``n`` and min/max. Works on [S] device or host arrays; NaNs
+    (sims where the metric is undefined, e.g. an unrecovered
+    partition) are excluded and counted in ``n_undefined``."""
+    v = np.asarray(values, np.float64).ravel()
+    finite = v[np.isfinite(v)]
+    out = {"n": int(v.size), "n_undefined": int(v.size - finite.size)}
+    if finite.size:
+        for q in qs:
+            out[f"q{int(round(q * 100))}"] = float(np.quantile(finite, q))
+        out["min"] = float(finite.min())
+        out["max"] = float(finite.max())
+    return out
+
+
+def bootstrap_ci(values, n_boot: int = 2000, alpha: float = 0.05,
+                 seed: int = 0, stat=np.median) -> tuple[float, float]:
+    """Host-side bootstrap CI of ``stat`` over the per-sim summaries
+    (resampling S scalars, not S states). Returns (lo, hi)."""
+    v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        return (float("nan"), float("nan"))
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, v.size, size=(n_boot, v.size))
+    boots = stat(v[idx], axis=1)
+    return (float(np.quantile(boots, alpha / 2)),
+            float(np.quantile(boots, 1 - alpha / 2)))

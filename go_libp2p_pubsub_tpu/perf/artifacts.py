@@ -46,6 +46,33 @@ NORTH_STAR_RATE = 10_000.0
 CHAOS_OFF = {"generator": "off", "loss_rate": 0.0, "scheduled": False,
              "scenario": None}
 
+#: the ensemble-plane defaults every artifact WITHOUT an ensemble block
+#: reads back as: one sim, the base key unfolded, a point estimate (the
+#: whole pre-round-10 trajectory is single-seed)
+ENSEMBLE_OFF = {"n_sims": 1, "sim_key": "base", "aggregation": "point"}
+
+#: the one sim-key derivation the ensemble plane implements
+#: (ensemble/batch.py): sim i's PRNG key is fold_in(sim_key, i)
+SIM_KEY_DERIVATION = "fold_in(sim_key, sim_idx)"
+
+
+def ensemble_fingerprint(n_sims: int = 1,
+                         aggregation: str = "quantile_band") -> dict:
+    """The schema-v2 ``fingerprint["ensemble"]`` block for an
+    ENSEMBLE-EXECUTED run: how many sims the number aggregates over,
+    how their keys were derived, and the aggregation mode
+    (``"quantile_band"`` median + IQR over per-sim summaries,
+    ``"pooled_cdf"`` sims' events pooled before the reduction).
+
+    The derivation is reported even at S=1: a batched single-sim run
+    samples the ``fold_in(sim_key, 0)`` stream, which is a DIFFERENT
+    stream from the base key's — labeling it ``"base"`` would send a
+    replayer to the wrong numbers. Non-ensemble producers simply omit
+    the block; readers default it to :data:`ENSEMBLE_OFF` via
+    :attr:`BenchRecord.ensemble`."""
+    return {"n_sims": int(n_sims), "sim_key": SIM_KEY_DERIVATION,
+            "aggregation": str(aggregation)}
+
 
 def chaos_fingerprint(chaos=None, scenario=None) -> dict:
     """The schema-v2 ``fingerprint["chaos"]`` block: generator kind +
@@ -140,6 +167,21 @@ class BenchRecord:
         c = self.chaos
         return (c["generator"] == "off" and c["scenario"] is None
                 and not c.get("scheduled", False))
+
+    @property
+    def ensemble(self) -> dict:
+        """The ensemble block of the fingerprint. LEGACY artifacts
+        (every line that predates the ensemble plane) read back as the
+        single-sim point-estimate defaults, so readers can ask "how
+        many trials is this number over" across the whole trajectory."""
+        fp = self.fingerprint or {}
+        out = dict(ENSEMBLE_OFF)
+        out.update(fp.get("ensemble") or {})
+        return out
+
+    @property
+    def n_sims(self) -> int:
+        return int(self.ensemble["n_sims"])
 
     @property
     def permute_sets_per_phase(self) -> int | None:

@@ -543,3 +543,27 @@ class TraceSession:
         """Exact cumulative totals from the device counters (includes the
         duplicate/control volume the per-event stream elides)."""
         return {e.name: int(snap.events[e]) for e in EV}
+
+
+def batched_counter_events(events) -> tuple[list[dict[str, int]], dict[str, int]]:
+    """Counters-only drain for a BATCHED ensemble run (docs/DESIGN.md
+    §10): ``events [S, N_EVENTS]`` (a batched state's
+    ``core.events``) -> (per-sim counter dicts, pooled totals).
+
+    This is the only batched trace mode: the counters are exact per
+    sim (each sim's row is bit-identical to the unbatched run's
+    vector — the vmapped accumulation is elementwise). Exact
+    PER-EVENT emission stays per-sim by design — a TraceSession's
+    reconstructive diff walks host-side snapshots, so batching it
+    would serialize on the host anyway; drive one session over
+    ``ensemble.unbatch(states, i)`` snapshots for the sims whose event
+    streams you need (typically a handful of representative sims out
+    of a band, not all S)."""
+    ev = np.asarray(events)
+    if ev.ndim != 2:
+        raise ValueError(
+            f"expected batched [S, N_EVENTS] counters, got shape {ev.shape}"
+        )
+    per_sim = [{e.name: int(row[e]) for e in EV} for row in ev]
+    totals = {e.name: int(ev[:, e].sum()) for e in EV}
+    return per_sim, totals

@@ -6,10 +6,14 @@ Two halves, either of which failing exits non-zero:
      with the repo-specific rule set; intentional exceptions live in
      the committed ``analysis/ALLOWLIST``.
   2. **trace guards** (analysis/guards.py): re-trace + run all four
-     engines under strict dtype promotion, jax_enable_checks and the
-     transfer guard; assert one compile per engine, buffer donation,
-     and the committed ``STATE_SCHEMA.json`` state-leaf baseline
-     (``ANALYZE_UPDATE=1`` rewrites it — the PERF_SMOKE pattern).
+     engines — plus the S=2 ENSEMBLE lift of the gossipsub step (the
+     batched path, round 10) — under strict dtype promotion,
+     jax_enable_checks and the transfer guard; assert one compile per
+     engine, buffer donation, and the committed ``STATE_SCHEMA.json``
+     state-leaf baseline (``ANALYZE_UPDATE=1`` rewrites it — the
+     PERF_SMOKE pattern). The ensemble engine's leaves validate by
+     STRIPPING the leading S axis against the gossipsub rows, so the
+     baseline is never duplicated.
 
 CPU-only by contract, like perf-smoke/chaos-smoke: it must mean the
 same thing on any dev box or CI runner. Emits one JSON summary line;
@@ -63,7 +67,7 @@ def main(argv=None) -> int:
         guard_failures = guards.run()
         failures.extend(guard_failures)
         summary["guards"] = {
-            "engines": list(guards.ENGINES),
+            "engines": list(guards.ENGINES) + [guards.ENSEMBLE_ENGINE],
             "failures": len(guard_failures),
             "updated": bool(os.environ.get("ANALYZE_UPDATE")),
         }

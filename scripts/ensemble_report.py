@@ -1,0 +1,360 @@
+"""Ensemble-plane gate + Monte Carlo throughput report
+(``make ensemble-smoke``; docs/DESIGN.md §10).
+
+Runs the chaos smoke's flap scenario (scripts/chaos_report.py shape:
+N=128, 60% i.i.d. link loss, 80 rounds, gossipsub v1.1 with live
+scoring) as an S=8 ensemble — ONE vmapped XLA program — and asserts
+the ensemble plane's whole contract:
+
+  1. **one compile** — the lifted step's compile-cache grows by
+     exactly 1 across the full S×80-round run (cache-size sentinel;
+     the one-program promise `jax.vmap` exists to make).
+  2. **per-sim bit-exactness** — EVERY sim's final state tree equals
+     the corresponding single-sim run built with the derived key
+     ``fold_in(sim_key, sim_idx)``, leaf for leaf, bit for bit. The
+     gate pins the THREEFRY PRNG: its counter-mode draws batch
+     elementwise, so vmap(step) == step per sim exactly. (unsafe_rbg
+     keeps sims independent but its RngBitGenerator batching is not
+     elementwise — documented in ensemble/batch.py; the chaos fault
+     hashes are impl-independent.)
+  3. **artifact integrity** — the emitted schema-v2 line carries the
+     ``fingerprint["ensemble"]`` block (S, sim-key derivation,
+     aggregation mode) and round-trips through perf.artifacts.
+  4. **aggregate-throughput floor** — batched sim-rounds/s must stay
+     above ENSEMBLE_SMOKE_TOL × the committed ENSEMBLE_SMOKE.json
+     baseline (ENSEMBLE_SMOKE_UPDATE=1 rewrites it). The sequential
+     rate (the same S sims run one-by-one through the single-sim jit)
+     is measured alongside — it is the docs/PERF.md comparison row,
+     and the batched/sequential ratio is reported in the artifact.
+
+CPU-only by contract, like perf-smoke/chaos-smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))  # repo root
+if _here not in sys.path:  # scripts/ — chaos_report owns the smoke shape
+    sys.path.insert(1, _here)
+
+import numpy as np  # noqa: E402
+
+from chaos_report import FLAP_LOSS, FLAP_ROUNDS, SMOKE_N  # noqa: E402
+
+ENSEMBLE_SMOKE_S = 8
+BASELINE_NAME = "ENSEMBLE_SMOKE.json"
+#: aggregate-throughput floor: fraction of the committed baseline the
+#: fresh batched rate must reach (machines vary; deliberately loose,
+#: like perf-smoke's DEFAULT_TOL)
+DEFAULT_TOL = 0.4
+
+
+def _keyless_leaves(tree):
+    """Flat leaf list with PRNG keys unwrapped to their raw data (so
+    bit-comparison covers the key plane too)."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.checkpoint import is_prng_key
+
+    def unkey(x):
+        if is_prng_key(x):
+            return jax.random.key_data(x)
+        return x
+
+    return jax.tree_util.tree_leaves(jax.tree_util.tree_map(unkey, tree))
+
+
+def _leaf_paths(tree):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [jax.tree_util.keystr(p) for p, _ in flat]
+
+
+def build_flap_cell(n: int, loss: float, seed: int):
+    """The smoke flap cell: (initial gossipsub state, jitted step,
+    schedule arrays) — the same overlay/score/chaos configuration
+    chaos_report.run_flap measures, built once and shared by the
+    batched and sequential runs."""
+    from chaos_report import _flap_params, _publish_schedule, _score_params
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.chaos import ChaosConfig
+    from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    topo = graph.random_connect(n, d=4, seed=seed)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    cc = ChaosConfig(loss_rate=loss)
+    rng = np.random.default_rng(seed)
+    po, pt, pv = _publish_schedule(rng, n, FLAP_ROUNDS, pub_rounds=3)
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_flap_params(), PeerScoreThresholds(),
+                                score_enabled=True, chaos=cc)
+    st0 = GossipSubState.init(net, 64, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    return st0, step, net, (po, pt, pv)
+
+
+def run_gate(s: int, n: int, loss: float, seed: int) -> dict:
+    """The full gate; returns the result dict (failures list inside)."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu import ensemble
+    from go_libp2p_pubsub_tpu.ensemble import stats as estats
+
+    failures: list[str] = []
+    st0, step, net, (po, pt, pv) = build_flap_cell(n, loss, seed)
+    base_key = st0.core.key
+    rounds = po.shape[0]
+    ens = ensemble.lift_step(step)
+
+    def margs(i):
+        return (ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
+                ensemble.tile(pv[i], s))
+
+    # --- batched: compile + warm run (the one-compile sentinel) -------
+    run = ensemble.run_rounds(ens, ensemble.batch_states(st0, s),
+                              margs, rounds)
+    if run.compiles not in (-1, 1):  # -1 = sentinel API unavailable
+        failures.append(
+            f"one-compile: lifted step compiled {run.compiles} times "
+            f"across the S={s} x {rounds}-round run (expected exactly 1)"
+        )
+    # timed warm segment (fresh batched states; the first run paid the
+    # compile, this one is the throughput number)
+    timed = ensemble.run_rounds(ens, ensemble.batch_states(st0, s),
+                                margs, rounds)
+    if timed.compiles not in (-1, 0):
+        failures.append(
+            f"one-compile: warm re-run recompiled ({timed.compiles} "
+            "fresh compiles) — shape/weak-type wobble in the loop"
+        )
+    aggregate = timed.aggregate_rounds_per_sec
+
+    # --- sequential baseline + per-sim bit-exactness ------------------
+    # apples-to-apples with the batched number: the S initial states
+    # are built OUTSIDE the timer (the batched run's batch_states is
+    # untimed too) and the single-sim jit is warmed first, so the
+    # window times execution only — not XLA compile or host topology
+    # rebuilds. Fresh donatable buffers come from copying st0's leaves
+    # (the jitted step donates its state, so each run needs its own) —
+    # key leaves pass through untouched because with_sim_key replaces
+    # them anyway.
+    def fresh_state(sim_key):
+        from go_libp2p_pubsub_tpu.checkpoint import is_prng_key
+
+        st = jax.tree_util.tree_map(
+            lambda x: x if is_prng_key(x) else jnp.copy(x), st0)
+        return ensemble.with_sim_key(st, base_key, sim_key)
+
+    inits = [fresh_state(i) for i in range(s)]
+    jax.block_until_ready(
+        step(fresh_state(0), jnp.asarray(po[0]), jnp.asarray(pt[0]),
+             jnp.asarray(pv[0])))
+    finals = []
+    t0 = time.perf_counter()
+    for st_i in inits:
+        for t in range(rounds):
+            st_i = step(st_i, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                        jnp.asarray(pv[t]))
+        jax.block_until_ready(st_i)
+        finals.append(st_i)
+    seq_dt = time.perf_counter() - t0
+    sequential = s * rounds / seq_dt if seq_dt > 0 else float("inf")
+
+    paths = _leaf_paths(finals[0])
+    for i, ref in enumerate(finals):
+        got = ensemble.unbatch(timed.states, i)
+        for path, a, b in zip(paths, _keyless_leaves(got),
+                              _keyless_leaves(ref)):
+            if not bool(jnp.array_equal(a, b)):
+                failures.append(
+                    f"parity: sim {i} diverges from its single-sim run "
+                    f"at state leaf {path} (first of possibly many)"
+                )
+                break
+
+    ratios = np.asarray(estats.sim_delivery_ratios(
+        timed.states.core.dlv.first_round, timed.states.core.msgs.birth,
+        timed.states.core.msgs.topic, timed.states.core.msgs.origin,
+        net.subscribed,
+    ))
+    return {
+        "failures": failures,
+        "aggregate": aggregate,
+        "sequential": sequential,
+        "speedup": aggregate / sequential if sequential else float("inf"),
+        "ratios": ratios,
+        "n_sims": s,
+        "rounds": rounds,
+        "n_peers": n,
+        "loss": loss,
+        "compiles": run.compiles,
+    }
+
+
+def emit_artifact(res: dict, loss: float) -> dict:
+    """Emit + round-trip-check the schema-v2 ensemble artifact line."""
+    from go_libp2p_pubsub_tpu.ensemble import stats as estats
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        SIM_KEY_DERIVATION,
+        BenchRecord,
+        chaos_fingerprint,
+        dump_record,
+        ensemble_fingerprint,
+        record_from_line,
+    )
+
+    band = estats.quantile_band(res["ratios"])
+    rec = BenchRecord(
+        metric="ensemble_flap_aggregate_sim_rounds_per_sec",
+        value=round(res["aggregate"], 2),
+        unit="sim-rounds/s",
+        vs_baseline=0.0,
+        schema=2,
+        fingerprint={
+            "chaos": chaos_fingerprint(_chaos_cfg(loss)),
+            "ensemble": ensemble_fingerprint(res["n_sims"]),
+        },
+        extras={
+            "sequential_sim_rounds_per_sec": round(res["sequential"], 2),
+            "batched_over_sequential": round(res["speedup"], 3),
+            "rounds": res["rounds"],
+            "delivery_ratio_median": round(band["q50"], 4),
+            "delivery_ratio_iqr": [round(band["q25"], 4),
+                                   round(band["q75"], 4)],
+        },
+    )
+    line = dump_record(rec)
+    print(line, flush=True)
+    back = record_from_line(json.loads(line))
+    errors = []
+    if back.n_sims != res["n_sims"]:
+        errors.append(
+            f"artifact: ensemble block lost n_sims on round-trip "
+            f"({back.n_sims} != {res['n_sims']})"
+        )
+    if back.ensemble.get("sim_key") != SIM_KEY_DERIVATION:
+        errors.append("artifact: sim-key derivation missing from the "
+                      "ensemble block")
+    return {"record": rec, "errors": errors}
+
+
+def _chaos_cfg(loss: float):
+    from go_libp2p_pubsub_tpu.chaos import ChaosConfig
+
+    return ChaosConfig(loss_rate=loss)
+
+
+def check_floor(root: str, res: dict) -> list[str]:
+    """Aggregate-throughput floor vs the committed baseline."""
+    path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(path) or os.environ.get("ENSEMBLE_SMOKE_UPDATE"):
+        return []
+    with open(path) as f:
+        base = json.load(f)
+    tol = float(os.environ.get("ENSEMBLE_SMOKE_TOL", DEFAULT_TOL))
+    errors = []
+    # the committed floor is shape-specific: a --sims/--n/--loss/--rounds
+    # variant run must not be judged against (or silently weaken) the
+    # default shape's number
+    for dim in ("n_sims", "n_peers", "rounds", "loss"):
+        if res[dim] != type(res[dim])(base.get(dim, res[dim])):
+            return []
+    committed = base.get("aggregate_sim_rounds_per_sec")
+    if committed and res["aggregate"] < tol * committed:
+        errors.append(
+            f"aggregate throughput regressed: {res['aggregate']:.1f} < "
+            f"{tol:.2f} x committed {committed:.1f} sim-rounds/s "
+            f"({BASELINE_NAME}; ENSEMBLE_SMOKE_TOL overrides, "
+            "ENSEMBLE_SMOKE_UPDATE=1 rewrites)"
+        )
+    return errors
+
+
+def write_baseline(root: str, res: dict) -> str:
+    path = os.path.join(root, BASELINE_NAME)
+    payload = {
+        "schema": 1,
+        "aggregate_sim_rounds_per_sec": round(res["aggregate"], 2),
+        "sequential_sim_rounds_per_sec": round(res["sequential"], 2),
+        "batched_over_sequential": round(res["speedup"], 3),
+        "n_sims": res["n_sims"],
+        "rounds": res["rounds"],
+        "n_peers": res["n_peers"],
+        "loss": res["loss"],
+        "note": (
+            "ensemble-smoke aggregate-throughput baseline "
+            "(scripts/ensemble_report.py); ENSEMBLE_SMOKE_UPDATE=1 "
+            "rewrites"
+        ),
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="exit non-zero on any gate failure")
+    ap.add_argument("--sims", type=int,
+                    default=int(os.environ.get("ENSEMBLE_SMOKE_S",
+                                               ENSEMBLE_SMOKE_S)))
+    ap.add_argument("--n", type=int, default=SMOKE_N)
+    ap.add_argument("--loss", type=float, default=FLAP_LOSS)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.sims < 1:
+        ap.error("--sims must be >= 1")
+
+    # CPU-only by contract; THREEFRY pinned (see the module docstring:
+    # the per-sim bit-parity assertion is only meaningful under an
+    # elementwise-batching PRNG). The persistent compile cache policy
+    # matches the other gates.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "threefry2x32")
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    enable_persistent_cache(os.path.join(repo_root(), ".jax_cache"))
+
+    res = run_gate(args.sims, args.n, args.loss, args.seed)
+    failures = list(res["failures"])
+    art = emit_artifact(res, args.loss)
+    failures += art["errors"]
+    root = repo_root()
+    if os.environ.get("ENSEMBLE_SMOKE_UPDATE"):
+        print("wrote", write_baseline(root, res))
+    failures += check_floor(root, res)
+
+    if args.smoke and failures:
+        for f in failures:
+            print(f"ensemble-smoke FAIL: {f}", file=sys.stderr)
+        print(json.dumps({"ensemble_smoke": "FAIL",
+                          "errors": len(failures)}))
+        return 1
+    print(json.dumps({"ensemble_smoke": "PASS" if not failures else "REPORT",
+                      "warnings": failures}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

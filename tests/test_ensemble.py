@@ -1,0 +1,527 @@
+"""Ensemble-plane tests (docs/DESIGN.md §10).
+
+The contracts pinned here, per the round-10 acceptance criteria:
+
+  * **S=1 parity** — the batched step is bit-exact against the
+    unbatched step on FULL state trees for all four engines, incl. the
+    phase engine at r ∈ {1, 8} on the stacked coalesced wire path;
+  * **sim-i parity** — sim ``i`` of an S>1 batched run reproduces the
+    unbatched run built with ``fold_in(sim_key, i)`` bit-exactly
+    (under the ambient threefry PRNG — ensemble/batch.py documents the
+    unsafe_rbg caveat);
+  * **stream independence** — two sims' Gilbert–Elliott chaos streams,
+    i.i.d. flap streams, and sampler streams all differ under the
+    fold_in derivation;
+  * **per-sim scenario inputs** — a [S, ...] ``link_deny`` runs S
+    DIFFERENT scenarios in one program;
+  * **checkpointing** — a batched state round-trips through the npz
+    backend unchanged (no version bump: the v6 format is pytree-
+    generic) and each unbatched sim slice remains v6-compatible;
+  * **one compile** — the runner's cache sentinel reads exactly 1 for
+    a multi-round batched run;
+  * **stats** — the device cross-sim reductions agree with the
+    host-side chaos.metrics versions per sim.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import checkpoint, ensemble, graph
+from go_libp2p_pubsub_tpu.chaos import ChaosConfig, delivery_stats
+from go_libp2p_pubsub_tpu.chaos import faults as chaos_faults
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.ensemble import stats as estats
+from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+    make_gossipsub_phase_step,
+)
+from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+from go_libp2p_pubsub_tpu.state import Net, SimState
+from go_libp2p_pubsub_tpu.trace.drain import batched_counter_events
+
+N = 48
+M = 64
+ROUNDS = 6
+
+
+def _keyless(tree):
+    def unkey(x):
+        if checkpoint.is_prng_key(x):
+            return jax.random.key_data(x)
+        return x
+
+    return jax.tree_util.tree_map(unkey, tree)
+
+
+def assert_trees_bitexact(got, want, context=""):
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(_keyless(got))
+    flat_w, _ = jax.tree_util.tree_flatten_with_path(_keyless(want))
+    assert len(flat_g) == len(flat_w)
+    for (path, a), (_, b) in zip(flat_g, flat_w):
+        assert a.dtype == b.dtype and a.shape == b.shape, (
+            f"{context}{jax.tree_util.keystr(path)}: aval mismatch"
+        )
+        assert bool(jnp.array_equal(a, b)), (
+            f"{context}{jax.tree_util.keystr(path)}: values differ"
+        )
+
+
+def _net(n=N, seed=0):
+    topo = graph.random_connect(n, d=4, seed=seed)
+    return Net.build(topo, graph.subscribe_all(n, 1))
+
+
+def _schedule(n, rounds, seed=0, width=4):
+    rng = np.random.default_rng(seed)
+    po = rng.integers(0, n, size=(rounds, width)).astype(np.int32)
+    po[rounds // 2:] = -1  # publish half the run, deliver the rest
+    pt = np.zeros((rounds, width), np.int32)
+    pv = np.ones((rounds, width), bool)
+    return po, pt, pv
+
+
+def _score_params():
+    return PeerScoreParams(topics={0: TopicScoreParams()},
+                           skip_app_specific=True)
+
+
+def _gossip_cfg(chaos=None, heartbeat_every=1):
+    return GossipSubConfig.build(
+        GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1),
+        PeerScoreThresholds(), score_enabled=True, chaos=chaos,
+        heartbeat_every=heartbeat_every,
+    )
+
+
+# ---------------------------------------------------------------------------
+# S=1 parity: batched == unbatched, full state trees, all engines
+
+
+def _run_unbatched(step, st, po, pt, pv, net=None, **kw):
+    for i in range(po.shape[0]):
+        args = (jnp.asarray(po[i]), jnp.asarray(pt[i]), jnp.asarray(pv[i]))
+        st = (step(net, st, *args, **kw) if net is not None
+              else step(st, *args, **kw))
+    return st
+
+
+def _run_batched(ens, states, po, pt, pv, s, heartbeat=None):
+    def margs(i):
+        return (ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
+                ensemble.tile(pv[i], s))
+
+    run = ensemble.run_rounds(ens, states, margs, po.shape[0],
+                              heartbeat_fn=heartbeat)
+    return run
+
+
+def test_s1_parity_floodsub():
+    net = _net()
+    po, pt, pv = _schedule(N, ROUNDS)
+    cc = ChaosConfig(loss_rate=0.3)
+
+    # fresh init per run: the jitted steps DONATE their state buffers,
+    # so a tree that has been through one run is dead (same seed ->
+    # identical init, including the key)
+    def init():
+        return SimState.init(N, M, seed=2, k=net.max_degree)
+
+    st0 = init()  # key source + the batched seed state (never donated)
+    ref = _run_unbatched(floodsub_step, init(), po, pt, pv, net=net,
+                         chaos=cc)
+    # the S=1 state derives sim key 0 — the unbatched reference must
+    # too (the parity contract is per derived key)
+    st1 = ensemble.with_sim_key(init(), st0.key, 0)
+    ref1 = _run_unbatched(floodsub_step, st1, po, pt, pv, net=net, chaos=cc)
+    ens = ensemble.lift_floodsub(net, chaos=cc)
+    run = _run_batched(ens, ensemble.batch_states(st0, 1), po, pt, pv, 1)
+    assert run.compiles == 1
+    assert_trees_bitexact(ensemble.unbatch(run.states, 0), ref1,
+                          "floodsub S=1 ")
+    # sanity: the derived-key run is a DIFFERENT stream from the raw one
+    assert not bool(jnp.array_equal(ref.key, ref1.key))
+
+
+def test_s1_parity_randomsub():
+    net = _net(seed=3)
+    po, pt, pv = _schedule(N, ROUNDS, seed=3)
+    step = make_randomsub_step(net)
+    st0 = SimState.init(N, M, seed=4, k=net.max_degree)
+    # the reference run gets its own init (donation kills the tree)
+    ref = _run_unbatched(
+        step,
+        ensemble.with_sim_key(SimState.init(N, M, seed=4,
+                                            k=net.max_degree),
+                              st0.key, 0),
+        po, pt, pv)
+    ens = ensemble.lift_step(step)
+    run = _run_batched(ens, ensemble.batch_states(st0, 1), po, pt, pv, 1)
+    assert_trees_bitexact(ensemble.unbatch(run.states, 0), ref,
+                          "randomsub S=1 ")
+
+
+def test_s1_parity_gossipsub_per_round():
+    net = _net(seed=5)
+    po, pt, pv = _schedule(N, ROUNDS, seed=5)
+    sp = _score_params()
+    cfg = _gossip_cfg(chaos=ChaosConfig(generator="ge", ge_p_down=0.2,
+                                        ge_p_up=0.4))
+    st0 = GossipSubState.init(net, M, cfg, score_params=sp, seed=6)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    ref = _run_unbatched(
+        step,
+        ensemble.with_sim_key(
+            GossipSubState.init(net, M, cfg, score_params=sp, seed=6),
+            st0.core.key, 0),
+        po, pt, pv)
+    ens = ensemble.lift_step(step)
+    run = _run_batched(ens, ensemble.batch_states(st0, 1), po, pt, pv, 1)
+    assert_trees_bitexact(ensemble.unbatch(run.states, 0), ref,
+                          "gossipsub S=1 ")
+
+
+# heavy compile: the r=8 case rides the slow tier with the other big
+# phase parity suites (tests/test_phase_stacked.py policy)
+@pytest.mark.parametrize(
+    "r", [1, pytest.param(8, marks=pytest.mark.slow)])
+def test_s1_parity_phase_stacked_wire(r):
+    net = _net(seed=7)
+    n_phases = 2
+    po, pt, pv = _schedule(N, n_phases * r, seed=7)
+    po3 = po.reshape(n_phases, r, -1)
+    pt3 = pt.reshape(n_phases, r, -1)
+    pv3 = pv.reshape(n_phases, r, -1)
+    sp = _score_params()
+    cfg = _gossip_cfg(heartbeat_every=max(r, 1))
+    assert cfg.wire_coalesced  # the stacked coalesced path is the default
+    st0 = GossipSubState.init(net, M, cfg, score_params=sp, seed=8)
+    step = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+    ref = ensemble.with_sim_key(
+        GossipSubState.init(net, M, cfg, score_params=sp, seed=8),
+        st0.core.key, 0)
+    for p in range(n_phases):
+        ref = step(ref, jnp.asarray(po3[p]), jnp.asarray(pt3[p]),
+                   jnp.asarray(pv3[p]), do_heartbeat=True)
+    ens = ensemble.lift_step(step)
+
+    def margs(p):
+        return (ensemble.tile(po3[p], 1), ensemble.tile(pt3[p], 1),
+                ensemble.tile(pv3[p], 1))
+
+    run = ensemble.run_rounds(ens, ensemble.batch_states(st0, 1), margs,
+                              n_phases, rounds_per_phase=r,
+                              heartbeat_fn=lambda p: True)
+    assert run.compiles == 1
+    assert_trees_bitexact(ensemble.unbatch(run.states, 0), ref,
+                          f"phase r={r} S=1 ")
+
+
+# ---------------------------------------------------------------------------
+# sim-i parity at S>1 + stream independence
+
+
+def test_sim_parity_and_independence_batched():
+    net = _net(seed=9)
+    po, pt, pv = _schedule(N, ROUNDS, seed=9)
+    cc = ChaosConfig(generator="ge", ge_p_down=0.25, ge_p_up=0.4)
+    sp = _score_params()
+    cfg = _gossip_cfg(chaos=cc)
+    st0 = GossipSubState.init(net, M, cfg, score_params=sp, seed=10)
+    base_key = st0.core.key
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    ens = ensemble.lift_step(step)
+    s = 3
+    run = _run_batched(ens, ensemble.batch_states(st0, s), po, pt, pv, s)
+    assert run.compiles == 1
+    # every sim bit-identical to its single-sim run under the derived key
+    for i in range(s):
+        ref = _run_unbatched(
+            step,
+            ensemble.with_sim_key(
+                GossipSubState.init(net, M, cfg, score_params=sp, seed=10),
+                base_key, i),
+            po, pt, pv)
+        assert_trees_bitexact(ensemble.unbatch(run.states, i), ref,
+                              f"sim {i} ")
+    # GE chaos chains (and hence fault histories) differ between sims
+    ge = np.asarray(run.states.core.chaos.ge_bad)
+    assert not np.array_equal(ge[0], ge[1])
+    # the delivery planes differ too (sampler + fault independence)
+    fr = np.asarray(run.states.core.dlv.first_round)
+    assert not np.array_equal(fr[0], fr[1])
+
+
+def test_fault_hash_streams_independent_per_sim():
+    # the chaos counter-mode hash is keyed on the sim key, so fold_in
+    # derivation alone must separate the streams — no engine in the loop
+    net = _net(seed=11)
+    key = jax.random.key(0)
+    k0 = jax.random.fold_in(key, 0)
+    k1 = jax.random.fold_in(key, 1)
+    s0, s1 = chaos_faults.chaos_seed(k0), chaos_faults.chaos_seed(k1)
+    assert int(s0) != int(s1)
+    m0 = chaos_faults.iid_link_down(s0, net.nbr, jnp.int32(3), 0.5)
+    m1 = chaos_faults.iid_link_down(s1, net.nbr, jnp.int32(3), 0.5)
+    assert not bool(jnp.array_equal(m0, m1))
+    # and sim 0's stream is the BASE run's stream under the same key
+    # (what makes batched-vs-unbatched chaos bit-exact in the parity
+    # tests above)
+    assert int(chaos_faults.chaos_seed(k0)) == int(s0)
+
+
+def test_sampler_streams_independent_per_sim():
+    # randomsub's per-round fanout draw comes from fold_in(st.key, tick)
+    # — per-sim keys must decorrelate it
+    net = _net(seed=12)
+    po, pt, pv = _schedule(N, ROUNDS, seed=12)
+    step = make_randomsub_step(net)
+    st0 = SimState.init(N, M, seed=13, k=net.max_degree)
+    ens = ensemble.lift_step(step)
+    run = _run_batched(ens, ensemble.batch_states(st0, 2), po, pt, pv, 2)
+    fr = np.asarray(run.states.dlv.first_round)
+    assert not np.array_equal(fr[0], fr[1])
+
+
+def test_per_sim_scenario_inputs():
+    # one program, S different scenarios: sim 0 has every link denied
+    # (nothing can deliver), sim 1 a lossless wire
+    net = _net(seed=14)
+    po, pt, pv = _schedule(N, ROUNDS, seed=14)
+    cc = ChaosConfig(scheduled=True)
+    st0 = SimState.init(N, M, seed=15, k=net.max_degree)
+    ens = ensemble.lift_floodsub(net, chaos=cc)
+    deny = np.stack([np.ones(net.nbr.shape, bool),
+                     np.zeros(net.nbr.shape, bool)])
+
+    def margs(i):
+        return (ensemble.tile(po[i], 2), ensemble.tile(pt[i], 2),
+                ensemble.tile(pv[i], 2), jnp.asarray(deny))
+
+    run = ensemble.run_rounds(ens, ensemble.batch_states(st0, 2), margs,
+                              ROUNDS)
+    fr = np.asarray(run.states.dlv.first_round)
+    origin_free = fr.copy()
+    # non-origin receipts only: origins stamp their own publishes
+    for sim in range(2):
+        o = np.asarray(run.states.msgs.origin[sim])
+        live = o >= 0
+        origin_free[sim][np.clip(o, 0, N - 1)[live],
+                         np.nonzero(live)[0]] = -1
+    assert (origin_free[0] < 0).all()       # total outage: no deliveries
+    assert (origin_free[1] >= 0).any()      # lossless: traffic flowed
+
+
+# ---------------------------------------------------------------------------
+# sharding composition (conftest forces 8 virtual CPU devices)
+
+
+@pytest.mark.parametrize("axis", ["sims", "peers"])
+def test_shard_ensemble_state_parity(axis):
+    # the two documented layouts (docs/DESIGN.md §10): sims sharded
+    # across devices (S/D whole sims each, no steady-state collectives)
+    # or the peer dim sharded as the unbatched state is. Placement must
+    # not change a single bit vs the unplaced batched run.
+    from go_libp2p_pubsub_tpu.parallel.sharding import make_mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("needs a multi-device (virtual CPU) harness")
+    net = _net(seed=27)
+    po, pt, pv = _schedule(N, ROUNDS, seed=27)
+    s = 8  # divisible by the 8 virtual devices (and N=48 by 8 for peers)
+    st0 = SimState.init(N, M, seed=28, k=net.max_degree)
+    ens = ensemble.lift_floodsub(net)
+    gold = _run_batched(ens, ensemble.batch_states(st0, s), po, pt, pv, s)
+    placed = ensemble.shard_ensemble_state(
+        ensemble.batch_states(
+            SimState.init(N, M, seed=28, k=net.max_degree), s),
+        make_mesh(), N, axis=axis)
+    run = _run_batched(ens, placed, po, pt, pv, s)
+    assert_trees_bitexact(run.states, gold.states, f"{axis}-sharded ")
+
+
+# ---------------------------------------------------------------------------
+# checkpointing
+
+
+def test_checkpoint_batched_roundtrip_no_version_bump(tmp_path):
+    # the npz format is pytree-generic, so a batched tree checkpoints
+    # as-is — same v6 format, no bump
+    assert checkpoint._FORMAT_VERSION == 6
+    net = _net(seed=16)
+    po, pt, pv = _schedule(N, ROUNDS, seed=16)
+    cc = ChaosConfig(generator="ge", ge_p_down=0.3, ge_p_up=0.5)
+    st0 = SimState.init(N, M, seed=17, k=net.max_degree, chaos_ge=True)
+    ens = ensemble.lift_floodsub(net, chaos=cc)
+    run = _run_batched(ens, ensemble.batch_states(st0, 2), po, pt, pv, 2)
+    path = str(tmp_path / "batched.npz")
+    checkpoint.save(path, run.states)
+    template = ensemble.batch_states(
+        SimState.init(N, M, seed=17, k=net.max_degree, chaos_ge=True), 2)
+    restored = checkpoint.restore(path, template)
+    assert_trees_bitexact(restored, run.states, "batched roundtrip ")
+    # resume parity: continuing the restored ensemble == uninterrupted
+    po2, pt2, pv2 = _schedule(N, 3, seed=18)
+    cont = _run_batched(ens, restored, po2, pt2, pv2, 2)
+    gold = _run_batched(ens, run.states, po2, pt2, pv2, 2)
+    assert_trees_bitexact(cont.states, gold.states, "batched resume ")
+
+
+def test_checkpoint_per_sim_slice_v6_compatible(tmp_path):
+    # an unbatched sim slice is a plain v6 state: it must round-trip
+    # against an UNBATCHED template (the per-sim compatibility pin)
+    net = _net(seed=19)
+    po, pt, pv = _schedule(N, ROUNDS, seed=19)
+    st0 = SimState.init(N, M, seed=20, k=net.max_degree)
+    ens = ensemble.lift_floodsub(net)
+    run = _run_batched(ens, ensemble.batch_states(st0, 2), po, pt, pv, 2)
+    sim1 = ensemble.unbatch(run.states, 1)
+    path = str(tmp_path / "sim1.npz")
+    checkpoint.save(path, sim1)
+    template = SimState.init(N, M, seed=20, k=net.max_degree)
+    restored = checkpoint.restore(path, template)
+    assert_trees_bitexact(restored, sim1, "per-sim slice ")
+    # and a batched checkpoint must REFUSE an unbatched template with
+    # the pytree-path mismatch message, not load garbage
+    bpath = str(tmp_path / "batched.npz")
+    checkpoint.save(bpath, run.states)
+    with pytest.raises(ValueError, match="shape"):
+        checkpoint.restore(bpath, template)
+
+
+# ---------------------------------------------------------------------------
+# stats
+
+
+def test_sim_delivery_ratios_match_host_metrics():
+    net = _net(seed=21)
+    po, pt, pv = _schedule(N, ROUNDS, seed=21)
+    cc = ChaosConfig(loss_rate=0.4)
+    st0 = SimState.init(N, M, seed=22, k=net.max_degree)
+    ens = ensemble.lift_floodsub(net, chaos=cc)
+    s = 3
+    run = _run_batched(ens, ensemble.batch_states(st0, s), po, pt, pv, s)
+    ratios = np.asarray(estats.sim_delivery_ratios(
+        run.states.dlv.first_round, run.states.msgs.birth,
+        run.states.msgs.topic, run.states.msgs.origin, net.subscribed,
+    ))
+    for i in range(s):
+        want = delivery_stats(
+            np.asarray(run.states.dlv.first_round[i]),
+            np.asarray(run.states.msgs.birth[i]),
+            np.asarray(run.states.msgs.topic[i]),
+            np.asarray(run.states.msgs.origin[i]),
+            np.asarray(net.subscribed),
+        ).ratio
+        assert ratios[i] == pytest.approx(want, abs=1e-6)
+    # the flap made sims differ — the band is non-degenerate
+    band = estats.quantile_band(ratios)
+    assert band["n"] == s and band["n_undefined"] == 0
+    assert band["min"] <= band["q50"] <= band["max"]
+    lo, hi = estats.bootstrap_ci(ratios, n_boot=200)
+    assert lo <= np.median(ratios) <= hi
+
+
+def test_latency_cdf_bands_shapes_and_pooling():
+    # hand-built histograms: sim 0 delivers everything at latency 1,
+    # sim 1 at latency 3
+    counts = np.zeros((2, 5), np.int64)
+    counts[0, 1] = 10
+    counts[1, 3] = 10
+    out = estats.cdf_bands(counts, qs=(0.0, 0.5, 1.0))
+    assert out["pooled"].shape == (5,)
+    assert out["bands"].shape == (3, 5)
+    # pooled CDF: half the mass at latency >= 1, all by 3
+    assert out["pooled"][0] == 0.0
+    assert out["pooled"][1] == pytest.approx(0.5)
+    assert out["pooled"][3] == pytest.approx(1.0)
+    # the band at latency 1 spans sim 1's 0.0 to sim 0's 1.0
+    assert out["bands"][0, 1] == pytest.approx(0.0)
+    assert out["bands"][2, 1] == pytest.approx(1.0)
+
+
+def test_latency_cdf_counts_device():
+    net = _net(seed=23)
+    po, pt, pv = _schedule(N, ROUNDS, seed=23)
+    st0 = SimState.init(N, M, seed=24, k=net.max_degree)
+    ens = ensemble.lift_floodsub(net)
+    run = _run_batched(ens, ensemble.batch_states(st0, 2), po, pt, pv, 2)
+    hist = np.asarray(estats.latency_cdf_counts(
+        run.states.dlv.first_round, run.states.msgs.birth,
+        run.states.msgs.topic, run.states.msgs.origin, net.subscribed,
+        max_lat=8,
+    ))
+    assert hist.shape == (2, 9)
+    # lossless wire: every expected pair delivers; totals match the
+    # device delivery count
+    fr = np.asarray(run.states.dlv.first_round)
+    for i in range(2):
+        exp_pairs = delivery_stats(
+            fr[i], np.asarray(run.states.msgs.birth[i]),
+            np.asarray(run.states.msgs.topic[i]),
+            np.asarray(run.states.msgs.origin[i]),
+            np.asarray(net.subscribed),
+        )
+        assert hist[i].sum() == exp_pairs.delivered
+
+
+def test_batched_counter_events_drain():
+    net = _net(seed=25)
+    po, pt, pv = _schedule(N, ROUNDS, seed=25)
+    cc = ChaosConfig(loss_rate=0.5)
+    st0 = SimState.init(N, M, seed=26, k=net.max_degree)
+    ens = ensemble.lift_floodsub(net, chaos=cc)
+    run = _run_batched(ens, ensemble.batch_states(st0, 2), po, pt, pv, 2)
+    per_sim, totals = batched_counter_events(run.states.events)
+    assert len(per_sim) == 2
+    # exact per sim: each row equals the unbatched counter_events dict
+    ev = np.asarray(run.states.events)
+    for i in range(2):
+        assert per_sim[i]["LINK_DOWN"] == int(ev[i][13])
+        assert per_sim[i]["PUBLISH_MESSAGE"] == int(ev[i][0])
+    assert totals["LINK_DOWN"] == sum(d["LINK_DOWN"] for d in per_sim)
+    # independent fault streams -> (almost surely) different link tallies
+    assert per_sim[0]["LINK_DOWN"] > 0
+    with pytest.raises(ValueError, match="batched"):
+        batched_counter_events(ev[0])
+
+
+def test_mesh_reform_latency_semantics():
+    # the band-robust partition-repair metric (chaos/metrics.py):
+    # trough (<= prune_floor) then re-formation (>= min_edges)
+    from go_libp2p_pubsub_tpu.chaos import mesh_reform_latency
+
+    arc = [(10, 30), (12, 8), (14, 1), (18, 2), (22, 9)]
+    assert mesh_reform_latency(arc, heal_tick=10) == 12
+    # never troughs but stays connected: connectivity never collapsed
+    assert mesh_reform_latency(
+        [(10, 30), (14, 12), (18, 15)], heal_tick=10) == 0
+    # troughs and never re-forms
+    assert mesh_reform_latency(
+        [(10, 30), (14, 0), (18, 3)], heal_tick=10) is None
+    # hovers below min_edges without ever recovering
+    assert mesh_reform_latency(
+        [(10, 30), (14, 4), (18, 5)], heal_tick=10) is None
+    # pre-heal readings are ignored entirely
+    assert mesh_reform_latency(
+        [(2, 0), (10, 30), (12, 1), (16, 7)], heal_tick=10) == 6
+
+
+def test_iwant_shares_batched():
+    ev = np.zeros((2, 15), np.int64)
+    ev[0, 3] = 100  # DELIVER_MESSAGE
+    ev[0, 14] = 25  # IWANT_RECOVER
+    shares = estats.batched_iwant_shares(ev)
+    assert shares[0] == pytest.approx(0.25)
+    assert shares[1] == 0.0
