@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke analyze sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke analyze sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -49,6 +49,17 @@ chaos-smoke:
 ensemble-smoke:
 	python scripts/ensemble_report.py --smoke
 
+# telemetry-plane gate (scripts/telemetry_smoke.py; docs/DESIGN.md §11):
+# the bench gossipsub step TELEMETRY-ON at the PERF_SMOKE shape — one
+# compile (cache sentinel) with ZERO host transfers across the run
+# window (transfer_guard 'disallow'), summed per-round EV deltas ==
+# drained counters bit-for-bit, telemetry-on compiled kernel census
+# within TELEMETRY_SMOKE.json's ceiling (TELEMETRY_SMOKE_UPDATE=1
+# rewrites), and warm-vs-warm overhead <= 15% over the telemetry-off
+# build (TELEMETRY_SMOKE_OVERHEAD overrides). ~40 s warm on CPU.
+telemetry-smoke:
+	python scripts/telemetry_smoke.py
+
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
 # discipline, packed-word dtype hygiene, import-time execution, static-
@@ -71,13 +82,14 @@ test:
 
 # quick tier: the sub-10-minute CI gate — `not slow` tests plus the CPU
 # perf-smoke regression gate, the chaos-smoke recovery gate, the
-# ensemble-plane gate and the analysis-plane gate (all fast once the
-# compile cache is warm)
+# ensemble-plane gate, the telemetry-plane gate and the analysis-plane
+# gate (all fast once the compile cache is warm)
 quick:
 	python -m pytest tests/ -q -m "not slow"
 	python -m go_libp2p_pubsub_tpu.perf.regress
 	python scripts/chaos_report.py --smoke
 	python scripts/ensemble_report.py --smoke
+	python scripts/telemetry_smoke.py
 	python scripts/analyze.py
 
 native:

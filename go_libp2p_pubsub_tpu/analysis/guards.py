@@ -34,6 +34,14 @@ stacked coalesced wire path, floodsub, randomsub):
                  wobble or an unhashable static into a failure instead
                  of a silent 100x slowdown.
 
+Two derived paths run the same guard set without their own committed
+baselines: the ENSEMBLE engine (S=2 vmap lift; schema = base rows plus
+a leading S axis) and, since round 11, the TELEMETRY engine (the base
+bench step with the per-round panel recorder on; schema = base rows
+plus the pinned ``.core.telem`` leaves — its transfer_guard run is the
+"telemetry records every round with zero host transfers and one
+compile" acceptance invariant).
+
 The harness shapes are deliberately small (N=192, K=16, M=64, r=4 —
 compile-bound, ~seconds warm via the shared .jax_cache); the invariants
 they pin are shape-independent. Entry: ``scripts/analyze.py`` /
@@ -70,6 +78,21 @@ ENGINES = ("gossipsub", "gossipsub_phase", "floodsub", "randomsub")
 ENSEMBLE_ENGINE = "ensemble"
 ENSEMBLE_BASE = "gossipsub"
 ENSEMBLE_S = 2
+
+#: the telemetry path (round 11): the gossipsub bench step built with a
+#: TelemetryConfig runs the same guard set — in particular the
+#: GUARD_ROUNDS execution under ``transfer_guard('disallow')`` with the
+#: one-compile sentinel, which is the "zero host transfers in the run
+#: window, one compile, telemetry on" acceptance invariant. Like the
+#: ensemble engine its schema is NOT committed separately: stripping the
+#: ``.core.telem`` leaves must yield EXACTLY the committed ``gossipsub``
+#: rows (telemetry only ADDS the panel plane), and the telem leaves
+#: themselves are pinned against TelemetryConfig/N_METRICS here.
+TELEMETRY_ENGINE = "telemetry"
+TELEMETRY_BASE = "gossipsub"
+TELEMETRY_ROWS = GUARD_ROUNDS
+TELEMETRY_TRACKED = (0, 7)
+_TELEM_PREFIX = ".core.telem"
 
 #: StableHLO markers proving the state argument is donated
 _DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
@@ -184,6 +207,80 @@ def build_ensemble_harness() -> EngineHarness:
                      for a in _pub_args((PUB_WIDTH,), i))
 
     return EngineHarness(ENSEMBLE_ENGINE, ens, states, make_args, {})
+
+
+def build_telemetry_harness() -> EngineHarness:
+    """The telemetry-on path: the TELEMETRY_BASE bench step built with a
+    TelemetryConfig (panel rows sized to the guarded run, two tracked
+    flight-recorder peers) and live event counters — the build every
+    reconciliation gate uses. Fresh jit via build_bench, so the
+    recompile sentinel covers the telemetry-on program."""
+    from ..perf.sweep import build_bench
+    from ..telemetry import TelemetryConfig
+
+    tcfg = TelemetryConfig(rows=TELEMETRY_ROWS, tracked=TELEMETRY_TRACKED)
+    st, step, _, _ = build_bench(
+        GUARD_N, GUARD_M, heartbeat_every=1, rounds_per_phase=1,
+        telemetry=tcfg, count_events=True,
+    )
+    return EngineHarness(
+        TELEMETRY_ENGINE, step, st,
+        lambda i: _pub_args((PUB_WIDTH,), i), {},
+    )
+
+
+def check_schema_telemetry(h: EngineHarness, out_tree,
+                           base_rows: list | None) -> list:
+    """Schema guard for the telemetry engine: weak-type audit, pin the
+    ``.core.telem`` leaves (panel/flight dtype + shape from the static
+    TelemetryConfig), then the REMAINING rows must equal the base
+    engine's committed rows — telemetry only adds the panel plane; any
+    other drift is a real state change hiding behind the flag. That
+    includes the ``events`` leaf: the telemetry build counts events
+    (count_events=True) while the committed bench rows are
+    tracer-detached, and the comparison doubles as the pin that the
+    live-counters build changes no leaf schema."""
+    from ..telemetry import N_FLIGHT, N_METRICS
+
+    rows = schema_of(out_tree)
+    weak = [r["path"] for r in rows if r["weak_type"]]
+    if weak:
+        raise GuardViolation(
+            h.name, "schema",
+            f"weak-typed state leaves {weak[:4]} in the telemetry step",
+        )
+    telem = [r for r in rows if r["path"].startswith(_TELEM_PREFIX)]
+    want_telem = {
+        f"{_TELEM_PREFIX}.panel": [TELEMETRY_ROWS, N_METRICS],
+        f"{_TELEM_PREFIX}.flight": [TELEMETRY_ROWS,
+                                    len(TELEMETRY_TRACKED), N_FLIGHT],
+    }
+    got_telem = {r["path"]: r for r in telem}
+    for path, shape in want_telem.items():
+        r = got_telem.get(path)
+        if r is None or r["dtype"] != "float32" or r["shape"] != shape:
+            raise GuardViolation(
+                h.name, "schema",
+                f"telemetry leaf {path} expected float32 {shape}, got "
+                f"{r} — the panel plane does not match its static "
+                "TelemetryConfig",
+            )
+    if set(got_telem) != set(want_telem):
+        raise GuardViolation(
+            h.name, "schema",
+            f"unexpected telemetry leaves {sorted(set(got_telem) - set(want_telem))}",
+        )
+    stripped = [r for r in rows if not r["path"].startswith(_TELEM_PREFIX)]
+    if base_rows is not None:
+        mism = diff_schema(h.name, stripped, base_rows)
+        if mism:
+            raise GuardViolation(
+                h.name, "schema",
+                f"{len(mism)} non-telemetry leaf drift(s) vs the "
+                f"{TELEMETRY_BASE!r} baseline after stripping "
+                f"{_TELEM_PREFIX}.*: " + "; ".join(mism[:5]),
+            )
+    return stripped
 
 
 def _call(h: EngineHarness, state, i: int):
@@ -449,6 +546,21 @@ def run_ensemble_engine(base_rows: list | None) -> list:
     return rows
 
 
+def run_telemetry_engine(base_rows: list | None) -> list:
+    """All guards for the telemetry-on path: strict-dtype trace, the
+    telem-leaf pin + base-row comparison, buffer-donation audit, and
+    the GUARD_ROUNDS execution under ``transfer_guard('disallow')``
+    with the one-compile sentinel — i.e. the recorder writes every
+    round with ZERO host transfers in the run window and no
+    per-round recompiles. Returns the stripped (non-telem) rows."""
+    h = build_telemetry_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_telemetry(h, out_tree, base_rows)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
 def run(update: bool | None = None, root: str | None = None) -> list:
     """The full harness over every engine. Returns a list of failure
     strings (empty = pass). ``update`` (default: env ANALYZE_UPDATE)
@@ -498,6 +610,17 @@ def run(update: bool | None = None, root: str | None = None) -> list:
             failures.append(str(e))
         except Exception as e:  # noqa: BLE001 — any crash is a finding
             failures.append(f"[{ENSEMBLE_ENGINE}] harness crashed: "
+                            f"{type(e).__name__}: {str(e)[:300]}")
+    # the telemetry-on path validates against the same base rows (the
+    # telem leaves are pinned internally, everything else must be the
+    # base engine's tree exactly — never a second committed baseline)
+    if base_rows is not None:
+        try:
+            run_telemetry_engine(base_rows)
+        except GuardViolation as e:
+            failures.append(str(e))
+        except Exception as e:  # noqa: BLE001 — any crash is a finding
+            failures.append(f"[{TELEMETRY_ENGINE}] harness crashed: "
                             f"{type(e).__name__}: {str(e)[:300]}")
     if update and not failures:
         write_baseline(schemas, root)

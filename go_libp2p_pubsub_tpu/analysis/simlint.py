@@ -60,7 +60,20 @@ Rule catalog (ids are stable; docs/DESIGN.md §9):
                  ``TraceEvent.<NAME>`` record (proto-backed events) or
                  named in drain.py's counter-only documentation
                  (sim-only counters) — so no counter can silently stop
-                 being drained or documented.
+                 being drained or documented. Since the telemetry plane
+                 (round 11) an EV accumulated into the per-round
+                 timeline ALSO counts as drained: a sim-only counter
+                 whose ``ev_<name>`` column is in telemetry/panel.py's
+                 catalog is visible to every run report even if the
+                 drain prose never names it.
+  telemetry-panel  telemetry/panel.py's ``EV_METRICS`` catalog must
+                 carry one ``ev_<name>`` column per ``EV`` member, in
+                 enum order (the panel writes the whole delta vector by
+                 position — a missing/misordered column silently
+                 relabels every metric to its right), and every
+                 recorded EV column must be in ``RECONCILED`` — a
+                 recorded-but-never-reconciled metric is a timeline
+                 that can drift from the drained counters unchecked.
 
 Allowlist: ``analysis/ALLOWLIST`` lines of ``<rule> <relpath>`` or
 ``<rule> <relpath>::<qualname>`` (``#`` comments). Entries match every
@@ -519,8 +532,14 @@ def _proto_event_names(proto_src: str) -> set:
 
 
 def check_ev_drain(ev_names, proto_names, drain_src: str,
-                   package_refs: set) -> list:
-    """The ev-drain rule on explicit inputs (unit-testable)."""
+                   package_refs: set, telemetry_src: str = "") -> list:
+    """The ev-drain rule on explicit inputs (unit-testable).
+
+    ``telemetry_src`` is telemetry/panel.py's source (or ``""`` pre-
+    telemetry): a sim-only counter whose ``ev_<name>`` timeline column
+    appears there counts as drained — the panel records its per-round
+    deltas and the reconciliation gate pins them to the counters, which
+    is stronger visibility than a prose mention in the drain."""
     out = []
     for name in ev_names:
         if name not in package_refs:
@@ -537,14 +556,109 @@ def check_ev_drain(ev_names, proto_names, drain_src: str,
                     "emission in the drain — the reconstructive tracer "
                     "silently drops it",
                 ))
-        elif name not in drain_src:
+        elif (name not in drain_src
+              and f"ev_{name.lower()}" not in telemetry_src):
             out.append(Violation(
                 "ev-drain", "trace/drain.py", 1, "",
-                f"sim-only counter EV.{name} is not documented in the "
-                "drain (counter_events exposes it, but the drain contract "
+                f"sim-only counter EV.{name} is neither documented in "
+                "the drain nor recorded as a telemetry timeline column "
+                "(counter_events exposes it, but a consumer contract "
                 "must say so by name)",
             ))
     return out
+
+
+def _tuple_value(tree: ast.Module, v: ast.expr) -> list | None:
+    """Evaluate a string-tuple expression: a literal tuple/list, a Name
+    aliasing another module-level tuple (resolved against ``tree``), or
+    a ``+`` concatenation of such expressions."""
+    if isinstance(v, ast.Name):             # e.g. RECONCILED = EV_METRICS
+        return _tuple_literal(tree, v.id)
+    if isinstance(v, (ast.Tuple, ast.List)):
+        out = []
+        for elt in v.elts:
+            if not isinstance(elt, ast.Constant) or not isinstance(
+                    elt.value, str):
+                return None
+            out.append(elt.value)
+        return out
+    if isinstance(v, ast.BinOp) and isinstance(v.op, ast.Add):
+        left = _tuple_value(tree, v.left)
+        right = _tuple_value(tree, v.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
+
+
+def _tuple_literal(tree: ast.Module, name: str) -> list | None:
+    """A module-level ``NAME = ("a", "b", ...)`` string-tuple literal —
+    aliases and ``+`` concatenations of other module-level tuples
+    resolve too (None when absent or not statically evaluable)."""
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            return _tuple_value(tree, node.value)
+    return None
+
+
+def check_telemetry_panel(ev_names, ev_metrics, reconciled) -> list:
+    """The telemetry-panel rule on explicit inputs (unit-testable):
+    the EV column catalog must mirror the EV enum positionally, and
+    every recorded EV column must be reconciled."""
+    rel = "telemetry/panel.py"
+    out = []
+    want = [f"ev_{n.lower()}" for n in ev_names]
+    if list(ev_metrics) != want:
+        out.append(Violation(
+            "telemetry-panel", rel, 1, "EV_METRICS",
+            f"EV column catalog {list(ev_metrics)} != one ev_<name> "
+            f"column per EV member in enum order {want} — the panel "
+            "writes the delta vector by position, so a missing or "
+            "misordered column silently relabels every column after it",
+        ))
+    rec = set(reconciled)
+    for col in ev_metrics:
+        if col not in rec:
+            out.append(Violation(
+                "telemetry-panel", rel, 1, "RECONCILED",
+                f"telemetry metric {col!r} is recorded into the panel "
+                "but missing from RECONCILED — a timeline column the "
+                "drain-vs-timeline gate never checks can drift from "
+                "the counters unnoticed",
+            ))
+    for col in reconciled:
+        if col not in ev_metrics:
+            out.append(Violation(
+                "telemetry-panel", rel, 1, "RECONCILED",
+                f"RECONCILED names {col!r} which is not a recorded "
+                "EV_METRICS column — the reconciliation would read a "
+                "column that does not exist",
+            ))
+    return out
+
+
+def _rule_telemetry_panel(pkg_root: str) -> list:
+    panel_p = os.path.join(pkg_root, "telemetry", "panel.py")
+    events_p = os.path.join(pkg_root, "trace", "events.py")
+    if not os.path.exists(panel_p):
+        return []
+    with open(events_p) as f:
+        ev_names = _ev_members(f.read())
+    with open(panel_p) as f:
+        tree = ast.parse(f.read())
+    ev_metrics = _tuple_literal(tree, "EV_METRICS")
+    reconciled = _tuple_literal(tree, "RECONCILED")
+    if ev_metrics is None or reconciled is None:
+        return [Violation(
+            "telemetry-panel", "telemetry/panel.py", 1, "",
+            "EV_METRICS/RECONCILED must be module-level string-tuple "
+            "literals (or an alias/concatenation of them) — the lint "
+            "pins the catalog against the EV enum and cannot evaluate "
+            "computed catalogs",
+        )]
+    return check_telemetry_panel(ev_names, ev_metrics, reconciled)
 
 
 def _rule_ev_drain(pkg_root: str) -> list:
@@ -570,7 +684,13 @@ def _rule_ev_drain(pkg_root: str) -> list:
             continue
         for m in re.finditer(r"\bEV\.(\w+)", src):
             refs.add(m.group(1))
-    return check_ev_drain(ev_names, proto_names, drain_src, refs)
+    tele_p = os.path.join(pkg_root, "telemetry", "panel.py")
+    telemetry_src = ""
+    if os.path.exists(tele_p):
+        with open(tele_p) as f:
+            telemetry_src = f.read()
+    return check_ev_drain(ev_names, proto_names, drain_src, refs,
+                          telemetry_src)
 
 
 # ---------------------------------------------------------------------------
@@ -606,6 +726,7 @@ def lint_package(pkg_root: str) -> list:
         except SyntaxError as e:  # pragma: no cover
             out.append(Violation("parse", rel, e.lineno or 1, "", str(e)))
     out.extend(_rule_ev_drain(pkg_root))
+    out.extend(_rule_telemetry_panel(pkg_root))
     return sorted(out, key=lambda v: (v.rel, v.line, v.rule))
 
 

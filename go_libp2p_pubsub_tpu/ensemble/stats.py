@@ -113,6 +113,29 @@ def cdf_bands(counts, qs=(0.1, 0.5, 0.9)):
     return {"pooled": pooled, "bands": bands, "qs": tuple(qs)}
 
 
+def panel_bands(panels, qs=(0.25, 0.5, 0.75)):
+    """[len(qs), T, n_metrics] per-observation quantile bands over a
+    batched telemetry panel stack ``[S, T, n_metrics]`` (the round-11
+    timeline plane: every sim records one f32 row per round/phase as a
+    scan-style extra output; telemetry/panel.py). The reduction runs on
+    device — one vmapped-quantile kernel over the sim axis, no [S, T,
+    M] transfer — for consumers that keep working on device. The
+    schema-v3 ``timeline`` artifact block does NOT use it: committed
+    artifacts are built by ``telemetry.timeline_block``, which computes
+    the same bands host-side in f64 so the pinned values stay stable
+    across backends — change band semantics there, not here. A single
+    sim's ``[T, M]`` panel is accepted and degenerates to identical
+    bands."""
+    p = jnp.asarray(panels)
+    if p.ndim == 2:
+        p = p[None]
+    if p.ndim != 3:
+        raise ValueError(f"expected [S, T, n_metrics] panels, got {p.shape}")
+    return np.asarray(
+        jnp.quantile(p, jnp.asarray(qs, jnp.float32), axis=0)
+    )
+
+
 def quantile_band(values, qs=(0.25, 0.5, 0.75)) -> dict:
     """Median/IQR-style summary of one per-sim metric: ``{q: value}``
     plus ``n`` and min/max. Works on [S] device or host arrays; NaNs

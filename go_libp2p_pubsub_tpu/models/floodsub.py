@@ -31,7 +31,8 @@ def flood_edge_mask(net: Net, msgs) -> jax.Array:
 
 
 @functools.partial(jax.jit, donate_argnums=1,
-                   static_argnames=("queue_cap", "stacked", "chaos"))
+                   static_argnames=("queue_cap", "stacked", "chaos",
+                                    "telemetry"))
 def floodsub_step(
     net: Net,
     state: SimState,
@@ -46,6 +47,9 @@ def floodsub_step(
                             # (chaos/faults.py); None/off elides statically
     link_deny: jax.Array | None = None,  # [N,K] bool scheduled outages
                             # (ChaosConfig.scheduled scenarios)
+    telemetry=None,         # TelemetryConfig | None — per-round panel row
+                            # (telemetry/panel.py; state needs
+                            # SimState.init(telemetry=...)); None elides
 ) -> SimState:
     """One synchronous round: deliver in-flight messages one hop, then
     intern this round's publishes (they start propagating next round).
@@ -82,7 +86,18 @@ def floodsub_step(
         if chaos.needs_state:
             state = state.replace(chaos=state.chaos.replace(ge_bad=ge_bad_next))
 
-    return state.replace(tick=state.tick + 1, msgs=msgs, dlv=dlv, events=events)
+    telem = state.telem
+    if telemetry is not None:
+        from ..telemetry import panel as _tele
+
+        # mesh-less engine: the mesh/score columns record zeros (the
+        # catalog is fixed so panels from different engines stack)
+        telem = _tele.record_step(
+            telemetry, telem, state.tick, state.events, events,
+            net, msgs, dlv,
+        )
+    return state.replace(tick=state.tick + 1, msgs=msgs, dlv=dlv,
+                         events=events, telem=telem)
 
 
 def run_rounds(net: Net, state: SimState, n_rounds: int) -> SimState:

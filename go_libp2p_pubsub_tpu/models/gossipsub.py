@@ -363,6 +363,7 @@ class GossipSubState:
         app_score: np.ndarray | None = None,
         dormant: np.ndarray | None = None,
         wire_block: bool = False,
+        telemetry=None,
     ) -> "GossipSubState":
         n, k = net.nbr.shape
         s = net.n_slots
@@ -381,7 +382,8 @@ class GossipSubState:
                                val_delay=cfg.validation_delay_rounds,
                                wire_block=wire_block,
                                chaos_ge=(cfg.chaos is not None
-                                         and cfg.chaos.needs_state)),
+                                         and cfg.chaos.needs_state),
+                               telemetry=telemetry),
             mesh=jnp.zeros((n, s, k), bool),
             backoff_expire=jnp.zeros((n, s, k), jnp.int32),
             backoff_present=jnp.zeros((n, s, k), bool),
@@ -1738,6 +1740,7 @@ def make_gossipsub_step(
     adversary_no_forward: np.ndarray | None = None,
     static_heartbeat: bool = False,
     sub_knowledge_holes: np.ndarray | None = None,
+    telemetry=None,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
@@ -1773,6 +1776,16 @@ def make_gossipsub_step(
     (gossipsub_test.go:1777-1811): grafted-but-silent peers that starve
     their mesh neighbors, to be caught by the P3 mesh-delivery deficit and
     IWANT-promise (P7) machinery.
+
+    ``telemetry`` (a telemetry.TelemetryConfig) appends the time-series
+    recorder as the step's LAST operation: one ``[N_METRICS]`` f32 panel
+    row per round — EV-counter deltas covering everything this round
+    accumulated (delivery, control, churn, heartbeat), delivery ratio,
+    mesh/score stats — written into ``state.core.telem`` on device
+    (docs/DESIGN.md §11). The state must be built with the same config
+    (``GossipSubState.init(telemetry=...)``). None (the default) elides
+    the plane statically: the traced program and the state tree are the
+    pre-telemetry ones, bit for bit.
     """
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
@@ -1825,6 +1838,10 @@ def make_gossipsub_step(
     def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
                do_heartbeat: bool = True,
                link_deny=None) -> GossipSubState:
+        # telemetry: counters at step ENTRY (before the churn plane's
+        # ADD/REMOVE_PEER accounting), so the row's EV deltas cover the
+        # whole step and the panel sums telescope to the drained totals
+        ev_prev = st.core.events if telemetry is not None else None
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
         if dynamic_peers:
             st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
@@ -2237,6 +2254,22 @@ def make_gossipsub_step(
                 st2 = hb(st2)
         else:
             st2 = jax.lax.cond((tick % cfg.heartbeat_every) == 0, hb, lambda s: s, st2)
+
+        # telemetry row — the step's LAST operation, after the heartbeat
+        # (whose GRAFT/PRUNE accounting the EV deltas must cover)
+        if telemetry is not None:
+            from ..telemetry import panel as _tele
+
+            core_f = st2.core
+            telem = _tele.record_step(
+                telemetry, core_f.telem, tick, ev_prev, core_f.events,
+                net_l, core_f.msgs, core_f.dlv,
+                mesh=st2.mesh, my_topics=net_l.my_topics,
+                scores=st2.scores,
+                backoff_active=(st2.backoff_present
+                                & (st2.backoff_expire > tick)),
+            )
+            st2 = st2.replace(core=core_f.replace(telem=telem))
 
         return st2.replace(core=st2.core.replace(tick=tick + 1))
 

@@ -231,6 +231,7 @@ def make_gossipsub_phase_step(
     score_counts: bool | None = None,
     exact_counters: bool = False,
     admission_capped: bool = False,
+    telemetry=None,
 ):
     """Build the jitted multi-round phase step.
 
@@ -267,6 +268,16 @@ def make_gossipsub_phase_step(
     trace time when ``rounds_per_phase * pub_width > msg_slots // 2``.
     ``admission_capped=True`` (the API's builds) suppresses the warning —
     the caller certifies it enforces the flat cap itself.
+
+    ``telemetry`` (a telemetry.TelemetryConfig) appends the time-series
+    recorder at the phase TAIL: ONE panel row per PHASE
+    (``rounds_per_row = r`` — the same cadence caveat the drain and the
+    chaos metrics document), whose EV deltas cover all r sub-rounds plus
+    the control head and heartbeat, so summed rows still reconcile
+    bit-for-bit against the drained counters. The state must be built
+    with the same config (``GossipSubState.init(telemetry=...)``) and a
+    driver must start ticks at a multiple of r (every scan/driver does —
+    the row index is ``tick0 // r``). None elides the plane statically.
     """
     r = int(rounds_per_phase)
     assert r >= 1
@@ -324,6 +335,10 @@ def make_gossipsub_phase_step(
 
     def _phase(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
                do_heartbeat: bool, link_deny=None) -> GossipSubState:
+        # telemetry: counters at phase ENTRY, before the churn plane's
+        # ADD/REMOVE_PEER accounting (the phase-tail row's deltas cover
+        # the whole phase, so the panel sums telescope exactly)
+        ev_prev = st.core.events if telemetry is not None else None
         # ---- control head (once per phase) ------------------------------
         if dynamic_peers:
             st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
@@ -982,6 +997,22 @@ def make_gossipsub_phase_step(
                 gater_params, nbr_sub_words_l, present_ok=net.nbr_ok,
                 gossip_suppress=gossip_suppress, app_gathered=app_g,
             )
+
+        # telemetry row — one per phase, recorded LAST (after the
+        # heartbeat's GRAFT/PRUNE accounting), at phase-tail state
+        if telemetry is not None:
+            from ..telemetry import panel as _tele
+
+            core_f = st2.core
+            telem = _tele.record_step(
+                telemetry, core_f.telem, tick0, ev_prev, core_f.events,
+                net_l, core_f.msgs, core_f.dlv, rounds_per_row=r,
+                mesh=st2.mesh, my_topics=net_l.my_topics,
+                scores=st2.scores,
+                backoff_active=(st2.backoff_present
+                                & (st2.backoff_expire > tick_last)),
+            )
+            st2 = st2.replace(core=core_f.replace(telem=telem))
         return st2.replace(core=st2.core.replace(tick=tick0 + r))
 
     # scheduled-chaos builds take the Scenario's forced-down link mask as

@@ -33,7 +33,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
                         size_estimate: int | None = None,
                         queue_cap: int = 0,
                         stacked: bool = True,
-                        chaos: "chaos_faults.ChaosConfig | None" = None):
+                        chaos: "chaos_faults.ChaosConfig | None" = None,
+                        telemetry=None):
     """Build the jitted per-round RandomSub step.
 
     `size_estimate` mirrors the reference's static network-size parameter:
@@ -58,7 +59,12 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
     generators and elision contract as the other routers); a
     ``scheduled=True`` config makes the step take a trailing
     ``link_deny [N, K]`` argument, and a GE generator needs
-    ``SimState.init(chaos_ge=True)``."""
+    ``SimState.init(chaos_ge=True)``.
+
+    ``telemetry`` (a telemetry.TelemetryConfig) appends the per-round
+    panel recorder as the step's last operation (mesh/score columns
+    record zeros — randomsub has neither plane); the state needs
+    ``SimState.init(telemetry=...)``. None elides it statically."""
     chaos = chaos_faults.resolve(chaos)
     chaos_sched = chaos is not None and chaos.scheduled
     protocol = np.asarray(net.protocol)
@@ -125,7 +131,15 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
             )
             if chaos.needs_state:
                 st = st.replace(chaos=st.chaos.replace(ge_bad=ge_bad_next))
-        return st.replace(tick=tick + 1, msgs=msgs, dlv=dlv, events=events)
+        telem = st.telem
+        if telemetry is not None:
+            from ..telemetry import panel as _tele
+
+            telem = _tele.record_step(
+                telemetry, telem, tick, st.events, events, net, msgs, dlv,
+            )
+        return st.replace(tick=tick + 1, msgs=msgs, dlv=dlv, events=events,
+                          telem=telem)
 
     if chaos_sched:
         def step(st, pub_origin, pub_topic, pub_valid, link_deny):

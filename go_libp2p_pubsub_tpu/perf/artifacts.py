@@ -1,4 +1,4 @@
-"""Versioned, self-describing bench artifacts (schema v2) + readers.
+"""Versioned, self-describing bench artifacts (schema v3) + readers.
 
 Motivation (ADVICE round 5, item 1): the round-5 headline gains partly
 came from a *workload* change — the honest-net configs zeroed
@@ -11,18 +11,23 @@ gating), so an artifact alone answers "what exactly was measured".
 
 Three on-disk shapes are normalized here:
 
-  * **v2 line** — what bench.py now prints: the v1 metric fields plus
-    ``"schema": 2`` and ``"fingerprint": {...}``;
+  * **v2/v3 line** — what bench.py now prints: the v1 metric fields plus
+    ``"schema": 2|3`` and ``"fingerprint": {...}``. Schema v3 (round 11)
+    adds an optional top-level ``"timeline"`` block — the telemetry
+    plane's per-round time-series bands (telemetry.timeline_block) — so
+    an artifact carries the run's trajectory, not just its endpoint;
   * **v1 line** — rounds 1–5 bench output: bare
     ``{"metric", "value", "unit", "vs_baseline", ...}``;
   * **driver wrapper** — the committed ``BENCH_r0*.json`` files:
     ``{"n": round, "cmd", "rc", "tail", "parsed": <line>}`` where
-    ``parsed`` is a v1 or v2 line (``MULTICHIP_r0*.json`` wrappers carry
+    ``parsed`` is a v1/v2/v3 line (``MULTICHIP_r0*.json`` wrappers carry
     ``{"n_devices", "rc", "ok", "skipped", "tail"}`` instead).
 
 ``load_bench_artifact`` accepts any of the three and returns a
-:class:`BenchRecord`; ``load_bench_trajectory`` globs a repo checkout
-for the committed ``BENCH_r*.json`` series in round order.
+:class:`BenchRecord`; ``load_bench_lines`` reads every metric line of a
+JSON-lines artifact (timeline files carry several);
+``load_bench_trajectory`` globs a repo checkout for the committed
+``BENCH_r*.json`` series in round order.
 """
 
 from __future__ import annotations
@@ -33,7 +38,7 @@ import json
 import os
 import re
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 #: the north-star denominator every ``vs_baseline`` in the series uses
 #: (BASELINE.json: >= 10k simulated delivery rounds / heartbeat ticks
@@ -54,6 +59,14 @@ ENSEMBLE_OFF = {"n_sims": 1, "sim_key": "base", "aggregation": "point"}
 #: the one sim-key derivation the ensemble plane implements
 #: (ensemble/batch.py): sim i's PRNG key is fold_in(sim_key, i)
 SIM_KEY_DERIVATION = "fold_in(sim_key, sim_idx)"
+
+#: the telemetry-plane defaults every artifact WITHOUT a timeline block
+#: reads back as (every line up to schema v2 — the whole committed
+#: trajectory predates the telemetry plane): no panel was recorded, so
+#: readers asking for the trajectory get an explicit empty-but-typed
+#: answer instead of a KeyError
+TELEMETRY_OFF = {"enabled": False, "rounds_per_row": 1, "rows": 0,
+                 "n_sims": 0, "metrics": [], "series": {}}
 
 
 def ensemble_fingerprint(n_sims: int = 1,
@@ -108,6 +121,9 @@ class BenchRecord:
     fingerprint: dict | None = None
     round_index: int | None = None
     extras: dict = dataclasses.field(default_factory=dict)
+    #: schema-v3 telemetry block (telemetry.timeline_block); None when
+    #: the producing run recorded no panel — read through .timeline
+    timeline_raw: dict | None = None
 
     # -- derived views ----------------------------------------------------
 
@@ -184,6 +200,21 @@ class BenchRecord:
         return int(self.ensemble["n_sims"])
 
     @property
+    def timeline(self) -> dict:
+        """The schema-v3 timeline block. LEGACY artifacts (every line
+        that predates the telemetry plane) read back as
+        :data:`TELEMETRY_OFF`, so readers — the run report, gates —
+        can ask any artifact for its trajectory without special-casing
+        age; ``timeline["enabled"]`` says whether one was recorded."""
+        out = dict(TELEMETRY_OFF)
+        out.update(self.timeline_raw or {})
+        return out
+
+    @property
+    def telemetry_on(self) -> bool:
+        return bool(self.timeline["enabled"])
+
+    @property
     def permute_sets_per_phase(self) -> int | None:
         """MEASURED halo gather sets per phase (16 rolled permutes each)
         recorded by round-7+ fingerprints; None for legacy artifacts —
@@ -193,9 +224,14 @@ class BenchRecord:
         return None if v is None else int(v)
 
     def to_line(self) -> dict:
-        """The v2 JSON-line object (what bench.py prints)."""
+        """The JSON-line object (what bench.py prints) — stamped with
+        the record's OWN schema so v2 lines round-trip losslessly; a
+        timeline block forces at least v3 (the version that defines
+        it)."""
         out = {
-            "schema": SCHEMA_VERSION,
+            "schema": (max(int(self.schema), SCHEMA_VERSION)
+                       if self.timeline_raw is not None
+                       else int(self.schema)),
             "metric": self.metric,
             "value": self.value,
             "unit": self.unit,
@@ -204,6 +240,8 @@ class BenchRecord:
         out.update(self.extras)
         if self.fingerprint is not None:
             out["fingerprint"] = self.fingerprint
+        if self.timeline_raw is not None:
+            out["timeline"] = self.timeline_raw
         return out
 
 
@@ -213,10 +251,11 @@ def dump_record(rec: BenchRecord) -> str:
 
 
 def record_from_line(obj: dict, round_index: int | None = None) -> BenchRecord:
-    """Normalize a parsed v1/v2 metric line into a BenchRecord."""
+    """Normalize a parsed v1/v2/v3 metric line into a BenchRecord."""
     if "metric" not in obj:
         raise ValueError(f"not a bench metric line: keys={sorted(obj)}")
-    known = {"schema", "metric", "value", "unit", "vs_baseline", "fingerprint"}
+    known = {"schema", "metric", "value", "unit", "vs_baseline",
+             "fingerprint", "timeline"}
     return BenchRecord(
         metric=str(obj["metric"]),
         value=float(obj["value"]),
@@ -226,6 +265,7 @@ def record_from_line(obj: dict, round_index: int | None = None) -> BenchRecord:
         fingerprint=obj.get("fingerprint"),
         round_index=round_index,
         extras={k: v for k, v in obj.items() if k not in known},
+        timeline_raw=obj.get("timeline"),
     )
 
 
@@ -264,6 +304,32 @@ def load_bench_artifact(path: str) -> BenchRecord:
             raise ValueError(f"{path}: wrapper has no parseable tail line")
         return record_from_line(inner, round_index=obj.get("n"))
     return record_from_line(obj)
+
+
+def load_bench_lines(path: str) -> list[BenchRecord]:
+    """Every metric line of a JSON-lines artifact, in file order
+    (timeline artifacts carry one line per experiment cell; single-line
+    and wrapper files come back as a one-element list)."""
+    with open(path) as f:
+        text = f.read()
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "parsed" in obj:
+            obj, ridx = obj["parsed"], obj.get("n")
+        else:
+            ridx = None
+        if isinstance(obj, dict) and "metric" in obj:
+            out.append(record_from_line(obj, round_index=ridx))
+    if not out:  # single non-line JSON (wrapper or object): delegate
+        return [load_bench_artifact(path)]
+    return out
 
 
 def load_bench_trajectory(repo_root: str | None = None) -> list[BenchRecord]:

@@ -257,10 +257,14 @@ def hlo_kernel_census(hlo_text: str) -> dict:
 
 def compiled_phase_kernel_count(n_peers: int, rounds_per_phase: int,
                                 config: str = "default",
-                                msg_slots: int = 64) -> dict:
+                                msg_slots: int = 64,
+                                telemetry=None) -> dict:
     """Compile the bench phase step at (n_peers, r) on the current
     platform and census its kernels (hlo_kernel_census). Adds
-    ``per_round`` — the gate's headline number."""
+    ``per_round`` — the gate's headline number. ``telemetry`` (a
+    telemetry.TelemetryConfig) censuses the TELEMETRY-ON build instead
+    (live counters + panel recorder — the `make telemetry-smoke`
+    variant; None is the committed PERF_SMOKE/chaos-smoke build)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -270,7 +274,8 @@ def compiled_phase_kernel_count(n_peers: int, rounds_per_phase: int,
     r = max(int(rounds_per_phase), 1)
     st, step, _, _ = build_bench(
         n_peers, msg_slots, config=config, heartbeat_every=max(r, 1),
-        rounds_per_phase=r,
+        rounds_per_phase=r, telemetry=telemetry,
+        count_events=(True if telemetry is not None else None),
     )
     shape = (r, PUBS_PER_ROUND) if r > 1 else (PUBS_PER_ROUND,)
     po = jnp.asarray(np.full(shape, -1, np.int32))
@@ -284,6 +289,7 @@ def compiled_phase_kernel_count(n_peers: int, rounds_per_phase: int,
     census["per_round"] = round(census["total"] / r, 2)
     census["n_peers"] = int(n_peers)
     census["rounds_per_phase"] = r
+    census["telemetry"] = telemetry is not None
     return census
 
 

@@ -88,7 +88,8 @@ def bench_wire_coalesced(wire_coalesced: bool | None = None) -> bool:
 
 def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "default",
                 heartbeat_every: int = 1, rounds_per_phase: int = 1,
-                wire_coalesced: bool | None = None):
+                wire_coalesced: bool | None = None,
+                telemetry=None, count_events: bool | None = None):
     """Build (state, step, n_topics, honest) for a BENCH_CONFIG:
 
     default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
@@ -110,6 +111,13 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     (models/gossipsub_phase.py): r delivery rounds per dispatch, control
     once per phase — the reference's continuous-delivery / 1 Hz-heartbeat
     timing shape (gossipsub.go:1278-1301).
+
+    ``telemetry`` (a telemetry.TelemetryConfig) builds the TELEMETRY-ON
+    variant of the same workload: the state carries the panel plane and
+    the step records one row per round/phase (docs/DESIGN.md §11).
+    ``count_events`` overrides the tracer-detached default (False);
+    telemetry's EV columns only move when counters are live, so
+    telemetry builds that reconcile pass ``count_events=True``.
     """
     import dataclasses as _dc
 
@@ -154,19 +162,21 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     # no aggregate event counters; no fanout slots when every peer
     # subscribes the topic (fanout provably can't occur in that workload)
     cfg = _dc.replace(
-        cfg, count_events=False,
+        cfg, count_events=(False if count_events is None else count_events),
         fanout_slots=0 if config != "eth2" else cfg.fanout_slots,
     )
-    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=seed)
+    st = GossipSubState.init(net, msg_slots, cfg, score_params=sp, seed=seed,
+                             telemetry=telemetry)
     if rounds_per_phase > 1:
         step = make_gossipsub_phase_step(
             cfg, net, rounds_per_phase, score_params=sp, gater_params=gater,
-            adversary_no_forward=adversary,
+            adversary_no_forward=adversary, telemetry=telemetry,
         )
     else:
         step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gater,
                                    adversary_no_forward=adversary,
-                                   static_heartbeat=heartbeat_every > 1)
+                                   static_heartbeat=heartbeat_every > 1,
+                                   telemetry=telemetry)
 
     n_dev = len(jax.devices())
     if n_dev > 1 and n_peers % n_dev == 0:

@@ -283,11 +283,16 @@ class SimState:
     # changes the pytree leaf count, so checkpoint templates must be
     # built with the same setting)
     chaos: ChaosState | None = None
+    # telemetry plane (telemetry/panel.py): the per-round time-series
+    # panel + flight recorder. None = telemetry off (the default) — the
+    # state tree is leaf-identical to a pre-telemetry build, same
+    # presence contract as the chaos/wire_block planes
+    telem: object | None = None  # TelemetryState | None
 
     @classmethod
     def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0,
              val_delay: int = 0, wire_block: bool = False,
-             chaos_ge: bool = False) -> "SimState":
+             chaos_ge: bool = False, telemetry=None) -> "SimState":
         """`k` is the topology's padded max degree (net.max_degree) — it
         sizes the packed first-arrival-edge plane. k=0 is only for states
         that never enter a delivery round (e.g. checkpoint plumbing).
@@ -295,7 +300,15 @@ class SimState:
         `wire_block` enables the per-message oversized-transmit-block plane
         (WithMaxMessageSize support — off by default, zero hot-path cost).
         `chaos_ge` adds the Gilbert–Elliott link-fault chain plane
-        (required iff the build's ChaosConfig.needs_state)."""
+        (required iff the build's ChaosConfig.needs_state).
+        `telemetry` (a telemetry.TelemetryConfig) allocates the on-device
+        time-series panel — required iff the build's step records one."""
+        if telemetry is not None:
+            from .telemetry.panel import TelemetryState
+
+            telem = TelemetryState.empty(telemetry)
+        else:
+            telem = None
         return cls(
             tick=jnp.int32(0),
             key=jax.random.key(seed),
@@ -303,6 +316,7 @@ class SimState:
             dlv=Delivery.empty(n_peers, msg_slots, k, val_delay),
             events=zero_counters(),
             chaos=ChaosState.empty(n_peers, k) if chaos_ge else None,
+            telem=telem,
         )
 
 

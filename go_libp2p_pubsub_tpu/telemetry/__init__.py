@@ -1,0 +1,43 @@
+"""Telemetry plane: on-device per-round time-series + run ledger
+(docs/DESIGN.md §11).
+
+The reference's L2 is an EventTracer/RawTracer fan-out (trace.go /
+tracer.go) feeding offline time-series analysis — the v1.1 hardening
+evaluation (arxiv 2007.02754) argues entirely from delivery-ratio,
+mesh-degree and score *trajectories*, not end-of-run totals. This
+package supplies that visibility inside one compiled program: every
+``make_*_step`` closure built with a :class:`TelemetryConfig` writes
+one ``[n_metrics]`` f32 row per observation into a pre-allocated
+``[rows, n_metrics]`` panel carried in the state tree — no host
+transfer in the run window, one compile, and the per-event columns
+reconcile bit-for-bit against the drained counters.
+
+  panel   — TelemetryConfig/TelemetryState, the metric catalog, the
+            device-side row recorder every engine calls at its step
+            tail, the sampled per-peer flight recorder, and the host
+            reconciliation check (summed per-row EV deltas == drained
+            counters, exactly)
+
+Entry points: ``scripts/run_report.py`` (HTML/markdown dashboard from
+any schema-v3 artifact), ``scripts/chaos_report.py --timeline``,
+``scripts/ensemble_report.py --timeline``, and ``make
+telemetry-smoke`` (scripts/telemetry_smoke.py).
+"""
+
+from .panel import (  # noqa: F401
+    EV_METRICS,
+    FLIGHT_METRICS,
+    METRICS,
+    N_FLIGHT,
+    N_METRICS,
+    RECONCILED,
+    TelemetryConfig,
+    TelemetryState,
+    metric_index,
+    panel_ev_totals,
+    reconcile,
+    reconcile_batched,
+    record_step,
+    rows_used,
+    timeline_block,
+)

@@ -1,0 +1,442 @@
+"""Device-side per-round telemetry panel + sampled flight recorder.
+
+Design (docs/DESIGN.md §11): a state built with a
+:class:`TelemetryConfig` carries a pre-allocated ``[rows, N_METRICS]``
+f32 panel (``SimState.telem``); every engine step's LAST operation
+writes one row — EV-counter deltas, delivery ratio, mesh-degree
+min/mean/max, score quantiles, link-down occupancy — as plain device
+ops inside the same compiled program (scan-output style: no host
+transfer in the run window, no extra compile, donation preserved).
+The phase engine writes one row per PHASE (``rounds_per_row = r``,
+the same cadence caveat the drain and chaos metrics document); rows
+past the panel capacity drop silently (size ``rows`` to the run).
+
+Exactness contract: the EV columns are *deltas* of the int32 event
+counters cast to f32 — exact while a single observation's delta stays
+below 2**24 events (every gate/test shape is orders of magnitude
+under it), so the host reconciliation (:func:`reconcile`) can demand
+summed deltas == drained counters BIT-FOR-BIT, per sim. That equality
+is the telemetry plane's correctness anchor — a panel that drifts
+from the counters is lying about the run.
+
+The lint side: ``EV_METRICS`` below is a LITERAL catalog (one column
+per trace/events.py EV member, same order). analysis/simlint.py's
+``ev-drain`` rule cross-checks it against the EV enum and against
+``RECONCILED`` — adding an event counter without a timeline column,
+or a recorded EV column that the reconciliation ignores, fails lint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops import bitset
+from ..trace.events import EV, N_EVENTS
+
+#: per-event delta columns — one per trace/events.py EV member, in
+#: enum order (literal on purpose: the ev-drain lint rule pins this
+#: catalog against the enum so neither can drift silently)
+EV_METRICS = (
+    "ev_publish_message",
+    "ev_reject_message",
+    "ev_duplicate_message",
+    "ev_deliver_message",
+    "ev_add_peer",
+    "ev_remove_peer",
+    "ev_recv_rpc",
+    "ev_send_rpc",
+    "ev_drop_rpc",
+    "ev_join",
+    "ev_leave",
+    "ev_graft",
+    "ev_prune",
+    "ev_link_down",
+    "ev_iwant_recover",
+)
+
+#: EV columns whose summed deltas must equal the end-of-run drained
+#: counters bit-for-bit (reconcile()); every recorded EV column is
+#: reconciled — the ev-drain lint rule rejects a catalog that records
+#: an EV metric without reconciling it
+RECONCILED = EV_METRICS
+
+#: instantaneous state readings (end-of-observation values, not
+#: deltas). Engines without a mesh/score plane (floodsub, randomsub)
+#: record zeros in the mesh/score columns — the catalog is fixed so
+#: panels from different engines stack into one [S, T, M] band. The
+#: score_p* columns are quantiles ACROSS PEERS of the per-peer mean
+#: held neighbor score (see _score_quantiles).
+STATE_METRICS = (
+    "mesh_deg_min",
+    "mesh_deg_mean",
+    "mesh_deg_max",
+    "score_p5",
+    "score_p50",
+    "score_p95",
+    "links_down_frac",
+)
+
+METRICS = ("delivery_ratio",) + EV_METRICS + STATE_METRICS
+N_METRICS = len(METRICS)
+_EV_COL0 = METRICS.index(EV_METRICS[0])
+
+#: flight-recorder per-peer leaves (K tracked peers, every observation)
+FLIGHT_METRICS = (
+    "mesh_degree",      # directed mesh edges this peer holds (all slots)
+    "score_mean",       # mean score it holds of its live neighbors
+    "score_min",        # worst neighbor score
+    "backoff_active",   # neighbor/slot pairs under active prune backoff
+    "msgs_held",        # seen-cache population (popcount of have)
+)
+N_FLIGHT = len(FLIGHT_METRICS)
+
+
+def metric_index(name: str) -> int:
+    """Column index of a panel metric by catalog name."""
+    return METRICS.index(name)
+
+
+class TelemetryConfigError(ValueError):
+    """Raised by TelemetryConfig.validate() on invalid parameters."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Static (build-time) telemetry configuration — rides the jit
+    static args like ChaosConfig, so None/off builds trace exactly the
+    pre-telemetry program (elision contract pinned by
+    tests/test_telemetry.py and the chaos-off kernel census).
+
+    ``rows`` is the panel capacity in OBSERVATIONS (per-round engines:
+    one per round; the phase engine: one per phase). Observations past
+    the capacity are dropped on device (no wrap — a wrapped panel
+    would silently break the reconciliation sums); size it to the run.
+    ``tracked`` is the flight recorder's static peer-index tuple
+    (empty = no flight plane, no extra state leaf).
+    """
+
+    rows: int
+    tracked: tuple = ()
+
+    def validate(self) -> None:
+        if self.rows < 1:
+            raise TelemetryConfigError(f"rows must be >= 1, got {self.rows}")
+        if not isinstance(self.tracked, tuple):
+            raise TelemetryConfigError(
+                f"tracked must be a (hashable) tuple of peer indices, "
+                f"got {type(self.tracked).__name__}"
+            )
+        if any(int(t) < 0 for t in self.tracked):
+            raise TelemetryConfigError(
+                f"tracked peer indices must be >= 0, got {self.tracked}"
+            )
+
+    @property
+    def n_tracked(self) -> int:
+        return len(self.tracked)
+
+
+@struct.dataclass
+class TelemetryState:
+    """Device telemetry carry: the time-series panel and (optionally)
+    the flight recorder. Present in a state tree ONLY when built with
+    a TelemetryConfig — like ChaosState/wire_block, presence changes
+    the pytree leaf count, so checkpoint templates must be built with
+    the same telemetry setting (v6 is pytree-generic: no format bump)."""
+
+    panel: jax.Array              # [rows, N_METRICS] f32
+    flight: jax.Array | None = None  # [rows, n_tracked, N_FLIGHT] f32
+
+    @classmethod
+    def empty(cls, cfg: TelemetryConfig) -> "TelemetryState":
+        cfg.validate()
+        return cls(
+            panel=jnp.zeros((cfg.rows, N_METRICS), jnp.float32),
+            flight=(
+                jnp.zeros((cfg.rows, len(cfg.tracked), N_FLIGHT),
+                          jnp.float32)
+                if cfg.tracked else None
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# device-side metric computation
+
+
+def _delivery_ratio(net, msgs, dlv) -> jax.Array:
+    """Cumulative delivery ratio over expected (subscriber, live
+    message) pairs — the device form of chaos.metrics.delivery_stats
+    (same exclusions: only live slots count, the origin has its own
+    copy), shared semantics with ensemble.stats's batched reduction
+    (pinned by tests/test_telemetry.py). Counted per MESSAGE — the
+    expected-receiver total is subscriber-count minus origin, so only
+    one [N, M] mask materializes (this runs every round inside the hot
+    step; make telemetry-smoke ceilings the recorder's overhead)."""
+    birth = msgs.birth.astype(jnp.int32)
+    live = birth >= 0
+    n = net.subscribed.shape[0]
+    topic = jnp.clip(msgs.topic, 0)
+    origin = jnp.clip(msgs.origin, 0, n - 1)
+    sub_t = net.subscribed[:, topic]                     # [N, M]
+    orig_sub = jnp.take_along_axis(sub_t, origin[None, :], axis=0)[0]
+    nsub = jnp.sum(net.subscribed.astype(jnp.int32), axis=0)
+    exp_m = jnp.where(live, nsub[topic] - orig_sub.astype(jnp.int32), 0)
+    got_all = jnp.sum(
+        ((dlv.first_round >= 0) & sub_t & live[None, :]).astype(jnp.int32),
+        axis=0,
+    )
+    fr_o = jnp.take_along_axis(dlv.first_round, origin[None, :], axis=0)[0]
+    got_m = got_all - ((fr_o >= 0) & orig_sub & live).astype(jnp.int32)
+    n_exp = jnp.sum(exp_m)
+    ratio = (jnp.sum(got_m).astype(jnp.float32)
+             / jnp.maximum(n_exp, 1).astype(jnp.float32))
+    return jnp.where(n_exp > 0, ratio, jnp.float32(1.0))
+
+
+def _mesh_stats(mesh, my_topics):
+    """(min, mean, max) f32 of per-(peer, live topic slot) mesh degree."""
+    deg = jnp.sum(mesh.astype(jnp.int32), axis=-1)       # [N, S]
+    valid = my_topics >= 0                               # [N, S]
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    degf = deg.astype(jnp.float32)
+    big = jnp.float32(3.4e38)
+    mmin = jnp.min(jnp.where(valid, degf, big))
+    mmax = jnp.max(jnp.where(valid, degf, -big))
+    mmean = (jnp.sum(jnp.where(valid, degf, 0.0))
+             / jnp.maximum(n_valid, 1).astype(jnp.float32))
+    ok = n_valid > 0
+    zero = jnp.float32(0.0)
+    return (jnp.where(ok, mmin, zero), jnp.where(ok, mmean, zero),
+            jnp.where(ok, mmax, zero))
+
+
+def _score_quantiles(scores, edge_ok):
+    """(p5, p50, p95) f32 across peers of each peer's MEAN held
+    neighbor score over its live edges (the same per-peer statistic the
+    flight recorder tracks as ``score_mean``). Peers with no live edge
+    are EXCLUDED (pushed past the live prefix of one sort), not
+    zero-filled; linear interpolation between order statistics, the
+    numpy default. Per-peer means rather than the raw [N, K] edge plane
+    keep the sort 16x smaller — this runs every round inside the hot
+    step, and `make telemetry-smoke` ceilings the recorder's overhead.
+    Hand-rolled instead of jnp.nanquantile so the whole computation
+    stays strict-dtype-clean (the analyze gate traces every telemetry
+    build under numpy_dtype_promotion('strict'))."""
+    sc = scores.astype(jnp.float32)
+    cnt = jnp.sum(edge_ok.astype(jnp.float32), axis=-1)           # [N]
+    mean = (jnp.sum(jnp.where(edge_ok, sc, 0.0), axis=-1)
+            / jnp.maximum(cnt, 1.0))
+    has = cnt > 0.0
+    order = jnp.sort(jnp.where(has, mean, jnp.float32(jnp.inf)))
+    n = jnp.sum(has.astype(jnp.int32))
+    last = jnp.int32(order.shape[0] - 1)
+
+    def q(p):
+        pos = jnp.maximum(n - 1, 0).astype(jnp.float32) * jnp.float32(p)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, jnp.maximum(n - 1, 0))
+        frac = pos - lo.astype(jnp.float32)
+        vlo = order[jnp.clip(lo, 0, last)]
+        vhi = order[jnp.clip(hi, 0, last)]
+        return vlo * (jnp.float32(1.0) - frac) + vhi * frac
+
+    any_edge = n > 0
+    zero = jnp.float32(0.0)
+    return tuple(
+        jnp.where(any_edge, q(p), zero) for p in (0.05, 0.5, 0.95)
+    )
+
+
+def _flight_row(cfg: TelemetryConfig, net, dlv, mesh, scores, edge_ok,
+                backoff_active) -> jax.Array:
+    """[n_tracked, N_FLIGHT] f32 snapshot of the tracked peers."""
+    idx = np.asarray(cfg.tracked, np.int32)  # static gather indices
+    zerok = jnp.zeros((len(idx),), jnp.float32)
+    if mesh is not None:
+        mesh_deg = jnp.sum(
+            mesh[idx].astype(jnp.float32), axis=(-2, -1)
+        )
+    else:
+        mesh_deg = zerok
+    if scores is not None:
+        sc = scores[idx].astype(jnp.float32)             # [Kt, K]
+        ok = edge_ok[idx]
+        cnt = jnp.sum(ok.astype(jnp.float32), axis=-1)
+        s_mean = jnp.sum(jnp.where(ok, sc, 0.0), axis=-1) / jnp.maximum(cnt, 1.0)
+        s_min = jnp.min(jnp.where(ok, sc, jnp.float32(3.4e38)), axis=-1)
+        has = cnt > 0
+        s_mean = jnp.where(has, s_mean, 0.0)
+        s_min = jnp.where(has, s_min, 0.0)
+    else:
+        s_mean = s_min = zerok
+    if backoff_active is not None:
+        bo = jnp.sum(
+            backoff_active[idx].astype(jnp.float32), axis=(-2, -1)
+        )
+    else:
+        bo = zerok
+    # popcount(axis=-1) already sums the word axis: [Kt, W] -> [Kt]
+    held = bitset.popcount(dlv.have[idx], axis=-1).astype(jnp.float32)
+    return jnp.stack([mesh_deg, s_mean, s_min, bo, held], axis=-1)
+
+
+def record_step(
+    cfg: TelemetryConfig,
+    telem: TelemetryState,
+    tick0,                    # i32: the observation's FIRST executed round
+    ev_prev,                  # [N_EVENTS] i32 counters at step entry
+    ev_next,                  # [N_EVENTS] i32 counters at step exit
+    net,                      # Net (live view is fine; subscribed/nbr_ok)
+    msgs,
+    dlv,
+    *,
+    rounds_per_row: int = 1,  # static: rounds per observation (phase r)
+    mesh=None,                # [N,S,K] bool | None (mesh-less engines)
+    my_topics=None,           # [N,S] i32 (required with mesh)
+    scores=None,              # [N,K] f32 | None
+    backoff_active=None,      # [N,S,K] bool | None (flight recorder)
+) -> TelemetryState:
+    """Compute + write one panel row (and flight row). Pure device ops
+    — called as the LAST operation of a step closure so the EV deltas
+    cover everything the step accumulated (delivery, control, churn,
+    heartbeat). ``row = tick0 // rounds_per_row``; rows beyond the
+    panel capacity drop (mode="drop")."""
+    row = (jnp.asarray(tick0, jnp.int32)
+           // jnp.int32(max(int(rounds_per_row), 1)))
+    delta = (jnp.asarray(ev_next, jnp.int32)
+             - jnp.asarray(ev_prev, jnp.int32)).astype(jnp.float32)
+
+    dr = _delivery_ratio(net, msgs, dlv)
+    edge_ok = net.nbr_ok
+    if mesh is not None:
+        mmin, mmean, mmax = _mesh_stats(mesh, my_topics)
+    else:
+        mmin = mmean = mmax = jnp.float32(0.0)
+    if scores is not None:
+        p5, p50, p95 = _score_quantiles(scores, edge_ok)
+    else:
+        p5 = p50 = p95 = jnp.float32(0.0)
+    # link-down occupancy: this observation's LINK_DOWN delta over the
+    # total undirected live links × rounds it covers (0 when chaos off
+    # — the counter never moves)
+    links_total = jnp.sum(
+        (edge_ok & (net.nbr >= 0)).astype(jnp.int32)
+    ).astype(jnp.float32) / 2.0
+    ldf = delta[EV.LINK_DOWN] / jnp.maximum(
+        links_total * jnp.float32(max(int(rounds_per_row), 1)), 1.0
+    )
+
+    row_vec = jnp.concatenate([
+        dr[None],
+        delta,
+        jnp.stack([mmin, mmean, mmax, p5, p50, p95, ldf]),
+    ])
+    panel = telem.panel.at[row].set(row_vec, mode="drop")
+    flight = telem.flight
+    if flight is not None:
+        fl = _flight_row(cfg, net, dlv, mesh, scores, edge_ok,
+                         backoff_active)
+        flight = flight.at[row].set(fl, mode="drop")
+    return telem.replace(panel=panel, flight=flight)
+
+
+# ---------------------------------------------------------------------------
+# host-side reconciliation + readers
+
+
+def panel_ev_totals(panel) -> np.ndarray:
+    """[N_EVENTS] int64 summed per-observation EV deltas of one sim's
+    panel (f64 accumulation of exact-int f32 deltas — exact while each
+    delta < 2**24 and totals < 2**53, the documented envelope)."""
+    p = np.asarray(panel, np.float64)
+    if p.ndim != 2 or p.shape[1] != N_METRICS:
+        raise ValueError(
+            f"expected a [rows, {N_METRICS}] panel, got shape {p.shape}"
+        )
+    cols = p[:, _EV_COL0:_EV_COL0 + len(EV_METRICS)]
+    return cols.sum(axis=0).astype(np.int64)
+
+
+def reconcile(panel, events) -> list:
+    """Drain-vs-timeline reconciliation for ONE sim: summed per-row EV
+    deltas must equal the end-of-run drained counters exactly. Returns
+    mismatch strings (empty = reconciled). This is the telemetry
+    plane's correctness anchor — ``make telemetry-smoke`` and
+    tests/test_telemetry.py gate on it for every engine."""
+    totals = panel_ev_totals(panel)
+    ev = np.asarray(events, np.int64)
+    out = []
+    for e in EV:
+        if int(totals[e]) != int(ev[e]):
+            out.append(
+                f"{EV_METRICS[e]}: timeline total {int(totals[e])} != "
+                f"drained counter {int(ev[e])} ({e.name})"
+            )
+    return out
+
+
+def reconcile_batched(panels, events) -> list:
+    """reconcile() per sim over batched ``[S, rows, N_METRICS]`` panels
+    and ``[S, N_EVENTS]`` counters; mismatches are prefixed with the
+    sim index."""
+    p = np.asarray(panels)
+    ev = np.asarray(events)
+    out = []
+    for i in range(p.shape[0]):
+        out += [f"sim {i}: {m}" for m in reconcile(p[i], ev[i])]
+    return out
+
+
+def rows_used(panel, rounds: int, rounds_per_row: int = 1) -> int:
+    """Observations a ``rounds``-round run wrote (capped at capacity)."""
+    cap = int(np.asarray(panel).shape[-2])
+    return min(cap, int(rounds) // max(int(rounds_per_row), 1))
+
+
+def timeline_block(panels, rounds_per_row: int = 1, rows: int | None = None,
+                   qs=(0.25, 0.5, 0.75), ndigits: int = 5) -> dict:
+    """The schema-v3 ``timeline`` artifact block from a run's panel(s).
+
+    ``panels`` is one sim's ``[T, N_METRICS]`` panel or a batched
+    ``[S, T, N_METRICS]`` stack; the block carries, per catalog metric,
+    the per-observation ``qs`` quantile bands across sims (S=1 bands
+    degenerate to the single trajectory — same shape either way, so
+    readers and the run report never branch on S). ``rows`` truncates
+    to the observations a run actually wrote (:func:`rows_used`);
+    values are rounded to ``ndigits`` to keep committed artifacts
+    reviewable. Legacy artifacts without the block read back
+    ``perf.artifacts.TELEMETRY_OFF``."""
+    p = np.asarray(panels, np.float64)
+    if p.ndim == 2:
+        p = p[None]
+    if p.ndim != 3 or p.shape[-1] != N_METRICS:
+        raise ValueError(
+            f"expected [T, {N_METRICS}] or [S, T, {N_METRICS}] panels, "
+            f"got shape {p.shape}"
+        )
+    if rows is not None:
+        p = p[:, : int(rows), :]
+    bands = np.quantile(p, np.asarray(qs, np.float64), axis=0)  # [Q, T, M]
+    series = {
+        name: {
+            f"q{int(round(q * 100))}": [
+                round(float(v), ndigits) for v in bands[qi, :, mi]
+            ]
+            for qi, q in enumerate(qs)
+        }
+        for mi, name in enumerate(METRICS)
+    }
+    return {
+        "enabled": True,
+        "rounds_per_row": int(rounds_per_row),
+        "rows": int(p.shape[1]),
+        "n_sims": int(p.shape[0]),
+        "metrics": list(METRICS),
+        "series": series,
+    }

@@ -105,14 +105,21 @@ def _publish_schedule(rng, n, rounds, pub_rounds, width=4):
 
 
 def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
-             rounds_per_phase=1, seeds=SMOKE_SEEDS, full=True):
+             rounds_per_phase=1, seeds=SMOKE_SEEDS, full=True,
+             telemetry=False):
     """One flap cell over ``seeds`` sims (one vmapped program per
     router): per-sim gossipsub/floodsub delivery ratios and IWANT
     shares plus their median/IQR bands. Same topology / schedule for
     every sim and both routers; per-sim fault + sampler streams derive
     from ``fold_in(sim_key, i)``, shared across the two routers (the
     chaos hash keys on the canonical link id and the sim key, which
-    both runs share per sim)."""
+    both runs share per sim).
+
+    ``telemetry=True`` builds the gossipsub cell TELEMETRY-ON (one
+    panel row per round/phase; telemetry/panel.py), reconciles the
+    batched panels against the drained counters per sim, and returns
+    the raw ``[S, T, n_metrics]`` panels plus a latency-CDF envelope
+    for the ``--timeline`` artifact."""
     from go_libp2p_pubsub_tpu import ensemble, graph
     from go_libp2p_pubsub_tpu.chaos import ChaosConfig
     from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
@@ -141,12 +148,19 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
         chaos=cc,
     )
     r = int(rounds_per_phase)
+    tcfg = None
+    if telemetry:
+        from go_libp2p_pubsub_tpu.telemetry import TelemetryConfig
 
-    def run_gossipsub(g_cfg):
-        gs0 = GossipSubState.init(net, 64, g_cfg, score_params=sp, seed=seed)
+        tcfg = TelemetryConfig(rows=rounds // r)
+
+    def run_gossipsub(g_cfg, tele=None):
+        gs0 = GossipSubState.init(net, 64, g_cfg, score_params=sp, seed=seed,
+                                  telemetry=tele)
         gstates = ensemble.batch_states(gs0, s)
         if r > 1:
-            step = make_gossipsub_phase_step(g_cfg, net, r, score_params=sp)
+            step = make_gossipsub_phase_step(g_cfg, net, r, score_params=sp,
+                                             telemetry=tele)
             ens = ensemble.lift_step(step)
             assert rounds % r == 0
 
@@ -158,7 +172,8 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
             return ensemble.run_rounds(ens, gstates, phase_args, rounds // r,
                                        rounds_per_phase=r,
                                        heartbeat_fn=lambda p: True)
-        step = make_gossipsub_step(g_cfg, net, score_params=sp)
+        step = make_gossipsub_step(g_cfg, net, score_params=sp,
+                                   telemetry=tele)
         ens = ensemble.lift_step(step)
 
         def round_args(i):
@@ -173,7 +188,7 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
             core.msgs.topic, core.msgs.origin, net.subscribed,
         ))
 
-    grun = run_gossipsub(cfg)
+    grun = run_gossipsub(cfg, tele=tcfg)
     g_ratios = ratios_of(grun.states.core)
     iwant_shares = estats.batched_iwant_shares(grun.states.core.events)
     out = {
@@ -188,6 +203,28 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
         "rounds_per_phase": r,
         "seeds": s,
     }
+    if telemetry:
+        from go_libp2p_pubsub_tpu.telemetry import reconcile_batched
+
+        core = grun.states.core
+        mism = reconcile_batched(np.asarray(core.telem.panel),
+                                 np.asarray(core.events))
+        if mism:  # the correctness anchor — a lying panel is a hard stop
+            raise AssertionError(
+                "drain-vs-timeline reconciliation failed: " + "; ".join(mism)
+            )
+        counts = estats.latency_cdf_counts(
+            core.dlv.first_round, core.msgs.birth, core.msgs.topic,
+            core.msgs.origin, net.subscribed, max_lat=20,
+        )
+        bands = estats.cdf_bands(counts, qs=(0.1, 0.9))
+        out["panels"] = np.asarray(core.telem.panel)
+        out["latency_cdf"] = {
+            "lat": list(range(counts.shape[1])),
+            "pooled": [round(float(v), 4) for v in bands["pooled"]],
+            "q10": [round(float(v), 4) for v in bands["bands"][0]],
+            "q90": [round(float(v), 4) for v in bands["bands"][1]],
+        }
     if not full:
         return out
 
@@ -227,7 +264,7 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
 
 def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
                   window=PARTITION_ROUNDS, tail=PARTITION_TAIL,
-                  seeds=SMOKE_SEEDS):
+                  seeds=SMOKE_SEEDS, telemetry=False):
     """Partition/heal cell over ``seeds`` sims (one vmapped program):
     scheduled 2-group split with P3 deficit scoring live (cross-group
     mesh edges starve -> pruned during the window; short prune backoff
@@ -293,8 +330,14 @@ def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
     cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
                                 score_enabled=True, chaos=cc)
     s = int(seeds)
-    st0 = GossipSubState.init(net, 64, cfg, score_params=sp, seed=seed)
-    step = make_gossipsub_step(cfg, net, score_params=sp)
+    tcfg = None
+    if telemetry:
+        from go_libp2p_pubsub_tpu.telemetry import TelemetryConfig
+
+        tcfg = TelemetryConfig(rows=rounds)
+    st0 = GossipSubState.init(net, 64, cfg, score_params=sp, seed=seed,
+                              telemetry=tcfg)
+    step = make_gossipsub_step(cfg, net, score_params=sp, telemetry=tcfg)
     ens = ensemble.lift_step(step)
     from go_libp2p_pubsub_tpu.ensemble import stats as estats
 
@@ -361,7 +404,7 @@ def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
         )) is not None else np.nan
         for i in range(s)
     ], np.float64)
-    return {
+    out = {
         "cross_mesh_pre_partition": (
             None if pre is None else [int(x) for x in pre]
         ),
@@ -377,9 +420,31 @@ def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
         "chaos": cc,
         "n": n,
         "rounds": rounds,
+        "start": start,
         "heal": heal,
         "seeds": s,
     }
+    if telemetry:
+        from go_libp2p_pubsub_tpu.telemetry import reconcile_batched
+
+        mism = reconcile_batched(np.asarray(st.core.telem.panel),
+                                 np.asarray(st.core.events))
+        if mism:
+            raise AssertionError(
+                "drain-vs-timeline reconciliation failed: " + "; ".join(mism)
+            )
+        out["panels"] = np.asarray(st.core.telem.panel)
+        # the repair-arc series the run report plots — the SAME rows
+        # mesh_reform_latency consumed above, so plot and metric agree
+        cs = np.asarray([c for _, c in mesh_series], np.float64)  # [T, S]
+        qs = np.quantile(cs, [0.25, 0.5, 0.75], axis=1)
+        out["cross_mesh_series"] = {
+            "ticks": [int(t) for t, _ in mesh_series],
+            "q25": [round(float(v), 2) for v in qs[0]],
+            "q50": [round(float(v), 2) for v in qs[1]],
+            "q75": [round(float(v), 2) for v in qs[2]],
+        }
+    return out
 
 
 def check_census() -> dict:
@@ -443,10 +508,78 @@ def _band_extras(band: dict, per_sim, ci=None) -> dict:
     return out
 
 
+def run_timeline(prefix: str, n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS,
+                 seed=0, seeds=SMOKE_SEEDS) -> tuple:
+    """The ``--timeline`` mode: both canonical cells TELEMETRY-ON, the
+    per-round panels reduced to schema-v3 timeline bands, written as
+    ``<prefix>.json`` (one artifact line per cell) and rendered as the
+    self-contained ``<prefix>.html`` dashboard (scripts/run_report.py).
+    The batched panels are reconciled against the drained counters per
+    sim before anything is written — a lying timeline never ships."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        BenchRecord,
+        chaos_fingerprint,
+        ensemble_fingerprint,
+    )
+    from go_libp2p_pubsub_tpu.telemetry import timeline_block
+
+    import run_report as run_report_mod
+
+    flap = run_flap(n=n, loss=loss, rounds=rounds, seed=seed, seeds=seeds,
+                    full=False, telemetry=True)
+    part = run_partition(n=n, seed=seed + 1, seeds=seeds, telemetry=True)
+    lines = [
+        BenchRecord(
+            metric="chaos_flap_delivery_ratio_gossipsub",
+            value=float(flap["gossipsub_band"]["q50"]), unit="ratio",
+            vs_baseline=0.0, schema=3,
+            fingerprint={"chaos": chaos_fingerprint(flap["chaos"]),
+                         "ensemble": ensemble_fingerprint(flap["seeds"])},
+            extras={
+                "n_peers": flap["n"], "rounds": flap["rounds"],
+                "iqr": [flap["gossipsub_band"].get("q25"),
+                        flap["gossipsub_band"].get("q75")],
+                "iwant_recovery_share_median":
+                    round(float(flap["iwant_band"]["q50"]), 4),
+                "iwant_recovery_share_iqr": [
+                    round(float(flap["iwant_band"]["q25"]), 4),
+                    round(float(flap["iwant_band"]["q75"]), 4)],
+                "latency_cdf": flap["latency_cdf"],
+            },
+            timeline_raw=timeline_block(flap["panels"]),
+        ),
+        BenchRecord(
+            metric="chaos_partition_delivery_ratio",
+            value=float(part["ratio_band"]["q50"]), unit="ratio",
+            vs_baseline=0.0, schema=3,
+            fingerprint={"chaos": chaos_fingerprint(part["chaos"],
+                                                    part["scenario"]),
+                         "ensemble": ensemble_fingerprint(part["seeds"])},
+            extras={
+                "n_peers": part["n"], "rounds": part["rounds"],
+                "iqr": [part["ratio_band"].get("q25"),
+                        part["ratio_band"].get("q75")],
+                "partition_window": [part["start"], part["heal"]],
+                "mesh_reform_latency_median": part["repair_band"].get("q50"),
+                "mesh_reform_latency_iqr": [part["repair_band"].get("q25"),
+                                            part["repair_band"].get("q75")],
+                "time_to_recover_median": part["ttr_band"].get("q50"),
+                "cross_mesh_series": part["cross_mesh_series"],
+            },
+            timeline_raw=timeline_block(part["panels"]),
+        ),
+    ]
+    return run_report_mod.write_report(prefix, lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="assert the acceptance invariants; exit 1 on failure")
+    ap.add_argument("--timeline", metavar="PREFIX",
+                    help="run both cells telemetry-on and write the "
+                         "PREFIX.json timeline artifact + the PREFIX.html "
+                         "dashboard (scripts/run_report.py), then exit")
     ap.add_argument("--n", type=int, default=SMOKE_N)
     ap.add_argument("--loss", type=float, default=FLAP_LOSS)
     ap.add_argument("--rounds", type=int, default=FLAP_ROUNDS)
@@ -473,6 +606,15 @@ def main(argv=None) -> int:
     enable_persistent_cache(os.path.join(repo_root(), ".jax_cache"))
 
     from go_libp2p_pubsub_tpu.ensemble import stats as estats
+
+    if args.timeline:
+        json_path, html_path = run_timeline(
+            args.timeline, n=args.n, loss=args.loss, rounds=args.rounds,
+            seed=args.seed, seeds=args.seeds,
+        )
+        print(json.dumps({"timeline_artifact": json_path,
+                          "report": html_path}))
+        return 0
 
     failures = []
 

@@ -309,10 +309,55 @@ def write_baseline(root: str, res: dict) -> str:
     return path
 
 
+def run_timeline(prefix: str, s: int, n: int, loss: float, seed: int) -> tuple:
+    """The ``--timeline`` mode: the S-sim flap cell TELEMETRY-ON (one
+    vmapped program, one panel row per round per sim), panels reconciled
+    against the drained counters per sim, reduced to schema-v3 timeline
+    bands, written as ``<prefix>.json`` and rendered as the
+    self-contained ``<prefix>.html`` dashboard (scripts/run_report.py)."""
+    from chaos_report import run_flap
+
+    import run_report as run_report_mod
+
+    from go_libp2p_pubsub_tpu.ensemble import stats as estats
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        BenchRecord,
+        chaos_fingerprint,
+        ensemble_fingerprint,
+    )
+    from go_libp2p_pubsub_tpu.telemetry import timeline_block
+
+    flap = run_flap(n=n, loss=loss, seed=seed, seeds=s, full=False,
+                    telemetry=True)
+    band = estats.quantile_band(np.asarray(flap["gossipsub_ratios"]))
+    rec = BenchRecord(
+        metric="ensemble_flap_delivery_ratio",
+        value=round(float(band["q50"]), 4),
+        unit="ratio",
+        vs_baseline=0.0,
+        schema=3,
+        fingerprint={"chaos": chaos_fingerprint(flap["chaos"]),
+                     "ensemble": ensemble_fingerprint(flap["seeds"])},
+        extras={
+            "n_peers": flap["n"], "rounds": flap["rounds"],
+            "iqr": [round(float(band["q25"]), 4),
+                    round(float(band["q75"]), 4)],
+            "latency_cdf": flap["latency_cdf"],
+        },
+        timeline_raw=timeline_block(flap["panels"]),
+    )
+    return run_report_mod.write_report(prefix, [rec])
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="exit non-zero on any gate failure")
+    ap.add_argument("--timeline", metavar="PREFIX",
+                    help="run the S-sim flap cell telemetry-on and write "
+                         "the PREFIX.json timeline artifact + the "
+                         "PREFIX.html dashboard (scripts/run_report.py), "
+                         "then exit")
     ap.add_argument("--sims", type=int,
                     default=int(os.environ.get("ENSEMBLE_SMOKE_S",
                                                ENSEMBLE_SMOKE_S)))
@@ -335,6 +380,14 @@ def main(argv=None) -> int:
     from go_libp2p_pubsub_tpu.perf.regress import repo_root
 
     enable_persistent_cache(os.path.join(repo_root(), ".jax_cache"))
+
+    if args.timeline:
+        json_path, html_path = run_timeline(
+            args.timeline, args.sims, args.n, args.loss, args.seed,
+        )
+        print(json.dumps({"timeline_artifact": json_path,
+                          "report": html_path}))
+        return 0
 
     res = run_gate(args.sims, args.n, args.loss, args.seed)
     failures = list(res["failures"])

@@ -1,0 +1,539 @@
+#!/usr/bin/env python
+"""run_report — render a timeline dashboard from any schema-v3 artifact.
+
+The telemetry plane (docs/DESIGN.md §11) gives every run a per-round
+``[T, n_metrics]`` panel; chaos_report/ensemble_report ``--timeline``
+embed its median/IQR bands as the artifact's ``timeline`` block. This
+script turns any such artifact into a SELF-CONTAINED dashboard — no
+external assets, one HTML file (or ``--md`` markdown) — with:
+
+  * per-round band plots (median line + IQR wash) for delivery ratio,
+    mesh degree, score quantiles, recovery events and link-down
+    occupancy;
+  * the delivery-latency CDF envelope when the artifact carries one
+    (``extras["latency_cdf"]``);
+  * the partition→heal mesh-repair arc (``extras["cross_mesh_series"]``
+    — the same series chaos.metrics.mesh_reform_latency is computed
+    from, so the plot and the reported latency can never disagree);
+  * a stat-tile row of the artifact's headline numbers, and a table
+    view per chart (values are never tooltip-gated).
+
+Legacy (pre-v3) artifacts read back TELEMETRY_OFF and render a stub
+section saying so. ``--tracestat FILE`` additionally embeds a
+``tracestat --json`` summary (counters + caveat flags) as a section.
+
+Usage:
+  python scripts/run_report.py ARTIFACT.json [--out report.html] [--md]
+                               [--tracestat ts.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import html as _html
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# chart chrome — the dataviz reference palette (first three categorical
+# slots; documented all-pairs-safe in both modes), surfaces and ink as CSS
+# custom properties so light/dark swap in one place
+
+_CSS = """
+.viz-root { color-scheme: light;
+  --surface-1:#fcfcfb; --page:#f9f9f7;
+  --ink-1:#0b0b0b; --ink-2:#52514e; --ink-3:#898781;
+  --grid:#e1e0d9; --axis:#c3c2b7; --border:rgba(11,11,11,0.10);
+  --series-1:#2a78d6; --series-2:#eb6834; --series-3:#1baf7a;
+  font-family: system-ui, -apple-system, "Segoe UI", sans-serif;
+  background: var(--page); color: var(--ink-1); margin:0; padding:24px; }
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root { color-scheme: dark;
+    --surface-1:#1a1a19; --page:#0d0d0d;
+    --ink-1:#ffffff; --ink-2:#c3c2b7; --ink-3:#898781;
+    --grid:#2c2c2a; --axis:#383835; --border:rgba(255,255,255,0.10);
+    --series-1:#3987e5; --series-2:#d95926; --series-3:#199e70; } }
+.viz-root h1 { font-size:20px; font-weight:600; margin:0 0 4px; }
+.viz-root h2 { font-size:15px; font-weight:600; margin:28px 0 10px; }
+.viz-root .sub { color:var(--ink-2); font-size:12.5px; margin:0 0 18px; }
+.viz-root .tiles { display:flex; flex-wrap:wrap; gap:12px; margin:14px 0 6px; }
+.viz-root .tile { background:var(--surface-1); border:1px solid var(--border);
+  border-radius:8px; padding:10px 14px; min-width:128px; }
+.viz-root .tile .lab { color:var(--ink-2); font-size:11.5px; }
+.viz-root .tile .val { font-size:24px; font-weight:600; margin-top:2px; }
+.viz-root .tile .d { font-size:11.5px; color:var(--ink-3); margin-top:2px; }
+.viz-root .grid2 { display:flex; flex-wrap:wrap; gap:16px; }
+.viz-root .card { background:var(--surface-1); border:1px solid var(--border);
+  border-radius:8px; padding:12px 14px 8px; position:relative; }
+.viz-root .card h3 { font-size:13px; font-weight:600; margin:0 0 2px; }
+.viz-root .card .note { color:var(--ink-3); font-size:11px; margin:0 0 6px; }
+.viz-root .legend { display:flex; gap:14px; font-size:11.5px;
+  color:var(--ink-2); margin:2px 0 4px; }
+.viz-root .legend .key { display:inline-block; width:14px; height:0;
+  border-top:2.5px solid; vertical-align:middle; margin-right:5px;
+  border-radius:2px; }
+.viz-root svg text { font-family:inherit; font-size:10.5px;
+  fill:var(--ink-3); font-variant-numeric: tabular-nums; }
+.viz-root svg .dl { fill:var(--ink-2); font-size:11px; }
+.viz-root details { margin:4px 0 8px; }
+.viz-root summary { color:var(--ink-2); font-size:11.5px; cursor:pointer; }
+.viz-root table { border-collapse:collapse; font-size:11px; margin-top:6px; }
+.viz-root td, .viz-root th { border:1px solid var(--grid); padding:2px 7px;
+  text-align:right; font-variant-numeric: tabular-nums; }
+.viz-root th { color:var(--ink-2); font-weight:600; }
+.viz-root .tip { position:fixed; pointer-events:none; display:none;
+  background:var(--surface-1); border:1px solid var(--border);
+  border-radius:6px; padding:6px 9px; font-size:11.5px; z-index:9;
+  box-shadow:0 2px 8px rgba(0,0,0,0.12); }
+.viz-root .tip .v { font-weight:600; color:var(--ink-1); }
+.viz-root .tip .k { display:inline-block; width:11px; height:0;
+  border-top:2.5px solid; vertical-align:middle; margin-right:5px; }
+.viz-root pre { background:var(--surface-1); border:1px solid var(--border);
+  border-radius:8px; padding:10px 12px; font-size:11.5px; overflow-x:auto; }
+"""
+
+# one shared hover layer: crosshair snapped to the nearest x, one tooltip
+# listing every series at that x (names inserted via textContent)
+_JS = """
+(function(){
+  var tip = document.createElement('div'); tip.className='tip';
+  document.body.appendChild(tip);
+  document.querySelectorAll('.viz-chart').forEach(function(card){
+    var data = JSON.parse(card.querySelector('script[type="application/json"]').textContent);
+    var svg = card.querySelector('svg'); if (!svg) return;
+    var hair = svg.querySelector('.hair');
+    svg.addEventListener('pointerleave', function(){
+      tip.style.display='none'; if (hair) hair.setAttribute('opacity','0');
+    });
+    svg.addEventListener('pointermove', function(ev){
+      var r = svg.getBoundingClientRect();
+      var fx = (ev.clientX - r.left) * (data.w / r.width);
+      var best = 0, bd = 1e18;
+      data.px.forEach(function(p, i){
+        var d = Math.abs(p - fx); if (d < bd) { bd = d; best = i; } });
+      if (hair) { hair.setAttribute('x1', data.px[best]);
+        hair.setAttribute('x2', data.px[best]);
+        hair.setAttribute('opacity','1'); }
+      while (tip.firstChild) tip.removeChild(tip.firstChild);
+      var head = document.createElement('div');
+      head.style.color = 'var(--ink-3)';
+      head.textContent = data.xlabel + ' ' + data.x[best];
+      tip.appendChild(head);
+      data.series.forEach(function(s){
+        var row = document.createElement('div');
+        var k = document.createElement('span'); k.className = 'k';
+        k.style.borderTopColor = s.color;
+        var v = document.createElement('span'); v.className = 'v';
+        var val = s.values[best];
+        v.textContent = (val === null || val === undefined)
+          ? '—' : (Math.round(val * 10000) / 10000);
+        var n = document.createElement('span');
+        n.textContent = ' ' + s.name; n.style.color = 'var(--ink-2)';
+        row.appendChild(k); row.appendChild(v); row.appendChild(n);
+        tip.appendChild(row);
+      });
+      tip.style.display = 'block';
+      var tx = ev.clientX + 14, ty = ev.clientY + 12;
+      tip.style.left = Math.min(tx, window.innerWidth - 170) + 'px';
+      tip.style.top = ty + 'px';
+    });
+  });
+})();
+"""
+
+W, H = 520, 200
+ML, MR, MT, MB = 46, 10, 8, 22
+
+
+def _ticks(lo: float, hi: float, n: int = 4) -> list:
+    if not math.isfinite(lo) or not math.isfinite(hi) or hi <= lo:
+        return [lo]
+    raw = (hi - lo) / n
+    mag = 10 ** math.floor(math.log10(raw))
+    step = min((m for m in (1, 2, 2.5, 5, 10)
+                if m * mag >= raw), default=10) * mag
+    t0 = math.ceil(lo / step) * step
+    out = []
+    t = t0
+    while t <= hi + 1e-12:
+        out.append(round(t, 10))
+        t += step
+    return out or [lo]
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e6:
+            return str(int(v))
+        return f"{v:.4g}"
+    return str(v)
+
+
+def svg_chart(title: str, x: list, series: list, xlabel: str = "round",
+              note: str = "", y0: float | None = None,
+              y1: float | None = None, spans: list | None = None,
+              vlines: list | None = None) -> str:
+    """One band/line chart card. ``series`` rows are dicts:
+    name, values, color (css var), optional band=(lo, hi) and
+    muted=True (context series — hairline gray, no legend emphasis)."""
+    vals = [v for s in series for v in (s["values"] or []) if v is not None]
+    for s in series:
+        for b in s.get("band") or ():
+            vals += [v for v in b if v is not None]
+    lo = min(vals) if vals else 0.0
+    hi = max(vals) if vals else 1.0
+    if y0 is not None:
+        lo = min(lo, y0)
+    if y1 is not None:
+        hi = max(hi, y1)
+    if hi <= lo:
+        hi = lo + 1.0
+    pad = (hi - lo) * 0.06
+    lo2, hi2 = (lo - pad if y0 is None else max(lo - pad, y0)), hi + pad
+    pw, ph = W - ML - MR, H - MT - MB
+    n = max(len(x), 2)
+    px = [ML + pw * i / (n - 1) for i in range(len(x))]
+
+    def sy(v):
+        return MT + ph * (1 - (v - lo2) / (hi2 - lo2))
+
+    g = []
+    # partition-window wash + heal line annotations (neutral, behind data)
+    for sp in spans or ():
+        xa = ML + pw * (x.index(sp[0]) / (n - 1)) if sp[0] in x else None
+        xb = ML + pw * (x.index(sp[1]) / (n - 1)) if sp[1] in x else None
+        if xa is None or xb is None:
+            # clamp to the x range positionally
+            xa = ML + pw * max(0.0, min(1.0, (sp[0] - x[0]) / max(x[-1] - x[0], 1)))
+            xb = ML + pw * max(0.0, min(1.0, (sp[1] - x[0]) / max(x[-1] - x[0], 1)))
+        g.append(f'<rect x="{xa:.1f}" y="{MT}" width="{max(xb - xa, 1):.1f}" '
+                 f'height="{ph}" fill="var(--grid)" opacity="0.45"/>')
+        if len(sp) > 2:
+            g.append(f'<text x="{(xa + xb) / 2:.1f}" y="{MT + 11}" '
+                     f'text-anchor="middle">{_html.escape(str(sp[2]))}</text>')
+    for vl in vlines or ():
+        xv = ML + pw * max(0.0, min(1.0, (vl[0] - x[0]) / max(x[-1] - x[0], 1)))
+        g.append(f'<line x1="{xv:.1f}" x2="{xv:.1f}" y1="{MT}" y2="{MT + ph}" '
+                 f'stroke="var(--axis)" stroke-width="1"/>')
+        if len(vl) > 1:
+            g.append(f'<text x="{xv + 4:.1f}" y="{MT + 11}">'
+                     f'{_html.escape(str(vl[1]))}</text>')
+    for t in _ticks(lo2, hi2):
+        yy = sy(t)
+        g.append(f'<line x1="{ML}" x2="{W - MR}" y1="{yy:.1f}" y2="{yy:.1f}" '
+                 f'stroke="var(--grid)" stroke-width="1"/>')
+        g.append(f'<text x="{ML - 6}" y="{yy + 3.5:.1f}" text-anchor="end">'
+                 f'{_fmt(float(t))}</text>')
+    g.append(f'<line x1="{ML}" x2="{W - MR}" y1="{MT + ph}" y2="{MT + ph}" '
+             f'stroke="var(--axis)" stroke-width="1"/>')
+    for i in range(0, len(x), max(1, (len(x) + 5) // 6)):
+        g.append(f'<text x="{px[i]:.1f}" y="{H - 7}" text-anchor="middle">'
+                 f'{x[i]}</text>')
+    # bands first (washes under every line)
+    for s in series:
+        b = s.get("band")
+        if b:
+            up = " ".join(f"{px[i]:.1f},{sy(v):.1f}" for i, v in enumerate(b[1]))
+            dn = " ".join(f"{px[i]:.1f},{sy(v):.1f}"
+                          for i, v in reversed(list(enumerate(b[0]))))
+            g.append(f'<polygon points="{up} {dn}" fill="{s["color"]}" '
+                     f'opacity="0.10"/>')
+    for s in series:
+        pts = " ".join(f"{px[i]:.1f},{sy(v):.1f}"
+                       for i, v in enumerate(s["values"]) if v is not None)
+        width = 1 if s.get("muted") else 2
+        color = "var(--axis)" if s.get("muted") else s["color"]
+        g.append(f'<polyline points="{pts}" fill="none" stroke="{color}" '
+                 f'stroke-width="{width}" stroke-linejoin="round" '
+                 f'stroke-linecap="round"/>')
+    # end marker + direct label on the first (emphasized) series only
+    main = series[0]
+    if main["values"]:
+        ex, ey = px[len(main["values"]) - 1], sy(main["values"][-1])
+        g.append(f'<circle cx="{ex:.1f}" cy="{ey:.1f}" r="4" '
+                 f'fill="{main["color"]}" stroke="var(--surface-1)" '
+                 f'stroke-width="2"/>')
+        g.append(f'<text x="{min(ex, W - MR - 2):.1f}" y="{ey - 7:.1f}" '
+                 f'text-anchor="end" class="dl">'
+                 f'{_fmt(main["values"][-1])}</text>')
+    g.append(f'<line class="hair" x1="0" x2="0" y1="{MT}" y2="{MT + ph}" '
+             f'stroke="var(--axis)" stroke-width="1" opacity="0"/>')
+
+    data = {"w": W, "x": x, "px": [round(p, 1) for p in px],
+            "xlabel": xlabel,
+            "series": [{"name": s["name"], "values": s["values"],
+                        "color": ("var(--axis)" if s.get("muted")
+                                  else s["color"])} for s in series]}
+    legend = ""
+    if len(series) > 1:
+        legend = '<div class="legend">' + "".join(
+            f'<span><span class="key" style="border-top-color:'
+            f'{"var(--axis)" if s.get("muted") else s["color"]}"></span>'
+            f'{_html.escape(s["name"])}</span>' for s in series) + "</div>"
+    # table view: the values are never tooltip-gated
+    head = "<tr><th>" + _html.escape(xlabel) + "</th>" + "".join(
+        f"<th>{_html.escape(s['name'])}</th>" for s in series) + "</tr>"
+    stride = max(1, len(x) // 24)
+    rows = "".join(
+        "<tr><td>" + str(x[i]) + "</td>" + "".join(
+            f"<td>{_fmt(s['values'][i] if i < len(s['values']) else None)}</td>"
+            for s in series) + "</tr>"
+        for i in range(0, len(x), stride))
+    payload = json.dumps(data).replace("<", "\\u003c")
+    return (
+        f'<div class="card viz-chart"><h3>{_html.escape(title)}</h3>'
+        + (f'<p class="note">{_html.escape(note)}</p>' if note else "")
+        + legend
+        + f'<svg viewBox="0 0 {W} {H}" width="{W}" height="{H}" '
+          f'role="img" aria-label="{_html.escape(title)}">{"".join(g)}</svg>'
+        + f'<details><summary>Table view</summary><table>{head}{rows}'
+          f'</table></details>'
+        + f'<script type="application/json">{payload}</script></div>'
+    )
+
+
+# ---------------------------------------------------------------------------
+# artifact -> chart specs
+
+
+def _tile(label: str, value, detail: str = "") -> str:
+    return (f'<div class="tile"><div class="lab">{_html.escape(label)}</div>'
+            f'<div class="val">{_fmt(value)}</div>'
+            + (f'<div class="d">{_html.escape(detail)}</div>' if detail else "")
+            + "</div>")
+
+
+def record_sections(rec) -> str:
+    tl = rec.timeline
+    ex = rec.extras or {}
+    chaos = rec.chaos
+    sub = (f'{rec.unit} · {rec.n_sims} sims · chaos generator '
+           f'{chaos["generator"]} loss {chaos["loss_rate"]}'
+           + (" · scheduled scenario" if chaos.get("scheduled") else ""))
+    out = [f"<h2>{_html.escape(rec.metric)}</h2>",
+           f'<p class="sub">{_html.escape(sub)}</p>']
+    tiles = [_tile(rec.metric.rsplit("_", 1)[-1] + " (median)", rec.value,
+                   f"IQR {ex.get('iqr')}" if ex.get("iqr") else "")]
+    if "iwant_recovery_share_median" in ex:
+        tiles.append(_tile("IWANT recovery share",
+                           ex["iwant_recovery_share_median"],
+                           f"IQR {ex.get('iwant_recovery_share_iqr')}"))
+    if "mesh_reform_latency_median" in ex:
+        tiles.append(_tile("mesh re-form latency",
+                           ex["mesh_reform_latency_median"],
+                           f"ticks after heal · IQR "
+                           f"{ex.get('mesh_reform_latency_iqr')}"))
+    if "time_to_recover_median" in ex:
+        tiles.append(_tile("time to recover", ex["time_to_recover_median"],
+                           f"ticks · IQR {ex.get('time_to_recover_iqr')}"))
+    tiles.append(_tile("sims", tl["n_sims"] or rec.n_sims,
+                       f"{tl['rows']} obs × {tl['rounds_per_row']} round(s)"
+                       if tl["enabled"] else "no timeline recorded"))
+    out.append('<div class="tiles">' + "".join(tiles) + "</div>")
+
+    charts = []
+    spans, vlines = [], []
+    if "partition_window" in ex:
+        a, b = ex["partition_window"][:2]
+        spans = [(a, b, "partition")]
+        vlines = [(b, "heal")]
+    if tl["enabled"]:
+        s = tl["series"]
+        rpr = tl["rounds_per_row"]
+        x = [i * rpr for i in range(tl["rows"])]
+
+        def band(name):
+            return (s[name]["q25"], s[name]["q75"])
+
+        charts.append(svg_chart(
+            "Delivery ratio", x,
+            [{"name": "median", "values": s["delivery_ratio"]["q50"],
+              "color": "var(--series-1)", "band": band("delivery_ratio")}],
+            note="cumulative delivered/expected · IQR wash over sims",
+            y0=0.0, y1=1.0, spans=spans, vlines=vlines))
+        charts.append(svg_chart(
+            "Mesh degree", x,
+            [{"name": "mean (median)", "values": s["mesh_deg_mean"]["q50"],
+              "color": "var(--series-1)", "band": band("mesh_deg_mean")},
+             {"name": "min", "values": s["mesh_deg_min"]["q50"],
+              "color": "var(--series-1)", "muted": True},
+             {"name": "max", "values": s["mesh_deg_max"]["q50"],
+              "color": "var(--series-1)", "muted": True}],
+            note="per-(peer, topic) mesh degree across the network",
+            y0=0.0, spans=spans, vlines=vlines))
+        charts.append(svg_chart(
+            "Peer score quantiles", x,
+            [{"name": "p50", "values": s["score_p50"]["q50"],
+              "color": "var(--series-1)", "band": band("score_p50")},
+             {"name": "p5", "values": s["score_p5"]["q50"],
+              "color": "var(--series-1)", "muted": True},
+             {"name": "p95", "values": s["score_p95"]["q50"],
+              "color": "var(--series-1)", "muted": True}],
+            note="across peers: each peer's mean held neighbor score",
+            spans=spans, vlines=vlines))
+        charts.append(svg_chart(
+            "Deliveries & recovery per observation", x,
+            [{"name": "deliveries", "values": s["ev_deliver_message"]["q50"],
+              "color": "var(--series-1)",
+              "band": band("ev_deliver_message")},
+             {"name": "duplicates", "values": s["ev_duplicate_message"]["q50"],
+              "color": "var(--series-2)"},
+             {"name": "IWANT recoveries",
+              "values": s["ev_iwant_recover"]["q50"],
+              "color": "var(--series-3)"}],
+            note="EV-counter deltas per observation (reconciled against "
+                 "the drained totals)", y0=0.0, spans=spans, vlines=vlines))
+        if any(v > 0 for v in s["links_down_frac"]["q75"]):
+            charts.append(svg_chart(
+                "Link-down occupancy", x,
+                [{"name": "median", "values": s["links_down_frac"]["q50"],
+                  "color": "var(--series-2)",
+                  "band": band("links_down_frac")}],
+                note="fraction of live undirected links down per round",
+                y0=0.0, y1=1.0, spans=spans, vlines=vlines))
+    if "cross_mesh_series" in ex:
+        cm = ex["cross_mesh_series"]
+        charts.append(svg_chart(
+            "Cross-group mesh edges — the repair arc", cm["ticks"],
+            [{"name": "median", "values": cm["q50"],
+              "color": "var(--series-1)", "band": (cm["q25"], cm["q75"])}],
+            xlabel="tick",
+            note="directed mesh edges crossing the partition: starve → "
+                 "prune trough → backoff-clear re-graft wave "
+                 "(chaos.metrics.mesh_reform_latency reads this series)",
+            y0=0.0, spans=spans, vlines=vlines))
+    if "latency_cdf" in ex:
+        cdf = ex["latency_cdf"]
+        charts.append(svg_chart(
+            "Delivery-latency CDF", cdf["lat"],
+            [{"name": "pooled", "values": cdf["pooled"],
+              "color": "var(--series-1)",
+              "band": (cdf.get("q10", cdf["pooled"]),
+                       cdf.get("q90", cdf["pooled"]))}],
+            xlabel="rounds after publish",
+            note="pooled over sims · band = per-sim CDF 10/90 percentiles",
+            y0=0.0, y1=1.0))
+    if not charts:
+        out.append('<p class="sub">This artifact predates the telemetry '
+                   'plane (TELEMETRY_OFF) — no per-round series to plot; '
+                   're-run the producing report with --timeline.</p>')
+    out.append('<div class="grid2">' + "".join(charts) + "</div>")
+    return "".join(out)
+
+
+def render_html(records, title: str = "pubsub run report",
+                tracestat: dict | None = None) -> str:
+    body = [f"<h1>{_html.escape(title)}</h1>",
+            '<p class="sub">telemetry-plane timeline dashboard '
+            "(go_libp2p_pubsub_tpu, docs/DESIGN.md §11) · bands are "
+            "median/IQR across sims</p>"]
+    for rec in records:
+        body.append(record_sections(rec))
+    if tracestat is not None:
+        body.append("<h2>trace summary (tracestat)</h2>")
+        counts = tracestat.get("counts", {})
+        rows = "".join(f"<tr><th>{_html.escape(k)}</th><td>{v}</td></tr>"
+                       for k, v in counts.items())
+        body.append(f'<div class="card"><table>{rows}</table>')
+        caveats = tracestat.get("caveats", [])
+        if caveats:
+            body.append('<p class="note">caveats: '
+                        + _html.escape(", ".join(caveats)) + "</p>")
+        body.append("</div>")
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{_html.escape(title)}</title>"
+            f"<style>{_CSS}</style></head>"
+            f"<body class='viz-root'>{''.join(body)}"
+            f"<script>{_JS}</script></body></html>")
+
+
+def write_report(prefix: str, records) -> tuple:
+    """Write ``records`` as ``<prefix>.json`` (one dump_record line
+    each) and render the parsed-back lines as the self-contained
+    ``<prefix>.html`` dashboard. The shared tail of every ``--timeline``
+    mode (chaos_report, ensemble_report) — round-tripping through
+    load_bench_lines so the HTML shows exactly what the artifact
+    carries. Returns ``(json_path, html_path)``."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import dump_record
+
+    json_path = prefix + ".json"
+    with open(json_path, "w") as f:
+        for rec in records:
+            f.write(dump_record(rec) + "\n")
+    html_path = prefix + ".html"
+    with open(html_path, "w") as f:
+        f.write(render_html(load_bench_lines(json_path),
+                            title=os.path.basename(json_path)))
+    return json_path, html_path
+
+
+def render_markdown(records) -> str:
+    out = ["# pubsub run report", ""]
+    for rec in records:
+        tl = rec.timeline
+        ex = rec.extras or {}
+        out += [f"## {rec.metric}", "",
+                f"- value (median over {rec.n_sims} sims): **{rec.value}**"
+                f" {rec.unit}"]
+        for k in ("iqr", "iwant_recovery_share_median",
+                  "mesh_reform_latency_median", "time_to_recover_median"):
+            if k in ex:
+                out.append(f"- {k}: {ex[k]}")
+        if tl["enabled"]:
+            s = tl["series"]
+            x = [i * tl["rounds_per_row"] for i in range(tl["rows"])]
+            cols = ["delivery_ratio", "mesh_deg_mean", "score_p50",
+                    "ev_deliver_message", "ev_duplicate_message",
+                    "ev_iwant_recover", "links_down_frac"]
+            out += ["", "| round | " + " | ".join(cols) + " |",
+                    "|" + "---|" * (len(cols) + 1)]
+            stride = max(1, len(x) // 16)
+            for i in range(0, len(x), stride):
+                out.append("| " + str(x[i]) + " | " + " | ".join(
+                    _fmt(s[c]["q50"][i]) for c in cols) + " |")
+        else:
+            out.append("- no timeline block (TELEMETRY_OFF artifact)")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="schema-v3 artifact (JSON lines)")
+    ap.add_argument("--out", help="output path (default: artifact + "
+                                  ".html/.md)")
+    ap.add_argument("--md", action="store_true",
+                    help="emit markdown instead of HTML")
+    ap.add_argument("--tracestat",
+                    help="tracestat --json output to embed as a section")
+    args = ap.parse_args(argv)
+    records = load_bench_lines(args.artifact)
+    ts = None
+    if args.tracestat:
+        with open(args.tracestat) as f:
+            ts = json.load(f)
+    if args.md:
+        text = render_markdown(records)
+        suffix = ".md"
+    else:
+        text = render_html(
+            records, title=os.path.basename(args.artifact), tracestat=ts)
+        suffix = ".html"
+    out = args.out or (os.path.splitext(args.artifact)[0] + suffix)
+    with open(out, "w") as f:
+        f.write(text)
+    print(f"wrote {out} ({len(records)} record(s), "
+          f"{sum(1 for r in records if r.telemetry_on)} with timelines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Telemetry-plane gate (``make telemetry-smoke``; docs/DESIGN.md §11).
+
+Builds the bench gossipsub step TELEMETRY-ON at the PERF_SMOKE shape
+(N=2048, live counters, one panel row per round + a two-peer flight
+recorder) and asserts the plane's whole contract:
+
+  1. **one compile, zero host transfers** — the full ROUNDS-round run
+     executes under ``jax.transfer_guard('disallow')`` and the step's
+     compile cache grows by exactly 1 (cache-size sentinel): the
+     recorder writes every round as plain device ops inside the one
+     compiled program, never by polling the host.
+  2. **exact reconciliation** — summed per-round EV deltas of the
+     recorded panel equal the end-of-run drained counters bit-for-bit
+     (telemetry/panel.reconcile). A panel that drifts from the
+     counters is lying about the run; the gate hard-stops on it.
+  3. **telemetry-on kernel census** — the compiled phase-step (r=8)
+     kernel total with telemetry on is pinned against the committed
+     TELEMETRY_SMOKE.json (ceiling TELEMETRY_SMOKE_KERNEL_TOL, default
+     1.10 — looser than PERF_SMOKE's 1.05 because the committed number
+     also rides XLA-version fusion jitter across images). The
+     image-independent invariant is checked alongside: the on-vs-off
+     census delta measured FRESH on this machine must stay within the
+     committed extra-kernel budget x the same tolerance.
+  4. **overhead ceiling** — warm-vs-warm, same build except the
+     TelemetryConfig (both with live counters, so the delta isolates
+     the recorder): telemetry-on must run no more than
+     TELEMETRY_SMOKE_OVERHEAD (default 0.15 = 15%) slower.
+
+``TELEMETRY_SMOKE_UPDATE=1`` rewrites the baseline from this run
+(same workflow as PERF_SMOKE / ENSEMBLE_SMOKE). CPU-only by contract,
+like the other smoke gates; telemetry-OFF elision is pinned separately
+by chaos-smoke's census-equality gate and
+tests/test_telemetry.py::test_telemetry_on_is_bitwise_additive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+
+import numpy as np  # noqa: E402
+
+BASELINE_NAME = "TELEMETRY_SMOKE.json"
+SMOKE_ROUNDS = 48
+#: warm-vs-warm slowdown ceiling for the telemetry-on build
+DEFAULT_OVERHEAD = 0.15
+#: census ceiling vs the committed baseline (and for the extra-kernel
+#: budget) — absorbs cross-image XLA fusion jitter
+DEFAULT_KERNEL_TOL = 1.10
+TIMING_REPS = 3
+
+
+def _fresh(state):
+    """Donatable copy of a state tree (jitted steps donate their state
+    argument, so every run window needs its own buffers — key leaves
+    included, or the first window's donation deletes the shared key)."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.checkpoint import is_prng_key
+
+    def cp(x):
+        if is_prng_key(x):
+            return jax.random.wrap_key_data(
+                jnp.copy(jax.random.key_data(x)), impl=jax.random.key_impl(x))
+        return jnp.copy(x)
+
+    return jax.tree_util.tree_map(cp, state)
+
+
+def _pub_args(n: int, rounds: int):
+    """One valid publish per round from a rotating origin — enough to
+    keep the allocator/delivery path live in the timed window."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.perf.sweep import PUBS_PER_ROUND
+
+    out = []
+    for i in range(rounds):
+        po = np.full((PUBS_PER_ROUND,), -1, np.int32)
+        po[0] = i % n
+        out.append((jnp.asarray(po),
+                    jnp.asarray(np.zeros((PUBS_PER_ROUND,), np.int32)),
+                    jnp.asarray(np.ones((PUBS_PER_ROUND,), bool))))
+    return out
+
+
+def _build(n: int, rounds: int, telemetry_on: bool):
+    """(state, step, tcfg) — the bench gossipsub per-round step with
+    live counters; only the TelemetryConfig differs between the on and
+    off builds, so their timing delta isolates the recorder."""
+    from go_libp2p_pubsub_tpu.perf.sweep import build_bench
+    from go_libp2p_pubsub_tpu.telemetry import TelemetryConfig
+
+    tcfg = (TelemetryConfig(rows=rounds, tracked=(0, 7))
+            if telemetry_on else None)
+    st, step, _, _ = build_bench(n, 64, heartbeat_every=1,
+                                 rounds_per_phase=1, telemetry=tcfg,
+                                 count_events=True)
+    return st, step, tcfg
+
+
+def _timed_window(step, state, args) -> float:
+    """Seconds for one warm run over ``args`` (state must be fresh —
+    the step donates it)."""
+    import jax
+
+    t0 = time.perf_counter()
+    for a in args:
+        state = step(state, *a)
+    jax.block_until_ready(state)
+    return time.perf_counter() - t0
+
+
+def run_gate(n: int, rounds: int) -> dict:
+    import jax
+
+    from go_libp2p_pubsub_tpu.ensemble.runner import _cache_size
+    from go_libp2p_pubsub_tpu.telemetry import reconcile
+
+    failures: list[str] = []
+    args = _pub_args(n, rounds)
+
+    # --- guarded telemetry-on run: one compile, zero host transfers --
+    st_on, step_on, tcfg = _build(n, rounds, telemetry_on=True)
+    before = _cache_size(step_on)
+    st_fin = _fresh(st_on)
+    with jax.transfer_guard("disallow"):
+        for a in args:
+            st_fin = step_on(st_fin, *a)
+        jax.block_until_ready(st_fin)
+    after = _cache_size(step_on)
+    compiles = -1 if before is None or after is None else after - before
+    if compiles not in (-1, 1):
+        failures.append(
+            f"one-compile: telemetry-on step compiled {compiles} times "
+            f"across the {rounds}-round run (expected exactly 1)"
+        )
+
+    # --- reconciliation (host side, outside the run window) ----------
+    panel = np.asarray(st_fin.core.telem.panel)
+    events = np.asarray(st_fin.core.events)
+    mism = reconcile(panel, events)
+    if mism:
+        failures.append(
+            "drain-vs-timeline reconciliation failed: " + "; ".join(mism[:4])
+        )
+    from go_libp2p_pubsub_tpu.telemetry.panel import _EV_COL0, EV_METRICS
+    if panel[:, _EV_COL0:_EV_COL0 + len(EV_METRICS)].sum() <= 0:
+        failures.append("telemetry panel recorded no events — the run "
+                        "window never exercised the recorder")
+
+    # --- warm-vs-warm overhead ---------------------------------------
+    st_off, step_off, _ = _build(n, rounds, telemetry_on=False)
+    # warm the off build (the on build is warm from the guarded run)
+    _timed_window(step_off, _fresh(st_off), args)
+    t_on = min(_timed_window(step_on, _fresh(st_on), args)
+               for _ in range(TIMING_REPS))
+    t_off = min(_timed_window(step_off, _fresh(st_off), args)
+                for _ in range(TIMING_REPS))
+    overhead = t_on / t_off - 1.0
+    ceiling = float(os.environ.get("TELEMETRY_SMOKE_OVERHEAD",
+                                   DEFAULT_OVERHEAD))
+    if overhead > ceiling:
+        failures.append(
+            f"overhead: telemetry-on ran {100 * overhead:.1f}% slower "
+            f"than telemetry-off warm-vs-warm (ceiling {100 * ceiling:.0f}%"
+            f"; {t_on:.3f}s vs {t_off:.3f}s over {rounds} rounds)"
+        )
+
+    # --- telemetry-on kernel census (phase r=8, the PERF_SMOKE shape) -
+    from go_libp2p_pubsub_tpu.perf.profile import compiled_phase_kernel_count
+    from go_libp2p_pubsub_tpu.perf.regress import PERF_SMOKE_R
+    from go_libp2p_pubsub_tpu.telemetry import TelemetryConfig
+
+    r = PERF_SMOKE_R
+    census_on = compiled_phase_kernel_count(
+        n, r, telemetry=TelemetryConfig(rows=max(rounds // r, 1)))
+    census_off = compiled_phase_kernel_count(n, r)
+
+    return {
+        "failures": failures,
+        "compiles": compiles,
+        "rate_on": round(rounds / t_on, 2),
+        "rate_off": round(rounds / t_off, 2),
+        "overhead_frac": round(overhead, 4),
+        "census_on_total": census_on["total"],
+        "census_off_total": census_off["total"],
+        "extra_kernels": census_on["total"] - census_off["total"],
+        "n_peers": n,
+        "rounds": rounds,
+        "rounds_per_phase": r,
+    }
+
+
+def check_baseline(root: str, res: dict) -> list[str]:
+    """Census ceiling vs the committed TELEMETRY_SMOKE.json."""
+    path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(path) or os.environ.get("TELEMETRY_SMOKE_UPDATE"):
+        return []
+    with open(path) as f:
+        base = json.load(f)
+    if (int(base.get("n_peers", res["n_peers"])) != res["n_peers"]
+            or int(base.get("rounds_per_phase", res["rounds_per_phase"]))
+            != res["rounds_per_phase"]):
+        return []  # reshape run: the committed census is shape-specific
+    tol = float(os.environ.get("TELEMETRY_SMOKE_KERNEL_TOL",
+                               DEFAULT_KERNEL_TOL))
+    out = []
+    committed = base.get("census_on_total")
+    if committed is not None and res["census_on_total"] > tol * committed:
+        out.append(
+            f"telemetry-on kernel census regressed: "
+            f"{res['census_on_total']} > {tol:.2f} x committed {committed} "
+            f"({BASELINE_NAME}; TELEMETRY_SMOKE_KERNEL_TOL overrides, "
+            f"TELEMETRY_SMOKE_UPDATE=1 rewrites)"
+        )
+    budget = base.get("extra_kernels")
+    if budget is not None and res["extra_kernels"] > tol * budget:
+        out.append(
+            f"telemetry recorder kernel budget blown: +{res['extra_kernels']}"
+            f" kernels over the telemetry-off build (committed budget "
+            f"+{budget}, tol {tol:.2f}) — the panel write stopped fusing"
+        )
+    return out
+
+
+def write_baseline(root: str, res: dict) -> str:
+    path = os.path.join(root, BASELINE_NAME)
+    doc = {
+        "schema": 1,
+        "note": ("telemetry-plane smoke baseline (scripts/telemetry_smoke"
+                 ".py); TELEMETRY_SMOKE_UPDATE=1 rewrites. rate_* are "
+                 "per-round-engine rounds/s on the gate machine; census "
+                 "totals are compiled phase-step (r=8) kernel counts."),
+        **{k: res[k] for k in (
+            "n_peers", "rounds", "rounds_per_phase", "rate_on", "rate_off",
+            "overhead_frac", "census_on_total", "census_off_total",
+            "extra_kernels")},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int,
+                    default=int(os.environ.get("TELEMETRY_SMOKE_N", 0)) or None)
+    ap.add_argument("--rounds", type=int, default=SMOKE_ROUNDS)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # regress.py policy: the gate is CPU-only and uses the bench PRNG
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import PERF_SMOKE_N, repo_root
+
+    root = repo_root()
+    enable_persistent_cache(os.path.join(root, ".jax_cache"))
+    n = args.n or PERF_SMOKE_N
+
+    res = run_gate(n, args.rounds)
+    failures = list(res["failures"]) + check_baseline(root, res)
+    if os.environ.get("TELEMETRY_SMOKE_UPDATE") and not res["failures"]:
+        print(f"wrote {write_baseline(root, res)}")
+
+    print(json.dumps({
+        "telemetry_smoke": "PASS" if not failures else "FAIL",
+        **{k: v for k, v in res.items() if k != "failures"},
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,12 @@ TraceEvent files and reports:
     the message's PUBLISH_MESSAGE, by message id), in the trace's time
     base (nanoseconds; the drain writes tick * tick_ns).
 
+With ``--json`` the summary is machine-readable: the per-type counts
+plus a ``caveats`` list of stable flag strings (``phase_cadence``,
+``counter_only_events``, ``no_publishes``) with their prose in
+``caveat_notes`` — so gates and scripts/run_report.py consume the
+accounting caveats structurally instead of re-parsing report text.
+
 Usage: python scripts/tracestat.py TRACEFILE [--json]
 """
 
@@ -134,8 +140,34 @@ def summarize(events) -> dict:
     pub = counts.get("PUBLISH_MESSAGE", 0)
     dlv = counts.get("DELIVER_MESSAGE", 0)
     cadence = _cadence_note(data_ts, control_ts)
+    # stable machine-readable caveat FLAGS (the prose lives in
+    # caveat_notes): gates and run_report branch on the flag strings,
+    # never on report text
+    caveats = []
+    notes = {}
+    if cadence:
+        caveats.append("phase_cadence")
+        notes["phase_cadence"] = cadence["note"]
+    # the per-event stream never carries the sim-only chaos counters
+    # (trace/drain.py COUNTER_ONLY_EVENTS) — flag it so a gate reading
+    # this file knows LINK_DOWN/IWANT_RECOVER totals live in the
+    # drained counters (counter_events()), not here
+    caveats.append("counter_only_events")
+    notes["counter_only_events"] = (
+        "LINK_DOWN/IWANT_RECOVER have no TraceEvent record type; their "
+        "exact totals come from the device counters "
+        "(trace.drain.counter_events), not this stream."
+    )
+    if not pub:
+        caveats.append("no_publishes")
+        notes["no_publishes"] = (
+            "no PUBLISH_MESSAGE events: delivery ratio and delay "
+            "percentiles are undefined for this trace."
+        )
     return {
         **({"cadence": cadence} if cadence else {}),
+        "caveats": caveats,
+        "caveat_notes": notes,
         "events": sum(counts.values()),
         "peers": len(peers),
         "counts": dict(sorted(counts.items())),
@@ -185,6 +217,8 @@ def main():
             f"cadence: phase trace, ~{c['rounds_per_phase_estimate']} "
             f"rounds/phase — {c['note']}"
         )
+    if stats.get("caveats"):
+        print("caveats: " + ", ".join(stats["caveats"]))
 
 
 if __name__ == "__main__":

@@ -237,6 +237,62 @@ def test_ev_drain_fires():
     assert sum("LINK_DOWN" in v.msg for v in vs) == 0  # documented counter
 
 
+def test_ev_drain_telemetry_column_counts_as_drained():
+    """Round 11: a sim-only counter whose ``ev_<name>`` column appears
+    in telemetry/panel.py counts as drained — the panel records its
+    per-round deltas and the reconciliation gate pins them. Without
+    the column (and without drain prose) the rule still fires."""
+    args = dict(
+        ev_names=["DELIVER_MESSAGE", "IWANT_RECOVER"],
+        proto_names={"DELIVER_MESSAGE"},
+        drain_src="TraceEvent.DELIVER_MESSAGE",  # no IWANT prose at all
+        package_refs={"DELIVER_MESSAGE", "IWANT_RECOVER"},
+    )
+    vs = simlint.check_ev_drain(
+        **args, telemetry_src='EV_METRICS = ("ev_iwant_recover",)')
+    assert not any("IWANT_RECOVER" in v.msg for v in vs)
+    vs = simlint.check_ev_drain(**args, telemetry_src="")
+    assert any("IWANT_RECOVER" in v.msg for v in vs)
+
+
+def test_telemetry_panel_rule_negatives():
+    """The panel catalog must mirror the EV enum positionally, and a
+    metric that is RECORDED but never RECONCILED is a violation (a
+    timeline column the drain-vs-timeline gate never checks)."""
+    ev = ["PUBLISH_MESSAGE", "DELIVER_MESSAGE"]
+    ok = ["ev_publish_message", "ev_deliver_message"]
+    assert simlint.check_telemetry_panel(ev, ok, ok) == []
+    # missing / misordered column relabels everything after it
+    vs = simlint.check_telemetry_panel(ev, ok[::-1], ok[::-1])
+    assert any("enum order" in v.msg for v in vs)
+    vs = simlint.check_telemetry_panel(ev, ok[:1], ok[:1])
+    assert any("enum order" in v.msg for v in vs)
+    # recorded but never reconciled — the negative test the issue pins
+    vs = simlint.check_telemetry_panel(ev, ok, ok[:1])
+    assert any("never" in v.msg or "missing from RECONCILED" in v.msg
+               for v in vs)
+    assert all(v.rule == "telemetry-panel" for v in vs)
+    # RECONCILED naming a non-recorded column is equally broken
+    vs = simlint.check_telemetry_panel(ev, ok, ok + ["ev_ghost"])
+    assert any("ev_ghost" in v.msg for v in vs)
+
+
+def test_telemetry_panel_rule_on_repo_source():
+    """The in-tree catalog satisfies the rule, and the AST extractor
+    resolves the RECONCILED = EV_METRICS alias + tuple concatenation."""
+    import ast
+
+    panel_p = os.path.join(PKG, "telemetry", "panel.py")
+    with open(panel_p) as f:
+        tree = ast.parse(f.read())
+    ev_metrics = simlint._tuple_literal(tree, "EV_METRICS")
+    reconciled = simlint._tuple_literal(tree, "RECONCILED")
+    assert ev_metrics and reconciled == ev_metrics
+    metrics = simlint._tuple_literal(tree, "METRICS")  # ("x",) + EV + (...)
+    assert metrics is not None and metrics[0] == "delivery_ratio"
+    assert simlint._rule_telemetry_panel(PKG) == []
+
+
 def test_allowlist_filters_by_qual(tmp_path):
     vs = lint("""
         def drain(state):

@@ -1,0 +1,523 @@
+"""Telemetry plane tests (go_libp2p_pubsub_tpu/telemetry/, docs/DESIGN.md
+§11).
+
+The load-bearing contracts:
+
+  * **exact reconciliation** — summed per-observation EV deltas of the
+    on-device panel equal the end-of-run drained counters BIT-FOR-BIT,
+    for every engine (per-round gossipsub incl. churn, phase r∈{1,8} on
+    the stacked coalesced wire path, floodsub, randomsub) and per sim in
+    a batched S=3 run. A panel that drifts from the counters is lying
+    about the run.
+  * **elision when off** — ``telemetry=None`` builds add NO state
+    leaves and change NOTHING: stripping the ``telem`` leaves from a
+    telemetry-on run leaves a tree bit-identical to the telemetry-off
+    run (the recorder is purely additive; `make telemetry-smoke`
+    additionally pins the chaos-off/telemetry-off compiled kernel
+    census against the committed PERF_SMOKE baseline).
+  * **registry parity** — every EV member maps to a reference tracer
+    event name (pb/trace.proto via trace_pb2) or is listed in the
+    documented sim-only set ``trace/drain.py::COUNTER_ONLY_EVENTS``;
+    the panel's metric catalog mirrors the enum positionally.
+  * **checkpoint carry** — the telemetry panel rides the v6 format
+    with NO version bump (v6 is pytree-generic), and template/state
+    telemetry settings must match.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import checkpoint, ensemble, graph
+from go_libp2p_pubsub_tpu.chaos import ChaosConfig
+from go_libp2p_pubsub_tpu.config import GossipSubParams, PeerScoreThresholds
+from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import make_gossipsub_phase_step
+from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+from go_libp2p_pubsub_tpu.ops import bitset
+from go_libp2p_pubsub_tpu.pb import trace_pb2
+from go_libp2p_pubsub_tpu.state import Net, SimState
+from go_libp2p_pubsub_tpu.telemetry import (
+    EV_METRICS,
+    FLIGHT_METRICS,
+    METRICS,
+    N_FLIGHT,
+    N_METRICS,
+    RECONCILED,
+    TelemetryConfig,
+    TelemetryState,
+    metric_index,
+    panel_ev_totals,
+    reconcile,
+    reconcile_batched,
+    rows_used,
+    timeline_block,
+)
+from go_libp2p_pubsub_tpu.telemetry.panel import TelemetryConfigError
+from go_libp2p_pubsub_tpu.trace import drain
+from go_libp2p_pubsub_tpu.trace.events import EV, N_EVENTS
+
+from test_phase import assert_states_equal, score_params
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+IID = ChaosConfig(loss_rate=0.35)
+N, D, M, P = 32, 6, 64, 3
+
+
+def _net(n=N, seed=0, n_topics=1):
+    topo = graph.random_connect(n, d=D, seed=seed)
+    subs = graph.subscribe_all(n, n_topics)
+    return Net.build(topo, subs)
+
+
+def _build_gossip(seed=0, chaos=IID, telemetry=None, n=N, **cfg_kw):
+    net = _net(n=n, seed=seed)
+    sp = score_params()
+    params = dataclasses.replace(GossipSubParams(), flood_publish=True)
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
+                                score_enabled=True, chaos=chaos, **cfg_kw)
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=seed,
+                             telemetry=telemetry)
+    return net, cfg, sp, st
+
+
+def _schedule(rounds, seed=0, n=N):
+    rng = np.random.default_rng(seed)
+    po = rng.integers(0, n, size=(rounds, P)).astype(np.int32)
+    pt = np.zeros((rounds, P), np.int32)
+    pv = np.ones((rounds, P), bool)
+    return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+
+def _strip_telem(tree):
+    """Leaf (path, value) pairs excluding the telemetry plane."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(p), v) for p, v in flat
+            if "telem" not in jax.tree_util.keystr(p)]
+
+
+# ---------------------------------------------------------------------------
+# EV registry <-> reference tracer parity (the drift audit)
+
+
+def test_ev_registry_matches_reference_tracer():
+    """Every EV member is either a pb/trace.proto TraceEvent.Type name
+    (the Go tracer's event registry) or listed in the documented
+    sim-only set next to drain.COUNTER_ONLY_EVENTS — and vice versa:
+    every reference type has an EV member. Catches silent drift in
+    BOTH directions when either registry grows."""
+    ref_names = set(trace_pb2.TraceEvent.Type.keys())
+    sim_only = set(drain.COUNTER_ONLY_EVENTS)
+    for e in EV:
+        if e in sim_only:
+            # sim-only counters must NOT shadow a reference event name
+            assert e.name not in ref_names, (
+                f"EV.{e.name} is in COUNTER_ONLY_EVENTS but the "
+                "reference tracer HAS that event type — it must be "
+                "drained as TraceEvents, not counter-only"
+            )
+        else:
+            assert e.name in ref_names, (
+                f"EV.{e.name} maps to no pb/trace.proto TraceEvent.Type "
+                "and is not in drain.COUNTER_ONLY_EVENTS — either add "
+                "the proto mapping or document it as sim-only"
+            )
+    for name in ref_names:
+        assert name in EV.__members__, (
+            f"reference trace event {name} has no EV member — the "
+            "device counters cannot count it"
+        )
+    # the documented sim-only set is exactly the enum tail the proto
+    # does not know, and the codes beyond it stay contiguous
+    assert sim_only == {e for e in EV if e.name not in ref_names}
+
+
+def test_metric_catalog_mirrors_ev_enum():
+    """The panel writes the EV delta vector by position — the catalog
+    must mirror the enum exactly (the telemetry-panel simlint rule
+    pins the same contract at lint time)."""
+    assert METRICS[0] == "delivery_ratio"
+    assert list(EV_METRICS) == [f"ev_{e.name.lower()}" for e in EV]
+    assert N_METRICS == 1 + N_EVENTS + 7
+    assert RECONCILED == EV_METRICS
+    assert metric_index("ev_deliver_message") == 1 + int(EV.DELIVER_MESSAGE)
+    assert N_FLIGHT == len(FLIGHT_METRICS)
+    with pytest.raises(TelemetryConfigError):
+        TelemetryConfig(rows=0).validate()
+    with pytest.raises(TelemetryConfigError):
+        TelemetryConfig(rows=4, tracked=[0, 1]).validate()  # not hashable
+
+
+# ---------------------------------------------------------------------------
+# drain-vs-timeline reconciliation (the correctness anchor)
+
+
+def test_reconcile_gossipsub_under_chaos_and_churn():
+    rounds = 12
+    tcfg = TelemetryConfig(rows=rounds)
+    net, cfg, sp, st = _build_gossip(seed=3, telemetry=tcfg)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               dynamic_peers=True, telemetry=tcfg)
+    po, pt, pv = _schedule(rounds, seed=3)
+    up = np.ones((rounds, N), bool)
+    up[4:8, 5] = False   # peer 5 leaves and returns (ADD/REMOVE_PEER)
+    up[6:, 11] = False   # peer 11 leaves for good
+    for i in range(rounds):
+        st = step(st, po[i], pt[i], pv[i], jnp.asarray(up[i]))
+    panel = np.asarray(st.core.telem.panel)
+    events = np.asarray(st.core.events)
+    assert reconcile(panel, events) == []
+    # the run actually moved: deliveries, churn and chaos all recorded
+    totals = panel_ev_totals(panel)
+    assert totals[EV.DELIVER_MESSAGE] > 0
+    assert totals[EV.REMOVE_PEER] >= 2 and totals[EV.ADD_PEER] >= 1
+    assert totals[EV.LINK_DOWN] > 0
+    dr = panel[:, metric_index("delivery_ratio")]
+    assert 0.0 <= dr.min() and dr.max() <= 1.0
+    deg = panel[:, metric_index("mesh_deg_mean")]
+    assert deg[-1] > 0.0  # the mesh formed
+
+
+@pytest.mark.parametrize("r", [1, pytest.param(8, marks=pytest.mark.slow)])
+def test_reconcile_phase_stacked_wire(r):
+    """Phase engine on the stacked coalesced wire path: ONE row per
+    phase whose deltas cover all r sub-rounds + control head +
+    heartbeat, so the panel still telescopes to the drained totals."""
+    rounds = 16
+    tcfg = TelemetryConfig(rows=rounds // r)
+    net, cfg, sp, st = _build_gossip(seed=7, telemetry=tcfg)
+    assert cfg.wire_coalesced
+    pstep = make_gossipsub_phase_step(cfg, net, r, score_params=sp,
+                                      telemetry=tcfg)
+    po, pt, pv = _schedule(rounds, seed=7)
+    g = rounds // r
+    gro = lambda a: a.reshape((g, r) + a.shape[1:])
+    po, pt, pv = gro(po), gro(pt), gro(pv)
+    for p in range(g):
+        st = pstep(st, po[p], pt[p], pv[p], do_heartbeat=True)
+    panel = np.asarray(st.core.telem.panel)
+    assert reconcile(panel, np.asarray(st.core.events)) == []
+    assert panel_ev_totals(panel)[EV.DELIVER_MESSAGE] > 0
+    assert rows_used(panel, rounds, rounds_per_row=r) == g
+
+
+def test_reconcile_floodsub_randomsub_under_chaos():
+    net = _net(seed=2)
+    rounds = 10
+    tcfg = TelemetryConfig(rows=rounds)
+    po, pt, pv = _schedule(rounds, seed=2)
+    st = SimState.init(N, M, seed=2, k=net.max_degree, telemetry=tcfg)
+    for i in range(rounds):
+        st = floodsub_step(net, st, po[i], pt[i], pv[i], chaos=IID,
+                           telemetry=tcfg)
+    panel = np.asarray(st.telem.panel)
+    assert reconcile(panel, np.asarray(st.events)) == []
+    assert panel_ev_totals(panel)[EV.DELIVER_MESSAGE] > 0
+    # mesh-less engine: the mesh/score columns record zeros
+    assert not panel[:, metric_index("mesh_deg_mean")].any()
+    assert not panel[:, metric_index("score_p50")].any()
+
+    step = make_randomsub_step(net, chaos=IID, telemetry=tcfg)
+    st = SimState.init(N, M, seed=3, k=net.max_degree, telemetry=tcfg)
+    for i in range(rounds):
+        st = step(st, po[i], pt[i], pv[i])
+    panel = np.asarray(st.telem.panel)
+    assert reconcile(panel, np.asarray(st.events)) == []
+    assert panel_ev_totals(panel)[EV.DELIVER_MESSAGE] > 0
+
+
+@pytest.mark.slow
+def test_reconcile_batched_s3_per_sim_exact():
+    """S=3 vmapped run: every sim's panel reconciles against ITS OWN
+    drained counters, and sim i's panel is bit-identical to the
+    single-sim run built with the derived key fold_in(sim_key, i)
+    (threefry batches elementwise — the ensemble parity contract)."""
+    s, rounds = 3, 10
+    tcfg = TelemetryConfig(rows=rounds)
+    net, cfg, sp, st0 = _build_gossip(seed=5, telemetry=tcfg)
+    step = make_gossipsub_step(cfg, net, score_params=sp, telemetry=tcfg)
+    base_key = st0.core.key
+    po, pt, pv = _schedule(rounds, seed=5)
+    ens = ensemble.lift_step(step)
+    states = ensemble.batch_states(st0, s)
+    for i in range(rounds):
+        states = ens(states, ensemble.tile(po[i], s),
+                     ensemble.tile(pt[i], s), ensemble.tile(pv[i], s))
+    panels = np.asarray(states.core.telem.panel)
+    events = np.asarray(states.core.events)
+    assert panels.shape == (s, rounds, N_METRICS)
+    assert reconcile_batched(panels, events) == []
+    # sims are genuinely different runs (independent fault streams)
+    assert not np.array_equal(panels[0], panels[1])
+    for i in range(s):
+        net_i, cfg_i, sp_i, st_i = _build_gossip(seed=5, telemetry=tcfg)
+        st_i = ensemble.with_sim_key(st_i, base_key, i)
+        for t in range(rounds):
+            st_i = step(st_i, po[t], pt[t], pv[t])
+        single = np.asarray(st_i.core.telem.panel)
+        # the reconciled columns (delivery ratio + EV deltas — exact
+        # integer arithmetic) are BIT-identical per sim; the derived f32
+        # state stats (means/quantiles) may differ by float epsilon
+        # because vmap changes the XLA reduction order
+        np.testing.assert_array_equal(
+            panels[i][:, : 1 + N_EVENTS], single[:, : 1 + N_EVENTS],
+            err_msg=f"sim {i} batched EV/delivery columns != single-sim",
+        )
+        np.testing.assert_allclose(
+            panels[i], single, rtol=1e-5, atol=1e-6,
+            err_msg=f"sim {i} batched panel != its single-sim panel",
+        )
+
+
+def test_rows_past_capacity_drop_without_wrap():
+    """Observations beyond the panel capacity DROP (no wraparound — a
+    wrapped panel would silently break the reconciliation sums)."""
+    net = _net(seed=4)
+    tcfg = TelemetryConfig(rows=4)
+    po, pt, pv = _schedule(8, seed=4)
+    st = SimState.init(N, M, seed=4, k=net.max_degree, telemetry=tcfg)
+    for i in range(4):
+        st = floodsub_step(net, st, po[i], pt[i], pv[i], telemetry=tcfg)
+    first4 = np.asarray(st.telem.panel)
+    assert reconcile(first4, np.asarray(st.events)) == []
+    for i in range(4, 8):
+        st = floodsub_step(net, st, po[i], pt[i], pv[i], telemetry=tcfg)
+    np.testing.assert_array_equal(np.asarray(st.telem.panel), first4)
+    assert rows_used(st.telem.panel, 8, rounds_per_row=1) == 4
+
+
+# ---------------------------------------------------------------------------
+# elision when off: telemetry must be purely additive
+
+
+def test_telemetry_off_adds_no_state_leaves():
+    net = _net(seed=0)
+    off = SimState.init(N, M, seed=0, k=net.max_degree)
+    assert off.telem is None
+    assert not any("telem" in p for p, _ in
+                   jax.tree_util.tree_flatten_with_path(off)[0]
+                   for p in [jax.tree_util.keystr(p)])
+    _, _, _, goff = _build_gossip(seed=0)
+    assert goff.core.telem is None
+
+
+def test_telemetry_on_is_bitwise_additive():
+    """Same seed, telemetry on vs off: stripping the telem leaves from
+    the on-run leaves a tree BIT-IDENTICAL to the off-run — recording
+    a panel changes nothing else about the simulation."""
+    rounds = 8
+    po, pt, pv = _schedule(rounds, seed=6)
+    finals = []
+    for tcfg in (None, TelemetryConfig(rows=rounds, tracked=(0, 3))):
+        net, cfg, sp, st = _build_gossip(seed=6, telemetry=tcfg)
+        step = make_gossipsub_step(cfg, net, score_params=sp,
+                                   telemetry=tcfg)
+        for i in range(rounds):
+            st = step(st, po[i], pt[i], pv[i])
+        finals.append(st)
+    off_leaves = _strip_telem(finals[0])
+    on_leaves = _strip_telem(finals[1])
+    assert [p for p, _ in off_leaves] == [p for p, _ in on_leaves]
+    for (path, a), (_, b) in zip(off_leaves, on_leaves):
+        if jnp.issubdtype(getattr(a, "dtype", None), jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"telemetry-on run diverged from off at {path}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_recorder_tracks_peer_trajectories():
+    rounds = 10
+    tracked = (0, 9, 17)
+    tcfg = TelemetryConfig(rows=rounds, tracked=tracked)
+    net, cfg, sp, st = _build_gossip(seed=8, telemetry=tcfg)
+    step = make_gossipsub_step(cfg, net, score_params=sp, telemetry=tcfg)
+    po, pt, pv = _schedule(rounds, seed=8)
+    for i in range(rounds):
+        st = step(st, po[i], pt[i], pv[i])
+    flight = np.asarray(st.core.telem.flight)
+    assert flight.shape == (rounds, len(tracked), N_FLIGHT)
+    # the LAST row snapshots the final state's planes exactly
+    mesh = np.asarray(st.mesh)
+    have = st.core.dlv.have
+    fi = {m: i for i, m in enumerate(FLIGHT_METRICS)}
+    for k, peer in enumerate(tracked):
+        assert flight[-1, k, fi["mesh_degree"]] == mesh[peer].sum()
+        held = int(np.asarray(bitset.popcount(have[peer], axis=-1)))
+        assert flight[-1, k, fi["msgs_held"]] == held
+    # the mesh formed over the run: some tracked peer's degree moved
+    assert flight[:, :, fi["mesh_degree"]].max() > 0
+    # no flight plane without tracked peers (no extra leaf when unused)
+    assert TelemetryState.empty(TelemetryConfig(rows=4)).flight is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint carry (v6-generic — no format bump)
+
+
+def test_checkpoint_roundtrip_telemetry_carry(tmp_path):
+    assert checkpoint._FORMAT_VERSION == 6, (
+        "the telemetry plane must ride the pytree-generic v6 format "
+        "WITHOUT a version bump — a bump here breaks every committed "
+        "v6 checkpoint for no format reason"
+    )
+    rounds = 6
+    tcfg = TelemetryConfig(rows=rounds, tracked=(2,))
+    net, cfg, sp, st = _build_gossip(seed=9, telemetry=tcfg)
+    step = make_gossipsub_step(cfg, net, score_params=sp, telemetry=tcfg)
+    po, pt, pv = _schedule(rounds, seed=9)
+    for i in range(4):
+        st = step(st, po[i], pt[i], pv[i])
+    path = os.path.join(tmp_path, "telem.ckpt")
+    checkpoint.save(path, st)
+    template = GossipSubState.init(net, M, cfg, score_params=sp, seed=9,
+                                   telemetry=tcfg)
+    resumed = checkpoint.restore(path, template)
+    assert_states_equal(st, resumed, "telem-ckpt/")
+    # resumed run == uninterrupted run, panel included
+    st2 = resumed
+    for i in range(4, rounds):
+        st = step(st, po[i], pt[i], pv[i])
+        st2 = step(st2, po[i], pt[i], pv[i])
+    assert_states_equal(st, st2, "telem-resume/")
+    assert reconcile(np.asarray(st2.core.telem.panel),
+                     np.asarray(st2.core.events)) == []
+    # a telemetry-off template must refuse the telemetry-on snapshot
+    off_template = GossipSubState.init(net, M, cfg, score_params=sp, seed=9)
+    with pytest.raises(ValueError, match="telem|leaves|leaf"):
+        checkpoint.restore(path, off_template)
+
+
+# ---------------------------------------------------------------------------
+# artifact plumbing: schema-v3 timeline block
+
+
+def test_timeline_block_and_artifact_roundtrip():
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        TELEMETRY_OFF,
+        BenchRecord,
+        dump_record,
+        record_from_line,
+    )
+    import json as _json
+
+    rng = np.random.default_rng(0)
+    panels = rng.random((3, 6, N_METRICS)).astype(np.float32)
+    tl = timeline_block(panels, rounds_per_row=2)
+    assert tl["enabled"] and tl["n_sims"] == 3 and tl["rows"] == 6
+    assert tl["metrics"] == list(METRICS)
+    assert set(tl["series"]) == set(METRICS)
+    q = tl["series"]["delivery_ratio"]
+    assert set(q) == {"q25", "q50", "q75"} and len(q["q50"]) == 6
+    med = np.quantile(panels.astype(np.float64), 0.5, axis=0)
+    np.testing.assert_allclose(q["q50"], med[:, 0], atol=1e-5)
+    # single-sim panels degenerate to the same shape
+    one = timeline_block(panels[0])
+    assert one["n_sims"] == 1 and one["series"]["delivery_ratio"]["q25"] \
+        == one["series"]["delivery_ratio"]["q75"]
+
+    rec = BenchRecord(metric="m", value=1.0, unit="x", vs_baseline=0.0,
+                      schema=3, timeline_raw=tl)
+    back = record_from_line(_json.loads(dump_record(rec)))
+    assert back.telemetry_on and back.timeline["rows"] == 6
+    assert back.timeline["rounds_per_row"] == 2
+    # legacy lines read back TELEMETRY_OFF
+    legacy = record_from_line({"metric": "m", "value": 1.0, "unit": "x",
+                               "vs_baseline": 0.0})
+    assert not legacy.telemetry_on
+    assert legacy.timeline == TELEMETRY_OFF
+
+
+def test_panel_bands_matches_host_quantiles():
+    from go_libp2p_pubsub_tpu.ensemble import stats as estats
+
+    rng = np.random.default_rng(1)
+    panels = rng.random((5, 7, N_METRICS)).astype(np.float32)
+    bands = estats.panel_bands(panels, qs=(0.25, 0.5, 0.75))
+    assert bands.shape == (3, 7, N_METRICS)
+    np.testing.assert_allclose(
+        bands[1], np.quantile(panels, 0.5, axis=0), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the committed chaos band: run_report renders the repair arc
+
+
+def _committed_records():
+    path = os.path.join(REPO_ROOT, "TIMELINE_CHAOS.json")
+    if not os.path.exists(path):
+        pytest.skip("TIMELINE_CHAOS.json not committed in this checkout")
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    return load_bench_lines(path)
+
+
+def test_committed_timeline_band_renders_repair_arc():
+    """Acceptance pin: the committed 60%-loss 8-sim chaos band renders
+    a dashboard whose partition cell shows the trough→re-form
+    mesh-repair arc, with the re-form latency chaos.metrics measured
+    (median ~25 ticks, round-10 band)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    import run_report
+
+    records = _committed_records()
+    by_metric = {r.metric: r for r in records}
+    flap = by_metric["chaos_flap_delivery_ratio_gossipsub"]
+    part = by_metric["chaos_partition_delivery_ratio"]
+    # the committed cells are the canonical smoke shape
+    assert flap.chaos["loss_rate"] == 0.6 and flap.n_sims == 8
+    assert flap.telemetry_on and part.telemetry_on
+    # flap: v1.1 gossip holds delivery up under 60% loss
+    assert flap.value > 0.8
+    dr = flap.timeline["series"]["delivery_ratio"]["q50"]
+    assert dr[-1] > 0.8 and dr[-1] >= dr[2]
+    # partition: the repair arc — pre-partition cross mesh, starvation
+    # prune trough, then the re-graft wave after heal
+    cm = part.extras["cross_mesh_series"]
+    ticks, q50 = cm["ticks"], cm["q50"]
+    heal = part.extras["partition_window"][1]
+    pre = q50[0]
+    trough = min(q50)
+    assert trough < 0.25 * pre, (pre, trough)
+    post_heal = [v for t, v in zip(ticks, q50) if t > heal]
+    assert max(post_heal) > 3 * max(trough, 1.0), "cross mesh never re-formed"
+    # the reported latency is the chaos.metrics reading of that series
+    lat = part.extras["mesh_reform_latency_median"]
+    assert 10 <= lat <= 45, f"mesh re-form median {lat} drifted from ~25"
+    # the dashboard renders self-contained, with the arc + CDF sections
+    html = run_report.render_html(records, title="t")
+    assert "repair arc" in html and "Delivery ratio" in html
+    assert "Delivery-latency CDF" in html
+    assert "<script src=" not in html  # self-contained: no external assets
+    md = run_report.render_markdown(records)
+    assert "delivery_ratio" in md and "chaos_partition_delivery_ratio" in md
+
+
+def test_run_report_renders_legacy_artifact_as_stub():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    import run_report
+
+    from go_libp2p_pubsub_tpu.perf.artifacts import record_from_line
+
+    legacy = record_from_line({"metric": "m", "value": 1.0, "unit": "x",
+                               "vs_baseline": 0.0})
+    html = run_report.render_html([legacy])
+    assert "TELEMETRY_OFF" in html

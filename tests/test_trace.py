@@ -357,6 +357,12 @@ def test_tracestat_cli(tmp_path):
         # per-round cadence: control and data share the tick stride, so
         # no phase-cadence caveat is emitted
         assert "cadence" not in stats
+        # round 11: machine-readable caveat FLAGS (gates/run_report
+        # branch on these, never on report prose)
+        assert "phase_cadence" not in stats["caveats"]
+        assert "counter_only_events" in stats["caveats"]
+        assert "counter_only_events" in stats["caveat_notes"]
+        assert "no_publishes" not in stats["caveats"]
     # both formats describe the same run
     assert results[jpath] == results[ppath]
 
@@ -411,3 +417,7 @@ def test_tracestat_cli_phase_cadence(tmp_path):
     assert "cadence" in stats, stats.keys()
     assert stats["cadence"]["rounds_per_phase_estimate"] % 4 == 0
     assert "undercount" in stats["cadence"]["note"]
+    # the flag form of the same caveat (round 11): stable strings for
+    # gates + run_report, prose mirrored in caveat_notes
+    assert "phase_cadence" in stats["caveats"]
+    assert stats["caveat_notes"]["phase_cadence"] == stats["cadence"]["note"]
