@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke analyze sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke analyze sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -60,6 +60,23 @@ ensemble-smoke:
 telemetry-smoke:
 	python scripts/telemetry_smoke.py
 
+# invariant-oracle gate (scripts/invariant_report.py; docs/DESIGN.md
+# §12): the verification literature's safety/liveness properties
+# (no self-graft, mesh ⊆ topology ∩ subscription, degree bounds,
+# backoff respected, graylist exclusion, seen-cache consistency,
+# windowed eventual delivery, post-heal mesh re-formation) checked as
+# on-device predicates inside the 60%-loss flap band (per-round +
+# phase engines), the partition/heal scenario, and a loss-free quiet
+# cell (gossipsub + floodsub) — S=8 vmapped, one compile for the step
+# AND one for the checker, the quiet window under
+# transfer_guard('disallow'), warm-vs-warm overhead <= 10%
+# (ORACLE_SMOKE_OVERHEAD overrides), chaos-off census still equal to
+# PERF_SMOKE (the oracle plane never touches engine programs), and the
+# committed ORACLE_SMOKE.json property-catalog sentinel
+# (ORACLE_SMOKE_UPDATE=1 rewrites). ~2 min warm on CPU.
+oracle-smoke:
+	python scripts/invariant_report.py --smoke
+
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
 # discipline, packed-word dtype hygiene, import-time execution, static-
@@ -82,14 +99,16 @@ test:
 
 # quick tier: the sub-10-minute CI gate — `not slow` tests plus the CPU
 # perf-smoke regression gate, the chaos-smoke recovery gate, the
-# ensemble-plane gate, the telemetry-plane gate and the analysis-plane
-# gate (all fast once the compile cache is warm)
+# ensemble-plane gate, the telemetry-plane gate, the invariant-oracle
+# gate and the analysis-plane gate (all fast once the compile cache is
+# warm)
 quick:
 	python -m pytest tests/ -q -m "not slow"
 	python -m go_libp2p_pubsub_tpu.perf.regress
 	python scripts/chaos_report.py --smoke
 	python scripts/ensemble_report.py --smoke
 	python scripts/telemetry_smoke.py
+	python scripts/invariant_report.py --smoke
 	python scripts/analyze.py
 
 native:

@@ -74,6 +74,17 @@ Rule catalog (ids are stable; docs/DESIGN.md §9):
                  recorded EV column must be in ``RECONCILED`` — a
                  recorded-but-never-reconciled metric is a timeline
                  that can drift from the drained counters unchecked.
+  invariant-registry  every property registered in
+                 oracle/invariants.py's ``@invariant(...)`` catalog
+                 must declare a literal ``kind`` (safety|liveness), a
+                 literal non-empty ``engines`` applicability tuple
+                 drawn from the module's ``ENGINES``, a ``doc``
+                 citation — and be referenced by name in a
+                 tests/test_invariant*.py file (the seeded-violation
+                 negative-test catalog; names quoted incidentally in
+                 other test files do not count: a property nothing can
+                 trip is a rubber stamp, the exact failure mode the
+                 oracle plane exists to prevent).
 
 Allowlist: ``analysis/ALLOWLIST`` lines of ``<rule> <relpath>`` or
 ``<rule> <relpath>::<qualname>`` (``#`` comments). Entries match every
@@ -693,6 +704,128 @@ def _rule_ev_drain(pkg_root: str) -> list:
                           telemetry_src)
 
 
+_INVARIANT_KINDS = {"safety", "liveness"}
+
+
+def registry_entries(tree: ast.Module) -> list:
+    """Parse oracle/invariants.py's ``@invariant("name", kind=...,
+    engines=..., doc=...)`` decorators into plain dicts. ``engines`` is
+    resolved through module-level tuple literals/aliases
+    (CORE_ENGINES / GOSSIP_ENGINES) via the same extractor the
+    telemetry rule uses; None means "not statically resolvable" (a
+    violation — the catalog must be literal)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if not (isinstance(dec, ast.Call)
+                    and _call_root(dec.func) == "invariant"):
+                continue
+            name = (dec.args[0].value
+                    if dec.args and isinstance(dec.args[0], ast.Constant)
+                    and isinstance(dec.args[0].value, str) else None)
+            kw = {k.arg: k.value for k in dec.keywords}
+            kind = (kw["kind"].value
+                    if isinstance(kw.get("kind"), ast.Constant) else None)
+            doc = (kw["doc"].value
+                   if isinstance(kw.get("doc"), ast.Constant)
+                   and isinstance(kw["doc"].value, str) else None)
+            engines = None
+            e = kw.get("engines")
+            if isinstance(e, ast.Tuple):
+                vals = [c.value for c in e.elts
+                        if isinstance(c, ast.Constant)]
+                engines = vals if len(vals) == len(e.elts) else None
+            elif isinstance(e, ast.Name):
+                engines = _tuple_literal(tree, e.id)
+            out.append({"name": name, "line": dec.lineno, "kind": kind,
+                        "engines": engines, "doc": doc})
+    return out
+
+
+def check_invariant_registry(entries, known_engines, tests_src: str) -> list:
+    """The invariant-registry rule on explicit inputs (unit-testable):
+    every registered property declares literal kind/engines/doc and is
+    referenced by a seeded-violation negative test in tests/."""
+    rel = "oracle/invariants.py"
+    out = []
+    if not entries:
+        out.append(Violation(
+            "invariant-registry", rel, 1, "",
+            "no @invariant(...) registrations found — the property "
+            "catalog must be literal @invariant decorators (the lint "
+            "cannot audit a computed registry)",
+        ))
+        return out
+    known = set(known_engines or ())
+    for e in entries:
+        where = e["name"] or f"line {e['line']}"
+        if e["name"] is None:
+            out.append(Violation(
+                "invariant-registry", rel, e["line"], "",
+                "invariant registered with a non-literal name — the "
+                "catalog (and its negative-test cross-check) must be "
+                "statically readable",
+            ))
+            continue
+        if e["kind"] not in _INVARIANT_KINDS:
+            out.append(Violation(
+                "invariant-registry", rel, e["line"], e["name"],
+                f"invariant {where} declares kind={e['kind']!r}; must be "
+                "a literal 'safety' or 'liveness'",
+            ))
+        if not e["engines"] or (known and not set(e["engines"]) <= known):
+            out.append(Violation(
+                "invariant-registry", rel, e["line"], e["name"],
+                f"invariant {where} must declare a literal non-empty "
+                f"engines applicability tuple drawn from {sorted(known)} "
+                f"(got {e['engines']!r}) — a property without declared "
+                "applicability silently goes unchecked on the engines "
+                "it was meant to cover",
+            ))
+        if not e["doc"]:
+            out.append(Violation(
+                "invariant-registry", rel, e["line"], e["name"],
+                f"invariant {where} must carry a literal doc string "
+                "(the property statement + paper citation the DESIGN "
+                "catalog renders)",
+            ))
+        if e["name"] and (f'"{e["name"]}"' not in tests_src
+                          and f"'{e['name']}'" not in tests_src):
+            out.append(Violation(
+                "invariant-registry", rel, e["line"], e["name"],
+                f"invariant {e['name']!r} is not referenced by any "
+                "tests/test_invariant*.py file — every property needs "
+                "a seeded-violation negative test (corrupt one leaf, "
+                "assert exactly this property trips); an untrippable "
+                "property is a rubber stamp",
+            ))
+    return out
+
+
+def _rule_invariant_registry(pkg_root: str) -> list:
+    inv_p = os.path.join(pkg_root, "oracle", "invariants.py")
+    if not os.path.exists(inv_p):
+        return []
+    with open(inv_p) as f:
+        tree = ast.parse(f.read())
+    entries = registry_entries(tree)
+    known = _tuple_literal(tree, "ENGINES") or ()
+    tests_dir = os.path.join(os.path.dirname(pkg_root), "tests")
+    chunks = []
+    if os.path.isdir(tests_dir):
+        for fname in sorted(os.listdir(tests_dir)):
+            # ONLY the invariant test files count: a property name
+            # quoted incidentally elsewhere (an assertion listing the
+            # catalog, a docstring) must not satisfy the
+            # seeded-violation requirement
+            if fname.startswith("test_invariant") and fname.endswith(".py"):
+                with open(os.path.join(tests_dir, fname)) as f:
+                    chunks.append(f.read())
+    return check_invariant_registry(entries, known, "\n".join(chunks))
+
+
 # ---------------------------------------------------------------------------
 # drivers
 
@@ -727,6 +860,7 @@ def lint_package(pkg_root: str) -> list:
             out.append(Violation("parse", rel, e.lineno or 1, "", str(e)))
     out.extend(_rule_ev_drain(pkg_root))
     out.extend(_rule_telemetry_panel(pkg_root))
+    out.extend(_rule_invariant_registry(pkg_root))
     return sorted(out, key=lambda v: (v.rel, v.line, v.rule))
 
 

@@ -65,7 +65,7 @@ def _cache_size(jit_fn) -> int | None:
 
 def run_rounds(ens_step, states, make_args, n_steps: int, *,
                rounds_per_phase: int = 1, heartbeat_fn=None,
-               observe=None) -> EnsembleRun:
+               observe=None, invariants=None) -> EnsembleRun:
     """Drive ``n_steps`` dispatches of a lifted ensemble step.
 
     ``make_args(i)`` returns the tuple of per-step positional arrays
@@ -78,6 +78,14 @@ def run_rounds(ens_step, states, make_args, n_steps: int, *,
     batched state (measurement hook — e.g. per-round mesh snapshots;
     readbacks here are host-side analysis, not part of the program).
 
+    ``invariants`` is an ``oracle.InvariantHook`` (docs/DESIGN.md §12):
+    every ``check_every`` dispatches it runs its jitted property
+    checker on the live batched state and accumulates the ``[S, P]``
+    violation mask on DEVICE — zero host transfers inside the window
+    (the hook's due rows are materialized up front via
+    ``precompute``); read the results back with ``invariants.report()``
+    after the run.
+
     The state buffers are donated each dispatch (the lifted step's
     contract), so callers must not reuse the passed-in ``states``.
     Returns an :class:`EnsembleRun` carrying the compile-count
@@ -85,6 +93,10 @@ def run_rounds(ens_step, states, make_args, n_steps: int, *,
     import jax
 
     n_sims = jax.tree_util.tree_leaves(states)[0].shape[0]
+    if invariants is not None:
+        # no-op if the caller already precomputed (the transfer_guard
+        # pattern: materialize due rows before entering the window)
+        invariants.precompute(n_steps)
     before = _cache_size(ens_step)
     t0 = time.perf_counter()
     for i in range(n_steps):
@@ -92,6 +104,8 @@ def run_rounds(ens_step, states, make_args, n_steps: int, *,
         if heartbeat_fn is not None:
             kw["do_heartbeat"] = bool(heartbeat_fn(i))
         states = ens_step(states, *make_args(i), **kw)
+        if invariants is not None:
+            invariants.on_step(i, states)
         if observe is not None:
             observe(i, states)
     jax.block_until_ready(states)

@@ -1,4 +1,23 @@
-"""Scalar pure-Python oracle nodes — a faithful per-node transcription of
-the reference call stacks (survey §3), used as the golden model for every
-vectorized kernel (survey §4 tier-1 strategy: golden-value equivalence
-tests against a scalar oracle)."""
+"""Oracle package: the two golden models the vectorized engines are
+checked against.
+
+  * scalar oracles (gossipsub/floodsub/randomsub/score modules) — a
+    faithful per-node transcription of the reference call stacks
+    (survey §3), the golden-value equivalence surface (survey §4);
+  * the invariant oracle plane (invariants.py, docs/DESIGN.md §12) —
+    the verification literature's safety/liveness properties
+    (arXiv:2311.08859, arXiv:2507.19013) as vectorized on-device
+    predicates, checked every k rounds inside chaos/ensemble runs.
+"""
+
+from .invariants import (  # noqa: F401
+    ENGINES,
+    REGISTRY,
+    InvariantConfig,
+    InvariantHook,
+    InvariantReport,
+    check_state,
+    due_vector,
+    invariant_names,
+    make_checker,
+)

@@ -16,6 +16,10 @@ Three on-disk shapes are normalized here:
     adds an optional top-level ``"timeline"`` block — the telemetry
     plane's per-round time-series bands (telemetry.timeline_block) — so
     an artifact carries the run's trajectory, not just its endpoint;
+    round 12 adds the optional ``"invariants"`` block (the invariant
+    oracle plane's checked/violated accounting,
+    oracle.InvariantReport.artifact_block — read back through
+    ``BenchRecord.invariants``, :data:`INVARIANTS_OFF` for legacy);
   * **v1 line** — rounds 1–5 bench output: bare
     ``{"metric", "value", "unit", "vs_baseline", ...}``;
   * **driver wrapper** — the committed ``BENCH_r0*.json`` files:
@@ -67,6 +71,15 @@ SIM_KEY_DERIVATION = "fold_in(sim_key, sim_idx)"
 #: answer instead of a KeyError
 TELEMETRY_OFF = {"enabled": False, "rounds_per_row": 1, "rows": 0,
                  "n_sims": 0, "metrics": [], "series": {}}
+
+#: the invariant-oracle defaults every artifact WITHOUT an invariants
+#: block reads back as (every line that predates the oracle plane):
+#: nothing was property-checked — readers (tracestat --json, gates) get
+#: an explicit typed answer, never a KeyError
+INVARIANTS_OFF = {"enabled": False, "engine": None, "properties": [],
+                  "checked": 0, "violated": 0, "n_checks": 0, "n_sims": 0,
+                  "check_every": 0, "rounds_per_step": 1,
+                  "last_checked_round": -1, "violations": []}
 
 
 def ensemble_fingerprint(n_sims: int = 1,
@@ -124,6 +137,10 @@ class BenchRecord:
     #: schema-v3 telemetry block (telemetry.timeline_block); None when
     #: the producing run recorded no panel — read through .timeline
     timeline_raw: dict | None = None
+    #: schema-v3 invariant-oracle block (oracle.InvariantReport
+    #: .artifact_block); None when the run checked nothing — read
+    #: through .invariants
+    invariants_raw: dict | None = None
 
     # -- derived views ----------------------------------------------------
 
@@ -215,6 +232,21 @@ class BenchRecord:
         return bool(self.timeline["enabled"])
 
     @property
+    def invariants(self) -> dict:
+        """The schema-v3 invariants block (checked/violated counts,
+        last-checked round, property catalog). LEGACY artifacts — every
+        line that predates the invariant oracle plane — read back as
+        :data:`INVARIANTS_OFF`; ``invariants["enabled"]`` says whether
+        the producing run was property-checked."""
+        out = dict(INVARIANTS_OFF)
+        out.update(self.invariants_raw or {})
+        return out
+
+    @property
+    def invariants_on(self) -> bool:
+        return bool(self.invariants["enabled"])
+
+    @property
     def permute_sets_per_phase(self) -> int | None:
         """MEASURED halo gather sets per phase (16 rolled permutes each)
         recorded by round-7+ fingerprints; None for legacy artifacts —
@@ -230,7 +262,8 @@ class BenchRecord:
         it)."""
         out = {
             "schema": (max(int(self.schema), SCHEMA_VERSION)
-                       if self.timeline_raw is not None
+                       if (self.timeline_raw is not None
+                           or self.invariants_raw is not None)
                        else int(self.schema)),
             "metric": self.metric,
             "value": self.value,
@@ -242,6 +275,8 @@ class BenchRecord:
             out["fingerprint"] = self.fingerprint
         if self.timeline_raw is not None:
             out["timeline"] = self.timeline_raw
+        if self.invariants_raw is not None:
+            out["invariants"] = self.invariants_raw
         return out
 
 
@@ -255,7 +290,7 @@ def record_from_line(obj: dict, round_index: int | None = None) -> BenchRecord:
     if "metric" not in obj:
         raise ValueError(f"not a bench metric line: keys={sorted(obj)}")
     known = {"schema", "metric", "value", "unit", "vs_baseline",
-             "fingerprint", "timeline"}
+             "fingerprint", "timeline", "invariants"}
     return BenchRecord(
         metric=str(obj["metric"]),
         value=float(obj["value"]),
@@ -266,6 +301,7 @@ def record_from_line(obj: dict, round_index: int | None = None) -> BenchRecord:
         round_index=round_index,
         extras={k: v for k, v in obj.items() if k not in known},
         timeline_raw=obj.get("timeline"),
+        invariants_raw=obj.get("invariants"),
     )
 
 

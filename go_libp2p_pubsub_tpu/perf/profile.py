@@ -255,6 +255,42 @@ def hlo_kernel_census(hlo_text: str) -> dict:
     return {"total": sum(by_op.values()), "by_op": by_op}
 
 
+#: the PRNG impl every committed kernel-census baseline was measured
+#: under (the bench PRNG the gate scripts pin). The census is
+#: PRNG-impl-DEPENDENT: the chaos-off PERF_SMOKE shape compiles to 393
+#: kernels under unsafe_rbg but 376 under the ambient threefry default
+#: — a "376 != 393" reading under the wrong impl is a measurement
+#: error, not a regression.
+GATE_PRNG_IMPL = "unsafe_rbg"
+_CENSUS_PRNG_NOTE = (
+    "the compiled kernel census is PRNG-impl-dependent (chaos-off "
+    "PERF_SMOKE shape: 393 kernels under unsafe_rbg, 376 under "
+    "threefry), so every committed baseline is defined under the bench "
+    "PRNG"
+)
+
+
+def require_gate_prng() -> None:
+    """Hard-fail a census taken under the wrong PRNG impl.
+
+    Every HLO kernel-census gate (perf-smoke, chaos-smoke's
+    elision-when-off equality, telemetry-smoke, oracle-smoke) pins
+    ``unsafe_rbg`` in its main(); calling the census helper from an
+    ambient-PRNG context (a pytest session, a REPL) used to produce a
+    bare '376 != committed 393' mismatch that reads as an image
+    regression. Raise the informative error instead."""
+    import jax
+
+    impl = str(jax.config.jax_default_prng_impl)
+    if impl != GATE_PRNG_IMPL:
+        raise RuntimeError(
+            f"kernel census requested under PRNG impl {impl!r}, but "
+            f"{_CENSUS_PRNG_NOTE}. Pin it first — "
+            f"jax.config.update('jax_default_prng_impl', "
+            f"'{GATE_PRNG_IMPL}') — or run the gate script, which does."
+        )
+
+
 def compiled_phase_kernel_count(n_peers: int, rounds_per_phase: int,
                                 config: str = "default",
                                 msg_slots: int = 64,
@@ -264,12 +300,18 @@ def compiled_phase_kernel_count(n_peers: int, rounds_per_phase: int,
     ``per_round`` — the gate's headline number. ``telemetry`` (a
     telemetry.TelemetryConfig) censuses the TELEMETRY-ON build instead
     (live counters + panel recorder — the `make telemetry-smoke`
-    variant; None is the committed PERF_SMOKE/chaos-smoke build)."""
+    variant; None is the committed PERF_SMOKE/chaos-smoke build).
+
+    Refuses to run under any PRNG impl other than the gate's
+    (:func:`require_gate_prng`) — a census taken under ambient threefry
+    is incomparable to every committed baseline."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from .sweep import PUBS_PER_ROUND, build_bench
+
+    require_gate_prng()
 
     r = max(int(rounds_per_phase), 1)
     st, step, _, _ = build_bench(

@@ -106,7 +106,7 @@ def _publish_schedule(rng, n, rounds, pub_rounds, width=4):
 
 def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
              rounds_per_phase=1, seeds=SMOKE_SEEDS, full=True,
-             telemetry=False):
+             telemetry=False, invariants=False):
     """One flap cell over ``seeds`` sims (one vmapped program per
     router): per-sim gossipsub/floodsub delivery ratios and IWANT
     shares plus their median/IQR bands. Same topology / schedule for
@@ -119,7 +119,16 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
     panel row per round/phase; telemetry/panel.py), reconciles the
     batched panels against the drained counters per sim, and returns
     the raw ``[S, T, n_metrics]`` panels plus a latency-CDF envelope
-    for the ``--timeline`` artifact."""
+    for the ``--timeline`` artifact.
+
+    ``invariants=True`` runs the invariant oracle plane
+    (oracle/invariants.py, docs/DESIGN.md §12) inside the gossipsub
+    cell: every safety property checked every
+    ``InvariantConfig.check_every`` dispatches on device, the
+    ``InvariantReport`` returned as ``out["invariants"]``. The flap
+    generator is active for the whole run, so the delivery-liveness
+    clause is vacuous here by the due contract (the quiet/partition
+    cells in scripts/invariant_report.py exercise it)."""
     from go_libp2p_pubsub_tpu import ensemble, graph
     from go_libp2p_pubsub_tpu.chaos import ChaosConfig
     from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
@@ -154,7 +163,7 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
 
         tcfg = TelemetryConfig(rows=rounds // r)
 
-    def run_gossipsub(g_cfg, tele=None):
+    def run_gossipsub(g_cfg, tele=None, hook=None):
         gs0 = GossipSubState.init(net, 64, g_cfg, score_params=sp, seed=seed,
                                   telemetry=tele)
         gstates = ensemble.batch_states(gs0, s)
@@ -171,7 +180,8 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
 
             return ensemble.run_rounds(ens, gstates, phase_args, rounds // r,
                                        rounds_per_phase=r,
-                                       heartbeat_fn=lambda p: True)
+                                       heartbeat_fn=lambda p: True,
+                                       invariants=hook)
         step = make_gossipsub_step(g_cfg, net, score_params=sp,
                                    telemetry=tele)
         ens = ensemble.lift_step(step)
@@ -180,7 +190,8 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
             return (ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
                     ensemble.tile(pv[i], s))
 
-        return ensemble.run_rounds(ens, gstates, round_args, rounds)
+        return ensemble.run_rounds(ens, gstates, round_args, rounds,
+                                   invariants=hook)
 
     def ratios_of(core):
         return np.asarray(estats.sim_delivery_ratios(
@@ -188,7 +199,22 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
             core.msgs.topic, core.msgs.origin, net.subscribed,
         ))
 
-    grun = run_gossipsub(cfg, tele=tcfg)
+    hook = None
+    if invariants:
+        from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+
+        # phase cadence: checks land at phase boundaries, and the
+        # delivery window scales with the control-latency quantum
+        # (docs/DESIGN.md §12 cadence note)
+        hook = oracle_inv.InvariantHook(
+            "phase" if r > 1 else "gossipsub", net, cfg,
+            oracle_inv.InvariantConfig(
+                check_every=max(8 // r, 1),
+                delivery_window=12 if r == 1 else 24,
+            ),
+            rounds_per_step=r,
+        )
+    grun = run_gossipsub(cfg, tele=tcfg, hook=hook)
     g_ratios = ratios_of(grun.states.core)
     iwant_shares = estats.batched_iwant_shares(grun.states.core.events)
     out = {
@@ -203,6 +229,9 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
         "rounds_per_phase": r,
         "seeds": s,
     }
+    if hook is not None:
+        out["invariants"] = hook.report()
+        out["invariant_compiles"] = hook.compiles
     if telemetry:
         from go_libp2p_pubsub_tpu.telemetry import reconcile_batched
 
@@ -262,9 +291,21 @@ def run_flap(n=SMOKE_N, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=0,
     return out
 
 
+#: partition-cell due-contract constant (oracle/invariants.py; mirrors
+#: the measured recovery arc the smoke already pins): the fault-scoped
+#: degree clauses stay suspended for this many rounds after heal (the
+#: P3 zombie-prune → backoff-clear → re-graft wave lands around
+#: heal+40, tail 56), and the SAME tick arms the recovery clauses —
+#: partition-era messages fully delivered (ttr median 6, far earlier)
+#: and the mesh re-formed. One constant on purpose: a reform deadline
+#: earlier than the grace end would enforce the degree bound while the
+#: grace contract still declares it suspended.
+PARTITION_GRACE_AFTER_HEAL = 44
+
+
 def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
                   window=PARTITION_ROUNDS, tail=PARTITION_TAIL,
-                  seeds=SMOKE_SEEDS, telemetry=False):
+                  seeds=SMOKE_SEEDS, telemetry=False, invariants=False):
     """Partition/heal cell over ``seeds`` sims (one vmapped program):
     scheduled 2-group split with P3 deficit scoring live (cross-group
     mesh edges starve -> pruned during the window; short prune backoff
@@ -370,11 +411,53 @@ def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
             np.asarray(states.mesh), nbr, nbr_ok, groups)
         mesh_series.append((t + 1, counts))
 
+    hook = None
+    if invariants:
+        from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+
+        # at least one CHECK TICK must land at/after the recovery
+        # deadline, or the partition-specific clauses (heal-liveness
+        # delivery, mesh re-formation) never arm while grace keeps the
+        # degree bounds suspended — an all-ok report that checked
+        # nothing this cell exists for. Checks land at multiples of
+        # check_every, so a bare tail >= grace test is not enough when
+        # heal is cadence-misaligned. Refuse rather than rubber-stamp.
+        check_every = 4
+        deadline = heal + PARTITION_GRACE_AFTER_HEAL
+        last_check = (rounds // check_every) * check_every
+        if last_check < deadline:
+            raise ValueError(
+                f"run_partition(invariants=True): the last check tick "
+                f"{last_check} (checks every {check_every} of {rounds} "
+                f"rounds) never reaches the recovery deadline "
+                f"{deadline} = heal + {PARTITION_GRACE_AFTER_HEAL}, so "
+                "the heal-recovery clauses would run vacuously; extend "
+                "tail")
+
+        def due_fn(tick):
+            # the due contract (docs/DESIGN.md §12): pre-partition
+            # publishes are quiet-window due; fault-scoped safety
+            # clauses suspend from the split until the measured re-form
+            # arc completes; partition-era in-mcache messages are due
+            # after the recovery deadline (the papers' heal-liveness)
+            return oracle_inv.due_vector(
+                quiet=(0, start),
+                recover=(heal - 4, heal - 1,
+                         heal + PARTITION_GRACE_AFTER_HEAL),
+                grace=start <= tick < heal + PARTITION_GRACE_AFTER_HEAL,
+            )
+
+        hook = oracle_inv.InvariantHook(
+            "gossipsub", net, cfg,
+            oracle_inv.InvariantConfig(check_every=check_every,
+                                       delivery_window=8),
+            due_fn=due_fn,
+        )
     run = ensemble.run_rounds(
         ens, ensemble.batch_states(st0, s),
         lambda t: (ensemble.tile(po_all[t], s), pt_r, pv_r,
                    ensemble.tile(denies[t], s)),
-        rounds, observe=observe,
+        rounds, observe=observe, invariants=hook,
     )
     st = run.states
 
@@ -424,6 +507,9 @@ def run_partition(n=SMOKE_N, seed=1, start=PARTITION_START,
         "heal": heal,
         "seeds": s,
     }
+    if hook is not None:
+        out["invariants"] = hook.report()
+        out["invariant_compiles"] = hook.compiles
     if telemetry:
         from go_libp2p_pubsub_tpu.telemetry import reconcile_batched
 

@@ -1,0 +1,455 @@
+#!/usr/bin/env python
+"""Invariant oracle gate (``make oracle-smoke``; docs/DESIGN.md §12).
+
+Runs the registered safety/liveness properties (oracle/invariants.py —
+the machine-checkable clauses of the ACL2s GossipSub verification,
+arXiv:2311.08859, and the FloodSub correctness formalization,
+arXiv:2507.19013) inside the repo's canonical degraded-network bands
+and asserts the plane's whole contract:
+
+  1. **conformance** — every applicable property passes on:
+     (a) the chaos-smoke 60%-loss flap band (S=8, one vmapped
+         program; safety properties live, delivery-liveness vacuous by
+         the due contract — the flap generator never goes quiet);
+     (b) the same generator through the phase engine's stacked
+         coalesced wire path (r=4, checks at phase boundaries);
+     (c) the partition/heal scenario (S=8): degree bounds suspend for
+         the declared grace window and must hold again after it, and
+         partition-era in-mcache messages are delivery-due after the
+         post-heal deadline — the papers' heal-liveness clause;
+     (d) a QUIET cell (loss off, S=8, gossipsub + floodsub) where the
+         fresh-publish eventual-delivery clause is non-vacuous
+         end-to-end.
+  2. **one compile, zero host transfers** — the quiet cell's whole run
+     window executes under ``jax.transfer_guard('disallow')`` (due
+     rows precomputed to device, violation masks accumulate on
+     device), and both the lifted step and the invariant checker
+     compile exactly once per cell (cache-size sentinels).
+  3. **overhead ceiling** — warm-vs-warm on the flap cell, same build
+     with and without the hook: checking every
+     ``check_every`` dispatches must cost no more than
+     ORACLE_SMOKE_OVERHEAD (default 0.10 = 10%).
+  4. **elision / census** — invariants are observers: the engine
+     programs are untouched, pinned by the chaos-off compiled-HLO
+     kernel census equaling the committed PERF_SMOKE baseline (the
+     census helper itself now hard-fails under the wrong PRNG impl —
+     the known 376-vs-393 threefry confound).
+
+``ORACLE_SMOKE_UPDATE=1`` rewrites the committed ORACLE_SMOKE.json
+baseline (overhead + property-catalog sentinel) from this run. CPU-only
+by contract, bench PRNG (unsafe_rbg), like the other smoke gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))  # repo root
+if _here not in sys.path:
+    sys.path.insert(1, _here)
+
+import numpy as np  # noqa: E402
+
+BASELINE_NAME = "ORACLE_SMOKE.json"
+#: warm-vs-warm slowdown ceiling for the invariants-on run
+DEFAULT_OVERHEAD = 0.10
+TIMING_REPS = 3
+QUIET_ROUNDS = 48
+QUIET_PUB_AT = (8, 11)   # publish rounds [lo, hi) — after mesh warmup
+QUIET_WINDOW = 12        # delivery window W for the quiet cells
+
+
+def _fmt_report(rep) -> str:
+    vio = rep.violations(limit=8)
+    return (f"{rep.violated}/{rep.checked} property evaluations failed "
+            f"(first: {vio})")
+
+
+def _cell_failures(name: str, out: dict, failures: list) -> None:
+    """Fold one chaos_report cell's invariant results into failures."""
+    rep = out.get("invariants")
+    if rep is None:
+        failures.append(f"{name}: cell ran without the invariant hook")
+        return
+    if not rep.all_ok:
+        failures.append(f"{name}: {_fmt_report(rep)}")
+    if out.get("invariant_compiles") not in (-1, 1):
+        failures.append(
+            f"{name}: invariant checker compiled "
+            f"{out.get('invariant_compiles')} times across the run "
+            "(expected exactly 1)")
+
+
+def run_quiet_cell(n: int, seeds: int, seed: int, engine: str) -> dict:
+    """The quiet (loss-free) conformance cell: publishes after mesh
+    warmup, the whole run declared QUIET, so the fresh-publish
+    eventual-delivery clause is due — and checked — for every message.
+    The run window executes under ``transfer_guard('disallow')``; every
+    input (args, due rows) is materialized on device beforehand."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu import ensemble, graph
+    from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+    from go_libp2p_pubsub_tpu.state import Net, SimState
+
+    from chaos_report import _flap_params, _score_params
+
+    s = int(seeds)
+    rounds = QUIET_ROUNDS
+    topo = graph.random_connect(n, d=4, seed=seed)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    rng = np.random.default_rng(seed)
+    width = 4
+    po = np.full((rounds, width), -1, np.int32)
+    po[QUIET_PUB_AT[0]:QUIET_PUB_AT[1]] = rng.integers(
+        0, n, size=(QUIET_PUB_AT[1] - QUIET_PUB_AT[0], width))
+    pt = np.zeros((rounds, width), np.int32)
+    pv = np.ones((rounds, width), bool)
+
+    if engine == "gossipsub":
+        sp = _score_params()
+        cfg = GossipSubConfig.build(_flap_params(), PeerScoreThresholds(),
+                                    score_enabled=True)
+        st0 = GossipSubState.init(net, 64, cfg, score_params=sp, seed=seed)
+        step = make_gossipsub_step(cfg, net, score_params=sp)
+        ens = ensemble.lift_step(step)
+    elif engine == "floodsub":
+        cfg = None
+        st0 = SimState.init(n, 64, seed=seed, k=net.max_degree)
+        ens = ensemble.lift_floodsub(net)
+    else:
+        raise ValueError(f"quiet cell has no {engine!r} build")
+
+    hook = oracle_inv.InvariantHook(
+        engine, net, cfg,
+        oracle_inv.InvariantConfig(check_every=4,
+                                   delivery_window=QUIET_WINDOW),
+        due_fn=lambda tick: oracle_inv.due_vector(quiet=(0, rounds)),
+    )
+    # everything the window consumes goes to device BEFORE the guard
+    args = [(ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
+             ensemble.tile(pv[i], s)) for i in range(rounds)]
+    states = ensemble.batch_states(st0, s)
+    hook.precompute(rounds)
+    with jax.transfer_guard("disallow"):
+        run = ensemble.run_rounds(ens, states, lambda i: args[i], rounds,
+                                  invariants=hook)
+    rep = hook.report()
+    # non-vacuity: the due clause must actually have covered messages
+    births = np.asarray(
+        (run.states.core if hasattr(run.states, "core")
+         else run.states).msgs.birth)
+    n_due = int(((births >= 0)
+                 & (births + QUIET_WINDOW <= rounds)).sum())
+    return {
+        "engine": engine,
+        "report": rep,
+        "step_compiles": run.compiles,
+        "checker_compiles": hook.compiles,
+        "n_due_messages": n_due,
+    }
+
+
+def measure_overhead(n: int, loss: float, rounds: int, seeds: int,
+                     seed: int) -> dict:
+    """Warm-vs-warm flap cell, identical build, with vs without the
+    invariant hook (the telemetry-smoke timing pattern: state builds
+    and compiles outside the window, min over TIMING_REPS)."""
+    from go_libp2p_pubsub_tpu import ensemble, graph
+    from go_libp2p_pubsub_tpu.chaos import ChaosConfig
+    from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+    from go_libp2p_pubsub_tpu.state import Net
+
+    from chaos_report import _flap_params, _publish_schedule, _score_params
+
+    s = int(seeds)
+    topo = graph.random_connect(n, d=4, seed=seed)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    rng = np.random.default_rng(seed)
+    po, pt, pv = _publish_schedule(rng, n, rounds, pub_rounds=3)
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_flap_params(), PeerScoreThresholds(),
+                                score_enabled=True,
+                                chaos=ChaosConfig(loss_rate=loss))
+    st0 = GossipSubState.init(net, 64, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    ens = ensemble.lift_step(step)
+    args = [(ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
+             ensemble.tile(pv[i], s)) for i in range(rounds)]
+
+    # ONE hook for every on-window: a fresh hook per rep would re-trace
+    # its jit inside the timed loop and read as bogus overhead (the
+    # checker itself dispatches in ~1ms; tracing costs ~1s)
+    hook = oracle_inv.InvariantHook(
+        "gossipsub", net, cfg,
+        oracle_inv.InvariantConfig(check_every=8))
+    hook.precompute(rounds)
+
+    def window(with_hook: bool):
+        if with_hook:
+            # fresh run, same jit: a stale prev-events snapshot would
+            # fabricate events-monotone violations
+            hook.reset()
+        return ensemble.run_rounds(ens, ensemble.batch_states(st0, s),
+                                   lambda i: args[i], rounds,
+                                   invariants=hook if with_hook else None)
+
+    window(True)          # warm both programs (step + checker)
+    window(False)
+    # interleave the reps so slow-box drift hits both sides equally
+    pairs = [(window(True).seconds, window(False).seconds)
+             for _ in range(TIMING_REPS)]
+    t_on = min(p[0] for p in pairs)
+    t_off = min(p[1] for p in pairs)
+    return {
+        "all_ok": hook.report().all_ok,   # the last timed rep's masks
+        "t_on": t_on,
+        "t_off": t_off,
+        "overhead_frac": round(t_on / t_off - 1.0, 4),
+        "rate_on": round(s * rounds / t_on, 2),
+        "rate_off": round(s * rounds / t_off, 2),
+    }
+
+
+def emit_artifact(reports: dict, seeds: int) -> dict:
+    """One schema-v3 line carrying the ``invariants`` block; round-trip
+    checked (and the legacy default asserted) through perf.artifacts."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        INVARIANTS_OFF,
+        BenchRecord,
+        chaos_fingerprint,
+        dump_record,
+        ensemble_fingerprint,
+        record_from_line,
+    )
+
+    checked = sum(r.checked for r in reports.values())
+    violated = sum(r.violated for r in reports.values())
+    flap = reports["flap"]
+    rec = BenchRecord(
+        metric="oracle_invariant_conformance",
+        value=round(1.0 - (violated / checked if checked else 0.0), 6),
+        unit="ratio",
+        vs_baseline=0.0,
+        schema=3,
+        fingerprint={"chaos": chaos_fingerprint(),
+                     "ensemble": ensemble_fingerprint(seeds)},
+        extras={"cells": {k: {"checked": r.checked, "violated": r.violated}
+                          for k, r in reports.items()}},
+        invariants_raw=flap.artifact_block(),
+    )
+    line = dump_record(rec)
+    print(line, flush=True)
+    errors = []
+    back = record_from_line(json.loads(line))
+    if not back.invariants.get("enabled") or (
+            back.invariants.get("properties") != list(flap.names)):
+        errors.append("artifact: invariants block lost on round-trip")
+    legacy = record_from_line({"metric": "x", "value": 1.0})
+    if legacy.invariants != INVARIANTS_OFF:
+        errors.append("artifact: legacy line did not read back "
+                      "INVARIANTS_OFF")
+    return {"record": rec, "errors": errors}
+
+
+def check_baseline(root: str, res: dict) -> list[str]:
+    path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(path) or os.environ.get("ORACLE_SMOKE_UPDATE"):
+        return []
+    with open(path) as f:
+        base = json.load(f)
+    out = []
+    committed = base.get("properties") or []
+    missing = [p for p in committed if p not in res["properties"]]
+    if missing:
+        out.append(
+            f"property catalog shrank: committed properties {missing} are "
+            f"no longer registered ({BASELINE_NAME}; deregistering a "
+            "verified property needs an explicit ORACLE_SMOKE_UPDATE=1 "
+            "rebaseline)")
+    return out
+
+
+def write_baseline(root: str, res: dict) -> str:
+    path = os.path.join(root, BASELINE_NAME)
+    doc = {
+        "schema": 1,
+        "note": ("invariant-oracle smoke baseline (scripts/"
+                 "invariant_report.py); ORACLE_SMOKE_UPDATE=1 rewrites. "
+                 "rate_* are S x rounds aggregate sim-rounds/s on the "
+                 "gate machine; properties is the registered catalog "
+                 "sentinel (a property can only leave it deliberately)."),
+        **{k: res[k] for k in (
+            "n_peers", "rounds", "seeds", "check_every", "n_properties",
+            "properties", "overhead_frac", "rate_on", "rate_off")},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="exit non-zero on any gate failure")
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--seeds", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-census", action="store_true",
+                    help="skip the chaos-off kernel-census gate")
+    args = ap.parse_args(argv)
+
+    # CPU-only, bench PRNG, persistent compile cache — the chaos-smoke
+    # gate policy (the census is PRNG-impl-dependent: 393 under
+    # unsafe_rbg, 376 under threefry; perf/profile.py hard-fails on the
+    # wrong impl now)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    root = repo_root()
+    enable_persistent_cache(os.path.join(root, ".jax_cache"))
+
+    from chaos_report import (
+        FLAP_LOSS,
+        FLAP_ROUNDS,
+        SMOKE_N,
+        SMOKE_SEEDS,
+        check_census,
+        run_flap,
+        run_partition,
+    )
+
+    n = args.n or SMOKE_N
+    seeds = args.seeds or SMOKE_SEEDS
+    failures: list[str] = []
+    reports = {}
+
+    # (a) the 60%-loss flap band, per-round engine
+    flap = run_flap(n=n, loss=FLAP_LOSS, rounds=FLAP_ROUNDS, seed=args.seed,
+                    seeds=seeds, full=False, invariants=True)
+    _cell_failures("flap", flap, failures)
+    reports["flap"] = flap["invariants"]
+
+    # (b) the same generator through the phase engine (stacked wire)
+    flap_phase = run_flap(n=n, loss=FLAP_LOSS, rounds=FLAP_ROUNDS,
+                          seed=args.seed, rounds_per_phase=4, seeds=seeds,
+                          full=False, invariants=True)
+    _cell_failures("flap-phase4", flap_phase, failures)
+    reports["flap_phase4"] = flap_phase["invariants"]
+
+    # (c) partition/heal: grace + heal-liveness due clauses live
+    part = run_partition(n=n, seed=args.seed + 1, seeds=seeds,
+                         invariants=True)
+    _cell_failures("partition", part, failures)
+    reports["partition"] = part["invariants"]
+
+    # (d) quiet cells: eventual delivery non-vacuous, guarded window
+    for engine in ("gossipsub", "floodsub"):
+        q = run_quiet_cell(n, seeds, args.seed + 2, engine)
+        rep = q["report"]
+        reports[f"quiet_{engine}"] = rep
+        if not rep.all_ok:
+            failures.append(f"quiet-{engine}: {_fmt_report(rep)}")
+        if q["step_compiles"] not in (-1, 1):
+            failures.append(
+                f"quiet-{engine}: lifted step compiled "
+                f"{q['step_compiles']} times under the guarded window "
+                "(expected exactly 1)")
+        if q["checker_compiles"] not in (-1, 1):
+            failures.append(
+                f"quiet-{engine}: invariant checker compiled "
+                f"{q['checker_compiles']} times (expected exactly 1)")
+        if q["n_due_messages"] <= 0:
+            failures.append(
+                f"quiet-{engine}: no message was delivery-due — the "
+                "liveness clause ran vacuously in the cell built to "
+                "exercise it")
+
+    # overhead ceiling (warm-vs-warm, flap shape)
+    ov = measure_overhead(n, FLAP_LOSS, FLAP_ROUNDS, seeds, args.seed)
+    ceiling = float(os.environ.get("ORACLE_SMOKE_OVERHEAD",
+                                   DEFAULT_OVERHEAD))
+    if not ov["all_ok"]:
+        failures.append("overhead cell recorded property violations — "
+                        "the timed run must be conformant too")
+    if ov["overhead_frac"] > ceiling:
+        failures.append(
+            f"overhead: invariant checking ran "
+            f"{100 * ov['overhead_frac']:.1f}% slower than the same run "
+            f"without the hook (ceiling {100 * ceiling:.0f}%; "
+            f"{ov['t_on']:.3f}s vs {ov['t_off']:.3f}s)")
+
+    # elision: the engine programs are untouched — chaos-off census
+    # still equals the committed PERF_SMOKE baseline
+    if not args.no_census:
+        census = check_census()
+        print(json.dumps({"chaos_off_kernel_census": census}), flush=True)
+        if not census["equal"]:
+            failures.append(
+                f"chaos-off kernel census {census['total']} != committed "
+                f"PERF_SMOKE baseline {census['committed']} — the oracle "
+                "plane must not touch the engine programs")
+
+    art = emit_artifact(reports, seeds)
+    failures += art["errors"]
+
+    flap_rep = reports["flap"]
+    res = {
+        "n_peers": n,
+        "rounds": FLAP_ROUNDS,
+        "seeds": seeds,
+        "check_every": flap_rep.check_every,
+        "n_properties": len(flap_rep.names),
+        "properties": list(flap_rep.names),
+        "overhead_frac": ov["overhead_frac"],
+        "rate_on": ov["rate_on"],
+        "rate_off": ov["rate_off"],
+    }
+    failures += check_baseline(root, res)
+    if os.environ.get("ORACLE_SMOKE_UPDATE") and not failures:
+        print(f"wrote {write_baseline(root, res)}")
+
+    summary = {
+        "oracle_smoke": "PASS" if not failures else "FAIL",
+        "cells": {k: {"checked": r.checked, "violated": r.violated,
+                      "n_checks": r.n_checks}
+                  for k, r in reports.items()},
+        "n_properties": res["n_properties"],
+        "overhead_frac": res["overhead_frac"],
+        "failures": failures,
+    }
+    if args.smoke and failures:
+        for f in failures:
+            print(f"oracle-smoke FAIL: {f}", file=sys.stderr)
+        print(json.dumps(summary))
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,14 @@ plus a ``caveats`` list of stable flag strings (``phase_cadence``,
 ``caveat_notes`` — so gates and scripts/run_report.py consume the
 accounting caveats structurally instead of re-parsing report text.
 
-Usage: python scripts/tracestat.py TRACEFILE [--json]
+``--artifact PATH`` additionally reads the run's schema-v3 bench
+artifact and reports its ``invariants`` block (the invariant oracle
+plane's checked/violated counts and last-checked round,
+docs/DESIGN.md §12) alongside the trace accounting; legacy artifacts
+— every line that predates the oracle plane — read back
+``INVARIANTS_OFF`` (enabled=false), never a KeyError.
+
+Usage: python scripts/tracestat.py TRACEFILE [--json] [--artifact RUN.json]
 """
 
 from __future__ import annotations
@@ -186,14 +193,34 @@ def summarize(events) -> dict:
     }
 
 
+def artifact_invariants(path: str) -> dict:
+    """The ``invariants`` block of a bench artifact's last metric line
+    (perf.artifacts readers; INVARIANTS_OFF for legacy lines)."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    # a multi-line artifact may mix checked and unchecked cells — the
+    # block of the last line that carries one wins, else the typed OFF
+    for rec in reversed(recs):
+        if rec.invariants_on:
+            return rec.invariants
+    return recs[-1].invariants
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("tracefile")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     ap.add_argument("--format", choices=("auto", "json", "pb"), default="auto")
+    ap.add_argument("--artifact", metavar="RUN.json",
+                    help="bench artifact of the same run: report its "
+                         "schema-v3 invariants block (legacy artifacts "
+                         "read back INVARIANTS_OFF)")
     args = ap.parse_args()
 
     stats = summarize(read_events(args.tracefile, args.format))
+    if args.artifact:
+        stats["invariants"] = artifact_invariants(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -219,6 +246,19 @@ def main():
         )
     if stats.get("caveats"):
         print("caveats: " + ", ".join(stats["caveats"]))
+    if "invariants" in stats:
+        iv = stats["invariants"]
+        if iv.get("enabled"):
+            print(
+                f"invariants: {iv['checked']} property evaluations, "
+                f"{iv['violated']} violated, last checked round "
+                f"{iv['last_checked_round']} "
+                f"({len(iv.get('properties', []))} properties, engine "
+                f"{iv.get('engine')})"
+            )
+        else:
+            print("invariants: INVARIANTS_OFF (artifact predates the "
+                  "oracle plane or the run checked nothing)")
 
 
 if __name__ == "__main__":

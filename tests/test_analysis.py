@@ -293,6 +293,62 @@ def test_telemetry_panel_rule_on_repo_source():
     assert simlint._rule_telemetry_panel(PKG) == []
 
 
+def test_invariant_registry_rule_negatives():
+    """The invariant-registry rule fires on every broken declaration
+    shape: missing/unknown engines, bad kind, missing doc, and a
+    property no tests/ file references (the untrippable-property
+    failure mode), plus an unparseable (computed) registry."""
+    known = ("gossipsub", "phase", "floodsub", "randomsub")
+    good = {"name": "mesh-ok", "line": 3, "kind": "safety",
+            "engines": ["gossipsub", "phase"], "doc": "mesh ⊆ topology"}
+    tests_src = 'CORRUPTIONS = [("mesh-ok", corrupt_mesh)]'
+    assert simlint.check_invariant_registry([good], known, tests_src) == []
+    # no declared applicability
+    vs = simlint.check_invariant_registry(
+        [{**good, "engines": None}], known, tests_src)
+    assert any("applicability" in v.msg for v in vs)
+    vs = simlint.check_invariant_registry(
+        [{**good, "engines": []}], known, tests_src)
+    assert any("applicability" in v.msg for v in vs)
+    # an engine outside the catalog
+    vs = simlint.check_invariant_registry(
+        [{**good, "engines": ["gossipsub", "bitcoin"]}], known, tests_src)
+    assert any("applicability" in v.msg for v in vs)
+    # kind must be a literal safety|liveness
+    vs = simlint.check_invariant_registry(
+        [{**good, "kind": "vibes"}], known, tests_src)
+    assert any("safety" in v.msg for v in vs)
+    # missing doc citation
+    vs = simlint.check_invariant_registry(
+        [{**good, "doc": None}], known, tests_src)
+    assert any("doc" in v.msg for v in vs)
+    # registered but untested — the rule the issue pins
+    vs = simlint.check_invariant_registry([good], known, "no mention")
+    assert any("seeded-violation" in v.msg for v in vs)
+    # computed/empty registry is itself a violation
+    vs = simlint.check_invariant_registry([], known, tests_src)
+    assert any("catalog" in v.msg for v in vs)
+    assert all(v.rule == "invariant-registry" for v in vs)
+
+
+def test_invariant_registry_rule_on_repo_source():
+    """The in-tree catalog satisfies the rule: every @invariant call
+    parses to a literal declaration (alias tuples resolved), and every
+    name has a seeded-violation reference in tests/."""
+    import ast
+
+    inv_p = os.path.join(PKG, "oracle", "invariants.py")
+    with open(inv_p) as f:
+        tree = ast.parse(f.read())
+    entries = simlint.registry_entries(tree)
+    assert len(entries) >= 12
+    names = [e["name"] for e in entries]
+    assert "mesh-degree-bounds" in names and "eventual-delivery" in names
+    for e in entries:
+        assert e["engines"], e
+    assert simlint._rule_invariant_registry(PKG) == []
+
+
 def test_allowlist_filters_by_qual(tmp_path):
     vs = lint("""
         def drain(state):

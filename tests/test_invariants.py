@@ -1,0 +1,667 @@
+"""Invariant-oracle tests (oracle/invariants.py; docs/DESIGN.md §12).
+
+Two halves, mirroring tests/test_analysis.py's contract for the lint
+plane: every registered property must PASS on clean runs of all four
+engines (positive — the oracle is not crying wolf), and every property
+must be TRIPPED by its own seeded violation — corrupt one leaf, assert
+EXACTLY that property fails (negative — the oracle is not a rubber
+stamp). The simlint ``invariant-registry`` rule cross-checks that every
+registered name appears in this file's literal corruption catalog.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import checkpoint, ensemble, graph
+from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
+from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+    make_gossipsub_phase_step,
+)
+from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+from go_libp2p_pubsub_tpu.oracle import invariants as inv
+from go_libp2p_pubsub_tpu.state import Net, SimState
+
+N = 48
+M = 64
+ROUNDS = 24
+PUB_AT = (2, 5)      # publish rounds [lo, hi)
+W = 12               # delivery window for the quiet due clause
+
+
+def _params():
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+
+    return GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1,
+                           history_length=6, history_gossip=4)
+
+
+def _score_params():
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+
+    return bench_score_params("default", 1)[1]
+
+
+def _schedule(rounds=ROUNDS, seed=0, width=4, pub_at=PUB_AT):
+    rng = np.random.default_rng(seed)
+    po = np.full((rounds, width), -1, np.int32)
+    po[pub_at[0]:pub_at[1]] = rng.integers(0, N, size=(
+        pub_at[1] - pub_at[0], width))
+    pt = np.zeros((rounds, width), np.int32)
+    pv = np.ones((rounds, width), bool)
+    return po, pt, pv
+
+
+def _net(seed=0):
+    topo = graph.random_connect(N, d=4, seed=seed)
+    subs = graph.subscribe_all(N, 1)
+    return Net.build(topo, subs)
+
+
+def _run_gossip(net, rounds=ROUNDS, seed=0):
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_params(), PeerScoreThresholds(),
+                                score_enabled=True)
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    po, pt, pv = _schedule(rounds, seed)
+    for t in range(rounds):
+        st = step(st, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                  jnp.asarray(pv[t]))
+    return cfg, st
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _net()
+
+
+@pytest.fixture(scope="module")
+def lived_in(net):
+    """A post-run gossipsub (cfg, state): mesh formed, messages fully
+    delivered, mcache populated. The checker never donates, so tests
+    may read (and .at[].set-copy) this tree freely."""
+    return _run_gossip(net)
+
+
+def _check(net, state, cfg=None, engine="gossipsub", due=None,
+           prev_events=None, window=W):
+    icfg = inv.InvariantConfig(delivery_window=window)
+    names = inv.invariant_names(engine)
+    ok = np.asarray(inv.check_state(engine, net, state, cfg, icfg,
+                                    prev_events=prev_events, due=due))
+    return dict(zip(names, ok.tolist()))
+
+
+QUIET = inv.due_vector(quiet=(0, ROUNDS))
+
+
+# ---------------------------------------------------------------------------
+# positive: clean runs of all four engines pass every property
+
+
+def test_clean_gossipsub_passes_all(net, lived_in):
+    cfg, st = lived_in
+    res = _check(net, st, cfg, due=QUIET)
+    assert all(res.values()), {k: v for k, v in res.items() if not v}
+    # the quiet due clause was non-vacuous: validated publishes existed
+    # and aged past the window
+    births = np.asarray(st.core.msgs.birth)
+    assert ((births >= 0) & (births + W <= ROUNDS)).any()
+
+
+def test_clean_floodsub_passes_all(net):
+    st = SimState.init(N, M, seed=0, k=net.max_degree)
+    po, pt, pv = _schedule()
+    for t in range(ROUNDS):
+        st = floodsub_step(net, st, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                           jnp.asarray(pv[t]))
+    res = _check(net, st, engine="floodsub", due=QUIET)
+    assert all(res.values()), {k: v for k, v in res.items() if not v}
+
+
+def test_clean_randomsub_passes_all(net):
+    st = SimState.init(N, M, seed=0, k=net.max_degree)
+    step = make_randomsub_step(net)
+    po, pt, pv = _schedule()
+    for t in range(ROUNDS):
+        st = step(st, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                  jnp.asarray(pv[t]))
+    res = _check(net, st, engine="randomsub", due=QUIET)
+    assert all(res.values()), {k: v for k, v in res.items() if not v}
+
+
+@pytest.mark.slow
+def test_clean_phase_passes_all(net):
+    """Phase engine (stacked coalesced wire): checks at phase
+    boundaries; the delivery window scales with the phase-cadence
+    control-latency quantum (docs/DESIGN.md §12)."""
+    rounds, r = 40, 4
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_params(), PeerScoreThresholds(),
+                                score_enabled=True)
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+    po, pt, pv = _schedule(rounds, seed=0, pub_at=(8, 11))
+    for p in range(rounds // r):
+        sl = slice(p * r, (p + 1) * r)
+        st = step(st, jnp.asarray(po[sl]), jnp.asarray(pt[sl]),
+                  jnp.asarray(pv[sl]), do_heartbeat=True)
+    res = _check(net, st, cfg, engine="phase",
+                 due=inv.due_vector(quiet=(0, rounds)), window=24)
+    assert all(res.values()), {k: v for k, v in res.items() if not v}
+
+
+# ---------------------------------------------------------------------------
+# negative: every property is tripped by its own seeded violation
+#
+# Each corruption touches one leaf (plus, where the property is about a
+# relation, the minimal second input: a doctored net, a due vector, a
+# prev snapshot) and declares the EXACT failure set it expects — the
+# target property, plus knock-ons only where the corruption necessarily
+# violates a second property's statement too.
+
+
+def _clear_bit_in(words_row, m):
+    """Index of a bit < m that is CLEAR in a packed [W] u32 row."""
+    bits = np.unpackbits(
+        np.asarray(words_row, np.uint32).view(np.uint8), bitorder="little")
+    for i in range(m):
+        if not bits[i]:
+            return i
+    raise AssertionError("no clear bit to corrupt with")
+
+
+def _mesh_edge(st):
+    """(i, s, k) of some set mesh bit."""
+    idx = np.argwhere(np.asarray(st.mesh))
+    assert idx.size, "lived-in state has an empty mesh"
+    return tuple(int(v) for v in idx[0])
+
+
+def _corrupt_msgtable(net, cfg, st):
+    msgs = st.core.msgs
+    slot = int(np.argwhere(np.asarray(msgs.valid))[0][0])
+    msgs = msgs.replace(ignored=msgs.ignored.at[slot].set(True))
+    return net, st.replace(core=st.core.replace(msgs=msgs)), {}
+
+
+def _corrupt_fwd(net, cfg, st):
+    dlv = st.core.dlv
+    bit = _clear_bit_in(np.asarray(dlv.have)[0], M)
+    w, b = bit // 32, np.uint32(1) << np.uint32(bit % 32)
+    dlv = dlv.replace(fwd=dlv.fwd.at[0, w].set(dlv.fwd[0, w] | b))
+    return net, st.replace(core=st.core.replace(dlv=dlv)), {}
+
+
+def _corrupt_first_edge(net, cfg, st):
+    # two first-arrival edges for one (peer, msg) — and both in have,
+    # so only the at-most-one clause trips
+    dlv = st.core.dlv
+    slot = int(np.argwhere(np.asarray(st.core.msgs.valid))[0][0])
+    w, b = slot // 32, np.uint32(1) << np.uint32(slot % 32)
+    have = dlv.have.at[0, w].set(dlv.have[0, w] | b)
+    fe = dlv.fe_words
+    fe = fe.at[0, 0, w].set(fe[0, 0, w] | b)
+    fe = fe.at[0, 1, w].set(fe[0, 1, w] | b)
+    return net, st.replace(core=st.core.replace(
+        dlv=dlv.replace(have=have, fe_words=fe))), {}
+
+
+def _corrupt_events(net, cfg, st):
+    return net, st, {"prev_events": np.asarray(st.core.events) + 1}
+
+
+def _corrupt_delivery(net, cfg, st):
+    # un-deliver one validated, subscribed, non-origin receipt and make
+    # the quiet clause due for it
+    msgs = st.core.msgs
+    slot = int(np.argwhere(np.asarray(msgs.valid))[0][0])
+    origin = int(np.asarray(msgs.origin)[slot])
+    peer = (origin + 1) % N
+    dlv = st.core.dlv
+    dlv = dlv.replace(first_round=dlv.first_round.at[peer, slot].set(-1))
+    return net, st.replace(core=st.core.replace(dlv=dlv)), {"due": QUIET}
+
+
+def _corrupt_self_graft(net, cfg, st):
+    # a self-loop edge in the doctored topology, GRAFT-targeted
+    i, s, k = _mesh_edge(st)
+    net2 = net.replace(nbr=net.nbr.at[i, k].set(i))
+    st2 = st.replace(graft_out=st.graft_out.at[i, s, k].set(True))
+    return net2, st2, {}
+
+
+def _corrupt_topology(net, cfg, st):
+    # a mesh member goes down without the dead-peer cleanup
+    i, s, k = _mesh_edge(st)
+    j = int(np.asarray(net.nbr)[i, k])
+    return net, st.replace(up=st.up.at[j].set(False)), {}
+
+
+def _corrupt_subscription(net, cfg, st):
+    # the far end of a mesh edge degrades to /floodsub/1.0.0 — a
+    # floodsub-only peer can never be a mesh member. Its own slots stop
+    # being mesh-capable too, so every mesh bit it holds trips the same
+    # property (still exactly one property).
+    i, s, k = _mesh_edge(st)
+    j = int(np.asarray(net.nbr)[i, k])
+    net2 = net.replace(protocol=net.protocol.at[j].set(0))
+    return net2, st, {}
+
+
+def _corrupt_degree(net, cfg, st):
+    # strip peer 0's mesh below Dlo while eligible candidates remain
+    st2 = st.replace(mesh=st.mesh.at[0].set(False))
+    return net, st2, {}
+
+
+def _corrupt_graft_backoff(net, cfg, st):
+    i, s, k = _mesh_edge(st)
+    tick = int(np.asarray(st.core.tick))
+    st2 = st.replace(
+        graft_out=st.graft_out.at[i, s, k].set(True),
+        backoff_present=st.backoff_present.at[i, s, k].set(True),
+        backoff_expire=st.backoff_expire.at[i, s, k].set(tick + 10),
+    )
+    return net, st2, {}
+
+
+def _corrupt_graylist(net, cfg, st):
+    i, s, k = _mesh_edge(st)
+    return net, st.replace(scores=st.scores.at[i, k].set(-5.0)), {}
+
+
+def _corrupt_mcache(net, cfg, st):
+    bit = _clear_bit_in(np.asarray(st.core.dlv.have)[0], M)
+    w, b = bit // 32, np.uint32(1) << np.uint32(bit % 32)
+    return net, st.replace(
+        mcache=st.mcache.at[0, 0, w].set(st.mcache[0, 0, w] | b)), {}
+
+
+def _corrupt_score_counter(net, cfg, st):
+    sc = st.score
+    return net, st.replace(score=sc.replace(
+        fmd=sc.fmd.at[0, 0, 0].set(-1.0))), {}
+
+
+def _corrupt_backoff_presence(net, cfg, st):
+    # an unexpired backoff whose presence flag is missing
+    i, s, k = _mesh_edge(st)
+    tick = int(np.asarray(st.core.tick))
+    st2 = st.replace(
+        backoff_expire=st.backoff_expire.at[i, s, k].set(tick + 50),
+        backoff_present=st.backoff_present.at[i, s, k].set(False),
+    )
+    return net, st2, {}
+
+
+def _corrupt_backoff_stuck(net, cfg, st):
+    # presence surviving far past expiry + slack + a full clear period
+    i, s, k = _mesh_edge(st)
+    st2 = st.replace(
+        backoff_expire=st.backoff_expire.at[i, s, k].set(1),
+        backoff_present=st.backoff_present.at[i, s, k].set(True),
+    )
+    return net, st2, {}
+
+
+def _corrupt_promise(net, cfg, st):
+    return net, st.replace(promise_mid=st.promise_mid.at[0, 0].set(M + 3)), {}
+
+
+def _corrupt_reform(net, cfg, st):
+    # post-heal deadline passed, mesh still empty, candidates available;
+    # grace=1 keeps the ordinary degree property suspended so ONLY the
+    # heal-liveness clause trips
+    tick = int(np.asarray(st.core.tick))
+    due = inv.due_vector(recover=(0, 5, tick - 1), grace=True)
+    return net, st.replace(mesh=st.mesh.at[0].set(False)), {"due": due}
+
+
+CORRUPTIONS = [
+    ("msgtable-wf", _corrupt_msgtable),
+    ("fwd-subset-have", _corrupt_fwd),
+    ("first-edge-wf", _corrupt_first_edge),
+    ("events-monotone", _corrupt_events),
+    ("eventual-delivery", _corrupt_delivery),
+    ("no-self-mesh", _corrupt_self_graft),
+    ("mesh-in-topology", _corrupt_topology),
+    ("mesh-subscribed", _corrupt_subscription),
+    ("mesh-degree-bounds", _corrupt_degree),
+    ("no-graft-under-backoff", _corrupt_graft_backoff),
+    ("graylist-not-in-mesh", _corrupt_graylist),
+    ("mcache-subset-seen", _corrupt_mcache),
+    ("score-counters-wf", _corrupt_score_counter),
+    ("backoff-wf", _corrupt_backoff_presence),
+    ("backoff-clears", _corrupt_backoff_stuck),
+    ("promise-wf", _corrupt_promise),
+    ("mesh-reform-after-heal", _corrupt_reform),
+]
+
+
+@pytest.mark.parametrize("name,corrupt",
+                         CORRUPTIONS, ids=[c[0] for c in CORRUPTIONS])
+def test_seeded_violation_trips_exact_property(net, lived_in, name, corrupt):
+    cfg, st = lived_in
+    net2, st2, kw = corrupt(net, cfg, st)
+    res = _check(net2, st2, cfg, **kw)
+    failed = {k for k, v in res.items() if not v}
+    assert failed == {name}, (
+        f"corrupting for {name!r} tripped {sorted(failed)}")
+
+
+def test_word_padding_violation_trips():
+    """word-padding-wf needs a capacity that does not fill its words
+    (M=48 leaves 16 padding bits); a set padding bit trips exactly it."""
+    net = _net()
+    st = SimState.init(N, 48, seed=0, k=net.max_degree)
+    res = _check(net, st, engine="floodsub")
+    assert all(res.values())
+    pad_bit = np.uint32(1) << np.uint32(17)   # bit 49 of word 1
+    dlv = st.dlv
+    st2 = st.replace(dlv=dlv.replace(
+        have=dlv.have.at[0, 1].set(dlv.have[0, 1] | pad_bit)))
+    res = _check(net, st2, engine="floodsub")
+    failed = {k for k, v in res.items() if not v}
+    assert failed == {"word-padding-wf"}
+
+
+def test_grace_suspends_degree_bounds(net, lived_in):
+    """The fault-scope contract: the same degree violation that trips
+    outside grace is suspended inside it (the clause the papers scope
+    out while links are down)."""
+    cfg, st = lived_in
+    _, st2, _ = _corrupt_degree(net, cfg, st)
+    assert not _check(net, st2, cfg)["mesh-degree-bounds"]
+    graced = _check(net, st2, cfg, due=inv.due_vector(grace=True))
+    assert graced["mesh-degree-bounds"]
+
+
+# ---------------------------------------------------------------------------
+# registry / config surface
+
+
+def test_registry_declares_engines_and_docs():
+    assert len(inv.REGISTRY) >= 12
+    for name, prop in inv.REGISTRY.items():
+        assert prop.kind in ("safety", "liveness"), name
+        assert prop.engines and set(prop.engines) <= set(inv.ENGINES), name
+        assert prop.doc and len(prop.doc) > 40, (
+            f"{name} doc is not a property statement")
+    # the catalog as a whole is anchored in the two verification papers
+    docs = " ".join(p.doc for p in inv.REGISTRY.values())
+    assert "2311.08859" in docs and "2507.19013" in docs
+    core = set(inv.invariant_names("floodsub"))
+    assert core == set(inv.invariant_names("randomsub"))
+    assert core < set(inv.invariant_names("gossipsub"))
+    assert set(inv.invariant_names("gossipsub")) == set(
+        inv.invariant_names("phase"))
+
+
+def test_invariant_config_validation():
+    with pytest.raises(inv.InvariantConfigError):
+        inv.InvariantConfig(delivery_window=0).validate()
+    with pytest.raises(inv.InvariantConfigError):
+        inv.InvariantConfig(check_every=0).validate()
+    with pytest.raises(inv.InvariantConfigError):
+        inv.InvariantConfig(names=("no-such-property",)).validate()
+    sub = inv.InvariantConfig(names=("fwd-subset-have",))
+    sub.validate()
+    assert inv.invariant_names("gossipsub", sub.names) == (
+        "fwd-subset-have",)
+    # a subset that leaves NO property applicable to the engine fails
+    # with the real reason, not a jnp.stack([]) trace error
+    net = _net()
+    st = SimState.init(N, M, seed=0, k=net.max_degree)
+    with pytest.raises(inv.InvariantConfigError, match="empty"):
+        inv.check_state("floodsub", net, st,
+                        inv=inv.InvariantConfig(names=("no-self-mesh",)))
+
+
+def test_due_vector_layout():
+    d = inv.due_vector()
+    assert d.tolist() == [-1, -1, -1, -1, -1, 0]
+    d = inv.due_vector(quiet=(3, 9), recover=(5, 7, 40), grace=True)
+    assert d.tolist() == [3, 9, 5, 7, 40, 1]
+
+
+def test_check_state_rejects_bare_simstate_for_mesh_engine(net):
+    st = SimState.init(N, M, seed=0, k=net.max_degree)
+    with pytest.raises(ValueError):
+        inv.check_state("gossipsub", net, st)
+
+
+# ---------------------------------------------------------------------------
+# batched checker: vmap parity + the runner hook
+
+
+def test_batched_checker_matches_per_sim(net):
+    """[S, P] rows of the vmapped checker equal per-sim eager checks
+    (threefry — the ambient default here — vmaps elementwise; bools
+    are exact either way)."""
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_params(), PeerScoreThresholds(),
+                                score_enabled=True)
+    st0 = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    base_key = st0.core.key
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    ens = ensemble.lift_step(step)
+    s = 3
+    po, pt, pv = _schedule(rounds=12)
+    states = ensemble.batch_states(st0, s)
+    for t in range(12):
+        states = ens(states, ensemble.tile(po[t], s), ensemble.tile(pt[t], s),
+                     ensemble.tile(pv[t], s))
+    chk, names = inv.make_checker("gossipsub", net, cfg, batched=True)
+    due = jnp.asarray(QUIET)
+    prev = states.core.events
+    got = np.asarray(chk(states, prev, due))
+    assert got.shape == (s, len(names))
+    for i in range(s):
+        one = ensemble.unbatch(states, i)
+        want = np.asarray(inv.check_state(
+            "gossipsub", net, one, cfg,
+            prev_events=np.asarray(states.core.events)[i], due=QUIET))
+        assert (got[i] == want).all(), f"sim {i} diverges"
+    assert got.all()
+
+
+def test_hook_runs_inside_ensemble_runner(net):
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_params(), PeerScoreThresholds(),
+                                score_enabled=True)
+    st0 = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    ens = ensemble.lift_step(step)
+    s, rounds = 2, 16
+    po, pt, pv = _schedule(rounds)
+    hook = inv.InvariantHook(
+        "gossipsub", net, cfg,
+        inv.InvariantConfig(check_every=4),
+        due_fn=lambda tick: inv.due_vector(quiet=(0, rounds)))
+    run = ensemble.run_rounds(
+        ens, ensemble.batch_states(st0, s),
+        lambda i: (ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
+                   ensemble.tile(pv[i], s)),
+        rounds, invariants=hook)
+    rep = hook.report()
+    assert rep.ticks == (4, 8, 12, 16)
+    assert rep.ok.shape == (4, s, len(rep.names))
+    assert rep.all_ok and rep.violated == 0
+    assert rep.checked == 4 * s * len(rep.names)
+    assert rep.last_checked_round == rounds
+    assert hook.compiles in (-1, 1)
+    assert run.compiles in (-1, 1)
+    block = rep.artifact_block()
+    assert block["enabled"] and block["violated"] == 0
+    assert block["properties"] == list(rep.names)
+
+
+def test_report_surfaces_violations(net, lived_in):
+    """A violating check lands in the report with (round, sim, name)."""
+    cfg, st = lived_in
+    hook = inv.InvariantHook("gossipsub", net, cfg,
+                             inv.InvariantConfig(check_every=1),
+                             batched=False)
+    hook.precompute(2)
+    _, bad, _ = _corrupt_graylist(net, cfg, st)
+    hook.on_step(0, st)
+    hook.on_step(1, bad)
+    rep = hook.report()
+    assert not rep.all_ok and rep.violated == 1
+    assert rep.violations() == [(2, 0, "graylist-not-in-mesh")]
+    per = rep.per_property()
+    assert per["graylist-not-in-mesh"] == (2, 1)
+    assert per["fwd-subset-have"] == (2, 0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip with invariant checking enabled (no version bump)
+
+
+def test_checkpoint_roundtrip_with_invariants(net, tmp_path):
+    """A run with invariant checking enabled checkpoints and resumes
+    bit-exactly — the v6 format is pytree-generic, no bump — and the
+    resumed run's violation masks equal the uninterrupted run's."""
+    assert checkpoint._FORMAT_VERSION == 6
+
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_params(), PeerScoreThresholds(),
+                                score_enabled=True)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    po, pt, pv = _schedule(rounds=16)
+
+    def drive(st, hook, lo, hi):
+        for t in range(lo, hi):
+            st = step(st, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                      jnp.asarray(pv[t]))
+            hook.on_step(t, st)
+        return st
+
+    def fresh_hook():
+        h = inv.InvariantHook("gossipsub", net, cfg,
+                              inv.InvariantConfig(check_every=4),
+                              batched=False)
+        h.precompute(16)
+        return h
+
+    # uninterrupted reference
+    st_a = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    hook_a = fresh_hook()
+    st_a = drive(st_a, hook_a, 0, 16)
+
+    # interrupted at round 8: save, restore into a fresh template,
+    # resume (the window state the hook carries — the prev-events
+    # monotone snapshot — is rebuilt from the restored state itself)
+    st_b = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    hook_b = fresh_hook()
+    st_b = drive(st_b, hook_b, 0, 8)
+    path = os.path.join(tmp_path, "inv_ckpt.npz")
+    checkpoint.save(path, st_b)
+    template = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    st_c = checkpoint.restore(path, template)
+    st_c = drive(st_c, hook_b, 8, 16)
+
+    # resumed final state == uninterrupted, leaf for leaf
+    for pa, la, lc in zip(
+            [jax.tree_util.keystr(p)
+             for p, _ in jax.tree_util.tree_flatten_with_path(st_a)[0]],
+            jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda x: jax.random.key_data(x)
+                if checkpoint.is_prng_key(x) else x, st_a)),
+            jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda x: jax.random.key_data(x)
+                if checkpoint.is_prng_key(x) else x, st_c))):
+        assert bool(jnp.array_equal(la, lc)), f"leaf {pa} diverged"
+    rep_a, rep_b = hook_a.report(), hook_b.report()
+    assert rep_a.ticks == rep_b.ticks
+    assert (rep_a.ok == rep_b.ok).all()
+    assert rep_a.all_ok
+
+
+# ---------------------------------------------------------------------------
+# artifact plumbing (schema-v3 invariants block + tracestat reader)
+
+
+def test_artifact_invariants_block_roundtrip(net, lived_in):
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        INVARIANTS_OFF,
+        BenchRecord,
+        dump_record,
+        record_from_line,
+    )
+    import json as _json
+
+    cfg, st = lived_in
+    hook = inv.InvariantHook("gossipsub", net, cfg,
+                             inv.InvariantConfig(check_every=1),
+                             batched=False)
+    hook.precompute(1)
+    hook.on_step(0, st)
+    block = hook.report().artifact_block()
+    rec = BenchRecord(metric="m", value=1.0, unit="ratio", vs_baseline=0.0,
+                      schema=2, invariants_raw=block)
+    line = _json.loads(dump_record(rec))
+    assert line["schema"] >= 3          # the block forces v3
+    back = record_from_line(line)
+    assert back.invariants_on
+    assert back.invariants["checked"] == block["checked"]
+    # the hook labels rounds by its own dispatch count (1 dispatch here)
+    assert back.invariants["last_checked_round"] == 1
+    # legacy lines read back the typed OFF default
+    legacy = record_from_line({"metric": "m", "value": 1.0})
+    assert not legacy.invariants_on
+    assert legacy.invariants == INVARIANTS_OFF
+
+
+def test_partition_cell_refuses_vacuous_invariant_run():
+    """A tail shorter than the grace window would leave every
+    partition-specific clause unarmed (and degree bounds suspended) for
+    the whole post-heal run — the cell must refuse, not rubber-stamp."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import chaos_report
+
+    with pytest.raises(ValueError, match="vacuous"):
+        chaos_report.run_partition(
+            n=32, seeds=1, tail=chaos_report.PARTITION_GRACE_AFTER_HEAL - 1,
+            invariants=True)
+
+
+def test_tracestat_reads_invariants_block(net, lived_in, tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import tracestat
+
+    from go_libp2p_pubsub_tpu.perf.artifacts import BenchRecord, dump_record
+
+    cfg, st = lived_in
+    hook = inv.InvariantHook("gossipsub", net, cfg,
+                             inv.InvariantConfig(check_every=1),
+                             batched=False)
+    hook.precompute(1)
+    hook.on_step(0, st)
+    rec = BenchRecord(metric="m", value=1.0, unit="ratio", vs_baseline=0.0,
+                      schema=2, invariants_raw=hook.report().artifact_block())
+    p = tmp_path / "run.json"
+    p.write_text(dump_record(rec) + "\n")
+    got = tracestat.artifact_invariants(str(p))
+    assert got["enabled"] and got["violated"] == 0
+    # legacy artifact: the typed OFF default, not a KeyError
+    p2 = tmp_path / "legacy.json"
+    p2.write_text('{"metric": "m", "value": 1.0}\n')
+    off = tracestat.artifact_invariants(str(p2))
+    assert off["enabled"] is False and off["checked"] == 0
