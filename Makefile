@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke analyze sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke analyze sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -77,6 +77,23 @@ telemetry-smoke:
 oracle-smoke:
 	python scripts/invariant_report.py --smoke
 
+# adversary-plane gate (scripts/attack_report.py; docs/DESIGN.md §13):
+# the GossipSub v1.1 attack suite as 8-sim ensemble bands with the
+# invariant oracle hook ENABLED — (a) sybil flood (drop-forward +
+# lie-IHAVE + graft-spam + self-promotion on a lossy wire, paired per
+# sim against an attack-free ablation on identical fault streams):
+# honest delivery within band of the ablation, attacker-as-receiver
+# delivery separated below it, attacker median score below the
+# graylist threshold while honest medians stay >= 0, in EVERY sim;
+# (b) eclipse (half-sybil target neighborhoods, targeted graft-spam):
+# sybil-majority takeover observed, then every sim's targets recover
+# an all-honest mesh within the bounded tick count; (c) ZERO invariant
+# violations under every attack cell; (d) the chaos-off ADVERSARY-OFF
+# compiled HLO census still equals the committed PERF_SMOKE baseline
+# and the one-compile cache sentinels hold. ~70 s warm on CPU.
+attack-smoke:
+	python scripts/attack_report.py --smoke
+
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
 # discipline, packed-word dtype hygiene, import-time execution, static-
@@ -97,11 +114,11 @@ sweep:
 test:
 	python -m pytest tests/ -q
 
-# quick tier: the sub-10-minute CI gate — `not slow` tests plus the CPU
-# perf-smoke regression gate, the chaos-smoke recovery gate, the
-# ensemble-plane gate, the telemetry-plane gate, the invariant-oracle
-# gate and the analysis-plane gate (all fast once the compile cache is
-# warm)
+# quick tier: the CI gate — `not slow` tests plus the CPU perf-smoke
+# regression gate, the chaos-smoke recovery gate, the ensemble-plane
+# gate, the telemetry-plane gate, the invariant-oracle gate, the
+# adversary attack-smoke gate and the analysis-plane gate (all fast
+# once the compile cache is warm)
 quick:
 	python -m pytest tests/ -q -m "not slow"
 	python -m go_libp2p_pubsub_tpu.perf.regress
@@ -109,6 +126,7 @@ quick:
 	python scripts/ensemble_report.py --smoke
 	python scripts/telemetry_smoke.py
 	python scripts/invariant_report.py --smoke
+	python scripts/attack_report.py --smoke
 	python scripts/analyze.py
 
 native:

@@ -8,10 +8,22 @@ scenarios, and measured recovery (docs/DESIGN.md §8).
               to per-round/per-phase mask arguments
   metrics   — recovery metrics: delivery ratio under loss, IWANT-
               recovery share, mesh-repair latency, time-to-recover
+  adversary — the v1.1 attack suite (docs/DESIGN.md §13): per-peer
+              sybil/behavior masks driving lie-in-IHAVE, drop-on-
+              forward, graft-spam, self-promotion and censorship as
+              masked variants of the existing step math, plus
+              declarative AttackScenario schedules
 
-The runner lives in scripts/chaos_report.py (``make chaos-smoke``).
+The runners live in scripts/chaos_report.py (``make chaos-smoke``)
+and scripts/attack_report.py (``make attack-smoke``).
 """
 
+from .adversary import (  # noqa: F401
+    Adversary,
+    AdversaryError,
+    AttackScenario,
+    BEHAVIORS,
+)
 from .faults import ChaosConfig, ChaosConfigError, resolve  # noqa: F401
 from .metrics import (  # noqa: F401
     batched_cross_group_mesh_counts,

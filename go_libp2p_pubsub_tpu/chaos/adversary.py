@@ -1,0 +1,484 @@
+"""Vectorized adversary plane: the GossipSub v1.1 attack suite as
+masked variants of the existing step math (docs/DESIGN.md §13).
+
+The v1.1 hardening paper (arXiv:2007.02754) validates the protocol by
+attacking it — sybil flood, eclipse/mesh-takeover, cold boot, covert
+flash, censorship — and showing the scoring machinery (P1–P7, gater,
+backoff, opportunistic grafting) isolates the attackers while honest
+delivery survives. This module supplies those attacker populations as
+batched array programs: a per-peer ``is_sybil`` plane plus per-behavior
+masks drive attacker behaviors inside the SAME jitted steps the honest
+network runs, as masked variants of the existing math — no separate
+attacker stack, no per-attacker host loop, vmappable to ensemble bands.
+
+Behaviors (each an independently maskable plane; the reference test
+each models is cited inline where the engines apply it):
+
+  * **drop_forward** — run the full control plane but never transmit
+    message data (mesh push, flood-publish, fanout, IWANT service):
+    the ``sybilSquatter`` attacker (gossipsub_test.go:1777-1811),
+    caught by the P3 mesh-delivery deficit + P7 broken promises. The
+    scheduled generalization of the static ``adversary_no_forward``
+    build vector (which remains supported, always-on, unscheduled).
+  * **lie_ihave** — advertise every live message id on every edge,
+    whether or not it was ever received (IHAVE spam,
+    gossipsub_spam_test.go:290): elicits IWANTs the attacker will not
+    serve → broken gossip promises → P7 behaviour penalty.
+  * **graft_spam** — GRAFT every (live slot, edge) each heartbeat,
+    ignoring PRUNE backoff (GRAFT flood, gossipsub_spam_test.go:365):
+    victims double-penalize flood-window GRAFTs (gossipsub.go:760-768)
+    → P7. Spam attackers keep NO backoff bookkeeping of their own (the
+    reference attacker is a raw-wire fake with no router state) — the
+    hook zeroes their backoff planes, so the oracle plane's
+    backoff-respect properties hold for the honest population they
+    were written about.
+  * **self_promo** — cooperating sybils pin their held scores of
+    FELLOW sybils at ``promo_score`` (the P5-style app credit a sybil
+    faction grants itself): sybils never graylist, prune, or
+    score-gate each other, the covert-flash cohesion shape — honest
+    peers' scoring of sybils (the defense under test) is untouched.
+  * **censor** — forward everything EXCEPT messages originated by the
+    ``censor_origins`` target set (selective per-message drop): the
+    stealthy censorship attack — P3 stays clean on ambient traffic, so
+    isolation must come from the targets' own delivery paths.
+
+Zero-permute contract: every mask ANDs into gathers the steps already
+perform. The gossipsub factories (and ``make_randomsub_step``) build
+neighbor views of the static per-peer planes EAGERLY at build time
+(``is_sybil[nbr]`` etc. are jit constants), so the sharded lowering
+adds NO halo permutes; per-round activity is a pure elementwise
+compare of those constants against the tick. ``floodsub_step`` takes
+``net`` as a traced argument, so its neighbor views trace as one tiny
+[N] → [N, K] gather per round (floodsub is outside the pinned
+collectives budget; the gossipsub engines stay zero-extra-permute).
+
+Schedules: ``onset``/``stop`` are per-peer i32 planes compared against
+the tick on device — an :class:`AttackScenario` compiles declarative
+attack windows (onset, ramp, stop, sybil fraction, eclipse target
+sets) down to those planes, staggering per-peer onsets across a ramp.
+Because activity is a pure function of (static planes, tick), the
+plane is stateless: checkpoints resume the exact attack sequence with
+no new state leaves and no format bump (tests/test_adversary.py pins
+the round trip). It composes orthogonally with chaos link faults /
+partitions (``chaos.Scenario``) and the churn plane's ``up`` rows —
+cold-boot and covert-flash timing ride those existing arguments.
+
+Static elision contract: ``adversary=None`` (or a population whose
+every behavior is off / empty) traces exactly the pre-adversary
+program — no masks, no counters, no extra ops; ``resolve`` is the one
+shared elision decision, like ``chaos.faults.resolve``. Pinned by
+tests/test_adversary.py (bit-exact state trees, all four engines) and
+``make attack-smoke`` (adversary-off compiled HLO census equality).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import bitset
+
+#: the maskable behavior planes (one [N] bool mask each; None = the
+#: behavior is off for the whole population)
+BEHAVIORS = ("drop_forward", "lie_ihave", "graft_spam", "self_promo",
+             "censor")
+
+#: "never stops" tick sentinel (far beyond any simulated horizon,
+#: safely inside i32)
+NEVER = 2 ** 30
+
+
+class AdversaryError(ValueError):
+    """Raised on invalid adversary populations / attack scenarios."""
+
+
+class Adversary:
+    """A build-time adversary population description.
+
+    Plain host object holding numpy planes; the ``make_*_step``
+    factories close over eagerly-built constants derived from it
+    (:class:`AdversaryConsts`). Hashable by IDENTITY (not value) on
+    purpose, so it can also ride jit static args (``floodsub_step``)
+    — two distinct instances are two cache entries, like two distinct
+    topologies.
+
+    ``is_sybil`` names the attacker faction; each behavior defaults to
+    the whole faction and can be restricted with a per-behavior mask
+    (``masks={"graft_spam": ...}``) — every behavior mask must be a
+    subset of ``is_sybil``. ``onset``/``stop`` are ticks (scalar or
+    per-peer [N] i32): a behavior is ACTIVE for peer i exactly when
+    ``mask[i] and onset[i] <= tick < stop[i]``.
+
+    ``censor_origins`` is the [N] bool target set whose messages the
+    ``censor`` behavior drops; ``graft_targets`` optionally restricts
+    ``graft_spam`` to edges toward a victim set (the eclipse shape —
+    None spams every edge).
+    """
+
+    def __init__(self, n_peers: int, is_sybil, behaviors=("drop_forward",),
+                 *, masks: dict | None = None, onset=0, stop=None,
+                 promo_score: float = 20.0, censor_origins=None,
+                 graft_targets=None):
+        self.n_peers = int(n_peers)
+        self.is_sybil = np.asarray(is_sybil, bool).reshape(-1)
+        self.behaviors = tuple(behaviors)
+        self.masks = {k: np.asarray(v, bool).reshape(-1)
+                      for k, v in (masks or {}).items()}
+        self.onset = np.broadcast_to(
+            np.asarray(onset, np.int32), (self.n_peers,)).copy()
+        self.stop = np.broadcast_to(
+            np.asarray(NEVER if stop is None else stop, np.int32),
+            (self.n_peers,)).copy()
+        self.promo_score = float(promo_score)
+        self.censor_origins = (
+            None if censor_origins is None
+            else np.asarray(censor_origins, bool).reshape(-1))
+        self.graft_targets = (
+            None if graft_targets is None
+            else np.asarray(graft_targets, bool).reshape(-1))
+        self.validate()
+
+    def validate(self) -> None:
+        n = self.n_peers
+        if self.is_sybil.shape != (n,):
+            raise AdversaryError(
+                f"is_sybil has shape {self.is_sybil.shape} for {n} peers")
+        unknown = [b for b in self.behaviors if b not in BEHAVIORS]
+        if unknown:
+            raise AdversaryError(
+                f"unknown behaviors {unknown}; known: {BEHAVIORS}")
+        for k, m in self.masks.items():
+            if k not in BEHAVIORS:
+                raise AdversaryError(
+                    f"mask for unknown behavior {k!r}; known: {BEHAVIORS}")
+            if k not in self.behaviors:
+                raise AdversaryError(
+                    f"mask[{k!r}] given but the behavior is not enabled "
+                    f"(behaviors={self.behaviors}) — a silently ignored "
+                    "mask would run the experiment without the attack")
+            if m.shape != (n,):
+                raise AdversaryError(
+                    f"mask[{k!r}] has shape {m.shape} for {n} peers")
+            if (m & ~self.is_sybil).any():
+                raise AdversaryError(
+                    f"mask[{k!r}] marks peers outside is_sybil — behavior "
+                    "masks restrict the faction, they cannot extend it")
+        for name in ("onset", "stop"):
+            v = getattr(self, name)
+            if v.shape != (n,):
+                raise AdversaryError(
+                    f"{name} has shape {v.shape} for {n} peers")
+        if (self.onset < 0).any():
+            raise AdversaryError("onset ticks must be >= 0")
+        if "censor" in self.behaviors and self.censor_origins is None:
+            raise AdversaryError(
+                "the censor behavior needs censor_origins (the [N] bool "
+                "target set whose messages are dropped)")
+        for name, v in (("censor_origins", self.censor_origins),
+                        ("graft_targets", self.graft_targets)):
+            if v is not None and v.shape != (n,):
+                raise AdversaryError(
+                    f"{name} has shape {v.shape} for {n} peers")
+
+    def mask(self, behavior: str) -> np.ndarray | None:
+        """[N] bool plane of ``behavior``, or None when it is off."""
+        if behavior not in self.behaviors:
+            return None
+        m = self.masks.get(behavior, self.is_sybil)
+        return m if m.any() else None
+
+    @property
+    def enabled(self) -> bool:
+        """False ⇒ the build elides the adversary plane entirely."""
+        return any(self.mask(b) is not None for b in self.behaviors)
+
+    def fingerprint(self) -> dict:
+        """The schema-v3 artifact self-description of this population
+        (perf/artifacts.py ``adversary`` block)."""
+        h = hashlib.sha256()
+        h.update(self.is_sybil.tobytes())
+        h.update(self.onset.tobytes())
+        h.update(self.stop.tobytes())
+        for b in BEHAVIORS:
+            m = self.mask(b)
+            h.update(b"-" if m is None else m.tobytes())
+        for v in (self.censor_origins, self.graft_targets):
+            h.update(b"-" if v is None else v.tobytes())
+        live = [b for b in self.behaviors if self.mask(b) is not None]
+        return {
+            "enabled": bool(self.enabled),
+            "n_sybils": int(self.is_sybil.sum()),
+            "behaviors": live,
+            "onset": int(self.onset[self.is_sybil].min())
+            if self.is_sybil.any() else 0,
+            "stop": (lambda s: None if s >= NEVER else s)(
+                int(self.stop[self.is_sybil].max())
+                if self.is_sybil.any() else NEVER),
+            "promo_score": self.promo_score,
+            "population": h.hexdigest()[:12],
+        }
+
+
+def resolve(adversary: "Adversary | None") -> "Adversary | None":
+    """Normalize to None when the plane is off — the single elision
+    decision every engine shares (mirrors chaos.faults.resolve).
+    Validation runs FIRST: a typo'd behavior name must raise, not
+    silently run the experiment against an honest network."""
+    if adversary is None:
+        return None
+    adversary.validate()
+    return adversary if adversary.enabled else None
+
+
+class AdversaryConsts:
+    """Eager per-(adversary, topology) jit constants.
+
+    Built once at step-build time (the ``StepConsts`` pattern): the
+    per-peer planes and their NEIGHBOR views are concrete arrays, so
+    the steps' per-round activity tests are elementwise compares of
+    constants against the tick — zero gathers, zero halo permutes on
+    the sharded mesh. Under a traced ``net`` (floodsub's calling
+    convention) the neighbor views trace as one [N] → [N, K] gather.
+    """
+
+    __slots__ = ("adv", "onset", "stop", "onset_nbr", "stop_nbr",
+                 "self_masks", "nbr_masks", "sybil_nbr", "spam_edges",
+                 "censor_origin", "promo_score")
+
+    def __init__(self, adv: Adversary, net):
+        self.adv = adv
+        self.promo_score = jnp.float32(adv.promo_score)
+        nbr = jnp.clip(net.nbr, 0)
+        self.onset = jnp.asarray(adv.onset)
+        self.stop = jnp.asarray(adv.stop)
+        self.onset_nbr = self.onset[nbr]
+        self.stop_nbr = self.stop[nbr]
+        self.self_masks = {}
+        self.nbr_masks = {}
+        for b in BEHAVIORS:
+            m = adv.mask(b)
+            if m is None:
+                continue
+            mj = jnp.asarray(m)
+            self.self_masks[b] = mj
+            self.nbr_masks[b] = mj[nbr] & net.nbr_ok
+        sybil = jnp.asarray(adv.is_sybil)
+        self.sybil_nbr = sybil[nbr] & net.nbr_ok
+        # graft-spam edge eligibility: present, never self, optionally
+        # restricted to the eclipse victim set
+        n = net.nbr.shape[0]
+        not_self = net.nbr != jnp.arange(n, dtype=net.nbr.dtype)[:, None]
+        spam = net.nbr_ok & not_self
+        if adv.graft_targets is not None:
+            spam = spam & jnp.asarray(adv.graft_targets)[nbr]
+        self.spam_edges = spam
+        self.censor_origin = (
+            jnp.asarray(adv.censor_origins)
+            if adv.censor_origins is not None else None)
+
+    def has(self, behavior: str) -> bool:
+        return behavior in self.self_masks
+
+    @property
+    def data_plane(self) -> bool:
+        """True when any data-plane behavior (drop_forward / censor)
+        is live — the engines' one gate for the transmit-mask hooks."""
+        return self.has("drop_forward") or self.has("censor")
+
+    def active_self(self, behavior: str, tick) -> jax.Array:
+        """[N] bool: peers running ``behavior`` this round."""
+        return (self.self_masks[behavior]
+                & (tick >= self.onset) & (tick < self.stop))
+
+    def active_nbr(self, behavior: str, tick) -> jax.Array:
+        """[N, K] bool: edge (j, k) has an active-``behavior`` SENDER
+        at its far end this round (the receiver-gather gate)."""
+        return (self.nbr_masks[behavior]
+                & (tick >= self.onset_nbr) & (tick < self.stop_nbr))
+
+    def censor_words(self, msgs) -> jax.Array:
+        """[W] u32 packed mask of message slots an active censor drops
+        (live messages originated by the target set)."""
+        hit = (self.censor_origin[jnp.clip(msgs.origin, 0)]
+               & (msgs.origin >= 0))
+        return bitset.pack(hit)
+
+    def mask_transmit_nbr(self, tick, plane, msgs):
+        """Receiver-side data-plane gate: suppress bits of a gathered
+        [N, K, W] transmit plane on edges whose SENDER is an active
+        ``drop_forward`` / ``censor`` attacker this round. Returns
+        ``(masked, removed)`` — callers popcount ``removed`` (∩ the
+        forwardable set) into the EV.ADV_DROP attribution counter."""
+        out = plane
+        if self.has("drop_forward"):
+            dn = self.active_nbr("drop_forward", tick)
+            out = jnp.where(dn[:, :, None], jnp.uint32(0), out)
+        if self.has("censor"):
+            cn = self.active_nbr("censor", tick)
+            cw = self.censor_words(msgs)
+            out = jnp.where(cn[:, :, None], out & ~cw[None, None, :], out)
+        return out, plane & ~out
+
+    def mask_transmit_self(self, tick, plane, msgs):
+        """Sender-side form of the same gate (the phase engine's
+        transmit composition is sender-side, so the attacker masks its
+        OWN rows before the one edge gather). Returns
+        ``(masked, removed)``."""
+        out = plane
+        if self.has("drop_forward"):
+            ds = self.active_self("drop_forward", tick)
+            out = jnp.where(ds[:, None, None], jnp.uint32(0), out)
+        if self.has("censor"):
+            cs = self.active_self("censor", tick)
+            cw = self.censor_words(msgs)
+            out = jnp.where(cs[:, None, None], out & ~cw[None, None, :], out)
+        return out, plane & ~out
+
+
+def withheld_count(net, fwd, removed) -> jax.Array:
+    """i32 scalar EV.ADV_DROP attribution: suppressed receiver-side
+    carry bits ∩ the senders' forward sets (the same fwd gather the
+    delivery round performs — XLA CSE merges the two, so the counter
+    adds no second halo exchange)."""
+    fwd_g = net.peer_gather(fwd)
+    return bitset.popcount(removed & fwd_g, axis=None).sum().astype(
+        jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class AttackScenario:
+    """A declarative, reproducible attack schedule over one run.
+
+    Compiles to the static per-peer planes the engines consume
+    (:meth:`build` → :class:`Adversary`) — the adversary analogue of
+    ``chaos.Scenario``; it composes with partitions (``link_deny``),
+    crash storms / cold boot (the churn ``up`` rows), and covert-flash
+    timing (a late ``onset`` after a long honest warmup) purely at the
+    schedule layer.
+
+    Sybil recruitment, one of:
+      * ``sybils`` — explicit peer indices;
+      * ``sybil_fraction`` — the top fraction of the id space
+        (deterministic: peers ``[ceil(N·(1-f)), N)``);
+      * ``surround_targets=True`` — the TOPOLOGY NEIGHBORS of
+        ``targets`` become the sybils (the eclipse placement; needs
+        ``build(net=...)``). ``surround_fraction < 1`` recruits only
+        that fraction of each target's neighbors (seeded,
+        deterministic) — a full surround leaves the victim NO honest
+        edge to recover through, the unrecoverable limit case.
+
+    ``ramp_rounds`` staggers per-sybil onsets uniformly (seeded,
+    deterministic) across ``[onset, onset + ramp_rounds)`` — the
+    attack's arrival is a ramp, not a step. ``stop=None`` never stops.
+    """
+
+    n_peers: int
+    behaviors: tuple = ("drop_forward",)
+    sybils: tuple = ()
+    sybil_fraction: float = 0.0
+    onset: int = 0
+    stop: int | None = None
+    ramp_rounds: int = 0
+    targets: tuple = ()
+    surround_targets: bool = False
+    surround_fraction: float = 1.0
+    censor_origins: tuple = ()
+    promo_score: float = 20.0
+    seed: int = 0
+
+    def validate(self) -> None:
+        if not (0.0 <= self.sybil_fraction < 1.0):
+            raise AdversaryError(
+                f"sybil_fraction must be in [0, 1), got {self.sybil_fraction}")
+        if self.onset < 0 or self.ramp_rounds < 0:
+            raise AdversaryError("onset/ramp_rounds must be >= 0")
+        if self.stop is not None and self.stop <= self.onset:
+            raise AdversaryError(
+                f"stop ({self.stop}) must be > onset ({self.onset})")
+        for name in ("sybils", "targets", "censor_origins"):
+            for i in getattr(self, name):
+                if not (0 <= int(i) < self.n_peers):
+                    raise AdversaryError(f"{name} index {i} out of range")
+        if self.surround_targets and not self.targets:
+            raise AdversaryError("surround_targets needs a target set")
+        if not (0.0 < self.surround_fraction <= 1.0):
+            raise AdversaryError(
+                f"surround_fraction must be in (0, 1], got "
+                f"{self.surround_fraction}")
+
+    def _sybil_plane(self, net=None) -> np.ndarray:
+        n = self.n_peers
+        sybil = np.zeros((n,), bool)
+        if self.sybils:
+            sybil[list(self.sybils)] = True
+        if self.sybil_fraction > 0.0:
+            sybil[int(np.ceil(n * (1.0 - self.sybil_fraction))):] = True
+        if self.surround_targets:
+            if net is None:
+                raise AdversaryError(
+                    "surround_targets recruits the targets' topology "
+                    "neighbors — pass build(net=...)")
+            nbr = np.asarray(net.nbr)
+            ok = np.asarray(net.nbr_ok)
+            rng = np.random.default_rng(self.seed + 0x5A11)
+            for t in self.targets:
+                nbrs = np.unique(nbr[int(t)][ok[int(t)]])
+                if self.surround_fraction < 1.0:
+                    keep = max(1, int(np.floor(
+                        self.surround_fraction * nbrs.size)))
+                    nbrs = rng.permutation(nbrs)[:keep]
+                sybil[nbrs] = True
+        sybil[list(self.targets)] = False  # a victim is never a sybil
+        return sybil
+
+    def build(self, net=None) -> Adversary:
+        """Compile to the static per-peer planes (an Adversary)."""
+        self.validate()
+        n = self.n_peers
+        sybil = self._sybil_plane(net)
+        onset = np.full((n,), self.onset, np.int32)
+        if self.ramp_rounds > 0:
+            rng = np.random.default_rng(self.seed)
+            idx = np.nonzero(sybil)[0]
+            onset[idx] = self.onset + rng.integers(
+                0, self.ramp_rounds, size=idx.size)
+        stop = NEVER if self.stop is None else self.stop
+        censor = None
+        if self.censor_origins:
+            censor = np.zeros((n,), bool)
+            censor[list(self.censor_origins)] = True
+        targets = None
+        if self.targets:
+            targets = np.zeros((n,), bool)
+            targets[list(self.targets)] = True
+        return Adversary(
+            n, sybil, self.behaviors, onset=onset, stop=stop,
+            promo_score=self.promo_score, censor_origins=censor,
+            graft_targets=targets if "graft_spam" in self.behaviors else None,
+        )
+
+    def events(self) -> list:
+        """The schedule as (tick, kind, detail) rows — host-known
+        exact, like chaos.Scenario.events."""
+        out = [(self.onset, "AttackOnset",
+                {"behaviors": list(self.behaviors),
+                 "ramp_rounds": self.ramp_rounds})]
+        if self.stop is not None:
+            out.append((self.stop, "AttackStop", {}))
+        return out
+
+    def scenario_hash(self) -> str:
+        """Stable short hash of the whole schedule (artifact adversary
+        fingerprint field)."""
+        h = hashlib.sha256()
+        h.update(repr((self.n_peers, self.behaviors, tuple(self.sybils),
+                       self.sybil_fraction, self.onset, self.stop,
+                       self.ramp_rounds, tuple(self.targets),
+                       self.surround_targets, self.surround_fraction,
+                       tuple(self.censor_origins),
+                       self.promo_score, self.seed)).encode())
+        return h.hexdigest()[:12]

@@ -39,6 +39,14 @@ import numpy as np
 #     entries). i.i.d./scheduled chaos adds NO state: fault masks are
 #     functions of (key, tick), both checkpointed since v1, so a restored
 #     run resumes the exact fault sequence.
+#     Round 13 (adversary plane) rides v6 UNCHANGED: attacker activity is
+#     a pure function of static build planes and the checkpointed tick —
+#     no new leaves, and a restored attacked run resumes the exact attack
+#     stream (tests/test_adversary.py). The event vector grew the
+#     ADV_DROP / ADV_IHAVE_LIE / ADV_GRAFT_SPAM counters (15 -> 18); a
+#     pre-round-13 snapshot restoring into a new template fails the
+#     leaf-SHAPE check with the `.events` path named — the format itself
+#     is pytree-generic, so no version bump.
 _FORMAT_VERSION = 6
 
 

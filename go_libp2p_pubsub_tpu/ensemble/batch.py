@@ -141,7 +141,7 @@ def lift_step(step, *, net=None, static_kwargs: dict | None = None,
     return jax.jit(ens, **jit_kw)
 
 
-def lift_floodsub(net, chaos=None, queue_cap: int = 0):
+def lift_floodsub(net, chaos=None, queue_cap: int = 0, adversary=None):
     """Convenience lift of the floodsub router (its step is a module-
     level jitted function taking ``net`` first, unlike the factories).
     Scheduled-chaos runs pass the per-round ``link_deny`` mask as a
@@ -157,6 +157,8 @@ def lift_floodsub(net, chaos=None, queue_cap: int = 0):
         kw = {"queue_cap": queue_cap}
         if chaos is not None:
             kw["chaos"] = chaos
+        if adversary is not None:
+            kw["adversary"] = adversary
         if deny:
             kw["link_deny"] = deny[0]
         return raw(net_, s, po, pt, pv, **kw)

@@ -26,7 +26,8 @@ import numpy as np
 from ..chaos.metrics import batched_iwant_shares  # noqa: F401
 
 
-def _expected_mask(birth, topic, origin, subscribed, born_lo, born_hi):
+def _expected_mask(birth, topic, origin, subscribed, born_lo, born_hi,
+                   receivers=None):
     """[N, M] bool: the (subscriber, message) pairs a delivery is
     expected for — ONE sim. The single source of the eligibility
     semantics (chaos.metrics.delivery_stats's exclusions: only live /
@@ -41,32 +42,40 @@ def _expected_mask(birth, topic, origin, subscribed, born_lo, born_hi):
         jnp.arange(n, dtype=jnp.int32)[:, None]
         == jnp.clip(origin, 0, n - 1)[None, :]
     ) & live[None, :]
-    return exp & ~is_origin
+    exp = exp & ~is_origin
+    if receivers is not None:
+        exp = exp & receivers[:, None]
+    return exp
 
 
 def _delivery_counts(first_round, birth, topic, origin, subscribed,
-                     born_lo, born_hi):
+                     born_lo, born_hi, receivers=None):
     """(delivered, expected) i32 scalars for ONE sim — the device form
     of chaos.metrics.delivery_stats."""
     exp = _expected_mask(birth, topic, origin, subscribed,
-                         born_lo, born_hi)
+                         born_lo, born_hi, receivers=receivers)
     got = (first_round >= 0) & exp
     return (jnp.sum(got.astype(jnp.int32)),
             jnp.sum(exp.astype(jnp.int32)))
 
 
 def sim_delivery_ratios(first_round, birth, topic, origin, subscribed,
-                        born_in: tuple | None = None):
+                        born_in: tuple | None = None, receivers=None):
     """[S] f32 per-sim delivery ratios, computed on device with one
     vmapped reduction. ``subscribed [N, T]`` is shared (static across
     sims); the message planes carry the leading S axis. ``born_in``
-    restricts to messages born in ``[lo, hi)`` (static)."""
+    restricts to messages born in ``[lo, hi)`` (static); ``receivers``
+    ([N] bool, shared) restricts the expected-receiver set — the
+    attack bands' honest-vs-attacker split (chaos.metrics
+    expected_receivers' ``up`` parameter, device form)."""
     lo, hi = born_in if born_in is not None else (0, 2**31 - 1)
     sub = jnp.asarray(subscribed, bool)
+    recv = None if receivers is None else jnp.asarray(receivers, bool)
 
     def one(fr, b, t, o):
         got, exp = _delivery_counts(fr, b, t, o, sub,
-                                    jnp.int32(lo), jnp.int32(hi))
+                                    jnp.int32(lo), jnp.int32(hi),
+                                    receivers=recv)
         ratio = got.astype(jnp.float32) / jnp.maximum(exp, 1).astype(jnp.float32)
         return jnp.where(exp > 0, ratio, jnp.float32(1.0))
 

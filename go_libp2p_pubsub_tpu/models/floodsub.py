@@ -16,6 +16,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..chaos import adversary as adversary_mod
 from ..chaos import faults as chaos_faults
 from ..state import Net, SimState, allocate_publishes
 from ..trace.events import EV
@@ -32,7 +33,7 @@ def flood_edge_mask(net: Net, msgs) -> jax.Array:
 
 @functools.partial(jax.jit, donate_argnums=1,
                    static_argnames=("queue_cap", "stacked", "chaos",
-                                    "telemetry"))
+                                    "telemetry", "adversary"))
 def floodsub_step(
     net: Net,
     state: SimState,
@@ -50,6 +51,15 @@ def floodsub_step(
     telemetry=None,         # TelemetryConfig | None — per-round panel row
                             # (telemetry/panel.py; state needs
                             # SimState.init(telemetry=...)); None elides
+    adversary=None,         # chaos.adversary.Adversary | None — the
+                            # attack plane's DATA behaviors (drop-on-
+                            # forward / censorship; the mesh/score
+                            # behaviors have no floodsub analogue).
+                            # Identity-hashed static arg; None elides
+                            # statically. floodsub takes `net` traced,
+                            # so the attacker neighbor views trace as
+                            # one [N] -> [N, K] gather per round (the
+                            # factory engines bake them as constants)
 ) -> SimState:
     """One synchronous round: deliver in-flight messages one hop, then
     intern this round's publishes (they start propagating next round).
@@ -63,6 +73,7 @@ def floodsub_step(
     flap gossipsub links flap floodsub's (a GE-generator config needs
     ``SimState.init(chaos_ge=True)``)."""
     chaos = chaos_faults.resolve(chaos)
+    adv_pop = adversary_mod.resolve(adversary)
     edge_mask = flood_edge_mask(net, state.msgs)
     if chaos is not None:
         ge_bad = state.chaos.ge_bad if state.chaos is not None else None
@@ -71,6 +82,14 @@ def floodsub_step(
             ge_bad, link_deny,
         )
         edge_mask = jnp.where(link_ok[:, :, None], edge_mask, jnp.uint32(0))
+    n_adv_drop = None
+    if adv_pop is not None:
+        adv = adversary_mod.AdversaryConsts(adv_pop, net)
+        if adv.data_plane:
+            edge_mask, removed = adv.mask_transmit_nbr(
+                state.tick, edge_mask, state.msgs)
+            n_adv_drop = adversary_mod.withheld_count(
+                net, state.dlv.fwd, removed)
     dlv, info = delivery_round(net, state.msgs, state.dlv, edge_mask, state.tick,
                                queue_cap=queue_cap)
 
@@ -85,6 +104,8 @@ def floodsub_step(
         )
         if chaos.needs_state:
             state = state.replace(chaos=state.chaos.replace(ge_bad=ge_bad_next))
+    if n_adv_drop is not None:
+        events = events.at[EV.ADV_DROP].add(n_adv_drop)
 
     telem = state.telem
     if telemetry is not None:

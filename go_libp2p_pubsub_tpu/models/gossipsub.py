@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..chaos import adversary as adversary_mod
 from ..chaos import faults as chaos_faults
 from ..chaos.faults import ChaosConfig
 from ..config import (
@@ -951,7 +952,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               nbr_sub_words: jax.Array | None = None,
               present_ok: jax.Array | None = None,
               gossip_suppress: jax.Array | None = None,
-              app_gathered: jax.Array | None = None) -> GossipSubState:
+              app_gathered: jax.Array | None = None,
+              adversary=None) -> GossipSubState:
     """`net` is the live view (nbr_ok masked by churn/edge-liveness);
     `present_ok` is the static edge-presence mask, needed by directConnect
     to re-dial edges that are currently dormant (defaults to net.nbr_ok).
@@ -959,7 +961,13 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     batch is dropped this heartbeat (queue_cap backpressure).
     ``app_gathered`` is the pre-gathered P5 plane when the coalesced wire
     exchange carried it (app_score is phase-invariant, so the head gather
-    equals the tail gather bit-for-bit)."""
+    equals the tail gather bit-for-bit).
+    ``adversary`` (a chaos.adversary.AdversaryConsts, None = elided)
+    applies the heartbeat-cadence attacker behaviors: self-promotion
+    pins sybil-held scores of fellow sybils, graft-spam overwrites the
+    GRAFT outbox ignoring backoff (and zeroes the attackers' own
+    backoff bookkeeping — raw-wire fakes keep no router state), and
+    lie-in-IHAVE advertises every live message id on every edge."""
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -986,12 +994,33 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     clear_now = (tick % cfg.backoff_clear_ticks) == 0
     expired = (st.backoff_expire + cfg.backoff_slack_ticks) < tick
     backoff_present = jnp.where(clear_now, st.backoff_present & ~expired, st.backoff_present)
+    # adversary graft-spam: attackers keep NO backoff bookkeeping (the
+    # reference attacker is a raw-wire fake with no router state), and
+    # the clear must land BEFORE the candidate filter below — a spam
+    # attacker pruned last round re-grafts its victims immediately
+    # (clearing only at the tail would leave the heartbeat's candidate
+    # set backoff-excluded while the post-step state reads clear, a
+    # decision/check mismatch the degree-bound oracle would flag)
+    if adversary is not None and adversary.has("graft_spam"):
+        spam_a = adversary.active_self("graft_spam", tick)
+        backoff_present = jnp.where(spam_a[:, None, None], False,
+                                    backoff_present)
 
     # refreshScores + memoized score cache (gossipsub.go:1333-1341)
     if cfg.score_enabled:
         score = refresh_scores(score, st.mesh, tick, tp, score_params)
         scores = compute_scores(score, st.mesh, tp, score_params, st.p6,
                                 st.app_score, net, app_gathered=app_gathered)
+        # adversary self-promotion (chaos/adversary.py): cooperating
+        # sybils pin their held scores of FELLOW sybils at the promo
+        # value — applied to the memoized plane at refresh, so every
+        # consumer (mesh maintenance, gossip targeting, accept gates,
+        # the wire score column) sees the faction's cohesion; honest
+        # peers' scoring of sybils (the defense) is untouched
+        if adversary is not None and adversary.has("self_promo"):
+            promo = adversary.active_self("self_promo", tick)
+            scores = jnp.where(promo[:, None] & adversary.sybil_nbr,
+                               adversary.promo_score, scores)
     else:
         scores = st.scores
 
@@ -1211,6 +1240,42 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         ok = net.nbr_ok if present_ok is None else present_ok
         edge_live = jnp.where(redial, edge_live | (direct_sym & ok), edge_live)
 
+    # ---- adversary heartbeat behaviors (chaos/adversary.py §13) ---------
+    graft_out_next = new_grafts
+    if adversary is not None:
+        if adversary.has("graft_spam"):
+            # GRAFT every eligible (live slot, edge) ignoring backoff
+            # (the GRAFT-flood attacker, gossipsub_spam_test.go:365);
+            # spam attackers keep no backoff bookkeeping of their own —
+            # the reference attacker is a raw-wire fake with no router
+            # state — so their planes zero (the oracle plane's backoff
+            # properties quantify over peers that RUN the router)
+            spam_a = adversary.active_self("graft_spam", tick)
+            spam = (spam_a[:, None, None] & slot_live[:, :, None]
+                    & adversary.spam_edges[:, None, :])
+            graft_out_next = graft_out_next | spam
+            backoff_present = jnp.where(spam_a[:, None, None], False,
+                                        backoff_present)
+            backoff_expire = jnp.where(spam_a[:, None, None], 0,
+                                       backoff_expire)
+            if cfg.count_events:
+                events = events.at[EV.ADV_GRAFT_SPAM].add(
+                    jnp.sum(spam.astype(jnp.int32)))
+        if adversary.has("lie_ihave"):
+            # advertise EVERY live message id on every present edge,
+            # held or not (IHAVE spam, gossipsub_spam_test.go:290) —
+            # the victims' IWANTs go unserved (the attacker's real
+            # mcache lacks the ids), breaking gossip promises → P7
+            lie_a = adversary.active_self("lie_ihave", tick)
+            live_w = bitset.pack(st.core.msgs.birth >= 0)     # [W]
+            lie = jnp.where((lie_a[:, None] & net.nbr_ok)[:, :, None],
+                            live_w[None, None, :], jnp.uint32(0))
+            if cfg.count_events:
+                events = events.at[EV.ADV_IHAVE_LIE].add(
+                    bitset.popcount(lie & ~ihave_out, axis=None)
+                    .sum().astype(jnp.int32))
+            ihave_out = ihave_out | lie
+
     if cfg.count_events:
         events = (
             events.at[EV.GRAFT].add(jnp.sum(new_grafts.astype(jnp.int32)))
@@ -1225,7 +1290,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         backoff_present=backoff_present,
         mcache=mcache,
         ihave_out=ihave_out,
-        graft_out=new_grafts,
+        graft_out=graft_out_next,
         prune_out=st.prune_out | toprune,
         prune_px_out=st.prune_px_out | px_prune,
         peerhave=peerhave,
@@ -1319,6 +1384,7 @@ class StepConsts:
     __slots__ = (
         "score_params", "tp", "tpa", "window_rounds_t", "nbr_sub_const",
         "flood_from", "i_am_floodsub", "nbr_sub_words", "sender_fwd_ok",
+        "adv",
     )
 
     def __init__(self, **kw):
@@ -1334,6 +1400,7 @@ def prepare_step_consts(
     gater_params,
     sub_knowledge_holes: np.ndarray | None,
     adversary_no_forward: np.ndarray | None,
+    adversary=None,
 ) -> StepConsts:
     """Validate the configuration and build the static topology constants
     (see the field comments inline — each maps a reference-side check)."""
@@ -1398,11 +1465,21 @@ def prepare_step_consts(
         sender_fwd_ok = ~adv[jnp.clip(net.nbr, 0)] & net.nbr_ok  # [N,K]
     else:
         sender_fwd_ok = None
+    # adversary plane (chaos/adversary.py): None elides it statically;
+    # when live, every per-peer plane and its neighbor view is an EAGER
+    # jit constant here, so per-round activity tests are elementwise
+    # compares against the tick — zero extra halo permutes
+    adversary = adversary_mod.resolve(adversary)
+    adv_consts = (
+        adversary_mod.AdversaryConsts(adversary, net)
+        if adversary is not None else None
+    )
     return StepConsts(
         score_params=score_params, tp=tp, tpa=tpa,
         window_rounds_t=window_rounds_t, nbr_sub_const=nbr_sub_const,
         flood_from=flood_from, i_am_floodsub=i_am_floodsub,
         nbr_sub_words=nbr_sub_words, sender_fwd_ok=sender_fwd_ok,
+        adv=adv_consts,
     )
 
 
@@ -1741,6 +1818,7 @@ def make_gossipsub_step(
     static_heartbeat: bool = False,
     sub_knowledge_holes: np.ndarray | None = None,
     telemetry=None,
+    adversary=None,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
@@ -1786,10 +1864,20 @@ def make_gossipsub_step(
     (``GossipSubState.init(telemetry=...)``). None (the default) elides
     the plane statically: the traced program and the state tree are the
     pre-telemetry ones, bit for bit.
+
+    ``adversary`` (a chaos.adversary.Adversary) arms the vectorized
+    attack suite (docs/DESIGN.md §13): per-peer sybil/behavior masks
+    drive drop-on-forward, lie-in-IHAVE, graft-spam, self-promotion
+    and censorship as masked variants of this step's own math, with
+    per-peer onset/stop schedules compared against the tick on device
+    (stateless — checkpoints resume the exact attack sequence). None
+    (or an all-off population) elides the plane statically: the traced
+    program is the pre-adversary one, bit for bit
+    (tests/test_adversary.py).
     """
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
-        sub_knowledge_holes, adversary_no_forward,
+        sub_knowledge_holes, adversary_no_forward, adversary,
     )
     score_params = consts.score_params
     tp = consts.tp
@@ -1815,6 +1903,7 @@ def make_gossipsub_step(
     # the pre-chaos one, bit for bit (tests/test_chaos.py)
     chaos = chaos_faults.resolve(cfg.chaos)
     chaos_sched = chaos is not None and chaos.scheduled
+    adv = consts.adv
 
     fused_env = os.environ.get("PUBSUB_FUSED", "")
     fused_eligible = (
@@ -1824,6 +1913,7 @@ def make_gossipsub_step(
         and cfg.queue_cap == 0
         and not _old_pallas
         and chaos is None  # the fused halo kernel predates the chaos plane
+        and adv is None    # ... and the adversary plane
     )
     fused_interp = jax.default_backend() != "tpu"
     use_fused = fused_eligible and fused_env == "1"
@@ -1929,6 +2019,7 @@ def make_gossipsub_step(
         joined_words = joined_msg_words(net_l, core.msgs)
         slotw = slot_topic_words(net_l, core.msgs.topic)
         pre_have = core.dlv.have
+        n_adv_drop = None
         if use_fused:
             if core.msgs.wire_block is not None:
                 raise NotImplementedError(
@@ -2053,6 +2144,27 @@ def make_gossipsub_step(
             if sender_fwd_ok is not None:
                 edge_mask = jnp.where(sender_fwd_ok[:, :, None], edge_mask, jnp.uint32(0))
                 iwant_resp = jnp.where(sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0))
+            # adversary data plane (chaos/adversary.py): drop-on-
+            # forward / censorship suppress bits on edges from ACTIVE
+            # attackers — one AND into the receiver gathers the step
+            # already performs, zero extra halo permutes (the behavior
+            # masks and their neighbor views are eager jit constants)
+            if adv is not None and adv.data_plane:
+                edge_mask, rem_mask = adv.mask_transmit_nbr(
+                    tick, edge_mask, core.msgs)
+                iwant_resp, rem_resp = adv.mask_transmit_nbr(
+                    tick, iwant_resp, core.msgs)
+                if cfg.count_events:
+                    # withheld-transmission attribution: suppressed
+                    # carry bits ∩ the senders' forward sets (the same
+                    # fwd gather delivery_round performs — XLA CSE
+                    # merges the two); IWANT-response bits are actual
+                    # serves, counted whole
+                    fwd_g = net_l.peer_gather(core.dlv.fwd)
+                    n_adv_drop = (
+                        bitset.popcount(rem_mask & fwd_g, axis=None).sum()
+                        + bitset.popcount(rem_resp, axis=None).sum()
+                    ).astype(jnp.int32)
             dlv, info = delivery_round(
                 net_l, core.msgs, core.dlv, edge_mask, tick,
                 count_events=cfg.count_events, queue_cap=cfg.queue_cap,
@@ -2197,6 +2309,8 @@ def make_gossipsub_step(
                     chaos_faults.count_links_down(net.nbr, net_l.nbr_ok,
                                                   link_ok)
                 ).at[EV.IWANT_RECOVER].add(n_iwant_rec)
+            if n_adv_drop is not None:
+                events = events.at[EV.ADV_DROP].add(n_adv_drop)
         core_next = core.replace(msgs=msgs, dlv=dlv, events=events)
         if chaos is not None and chaos.needs_state:
             core_next = core_next.replace(
@@ -2241,7 +2355,7 @@ def make_gossipsub_step(
             return heartbeat(
                 cfg, net_l, s, tp, score_params, nbr_sub_l, gater_params,
                 nbr_sub_words_l, present_ok=net.nbr_ok,
-                gossip_suppress=gossip_suppress,
+                gossip_suppress=gossip_suppress, adversary=adv,
             )
 
         if cfg.heartbeat_every == 1:

@@ -131,6 +131,16 @@ from .gossipsub import (
 )
 
 
+class PhaseAdmissionError(ValueError):
+    """The phase publish schedule can re-allocate message slots WITHIN
+    one phase (``rounds_per_phase * pub_width > msg_slots``) — the
+    deferred recycled-slot clears' exactness assumption breaks, so the
+    built step refuses at trace time (ADVICE round 5, item 2: the
+    engine layer enforces what previously only ``api.Network._run_phase``
+    enforced). Cap admitted publishes (``admission_capped=True`` after
+    doing so), raise ``msg_slots``, or lower the publish rate."""
+
+
 class _AccStack:
     """The phase's attribution accumulators as ONE edge-axis-stacked
     ``[N, C, W]`` tensor (round-7 tentpole): every live plane — [N, W]
@@ -232,6 +242,7 @@ def make_gossipsub_phase_step(
     exact_counters: bool = False,
     admission_capped: bool = False,
     telemetry=None,
+    adversary=None,
 ):
     """Build the jitted multi-round phase step.
 
@@ -278,13 +289,23 @@ def make_gossipsub_phase_step(
     with the same config (``GossipSubState.init(telemetry=...)``) and a
     driver must start ticks at a multiple of r (every scan/driver does —
     the row index is ``tick0 // r``). None elides the plane statically.
+
+    ``adversary`` (a chaos.adversary.Adversary) arms the vectorized
+    attack suite (docs/DESIGN.md §13) at phase cadence: the data-plane
+    behaviors (drop-on-forward, censorship) mask each sub-round's
+    SENDER-side transmit composition with that round's own activity
+    window, and the heartbeat-cadence behaviors (lie-in-IHAVE,
+    graft-spam, self-promotion) ride the phase-tail heartbeat. None
+    elides the plane statically (tests/test_adversary.py pins
+    bit-exact adversary-off parity on the stacked wire path).
     """
     r = int(rounds_per_phase)
     assert r >= 1
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
-        sub_knowledge_holes, adversary_no_forward,
+        sub_knowledge_holes, adversary_no_forward, adversary,
     )
+    adv = consts.adv
     tp = consts.tp
     # chaos plane: None elides it statically (the traced program is the
     # pre-chaos one — tests/test_chaos.py pins bit-exactness and `make
@@ -352,21 +373,39 @@ def make_gossipsub_phase_step(
         m = core.msgs.capacity
         w = bitset.n_words(m)
 
-        # the admission invariant, checked at trace time (shapes are
+        # the admission invariant, enforced at trace time (shapes are
         # static): see the builder docstring. ADVICE round 5 item 2.
-        if not admission_capped and r * pub_origin.shape[-1] > m // 2:
-            import warnings
+        # Two tiers: a schedule that can exceed msg_slots WITHIN one
+        # phase would re-allocate a slot inside its own phase — the
+        # deferred recycled-slot clears are then WRONG, not merely
+        # lossy, so that is a hard error; the (msg_slots//2, msg_slots]
+        # band stays a warning (in-flight receipts of the previous
+        # occupants can be wiped before the boundary drain sees them).
+        if not admission_capped:
+            flat_cap = r * pub_origin.shape[-1]
+            if flat_cap > m:
+                raise PhaseAdmissionError(
+                    f"phase publish capacity rounds_per_phase*pub_width = "
+                    f"{r}*{pub_origin.shape[-1]} = {flat_cap} exceeds "
+                    f"msg_slots = {m}: a slot can be re-allocated WITHIN "
+                    "one phase, which the deferred recycled-slot clears "
+                    "assume never happens. Cap admitted publishes at "
+                    f"{m // 2} per phase (api.Network._run_phase does; "
+                    "pass admission_capped=True once you do), raise "
+                    "msg_slots, or lower the publish rate."
+                )
+            if flat_cap > m // 2:
+                import warnings
 
-            warnings.warn(
-                f"phase publish capacity rounds_per_phase*pub_width = "
-                f"{r}*{pub_origin.shape[-1]} exceeds msg_slots//2 = {m // 2}: "
-                "slots recycled within a phase silently wipe in-flight "
-                "receipts (and the deferred recycled-slot clears assume no "
-                "within-phase re-allocation). Cap admitted publishes at "
-                f"{m // 2} per phase (api.Network._run_phase does), raise "
-                "msg_slots, or lower the publish rate.",
-                stacklevel=3,
-            )
+                warnings.warn(
+                    f"phase publish capacity rounds_per_phase*pub_width = "
+                    f"{r}*{pub_origin.shape[-1]} exceeds msg_slots//2 = "
+                    f"{m // 2}: slots recycled within a phase silently wipe "
+                    "in-flight receipts. Cap admitted publishes at "
+                    f"{m // 2} per phase (api.Network._run_phase does), "
+                    "raise msg_slots, or lower the publish rate.",
+                    stacklevel=3,
+                )
 
         acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
                                        core.key, tick0)
@@ -428,6 +467,17 @@ def make_gossipsub_phase_step(
             iwant_resp = jnp.where(
                 consts.sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0)
             )
+        # adversary data plane: an active drop/censor attacker withholds
+        # its IWANT service too (the responses ride sub-round 0, so the
+        # head tick's activity window applies) — receiver-side nbr-view
+        # constants, zero extra halo permutes
+        n_adv_drop = None
+        if adv is not None and adv.data_plane:
+            iwant_resp, rem_resp = adv.mask_transmit_nbr(
+                tick0, iwant_resp, core.msgs)
+            if cfg.count_events:
+                n_adv_drop = bitset.popcount(
+                    rem_resp, axis=None).sum().astype(jnp.int32)
         iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
 
         # phase-fixed data-plane constants (the r-round control latency:
@@ -621,6 +671,18 @@ def make_gossipsub_phase_step(
                 send = jnp.where(
                     adv_self[:, None, None], jnp.uint32(0), send
                 )
+            if adv is not None and adv.data_plane:
+                # scheduled drop/censor attackers mask their OWN rows
+                # before the one edge gather (sender-side — the phase
+                # engine's transmit composition), each sub-round under
+                # its own tick's activity window; the removed bits are
+                # the withheld-transmission attribution (sender-side —
+                # an upper bound: the receiver's joined/origin/link
+                # gates apply after the gather)
+                send, rem_send = adv.mask_transmit_self(tick_i, send, msgs)
+                if cfg.count_events:
+                    n_adv_drop = n_adv_drop + bitset.popcount(
+                        rem_send, axis=None).sum().astype(jnp.int32)
             trans = jnp.where(
                 gate_i[:, :, None], net_l.edge_gather(send), jnp.uint32(0)
             )
@@ -951,6 +1013,8 @@ def make_gossipsub_phase_step(
                 events = events.at[EV.LINK_DOWN].add(n_link_down)
                 if n_iwant_rec is not None:
                     events = events.at[EV.IWANT_RECOVER].add(n_iwant_rec)
+            if n_adv_drop is not None:
+                events = events.at[EV.ADV_DROP].add(n_adv_drop)
 
         core_next = core.replace(msgs=msgs, dlv=dlv, events=events,
                                  tick=tick_last)
@@ -996,6 +1060,7 @@ def make_gossipsub_phase_step(
                 cfg, net_l, st2, tp, consts.score_params, nbr_sub_l,
                 gater_params, nbr_sub_words_l, present_ok=net.nbr_ok,
                 gossip_suppress=gossip_suppress, app_gathered=app_g,
+                adversary=adv,
             )
 
         # telemetry row — one per phase, recorded LAST (after the

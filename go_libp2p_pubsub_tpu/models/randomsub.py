@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import adversary as adversary_mod
 from ..chaos import faults as chaos_faults
 from ..ops.select import select_random_mask
 from ..score.engine import slot_topic_words
@@ -34,7 +35,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
                         queue_cap: int = 0,
                         stacked: bool = True,
                         chaos: "chaos_faults.ChaosConfig | None" = None,
-                        telemetry=None):
+                        telemetry=None,
+                        adversary=None):
     """Build the jitted per-round RandomSub step.
 
     `size_estimate` mirrors the reference's static network-size parameter:
@@ -64,9 +66,18 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
     ``telemetry`` (a telemetry.TelemetryConfig) appends the per-round
     panel recorder as the step's last operation (mesh/score columns
     record zeros — randomsub has neither plane); the state needs
-    ``SimState.init(telemetry=...)``. None elides it statically."""
+    ``SimState.init(telemetry=...)``. None elides it statically.
+
+    ``adversary`` (a chaos.adversary.Adversary) applies the attack
+    plane's DATA behaviors — drop-on-forward and censorship, masked
+    into the receiver gather with eager neighbor-view constants (zero
+    extra halo permutes); the mesh/score behaviors have no randomsub
+    analogue. None elides it statically."""
     chaos = chaos_faults.resolve(chaos)
     chaos_sched = chaos is not None and chaos.scheduled
+    adv_pop = adversary_mod.resolve(adversary)
+    adv = (adversary_mod.AdversaryConsts(adv_pop, net)
+           if adv_pop is not None else None)
     protocol = np.asarray(net.protocol)
     if size_estimate is not None:
         gs_size = np.full((net.n_topics,), size_estimate, np.int64)
@@ -117,6 +128,12 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
                 ge_bad, link_deny,
             )
             edge_mask = jnp.where(link_ok[:, :, None], edge_mask, jnp.uint32(0))
+        n_adv_drop = None
+        if adv is not None and adv.data_plane:
+            edge_mask, removed = adv.mask_transmit_nbr(tick, edge_mask,
+                                                       st.msgs)
+            n_adv_drop = adversary_mod.withheld_count(net, st.dlv.fwd,
+                                                      removed)
 
         dlv, info = delivery_round(net, st.msgs, st.dlv, edge_mask, tick,
                                    queue_cap=queue_cap)
@@ -131,6 +148,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
             )
             if chaos.needs_state:
                 st = st.replace(chaos=st.chaos.replace(ge_bad=ge_bad_next))
+        if n_adv_drop is not None:
+            events = events.at[EV.ADV_DROP].add(n_adv_drop)
         telem = st.telem
         if telemetry is not None:
             from ..telemetry import panel as _tele

@@ -82,6 +82,48 @@ INVARIANTS_OFF = {"enabled": False, "engine": None, "properties": [],
                   "last_checked_round": -1, "violations": []}
 
 
+#: the adversary-plane defaults every artifact WITHOUT an adversary
+#: block reads back as (the whole pre-round-13 trajectory was measured
+#: against an honest population; the static bench `sybil` config's
+#: no-forward vector predates the plane and is fingerprinted as
+#: ``adversary_fraction`` in the workload block instead)
+ADVERSARY_OFF = {"enabled": False, "n_sybils": 0, "behaviors": [],
+                 "onset": 0, "stop": None, "promo_score": 0.0,
+                 "population": None, "scenario": None}
+
+#: the score-weight defaults every artifact WITHOUT a
+#: fingerprint["score_weights"] block reads back as (ADVICE round 5
+#: item 1: the P4-weight-zeroing that enables trans-plane elision must
+#: be visible in the JSON itself — a legacy line can only answer
+#: "unrecorded", never silently "zero")
+SCORE_WEIGHTS_UNKNOWN = {"recorded": False}
+
+
+def adversary_fingerprint(adversary=None, scenario=None) -> dict:
+    """The schema-v3 ``fingerprint["adversary"]`` block: the attacker
+    population's self-description (duck-typed via ``fingerprint()`` —
+    chaos.adversary.Adversary — so this module stays jax-free) plus the
+    AttackScenario schedule hash. No arguments = the explicit off block
+    new honest-population artifacts carry."""
+    out = dict(ADVERSARY_OFF)
+    if adversary is not None and getattr(adversary, "enabled", False):
+        out.update(adversary.fingerprint())
+    if scenario is not None:
+        out["scenario"] = scenario.scenario_hash()
+    return out
+
+
+def score_weights_fingerprint(**weights) -> dict:
+    """The ``fingerprint["score_weights"]`` block for a producer that
+    knows its weights (``recorded: True`` + the named weight values) —
+    the self-description satellite of ADVICE round 5 item 1. Readers go
+    through :attr:`BenchRecord.score_weights`, which defaults legacy
+    lines to :data:`SCORE_WEIGHTS_UNKNOWN`."""
+    out = {"recorded": True}
+    out.update({k: float(v) for k, v in weights.items()})
+    return out
+
+
 def ensemble_fingerprint(n_sims: int = 1,
                          aggregation: str = "quantile_band") -> dict:
     """The schema-v2 ``fingerprint["ensemble"]`` block for an
@@ -215,6 +257,38 @@ class BenchRecord:
     @property
     def n_sims(self) -> int:
         return int(self.ensemble["n_sims"])
+
+    @property
+    def adversary(self) -> dict:
+        """The adversary block of the fingerprint. LEGACY artifacts
+        (every line that predates the adversary plane) read back as
+        :data:`ADVERSARY_OFF`, so readers can ask any artifact "was
+        this measured under attack, by whom" without special-casing
+        age; ``adversary["enabled"]`` says whether one was armed."""
+        fp = self.fingerprint or {}
+        out = dict(ADVERSARY_OFF)
+        out.update(fp.get("adversary") or {})
+        return out
+
+    @property
+    def adversary_on(self) -> bool:
+        return bool(self.adversary["enabled"])
+
+    @property
+    def score_weights(self) -> dict:
+        """The score-weight block of the fingerprint (ADVICE round 5
+        item 1). Producers that record their weights carry
+        ``recorded: True`` plus the named values (the sweep's workload
+        fingerprint and the chaos/attack report lines do); LEGACY
+        artifacts read back :data:`SCORE_WEIGHTS_UNKNOWN` — an explicit
+        "unrecorded" sentinel, never a silently-assumed zero."""
+        fp = self.fingerprint or {}
+        sw = fp.get("score_weights")
+        if not sw:
+            return dict(SCORE_WEIGHTS_UNKNOWN)
+        out = {"recorded": True}
+        out.update(sw)
+        return out
 
     @property
     def timeline(self) -> dict:

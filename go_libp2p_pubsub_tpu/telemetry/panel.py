@@ -57,6 +57,9 @@ EV_METRICS = (
     "ev_prune",
     "ev_link_down",
     "ev_iwant_recover",
+    "ev_adv_drop",
+    "ev_adv_ihave_lie",
+    "ev_adv_graft_spam",
 )
 
 #: EV columns whose summed deltas must equal the end-of-run drained

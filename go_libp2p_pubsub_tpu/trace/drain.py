@@ -59,12 +59,15 @@ PROTOCOL_NAMES = {0: "/floodsub/1.0.0", 1: "/meshsub/1.0.0", 2: "/meshsub/1.1.0"
 
 #: sim-only counters with NO trace.proto record type: never expanded
 #: into per-event TraceEvents (not even in exact mode — the reference's
-#: event stream has no LinkDown/IwantRecover records to emit), exposed
+#: event stream has no LinkDown/IwantRecover records, and its attackers
+#: are raw-wire test fakes its tracer never sees, so there are no
+#: AdvDrop/AdvIhaveLie/AdvGraftSpam records either), exposed
 #: exclusively through ``counter_events()`` at phase-cadence resolution
-#: (docs/DESIGN.md §8). Every other EV.* member maps 1:1 to a
+#: (docs/DESIGN.md §8, §13). Every other EV.* member maps 1:1 to a
 #: TraceEvent emission below; the ``ev-drain`` simlint rule
 #: (analysis/simlint.py) pins both halves of that contract.
-COUNTER_ONLY_EVENTS = (EV.LINK_DOWN, EV.IWANT_RECOVER)
+COUNTER_ONLY_EVENTS = (EV.LINK_DOWN, EV.IWANT_RECOVER,
+                       EV.ADV_DROP, EV.ADV_IHAVE_LIE, EV.ADV_GRAFT_SPAM)
 
 
 def peer_id(i: int) -> bytes:

@@ -37,6 +37,22 @@ class EV(enum.IntEnum):
     # counts events, so non-chaos accounting is unchanged.
     LINK_DOWN = 13       # undirected live links down (flap/partition) per round, summed
     IWANT_RECOVER = 14   # validated deliveries whose FIRST arrival rode IWANT service
+    # --- sim-only adversary-plane counters (chaos/adversary.py;
+    # docs/DESIGN.md §13): attacker-vs-honest attribution with no
+    # trace.proto counterpart — the reference's attackers are raw-wire
+    # test fakes outside its tracer. Statically elided unless an
+    # adversary-enabled build counts events.
+    ADV_DROP = 15        # forwardable (edge, msg) transmissions withheld by
+                         # drop-on-forward / censorship attackers. Engine-
+                         # approximate attribution (the one adversary counter
+                         # whose totals differ across cadences): the per-round
+                         # engines count receiver-side after their gates, the
+                         # phase engine sender-side before them — cross-engine
+                         # parity under attack is bit-exact on every OTHER
+                         # leaf (tests/test_adversary.py)
+    ADV_IHAVE_LIE = 16   # lying IHAVE advertisement bits emitted (ids the
+                         # attacker never held) per heartbeat, summed
+    ADV_GRAFT_SPAM = 17  # spam GRAFTs emitted ignoring PRUNE backoff
 
 
 N_EVENTS = len(EV)

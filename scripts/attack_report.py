@@ -1,0 +1,625 @@
+"""Adversary-plane attack runner + the ``make attack-smoke`` gate.
+
+Reproduces the GossipSub v1.1 hardening paper's attack evaluation
+(arXiv:2007.02754) as ensemble bands: vectorized attacker populations
+(chaos/adversary.py, docs/DESIGN.md §13) run INSIDE the same jitted
+steps as the honest network, S sims per cell as one vmapped program
+(ensemble plane), with the invariant oracle hook (docs/DESIGN.md §12)
+ENABLED — the paper's strongest claim is protocol conformance *under*
+attack, so every cell asserts zero property violations.
+
+  * **sybil-flood** — a 20% sybil faction running the full suite
+    (drop-on-forward + lie-in-IHAVE + graft-spam + self-promotion) on
+    a lossy wire (i.i.d. flap — the chaos plane composes), PAIRED per
+    sim against an attack-free ablation on IDENTICAL fault/PRNG
+    streams. Gates: honest delivery stays within band of the ablation
+    in every sim; attacker-as-receiver delivery separates below honest
+    delivery in every sim (graylisted peers stop being served); the
+    honest population's median score of attacker edges lands below the
+    graylist threshold while honest-edge medians stay >= 0 — the
+    paper's score-isolation figure as a per-sim gate.
+  * **eclipse** — a target set whose topology neighborhood is half
+    sybil (AttackScenario surround placement): graft-spam toward the
+    targets takes their meshes over, drop-on-forward starves them, and
+    the scoring machinery (P3 deficit -> prune -> graylist -> spam
+    rejected at ingress) must hand the meshes back — every sim's
+    targets recover an all-honest mesh within a bounded tick count
+    after onset, with the takeover actually observed first.
+
+``--smoke`` additionally asserts the acceptance invariants plus the
+CHAOS-OFF **and ADVERSARY-OFF** compiled HLO kernel census equality vs
+the committed PERF_SMOKE baseline (the elision-when-off contract at
+the compiler level — the adversary plane must cost literally nothing
+when unarmed) and the one-compile cache sentinels, exiting non-zero on
+any failure. CPU-only by contract, like the sibling gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: smoke-shape defaults (the chaos_report sizing logic: big enough for
+#: real score dynamics and a recovery tail, small enough for tens of
+#: seconds warm)
+SYBIL_N = 128
+SYBIL_FRACTION = 0.2
+SYBIL_ONSET = 12
+SYBIL_ROUNDS = 72
+SYBIL_LOSS = 0.10
+ECLIPSE_N = 96
+ECLIPSE_ONSET = 20
+ECLIPSE_ROUNDS = 88
+ECLIPSE_TARGETS = (0, 1, 2)
+#: ticks after onset within which every sim's targets must hold an
+#: all-honest mesh again (scoring reacts at heartbeat cadence: P3
+#: activation ~8 ticks, deficit prune, graylist, spam rejected)
+ECLIPSE_RECOVER_BOUND = 56
+SMOKE_SEEDS = 8
+
+#: the measured-delivery window of the sybil cell: messages born while
+#: the attack is fully active (post-onset, pre-tail) — delivery is read
+#: at run end, so the window only needs to avoid slot recycling (the
+#: publish schedule stays under msg_slots)
+SYBIL_BORN = (SYBIL_ONSET + 4, SYBIL_ONSET + 24)
+#: ablation tolerance: honest delivery under attack must stay within
+#: this of the SAME sim's attack-free run (identical fault streams)
+SYBIL_ABLATION_TOL = 0.05
+
+
+def _attack_score_params():
+    """P3 deficit + P2 credit + P7 behaviour penalty — the v1.1
+    security plane with every attacker-catching term live (the
+    weights the smoke's score-isolation gate prices)."""
+    from go_libp2p_pubsub_tpu.config import PeerScoreParams, TopicScoreParams
+
+    tp = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.0,
+        first_message_deliveries_weight=0.5,
+        first_message_deliveries_cap=50.0,
+        first_message_deliveries_decay=0.9,
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_decay=0.9,
+        mesh_message_deliveries_cap=20.0,
+        mesh_message_deliveries_threshold=0.5,
+        mesh_message_deliveries_window=2.0,
+        mesh_message_deliveries_activation=8.0,
+        mesh_failure_penalty_weight=-1.0,
+        mesh_failure_penalty_decay=0.9,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-10.0,
+        behaviour_penalty_threshold=0.0,
+        behaviour_penalty_decay=0.9,
+        ip_colocation_factor_weight=0.0,
+    )
+    return tp, sp
+
+
+def _thresholds():
+    from go_libp2p_pubsub_tpu.config import PeerScoreThresholds
+
+    return PeerScoreThresholds(
+        gossip_threshold=-2.0,
+        publish_threshold=-4.0,
+        graylist_threshold=-8.0,
+        accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+
+
+def _overlay_params():
+    """Low-degree v1.1 overlay (the chaos-smoke shape): D=3 leaves
+    non-mesh neighbors for gossip, K=8 keeps the cells fast."""
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+
+    return GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1,
+                           history_length=6, history_gossip=4)
+
+
+def _score_weights_block(tp, sp):
+    from go_libp2p_pubsub_tpu.perf.artifacts import score_weights_fingerprint
+
+    return score_weights_fingerprint(
+        mesh_message_deliveries_weight=tp.mesh_message_deliveries_weight,
+        mesh_failure_penalty_weight=tp.mesh_failure_penalty_weight,
+        invalid_message_deliveries_weight=tp.invalid_message_deliveries_weight,
+        first_message_deliveries_weight=tp.first_message_deliveries_weight,
+        time_in_mesh_weight=tp.time_in_mesh_weight,
+        behaviour_penalty_weight=sp.behaviour_penalty_weight,
+    )
+
+
+def _edge_masks(net, is_sybil):
+    """(honest->sybil, honest->honest) [N, K] bool edge masks."""
+    nbr = np.clip(np.asarray(net.nbr), 0, None)
+    ok = np.asarray(net.nbr_ok)
+    att = ok & is_sybil[nbr] & ~is_sybil[:, None]
+    hon = ok & ~is_sybil[nbr] & ~is_sybil[:, None]
+    return att, hon
+
+
+def _per_sim_medians(scores, edge_mask):
+    """[S] medians of a batched [S, N, K] score plane over an edge
+    mask."""
+    sc = np.asarray(scores)
+    return np.asarray([float(np.median(sc[i][edge_mask]))
+                       for i in range(sc.shape[0])])
+
+
+def _honest_publish_schedule(rng, honest_ids, rounds, pub_rounds, width=2):
+    """Publish batches drawn from HONEST origins only (an attacker
+    origin would withhold its own publish — the measured delivery
+    window must start from honest sources, like the paper's)."""
+    po = np.full((rounds, width), -1, np.int32)
+    for t in range(*pub_rounds):
+        po[t] = rng.choice(honest_ids, size=width)
+    pt = np.zeros((rounds, width), np.int32)
+    pv = np.ones((rounds, width), bool)
+    return po, pt, pv
+
+
+def run_sybil_flood(n=SYBIL_N, fraction=SYBIL_FRACTION, loss=SYBIL_LOSS,
+                    onset=SYBIL_ONSET, rounds=SYBIL_ROUNDS, seed=0,
+                    seeds=SMOKE_SEEDS, invariants=True):
+    """The sybil-flood cell + its paired attack-free ablation.
+
+    Both runs share the topology, subscriptions, publish schedule, sim
+    keys (hence chaos fault streams and every sampler stream) — the
+    per-sim honest-delivery delta is the ATTACK's causal effect, the
+    chaos-smoke pairing discipline applied to an adversary."""
+    from go_libp2p_pubsub_tpu import ensemble, graph
+    from go_libp2p_pubsub_tpu.chaos import AttackScenario, ChaosConfig
+    from go_libp2p_pubsub_tpu.ensemble import stats as estats
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    s = int(seeds)
+    topo = graph.random_connect(n, d=4, seed=seed)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    tp, sp = _attack_score_params()
+    cc = ChaosConfig(loss_rate=loss)
+    cfg = GossipSubConfig.build(_overlay_params(), _thresholds(),
+                                score_enabled=True, chaos=cc)
+    scenario = AttackScenario(
+        n_peers=n, sybil_fraction=fraction,
+        behaviors=("drop_forward", "lie_ihave", "graft_spam", "self_promo"),
+        onset=onset, seed=seed,
+    )
+    adv = scenario.build()
+    is_sybil = adv.is_sybil
+    honest_ids = np.flatnonzero(~is_sybil)
+    rng = np.random.default_rng(seed)
+    po, pt, pv = _honest_publish_schedule(
+        rng, honest_ids, rounds, (2, SYBIL_BORN[1] + 4))
+    assert 2 * (SYBIL_BORN[1] + 2) <= 128, "publish volume must not recycle"
+
+    def run_one(adversary, hook):
+        st0 = GossipSubState.init(net, 128, cfg, score_params=sp, seed=seed)
+        step = make_gossipsub_step(cfg, net, score_params=sp,
+                                   adversary=adversary)
+        ens = ensemble.lift_step(step)
+        return ensemble.run_rounds(
+            ens, ensemble.batch_states(st0, s),
+            lambda i: (ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
+                       ensemble.tile(pv[i], s)),
+            rounds, invariants=hook,
+        )
+
+    hook = None
+    if invariants:
+        from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+
+        # the flap generator is active for the whole run, so the
+        # delivery-liveness clause is vacuous by the due contract (the
+        # chaos flap cell's precedent); every safety property stays
+        # live under the attack — the acceptance claim
+        hook = oracle_inv.InvariantHook(
+            "gossipsub", net, cfg,
+            oracle_inv.InvariantConfig(check_every=8, delivery_window=12),
+        )
+    arun = run_one(adv, hook)
+    brun = run_one(None, None)  # the paired attack-free ablation
+
+    core = arun.states.core
+    honest_attack = np.asarray(estats.sim_delivery_ratios(
+        core.dlv.first_round, core.msgs.birth, core.msgs.topic,
+        core.msgs.origin, net.subscribed, born_in=SYBIL_BORN,
+        receivers=~is_sybil))
+    sybil_attack = np.asarray(estats.sim_delivery_ratios(
+        core.dlv.first_round, core.msgs.birth, core.msgs.topic,
+        core.msgs.origin, net.subscribed, born_in=SYBIL_BORN,
+        receivers=is_sybil))
+    bcore = brun.states.core
+    honest_ablation = np.asarray(estats.sim_delivery_ratios(
+        bcore.dlv.first_round, bcore.msgs.birth, bcore.msgs.topic,
+        bcore.msgs.origin, net.subscribed, born_in=SYBIL_BORN,
+        receivers=~is_sybil))
+    att_edges, hon_edges = _edge_masks(net, is_sybil)
+    att_scores = _per_sim_medians(arun.states.scores, att_edges)
+    hon_scores = _per_sim_medians(arun.states.scores, hon_edges)
+    out = {
+        "n": n, "rounds": rounds, "seeds": s, "onset": onset,
+        "born": SYBIL_BORN,
+        "chaos": cc, "scenario": scenario, "adversary": adv,
+        "score_weights": _score_weights_block(tp, sp),
+        "graylist_threshold": _thresholds().graylist_threshold,
+        "honest_attack": honest_attack,
+        "honest_attack_band": estats.quantile_band(honest_attack),
+        "sybil_attack": sybil_attack,
+        "sybil_attack_band": estats.quantile_band(sybil_attack),
+        "honest_ablation": honest_ablation,
+        "honest_ablation_band": estats.quantile_band(honest_ablation),
+        "attacker_score_medians": att_scores,
+        "attacker_score_band": estats.quantile_band(att_scores),
+        "honest_score_medians": hon_scores,
+        "honest_score_band": estats.quantile_band(hon_scores),
+        "events": np.asarray(core.events),
+        "compiles": {"attack": arun.compiles, "ablation": brun.compiles},
+    }
+    if hook is not None:
+        out["invariants"] = hook.report()
+        out["invariant_compiles"] = hook.compiles
+    return out
+
+
+def run_eclipse(n=ECLIPSE_N, targets=ECLIPSE_TARGETS, onset=ECLIPSE_ONSET,
+                rounds=ECLIPSE_ROUNDS, seed=1, seeds=SMOKE_SEEDS,
+                invariants=True):
+    """The eclipse/mesh-takeover cell: half of each target's topology
+    neighborhood is sybil; graft-spam (restricted to the targets)
+    takes the victims' meshes over while drop-on-forward starves them.
+    Per-round mesh snapshots measure the takeover and the scoring-
+    driven recovery (P3 deficit -> prune -> graylist -> spam rejected
+    at ingress -> honest re-graft)."""
+    from go_libp2p_pubsub_tpu import ensemble, graph
+    from go_libp2p_pubsub_tpu.chaos import AttackScenario
+    from go_libp2p_pubsub_tpu.ensemble import stats as estats
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    s = int(seeds)
+    topo = graph.random_connect(n, d=6, seed=seed)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    tp, sp = _attack_score_params()
+    cfg = GossipSubConfig.build(_overlay_params(), _thresholds(),
+                                score_enabled=True)
+    scenario = AttackScenario(
+        n_peers=n, targets=tuple(targets), surround_targets=True,
+        surround_fraction=0.5,
+        behaviors=("drop_forward", "graft_spam"),
+        onset=onset, seed=seed,
+    )
+    adv = scenario.build(net)
+    is_sybil = adv.is_sybil
+    honest_ids = np.flatnonzero(~is_sybil)
+    rng = np.random.default_rng(seed)
+    po, pt, pv = _honest_publish_schedule(
+        rng, honest_ids, rounds, (2, 62))
+
+    st0 = GossipSubState.init(net, 128, cfg, score_params=sp, seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp, adversary=adv)
+    ens = ensemble.lift_step(step)
+
+    tlist = list(targets)
+    nbr = np.clip(np.asarray(net.nbr), 0, None)
+    ok = np.asarray(net.nbr_ok)
+    syb_edge_t = ok[tlist] & is_sybil[nbr[tlist]]   # [T, K]
+    hon_edge_t = ok[tlist] & ~is_sybil[nbr[tlist]]
+
+    series: list = []  # (tick, syb_counts [S], hon_counts [S])
+
+    def observe(i, states):
+        mesh_t = np.asarray(states.mesh)[:, tlist, 0, :]  # [S, T, K]
+        syb = (mesh_t & syb_edge_t[None]).sum(axis=(1, 2))
+        hon = (mesh_t & hon_edge_t[None]).sum(axis=(1, 2))
+        series.append((i + 1, syb, hon))
+
+    hook = None
+    if invariants:
+        from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+
+        # lossless wire: pre-onset publishes are due end-to-end (the
+        # non-vacuous liveness leg); the takeover window gets the
+        # fault-scoped grace the due contract defines for active
+        # faults — the attack IS the fault here
+        w = 12
+
+        def due_fn(tick):
+            return oracle_inv.due_vector(
+                quiet=(0, onset),
+                grace=onset <= tick < onset + ECLIPSE_RECOVER_BOUND,
+            )
+
+        hook = oracle_inv.InvariantHook(
+            "gossipsub", net, cfg,
+            oracle_inv.InvariantConfig(check_every=8, delivery_window=w),
+            due_fn=due_fn,
+        )
+    run = ensemble.run_rounds(
+        ens, ensemble.batch_states(st0, s),
+        lambda i: (ensemble.tile(po[i], s), ensemble.tile(pt[i], s),
+                   ensemble.tile(pv[i], s)),
+        rounds, observe=observe, invariants=hook,
+    )
+
+    # takeover depth: max sybil share of the targets' mesh edges after
+    # onset; recovery: first tick at/after the takeover peak where the
+    # targets' meshes are sybil-free AND hold at least one honest edge
+    peak_share = np.zeros(s)
+    recover_tick = np.full(s, np.nan)
+    for i in range(s):
+        peak = 0.0
+        peak_t = onset
+        for t, syb, hon in series:
+            if t < onset:
+                continue
+            tot = syb[i] + hon[i]
+            share = syb[i] / tot if tot else 0.0
+            if share > peak:
+                peak, peak_t = share, t
+        peak_share[i] = peak
+        for t, syb, hon in series:
+            if t >= peak_t and syb[i] == 0 and hon[i] > 0:
+                recover_tick[i] = t
+                break
+    recover_after_onset = recover_tick - onset
+
+    core = run.states.core
+    honest_final = np.asarray(estats.sim_delivery_ratios(
+        core.dlv.first_round, core.msgs.birth, core.msgs.topic,
+        core.msgs.origin, net.subscribed, born_in=(2, onset),
+        receivers=~is_sybil))
+    out = {
+        "n": n, "rounds": rounds, "seeds": s, "onset": onset,
+        "targets": tlist, "scenario": scenario, "adversary": adv,
+        "score_weights": _score_weights_block(tp, sp),
+        "peak_sybil_share": peak_share,
+        "peak_band": estats.quantile_band(peak_share),
+        "recover_ticks": recover_after_onset,
+        "recover_band": estats.quantile_band(recover_after_onset),
+        "pre_onset_honest_delivery": honest_final,
+        "compiles": run.compiles,
+        "events": np.asarray(core.events),
+    }
+    if hook is not None:
+        out["invariants"] = hook.report()
+        out["invariant_compiles"] = hook.compiles
+    return out
+
+
+def _emit(metric, value, unit="ratio", chaos=None, chaos_scenario=None,
+          adversary=None, attack_scenario=None, score_weights=None,
+          extras=None, n_sims=1, invariants=None):
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        BenchRecord,
+        adversary_fingerprint,
+        chaos_fingerprint,
+        dump_record,
+        ensemble_fingerprint,
+    )
+
+    fp = {
+        "chaos": chaos_fingerprint(chaos, chaos_scenario),
+        "ensemble": ensemble_fingerprint(n_sims),
+        "adversary": adversary_fingerprint(adversary, attack_scenario),
+    }
+    if score_weights is not None:
+        fp["score_weights"] = score_weights
+    rec = BenchRecord(
+        metric=metric, value=float(value), unit=unit, vs_baseline=0.0,
+        schema=3, fingerprint=fp, extras=extras or {},
+        invariants_raw=invariants,
+    )
+    print(dump_record(rec), flush=True)
+
+
+def _band_extras(band: dict, per_sim) -> dict:
+    out = {
+        "iqr": [band.get("q25"), band.get("q75")],
+        "min": band.get("min"),
+        "max": band.get("max"),
+        "n_sims": band["n"],
+        "n_undefined": band["n_undefined"],
+        "per_sim": [None if not np.isfinite(v) else round(float(v), 4)
+                    for v in np.asarray(per_sim, np.float64)],
+    }
+    return out
+
+
+def _check_invariants(failures, cell, out):
+    rep = out.get("invariants")
+    if rep is None:
+        failures.append(f"{cell}: the invariant hook did not run")
+        return None
+    if not rep.all_ok:
+        failures.append(
+            f"{cell}: {rep.violated} invariant violation(s) under attack: "
+            f"{rep.violations()}")
+    if rep.checked == 0:
+        failures.append(f"{cell}: the invariant hook checked nothing")
+    if out.get("invariant_compiles") not in (-1, 1):
+        failures.append(
+            f"{cell}: invariant checker ran {out['invariant_compiles']} "
+            "compiles (expected exactly 1)")
+    return rep
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the acceptance invariants; exit 1 on failure")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seeds", type=int, default=SMOKE_SEEDS,
+                    help="sims per cell (one vmapped program)")
+    ap.add_argument("--no-census", action="store_true",
+                    help="skip the adversary-off kernel-census gate")
+    args = ap.parse_args(argv)
+    if args.seeds < 1:
+        ap.error("--seeds must be >= 1")
+
+    # CPU-only by contract (the perf-smoke platform/PRNG pinning)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    enable_persistent_cache(os.path.join(repo_root(), ".jax_cache"))
+
+    failures = []
+
+    # ---- sybil flood ----------------------------------------------------
+    syb = run_sybil_flood(seed=args.seed, seeds=args.seeds)
+    rep = _check_invariants(failures, "sybil-flood", syb)
+    gray = syb["graylist_threshold"]
+    _emit("attack_sybil_honest_delivery", syb["honest_attack_band"]["q50"],
+          chaos=syb["chaos"], adversary=syb["adversary"],
+          attack_scenario=syb["scenario"],
+          score_weights=syb["score_weights"], n_sims=syb["seeds"],
+          invariants=rep.artifact_block() if rep is not None else None,
+          extras={
+              "n_peers": syb["n"], "rounds": syb["rounds"],
+              "onset": syb["onset"], "born_window": list(syb["born"]),
+              "sybil_delivery_median":
+                  round(float(syb["sybil_attack_band"]["q50"]), 4),
+              "sybil_delivery_iqr": [syb["sybil_attack_band"].get("q25"),
+                                     syb["sybil_attack_band"].get("q75")],
+              "honest_ablation_median":
+                  round(float(syb["honest_ablation_band"]["q50"]), 4),
+              "attacker_score_median":
+                  round(float(syb["attacker_score_band"]["q50"]), 4),
+              "honest_score_median":
+                  round(float(syb["honest_score_band"]["q50"]), 4),
+              "graylist_threshold": gray,
+              **_band_extras(syb["honest_attack_band"],
+                             syb["honest_attack"]),
+          })
+    # (a) paired per-sim honest-vs-attacker separation + unharmed honest
+    sep = syb["honest_attack"] - syb["sybil_attack"]
+    if float(sep.min()) <= 0.0:
+        failures.append(
+            "sybil-flood: honest-vs-attacker delivery separation failed in "
+            "at least one sim (per-sim honest-minus-attacker: "
+            f"{[round(float(v), 4) for v in sep]})")
+    harm = syb["honest_ablation"] - syb["honest_attack"]
+    if float(harm.max()) > SYBIL_ABLATION_TOL:
+        failures.append(
+            "sybil-flood: honest delivery under attack fell more than "
+            f"{SYBIL_ABLATION_TOL} below the attack-free ablation on the "
+            "same fault stream in at least one sim (per-sim deltas: "
+            f"{[round(float(v), 4) for v in harm]})")
+    # score isolation, per sim: attackers below the graylist line,
+    # honest edges unharmed
+    if float(syb["attacker_score_medians"].max()) >= gray:
+        failures.append(
+            "sybil-flood: attacker median score failed to cross the "
+            f"graylist threshold {gray} in at least one sim (per-sim: "
+            f"{[round(float(v), 2) for v in syb['attacker_score_medians']]})")
+    if float(syb["honest_score_medians"].min()) < 0.0:
+        failures.append(
+            "sybil-flood: an honest-edge median score went negative "
+            "(per-sim: "
+            f"{[round(float(v), 2) for v in syb['honest_score_medians']]})")
+    for name, nc in sorted(syb["compiles"].items()):
+        if nc not in (-1, 1):
+            failures.append(
+                f"sybil-flood: {name} ensemble ran {nc} compiles "
+                "(expected exactly 1)")
+
+    # ---- eclipse --------------------------------------------------------
+    ecl = run_eclipse(seed=args.seed + 1, seeds=args.seeds)
+    rep = _check_invariants(failures, "eclipse", ecl)
+    _emit("attack_eclipse_recovery_ticks", ecl["recover_band"]["q50"],
+          unit="rounds", adversary=ecl["adversary"],
+          attack_scenario=ecl["scenario"],
+          score_weights=ecl["score_weights"], n_sims=ecl["seeds"],
+          invariants=rep.artifact_block() if rep is not None else None,
+          extras={
+              "n_peers": ecl["n"], "rounds": ecl["rounds"],
+              "onset": ecl["onset"], "targets": ecl["targets"],
+              "peak_sybil_share_median":
+                  round(float(ecl["peak_band"]["q50"]), 4),
+              "peak_sybil_share_min":
+                  round(float(ecl["peak_band"]["min"]), 4),
+              "recover_bound": ECLIPSE_RECOVER_BOUND,
+              **_band_extras(ecl["recover_band"], ecl["recover_ticks"]),
+          })
+    # (b) the takeover must be observed, then recovered from — bounded,
+    # in EVERY sim: every stream shows real sybil mesh presence at the
+    # targets, the MEDIAN stream a sybil-majority mesh (takeover depth
+    # varies with the random overlay draw; recovery is the hard gate)
+    peak = ecl["peak_sybil_share"]
+    if float(peak.min()) <= 0.25:
+        failures.append(
+            "eclipse: the attack never took a meaningful share of the "
+            "targets' meshes in at least one sim (per-sim peak shares: "
+            f"{[round(float(v), 3) for v in peak]})")
+    if float(ecl["peak_band"]["q50"]) < 0.5:
+        failures.append(
+            "eclipse: the median stream never reached a sybil-majority "
+            "mesh at the targets (median peak share "
+            f"{ecl['peak_band']['q50']:.3f})")
+    if ecl["recover_band"]["n_undefined"] > 0:
+        failures.append(
+            f"eclipse: the targets' meshes never recovered an all-honest "
+            f"state in {ecl['recover_band']['n_undefined']}/{ecl['seeds']} "
+            "sims")
+    elif float(np.nanmax(ecl["recover_ticks"])) > ECLIPSE_RECOVER_BOUND:
+        failures.append(
+            "eclipse: mesh recovery exceeded the "
+            f"{ECLIPSE_RECOVER_BOUND}-tick bound in at least one sim "
+            "(per-sim ticks after onset: "
+            f"{[round(float(v), 1) for v in ecl['recover_ticks']]})")
+    if float(ecl["pre_onset_honest_delivery"].min()) < 1.0:
+        failures.append(
+            "eclipse: pre-onset publishes failed to fully deliver to the "
+            "honest population in at least one sim")
+    if ecl["compiles"] not in (-1, 1):
+        failures.append(
+            f"eclipse: ensemble ran {ecl['compiles']} compiles "
+            "(expected exactly 1)")
+
+    # ---- (d) adversary-off census + elision ----------------------------
+    if not args.no_census:
+        import chaos_report
+
+        census = chaos_report.check_census()
+        print(json.dumps({"adversary_off_kernel_census": census}),
+              flush=True)
+        if not census["equal"]:
+            failures.append(
+                f"adversary-off kernel census {census['total']} != "
+                f"committed PERF_SMOKE baseline {census['committed']} — "
+                "the elision-when-off contract broke")
+
+    if args.smoke and failures:
+        for f in failures:
+            print(f"attack-smoke FAIL: {f}", file=sys.stderr)
+        print(json.dumps({"attack_smoke": "FAIL", "errors": len(failures)}))
+        return 1
+    print(json.dumps({"attack_smoke": "PASS" if not failures else "REPORT",
+                      "warnings": failures}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

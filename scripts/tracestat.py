@@ -207,6 +207,19 @@ def artifact_invariants(path: str) -> dict:
     return recs[-1].invariants
 
 
+def artifact_adversary(path: str) -> dict:
+    """The ``adversary`` fingerprint block of a bench artifact's last
+    metric line (perf.artifacts readers; ADVERSARY_OFF for legacy
+    lines and honest-population runs)."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    for rec in reversed(recs):
+        if rec.adversary_on:
+            return rec.adversary
+    return recs[-1].adversary
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("tracefile")
@@ -214,13 +227,15 @@ def main():
     ap.add_argument("--format", choices=("auto", "json", "pb"), default="auto")
     ap.add_argument("--artifact", metavar="RUN.json",
                     help="bench artifact of the same run: report its "
-                         "schema-v3 invariants block (legacy artifacts "
-                         "read back INVARIANTS_OFF)")
+                         "schema-v3 invariants and adversary blocks "
+                         "(legacy artifacts read back INVARIANTS_OFF / "
+                         "ADVERSARY_OFF)")
     args = ap.parse_args()
 
     stats = summarize(read_events(args.tracefile, args.format))
     if args.artifact:
         stats["invariants"] = artifact_invariants(args.artifact)
+        stats["adversary"] = artifact_adversary(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -259,6 +274,18 @@ def main():
         else:
             print("invariants: INVARIANTS_OFF (artifact predates the "
                   "oracle plane or the run checked nothing)")
+    if "adversary" in stats:
+        av = stats["adversary"]
+        if av.get("enabled"):
+            print(
+                f"adversary: {av['n_sybils']} sybils, behaviors "
+                f"{av.get('behaviors')}, onset {av.get('onset')} "
+                f"stop {av.get('stop')} (population "
+                f"{av.get('population')}, scenario {av.get('scenario')})"
+            )
+        else:
+            print("adversary: ADVERSARY_OFF (honest population, or the "
+                  "artifact predates the adversary plane)")
 
 
 if __name__ == "__main__":

@@ -441,3 +441,478 @@ def test_graft_unknown_topic_ignored():
     assert np.array_equal(post_scores, pre_scores), "unknown-topic GRAFT must not move scores"
     # attacker's own mesh for topic 1 stays empty (nobody to graft)
     assert int(np.asarray(st.mesh)[attacker, s1].sum()) == 0
+
+
+# =========================================================================
+# The adversary PLANE (chaos/adversary.py, docs/DESIGN.md §13): scheduled
+# vectorized attacker populations driving the same behaviors as engine
+# hooks — masked variants of the step math — rather than between-step
+# host injection. Elision-when-off is bit-exact on every engine; the
+# behaviors reproduce the manual-injection outcomes above end to end.
+
+import jax
+
+from go_libp2p_pubsub_tpu import checkpoint
+from go_libp2p_pubsub_tpu.chaos import adversary as adversary_mod
+from go_libp2p_pubsub_tpu.chaos.adversary import Adversary, AttackScenario
+from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+    make_gossipsub_phase_step,
+)
+from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+from go_libp2p_pubsub_tpu.state import SimState
+from go_libp2p_pubsub_tpu.trace.events import EV
+
+import pytest
+
+
+def _assert_trees_equal(a, b, what="", skip_events_entry=None):
+    la, paths = jax.tree_util.tree_leaves(a), \
+        jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb), f"{what}: leaf count differs"
+    for (path, xa), xb in zip(paths, lb):
+        name = jax.tree_util.keystr(path)
+        if jnp.issubdtype(getattr(xa, "dtype", None), jax.dtypes.prng_key):
+            xa, xb = jax.random.key_data(xa), jax.random.key_data(xb)
+        xa, xb = np.asarray(xa), np.asarray(xb)
+        if skip_events_entry is not None and name.endswith(".events"):
+            xa = np.delete(xa, skip_events_entry)
+            xb = np.delete(xb, skip_events_entry)
+        assert np.array_equal(xa, xb), f"{what}{name} differs"
+
+
+def _schedule(rounds, seed=0, n=32, m=32, width=4):
+    rng = np.random.default_rng(seed)
+    po = rng.integers(0, n, size=(rounds, width)).astype(np.int32)
+    pt = np.zeros((rounds, width), np.int32)
+    pv = np.ones((rounds, width), bool)
+    return po, pt, pv
+
+
+def _off_population(n):
+    """Two distinct all-off shapes: no sybils, and sybils with every
+    behavior empty — both must resolve to None (full elision)."""
+    return (
+        Adversary(n, np.zeros(n, bool), behaviors=("drop_forward",
+                                                   "lie_ihave")),
+        Adversary(n, np.arange(n) < 4, behaviors=()),
+    )
+
+
+def test_adversary_resolve_elides_off_populations():
+    for off in _off_population(16):
+        assert adversary_mod.resolve(off) is None
+    live = Adversary(16, np.arange(16) < 4)
+    assert adversary_mod.resolve(live) is live
+    with pytest.raises(adversary_mod.AdversaryError):
+        Adversary(16, np.arange(16) < 4, behaviors=("no_such_attack",))
+    with pytest.raises(adversary_mod.AdversaryError):
+        # behavior masks cannot extend the faction
+        Adversary(16, np.arange(16) < 4,
+                  masks={"drop_forward": np.arange(16) >= 4})
+    with pytest.raises(adversary_mod.AdversaryError):
+        # censorship needs its target set
+        Adversary(16, np.arange(16) < 4, behaviors=("censor",))
+
+
+def test_attack_scenario_build_deterministic_and_hashed():
+    sc = AttackScenario(n_peers=24, sybil_fraction=0.25,
+                        behaviors=("drop_forward", "graft_spam"),
+                        onset=5, ramp_rounds=6, seed=3)
+    a, b = sc.build(), sc.build()
+    assert np.array_equal(a.is_sybil, b.is_sybil)
+    assert np.array_equal(a.onset, b.onset)
+    assert a.is_sybil.sum() == 6  # top 25% of the id space
+    idx = np.nonzero(a.is_sybil)[0]
+    assert (a.onset[idx] >= 5).all() and (a.onset[idx] < 11).all()
+    assert sc.scenario_hash() == sc.scenario_hash()
+    sc2 = dataclasses.replace(sc, onset=6)
+    assert sc.scenario_hash() != sc2.scenario_hash()
+    assert sc.events()[0][1] == "AttackOnset"
+
+
+def test_attack_scenario_surround_targets_fraction():
+    topo = graph.random_connect(32, 6, seed=7)
+    net = Net.build(topo, graph.subscribe_all(32, 1))
+    sc = AttackScenario(n_peers=32, targets=(0, 1), surround_targets=True,
+                        surround_fraction=0.5,
+                        behaviors=("drop_forward", "graft_spam"), seed=7)
+    adv = sc.build(net)
+    nbr, ok = np.asarray(net.nbr), np.asarray(net.nbr_ok)
+    neighborhood = set()
+    for t in (0, 1):
+        neighborhood.update(np.unique(nbr[t][ok[t]]).tolist())
+    sybs = set(np.nonzero(adv.is_sybil)[0].tolist())
+    assert sybs and sybs <= neighborhood
+    assert not adv.is_sybil[0] and not adv.is_sybil[1]  # victims stay honest
+    # graft spam is restricted to edges toward the victim set
+    assert adv.graft_targets is not None
+    with pytest.raises(adversary_mod.AdversaryError):
+        sc.build()  # needs the topology
+
+
+def _adv_build(n=32, seed=1, m=32):
+    topo = graph.random_connect(n, 5, seed=seed)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(GossipSubParams(), thr, score_enabled=False)
+    return topo, net, cfg
+
+
+def test_adversary_off_bitexact_per_round():
+    n = 32
+    _topo, net, cfg = _adv_build(n)
+    po, pt, pv = _schedule(8, seed=5, n=n)
+    offs = (None,) + _off_population(n)
+    outs = []
+    for adv in offs:
+        st = GossipSubState.init(net, M, cfg, seed=5)
+        step = make_gossipsub_step(cfg, net, adversary=adv)
+        for i in range(8):
+            st = step(st, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                      jnp.asarray(pv[i]))
+        outs.append(st)
+    _assert_trees_equal(outs[0], outs[1], "off-per-round/")
+    _assert_trees_equal(outs[0], outs[2], "off-per-round-empty/")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("r", [4, 8])
+def test_adversary_off_bitexact_phase_stacked(r):
+    """Adversary-off elision on the phase engine's stacked coalesced
+    wire path (cfg.wire_coalesced default) — bit-exact vs a build that
+    never saw the parameter (the chaos-plane phase elision pattern)."""
+    n = 32
+    _topo, net, cfg = _adv_build(n)
+    rounds = 2 * r
+    po, pt, pv = _schedule(rounds, seed=5, n=n)
+    outs = []
+    for adv in (None, _off_population(n)[0]):
+        st = GossipSubState.init(net, M, cfg, seed=5)
+        pstep = make_gossipsub_phase_step(cfg, net, r, adversary=adv)
+        for p in range(rounds // r):
+            sl = slice(p * r, (p + 1) * r)
+            st = pstep(st, jnp.asarray(po[sl]), jnp.asarray(pt[sl]),
+                       jnp.asarray(pv[sl]), do_heartbeat=True)
+        outs.append(st)
+    _assert_trees_equal(outs[0], outs[1], f"off-phase-r{r}/")
+
+
+def test_adversary_off_bitexact_floodsub_randomsub():
+    n = 32
+    _topo, net, _cfg = _adv_build(n)
+    po, pt, pv = _schedule(6, seed=6, n=n)
+    outs = []
+    for adv in (None,) + _off_population(n):
+        st = SimState.init(n, M, seed=2, k=net.max_degree)
+        for i in range(6):
+            st = floodsub_step(net, st, jnp.asarray(po[i]),
+                               jnp.asarray(pt[i]), jnp.asarray(pv[i]),
+                               adversary=adv)
+        outs.append(st)
+    _assert_trees_equal(outs[0], outs[1], "off-flood/")
+    _assert_trees_equal(outs[0], outs[2], "off-flood-empty/")
+    outs = []
+    for adv in (None, _off_population(n)[0]):
+        st = SimState.init(n, M, seed=3, k=net.max_degree)
+        step = make_randomsub_step(net, adversary=adv)
+        for i in range(6):
+            st = step(st, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                      jnp.asarray(pv[i]))
+        outs.append(st)
+    _assert_trees_equal(outs[0], outs[1], "off-randomsub/")
+
+
+def test_attacked_phase_r1_matches_per_round():
+    """Under an active multi-behavior attack, the r=1 phase engine and
+    the per-round engine agree bit-for-bit on EVERY leaf except the
+    EV.ADV_DROP entry (documented engine-approximate attribution: the
+    per-round engines count receiver-side after their gates, the phase
+    engine sender-side before them)."""
+    n = 32
+    _topo, net, cfg = _adv_build(n)
+    po, pt, pv = _schedule(8, seed=4, n=n)
+    adv = AttackScenario(
+        n_peers=n, sybil_fraction=0.25, onset=2,
+        behaviors=("drop_forward", "lie_ihave", "graft_spam"),
+    ).build()
+    st1 = GossipSubState.init(net, M, cfg, seed=4)
+    s1 = make_gossipsub_step(cfg, net, adversary=adv)
+    for i in range(8):
+        st1 = s1(st1, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                 jnp.asarray(pv[i]))
+    st2 = GossipSubState.init(net, M, cfg, seed=4)
+    s2 = make_gossipsub_phase_step(cfg, net, 1, adversary=adv)
+    for i in range(8):
+        st2 = s2(st2, jnp.asarray(po[i][None]), jnp.asarray(pt[i][None]),
+                 jnp.asarray(pv[i][None]), do_heartbeat=True)
+    assert int(st1.core.events[EV.ADV_DROP]) > 0
+    _assert_trees_equal(st1, st2, "attacked-r1/",
+                        skip_events_entry=int(EV.ADV_DROP))
+
+
+def test_drop_forward_schedule_window():
+    """The ADV_DROP counter (and hence the masking) moves ONLY inside
+    the [onset, stop) activity window — and the run resumes honest
+    forwarding after stop."""
+    n = 24
+    topo = graph.random_connect(n, 5, seed=2)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds())
+    adv = Adversary(n, np.arange(n) < 6, behaviors=("drop_forward",),
+                    onset=4, stop=8)
+    st = GossipSubState.init(net, M, cfg, seed=2)
+    step = make_gossipsub_step(cfg, net, adversary=adv)
+    po, pt, pv = _schedule(14, seed=2, n=n)
+    drops = []
+    for i in range(14):
+        st = step(st, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                  jnp.asarray(pv[i]))
+        drops.append(int(st.core.events[EV.ADV_DROP]))
+    deltas = np.diff([0] + drops)
+    assert (deltas[:4] == 0).all(), deltas
+    assert deltas[4:8].sum() > 0, deltas
+    assert (deltas[9:] == 0).all(), deltas  # round 8 may still count the
+    # outbox written at tick 7 — activity is evaluated at transmit time,
+    # so from round 9 on nothing moves
+
+
+def test_lie_ihave_engine_driven_breaks_promises():
+    """The in-engine lie-in-IHAVE behavior reproduces the manual
+    inject_ihave outcome: victims IWANT, the attacker never serves,
+    promises break, P7 accrues, scores of the attacker go negative."""
+    n = 24
+    topo = graph.random_connect(n, 6, seed=9)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(GossipSubParams(), thr, score_enabled=True)
+    sp = p7_score_params()
+    attacker = 5
+    adv = Adversary(n, np.arange(n) == attacker,
+                    behaviors=("drop_forward", "lie_ihave"))
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=9)
+    step = make_gossipsub_step(cfg, net, score_params=sp, adversary=adv)
+    st = run(step, st, 6)
+    # the attacker originates messages it will never forward (drop),
+    # then lies about them every heartbeat: victims IWANT, nothing is
+    # served, promises break (the manual withheld_publish + inject_
+    # ihave sequence, engine-driven)
+    for i in range(4):
+        st = step(st, *pub(attacker))
+        st = run(step, st, 5)
+    assert int(st.core.events[EV.ADV_IHAVE_LIE]) > 0
+    bp = np.asarray(st.score.bp)
+    scores = np.asarray(st.scores)
+    hits = 0
+    for j in range(n):
+        k = edge_to(topo, j, attacker)
+        if k is None or j == attacker:
+            continue
+        if bp[j, k] > 0:
+            hits += 1
+            assert scores[j, k] < 0, (j, k, scores[j, k])
+    # the one-promise-per-edge model adopts lazily, so not every victim
+    # edge need accrue, but the neighborhood must catch the liar
+    assert hits >= 2, (hits, bp.max())
+
+
+def test_graft_spam_engine_driven_penalized_backoffless():
+    n = 24
+    topo = graph.random_connect(n, 5, seed=11)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(GossipSubParams(D=3, Dlo=2, Dhi=4,
+                                                Dscore=2, Dout=1),
+                                thr, score_enabled=True)
+    sp = dataclasses.replace(p7_score_params(),
+                             behaviour_penalty_weight=-1.0)
+    attacker = 7
+    mask = np.arange(n) == attacker
+    adv = Adversary(n, mask, behaviors=("drop_forward", "graft_spam"))
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=11)
+    step = make_gossipsub_step(cfg, net, score_params=sp, adversary=adv)
+    st = run(step, st, 30)
+    assert int(st.core.events[EV.ADV_GRAFT_SPAM]) > 0
+    # the attacker keeps NO backoff bookkeeping (raw-wire fake)
+    assert not bool(np.asarray(st.backoff_present)[attacker].any())
+    assert int(np.asarray(st.backoff_expire)[attacker].max()) == 0
+    # victims that pruned the spammer keep being grafted at and
+    # penalize the flood (P7 accrues somewhere in the neighborhood)
+    bp = np.asarray(st.score.bp)
+    vic = [edge_to(topo, j, attacker) for j in range(n)]
+    accr = [bp[j, k] for j, k in enumerate(vic) if k is not None
+            and j != attacker]
+    assert max(accr) > 0.0
+
+
+def test_self_promo_pins_sybil_faction_scores():
+    n = 24
+    topo = graph.random_connect(n, 5, seed=13)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(GossipSubParams(), thr, score_enabled=True)
+    sp = p7_score_params()
+    mask = np.arange(n) >= 18
+    adv = Adversary(n, mask, behaviors=("drop_forward", "self_promo"),
+                    promo_score=7.5)
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=13)
+    step = make_gossipsub_step(cfg, net, score_params=sp, adversary=adv)
+    st = run(step, st, 10)
+    scores = np.asarray(st.scores)
+    nbr = np.clip(np.asarray(net.nbr), 0, None)
+    ok = np.asarray(net.nbr_ok)
+    syb_syb = ok & mask[nbr] & mask[:, None]
+    if syb_syb.any():
+        assert np.allclose(scores[syb_syb], 7.5)
+    # honest opinions of sybils are NOT pinned (the defense untouched)
+    att_edges = ok & mask[nbr] & ~mask[:, None]
+    assert not np.allclose(scores[att_edges], 7.5)
+
+
+def test_censor_masks_only_target_messages():
+    n = 20
+    topo = graph.random_connect(n, 5, seed=15)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds())
+    censored_origin = 3
+    targets = np.arange(n) == censored_origin
+    mask = (np.arange(n) >= 14)
+    adv = Adversary(n, mask, behaviors=("censor",), censor_origins=targets)
+    st = GossipSubState.init(net, M, cfg, seed=15)
+    step = make_gossipsub_step(cfg, net, adversary=adv)
+    st = run(step, st, 6)
+    # unit check at the mask level: only the censored origin's slots
+    # are removed, only on attacker edges
+    consts = adversary_mod.AdversaryConsts(adv, net)
+    plane = jnp.full((n, net.max_degree, 1), 0xFFFFFFFF, jnp.uint32)
+    st = step(st, *pub(censored_origin))
+    st = step(st, *pub(0))
+    masked, removed = consts.mask_transmit_nbr(st.core.tick, plane,
+                                               st.core.msgs)
+    cw = np.asarray(consts.censor_words(st.core.msgs))
+    origin = np.asarray(st.core.msgs.origin)
+    slots = np.where(origin == censored_origin)[0]
+    assert len(slots) >= 1
+    for s_ in slots:
+        assert cw[s_ // 32] & np.uint32(1 << (s_ % 32))
+    s0 = int(np.where(origin == 0)[0][0])
+    assert not (cw[s0 // 32] & np.uint32(1 << (s0 % 32)))
+    rem = np.asarray(removed)[..., 0]
+    att_nbr = np.asarray(consts.active_nbr("censor", st.core.tick))
+    assert (rem[~att_nbr] == 0).all()
+    assert (rem[att_nbr] == cw[0]).all()
+    # the run delivers non-censored traffic and counts the withheld bits
+    st = run(step, st, 8)
+    assert int(st.core.events[EV.ADV_DROP]) > 0
+    have = np.asarray(bitset.unpack(st.core.dlv.have, M))
+    assert have[:, s0].all(), "non-censored message must fully deliver"
+
+
+def test_checkpoint_attacked_resume_bitexact(tmp_path):
+    """Checkpoint round trip with the adversary plane armed: format v6
+    UNCHANGED (the plane is stateless — activity is a pure function of
+    the checkpointed tick and the static planes), and a resumed run
+    reproduces the uninterrupted run's attack stream, scores, and
+    invariant verdicts bit-for-bit."""
+    n = 24
+    topo = graph.random_connect(n, 5, seed=21)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(GossipSubParams(), thr, score_enabled=True)
+    sp = p7_score_params()
+    adv = AttackScenario(
+        n_peers=n, sybil_fraction=0.25, onset=4, ramp_rounds=4,
+        behaviors=("drop_forward", "lie_ihave", "graft_spam"), seed=21,
+    ).build()
+    po, pt, pv = _schedule(12, seed=21, n=n)
+
+    def steps(st, step, lo, hi):
+        for i in range(lo, hi):
+            st = step(st, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                      jnp.asarray(pv[i]))
+        return st
+
+    step = make_gossipsub_step(cfg, net, score_params=sp, adversary=adv)
+    full = steps(GossipSubState.init(net, M, cfg, score_params=sp, seed=21),
+                 step, 0, 12)
+
+    st = steps(GossipSubState.init(net, M, cfg, score_params=sp, seed=21),
+               step, 0, 6)
+    path = str(tmp_path / "attacked.npz")
+    checkpoint.save(path, st)
+    with np.load(path) as data:  # no version bump: v6, pytree-generic
+        assert int(data["__version__"]) == 6
+    template = GossipSubState.init(net, M, cfg, score_params=sp, seed=21)
+    resumed = checkpoint.restore(path, template)
+    resumed = steps(resumed, step, 6, 12)
+    _assert_trees_equal(full, resumed, "attacked-resume/")
+
+    # identical invariant verdicts on both final states
+    from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+
+    checker, names = oracle_inv.make_checker("gossipsub", net, cfg)
+    due = oracle_inv.due_vector()
+    va = np.asarray(checker(full, full.core.events, due))
+    vb = np.asarray(checker(resumed, resumed.core.events, due))
+    assert np.array_equal(va, vb)
+    assert va.all(), list(zip(names, va.tolist()))
+
+
+def test_invariants_hold_under_attack_small():
+    """A quick all-behaviors attacked run with the PR-7 oracle checker:
+    every applicable safety property holds at every check (the
+    attack-smoke acceptance, tier-1 sized)."""
+    n = 32
+    topo = graph.random_connect(n, 5, seed=23)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    cfg = GossipSubConfig.build(GossipSubParams(D=3, Dlo=2, Dhi=4,
+                                                Dscore=2, Dout=1),
+                                thr, score_enabled=True)
+    sp = p7_score_params()
+    adv = AttackScenario(
+        n_peers=n, sybil_fraction=0.25, onset=4,
+        behaviors=("drop_forward", "lie_ihave", "graft_spam",
+                   "self_promo"), seed=23,
+    ).build()
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=23)
+    step = make_gossipsub_step(cfg, net, score_params=sp, adversary=adv)
+    po, pt, pv = _schedule(24, seed=23, n=n)
+
+    from go_libp2p_pubsub_tpu.oracle import invariants as oracle_inv
+
+    checker, names = oracle_inv.make_checker("gossipsub", net, cfg)
+    due = oracle_inv.due_vector()
+    prev = jnp.copy(st.core.events)
+    for i in range(24):
+        st = step(st, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                  jnp.asarray(pv[i]))
+        if (i + 1) % 4 == 0:
+            ok = np.asarray(checker(st, prev, due))
+            assert ok.all(), [nm for nm, o in zip(names, ok) if not o]
+            prev = jnp.copy(st.core.events)

@@ -255,6 +255,37 @@ def test_ev_drain_telemetry_column_counts_as_drained():
     assert any("IWANT_RECOVER" in v.msg for v in vs)
 
 
+def test_ev_drain_adversary_counters_negatives():
+    """Round 13: the adversary plane's sim-only counters (ADV_DROP /
+    ADV_IHAVE_LIE / ADV_GRAFT_SPAM) must each be accumulated somewhere
+    AND named by the drain (COUNTER_ONLY_EVENTS) or recorded as a
+    telemetry column — seeded breakage of each half fires the rule."""
+    adv = ["ADV_DROP", "ADV_IHAVE_LIE", "ADV_GRAFT_SPAM"]
+    clean = simlint.check_ev_drain(
+        adv, set(),
+        drain_src="EV.ADV_DROP, EV.ADV_IHAVE_LIE, EV.ADV_GRAFT_SPAM "
+                  "counter-only",
+        package_refs=set(adv),
+    )
+    assert clean == []
+    # never accumulated -> dead counter
+    vs = simlint.check_ev_drain(
+        adv, set(), drain_src="EV.ADV_DROP EV.ADV_IHAVE_LIE "
+        "EV.ADV_GRAFT_SPAM", package_refs={"ADV_DROP"})
+    assert any("ADV_IHAVE_LIE" in v.msg for v in vs)
+    assert any("ADV_GRAFT_SPAM" in v.msg for v in vs)
+    # neither drain-documented nor a telemetry column -> undrained
+    vs = simlint.check_ev_drain(
+        ["ADV_DROP"], set(), drain_src="", package_refs={"ADV_DROP"},
+        telemetry_src="")
+    assert any("ADV_DROP" in v.msg for v in vs)
+    # the telemetry column alone satisfies the consumer contract
+    vs = simlint.check_ev_drain(
+        ["ADV_DROP"], set(), drain_src="", package_refs={"ADV_DROP"},
+        telemetry_src='EV_METRICS = ("ev_adv_drop",)')
+    assert not any("ADV_DROP" in v.msg for v in vs)
+
+
 def test_telemetry_panel_rule_negatives():
     """The panel catalog must mirror the EV enum positionally, and a
     metric that is RECORDED but never RECONCILED is a violation (a

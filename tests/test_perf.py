@@ -412,3 +412,57 @@ def test_regress_catches_corrupt_artifact(tmp_path):
     (tmp_path / "BENCH_r01.json").write_text("{not json")
     errs = regress.check_trajectory(str(tmp_path))
     assert any("BENCH_r01" in e for e in errs)
+
+
+def test_adversary_and_score_weight_blocks_round_trip():
+    """Round 13: the `adversary` and `score_weights` fingerprint blocks
+    (ADVICE r5 item 1 for the weights) round-trip through the line
+    format, and LEGACY lines read back the typed sentinels —
+    ADVERSARY_OFF / SCORE_WEIGHTS_UNKNOWN, never a KeyError or a
+    silently-assumed zero."""
+
+    class _FakeAdv:
+        enabled = True
+
+        @staticmethod
+        def fingerprint():
+            return {"enabled": True, "n_sybils": 7,
+                    "behaviors": ["drop_forward"], "onset": 3,
+                    "stop": None, "promo_score": 1.0,
+                    "population": "abc123"}
+
+    fp = {
+        "adversary": artifacts.adversary_fingerprint(_FakeAdv()),
+        "score_weights": artifacts.score_weights_fingerprint(
+            invalid_message_deliveries_weight=-1.0,
+            behaviour_penalty_weight=-10.0,
+        ),
+    }
+    rec = artifacts.BenchRecord(
+        metric="attack_sybil_honest_delivery", value=1.0, unit="ratio",
+        vs_baseline=0.0, schema=3, fingerprint=fp,
+    )
+    back = artifacts.record_from_line(json.loads(artifacts.dump_record(rec)))
+    assert back.adversary_on
+    assert back.adversary["n_sybils"] == 7
+    assert back.adversary["behaviors"] == ["drop_forward"]
+    assert back.score_weights["recorded"] is True
+    assert back.score_weights["behaviour_penalty_weight"] == -10.0
+
+    # legacy / honest lines: typed sentinels
+    legacy = artifacts.record_from_line(
+        {"metric": "m", "value": 1.0, "unit": "x", "vs_baseline": 0.0})
+    assert legacy.adversary == artifacts.ADVERSARY_OFF
+    assert not legacy.adversary_on
+    assert legacy.score_weights == artifacts.SCORE_WEIGHTS_UNKNOWN
+    assert legacy.score_weights["recorded"] is False
+    # the off block is explicit on new honest artifacts
+    off = artifacts.adversary_fingerprint()
+    assert off["enabled"] is False and off["scenario"] is None
+
+    # every committed BENCH_r* line reads the sentinels without error
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    for p in paths:
+        r = artifacts.load_bench_artifact(p)
+        assert not r.adversary_on
+        assert r.adversary["n_sybils"] == 0

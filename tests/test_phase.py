@@ -544,30 +544,47 @@ def test_phase_api_network_snapshots_exact_counters():
         assert mmd_b > 0, "phase build elided the mmd plane"
 
 
-def test_admission_invariant_warns_direct_drivers():
+def test_admission_invariant_enforced_direct_drivers():
     """The phase engine's publish-capacity invariant (ADVICE round 5,
-    item 2): rounds_per_phase * pub_width > msg_slots // 2 means a
-    direct driver can recycle slots WITHIN a phase, silently wiping
-    in-flight receipts. The built step must warn at trace time; API
-    builds (which enforce the flat admission cap) suppress it via
-    admission_capped=True."""
+    item 2), now ENFORCED at the engine layer in two tiers:
+
+    * ``r * pub_width > msg_slots`` — a slot can be re-allocated WITHIN
+      one phase, which the deferred recycled-slot clears assume never
+      happens: hard PhaseAdmissionError at trace time;
+    * ``msg_slots // 2 < r * pub_width <= msg_slots`` — in-flight
+      receipts of recycled slots can be wiped before the boundary
+      drain observes them: warning.
+
+    API builds (which enforce the flat admission cap on ACTUAL
+    publishes) suppress both via admission_capped=True."""
     import warnings
+
+    from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+        PhaseAdmissionError,
+    )
 
     n = 16
     topo = graph.random_connect(n, 4, seed=3)
     net = Net.build(topo, graph.subscribe_all(n, 1))
     cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds())
     r = 4
-    st = GossipSubState.init(net, 8, cfg, seed=3)  # M=8: cap is 4 < r*P=16
     po = jnp.full((r, P), -1, jnp.int32)
     pt = jnp.zeros((r, P), jnp.int32)
     pv = jnp.zeros((r, P), bool)
 
+    # M=8 < r*P=16: within-phase re-allocation possible — hard error
+    st = GossipSubState.init(net, 8, cfg, seed=3)
     pstep = make_gossipsub_phase_step(cfg, net, r)
-    with pytest.warns(UserWarning, match="phase publish capacity"):
+    with pytest.raises(PhaseAdmissionError, match="re-allocated WITHIN"):
         pstep(st, po, pt, pv, do_heartbeat=True)
 
-    # the API-certified build stays silent on the same shapes
+    # M=24: cap 12 < 16 <= 24 — the warning band
+    stw = GossipSubState.init(net, 24, cfg, seed=3)
+    pwarn = make_gossipsub_phase_step(cfg, net, r)
+    with pytest.warns(UserWarning, match="phase publish capacity"):
+        pwarn(stw, po, pt, pv, do_heartbeat=True)
+
+    # the API-certified build stays silent on the raising shape
     st2 = GossipSubState.init(net, 8, cfg, seed=3)
     pcapped = make_gossipsub_phase_step(cfg, net, r, admission_capped=True)
     with warnings.catch_warnings():
