@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke analyze sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -94,6 +94,26 @@ oracle-smoke:
 attack-smoke:
 	python scripts/attack_report.py --smoke
 
+# whole-run-window gate (scripts/scan_smoke.py; docs/DESIGN.md §14):
+# the smoke-shape bench window (N=12.5k, phase r=16, 64 rounds) with
+# chaos + telemetry + the FOLDED invariant oracle executes as ONE XLA
+# dispatch (window-jit cache + invocation sentinels) under
+# transfer_guard('disallow'); the scanned window must beat the
+# committed per-dispatch path warm-vs-warm (SCAN_SMOKE_MIN_SPEEDUP)
+# and stay above the SCAN_SMOKE.json rate floor (SCAN_SMOKE_UPDATE=1
+# rewrites); the v5e-8 projection is recomputed with the measured
+# dispatch_overhead_ms term, gated on the 2-D (sims x peers) multichip
+# dryrun artifact (MULTICHIP_r06.json). ~40 s warm on CPU.
+scan-smoke:
+	python scripts/scan_smoke.py --smoke
+
+# the 2-D (sims x peers) mesh dryrun on the 8-virtual-device harness:
+# S=8 ensemble window placed via shard_ensemble_state(axis="sims+peers")
+# — bit-exact vs unplaced, halo permutes only (no all-gathers); writes
+# the MULTICHIP_r06.json artifact scan-smoke's projection refresh reads
+mesh2d-audit:
+	python scripts/mesh2d_dryrun.py --write
+
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
 # discipline, packed-word dtype hygiene, import-time execution, static-
@@ -127,6 +147,7 @@ quick:
 	python scripts/telemetry_smoke.py
 	python scripts/invariant_report.py --smoke
 	python scripts/attack_report.py --smoke
+	python scripts/scan_smoke.py --smoke
 	python scripts/analyze.py
 
 native:

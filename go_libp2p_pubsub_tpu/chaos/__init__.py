@@ -33,6 +33,7 @@ from .metrics import (  # noqa: F401
     delivery_stats,
     iwant_recovery_share,
     links_down_total,
+    make_cross_mesh_observer,
     mesh_reform_latency,
     mesh_repair_latency,
     time_to_recover,

@@ -144,6 +144,26 @@ def batched_cross_group_mesh_counts(mesh: np.ndarray, nbr: np.ndarray,
         axis=(1, 2, 3)).astype(np.int64)
 
 
+def make_cross_mesh_observer(nbr, nbr_ok, groups):
+    """DEVICE counterpart of :func:`batched_cross_group_mesh_counts`
+    for scan-window observation (driver.make_window ``observe=``): a
+    closure ``state -> [S] i32`` (scalar for unbatched states) counting
+    directed cross-group mesh edges on the live mesh plane — the
+    per-round repair-arc series without leaving the window program.
+    Same ``_cross_edge_mask`` definition, so the scanned series is
+    bit-identical to the host reduction (tests/test_window.py)."""
+    import jax.numpy as jnp
+
+    cross = jnp.asarray(_cross_edge_mask(nbr, nbr_ok, groups))  # [N, K]
+
+    def observe(state):
+        mesh = state.mesh  # [..., N, SL, K]
+        return jnp.sum(mesh & cross[:, None, :],
+                       axis=(-3, -2, -1)).astype(jnp.int32)
+
+    return observe
+
+
 def mesh_repair_latency(mesh_series, heal_tick: int,
                         min_edges: int = 1) -> int | None:
     """Rounds from ``heal_tick`` until the cross-group mesh re-forms.

@@ -1,4 +1,4 @@
-"""Fixed-schedule round drivers: the scan owns the heartbeat cadence.
+"""Fixed-schedule run-window compiler: one XLA program per bench window.
 
 `make_gossipsub_step(static_heartbeat=True)` and the phase engine
 (`make_gossipsub_phase_step`) both take a *static* ``do_heartbeat``
@@ -9,9 +9,31 @@ all (no lax.cond branch-materialization copies of the state).
 
 That made the cadence a *caller-owned contract*
 (``do_heartbeat == (tick % heartbeat_every == 0)``) with nothing
-enforcing it. This module is the enforcement: `make_scan` builds the
-scan, computes the schedule itself, and hands drivers a function that
-cannot desynchronize — callers supply only the publish schedule.
+enforcing it. This module is the enforcement — and, since round 14, the
+dispatch-amortization layer (docs/DESIGN.md §14): :func:`make_window`
+compiles a WHOLE run window (every per-dispatch input stacked as scan
+``xs`` — publish batches, churn ``up`` rows, scheduled chaos
+``link_deny`` masks — state donated through the scan carry) into ONE
+jitted program, with the observability hooks folded INTO the scan body:
+
+  * invariant checks (oracle/invariants.py) run every ``check_every``
+    dispatches inside the scan — due rows ride as stacked ``xs``, the
+    previous-counters snapshot rides the carry, and the ``[P]`` (or
+    batched ``[S, P]``) violation masks come back as scan ``ys``;
+  * arbitrary device observations (``observe(state) -> pytree``) are
+    stacked as per-dispatch ``ys`` (per-round mesh snapshots etc.);
+  * the telemetry plane needs no folding at all — its panel rows are
+    written by the step itself and ride the carry (docs/DESIGN.md §11).
+
+so a chaos + telemetry + invariant-checked bench window is a single
+XLA dispatch instead of one per round/phase. :func:`make_scan` (the
+rounds-4..13 driver API) is now a thin adapter over the same window
+body, so every driver — bench, sweeps, the ensemble runner, the report
+cells — compiles through one code path.
+
+DONATION RULE: the window donates the state tree through the scan carry
+(``donate_argnums=0``), exactly like the jitted steps donate their
+state — callers must NOT reuse a state tree after a window.
 """
 
 from __future__ import annotations
@@ -72,6 +94,204 @@ def form_mesh(step, st, *, rounds_per_phase: int, pub_width: int = 4,
     return step(st, *args, do_heartbeat=True)
 
 
+def min_cycle(flags) -> list[bool]:
+    """The minimal repeating pattern of a periodic flag sequence (the
+    whole sequence when aperiodic) — so a window built from a full
+    per-dispatch heartbeat list compiles the same program as one built
+    from the schedule pattern."""
+    flags = [bool(b) for b in flags]
+    n = len(flags)
+    for p in range(1, n + 1):
+        if n % p == 0 and all(flags[i] == flags[i % p] for i in range(n)):
+            return flags[:p]
+    return flags
+
+
+def _core_of(st):
+    """The SimState face of any engine state (GossipSubState wraps it)."""
+    return st.core if hasattr(st, "core") else st
+
+
+def make_window(
+    step,
+    *,
+    heartbeat=None,
+    check=None,
+    check_every: int = 1,
+    observe=None,
+    unroll: int = 1,
+    donate: bool = True,
+):
+    """Compile a whole run window into one program:
+    ``run(state, xs, due=None) -> (state, ys)``.
+
+    * ``xs`` is a tuple of per-dispatch arrays, each with leading axis
+      ``D`` (the dispatch count): publish batches (``[D, P]`` per-round
+      / ``[D, r, P]`` phase), churn ``up`` rows ``[D, N]``, scheduled
+      chaos ``link_deny`` masks ``[D, N, K]`` — for ensemble windows
+      every row additionally carries the sim axis (``[D, S, ...]``).
+      Dispatch ``d`` consumes row ``d`` of every array, exactly as if
+      ``step`` had been called ``D`` times from Python.
+    * ``heartbeat`` is the static cadence pattern (a bool sequence,
+      cycled over the window — :func:`heartbeat_schedule` shape) for
+      steps that take a keyword-only ``do_heartbeat``; None for steps
+      that own their cadence on device.
+    * ``check`` folds the invariant oracle into the scan body: an EAGER
+      predicate ``check(state, prev_events, due_row) -> [P]`` (batched:
+      ``[S, P]``) evaluated every ``check_every`` dispatches — build it
+      with ``oracle.invariants.ScanInvariants``. ``due`` is the stacked
+      ``[n_checks, 6]`` due-row plane (``ScanInvariants.precompute``);
+      the previous-counters snapshot rides the scan carry (initialized
+      from the window-entry counters) and the violation masks come back
+      in ``ys["ok"]`` (``[n_checks, P]`` / ``[n_checks, S, P]``).
+    * ``observe`` is a device function ``state -> pytree`` evaluated
+      after every dispatch; the per-dispatch stack comes back in
+      ``ys["obs"]`` (leading axis D).
+
+    The window requires ``D`` to be a multiple of
+    ``lcm(len(heartbeat pattern), check_every)``; the checker runs once
+    per ``check_every`` dispatches via a nested scan when the cadence
+    allows (the compiled program then contains the step body once, not
+    ``check_every`` times). The state is donated (module docstring).
+    """
+    hb = None if heartbeat is None else min_cycle(heartbeat)
+    period = 1 if hb is None else len(hb)
+    ce = int(check_every)
+    if ce < 1:
+        raise ValueError(f"check_every must be >= 1, got {ce}")
+    block = math.lcm(period, ce) if check is not None else period
+    cpb = block // ce if check is not None else 0  # checks per block
+
+    def call(st, args, j):
+        if hb is None:
+            return step(st, *args)
+        return step(st, *args, do_heartbeat=hb[j % period])
+
+    def run(st, xs, due=None):
+        xs = tuple(xs)
+        if not xs:
+            raise ValueError("make_window: xs must carry at least one "
+                             "per-dispatch array (the dispatch count is "
+                             "read from its leading axis)")
+        n_dispatch = xs[0].shape[0]
+        for a in xs[1:]:
+            if a.shape[0] != n_dispatch:
+                raise ValueError(
+                    f"make_window: xs leading axes disagree "
+                    f"({[a.shape[0] for a in xs]})")
+        if n_dispatch % block:
+            raise ValueError(
+                f"window length {n_dispatch} dispatches is not a multiple "
+                f"of lcm(heartbeat period={period}, check_every={ce}) = "
+                f"{block}")
+        n_blocks = n_dispatch // block
+        if check is not None:
+            if due is None:
+                raise ValueError("make_window: a checked window needs the "
+                                 "stacked [n_checks, 6] due rows")
+            if due.shape[0] != n_blocks * cpb:
+                raise ValueError(
+                    f"due rows {due.shape[0]} != expected checks "
+                    f"{n_blocks * cpb} ({n_dispatch} dispatches every {ce})")
+        gro = lambda a: a.reshape((n_blocks, block) + a.shape[1:])
+        bx = tuple(gro(a) for a in xs)
+        bdue = (due.reshape((n_blocks, cpb) + due.shape[1:])
+                if check is not None else None)
+
+        nested = check is not None and ce % period == 0 and ce > period
+        if nested:
+            # the block is ONE check preceded by ce dispatches that the
+            # inner scan rolls — the compiled program carries the step
+            # body `period` times (once, in the common period-1 case),
+            # not `check_every` times
+            def inner_body(s, rows):
+                obs = []
+                for j in range(period):
+                    s = call(s, tuple(r[j] for r in rows), j)
+                    if observe is not None:
+                        obs.append(observe(s))
+                ys = (jax.tree_util.tree_map(lambda *a: jnp.stack(a), *obs)
+                      if observe is not None else None)
+                return s, ys
+
+            def body(carry, xs_blk):
+                s, prev = carry
+                rows, drow = xs_blk
+                regro = lambda a: a.reshape(
+                    (ce // period, period) + a.shape[1:])
+                s, obs = jax.lax.scan(inner_body, s,
+                                      tuple(regro(r) for r in rows),
+                                      unroll=max(1, int(unroll)))
+                ev = _core_of(s).events
+                ok = check(s, prev, drow[0])
+                ys = {"ok": ok[None]}
+                if observe is not None:
+                    ys["obs"] = obs
+                return (s, ev), ys
+        else:
+            def body(carry, xs_blk):
+                s, prev = carry
+                rows, drows = xs_blk
+                oks, obs = [], []
+                for j in range(block):
+                    s = call(s, tuple(r[j] for r in rows), j)
+                    if observe is not None:
+                        obs.append(observe(s))
+                    if check is not None and (j + 1) % ce == 0:
+                        ev = _core_of(s).events
+                        oks.append(check(s, prev, drows[(j + 1) // ce - 1]))
+                        prev = ev
+                ys = {}
+                if oks:
+                    ys["ok"] = jnp.stack(oks)
+                if obs:
+                    ys["obs"] = jax.tree_util.tree_map(
+                        lambda *a: jnp.stack(a), *obs)
+                return (s, prev), (ys or None)
+
+        if check is not None:
+            carry0 = (st, _core_of(st).events)
+            (st, _), ys = jax.lax.scan(
+                body, carry0, (bx, bdue),
+                unroll=1 if nested else max(1, int(unroll)))
+        elif observe is not None:
+            def obs_body(s, rows):
+                obs = []
+                for j in range(block):
+                    s = call(s, tuple(r[j] for r in rows), j)
+                    obs.append(observe(s))
+                return s, jax.tree_util.tree_map(
+                    lambda *a: jnp.stack(a), *obs)
+            st, obs = jax.lax.scan(obs_body, st, bx,
+                                   unroll=max(1, int(unroll)))
+            ys = {"obs": obs}
+        else:
+            def plain_body(s, rows):
+                for j in range(block):
+                    s = call(s, tuple(r[j] for r in rows), j)
+                return s, None
+            st, _ = jax.lax.scan(plain_body, st, bx,
+                                 unroll=max(1, int(unroll)))
+            ys = None
+
+        out = {}
+        if ys:
+            if "ok" in ys:
+                a = ys["ok"]
+                out["ok"] = a.reshape((-1,) + a.shape[2:])
+            if "obs" in ys:
+                # nested mode stacks obs [n_blocks, inner, period, ...];
+                # flat mode [n_blocks, block, ...] — per-dispatch order
+                # is row-major either way
+                lead = 3 if nested else 2
+                out["obs"] = jax.tree_util.tree_map(
+                    lambda a: a.reshape((n_dispatch,) + a.shape[lead:]),
+                    ys["obs"])
+        return st, out
+
+    return jax.jit(run, donate_argnums=0 if donate else ())
+
+
 def make_scan(
     step,
     *,
@@ -102,6 +322,11 @@ def make_scan(
     Contract: the state's tick at entry must be ≡ 0 (mod lcm(he, r)) —
     any state freshly init'd (tick 0) or previously driven only through
     this function qualifies. R must be a multiple of lcm(he, r).
+
+    Since round 14 this is a thin adapter over :func:`make_window` (the
+    run-window compiler): it regroups the flattened ``[R, ...]``
+    schedules into per-dispatch rows and compiles the same scan body
+    every window-driven caller uses.
     """
     he = int(heartbeat_every)
     r = int(rounds_per_phase)
@@ -119,19 +344,9 @@ def make_scan(
             )
         static_heartbeat = r > 1
     lcm = math.lcm(he, r)
-
-    if r == 1 and not static_heartbeat:
-        def run(st, po, pt, pv, up=None):
-            def body(carry, xs):
-                xo, xt, xv, xu = xs
-                args = (xo, xt, xv) if xu is None else (xo, xt, xv, xu)
-                return step(carry, *args), None
-            st, _ = jax.lax.scan(body, st, (po, pt, pv, up), unroll=unroll)
-            return st
-        return jax.jit(run, donate_argnums=0 if donate else ())
-
-    sched = heartbeat_schedule(he, r)
-    period = len(sched)
+    sched = heartbeat_schedule(he, r) if static_heartbeat else None
+    win = make_window(step, heartbeat=sched, unroll=unroll, donate=False)
+    raw = win.__wrapped__  # traced inside the adapter's own jit below
 
     def run(st, po, pt, pv, up=None):
         n_rounds = po.shape[0]
@@ -140,29 +355,17 @@ def make_scan(
                 f"schedule length {n_rounds} is not a multiple of "
                 f"lcm(heartbeat_every={he}, rounds_per_phase={r}) = {lcm}"
             )
-        g = n_rounds // lcm
-        gro = lambda a: a.reshape((g, period, r) + a.shape[1:])
-        xo, xt, xv = gro(po), gro(pt), gro(pv)
-        xu = gro(up) if up is not None else None
-
-        def body(carry, xs):
-            bo, bt, bv, bu = xs
-            for j in range(period):
-                if r == 1:
-                    args = (bo[j, 0], bt[j, 0], bv[j, 0])
-                    if bu is not None:
-                        args += (bu[j, 0],)
-                else:
-                    args = (bo[j], bt[j], bv[j])
-                    if bu is not None:
-                        # a phase consumes ONE liveness plane (peer
-                        # transitions land once per phase, at its head) —
-                        # the first round's row of the [R, N] schedule
-                        args += (bu[j, 0],)
-                carry = step(carry, *args, do_heartbeat=sched[j])
-            return carry, None
-
-        st, _ = jax.lax.scan(body, st, (xo, xt, xv, xu),
-                             unroll=max(1, unroll))
+        if r > 1:
+            d = n_rounds // r
+            gro = lambda a: a.reshape((d, r) + a.shape[1:])
+            xs = (gro(po), gro(pt), gro(pv))
+            if up is not None:
+                # a phase consumes ONE liveness plane (peer transitions
+                # land once per phase, at its head) — the first round's
+                # row of the [R, N] schedule
+                xs += (gro(up)[:, 0],)
+        else:
+            xs = (po, pt, pv) + (() if up is None else (up,))
+        st, _ = raw(st, xs)
         return st
     return jax.jit(run, donate_argnums=0 if donate else ())

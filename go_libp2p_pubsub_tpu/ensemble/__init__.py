@@ -37,7 +37,9 @@ from .batch import (  # noqa: F401
 )
 from .runner import (  # noqa: F401
     EnsembleRun,
+    WindowRunner,
     run_rounds,
+    run_window,
     shard_ensemble_state,
 )
 from .stats import (  # noqa: F401

@@ -9,8 +9,8 @@ sweep that used to run S seeds sequentially (S compiles + S runs, or
 one compile amortized over S cold loops) becomes one program whose
 arrays are S× wider — the shape XLA is built to keep a chip full with.
 
-Sharding composition (docs/DESIGN.md §10): two layouts, both through
-:func:`shard_ensemble_state`.
+Sharding composition (docs/DESIGN.md §10, §14): three layouts, all
+through :func:`shard_ensemble_state`.
 
   * ``axis="peers"`` (default) — the peer dimension (now axis 1, after
     the leading S) is sharded exactly as the unbatched state was
@@ -23,6 +23,22 @@ Sharding composition (docs/DESIGN.md §10): two layouts, both through
     cross-chip collectives in the steady state (each chip runs S/D
     whole sims). The right layout when a single sim fits one chip —
     Monte Carlo at fleet width.
+  * ``axis="sims+peers"`` (round 14) — the 2-D composition on a
+    ``parallel.make_mesh_2d`` (sims × peers) mesh: the sim axis is
+    sharded over the mesh's ``sims`` axis AND every peer-dim-1 leaf is
+    additionally sharded over its ``peers`` axis. Halo permutes ride
+    only the peers axis (each sims-row is an independent replica of
+    the 1-D layout), so the collective count per phase is unchanged —
+    the layout for S sims that each need a multi-chip peer axis.
+
+Whole-run windows (round 14, docs/DESIGN.md §14): :class:`WindowRunner`
+/ :func:`run_window` compile the ENTIRE segment into one
+``driver.make_window`` program — per-dispatch inputs stacked as scan
+``xs``, invariant checks (``oracle.ScanInvariants``) and device
+observations folded into the scan body — so an S-sim, R-round, checked
+and observed run is ONE dispatch (``EnsembleRun.dispatches`` is the
+sentinel). ``run_rounds`` remains the per-dispatch face (the hook/
+parity surface); the report cells and gates drive windows.
 """
 
 from __future__ import annotations
@@ -34,7 +50,10 @@ import time
 @dataclasses.dataclass
 class EnsembleRun:
     """Result of one ensemble segment: the final batched state tree,
-    the compile-count sentinel, and wall-clock aggregates."""
+    the compile-count sentinel, and wall-clock aggregates. Window runs
+    (round 14) additionally carry the dispatch count (the one-dispatch
+    sentinel), the folded invariant report and the stacked per-dispatch
+    observations."""
 
     states: object
     n_sims: int
@@ -42,6 +61,13 @@ class EnsembleRun:
     compiles: int        # jit-cache growth across the segment
                          # (-1 = unknown: the cache-size API is gone)
     seconds: float
+    #: XLA dispatches the segment executed as (run_rounds: one per
+    #: step; run_window: one per scan segment — 1 = whole-run program)
+    dispatches: int = 0
+    #: oracle.InvariantReport when invariants were folded/hooked
+    invariant_report: object = None
+    #: stacked per-dispatch observe() pytree ([D, ...] leaves) or None
+    observations: object = None
 
     @property
     def aggregate_rounds_per_sec(self) -> float:
@@ -118,15 +144,175 @@ def run_rounds(ens_step, states, make_args, n_steps: int, *,
         compiles=(-1 if before is None or after is None
                   else after - before),
         seconds=dt,
+        dispatches=int(n_steps),
     )
+
+
+class WindowRunner:
+    """One compiled run-window program, reusable across runs (warm
+    re-runs hit the same jit — the zero-recompile sentinel gates rely
+    on that).
+
+    ``ens_step`` is a lifted ensemble step (batch.lift_step /
+    lift_floodsub) or any unbatched jitted step — the window mechanics
+    are batch-agnostic, but ``EnsembleRun.n_sims`` (and the aggregate
+    rate built on it) reads the leading leaf axis, so it is only
+    meaningful for batched trees (unbatched callers drive
+    ``driver.make_window`` directly, like scan-smoke does);
+    ``n_steps`` is the total dispatch count of a run;
+    ``segment_len`` splits it into equal scan segments (the checkpoint
+    quantum — ``run`` yields to ``on_segment`` between them), default
+    the whole run as ONE dispatch. ``heartbeat_fn(i)`` supplies the
+    static cadence (must be periodic with a period dividing
+    ``segment_len``); ``invariants`` is an ``oracle.ScanInvariants``;
+    ``observe(state) -> pytree`` is stacked per dispatch.
+    """
+
+    def __init__(self, ens_step, n_steps: int, *, rounds_per_phase: int = 1,
+                 heartbeat_fn=None, invariants=None, observe=None,
+                 segment_len: int | None = None, unroll: int = 1):
+        from ..driver import make_window, min_cycle
+
+        self.n_steps = int(n_steps)
+        self.rounds_per_phase = max(int(rounds_per_phase), 1)
+        self.invariants = invariants
+        seg = int(segment_len) if segment_len else self.n_steps
+        if self.n_steps % seg:
+            raise ValueError(
+                f"segment_len {seg} does not divide the {self.n_steps}"
+                "-dispatch window")
+        self.segment_len = seg
+        hb = None
+        if heartbeat_fn is not None:
+            # min_cycle returns the exact minimal cycle of the flag
+            # sequence (an aperiodic sequence comes back whole), so
+            # divisibility into the segment is the only constraint
+            hb = min_cycle(heartbeat_fn(i) for i in range(self.n_steps))
+            if seg % len(hb):
+                raise ValueError(
+                    f"heartbeat_fn's minimal period {len(hb)} does not "
+                    f"divide segment_len={seg} — every segment must "
+                    "compile the same window program")
+        ce = 1
+        check = None
+        if invariants is not None:
+            check = invariants.check
+            ce = invariants.check_every
+            if seg % ce:
+                raise ValueError(
+                    f"segment_len {seg} must be a multiple of the "
+                    f"invariant check_every {ce} (checks must land on "
+                    "segment boundaries for exact resume)")
+        self.window = make_window(ens_step, heartbeat=hb, check=check,
+                                  check_every=ce, observe=observe,
+                                  unroll=unroll)
+        self._observe = observe is not None
+
+    def _cache_size(self):
+        try:
+            return int(self.window._cache_size())
+        except Exception:  # pragma: no cover — newer-jax fallback
+            return None
+
+    def stack_args(self, make_args, lo: int, hi: int) -> tuple:
+        """Stack per-dispatch arg tuples ``make_args(i)`` for
+        ``i in [lo, hi)`` into the window's xs arrays ([D, ...])."""
+        import jax.numpy as jnp
+
+        rows = [tuple(make_args(i)) for i in range(lo, hi)]
+        width = {len(r) for r in rows}
+        if len(width) != 1:
+            raise ValueError(f"make_args returned ragged tuples: {width}")
+        return tuple(jnp.stack([r[k] for r in rows])
+                     for k in range(width.pop()))
+
+    def run(self, states, make_args, *, on_segment=None) -> EnsembleRun:
+        """Execute the window: ONE dispatch per segment. ``make_args``
+        is the run_rounds contract (per-dispatch arg tuples, leading S
+        axis per array for lifted steps). ``on_segment(seg_idx,
+        states)`` fires between segments — the checkpoint hook
+        (checkpoint_every == segment_len, docs/DESIGN.md §14)."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(states)
+        n_sims = leaves[0].shape[0] if leaves[0].ndim else 1
+        seg, D = self.segment_len, self.n_steps
+        due = (self.invariants.due_rows(D)
+               if self.invariants is not None else None)
+        cpseg = seg // self.invariants.check_every if due is not None else 0
+        before = self._cache_size()
+        oks, obs = [], []
+        t0 = time.perf_counter()
+        for g in range(D // seg):
+            xs = self.stack_args(make_args, g * seg, (g + 1) * seg)
+            dseg = (due[g * cpseg:(g + 1) * cpseg]
+                    if due is not None else None)
+            states, ys = (self.window(states, xs) if dseg is None
+                          else self.window(states, xs, dseg))
+            if "ok" in ys:
+                oks.append(ys["ok"])
+            if "obs" in ys:
+                obs.append(ys["obs"])
+            if on_segment is not None and g + 1 < D // seg:
+                on_segment(g, states)
+        jax.block_until_ready(states)
+        dt = time.perf_counter() - t0
+        after = self._cache_size()
+        import numpy as _np
+
+        report = None
+        if self.invariants is not None:
+            ok = (_np.concatenate([_np.asarray(o) for o in oks])
+                  if oks else _np.zeros(
+                      (0, len(self.invariants.names)), bool))
+            report = self.invariants.report(ok)
+        observations = None
+        if obs:
+            observations = jax.tree_util.tree_map(
+                lambda *a: _np.concatenate([_np.asarray(x) for x in a]),
+                *obs)
+        return EnsembleRun(
+            states=states,
+            n_sims=int(n_sims),
+            rounds=D * self.rounds_per_phase,
+            compiles=(-1 if before is None or after is None
+                      else after - before),
+            seconds=dt,
+            dispatches=D // seg,
+            invariant_report=report,
+            observations=observations,
+        )
+
+
+def run_window(ens_step, states, make_args, n_steps: int, *,
+               rounds_per_phase: int = 1, heartbeat_fn=None,
+               invariants=None, observe=None, segment_len=None,
+               unroll: int = 1, on_segment=None) -> EnsembleRun:
+    """One-shot :class:`WindowRunner`: compile the whole run as a scan
+    window and execute it (ONE dispatch per segment; default one
+    segment = one dispatch for the entire run). Drop-in for
+    :func:`run_rounds` call sites — same ``make_args`` contract, same
+    :class:`EnsembleRun` result — with the invariant hook replaced by
+    an ``oracle.ScanInvariants`` folded into the program and
+    ``observe`` now a DEVICE function ``state -> pytree`` (stacked per
+    dispatch in ``EnsembleRun.observations``)."""
+    return WindowRunner(
+        ens_step, n_steps, rounds_per_phase=rounds_per_phase,
+        heartbeat_fn=heartbeat_fn, invariants=invariants, observe=observe,
+        segment_len=segment_len, unroll=unroll,
+    ).run(states, make_args, on_segment=on_segment)
 
 
 def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers"):
     """Place a BATCHED state tree onto a device mesh (see the module
-    docstring for the two layouts). ``axis="peers"`` shards dim 1 of
+    docstring for the three layouts). ``axis="peers"`` shards dim 1 of
     every leaf whose dim-1 extent is ``n_peers`` (the batched analogue
     of parallel.shard_state); ``axis="sims"`` shards the leading sim
-    axis and replicates nothing else — every leaf carries it."""
+    axis and replicates nothing else — every leaf carries it;
+    ``axis="sims+peers"`` composes both on a 2-D
+    ``parallel.make_mesh_2d`` mesh (named axes ``sims``/``peers``):
+    every leaf's leading sim dim rides the ``sims`` mesh axis and
+    peer-dim-1 leaves are additionally split over ``peers``."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -138,8 +324,26 @@ def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers"):
         sims = NamedSharding(mesh, peer_spec(mesh))
         return jax.device_put(states, jax.tree_util.tree_map(
             lambda _: sims, states))
+    if axis == "sims+peers":
+        names = tuple(mesh.axis_names)
+        if names != ("sims", "peers"):
+            raise ValueError(
+                "axis='sims+peers' needs a 2-D mesh with axis_names "
+                f"('sims', 'peers') — parallel.make_mesh_2d; got {names}")
+        both = NamedSharding(mesh, P("sims", "peers"))
+        sims_only = NamedSharding(mesh, P("sims"))
+
+        def choose2d(leaf):
+            if (hasattr(leaf, "shape") and leaf.ndim >= 2
+                    and leaf.shape[1] == n_peers):
+                return both
+            return sims_only
+
+        return jax.device_put(states, jax.tree_util.tree_map(
+            choose2d, states))
     if axis != "peers":
-        raise ValueError(f"axis must be 'peers' or 'sims', got {axis!r}")
+        raise ValueError(
+            f"axis must be 'peers', 'sims' or 'sims+peers', got {axis!r}")
     peer = NamedSharding(
         mesh, P(None, *(
             (tuple(mesh.axis_names),) if len(mesh.axis_names) > 1

@@ -16,6 +16,7 @@ from .invariants import (  # noqa: F401
     InvariantConfig,
     InvariantHook,
     InvariantReport,
+    ScanInvariants,
     check_state,
     due_vector,
     invariant_names,

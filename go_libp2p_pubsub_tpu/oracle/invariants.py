@@ -719,12 +719,119 @@ class InvariantReport:
         }
 
 
+class ScanInvariants:
+    """The scan-folded face of the oracle plane (docs/DESIGN.md §14):
+    the same property registry, due contract and report shape as
+    :class:`InvariantHook`, but evaluated INSIDE the run-window program
+    (driver.make_window) instead of as a separate dispatch per check —
+    the checker traces into the window's scan body, due rows ride as
+    stacked scan ``xs``, the previous-counters snapshot rides the scan
+    carry, and the ``[n_checks, S, P]`` violation masks come back as
+    scan ``ys``. A checked whole-run window is therefore still ONE XLA
+    dispatch.
+
+    Two semantic deltas vs the hook, both pinned by tests/test_window.py:
+
+    * the first check's ``events-monotone`` compares against the
+      WINDOW-ENTRY counters (the scan carry's initial value) instead of
+      the hook's first-observation tautology — strictly stronger, never
+      weaker (counters are born monotone);
+    * no ``jnp.copy`` defensive snapshots — the carry is functional, so
+      the donation hazard the hook documents cannot occur.
+
+    ``check`` is the eager (un-jitted) predicate ``(state, prev_events,
+    due_row) -> [P]`` (vmapped to ``[S, P]`` when ``batched``) that
+    ``driver.make_window(check=...)`` folds in; :meth:`precompute`
+    materializes the stacked due rows on device (call it BEFORE a
+    ``transfer_guard`` window); :meth:`report` turns the window's
+    ``ys["ok"]`` masks back into the standard :class:`InvariantReport`.
+    """
+
+    def __init__(self, engine: str, net, cfg=None,
+                 inv: InvariantConfig | None = None, *,
+                 batched: bool = True, due_fn=None,
+                 rounds_per_step: int = 1):
+        self.engine = engine
+        self.inv = inv or InvariantConfig()
+        self.inv.validate()
+        self.names = invariant_names(engine, self.inv.names)
+        self.batched = batched
+        self.due_fn = due_fn
+        self.rounds_per_step = max(int(rounds_per_step), 1)
+        nbr_sub = (_mesh_eligible_const(net)
+                   if engine in GOSSIP_ENGINES else None)
+        icfg = self.inv
+
+        def check(state, prev_events, due):
+            return check_state(engine, net, state, cfg, icfg,
+                               prev_events=prev_events, due=due,
+                               nbr_sub=nbr_sub)
+
+        self.check = (jax.vmap(check, in_axes=(0, 0, None)) if batched
+                      else check)
+        self._due = None
+        self._ticks: tuple = ()
+
+    @property
+    def check_every(self) -> int:
+        return self.inv.check_every
+
+    def n_checks(self, n_steps: int) -> int:
+        return int(n_steps) // self.inv.check_every
+
+    def precompute(self, n_steps: int) -> jax.Array:
+        """The stacked ``[n_checks, 6]`` due-row plane for an
+        ``n_steps``-dispatch window (host → device transfers happen
+        HERE, not inside the window) plus the tick labels."""
+        ce = self.inv.check_every
+        rows, ticks = [], []
+        for i in range(int(n_steps)):
+            if (i + 1) % ce:
+                continue
+            tick = (i + 1) * self.rounds_per_step
+            rows.append(np.asarray(
+                self.due_fn(tick) if self.due_fn is not None
+                else due_vector(), np.int32))
+            ticks.append(tick)
+        self._ticks = tuple(ticks)
+        self._due = jnp.asarray(
+            np.stack(rows) if rows
+            else np.zeros((0, DUE_LEN), np.int32))
+        return self._due
+
+    def due_rows(self, n_steps: int) -> jax.Array:
+        if self._due is None or self._due.shape[0] != self.n_checks(n_steps):
+            self.precompute(n_steps)
+        return self._due
+
+    def report(self, ok, ticks=None) -> InvariantReport:
+        """Summarize the window's stacked ``ys["ok"]`` masks
+        (``[n_checks, P]`` unbatched / ``[n_checks, S, P]`` batched)
+        as the standard :class:`InvariantReport`."""
+        ok = np.asarray(ok)
+        if ok.ndim == 2:
+            ok = ok[:, None, :]
+        if ok.size and ok.shape[-1] != len(self.names):
+            raise ValueError(
+                f"ok mask property axis {ok.shape[-1]} != "
+                f"{len(self.names)} registered for {self.engine!r}")
+        return InvariantReport(
+            engine=self.engine, names=self.names,
+            ticks=tuple(ticks) if ticks is not None else self._ticks,
+            ok=ok, check_every=self.inv.check_every,
+            rounds_per_step=self.rounds_per_step,
+        )
+
+
 class InvariantHook:
     """The ``check_every=k`` observer ``ensemble.runner.run_rounds``
     (and the report scripts) drive: every k dispatches it evaluates the
     jitted checker on the live batched state and appends the ``[S, P]``
     bool result to a device-side list — zero host transfers inside the
     run window; :meth:`report` reads everything back afterwards.
+    (:class:`ScanInvariants` is the scan-folded equivalent the window
+    drivers use; this hook remains the per-dispatch face — the negative
+    tests and the parity gates drive both.)
 
     ``due_fn(tick) -> i32[6]`` supplies the host-known schedule context
     per check (see :func:`due_vector`); it is evaluated for every

@@ -58,6 +58,33 @@ def make_multihost_mesh(
     return Mesh(arr, axis_names)
 
 
+def make_mesh_2d(n_sims_devices: int, n_peer_devices: int | None = None,
+                 devices=None, axis_names=("sims", "peers")) -> Mesh:
+    """2-D (sims × peers) device mesh for ensemble windows
+    (docs/DESIGN.md §14): the leading sim axis of a batched state tree
+    shards over ``sims`` rows and the peer axis over ``peers`` columns
+    (ensemble.shard_ensemble_state(axis="sims+peers")). Each sims-row
+    is an independent replica of the 1-D peer layout, so the halo
+    collective-permute count per phase is UNCHANGED vs the 1-D mesh —
+    permutes just run row-parallel (the collective audit asserts
+    this). sims-major order keeps each row's peer shards on
+    consecutive devices (ICI-adjacent on a real slice)."""
+    if devices is None:
+        devices = jax.devices()
+    ns = int(n_sims_devices)
+    if ns < 1 or len(devices) % ns:
+        raise ValueError(
+            f"n_sims_devices={ns} must divide the device count "
+            f"{len(devices)}")
+    npd = int(n_peer_devices) if n_peer_devices else len(devices) // ns
+    if ns * npd > len(devices):
+        raise ValueError(
+            f"mesh {ns}x{npd} needs {ns * npd} devices, have "
+            f"{len(devices)}")
+    arr = np.asarray(devices[: ns * npd]).reshape(ns, npd)
+    return Mesh(arr, tuple(axis_names))
+
+
 def peer_spec(mesh: Mesh) -> P:
     """PartitionSpec sharding the leading (peer) axis over every mesh axis."""
     return P(tuple(mesh.axis_names)) if len(mesh.axis_names) > 1 else P(mesh.axis_names[0])

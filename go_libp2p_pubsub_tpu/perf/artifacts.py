@@ -98,6 +98,43 @@ ADVERSARY_OFF = {"enabled": False, "n_sybils": 0, "behaviors": [],
 #: "unrecorded", never silently "zero")
 SCORE_WEIGHTS_UNKNOWN = {"recorded": False}
 
+#: the execution defaults every artifact WITHOUT a
+#: fingerprint["execution"] block reads back as (round 14): nothing is
+#: known about how the run window was dispatched — the sentinel is
+#: explicit ("scan": None = unrecorded, NOT False), because the
+#: rounds-4..13 bench already scanned its segments while the report
+#: cells dispatched per round; a legacy line cannot say which it was.
+SCAN_OFF = {"scan": None, "segment_rounds": None,
+            "dispatches_per_window": None, "rounds_per_dispatch": None,
+            "mesh_shape": None, "unroll": None, "check_every": None}
+
+
+def execution_fingerprint(*, scan: bool, segment_rounds: int,
+                          dispatches_per_window: int,
+                          rounds_per_dispatch: int,
+                          mesh_shape=None, unroll: int | None = None,
+                          check_every: int | None = None) -> dict:
+    """The schema-v3 ``fingerprint["execution"]`` block (round 14): how
+    the run window was dispatched — whole-window scan vs per-dispatch
+    loop, segment length, dispatches per window, the device-mesh shape
+    (a ``{axis: size}`` dict — 2-D sims×peers meshes record both axes)
+    and the folded invariant cadence. This is what lets the projection
+    engine price dispatch overhead from the artifact alone
+    (perf.projection ``dispatch_overhead_ms``). Readers go through
+    :attr:`BenchRecord.execution`, which defaults legacy lines to
+    :data:`SCAN_OFF` (explicitly unrecorded)."""
+    return {
+        "scan": bool(scan),
+        "segment_rounds": int(segment_rounds),
+        "dispatches_per_window": int(dispatches_per_window),
+        "rounds_per_dispatch": int(rounds_per_dispatch),
+        "mesh_shape": (None if mesh_shape is None
+                       else {str(k): int(v)
+                             for k, v in dict(mesh_shape).items()}),
+        "unroll": None if unroll is None else int(unroll),
+        "check_every": None if check_every is None else int(check_every),
+    }
+
 
 def adversary_fingerprint(adversary=None, scenario=None) -> dict:
     """The schema-v3 ``fingerprint["adversary"]`` block: the attacker
@@ -319,6 +356,32 @@ class BenchRecord:
     @property
     def invariants_on(self) -> bool:
         return bool(self.invariants["enabled"])
+
+    @property
+    def execution(self) -> dict:
+        """The execution block of the fingerprint (round 14). LEGACY
+        artifacts — every line that predates whole-run windows — read
+        back :data:`SCAN_OFF` (``scan: None`` = unrecorded), so readers
+        can ask any artifact "how many dispatches did this window pay"
+        without special-casing age."""
+        fp = self.fingerprint or {}
+        out = dict(SCAN_OFF)
+        out.update(fp.get("execution") or {})
+        return out
+
+    @property
+    def scanned(self) -> bool | None:
+        return self.execution["scan"]
+
+    @property
+    def dispatches_per_round(self) -> float | None:
+        """Dispatches paid per simulated round — the projection's
+        ``dispatch_overhead_ms`` multiplier; None when unrecorded."""
+        ex = self.execution
+        if not ex["dispatches_per_window"] or not ex["segment_rounds"]:
+            return None
+        return float(ex["dispatches_per_window"]) / float(
+            ex["segment_rounds"])
 
     @property
     def permute_sets_per_phase(self) -> int | None:

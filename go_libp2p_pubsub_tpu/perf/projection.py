@@ -113,6 +113,19 @@ class Projection:
     rounds_per_sec: tuple  # (lo, central, hi) — note lo pairs with hi ICI
     #: gather sets/phase the ICI term used (None = legacy 16·(r+4) model)
     permute_sets_per_phase: int | None = None
+    #: per-dispatch host overhead the dispatch term priced (round 14);
+    #: 0.0 reproduces every pre-round-14 projection unchanged
+    dispatch_overhead_ms: float = 0.0
+    #: dispatches paid per simulated round (1/r for a per-phase Python
+    #: loop, 1/window for a scanned window, None = term disabled)
+    dispatches_per_round: float | None = None
+
+    @property
+    def dispatch_ms_per_round(self) -> float:
+        """The serialized per-round dispatch cost the rates include."""
+        if not self.dispatch_overhead_ms or not self.dispatches_per_round:
+            return 0.0
+        return self.dispatch_overhead_ms * self.dispatches_per_round
 
     @property
     def central(self) -> float:
@@ -130,6 +143,11 @@ class Projection:
             "permute_sets_per_phase": self.permute_sets_per_phase,
             "n_shards": self.n_shards,
             "ici_ms_lo_central_hi": tuple(round(v, 4) for v in self.ici_ms),
+            "dispatch_overhead_ms": round(self.dispatch_overhead_ms, 4),
+            "dispatches_per_round": (
+                None if self.dispatches_per_round is None
+                else round(self.dispatches_per_round, 6)),
+            "dispatch_ms_per_round": round(self.dispatch_ms_per_round, 6),
             "rounds_per_sec_lo_central_hi": (
                 round(lo), round(central), round(hi)),
             "vs_north_star_central": round(central / NORTH_STAR_RATE, 4),
@@ -138,7 +156,9 @@ class Projection:
 
 def project(shard_ms_per_round: float, rounds_per_phase: int,
             n_shards: int = 8,
-            permute_sets_per_phase: int | None = None) -> Projection:
+            permute_sets_per_phase: int | None = None,
+            dispatch_overhead_ms: float = 0.0,
+            dispatches_per_round: float | None = None) -> Projection:
     """Project the n-chip rate from one shard's measured round time.
 
     The peer axis is sharded; every shard advances the same round in
@@ -146,17 +166,32 @@ def project(shard_ms_per_round: float, rounds_per_phase: int,
     projected rate is the shard rate degraded by the serialized ICI
     fraction — shard count enters only through the shard's N.
     ``permute_sets_per_phase``: the measured gather-set count (artifact
-    fingerprint); None keeps the legacy 16·(r+4) model."""
+    fingerprint); None keeps the legacy 16·(r+4) model.
+
+    ``dispatch_overhead_ms`` × ``dispatches_per_round`` (round 14) adds
+    the serialized per-dispatch host cost — launch + donation
+    bookkeeping + the tunneled-platform round trip — so the projection
+    can distinguish per-round execution (``dispatches_per_round = 1/r``:
+    one program per phase from Python) from a scanned whole-run window
+    (``1/window_rounds`` — the artifact's ``execution`` block records
+    it, BenchRecord.dispatches_per_round). Defaults keep the term at
+    zero, so every pre-round-14 committed projection reproduces
+    unchanged (tests/test_perf.py pins round 5)."""
     if shard_ms_per_round <= 0:
         raise ValueError(f"shard_ms_per_round must be > 0, got {shard_ms_per_round}")
+    if dispatch_overhead_ms < 0:
+        raise ValueError(
+            f"dispatch_overhead_ms must be >= 0, got {dispatch_overhead_ms}")
+    disp = (dispatch_overhead_ms * dispatches_per_round
+            if dispatch_overhead_ms and dispatches_per_round else 0.0)
     ici = tuple(
         ici_serialized_ms(rounds_per_phase, us, permute_sets_per_phase)
         for us in (ICI_LAUNCH_US_LO, ICI_LAUNCH_US_CENTRAL, ICI_LAUNCH_US_HI)
     )
     rates = (
-        1000.0 / (shard_ms_per_round + ici[2]),  # lo rate <- hi ICI
-        1000.0 / (shard_ms_per_round + ici[1]),
-        1000.0 / (shard_ms_per_round + ici[0]),  # hi rate <- lo ICI
+        1000.0 / (shard_ms_per_round + ici[2] + disp),  # lo rate <- hi ICI
+        1000.0 / (shard_ms_per_round + ici[1] + disp),
+        1000.0 / (shard_ms_per_round + ici[0] + disp),  # hi rate <- lo ICI
     )
     return Projection(
         shard_ms_per_round=shard_ms_per_round,
@@ -168,6 +203,11 @@ def project(shard_ms_per_round: float, rounds_per_phase: int,
             int(permute_sets_per_phase)
             if permute_sets_per_phase is not None else None
         ),
+        dispatch_overhead_ms=float(dispatch_overhead_ms),
+        dispatches_per_round=(
+            float(dispatches_per_round)
+            if dispatches_per_round is not None else None
+        ),
     )
 
 
@@ -175,7 +215,9 @@ def project_from_artifacts(bench_path: str, multichip_path: str,
                            shard_rate: float | None = None,
                            rounds_per_phase: int | None = None,
                            n_shards: int = 8,
-                           permute_sets_per_phase: int | None = None
+                           permute_sets_per_phase: int | None = None,
+                           dispatch_overhead_ms: float = 0.0,
+                           dispatches_per_round: float | None = None
                            ) -> Projection:
     """The committed-round projection: gate on the round's multichip
     dryrun, then project from the shard rate.
@@ -193,6 +235,12 @@ def project_from_artifacts(bench_path: str, multichip_path: str,
     have no such field and keep the legacy 16·(r+4) formula their
     projections were built with — so the round-5 44-45% reproduces
     unchanged. Pass ``permute_sets_per_phase`` to override.
+
+    ``dispatch_overhead_ms`` (round 14) arms the dispatch term; its
+    multiplier defaults to the artifact's own recorded execution shape
+    (``BenchRecord.dispatches_per_round`` — the ``execution``
+    fingerprint block) and to zero for legacy artifacts, whose
+    committed projections therefore reproduce unchanged.
 
     Raises ValueError when the multichip artifact says the sharded step
     did not run clean — a projection built on a failed collective audit
@@ -232,5 +280,9 @@ def project_from_artifacts(bench_path: str, multichip_path: str,
             # count to the projection cadence
             control = max(int(recorded) - bench.rounds_per_phase, 0)
             permute_sets_per_phase = int(rounds_per_phase) + control
+    if dispatches_per_round is None and dispatch_overhead_ms:
+        dispatches_per_round = bench.dispatches_per_round
     return project(1000.0 / shard_rate, rounds_per_phase, n_shards=n_shards,
-                   permute_sets_per_phase=permute_sets_per_phase)
+                   permute_sets_per_phase=permute_sets_per_phase,
+                   dispatch_overhead_ms=dispatch_overhead_ms,
+                   dispatches_per_round=dispatches_per_round)

@@ -142,36 +142,72 @@ def run_kernel_census() -> dict:
 
 
 def check_kernel_count(root: str, census: dict) -> list[str]:
-    """The round-7 structural gate: compiled kernel total vs committed
-    baseline. Empty when no baseline is committed yet (legacy
-    PERF_SMOKE.json shapes stay accepted)."""
+    """The round-7 structural gate, image-portable since round 14: the
+    compiled kernel total is compared against the MEASURED-ON-THIS-
+    IMAGE baseline (perf.profile.on_image_census_baseline — seeded by
+    the first gate run on the image), so the gate fails on a DIFF that
+    re-inflates the kernel swarm, never on a container/XLA change (PR 8
+    recorded 324-vs-committed-393 ON SEED — an image delta, not a
+    regression). The committed PERF_SMOKE.json count stays as an
+    informational pin: a mismatch is printed, not failed."""
+    # PERF_SMOKE_UPDATE=1 is the deliberate-change path: reseed the
+    # on-image baseline from this run (alongside the committed rewrite)
+    # instead of comparing against the stale entry
+    update = bool(os.environ.get("PERF_SMOKE_UPDATE"))
+    onimage = on_image_census_baseline(census, update=update)
+    out = []
+    if onimage["seeded"] and not update:
+        # a fresh .jax_cache (new image / ephemeral CI) has nothing to
+        # compare against yet — say so LOUDLY: until the next run on
+        # this image the census gate is seed-only, not a regression
+        # check (the bit-exact elision parity tests still gate the
+        # off-path; persistent checkouts get the full gate from run 2)
+        print(
+            f"perf-smoke NOTE: on-image census baseline SEEDED at "
+            f"{onimage['total']} ({onimage['path']}) — first census run "
+            "on this image; no regression comparison was possible this "
+            "run", file=sys.stderr,
+        )
+    tol = float(os.environ.get("PERF_SMOKE_KERNEL_TOL", KERNEL_TOL))
+    if (not update and not onimage["seeded"]
+            and census["total"] > tol * onimage["total"]):
+        out.append(
+            f"compiled kernel count regressed: {census['total']} > "
+            f"{tol:.2f} x on-image baseline {onimage['total']} "
+            f"(N={census['n_peers']}, r={census['rounds_per_phase']}; "
+            f"top ops: {dict(list(census['by_op'].items())[:5])}; "
+            f"{onimage['path']}; PERF_SMOKE_KERNEL_TOL overrides)"
+        )
     base_path = os.path.join(root, BASELINE_NAME)
     if not os.path.exists(base_path) or os.environ.get("PERF_SMOKE_UPDATE"):
-        return []
+        return out
     with open(base_path) as f:
         base = json.load(f)
     committed = (base.get("hlo_kernels") or {}).get("total")
-    if committed is None:
-        return []
-    # the baseline is shape-specific: a PERF_SMOKE_N/_R reshape compiles
-    # a different program, so comparing against the committed shape's
-    # count would deterministically fail a healthy build — skip instead
-    # (the reshape knobs are for ad-hoc exploration; the committed gate
-    # runs at the committed shape)
-    if (int(base.get("n_peers", census["n_peers"])) != census["n_peers"]
+    # shape-specific: a PERF_SMOKE_N/_R reshape compiles a different
+    # program — the committed pin only applies at the committed shape
+    if (committed is None
+            or int(base.get("n_peers", census["n_peers"]))
+            != census["n_peers"]
             or int(base.get("rounds_per_phase", census["rounds_per_phase"]))
             != census["rounds_per_phase"]):
-        return []
-    tol = float(os.environ.get("PERF_SMOKE_KERNEL_TOL", KERNEL_TOL))
-    if census["total"] > tol * committed:
-        return [
-            f"compiled kernel count regressed: {census['total']} > "
-            f"{tol:.2f} x committed {committed} "
-            f"(N={census['n_peers']}, r={census['rounds_per_phase']}; "
-            f"top ops: {dict(list(census['by_op'].items())[:5])}; "
-            f"{BASELINE_NAME}; PERF_SMOKE_KERNEL_TOL overrides)"
-        ]
-    return []
+        return out
+    if census["total"] != committed:
+        print(
+            f"perf-smoke NOTE: census {census['total']} != committed "
+            f"{committed} ({BASELINE_NAME}) — informational pin only; "
+            "the hard gate compares against the on-image baseline "
+            f"{onimage['total']} (XLA fusion counts are image-dependent)",
+            file=sys.stderr,
+        )
+    return out
+
+
+def on_image_census_baseline(census: dict, variant: str = "default",
+                             update: bool = False) -> dict:
+    from .profile import on_image_census_baseline as _oib
+
+    return _oib(census, variant=variant, update=update)
 
 
 def run_mini_bench(emit=None) -> dict:

@@ -348,6 +348,27 @@ def workload_fingerprint(
         fp["seg_rounds"] = int(seg_rounds)
     if unroll is not None:
         fp["unroll"] = int(unroll)
+    n_devices = 1
+    try:
+        import jax
+
+        n_devices = len(jax.devices())
+    except Exception:  # pragma: no cover — jax not initializable
+        pass
+    if seg_rounds is not None:
+        # the bench measurement loop is whole-window compiled
+        # (driver.make_scan -> make_window): one XLA dispatch per
+        # seg_rounds-round segment — the execution self-description the
+        # projection's dispatch_overhead_ms term reads (round 14)
+        from .artifacts import execution_fingerprint
+
+        fp["execution"] = execution_fingerprint(
+            scan=True, segment_rounds=int(seg_rounds),
+            dispatches_per_window=1, rounds_per_dispatch=int(seg_rounds),
+            mesh_shape=({"peers": n_devices} if n_devices > 1
+                        and n_peers % n_devices == 0 else None),
+            unroll=unroll,
+        )
     if phase:
         # MEASURED halo gather sets per phase (16 rolled permutes each on
         # the banded bench topology) — the projection's ICI input; legacy

@@ -127,21 +127,29 @@ def run_gate(s: int, n: int, loss: float, seed: int) -> dict:
                 ensemble.tile(pv[i], s))
 
     # --- batched: compile + warm run (the one-compile sentinel) -------
-    run = ensemble.run_rounds(ens, ensemble.batch_states(st0, s),
-                              margs, rounds)
+    # round 14: the whole batched cell is ONE scan-window program
+    # (ensemble.WindowRunner) — S sims x all rounds in a single
+    # dispatch; the runner is reused so the warm re-run pins
+    # zero-recompile on the same jit
+    runner = ensemble.WindowRunner(ens, rounds)
+    run = runner.run(ensemble.batch_states(st0, s), margs)
     if run.compiles not in (-1, 1):  # -1 = sentinel API unavailable
         failures.append(
-            f"one-compile: lifted step compiled {run.compiles} times "
+            f"one-compile: the scan window compiled {run.compiles} times "
             f"across the S={s} x {rounds}-round run (expected exactly 1)"
+        )
+    if run.dispatches != 1:
+        failures.append(
+            f"one-dispatch: the batched cell executed as {run.dispatches} "
+            "dispatches (expected ONE whole-run window)"
         )
     # timed warm segment (fresh batched states; the first run paid the
     # compile, this one is the throughput number)
-    timed = ensemble.run_rounds(ens, ensemble.batch_states(st0, s),
-                                margs, rounds)
+    timed = runner.run(ensemble.batch_states(st0, s), margs)
     if timed.compiles not in (-1, 0):
         failures.append(
             f"one-compile: warm re-run recompiled ({timed.compiles} "
-            "fresh compiles) — shape/weak-type wobble in the loop"
+            "fresh compiles) — shape/weak-type wobble in the window"
         )
     aggregate = timed.aggregate_rounds_per_sec
 
@@ -204,6 +212,7 @@ def run_gate(s: int, n: int, loss: float, seed: int) -> dict:
         "n_peers": n,
         "loss": loss,
         "compiles": run.compiles,
+        "dispatches": run.dispatches,
     }
 
 
@@ -216,6 +225,7 @@ def emit_artifact(res: dict, loss: float) -> dict:
         chaos_fingerprint,
         dump_record,
         ensemble_fingerprint,
+        execution_fingerprint,
         record_from_line,
     )
 
@@ -229,6 +239,10 @@ def emit_artifact(res: dict, loss: float) -> dict:
         fingerprint={
             "chaos": chaos_fingerprint(_chaos_cfg(loss)),
             "ensemble": ensemble_fingerprint(res["n_sims"]),
+            "execution": execution_fingerprint(
+                scan=True, segment_rounds=res["rounds"],
+                dispatches_per_window=res["dispatches"],
+                rounds_per_dispatch=res["rounds"]),
         },
         extras={
             "sequential_sim_rounds_per_sec": round(res["sequential"], 2),

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""2-D (sims × peers) mesh dryrun on the 8-virtual-device CPU harness
+(docs/DESIGN.md §14) — the round-14 refresh of the MULTICHIP artifact
+series.
+
+Builds an S=8 ensemble window of the bench gossipsub step, places it on
+a ``parallel.make_mesh_2d(2, 4)`` mesh via
+``ensemble.shard_ensemble_state(axis="sims+peers")`` (sim axis over 2
+mesh rows, peer axis over 4 columns), runs the whole window as ONE scan
+dispatch, and checks:
+
+  * **bit-exactness** — the placed run equals the unplaced batched run
+    leaf-for-leaf (placement must never change a value);
+  * **collective profile** — the compiled window contains halo
+    collective-permutes and ZERO peer-sized all-gathers, exactly like
+    the 1-D audit (tests/test_collectives.py): the sims axis adds no
+    collectives (each row is an independent replica of the 1-D layout).
+
+Writes the MULTICHIP_r06.json wrapper (same shape the driver's
+multichip artifacts carry: n_devices/rc/ok/skipped/tail, plus the mesh
+shape and collective profile) that scan-smoke's projection refresh
+gates on. Usage:
+
+    python scripts/mesh2d_dryrun.py [--n 4096] [--rounds 8] [--write]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+
+ARTIFACT_NAME = "MULTICHIP_r06.json"
+
+
+def run_dryrun(n: int, rounds: int, sims: int = 8,
+               mesh_rows: int = 2) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import ensemble
+    from go_libp2p_pubsub_tpu.checkpoint import is_prng_key
+    from go_libp2p_pubsub_tpu.driver import make_window
+    from go_libp2p_pubsub_tpu.parallel import (
+        collective_profile,
+        make_mesh_2d,
+    )
+    from go_libp2p_pubsub_tpu.perf.sweep import PUBS_PER_ROUND, build_bench
+
+    n_dev = jax.device_count()
+    if n_dev < mesh_rows * 2:
+        return {"ok": False, "rc": 1, "skipped": True,
+                "tail": f"needs >= {mesh_rows * 2} devices, have {n_dev}"}
+    mesh = make_mesh_2d(mesh_rows, n_dev // mesh_rows)
+
+    st0, step, n_topics, _ = build_bench(n, 64, config="default")
+    ens = ensemble.lift_step(step)
+    rng = np.random.default_rng(0)
+    po = jnp.asarray(np.stack([
+        ensemble.tile(rng.integers(0, n, size=(PUBS_PER_ROUND,))
+                      .astype(np.int32), sims)
+        for _ in range(rounds)]))
+    pt = jnp.zeros((rounds, sims, PUBS_PER_ROUND), jnp.int32)
+    pv = jnp.ones((rounds, sims, PUBS_PER_ROUND), bool)
+    window = make_window(ens)
+
+    def batched():
+        return ensemble.batch_states(
+            build_bench(n, 64, config="default")[0], sims)
+
+    gold, _ = window(batched(), (po, pt, pv))
+    jax.block_until_ready(gold)
+
+    placed = ensemble.shard_ensemble_state(batched(), mesh, n,
+                                           axis="sims+peers")
+    lowered = window.lower(placed, (po, pt, pv))
+    compiled = lowered.compile()
+    prof = collective_profile(compiled.as_text())
+    got, _ = window(placed, (po, pt, pv))
+    jax.block_until_ready(got)
+
+    def unkey(x):
+        return jax.random.key_data(x) if is_prng_key(x) else x
+
+    mismatches = []
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(gold)
+    flat_b = jax.tree_util.tree_leaves(got)
+    for (path, a), b in zip(flat_a, flat_b):
+        if not bool(jnp.array_equal(unkey(a), unkey(b))):
+            mismatches.append(jax.tree_util.keystr(path))
+    ok = (not mismatches
+          and prof["all-gather"] == 0
+          and prof["collective-permute"] > 0)
+    tail = (f"2-D mesh {mesh_rows}x{n_dev // mesh_rows} (sims x peers), "
+            f"S={sims}, N={n}, {rounds}-round window as ONE dispatch; "
+            f"collectives={prof}; "
+            + ("bit-exact vs unplaced" if not mismatches
+               else f"MISMATCHED leaves: {mismatches[:5]}"))
+    return {
+        "n_devices": n_dev,
+        "mesh_shape": {"sims": mesh_rows, "peers": n_dev // mesh_rows},
+        "rc": 0 if ok else 1,
+        "ok": ok,
+        "skipped": False,
+        "collectives": prof,
+        "n_peers": n,
+        "n_sims": sims,
+        "rounds": rounds,
+        "tail": tail,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--write", action="store_true",
+                    help=f"write {ARTIFACT_NAME} at the repo root")
+    args = ap.parse_args(argv)
+
+    # the virtual 8-device harness must be configured before jax's
+    # backend initializes (the conftest/scaling_cpu_mesh mechanism)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # threefry on purpose (the parity-gate PRNG, ensemble/batch.py):
+    # its counter-mode draws are placement-invariant, so sharded ==
+    # unplaced bit-for-bit; unsafe_rbg's RngBitGenerator partitioning
+    # is not value-stable under GSPMD (the round-5..8 PRNG caveat)
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    root = repo_root()
+    enable_persistent_cache(os.path.join(root, ".jax_cache"))
+
+    res = run_dryrun(args.n, args.rounds)
+    print(json.dumps(res))
+    if args.write:
+        path = os.path.join(root, ARTIFACT_NAME)
+        with open(path, "w") as f:
+            json.dump(res, f, indent=2)
+            f.write("\n")
+        print(f"wrote {path}", file=sys.stderr)
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

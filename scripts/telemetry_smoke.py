@@ -184,8 +184,22 @@ def run_gate(n: int, rounds: int) -> dict:
     census_on = compiled_phase_kernel_count(
         n, r, telemetry=TelemetryConfig(rows=max(rounds // r, 1)))
     census_off = compiled_phase_kernel_count(n, r)
+    # image-portable (round 14): the hard census gate compares against
+    # the measured-on-this-image baseline; the committed value is an
+    # informational pin (perf.profile.on_image_census_baseline)
+    from go_libp2p_pubsub_tpu.perf.profile import on_image_census_baseline
+
+    # the UPDATE path reseeds the on-image entries too — a deliberate
+    # recorder change is accepted the same way the committed rewrite is
+    upd = bool(os.environ.get("TELEMETRY_SMOKE_UPDATE"))
+    oni_on = on_image_census_baseline(census_on, variant="telemetry_on",
+                                      update=upd)
+    oni_off = on_image_census_baseline(census_off, update=upd)
 
     return {
+        "census_on_on_image": oni_on["total"],
+        "census_off_on_image": oni_off["total"],
+        "on_image_seeded": oni_on["seeded"] or oni_off["seeded"],
         "failures": failures,
         "compiles": compiles,
         "rate_on": round(rounds / t_on, 2),
@@ -201,33 +215,47 @@ def run_gate(n: int, rounds: int) -> dict:
 
 
 def check_baseline(root: str, res: dict) -> list[str]:
-    """Census ceiling vs the committed TELEMETRY_SMOKE.json."""
+    """Census ceiling — image-portable since round 14: the hard gate
+    compares against the on-image baselines (seeded by the first run
+    on this image); the committed TELEMETRY_SMOKE.json values are an
+    informational pin (printed when they drift, never failed — census
+    counts are image-dependent, PR 8's 324-vs-393 lesson)."""
+    tol = float(os.environ.get("TELEMETRY_SMOKE_KERNEL_TOL",
+                               DEFAULT_KERNEL_TOL))
+    out = []
+    if not res["on_image_seeded"]:
+        if res["census_on_total"] > tol * res["census_on_on_image"]:
+            out.append(
+                f"telemetry-on kernel census regressed: "
+                f"{res['census_on_total']} > {tol:.2f} x on-image "
+                f"baseline {res['census_on_on_image']} "
+                f"(TELEMETRY_SMOKE_KERNEL_TOL overrides)"
+            )
+        budget = res["census_on_on_image"] - res["census_off_on_image"]
+        if budget > 0 and res["extra_kernels"] > tol * budget:
+            out.append(
+                f"telemetry recorder kernel budget blown: "
+                f"+{res['extra_kernels']} kernels over the telemetry-off "
+                f"build (on-image budget +{budget}, tol {tol:.2f}) — the "
+                "panel write stopped fusing"
+            )
     path = os.path.join(root, BASELINE_NAME)
     if not os.path.exists(path) or os.environ.get("TELEMETRY_SMOKE_UPDATE"):
-        return []
+        return out
     with open(path) as f:
         base = json.load(f)
     if (int(base.get("n_peers", res["n_peers"])) != res["n_peers"]
             or int(base.get("rounds_per_phase", res["rounds_per_phase"]))
             != res["rounds_per_phase"]):
-        return []  # reshape run: the committed census is shape-specific
-    tol = float(os.environ.get("TELEMETRY_SMOKE_KERNEL_TOL",
-                               DEFAULT_KERNEL_TOL))
-    out = []
+        return out  # reshape run: the committed census is shape-specific
     committed = base.get("census_on_total")
-    if committed is not None and res["census_on_total"] > tol * committed:
-        out.append(
-            f"telemetry-on kernel census regressed: "
-            f"{res['census_on_total']} > {tol:.2f} x committed {committed} "
-            f"({BASELINE_NAME}; TELEMETRY_SMOKE_KERNEL_TOL overrides, "
-            f"TELEMETRY_SMOKE_UPDATE=1 rewrites)"
-        )
-    budget = base.get("extra_kernels")
-    if budget is not None and res["extra_kernels"] > tol * budget:
-        out.append(
-            f"telemetry recorder kernel budget blown: +{res['extra_kernels']}"
-            f" kernels over the telemetry-off build (committed budget "
-            f"+{budget}, tol {tol:.2f}) — the panel write stopped fusing"
+    if committed is not None and res["census_on_total"] != committed:
+        print(
+            f"telemetry-smoke NOTE: telemetry-on census "
+            f"{res['census_on_total']} != committed {committed} "
+            f"({BASELINE_NAME}) — informational pin; the hard gate uses "
+            f"the on-image baseline {res['census_on_on_image']}",
+            file=sys.stderr,
         )
     return out
 

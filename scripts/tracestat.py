@@ -220,6 +220,20 @@ def artifact_adversary(path: str) -> dict:
     return recs[-1].adversary
 
 
+def artifact_execution(path: str) -> dict:
+    """The ``execution`` fingerprint block (round 14: scan on/off,
+    segment length, dispatches per window, mesh shape) of a bench
+    artifact's last metric line; legacy lines read back
+    perf.artifacts.SCAN_OFF (scan: null = unrecorded)."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    for rec in reversed(recs):
+        if rec.scanned is not None:
+            return rec.execution
+    return recs[-1].execution
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("tracefile")
@@ -236,6 +250,7 @@ def main():
     if args.artifact:
         stats["invariants"] = artifact_invariants(args.artifact)
         stats["adversary"] = artifact_adversary(args.artifact)
+        stats["execution"] = artifact_execution(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -274,6 +289,19 @@ def main():
         else:
             print("invariants: INVARIANTS_OFF (artifact predates the "
                   "oracle plane or the run checked nothing)")
+    if "execution" in stats:
+        ex = stats["execution"]
+        if ex.get("scan") is None:
+            print("execution: SCAN_OFF sentinel (artifact predates the "
+                  "round-14 execution block — dispatch shape unrecorded)")
+        else:
+            print(
+                f"execution: scan={ex['scan']}, "
+                f"{ex.get('dispatches_per_window')} dispatch(es) per "
+                f"{ex.get('segment_rounds')}-round window "
+                f"(mesh {ex.get('mesh_shape')}, unroll {ex.get('unroll')}, "
+                f"check_every {ex.get('check_every')})"
+            )
     if "adversary" in stats:
         av = stats["adversary"]
         if av.get("enabled"):
