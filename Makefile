@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -114,6 +114,25 @@ scan-smoke:
 mesh2d-audit:
 	python scripts/mesh2d_dryrun.py --write
 
+# bytes/peer audit over the live state trees (scripts/memstat.py;
+# docs/DESIGN.md §15): per-leaf byte costs fitted as const + slope*N
+# via eval_shape (no allocation), totals projected to N in {100k, 1M,
+# 10M}, the dense-vs-CSR exchange ratio, and the narrow_counters
+# delta. Deterministic shape arithmetic — the committed MEM_AUDIT.json
+# must reproduce byte-identical (MEM_AUDIT_UPDATE=1 rewrites). <5 s.
+mem-audit:
+	python scripts/memstat.py
+
+# million-peer sparse-plane gate (scripts/scale_smoke.py; docs/
+# DESIGN.md §15): an N=1M, K=8 CPU window on the CSR edge layout as
+# ONE scanned program with the invariant oracle folded in — asserts
+# zero violations, live delivery, peak RSS under the committed
+# SCALE_SMOKE.json ceiling and warm rounds/s above its floor
+# (SCALE_SMOKE_UPDATE=1 rewrites; SCALE_SMOKE_N shrinks the shape for
+# constrained boxes — RSS/rate gates then skip). ~25 s on CPU.
+scale-smoke:
+	python scripts/scale_smoke.py
+
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
 # discipline, packed-word dtype hygiene, import-time execution, static-
@@ -149,6 +168,8 @@ quick:
 	python scripts/attack_report.py --smoke
 	python scripts/scan_smoke.py --smoke
 	python scripts/analyze.py
+	python scripts/memstat.py
+	python scripts/scale_smoke.py
 
 native:
 	$(MAKE) -C native

@@ -94,6 +94,17 @@ TELEMETRY_ROWS = GUARD_ROUNDS
 TELEMETRY_TRACKED = (0, 7)
 _TELEM_PREFIX = ".core.telem"
 
+#: the sparse-data-plane path (round 15): the gossipsub bench step built
+#: with ``edge_layout="csr"`` (ops/csr.py — the flat [E] edge exchange)
+#: runs the same guard set. The CSR layout lives entirely in the Net
+#: (the state tree is leaf-identical to the dense build), so its schema
+#: is NOT committed separately: the rows must EQUAL the committed
+#: ``gossipsub`` rows exactly — any drift means the layout leaked into
+#: the state, which would break checkpoint v6's no-version-bump
+#: contract (docs/DESIGN.md §15).
+CSR_ENGINE = "csr"
+CSR_BASE = "gossipsub"
+
 #: StableHLO markers proving the state argument is donated
 _DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
@@ -207,6 +218,47 @@ def build_ensemble_harness() -> EngineHarness:
                      for a in _pub_args((PUB_WIDTH,), i))
 
     return EngineHarness(ENSEMBLE_ENGINE, ens, states, make_args, {})
+
+
+def build_csr_harness() -> EngineHarness:
+    """The sparse-plane path: the CSR_BASE bench step built with
+    ``edge_layout="csr"`` — a fresh jit via build_bench, so the
+    recompile sentinel covers the CSR program (a layout that
+    cache-busts or transfers mid-loop fails here)."""
+    from ..perf.sweep import build_bench
+
+    st, step, _, _ = build_bench(
+        GUARD_N, GUARD_M, heartbeat_every=1, rounds_per_phase=1,
+        edge_layout="csr",
+    )
+    return EngineHarness(
+        CSR_ENGINE, step, st, lambda i: _pub_args((PUB_WIDTH,), i), {},
+    )
+
+
+def check_schema_csr(h: EngineHarness, out_tree,
+                     base_rows: list | None) -> list:
+    """Schema guard for the CSR engine: weak-type audit, then the rows
+    must equal the base engine's EXACTLY — the sparse layout is a
+    Net-side structure and must never add, drop, or retype a state
+    leaf (the checkpoint-v6 no-version-bump contract)."""
+    rows = schema_of(out_tree)
+    weak = [r["path"] for r in rows if r["weak_type"]]
+    if weak:
+        raise GuardViolation(
+            h.name, "schema",
+            f"weak-typed state leaves {weak[:4]} in the csr step",
+        )
+    if base_rows is not None:
+        mism = diff_schema(h.name, rows, base_rows)
+        if mism:
+            raise GuardViolation(
+                h.name, "schema",
+                f"{len(mism)} state-leaf drift(s) vs the {CSR_BASE!r} "
+                "baseline — the csr layout leaked into the state tree: "
+                + "; ".join(mism[:5]),
+            )
+    return rows
 
 
 def build_telemetry_harness() -> EngineHarness:
@@ -546,6 +598,19 @@ def run_ensemble_engine(base_rows: list | None) -> list:
     return rows
 
 
+def run_csr_engine(base_rows: list | None) -> list:
+    """All guards for the sparse-plane path: strict-dtype trace of the
+    CSR-built step (the flat-edge kernels must promote nothing), the
+    exact-equality schema check against the base engine's rows, buffer
+    donation, and the GUARD_ROUNDS one-compile/transfer-guard run."""
+    h = build_csr_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_csr(h, out_tree, base_rows)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
 def run_telemetry_engine(base_rows: list | None) -> list:
     """All guards for the telemetry-on path: strict-dtype trace, the
     telem-leaf pin + base-row comparison, buffer-donation audit, and
@@ -621,6 +686,16 @@ def run(update: bool | None = None, root: str | None = None) -> list:
             failures.append(str(e))
         except Exception as e:  # noqa: BLE001 — any crash is a finding
             failures.append(f"[{TELEMETRY_ENGINE}] harness crashed: "
+                            f"{type(e).__name__}: {str(e)[:300]}")
+    # the sparse-plane path validates against the same base rows too
+    # (exact equality — the CSR layout is Net-side only; round 15)
+    if base_rows is not None:
+        try:
+            run_csr_engine(base_rows)
+        except GuardViolation as e:
+            failures.append(str(e))
+        except Exception as e:  # noqa: BLE001 — any crash is a finding
+            failures.append(f"[{CSR_ENGINE}] harness crashed: "
                             f"{type(e).__name__}: {str(e)[:300]}")
     if update and not failures:
         write_baseline(schemas, root)

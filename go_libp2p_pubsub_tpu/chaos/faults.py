@@ -39,6 +39,14 @@ CHAOS_TAG))``. Consequences, all deliberate:
     Gilbert–Elliott chain's only state is its [N, K] bad plane
     (state.ChaosState, carried in SimState and checkpointed).
 
+Edge-layout composition (round 15): the [N, K] masks this module
+produces compose with BOTH exchange layouts for free — routers AND
+them into the [N, K, W] edge mask before the shared delivery engine,
+and the CSR path (ops/csr.py) packs that composed mask onto the
+present edges (``pack_edges``), so chaos adds zero layout-specific
+code and the dense-vs-CSR parity suite runs with chaos ON
+(tests/test_csr.py).
+
 Static elision contract: a build whose ``ChaosConfig`` is ``None`` (or
 ``enabled`` is False) traces exactly the code it traced before the
 chaos plane existed — no masks, no counters, no extra ops. Pinned by

@@ -34,6 +34,13 @@ cells — compiles through one code path.
 DONATION RULE: the window donates the state tree through the scan carry
 (``donate_argnums=0``), exactly like the jitted steps donate their
 state — callers must NOT reuse a state tree after a window.
+
+Edge layout (round 15): windows carry the sparse data plane for free —
+a CSR-built step (cfg.edge_layout="csr", ops/csr.py) scans its flat
+[E] exchange inside the same one-dispatch program, with the folded
+invariant checker reading the unchanged state tree (`make scale-smoke`
+drives an N=1M CSR window this way; tests/test_csr.py pins
+scanned-vs-loop parity on the csr layout).
 """
 
 from __future__ import annotations

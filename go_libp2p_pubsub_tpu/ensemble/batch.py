@@ -13,7 +13,11 @@ Lifting contract (pinned by tests/test_ensemble.py):
     plumbing.
   * **config stays static**: the lifted step closes over the same
     ``cfg``/``net``/score tables the unbatched step compiled against —
-    one trace, one compile, S sims.
+    one trace, one compile, S sims. That includes the round-15 sparse
+    data plane: a CSR-built Net's flat [E] index arrays are shared
+    trace constants like the dense edge_perm, so the vmapped exchange
+    stays E-sized per sim and S=3 dense-vs-CSR ensembles are bit-exact
+    (tests/test_csr.py).
   * **per-sim array inputs grow a leading S axis**: publish schedules,
     churn ``up`` rows, chaos ``link_deny`` masks. One program can run S
     *different scenarios*, not just S seeds — tile with :func:`tile`

@@ -199,6 +199,38 @@ def delivery_round(
                 interpret=interpret, count_events=count_events,
             )
 
+    not_mine = ~origin_msg_words(net, msgs)  # [N, W]
+    if msgs.wire_block is not None:
+        # oversized messages never cross any edge (sendRPC's fragmentRPC
+        # drop, gossipsub.go:1126-1140) — they still live in mcache and
+        # get IHAVE-advertised, like the reference's
+        not_mine = not_mine & ~bitset.pack(msgs.wire_block)[None, :]
+
+    if net.edge_layout == "csr":
+        # sparse data plane (ops/csr.py, docs/DESIGN.md §15): the whole
+        # transmit composition runs over the flat [E, W] edge space —
+        # the neighbor fwd view and the echo involution are E-sized
+        # gathers, the edge/chaos/adversary masks pack down to the
+        # present edges, and dead padded slots never move (absent
+        # edges aren't in E, so the dense path's nbr_ok word mask has
+        # no flat counterpart). One local unpack rebuilds the
+        # [N, K, W] transmit tensor for the shared commit tail
+        # (finish_delivery) and the RoundInfo consumers (scoring
+        # attribution, IWANT merge, telemetry popcounts), so the
+        # delivery semantics stay single-source and dense-vs-CSR
+        # parity is bit-exact (tests/test_csr.py, all four engines).
+        fwd_e = net.peer_gather_flat(dlv.fwd)                    # [E, W]
+        echo_e = net.edge_gather_flat(net.pack_edges(dlv.fe_words))
+        mask_e = net.pack_edges(edge_mask)
+        # receiver-side gate, read at each edge's owner (a local gather)
+        not_mine_e = not_mine[net.csr_row]
+        trans = net.unpack_edges(fwd_e & ~echo_e & mask_e & not_mine_e)
+        return finish_delivery(
+            net, msgs, dlv, trans, tick, forward_mask=forward_mask,
+            count_events=count_events, queue_cap=queue_cap,
+            val_delay_topic=val_delay_topic,
+        )
+
     # what each sender is forwarding this round: [N, K, W] word gather
     fwd_gathered = net.peer_gather(dlv.fwd)
 
@@ -209,12 +241,6 @@ def delivery_round(
     echo_words = net.edge_gather(dlv.fe_words)
 
     ok_words = jnp.where(net.nbr_ok[..., None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-    not_mine = ~origin_msg_words(net, msgs)  # [N, W]
-    if msgs.wire_block is not None:
-        # oversized messages never cross any edge (sendRPC's fragmentRPC
-        # drop, gossipsub.go:1126-1140) — they still live in mcache and
-        # get IHAVE-advertised, like the reference's
-        not_mine = not_mine & ~bitset.pack(msgs.wire_block)[None, :]
 
     trans = fwd_gathered & ~echo_words & edge_mask & ok_words & not_mine[:, None, :]
     return finish_delivery(

@@ -7,6 +7,12 @@ and the origin. Dedup is the seen-cache. No mesh, no gossip, no scoring.
 Vector form: the edge-carry mask is simply "receiver subscribes to the
 topic" — one packed word-mask per receiver, broadcast over its edges; the
 shared delivery engine applies the source/origin exclusions and dedup.
+
+Edge layout: the step inherits the Net's static ``edge_layout`` through
+the shared ``delivery_round`` seam — a ``Net.build(edge_layout="csr")``
+topology runs the whole transmit composition over the flat [E] edge
+space (ops/csr.py; bit-exact vs dense, tests/test_csr.py) with zero
+runtime branching, which is what `make scale-smoke` drives at N=1M.
 """
 
 from __future__ import annotations

@@ -168,6 +168,25 @@ class GossipSubConfig:
     # (engine.wire_coalesced) and the measured permute_sets_per_phase.
     # Bit-identical either way (tests/test_phase_stacked.py).
     wire_coalesced: bool = True
+    # sparse data plane (round 15, ops/csr.py + docs/DESIGN.md §15): the
+    # edge-exchange layout — "dense" (the padded [N, K] involution, the
+    # default: traces the pre-CSR program bit for bit) or "csr" (the
+    # capacity-bounded flat [E] edge space; cross-peer movement is
+    # E-sized, the sparse-topology regime's shape). A frozen static: one
+    # build traces exactly ONE layout, zero runtime branching; the Net
+    # must be built with the same value (prepare_step_consts enforces).
+    edge_layout: str = "dense"
+    # int-packed control counters (round 15 narrowing contract, docs/
+    # DESIGN.md §15): store the per-edge IHAVE flood-protection counters
+    # (peerhave/iasked) as int16 instead of int32. EXACT by range
+    # analysis — both are cleared every heartbeat; iasked saturates at
+    # the max_ihave_length cap it gates on, and peerhave grows at most
+    # one batch per round so the heartbeat cadence bounds it — and
+    # build() refuses configs whose bound (max_ihave_length or
+    # heartbeat_every) falls outside int16, so the narrowed build is
+    # bit-identical in VALUES (tests/test_csr.py). Off by default (the
+    # committed STATE_SCHEMA pins the wide dtypes).
+    narrow_counters: bool = False
     # chaos plane (chaos/faults.py): link-fault injection — i.i.d. or
     # Gilbert–Elliott flap generators drawn from the sim PRNG stream,
     # plus (scheduled=True) a per-round link_deny argument fed by the
@@ -207,9 +226,34 @@ class GossipSubConfig:
         trace_exact: bool = False,
         wire_coalesced: bool = True,
         chaos: "ChaosConfig | None" = None,
+        edge_layout: str = "dense",
+        narrow_counters: bool = False,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
+        if edge_layout not in ("dense", "csr"):
+            raise ValueError(
+                f"edge_layout must be 'dense' or 'csr', got {edge_layout!r}"
+            )
+        if narrow_counters and p.max_ihave_length >= 2 ** 15:
+            # the iasked counter saturates at the cap it gates on; a cap
+            # outside int16 range would overflow before the gate fires
+            raise ValueError(
+                f"narrow_counters needs max_ihave_length < {2**15} "
+                f"(got {p.max_ihave_length}) — the int16 iasked counter "
+                "must be able to represent its own cap"
+            )
+        if narrow_counters and heartbeat_every >= 2 ** 15:
+            # peerhave's true bound is the heartbeat clear cadence, not
+            # max_ihave_messages: it counts one IHAVE batch per round
+            # (handle_ihave) and only clearIHaveCounters resets it, so
+            # an edge advertising every round reaches heartbeat_every
+            # before the clear
+            raise ValueError(
+                f"narrow_counters needs heartbeat_every < {2**15} "
+                f"(got {heartbeat_every}) — the int16 peerhave counter "
+                "grows once per round until the heartbeat clear"
+            )
         if validator_timeout_rounds < 0:
             raise ValueError(
                 f"validator_timeout_rounds must be >= 0, got {validator_timeout_rounds}"
@@ -254,6 +298,8 @@ class GossipSubConfig:
             trace_exact=trace_exact,
             wire_coalesced=wire_coalesced,
             chaos=chaos,
+            edge_layout=edge_layout,
+            narrow_counters=narrow_counters,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if chaos is not None:
@@ -393,8 +439,13 @@ class GossipSubState:
             iwant_out=jnp.zeros((n, k, w), jnp.uint32),
             graft_out=jnp.zeros((n, s, k), bool),
             prune_out=jnp.zeros((n, s, k), bool),
-            peerhave=jnp.zeros((n, k), jnp.int32),
-            iasked=jnp.zeros((n, k), jnp.int32),
+            # IHAVE flood-protection counters: int16 under the round-15
+            # narrowing contract (cfg.narrow_counters — exact: heartbeat-
+            # cleared, cap-bounded; build() refuses caps outside range)
+            peerhave=jnp.zeros(
+                (n, k), jnp.int16 if cfg.narrow_counters else jnp.int32),
+            iasked=jnp.zeros(
+                (n, k), jnp.int16 if cfg.narrow_counters else jnp.int32),
             served_lo=jnp.zeros((n, k, w), jnp.uint32),
             served_hi=jnp.zeros((n, k, w), jnp.uint32),
             promise_mid=jnp.full((n, k), -1, jnp.int32),
@@ -546,7 +597,7 @@ def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     ihave_in = jnp.where(acc_ok[:, :, None], ihave_in_raw, jnp.uint32(0))
 
     got = bitset.popcount(ihave_in, axis=-1) > 0  # [N,K] one batch per round
-    peerhave = st.peerhave + got.astype(jnp.int32)
+    peerhave = st.peerhave + got.astype(st.peerhave.dtype)
 
     ok = got
     if cfg.score_enabled:
@@ -561,12 +612,13 @@ def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     # one heartbeat's asks could exceed it; with msg_slots far below the cap
     # (the iasked >= cap gate above already ran) skip the prefix-cap pass
     if m * (cfg.heartbeat_every + 1) > cfg.max_ihave_length:
-        budget = jnp.maximum(cfg.max_ihave_length - st.iasked, 0)
+        budget = jnp.maximum(cfg.max_ihave_length - st.iasked, 0).astype(
+            jnp.int32)  # the prefix-cap cumsum compares in int32
         asks = _prefix_cap_bits(wants, budget, m)
     else:
         asks = wants
     n_asked = bitset.popcount(asks, axis=-1)
-    iasked = st.iasked + n_asked
+    iasked = st.iasked + n_asked.astype(st.iasked.dtype)
 
     # adopt one promised mid per edge when none is outstanding
     first_ask, _has = bitset.lowest_bit(asks)
@@ -1404,6 +1456,16 @@ def prepare_step_consts(
 ) -> StepConsts:
     """Validate the configuration and build the static topology constants
     (see the field comments inline — each maps a reference-side check)."""
+    if cfg.edge_layout != net.edge_layout:
+        # the layout is a FROZEN static: one engine build traces exactly
+        # one layout (docs/DESIGN.md §15) — a config/net mismatch would
+        # silently trace the net's layout while the fingerprint records
+        # the config's
+        raise ValueError(
+            f"cfg.edge_layout={cfg.edge_layout!r} but the Net was built "
+            f"with edge_layout={net.edge_layout!r} — build both with the "
+            "same layout (Net.build(..., edge_layout=...))"
+        )
     if cfg.gater_enabled:
         assert gater_params is not None
         gater_params.validate()

@@ -27,6 +27,15 @@ edge-gather + carry edge-gather). On the sharded mesh that is one set of
 halo permutes per sub-round. The two forms are boolean-algebra equal;
 tests/test_phase.py pins r=1 phase == per-round step bit-exactly.
 
+Edge layout (round 15): every cross-peer gather here — the sub-round
+sender-side exchange AND the stacked coalesced control head — goes
+through ``net.edge_gather``/``net.peer_gather``, so a
+``cfg.edge_layout="csr"`` build (ops/csr.py, with a matching
+``Net.build(edge_layout="csr")``) routes the whole phase over the flat
+[E] edge space with zero runtime branching; prepare_step_consts
+rejects a layout mismatch, and tests/test_csr.py pins phase-engine
+dense-vs-CSR bit-exactness at r∈{4,8} with chaos on.
+
 Round 7 (cfg.wire_coalesced, the default) restructures the rest of the
 phase the same way — launch count over everything else, because at the
 12.5k shard BOTH terms of rate = 1/(shard_ms + ici_ms) are

@@ -9,6 +9,11 @@ Vector form: each sender draws a fresh random-k edge selection per topic
 slot per round over the gossip-capable neighbors, ORs in the floodsub-only
 edges unconditionally; the receiver-side gather translates it through the
 reverse-edge index exactly like the gossipsub mesh mask.
+
+Edge layout: both the carry-outbox gather here and the shared delivery
+engine dispatch on the Net's static ``edge_layout`` — a CSR-built Net
+(ops/csr.py) runs them over the flat [E] edge space, bit-exact vs the
+dense involution (tests/test_csr.py).
 """
 
 from __future__ import annotations

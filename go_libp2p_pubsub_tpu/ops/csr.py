@@ -1,0 +1,263 @@
+"""Capacity-bounded CSR edge layout: the sparse data plane (round 15).
+
+The dense edge involution (ops/edges.py) spends every cross-peer gather
+on the full padded ``[N, K]`` slot space — on a capacity-padded ragged
+topology (power-law / random graphs padded to the max degree) most of
+those slots are dead, yet every exchange moves, masks, and re-reads
+them. This module is the sparse-regime alternative (Topiary,
+arXiv:2312.06800, is the scalable-pubsub exemplar): the E present edges
+packed flat in row-major ``(owner, slot)`` order with a row-pointer —
+a *capacity-bounded* CSR, meaning every row holds at most K entries,
+which is what lets ragged reductions compile to bounded-width gathers
+instead of sorts or data-dependent loops.
+
+Layout (host-built once per topology, ``build_csr``):
+
+  row_ptr[N+1]   edges of peer n are the contiguous span
+                 ``[row_ptr[n], row_ptr[n+1])``
+  col[E]         neighbor peer id of each edge (the CSR column index)
+  row[E]         owner peer id (the expanded row index; sorted)
+  e2nk[E]        flat ``n*K + k`` dense-slot address of each edge — the
+                 PACK gather (dense plane -> flat edge plane)
+  e_of_nk[N,K]   flat edge id of each dense slot, -1 where absent — the
+                 UNPACK gather (flat -> dense, absent slots filled)
+  eperm[E]       the edge involution in FLAT edge space:
+                 ``eperm[e_of_nk[n,k]] == e_of_nk[nbr[n,k], rev[n,k]]``
+                 — an [E] permutation (its own inverse), the sparse
+                 counterpart of ops/edges.build_edge_perm
+
+Cross-peer data movement in this layout is E-sized, not N*K-sized:
+``edge_permute_flat`` (the involution) and ``peer_gather_flat`` (the
+neighbor view) are 1-D row gathers over [E, ...] arrays — dead slots
+never cross the wire. Pack/unpack are LOCAL relayouts (each peer reads
+its own slots), so they add nothing to the halo-permute budget the
+v5e-8 projection charges (only the two flat gathers tally, exactly like
+their dense counterparts).
+
+Reductions back to peers come in two exact-equivalent forms:
+
+  * ``segment_sum_edges`` — ``jax.ops.segment_sum`` over the sorted row
+    ids (arithmetic reductions: counts, scores);
+  * ``segment_or_words`` / ``segment_or_scan`` — bitwise-OR has no
+    exact segment_sum decomposition (bits collide), so the packed-word
+    OR reduction is either a segmented associative scan (log-depth
+    passes over [E, W] — the fully-flat form) or the capacity-bounded
+    gather (``unpack_edges`` + ``bitset.word_or_reduce`` — one
+    bounded-width pass). The delivery engine uses the bounded-gather
+    form: at bench densities the K-bounded pass reads less than the
+    log2(E) scan sweeps, and its [N, K, W] intermediate is needed for
+    the RoundInfo transmit tensor anyway (docs/DESIGN.md §15 has the
+    tradeoff table). Both are property-tested equal.
+
+Word-dtype hygiene: every literal in a packed-word op below is an
+explicit ``jnp.uint32`` (simlint ``word-dtype``); no traced Python
+branches (``traced-branch``) — layout selection is trace-time static
+(state.Net.edge_layout, a pytree-aux field).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import edges as _edges
+
+
+@dataclasses.dataclass(frozen=True)
+class CsrTopology:
+    """Host-side CSR build of one padded adjacency (see module doc)."""
+
+    row_ptr: np.ndarray   # [N+1] i32
+    col: np.ndarray       # [E] i32
+    row: np.ndarray       # [E] i32 (sorted ascending)
+    slot: np.ndarray      # [E] i32 — dense slot k of each edge
+    e2nk: np.ndarray      # [E] i32 — flat n*K + k
+    e_of_nk: np.ndarray   # [N, K] i32, -1 absent
+    eperm: np.ndarray     # [E] i32 — flat involution
+
+    @property
+    def n_peers(self) -> int:
+        return self.row_ptr.shape[0] - 1
+
+    @property
+    def max_degree(self) -> int:
+        return self.e_of_nk.shape[1]
+
+    @property
+    def n_edges(self) -> int:
+        return self.col.shape[0]
+
+    @property
+    def density(self) -> float:
+        """E / (N*K): the fraction of padded slots that hold an edge —
+        the dense-vs-CSR byte ratio for per-edge exchange traffic."""
+        return self.n_edges / float(self.n_peers * self.max_degree)
+
+    @property
+    def seg_start(self) -> np.ndarray:
+        """[E] bool: True at the first edge of each (nonempty) row —
+        the segmented-scan reset flags."""
+        s = np.zeros(self.n_edges, bool)
+        starts = self.row_ptr[:-1][self.row_ptr[:-1] < self.row_ptr[1:]]
+        s[starts] = True
+        return s
+
+    @property
+    def row_last(self) -> np.ndarray:
+        """[N] i32: index of each row's last edge (clip-safe junk for
+        empty rows — pair with ``row_nonempty``)."""
+        return np.maximum(self.row_ptr[1:] - 1, 0).astype(np.int32)
+
+    @property
+    def row_nonempty(self) -> np.ndarray:
+        return (self.row_ptr[1:] > self.row_ptr[:-1])
+
+
+def build_csr(nbr: np.ndarray, rev: np.ndarray,
+              nbr_ok: np.ndarray) -> CsrTopology:
+    """Build the CSR layout from the padded adjacency (graph.Topology
+    fields). Requires a symmetric topology (every present edge's
+    reverse present — the graph builders' invariant); raises otherwise,
+    because the flat involution would have nowhere to point."""
+    nbr = np.asarray(nbr)
+    rev = np.asarray(rev)
+    nbr_ok = np.asarray(nbr_ok, bool)
+    n, k = nbr.shape
+    rows, slots = np.nonzero(nbr_ok)  # row-major: sorted by (n, k)
+    e = rows.shape[0]
+    if e == 0:
+        raise ValueError("build_csr: topology has no edges")
+    e_of_nk = np.full((n, k), -1, np.int32)
+    e_of_nk[rows, slots] = np.arange(e, dtype=np.int32)
+    col = nbr[rows, slots].astype(np.int32)
+    eperm = e_of_nk[col, rev[rows, slots]]
+    if (eperm < 0).any():
+        bad = int(np.flatnonzero(eperm < 0)[0])
+        raise ValueError(
+            f"build_csr: edge {int(rows[bad])}->{int(col[bad])} has no "
+            "present reverse edge — the topology is not symmetric"
+        )
+    if not (eperm[eperm] == np.arange(e)).all():
+        raise ValueError("build_csr: rev mapping is not an involution")
+    counts = nbr_ok.sum(axis=1).astype(np.int64)
+    row_ptr = np.zeros(n + 1, np.int32)
+    np.cumsum(counts, out=row_ptr[1:])
+    return CsrTopology(
+        row_ptr=row_ptr,
+        col=col,
+        row=rows.astype(np.int32),
+        slot=slots.astype(np.int32),
+        e2nk=(rows * k + slots).astype(np.int32),
+        e_of_nk=e_of_nk,
+        eperm=eperm.astype(np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device kernels — local relayouts (no halo cost)
+
+
+def pack_edges(x: jax.Array, e2nk: jax.Array, k: int) -> jax.Array:
+    """[N, K, ...] dense plane -> [E, ...] flat edge plane (present
+    slots only, row-major order). A local take — each peer reads its
+    own slots, so this never crosses the peer axis."""
+    n = x.shape[0]
+    flat = x.reshape((n * k,) + x.shape[2:])
+    return flat[e2nk]
+
+
+def unpack_edges(x_e: jax.Array, e_of_nk: jax.Array,
+                 fill=None) -> jax.Array:
+    """[E, ...] flat edge plane -> [N, K, ...] dense plane; absent
+    slots take ``fill`` (default: the dtype's zero). Local scatter-by-
+    gather (each peer writes its own slots)."""
+    n, k = e_of_nk.shape
+    idx = jnp.clip(e_of_nk, 0).reshape(-1)
+    got = x_e[idx].reshape((n, k) + x_e.shape[1:])
+    present = (e_of_nk >= 0).reshape((n, k) + (1,) * (x_e.ndim - 1))
+    if fill is None:
+        fill = jnp.zeros((), x_e.dtype)
+    return jnp.where(present, got, fill)
+
+
+# ---------------------------------------------------------------------------
+# device kernels — cross-peer gathers (one halo tally each, exactly
+# like their dense counterparts in ops/edges.py)
+
+
+def edge_permute_flat(x_e: jax.Array, eperm: jax.Array) -> jax.Array:
+    """The edge involution in flat space: out[e] = x_e[eperm[e]] —
+    E-sized cross-peer movement (the dense form moves N*K)."""
+    _edges._tally("edge")
+    return x_e[eperm]
+
+
+def peer_gather_flat(v: jax.Array, col: jax.Array) -> jax.Array:
+    """Flat neighbor view: out[e] = v[col[e]] ([N, ...] -> [E, ...])."""
+    _edges._tally("peer")
+    return v[col]
+
+
+# ---------------------------------------------------------------------------
+# segment reductions over the sorted row ids
+
+
+def segment_sum_edges(x_e: jax.Array, row: jax.Array,
+                      n_peers: int) -> jax.Array:
+    """Arithmetic per-peer reduction of a flat edge plane:
+    out[n] = sum of x_e over peer n's edges (``jax.ops.segment_sum``
+    over the sorted row ids — the CSR-native reduction)."""
+    return jax.ops.segment_sum(
+        x_e, row, num_segments=n_peers, indices_are_sorted=True
+    )
+
+
+def segment_popcount(words_e: jax.Array, row: jax.Array,
+                     n_peers: int) -> jax.Array:
+    """[E, W] packed words -> [N] i32 per-peer set-bit counts."""
+    per_edge = jnp.sum(
+        jax.lax.population_count(words_e).astype(jnp.int32), axis=-1
+    )
+    return segment_sum_edges(per_edge, row, n_peers)
+
+
+def segment_or_scan(words_e: jax.Array, seg_start: jax.Array
+                    ) -> tuple[jax.Array, jax.Array]:
+    """Segmented prefix-OR over a flat packed-word plane.
+
+    Returns ``(inclusive, exclusive)`` [E, W] prefix ORs within each
+    row segment — ``exclusive`` is the word-OR of all earlier edges of
+    the same row (zero at row starts), which is exactly the mask the
+    first-arrival isolation needs (``x & ~exclusive`` keeps each bit's
+    first carrying edge, the flat analogue of
+    ``bitset.first_set_per_bit``). Log-depth associative scan; see the
+    module docstring for when the capacity-bounded gather form wins."""
+    flags = jnp.asarray(seg_start, bool)
+
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf[..., None], bv, av | bv), af | bf
+
+    inc, _ = jax.lax.associative_scan(comb, (words_e, flags), axis=0)
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(inc[:1]), inc[:-1]], axis=0
+    )
+    exc = jnp.where(flags[:, None], jnp.uint32(0), shifted)
+    return inc, exc
+
+
+def segment_or_words(words_e: jax.Array, seg_start: jax.Array,
+                     row_last: jax.Array,
+                     row_nonempty: jax.Array) -> jax.Array:
+    """[E, W] -> [N, W] per-peer word-OR via the segmented scan (the
+    fully-flat form; property-tested equal to unpack +
+    ``bitset.word_or_reduce``)."""
+    inc, _ = segment_or_scan(words_e, seg_start)
+    out = inc[jnp.clip(row_last, 0)]
+    return jnp.where(
+        jnp.asarray(row_nonempty, bool)[:, None], out, jnp.uint32(0)
+    )

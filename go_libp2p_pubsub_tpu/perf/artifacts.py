@@ -263,6 +263,15 @@ class BenchRecord:
         return None if v is None else bool(v)
 
     @property
+    def edge_layout(self) -> str:
+        """The engine's round-15 edge-exchange layout ("dense" |
+        "csr"); every artifact that predates the field measured the
+        dense involution, so legacy lines read back "dense"."""
+        fp = self.fingerprint or {}
+        eng = fp.get("engine") or {}
+        return str(eng.get("edge_layout") or "dense")
+
+    @property
     def chaos(self) -> dict:
         """The chaos-plane block of the fingerprint. LEGACY artifacts
         (rounds 1-7 — every line that predates the chaos plane) read
@@ -502,6 +511,32 @@ def load_bench_lines(path: str) -> list[BenchRecord]:
             out.append(record_from_line(obj, round_index=ridx))
     if not out:  # single non-line JSON (wrapper or object): delegate
         return [load_bench_artifact(path)]
+    return out
+
+
+def load_bench_variants(path: str) -> dict[str, BenchRecord]:
+    """Every engine-variant record a driver-wrapper artifact carries,
+    keyed by wrapper field: ``"parsed"`` (the headline — what
+    ``load_bench_artifact`` returns) plus any ``parsed_*`` sibling
+    (round 15: ``parsed_csr``, the CSR edge-layout cell measured at the
+    same shape so the dense-vs-csr tradeoff stays a READABLE committed
+    number, not write-only data). Non-wrapper artifacts come back as
+    ``{"parsed": <record>}``."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        # JSON-lines artifact: no variant fields by construction
+        return {"parsed": load_bench_artifact(path)}
+    if not (isinstance(obj, dict) and "parsed" in obj):
+        # single bare record — already parsed, don't re-read the file
+        return {"parsed": record_from_line(obj)}
+    out = {}
+    for key, val in obj.items():
+        if key == "parsed" or key.startswith("parsed_"):
+            if isinstance(val, dict) and "metric" in val:
+                out[key] = record_from_line(val, round_index=obj.get("n"))
     return out
 
 

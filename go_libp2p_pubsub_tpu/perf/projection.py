@@ -67,6 +67,10 @@ ROUND5_SHARD_RATES_R16 = {
     200_000: 1_046.0,
 }
 
+#: v5e per-chip HBM capacity (bytes) — the memory wall the N-scaling
+#: model checks a shard against (16 GB HBM2E per v5e chip)
+HBM_BYTES_PER_CHIP = 16 * 1024 ** 3
+
 
 def permutes_per_round(rounds_per_phase: int,
                        permute_sets_per_phase: int | None = None) -> float:
@@ -208,6 +212,123 @@ def project(shard_ms_per_round: float, rounds_per_phase: int,
             float(dispatches_per_round)
             if dispatches_per_round is not None else None
         ),
+    )
+
+
+def shard_ms_at(shard_n: int,
+                shard_rates: dict | None = None) -> float:
+    """Measured-anchored shard round time (ms) at an arbitrary shard
+    size: piecewise-LINEAR interpolation of the committed shard table
+    (round time is plane-bandwidth-bound above the fixed-overhead knee,
+    so ms grows ~linearly in shard N — the table's own 100k->200k
+    segment is the evidence), extrapolated with the last segment's
+    per-peer slope beyond the table. Below the smallest measured shard
+    the smallest row's time is returned unscaled (fixed per-fusion
+    overhead dominates there; extrapolating the slope down would
+    project impossible sub-overhead times)."""
+    rates = shard_rates or ROUND5_SHARD_RATES_R16
+    pts = sorted((int(n), 1000.0 / float(r)) for n, r in rates.items())
+    if len(pts) < 2:
+        raise ValueError("shard_rates needs >= 2 measured sizes")
+    n = int(shard_n)
+    if n <= pts[0][0]:
+        return pts[0][1]
+    for (n0, t0), (n1, t1) in zip(pts, pts[1:]):
+        if n <= n1:
+            return t0 + (t1 - t0) * (n - n0) / (n1 - n0)
+    (n0, t0), (n1, t1) = pts[-2], pts[-1]
+    return t1 + (t1 - t0) / (n1 - n0) * (n - n1)
+
+
+@dataclasses.dataclass
+class ScaleProjection:
+    """The N-scaling projection (round 15): the v5e-8 rate target
+    evaluated at an arbitrary peer count, with the memory term made
+    explicit — `fits_hbm` is the feasibility gate the 100k-anchored
+    projections silently assumed."""
+
+    n_peers: int
+    n_shards: int
+    shard_n: int
+    projection: Projection          # the rate model at this shard size
+    bytes_per_peer: float | None    # from the memstat audit (None = unchecked)
+    shard_state_bytes: float | None
+    hbm_bytes: int
+    fits_hbm: bool | None           # None when bytes_per_peer is None
+    hbm_headroom: float | None      # hbm / shard_state_bytes
+
+    def summary(self) -> dict:
+        out = {
+            "n_peers": self.n_peers,
+            "n_shards": self.n_shards,
+            "shard_n": self.shard_n,
+            **self.projection.summary(),
+        }
+        if self.bytes_per_peer is not None:
+            out.update(
+                bytes_per_peer=round(float(self.bytes_per_peer), 1),
+                shard_state_gb=round(self.shard_state_bytes / 1024 ** 3, 3),
+                fits_hbm=self.fits_hbm,
+                hbm_headroom=round(float(self.hbm_headroom), 2),
+            )
+        return out
+
+
+def project_at_scale(n_peers: int, rounds_per_phase: int = 16,
+                     n_shards: int = 8, *,
+                     bytes_per_peer: float | None = None,
+                     hbm_bytes: int = HBM_BYTES_PER_CHIP,
+                     shard_rates: dict | None = None,
+                     permute_sets_per_phase: int | None = None,
+                     dispatch_overhead_ms: float = 0.0,
+                     dispatches_per_round: float | None = None
+                     ) -> ScaleProjection:
+    """Project the v5e-8 rate at an ARBITRARY peer count (the round-15
+    ask: the 10k-ticks/s target priced at 1M peers, not just 100k).
+
+    Two N-scaling terms on top of :func:`project`:
+
+    * **compute/bandwidth** — the shard round time scales with shard
+      size through the measured table (:func:`shard_ms_at`): plane
+      traffic is linear in shard N once past the fixed-overhead knee.
+    * **memory** — ``bytes_per_peer`` (the ``make mem-audit`` number,
+      MEM_AUDIT.json ``totals``) × shard N against per-chip HBM: the
+      projection is FICTION when the shard state doesn't fit, which is
+      exactly the wall between N=100k and N=1M the sparse data plane
+      (docs/DESIGN.md §15) exists to push back.
+
+    The permute term needs no N scaling by construction: halo permutes
+    move fixed band-edge rows whose volume stays negligible against
+    launch latency at any shard size (the round-3 cost model), and the
+    permute COUNT is topology-band-bound, not N-bound.
+
+    Defaults change nothing committed: :func:`project` and
+    :func:`project_from_artifacts` are untouched, so every pre-round-15
+    projection reproduces byte-identical (tests/test_perf.py round-5
+    pin; tests/test_csr.py pins this function against the table)."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    shard_n = int(n_peers) // int(n_shards)
+    if shard_n < 1:
+        raise ValueError(f"n_peers {n_peers} < n_shards {n_shards}")
+    proj = project(
+        shard_ms_at(shard_n, shard_rates), rounds_per_phase,
+        n_shards=n_shards,
+        permute_sets_per_phase=permute_sets_per_phase,
+        dispatch_overhead_ms=dispatch_overhead_ms,
+        dispatches_per_round=dispatches_per_round,
+    )
+    if bytes_per_peer is None:
+        shard_bytes = fits = headroom = None
+    else:
+        shard_bytes = float(bytes_per_peer) * shard_n
+        fits = shard_bytes <= hbm_bytes
+        headroom = hbm_bytes / shard_bytes if shard_bytes else float("inf")
+    return ScaleProjection(
+        n_peers=int(n_peers), n_shards=int(n_shards), shard_n=shard_n,
+        projection=proj, bytes_per_peer=bytes_per_peer,
+        shard_state_bytes=shard_bytes, hbm_bytes=int(hbm_bytes),
+        fits_hbm=fits, hbm_headroom=headroom,
     )
 
 

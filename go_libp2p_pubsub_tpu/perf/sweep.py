@@ -86,10 +86,26 @@ def bench_wire_coalesced(wire_coalesced: bool | None = None) -> bool:
     return os.environ.get("BENCH_WIRE_COALESCED", "1") != "0"
 
 
+def bench_edge_layout(edge_layout: str | None = None) -> str:
+    """The bench's edge-exchange layout (round-15 A/B knob): "dense"
+    (the default — the padded [N, K] involution, census-identical to
+    every prior round) or "csr" (the capacity-bounded flat edge space,
+    ops/csr.py). BENCH_EDGE_LAYOUT overrides. Single source for the
+    workload builder AND the fingerprint."""
+    if edge_layout is None:
+        edge_layout = os.environ.get("BENCH_EDGE_LAYOUT", "dense")
+    if edge_layout not in ("dense", "csr"):
+        raise ValueError(
+            f"BENCH_EDGE_LAYOUT must be 'dense' or 'csr', got {edge_layout!r}"
+        )
+    return edge_layout
+
+
 def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "default",
                 heartbeat_every: int = 1, rounds_per_phase: int = 1,
                 wire_coalesced: bool | None = None,
-                telemetry=None, count_events: bool | None = None):
+                telemetry=None, count_events: bool | None = None,
+                edge_layout: str | None = None):
     """Build (state, step, n_topics, honest) for a BENCH_CONFIG:
 
     default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
@@ -143,7 +159,8 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     else:
         n_topics = 1
         subs = graph.subscribe_all(n_peers, 1)
-    net = Net.build(topo, subs)
+    layout = bench_edge_layout(edge_layout)
+    net = Net.build(topo, subs, edge_layout=layout)
 
     params = _dc.replace(GossipSubParams(), flood_publish=False)
     _tp, sp = bench_score_params(config, n_topics)
@@ -157,6 +174,7 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         validation_capacity=8 if config == "sybil" else 0,
         heartbeat_every=heartbeat_every,
         wire_coalesced=bench_wire_coalesced(wire_coalesced),
+        edge_layout=layout,
     )
     # tracer-detached configuration (tracing is opt-in in the reference):
     # no aggregate event counters; no fanout slots when every peer
@@ -279,6 +297,7 @@ def workload_fingerprint(
     seg_rounds: int | None = None,
     unroll: int | None = None,
     wire_coalesced: bool | None = None,
+    edge_layout: str | None = None,
 ) -> dict:
     """The schema-v2 self-description of a bench cell: everything a
     future reader needs to know what the number measured, derived from
@@ -329,6 +348,11 @@ def workload_fingerprint(
             # exchange + accumulator stacking + head publish plan);
             # False = the legacy per-plane A/B path
             "wire_coalesced": coalesced,
+            # the round-15 sparse data plane: "dense" (padded [N, K]
+            # involution) or "csr" (flat [E] edge space, ops/csr.py);
+            # legacy artifacts without the field read back "dense"
+            # (artifacts.BenchRecord.edge_layout)
+            "edge_layout": bench_edge_layout(edge_layout),
             "gater": config == "sybil",
             "validation_capacity": 8 if config == "sybil" else 0,
             "count_events": False,

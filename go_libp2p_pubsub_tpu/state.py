@@ -22,7 +22,7 @@ import numpy as np
 from flax import struct
 
 from . import graph as graphlib
-from .ops import bitset, edges
+from .ops import bitset, csr, edges
 from .trace.events import zero_counters
 
 
@@ -50,21 +50,74 @@ class Net:
     # when set, cross-peer gathers compile to rolls (~9x faster on TPU)
     band_off: tuple = struct.field(pytree_node=False, default=None)
     band_rev: tuple = struct.field(pytree_node=False, default=None)
+    # capacity-bounded CSR edge layout (ops/csr.py, round 15): present
+    # only when built with edge_layout="csr" — cross-peer movement then
+    # runs over the flat [E] edge space (E = number of present edges)
+    # instead of the padded [N, K] slot space. The layout selector is
+    # pytree-AUX data, so engines trace exactly ONE layout with zero
+    # runtime branching (same contract as band_off); "dense" builds
+    # trace the pre-CSR program bit for bit.
+    edge_layout: str = struct.field(pytree_node=False, default="dense")
+    csr_col: jax.Array | None = None      # [E] i32 neighbor per edge
+    csr_row: jax.Array | None = None      # [E] i32 owner per edge (sorted)
+    csr_eperm: jax.Array | None = None    # [E] i32 flat involution
+    csr_e2nk: jax.Array | None = None     # [E] i32 pack gather (n*K+k)
+    csr_e_of_nk: jax.Array | None = None  # [N,K] i32 unpack map, -1 absent
 
     def edge_gather(self, x: jax.Array) -> jax.Array:
         """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] (the edge involution).
-        Callers mask with nbr_ok; entries on dead/absent edges are junk."""
+        Callers mask with nbr_ok; entries on dead/absent edges are junk
+        (self-pointing — both layouts reproduce the same values, so
+        dense-vs-CSR parity is bit-exact even on unmasked planes)."""
+        if self.edge_layout == "csr":
+            got = csr.unpack_edges(
+                csr.edge_permute_flat(self.pack_edges(x), self.csr_eperm),
+                self.csr_e_of_nk,
+            )
+            # absent slots: the dense perm self-points (build_edge_perm),
+            # so the junk value is the slot's own entry
+            present = (self.csr_e_of_nk >= 0).reshape(
+                self.csr_e_of_nk.shape + (1,) * (x.ndim - 2))
+            return jnp.where(present, got, x)
         if self.band_off is not None:
             return edges.edge_permute_banded(x, self.band_off, self.band_rev)
         return edges.edge_permute(x, self.edge_perm)
 
     def peer_gather(self, v: jax.Array) -> jax.Array:
         """v[N, ...] -> [N, K, ...] neighbor view v[nbr[j,k]]. Same masking
-        contract as edge_gather."""
+        contract as edge_gather (absent slots read v[0] in both layouts —
+        the dense path's clip(-1, 0))."""
+        if self.edge_layout == "csr":
+            got = csr.unpack_edges(
+                csr.peer_gather_flat(v, self.csr_col), self.csr_e_of_nk,
+            )
+            present = (self.csr_e_of_nk >= 0).reshape(
+                self.csr_e_of_nk.shape + (1,) * (v.ndim - 1))
+            return jnp.where(present, got, v[0])
         if self.band_off is not None:
             return edges.peer_gather_banded(v, self.band_off)
         edges._tally("peer")
         return v[jnp.clip(self.nbr, 0)]
+
+    # -- flat-edge-space face (edge_layout="csr" only) ---------------------
+
+    def pack_edges(self, x: jax.Array) -> jax.Array:
+        """[N, K, ...] -> [E, ...]: the present slots, row-major (a
+        LOCAL relayout — adds nothing to the halo-permute budget)."""
+        return csr.pack_edges(x, self.csr_e2nk, self.max_degree)
+
+    def unpack_edges(self, x_e: jax.Array, fill=None) -> jax.Array:
+        """[E, ...] -> [N, K, ...]; absent slots take ``fill`` (zero)."""
+        return csr.unpack_edges(x_e, self.csr_e_of_nk, fill)
+
+    def edge_gather_flat(self, x_e: jax.Array) -> jax.Array:
+        """The involution on a flat edge plane: out[e] = x_e[eperm[e]]
+        — E-sized cross-peer movement."""
+        return csr.edge_permute_flat(x_e, self.csr_eperm)
+
+    def peer_gather_flat(self, v: jax.Array) -> jax.Array:
+        """Flat neighbor view: out[e] = v[col[e]]."""
+        return csr.peer_gather_flat(v, self.csr_col)
 
     @classmethod
     def build(
@@ -74,6 +127,7 @@ class Net:
         ip_group: np.ndarray | None = None,
         direct: np.ndarray | None = None,
         protocol: np.ndarray | None = None,
+        edge_layout: str = "dense",
     ) -> "Net":
         n = topo.n_peers
         if ip_group is None:
@@ -82,8 +136,28 @@ class Net:
             direct = np.zeros(topo.nbr.shape, bool)
         if protocol is None:
             protocol = np.full((n,), 2, np.int8)  # all /meshsub/1.1.0
-        band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
+        if edge_layout not in ("dense", "csr"):
+            raise ValueError(
+                f"edge_layout must be 'dense' or 'csr', got {edge_layout!r}"
+            )
+        csr_kw: dict = {}
+        if edge_layout == "csr":
+            ct = csr.build_csr(topo.nbr, topo.rev, topo.nbr_ok)
+            csr_kw = dict(
+                csr_col=jnp.asarray(ct.col),
+                csr_row=jnp.asarray(ct.row),
+                csr_eperm=jnp.asarray(ct.eperm),
+                csr_e2nk=jnp.asarray(ct.e2nk),
+                csr_e_of_nk=jnp.asarray(ct.e_of_nk),
+            )
+            # the banded-roll and Pallas fast paths key off band_off;
+            # a CSR build must never fall into them
+            band = None
+        else:
+            band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
         return cls(
+            edge_layout=edge_layout,
+            **csr_kw,
             band_off=band[0] if band else None,
             band_rev=band[1] if band else None,
             nbr=jnp.asarray(topo.nbr),
@@ -104,6 +178,12 @@ class Net:
     @property
     def n_peers(self) -> int:
         return self.nbr.shape[0]
+
+    @property
+    def n_edges(self) -> int | None:
+        """Present (directed) edge count E of a CSR build; None on a
+        dense build (where the exchange is N*K-sized regardless)."""
+        return None if self.csr_col is None else self.csr_col.shape[0]
 
     @property
     def max_degree(self) -> int:

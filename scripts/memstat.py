@@ -1,0 +1,247 @@
+"""Bytes/peer audit over the live state trees (`make mem-audit`).
+
+The round-15 memory-budget satellite (docs/DESIGN.md §15): with PR 9 the
+dispatch overhead is gone, memory is the wall between N=100k and
+"millions of users" — so measure it instead of guessing. For each
+audited engine the script abstractly evaluates (``jax.eval_shape`` — no
+allocation) the full state tree at two reference peer counts, fits each
+leaf's byte cost as ``bytes(N) = const + slope·N`` (every axis is either
+N-proportional or fixed at the audit's K/M/S/H, so two points determine
+the line exactly), and emits:
+
+  * per-leaf rows: path, dtype, bytes/peer (the slope), fixed bytes,
+    and whether the leaf carries the padded edge axis K;
+  * per-engine totals: bytes/peer and projected resident state at
+    N ∈ {100k, 1M, 10M};
+  * the dense-vs-CSR exchange projection: the per-round transmit
+    tensor's dense ``N·K·W`` words against the flat ``E·W = density·N·K·W``
+    CSR form (ops/csr.py) at representative densities — the byte ratio
+    IS the topology density, which is the whole sparse-plane argument;
+  * the narrowing delta: the ``narrow_counters`` (int16) build's
+    bytes/peer against the default, leaf-exact.
+
+Everything is shape arithmetic — deterministic, platform-independent —
+so the committed MEM_AUDIT.json baseline must reproduce byte-identical
+with defaults; MEM_AUDIT_UPDATE=1 rewrites it. The v5e-8 N-scaling
+projection (perf/projection.py project_at_scale) reads the totals'
+``bytes_per_peer`` as its memory term.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+AUDIT_PATH = os.path.join(REPO, "MEM_AUDIT.json")
+
+#: two reference peer counts — any pair works (leaf bytes are affine in
+#: N); these keep eval_shape instant
+N_LO, N_HI = 256, 512
+#: audit array-sizing (the bench geometry: ring d=8 -> K=16, M=64)
+AUDIT_DEGREE_D = 8
+AUDIT_M = 64
+#: projection targets
+TARGETS = (100_000, 1_000_000, 10_000_000)
+#: representative edge densities E/(N·K) for the CSR projection: a full
+#: regular graph, the ~0.6 of a padded random graph, and the long-tail
+#: power-law regime
+DENSITIES = (1.0, 0.6, 0.25)
+
+ENGINES = ("gossipsub", "gossipsub_narrow", "floodsub")
+
+
+def _state_tree(engine: str, n: int):
+    """The engine's state tree as avals (no device allocation)."""
+    import jax
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.state import Net, SimState
+
+    if engine == "floodsub":
+        def build():
+            return SimState.init(n, AUDIT_M, k=2 * AUDIT_DEGREE_D)
+
+        return jax.eval_shape(build)
+
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+    )
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+
+    topo = graph.ring_lattice(n, d=AUDIT_DEGREE_D)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs)
+    _tp, sp = bench_score_params("default", 1)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True,
+        narrow_counters=(engine == "gossipsub_narrow"),
+    )
+
+    def build():
+        return GossipSubState.init(net, AUDIT_M, cfg, score_params=sp)
+
+    return jax.eval_shape(build)
+
+
+def _leaf_rows(engine: str) -> list[dict]:
+    import jax
+    import jax.tree_util as jtu
+
+    def flat(n):
+        tree = _state_tree(engine, n)
+        out = {}
+        for path, leaf in jtu.tree_flatten_with_path(tree)[0]:
+            dt = str(leaf.dtype)
+            if dt.startswith("key<"):
+                # PRNG keys: normalized to 8 bytes/element (threefry's
+                # 2x u32) so the audit is independent of the ambient
+                # jax_default_prng_impl — the same normalization the
+                # STATE_SCHEMA baseline applies to key dtypes
+                dt = "key"
+                nbytes = int(leaf.size) * 8
+            else:
+                nbytes = int(leaf.size) * leaf.dtype.itemsize
+            out[jtu.keystr(path)] = (dt, list(leaf.shape), nbytes)
+        return out
+
+    lo, hi = flat(N_LO), flat(N_HI)
+    assert set(lo) == set(hi), "leaf set changed with N"
+    k_dim = 2 * AUDIT_DEGREE_D
+    rows = []
+    for path in sorted(lo):
+        dt, shape_lo, b_lo = lo[path]
+        _, shape_hi, b_hi = hi[path]
+        slope = (b_hi - b_lo) / (N_HI - N_LO)
+        const = b_lo - slope * N_LO
+        # edge-axis tag: a non-N axis equal to the padded degree K
+        n_axes = [i for i, (a, b) in enumerate(zip(shape_lo, shape_hi))
+                  if a != b]
+        edge_axis = any(
+            d == k_dim and i not in n_axes
+            for i, d in enumerate(shape_lo)
+        )
+        rows.append({
+            "path": path,
+            "dtype": dt,
+            "shape_at_lo": shape_lo,
+            "bytes_per_peer": slope,
+            "const_bytes": const,
+            "edge_axis": bool(edge_axis),
+        })
+    return rows
+
+
+def _engine_block(engine: str) -> dict:
+    rows = _leaf_rows(engine)
+    bpp = sum(r["bytes_per_peer"] for r in rows)
+    const = sum(r["const_bytes"] for r in rows)
+    return {
+        "leaves": rows,
+        "totals": {
+            "bytes_per_peer": bpp,
+            "const_bytes": const,
+            "resident_mb": {
+                str(n): round((const + bpp * n) / 1024 ** 2, 2)
+                for n in TARGETS
+            },
+        },
+    }
+
+
+def _exchange_block() -> dict:
+    """Dense-vs-CSR projection of the per-round transmit exchange (the
+    [N, K, W] word tensor every delivery round moves)."""
+    k = 2 * AUDIT_DEGREE_D
+    w = (AUDIT_M + 31) // 32
+    dense_per_peer = k * w * 4
+    return {
+        "k": k,
+        "msg_slots": AUDIT_M,
+        "dense_bytes_per_peer": dense_per_peer,
+        "csr_bytes_per_peer": {
+            str(d): round(dense_per_peer * d, 2) for d in DENSITIES
+        },
+        "note": (
+            "per-round transmit words; the CSR/dense byte ratio equals "
+            "the topology density E/(N*K) (ops/csr.py) — dead padded "
+            "slots never cross the wire on the csr layout"
+        ),
+    }
+
+
+def build_audit() -> dict:
+    blocks = {e: _engine_block(e) for e in ENGINES}
+    gs = blocks["gossipsub"]["totals"]["bytes_per_peer"]
+    narrow = blocks["gossipsub_narrow"]["totals"]["bytes_per_peer"]
+    return {
+        "schema": 1,
+        "note": ("bytes/peer audit of the live state trees "
+                 "(scripts/memstat.py; MEM_AUDIT_UPDATE=1 rewrites)"),
+        "shape": {"degree_d": AUDIT_DEGREE_D, "k": 2 * AUDIT_DEGREE_D,
+                  "msg_slots": AUDIT_M, "n_lo": N_LO, "n_hi": N_HI},
+        "engines": blocks,
+        "exchange": _exchange_block(),
+        "narrowing": {
+            "gossipsub_bytes_per_peer": gs,
+            "narrow_counters_bytes_per_peer": narrow,
+            "saved_bytes_per_peer": gs - narrow,
+        },
+    }
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    audit = build_audit()
+    update = bool(os.environ.get("MEM_AUDIT_UPDATE"))
+    if update or not os.path.exists(AUDIT_PATH):
+        with open(AUDIT_PATH, "w") as f:
+            json.dump(audit, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"mem-audit: wrote {AUDIT_PATH}")
+    else:
+        with open(AUDIT_PATH) as f:
+            committed = json.load(f)
+        if committed != audit:
+            print("mem-audit: FAIL — live state trees no longer match "
+                  "the committed MEM_AUDIT.json (a state-plane change "
+                  "moved the byte budget; MEM_AUDIT_UPDATE=1 rewrites "
+                  "after review)")
+            return 1
+        print("mem-audit: OK — committed baseline reproduces")
+
+    # human-readable summary: the headroom table + top leaves
+    for eng in ENGINES:
+        tot = audit["engines"][eng]["totals"]
+        print(f"\n[{eng}] {tot['bytes_per_peer']:.1f} bytes/peer; "
+              "resident state:")
+        for n, mb in tot["resident_mb"].items():
+            print(f"  N={int(n):>10,}: {mb:>10.2f} MB")
+    top = sorted(audit["engines"]["gossipsub"]["leaves"],
+                 key=lambda r: -r["bytes_per_peer"])[:8]
+    print("\nheaviest gossipsub leaves (bytes/peer):")
+    for r in top:
+        tag = " [edge-axis]" if r["edge_axis"] else ""
+        print(f"  {r['path']:<40} {r['dtype']:<8} "
+              f"{r['bytes_per_peer']:8.1f}{tag}")
+    ex = audit["exchange"]
+    print(f"\nexchange (per round): dense {ex['dense_bytes_per_peer']} "
+          f"B/peer; csr {ex['csr_bytes_per_peer']} (by density)")
+    nar = audit["narrowing"]
+    print(f"narrow_counters saves {nar['saved_bytes_per_peer']:.1f} "
+          "bytes/peer (int16 IHAVE counters)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,228 @@
+"""`make scale-smoke`: the million-peer window gate (round 15).
+
+Runs an N=1M (SCALE_SMOKE_N), small-K, CPU window of the floodsub data
+plane on the **csr** edge layout (ops/csr.py — the sparse data plane),
+compiled as ONE scanned program (driver.make_window) with the invariant
+oracle folded in (oracle.ScanInvariants), and asserts:
+
+  * ZERO invariant violations across the window's folded checks;
+  * peak process RSS stays under the committed ceiling
+    (SCALE_SMOKE.json ``peak_rss_mb_ceiling``) — the memory wall the
+    sparse plane + byte audit (`make mem-audit`) exist to manage;
+  * the warm window sustains at least the committed rounds/s floor
+    (``rounds_per_sec_floor``).
+
+SCALE_SMOKE_UPDATE=1 rewrites the baseline from this run's measurements
+(ceiling = 1.35x measured RSS, floor = 0.5x measured rate — wide margins:
+this is a scale-feasibility gate, not a perf-regression gate; the
+PERF_SMOKE machinery owns rate regressions at bench shapes).
+
+The report also prints the v5e-8 N-scaling projection at the smoke's N
+(perf.projection.project_at_scale) with the memory term fed from the
+committed MEM_AUDIT.json bytes/peer — the round-15 ask that the
+10k-ticks/s target be priced at 1M peers, not just 100k.
+
+Delivery sanity: round 0 publishes a handful of messages; the window
+must actually propagate them (delivered receipts > 0) so the gate can
+never pass on a dead wire.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(REPO, "SCALE_SMOKE.json")
+MEM_AUDIT_PATH = os.path.join(REPO, "MEM_AUDIT.json")
+
+N = int(os.environ.get("SCALE_SMOKE_N", 1_000_000))
+DEGREE_D = int(os.environ.get("SCALE_SMOKE_D", 4))   # K = 2d = 8
+MSG_SLOTS = int(os.environ.get("SCALE_SMOKE_M", 32))
+ROUNDS = int(os.environ.get("SCALE_SMOKE_ROUNDS", 8))
+CHECK_EVERY = 4
+PUB_WIDTH = 4
+
+#: update-mode margins (see module docstring)
+RSS_MARGIN = 1.35
+RATE_MARGIN = 0.5
+
+
+def peak_rss_mb() -> float:
+    """Linux ru_maxrss is KB."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def run_smoke() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import driver, graph
+    from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+    from go_libp2p_pubsub_tpu.oracle.invariants import ScanInvariants
+    from go_libp2p_pubsub_tpu.state import Net, SimState
+    from go_libp2p_pubsub_tpu.trace.events import EV
+
+    topo = graph.ring_lattice(N, d=DEGREE_D)
+    subs = graph.subscribe_all(N, 1)
+    net = Net.build(topo, subs, edge_layout="csr")
+    k = net.max_degree
+
+    def step(st, po, pt, pv):
+        return floodsub_step(net, st, po, pt, pv)
+
+    from go_libp2p_pubsub_tpu.oracle.invariants import InvariantConfig
+
+    si = ScanInvariants(
+        "floodsub", net, inv=InvariantConfig(check_every=CHECK_EVERY),
+        batched=False, rounds_per_step=1,
+    )
+    win = driver.make_window(step, check=si.check, check_every=CHECK_EVERY)
+    due = si.precompute(ROUNDS)
+
+    rng = np.random.default_rng(0)
+    po = np.full((ROUNDS, PUB_WIDTH), -1, np.int32)
+    po[0] = rng.integers(0, N, size=PUB_WIDTH)
+    pt = np.zeros((ROUNDS, PUB_WIDTH), np.int32)
+    pv = np.ones((ROUNDS, PUB_WIDTH), bool)
+    xs = (jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv))
+
+    def fresh():
+        return SimState.init(N, MSG_SLOTS, k=k)
+
+    # compile + warm (the window donates its state)
+    t0 = time.perf_counter()
+    st, ys = win(fresh(), xs, due)
+    jax.block_until_ready(st.events)
+    cold_s = time.perf_counter() - t0
+    ok_cold = np.asarray(ys["ok"])
+
+    # warm timed rep on a fresh tree
+    st2 = fresh()
+    jax.block_until_ready(st2.events)
+    t0 = time.perf_counter()
+    st2, ys2 = win(st2, xs, due)
+    delivered = int(np.asarray(st2.events)[EV.DELIVER_MESSAGE])
+    warm_s = time.perf_counter() - t0
+    ok_warm = np.asarray(ys2["ok"])
+
+    return {
+        "n_peers": N,
+        "k": k,
+        "msg_slots": MSG_SLOTS,
+        "rounds": ROUNDS,
+        "engine": "floodsub",
+        "edge_layout": "csr",
+        "n_edges": int(net.n_edges),
+        "checks": int(ok_warm.shape[0]),
+        "properties": len(si.names),
+        "violations": int((~ok_cold).sum() + (~ok_warm).sum()),
+        "delivered": delivered,
+        "cold_s": round(cold_s, 2),
+        "warm_rounds_per_sec": round(ROUNDS / warm_s, 3),
+        "peak_rss_mb": round(peak_rss_mb(), 1),
+    }
+
+
+def projection_report() -> dict | None:
+    if not os.path.exists(MEM_AUDIT_PATH):
+        return None
+    from go_libp2p_pubsub_tpu.perf.projection import project_at_scale
+
+    with open(MEM_AUDIT_PATH) as f:
+        audit = json.load(f)
+    bpp = audit["engines"]["gossipsub"]["totals"]["bytes_per_peer"]
+    return project_at_scale(N, bytes_per_peer=bpp).summary()
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    res = run_smoke()
+    print(json.dumps(res, indent=1))
+
+    proj = projection_report()
+    if proj is not None:
+        print("v5e-8 N-scaling projection at the smoke N "
+              "(perf.projection.project_at_scale):")
+        print(json.dumps(proj, indent=1))
+
+    failures = []
+    if res["violations"]:
+        failures.append(
+            f"{res['violations']} invariant violations in the window")
+    if res["delivered"] <= 0:
+        failures.append("window delivered nothing — dead wire")
+
+    update = bool(os.environ.get("SCALE_SMOKE_UPDATE"))
+    if update or not os.path.exists(BASELINE_PATH):
+        if failures:
+            print("scale-smoke: FAIL (refusing to baseline a broken run):")
+            for f in failures:
+                print("  -", f)
+            return 1
+        baseline = {
+            "note": ("scale-smoke baseline (scripts/scale_smoke.py; "
+                     "SCALE_SMOKE_UPDATE=1 rewrites)"),
+            "n_peers": res["n_peers"],
+            "k": res["k"],
+            "msg_slots": res["msg_slots"],
+            "rounds": res["rounds"],
+            "engine": res["engine"],
+            "edge_layout": res["edge_layout"],
+            "peak_rss_mb_ceiling": round(res["peak_rss_mb"] * RSS_MARGIN),
+            "rounds_per_sec_floor": round(
+                res["warm_rounds_per_sec"] * RATE_MARGIN, 3),
+        }
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baseline, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"scale-smoke: wrote {BASELINE_PATH}")
+        return 0
+
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    # the RSS/rate gates only mean anything at the committed SHAPE —
+    # every env-overridable knob the baseline records must match, or a
+    # bigger M/K run would fail with no regression (and a smaller one
+    # would mask a real one)
+    shape_keys = ("n_peers", "k", "msg_slots", "rounds", "engine",
+                  "edge_layout")
+    mismatched = [k for k in shape_keys if res[k] != base.get(k)]
+    if not mismatched:
+        if res["peak_rss_mb"] > base["peak_rss_mb_ceiling"]:
+            failures.append(
+                f"peak RSS {res['peak_rss_mb']} MB exceeds the committed "
+                f"ceiling {base['peak_rss_mb_ceiling']} MB")
+        if res["warm_rounds_per_sec"] < base["rounds_per_sec_floor"]:
+            failures.append(
+                f"warm rate {res['warm_rounds_per_sec']} rounds/s below "
+                f"the committed floor {base['rounds_per_sec_floor']}")
+    else:
+        print("scale-smoke: NOTE — run shape differs from the committed "
+              "baseline on %s (%s); RSS/rate gates skipped (invariant + "
+              "delivery gates still apply)"
+              % (mismatched,
+                 {k: (res[k], base.get(k)) for k in mismatched}))
+
+    if failures:
+        print("scale-smoke: FAIL")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print("scale-smoke: PASS — N=%s csr window under %s MB, "
+          "%s rounds/s, zero violations"
+          % (res["n_peers"], base["peak_rss_mb_ceiling"],
+             res["warm_rounds_per_sec"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

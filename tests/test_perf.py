@@ -25,18 +25,32 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_v2_readers_parse_all_committed_bench_artifacts():
-    """Every in-tree BENCH_r0*.json (v1 driver wrappers, rounds 1-5)
-    must normalize through the v2 reader — the artifact trajectory is
-    the regression gate's ground truth."""
+    """Every in-tree BENCH_r0*.json (v1 driver wrappers rounds 1-5,
+    schema-v3 scanned-window lines from round 15 on) must normalize
+    through the reader — the artifact trajectory is the regression
+    gate's ground truth."""
     paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
     assert len(paths) >= 5, paths
     recs = [artifacts.load_bench_artifact(p) for p in paths]
     for rec in recs:
         assert rec.value > 0
         assert rec.metric.startswith("gossipsub_v1.1_")
-        assert rec.schema in (1, 2)
-        assert rec.n_peers == 100_000
+        assert rec.schema in (1, 2, 3)
         assert rec.config == "default"
+    # rounds 1-5: the 100k headline; round 6+ record their own N in the
+    # fingerprint (r06 is the CPU-container scanned-window artifact)
+    assert all(r.n_peers == 100_000 for r in recs[:5])
+    r06_paths = [p for p, r in zip(paths, recs) if r.round_index == 6]
+    if r06_paths:
+        variants = artifacts.load_bench_variants(r06_paths[0])
+        assert variants["parsed"].scanned is True
+        assert variants["parsed"].edge_layout == "dense"  # the headline
+        # the dense-vs-csr tradeoff is a committed, READABLE pair: the
+        # csr cell must parse with a live value at the same shape
+        csr = variants["parsed_csr"]
+        assert csr.edge_layout == "csr" and csr.value > 0
+        assert csr.n_peers == variants["parsed"].n_peers
+        assert csr.rounds_per_phase == variants["parsed"].rounds_per_phase
     # the metric-name fallbacks recover cadence for v1 lines
     assert [r.rounds_per_phase for r in recs[:5]] == [1, 1, 1, 8, 8]
     # trajectory ordering by driver round
