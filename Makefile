@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -133,17 +133,42 @@ mem-audit:
 scale-smoke:
 	python scripts/scale_smoke.py
 
+# liftability audit (scripts/lift_audit.py; docs/DESIGN.md §16): the
+# interprocedural SHAPE/VALUE dataflow pass over every *Config /
+# score-parameter read in the device scope — proves which knobs may
+# ride the traced ScoreParams plane; the committed LIFT_AUDIT.json
+# must reproduce byte-identical (LIFT_UPDATE=1 rewrites). Pure AST,
+# <1 s.
+lift-audit:
+	python scripts/lift_audit.py
+
+# compiled-program contract audit (scripts/hlo_audit.py; docs/
+# DESIGN.md §16): the StableHLO of every engine×layout build — zero
+# host-transfer ops, donation-marker coverage, per-category op census
+# with the dense==csr / lifted==static halo-tally equalities and the
+# ragged gather>=tally bound, the one-scan window contract, and the
+# recompile-cause attributor legs. Trace-only (no compiles beyond the
+# shared guard shapes). ~30 s warm.
+hlo-audit:
+	python scripts/hlo_audit.py
+
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
 # discipline, packed-word dtype hygiene, import-time execution, static-
 # config hashability, EV-counter completeness; exceptions in
-# analysis/ALLOWLIST) — plus the trace-time guard harness: all four
-# engines re-traced under strict dtype promotion + transfer guard +
-# jax_enable_checks, exactly one compile per multi-round run, buffer
-# donation audited, and every state leaf pinned against the committed
-# STATE_SCHEMA.json (ANALYZE_UPDATE=1 rewrites). CPU-only by contract.
+# analysis/ALLOWLIST) — plus the trace-time guard harness: the four
+# committed engines AND the derived rows (ensemble, telemetry, csr,
+# phase+csr, lifted-score — the last one's alternating-plane run IS
+# the recompile-free A/B sentinel) re-traced under strict dtype
+# promotion + transfer guard + jax_enable_checks, exactly one compile
+# per multi-round run, buffer donation audited, and every state leaf
+# pinned against the committed STATE_SCHEMA.json (ANALYZE_UPDATE=1
+# rewrites). CPU-only by contract. Since round 16 the target also
+# runs the lift-audit and hlo-audit legs above.
 analyze:
 	python scripts/analyze.py
+	python scripts/lift_audit.py
+	python scripts/hlo_audit.py
 
 # declarative (config x N x r) sweep — e.g. the eth2 shard table:
 #   make sweep SWEEP_ARGS='--config eth2 --n 12500,25000,50000 --r 16'
@@ -168,6 +193,8 @@ quick:
 	python scripts/attack_report.py --smoke
 	python scripts/scan_smoke.py --smoke
 	python scripts/analyze.py
+	python scripts/lift_audit.py
+	python scripts/hlo_audit.py
 	python scripts/memstat.py
 	python scripts/scale_smoke.py
 

@@ -105,6 +105,25 @@ _TELEM_PREFIX = ".core.telem"
 CSR_ENGINE = "csr"
 CSR_BASE = "gossipsub"
 
+#: the combined phase+CSR path (round 16): the multi-round phase
+#: engine built on the flat-[E] edge layout — a cell with real bugs to
+#: catch (the stacked wire head AND every sub-round exchange route
+#: through the CSR seams) that previously had no guard coverage. Its
+#: schema must EQUAL the committed ``gossipsub_phase`` rows exactly
+#: (the layout lives in the Net, never the state).
+PHASE_CSR_ENGINE = "phase_csr"
+PHASE_CSR_BASE = "gossipsub_phase"
+
+#: the lifted-score path (round 16, docs/DESIGN.md §16): the gossipsub
+#: bench step built with ``lift_scores=True`` — the traced ScoreParams
+#: plane rides as a trailing argument. Its schema must EQUAL the
+#: committed ``gossipsub`` rows (the plane is an INPUT, never state),
+#: and its GUARD_ROUNDS run ALTERNATES two distinct weight/threshold
+#: sets, so the one-compile cache sentinel IS the recompile-free A/B
+#: sentinel the lift exists for.
+LIFTED_ENGINE = "lifted"
+LIFTED_BASE = "gossipsub"
+
 #: StableHLO markers proving the state argument is donated
 _DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
@@ -236,29 +255,110 @@ def build_csr_harness() -> EngineHarness:
     )
 
 
-def check_schema_csr(h: EngineHarness, out_tree,
-                     base_rows: list | None) -> list:
-    """Schema guard for the CSR engine: weak-type audit, then the rows
-    must equal the base engine's EXACTLY — the sparse layout is a
-    Net-side structure and must never add, drop, or retype a state
-    leaf (the checkpoint-v6 no-version-bump contract)."""
+def build_phase_csr_harness() -> EngineHarness:
+    """The combined phase+CSR path (round 16): the r=GUARD_R phase
+    engine on the flat-[E] edge layout — the stacked coalesced wire
+    head and every data sub-round exchange route through the CSR
+    seams under the full guard set (a cell no row covered before)."""
+    from ..perf.sweep import build_bench
+
+    st, step, _, _ = build_bench(
+        GUARD_N, GUARD_M, heartbeat_every=GUARD_R,
+        rounds_per_phase=GUARD_R, edge_layout="csr",
+    )
+    return EngineHarness(
+        PHASE_CSR_ENGINE, step, st,
+        lambda i: _pub_args((GUARD_R, PUB_WIDTH), i),
+        {"do_heartbeat": True},
+    )
+
+
+def lifted_plane_pair():
+    """Two DISTINCT weight/threshold planes for the A/B sentinel:
+    plane A is the bench default parameterization; plane B moves every
+    lifted surface — per-topic weights/decays/caps, the P7 scalars,
+    the topic score cap, and all five v1.1 thresholds."""
+    import dataclasses as _dc
+
+    from ..config import PeerScoreThresholds
+    from ..perf.sweep import bench_score_params
+    from ..score.params import ScoreParams
+
+    tp_a, sp_a = bench_score_params("default", 1)
+    plane_a = ScoreParams.build(sp_a, PeerScoreThresholds(), 1)
+    tp_b = _dc.replace(
+        tp_a,
+        first_message_deliveries_weight=2.0,
+        mesh_message_deliveries_weight=-0.25,
+        mesh_message_deliveries_threshold=4.0,
+        invalid_message_deliveries_weight=-0.5,
+        time_in_mesh_weight=0.5,
+    )
+    sp_b = _dc.replace(
+        sp_a, topics={0: tp_b}, behaviour_penalty_weight=-2.0,
+        behaviour_penalty_threshold=0.5, topic_score_cap=50.0,
+    )
+    thr_b = PeerScoreThresholds(
+        gossip_threshold=-4.0, publish_threshold=-20.0,
+        graylist_threshold=-40.0, accept_px_threshold=5.0,
+        opportunistic_graft_threshold=10.0,
+    )
+    return plane_a, ScoreParams.build(sp_b, thr_b, 1)
+
+
+def build_lifted_harness() -> EngineHarness:
+    """The lifted-score path (round 16): the gossipsub bench step with
+    ``lift_scores=True``, driven with ALTERNATING weight planes — so
+    ``run_rounds_guarded``'s one-compile cache sentinel doubles as the
+    recompile-free A/B sentinel (two distinct score-weight sets, one
+    XLA program), executed under ``transfer_guard('disallow')``."""
+    from ..perf.sweep import build_bench
+
+    st, step, _, _ = build_bench(
+        GUARD_N, GUARD_M, heartbeat_every=1, rounds_per_phase=1,
+        lift_scores=True,
+    )
+    plane_a, plane_b = lifted_plane_pair()
+
+    def make_args(i):
+        return _pub_args((PUB_WIDTH,), i) + (
+            plane_a if i % 2 == 0 else plane_b,)
+
+    return EngineHarness(LIFTED_ENGINE, step, st, make_args, {})
+
+
+def check_schema_equal(h: EngineHarness, out_tree, base_rows: list | None,
+                       base_name: str, why: str) -> list:
+    """Schema guard for derived rows whose state tree must EQUAL a base
+    engine's exactly (csr / phase_csr: the layout lives in the Net;
+    lifted: the plane is an argument, never state): weak-type audit,
+    then the exact-equality diff against the base rows."""
     rows = schema_of(out_tree)
     weak = [r["path"] for r in rows if r["weak_type"]]
     if weak:
         raise GuardViolation(
             h.name, "schema",
-            f"weak-typed state leaves {weak[:4]} in the csr step",
+            f"weak-typed state leaves {weak[:4]} in the {h.name} step",
         )
     if base_rows is not None:
         mism = diff_schema(h.name, rows, base_rows)
         if mism:
             raise GuardViolation(
                 h.name, "schema",
-                f"{len(mism)} state-leaf drift(s) vs the {CSR_BASE!r} "
-                "baseline — the csr layout leaked into the state tree: "
-                + "; ".join(mism[:5]),
+                f"{len(mism)} state-leaf drift(s) vs the {base_name!r} "
+                f"baseline — {why}: " + "; ".join(mism[:5]),
             )
     return rows
+
+
+def check_schema_csr(h: EngineHarness, out_tree,
+                     base_rows: list | None) -> list:
+    """Schema guard for the CSR engine (exact equality with the base —
+    the checkpoint-v6 no-version-bump contract)."""
+    return check_schema_equal(
+        h, out_tree, base_rows, CSR_BASE,
+        "the csr layout leaked into the state tree",
+    )
 
 
 def build_telemetry_harness() -> EngineHarness:
@@ -611,6 +711,38 @@ def run_csr_engine(base_rows: list | None) -> list:
     return rows
 
 
+def run_phase_csr_engine(base_rows: list | None) -> list:
+    """All guards for the combined phase+CSR row (round 16): schema
+    must equal the committed ``gossipsub_phase`` rows exactly."""
+    h = build_phase_csr_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_equal(
+        h, out_tree, base_rows, PHASE_CSR_BASE,
+        "the csr layout leaked into the phase state tree",
+    )
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
+def run_lifted_engine(base_rows: list | None) -> list:
+    """All guards for the lifted-score row (round 16): schema must
+    equal the committed ``gossipsub`` rows exactly (the plane is an
+    argument, never state), donation must survive the extra traced
+    input, and the GUARD_ROUNDS run alternates TWO weight planes under
+    transfer_guard — its one-compile sentinel IS the recompile-free
+    A/B acceptance invariant."""
+    h = build_lifted_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_equal(
+        h, out_tree, base_rows, LIFTED_BASE,
+        "the lifted score plane leaked into the state tree",
+    )
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
 def run_telemetry_engine(base_rows: list | None) -> list:
     """All guards for the telemetry-on path: strict-dtype trace, the
     telem-leaf pin + base-row comparison, buffer-donation audit, and
@@ -626,10 +758,44 @@ def run_telemetry_engine(base_rows: list | None) -> list:
     return rows
 
 
+@dataclasses.dataclass(frozen=True)
+class GuardRow:
+    """One declarative harness row (round-16 dedup of the per-engine
+    copy-paste): ``runner`` is the module-level ``run_*`` callable
+    name; ``base`` names the COMMITTED engine (one of ``ENGINES``)
+    whose schema rows the derived row validates against — every
+    derived row anchors to a committed baseline, never a second
+    committed copy. Adding an engine variant — the lifted-score row, a
+    future v1.2 router — is one line here plus its builder/runner
+    pair (a variant needing its own committed rows goes in ``ENGINES``
+    instead)."""
+
+    name: str
+    runner: str
+    base: str
+
+
+#: every derived row `make analyze` runs after the four committed
+#: engines; each validates against its base engine's rows (committed
+#: normally, this run's fresh ones on ANALYZE_UPDATE — a deliberate
+#: state change updates ONE baseline and every derived row follows)
+DERIVED_ROWS = (
+    GuardRow(ENSEMBLE_ENGINE, "run_ensemble_engine", ENSEMBLE_BASE),
+    GuardRow(TELEMETRY_ENGINE, "run_telemetry_engine", TELEMETRY_BASE),
+    GuardRow(CSR_ENGINE, "run_csr_engine", CSR_BASE),
+    GuardRow(PHASE_CSR_ENGINE, "run_phase_csr_engine", PHASE_CSR_BASE),
+    GuardRow(LIFTED_ENGINE, "run_lifted_engine", LIFTED_BASE),
+)
+
+#: all row names, for reporting (scripts/analyze.py)
+ALL_ROWS = tuple(ENGINES) + tuple(r.name for r in DERIVED_ROWS)
+
+
 def run(update: bool | None = None, root: str | None = None) -> list:
-    """The full harness over every engine. Returns a list of failure
-    strings (empty = pass). ``update`` (default: env ANALYZE_UPDATE)
-    rewrites the schema baseline from this run instead of comparing."""
+    """The full harness over every row of the registry. Returns a list
+    of failure strings (empty = pass). ``update`` (default: env
+    ANALYZE_UPDATE) rewrites the schema baseline from this run instead
+    of comparing."""
     if update is None:
         update = bool(os.environ.get("ANALYZE_UPDATE"))
     baseline = None if update else load_baseline(root)
@@ -648,54 +814,32 @@ def run(update: bool | None = None, root: str | None = None) -> list:
         except Exception as e:  # noqa: BLE001 — any crash is a finding
             failures.append(f"[{name}] harness crashed: "
                             f"{type(e).__name__}: {str(e)[:300]}")
-    # the batched path validates against the BASE engine's rows — the
-    # committed ones normally, this run's fresh ones on update (so a
-    # deliberate state change updates ONE baseline and the ensemble
-    # check follows it automatically)
-    if update:
-        base_rows = schemas.get(ENSEMBLE_BASE)
-    else:
-        base_rows = ((baseline or {}).get("engines", {})
-                     .get(ENSEMBLE_BASE) or {}).get("leaves")
-    if base_rows is None:
-        # a hard failure, like check_schema's missing-baseline case —
-        # otherwise per-sim leaf drift in the batched path would pass
-        # silently whenever the gossipsub rows are absent (truncated
-        # baseline, or its harness crashed on an update run)
-        failures.append(
-            f"[{ENSEMBLE_ENGINE}] no {ENSEMBLE_BASE!r} schema rows to "
-            "validate the batched path against (committed baseline "
-            "missing the engine, or its harness failed on this update "
-            "run)"
-        )
-    else:
+
+    def base_rows_of(base: str):
+        if update:
+            return schemas.get(base)
+        return ((baseline or {}).get("engines", {})
+                .get(base) or {}).get("leaves")
+
+    for row in DERIVED_ROWS:
+        base_rows = base_rows_of(row.base)
+        if base_rows is None:
+            # a hard failure, like check_schema's missing-baseline case
+            # — otherwise leaf drift in a derived row would pass
+            # silently whenever its base rows are absent (truncated
+            # baseline, or the base harness crashed on an update run)
+            failures.append(
+                f"[{row.name}] no {row.base!r} schema rows to validate "
+                "against (committed baseline missing the engine, or its "
+                "harness failed on this update run)"
+            )
+            continue
         try:
-            run_ensemble_engine(base_rows)
+            globals()[row.runner](base_rows)
         except GuardViolation as e:
             failures.append(str(e))
         except Exception as e:  # noqa: BLE001 — any crash is a finding
-            failures.append(f"[{ENSEMBLE_ENGINE}] harness crashed: "
-                            f"{type(e).__name__}: {str(e)[:300]}")
-    # the telemetry-on path validates against the same base rows (the
-    # telem leaves are pinned internally, everything else must be the
-    # base engine's tree exactly — never a second committed baseline)
-    if base_rows is not None:
-        try:
-            run_telemetry_engine(base_rows)
-        except GuardViolation as e:
-            failures.append(str(e))
-        except Exception as e:  # noqa: BLE001 — any crash is a finding
-            failures.append(f"[{TELEMETRY_ENGINE}] harness crashed: "
-                            f"{type(e).__name__}: {str(e)[:300]}")
-    # the sparse-plane path validates against the same base rows too
-    # (exact equality — the CSR layout is Net-side only; round 15)
-    if base_rows is not None:
-        try:
-            run_csr_engine(base_rows)
-        except GuardViolation as e:
-            failures.append(str(e))
-        except Exception as e:  # noqa: BLE001 — any crash is a finding
-            failures.append(f"[{CSR_ENGINE}] harness crashed: "
+            failures.append(f"[{row.name}] harness crashed: "
                             f"{type(e).__name__}: {str(e)[:300]}")
     if update and not failures:
         write_baseline(schemas, root)

@@ -1,0 +1,340 @@
+"""hloaudit — compiled-program contract auditor (docs/DESIGN.md §16).
+
+simlint proves source-level invariants, guards proves trace-level ones;
+this half audits the LOWERED program text (StableHLO) of every
+engine×layout build, so the contracts the repo states in prose — "zero
+host transfers in the run window", "state buffers donate", "the layout
+never changes the halo budget", "floodsub draws no randomness" — are
+machine-checked against what the compiler actually received:
+
+  host-transfer   the lowered step contains NO host-boundary ops
+                  (infeed/outfeed/send/recv, host callbacks,
+                  python-callback custom_calls). The transfer_guard
+                  leg in guards catches *dispatch-time* transfers;
+                  this catches transfers baked into the program.
+  donation        donation-marker COVERAGE: the fraction of program
+                  parameters carrying ``tf.aliasing_output`` /
+                  ``jax.buffer_donor`` attributes must clear the
+                  per-build floor — a refactor that silently drops
+                  half the state tree from donation passes guards'
+                  any-marker check but fails here.
+  census          op census by category — halo/gather family,
+                  reductions, RNG, control flow — recorded per build,
+                  with two hard legs: (a) the trace-time halo-gather
+                  tally (ops/edges.tally_halo_gathers — the seams the
+                  sharded lowering turns into collective permutes)
+                  must be EQUAL between the dense and CSR layouts of
+                  the same engine (the sparse plane must not change
+                  the halo budget, docs/DESIGN.md §15), and (b) on a
+                  RAGGED topology (where the gather seams lower to
+                  real gather ops, not banded rolls) the program's
+                  gather-family count must be >= the tally — no
+                  cross-peer movement can bypass the tally seam.
+  rng             engines that consume the PRNG (gossipsub heartbeat
+                  shuffle, randomsub fanout draw) must contain RNG ops
+                  under the gate PRNG (unsafe_rbg lowers to
+                  rng_bit_generator); floodsub — which the reference
+                  defines with no randomness — must contain ZERO.
+  scan            a make_window program must carry its dispatch loop
+                  as a single ``stablehlo.while`` (the one-dispatch
+                  contract); plain per-round steps carry none (the
+                  conditional-free trace the static-heartbeat design
+                  promises; engine-internal lax.conds are whiles too,
+                  so this leg pins the count recorded at audit time).
+
+Plus the **recompile-cause attributor**: :func:`static_fingerprint`
+flattens a build's static surface (config fields, topology meta,
+platform) and :func:`attribute_recompile` diffs two of them, naming
+exactly which static changed — the first tool to reach for when a
+sweep recompiles. Under the round-16 score lift the attributor also
+knows which fields are traced (``lifted=True`` drops the
+LIFT_AUDIT-proved score fields from the static surface), so it can
+certify that an A/B pair differing only in lifted fields shares one
+program.
+
+Entry: ``scripts/hlo_audit.py`` / ``make hlo-audit`` (wired into
+``make analyze``); negative tests in tests/test_hloaudit.py doctor the
+HLO text and assert each contract trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+#: StableHLO ops that cross the host boundary — none may appear in a
+#: run-window program
+HOST_TRANSFER_OPS = (
+    "stablehlo.infeed", "stablehlo.outfeed",
+    "stablehlo.send", "stablehlo.recv",
+)
+
+#: custom_call targets that mean a host round-trip
+HOST_CALLBACK_MARKERS = ("callback", "xla_python", "host_compute")
+
+#: donation markers jax lowers for donated parameters
+DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+
+#: op -> census category
+CENSUS_CATEGORIES = {
+    "gather": "gather_family",
+    "dynamic_gather": "gather_family",
+    "scatter": "scatter",
+    "dynamic_slice": "slice_family",
+    "dynamic_update_slice": "slice_family",
+    "reduce": "reduction",
+    "reduce_window": "reduction",
+    "dot_general": "reduction",
+    "rng_bit_generator": "rng",
+    "rng": "rng",
+    "while": "control_flow",
+    "case": "control_flow",
+    "if": "control_flow",
+    "sort": "sort",
+    "custom_call": "custom_call",
+}
+
+_OP_RE = re.compile(r"\bstablehlo\.([a-z_]+)")
+_PARAM_RE = re.compile(r"%arg\d+")
+
+
+class HloContractViolation(Exception):
+    """One failed compiled-program contract; .build and .contract say
+    which."""
+
+    def __init__(self, build: str, contract: str, msg: str):
+        super().__init__(f"[{build}] {contract}: {msg}")
+        self.build = build
+        self.contract = contract
+
+
+# ---------------------------------------------------------------------------
+# text-level contracts (unit-testable on doctored HLO)
+
+
+def hlo_census(text: str) -> dict:
+    """Op counts by category over a StableHLO module text."""
+    out: dict = {}
+    for m in _OP_RE.finditer(text):
+        op = m.group(1)
+        cat = CENSUS_CATEGORIES.get(op)
+        out[op] = out.get(op, 0) + 1
+        if cat:
+            out.setdefault(f"cat:{cat}", 0)
+            out[f"cat:{cat}"] += 1
+    return out
+
+
+def check_no_host_transfer(build: str, text: str) -> None:
+    """The program must contain no host-boundary ops or callback
+    custom_calls — host transfers baked into the trace would serialize
+    the run window no matter what transfer_guard says at dispatch."""
+    for op in HOST_TRANSFER_OPS:
+        if op in text:
+            raise HloContractViolation(
+                build, "host-transfer",
+                f"lowered program contains {op} — a host boundary inside "
+                "the run window",
+            )
+    for m in re.finditer(r'custom_call[^\n]*call_target_name\s*=\s*"([^"]+)"',
+                         text):
+        target = m.group(1)
+        if any(k in target for k in HOST_CALLBACK_MARKERS):
+            raise HloContractViolation(
+                build, "host-transfer",
+                f"custom_call target {target!r} is a host callback",
+            )
+
+
+def donation_coverage(text: str) -> tuple:
+    """(n_donated_params, n_params) from the module's entry function
+    signature — donation attributes annotate input parameters."""
+    header = text.split("{", 1)[0]
+    # count params of the main function signature; donation attrs ride
+    # the whole module text (jax emits one attr per donated input)
+    m = re.search(r"func\.func\s+(?:public\s+)?@main\((.*?)\)\s*->",
+                  text, re.S)
+    sig = m.group(1) if m else header
+    n_params = len(_PARAM_RE.findall(sig)) or sig.count("tensor")
+    n_donated = sum(text.count(marker) for marker in DONATION_MARKERS)
+    return n_donated, max(n_params, 1)
+
+
+def check_donation_coverage(build: str, text: str,
+                            min_ratio: float) -> float:
+    """Donated-parameter coverage must clear the per-build floor."""
+    n_donated, n_params = donation_coverage(text)
+    ratio = n_donated / n_params
+    if ratio < min_ratio:
+        raise HloContractViolation(
+            build, "donation",
+            f"only {n_donated}/{n_params} program parameters carry "
+            f"donation markers ({ratio:.2f} < floor {min_ratio}) — part "
+            "of the state tree stopped donating (doubled resident HBM "
+            "at the 100k-peer shapes)",
+        )
+    return ratio
+
+
+def check_rng(build: str, text: str, expect_rng: bool) -> None:
+    """RNG presence contract (audited under the gate PRNG, unsafe_rbg:
+    sampling lowers to rng_bit_generator ops)."""
+    n = hlo_census(text).get("cat:rng", 0)
+    if expect_rng and n == 0:
+        raise HloContractViolation(
+            build, "rng",
+            "no RNG ops in a program that must draw randomness (is the "
+            "audit running under the gate PRNG?)",
+        )
+    if not expect_rng and n > 0:
+        raise HloContractViolation(
+            build, "rng",
+            f"{n} RNG op(s) in a program the reference defines with no "
+            "randomness — a sampler leaked into the engine",
+        )
+
+
+def check_gather_bound(build: str, text: str, n_tally: int) -> None:
+    """On a ragged topology every cross-peer gather seam lowers to a
+    real gather op, so the program's gather-family census bounds the
+    tally from above — a cross-peer movement path that bypasses the
+    tally seam (and therefore the sharded halo accounting) fails
+    here."""
+    n_hlo = hlo_census(text).get("cat:gather_family", 0)
+    if n_hlo < n_tally:
+        raise HloContractViolation(
+            build, "census",
+            f"gather-family op count {n_hlo} < trace-time halo tally "
+            f"{n_tally} — cross-peer movement is happening outside the "
+            "ops/edges tally seams",
+        )
+
+
+def check_while_count(build: str, text: str, expect_min: int,
+                      expect_max: int | None = None) -> int:
+    """Control-flow contract: a scanned window must carry >= 1 while
+    loop (its dispatch scan); the count is also pinned against the
+    recorded expectation."""
+    n = hlo_census(text).get("while", 0)
+    if n < expect_min or (expect_max is not None and n > expect_max):
+        bound = (f"[{expect_min}, {expect_max}]" if expect_max is not None
+                 else f">= {expect_min}")
+        raise HloContractViolation(
+            build, "scan",
+            f"{n} stablehlo.while op(s); expected {bound} — the "
+            "dispatch structure changed (window no longer one scan, or "
+            "a lax.cond/scan appeared in a plain step)",
+        )
+    return n
+
+
+# ---------------------------------------------------------------------------
+# recompile-cause attribution
+
+
+def _static_repr(obj) -> str:
+    if callable(obj):
+        # callables repr with an object address — nondeterministic
+        # across processes; the NAME is the static identity
+        return f"<callable {getattr(obj, '__qualname__', repr(obj))}>"
+    return repr(obj)
+
+
+def _flatten(prefix: str, obj, out: dict) -> None:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            _flatten(f"{prefix}{f.name}.", getattr(obj, f.name), out)
+        return
+    if isinstance(obj, dict):
+        for k in sorted(obj, key=repr):
+            _flatten(f"{prefix}{k}.", obj[k], out)
+        return
+    out[prefix[:-1] if prefix.endswith(".") else prefix] = _static_repr(obj)
+
+
+def static_fingerprint(cfg, net=None, score_params=None,
+                       lifted: bool = False, **extra) -> dict:
+    """The static surface of one build: every config field (nested
+    dataclasses and the per-topic params dict flattened), the baked
+    ``score_params`` struct when the caller passes it (the engines
+    close over it — a weight change IS a recompile cause on the static
+    path), topology meta, and any extra statics. With ``lifted=True``
+    the LIFT_AUDIT-proved score fields are EXCLUDED — they ride the
+    traced plane and cannot cause a recompile."""
+    out: dict = {}
+    _flatten("", cfg, out)
+    if score_params is not None:
+        _flatten("score_params.", score_params, out)
+    if lifted:
+        from ..score.params import PEER_SCALAR_FIELDS, THRESHOLD_FIELDS
+
+        for f in THRESHOLD_FIELDS:
+            out.pop(f, None)
+        for k in list(out):
+            # the whole per-topic table and the proven scalars ride the
+            # traced plane (the `scored` mask covers topic membership)
+            if k.startswith("score_params.topics."):
+                out.pop(k)
+        for f in PEER_SCALAR_FIELDS:
+            out.pop(f"score_params.{f}", None)
+    if net is not None:
+        out["net.n_peers"] = repr(int(net.n_peers))
+        out["net.max_degree"] = repr(int(net.max_degree))
+        out["net.edge_layout"] = repr(net.edge_layout)
+        out["net.banded"] = repr(net.band_off is not None)
+    for k, v in extra.items():
+        out[k] = _static_repr(v)
+    return out
+
+
+def attribute_recompile(fp_a: dict, fp_b: dict) -> list:
+    """Name the statics that differ between two build fingerprints —
+    the cause list for "why did this sweep recompile". Empty means the
+    two builds share a program (same static surface)."""
+    out = []
+    for k in sorted(set(fp_a) | set(fp_b)):
+        a, b = fp_a.get(k), fp_b.get(k)
+        if a != b:
+            out.append(f"{k}: {a} -> {b}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# build harnesses (lowered-text producers; shapes shared with guards
+# so `make analyze` and `make hlo-audit` reuse one compile cache)
+
+
+def lowered_text(h) -> str:
+    """StableHLO text of a guards EngineHarness's step (trace only — no
+    compile)."""
+    from . import guards
+
+    return guards._lower(h).as_text()
+
+
+def tally_gathers(h) -> dict:
+    """Trace-time halo-gather tally for one harness call, by kind.
+
+    Traces the UNJITTED step body (``__wrapped__``): jax's tracing
+    cache is keyed on the jitted function, so evaluating the jit could
+    hit a cached jaxpr from an earlier trace and silently record ZERO
+    gathers — the raw body re-traces every time, so the seams always
+    fire."""
+    import jax
+
+    from ..ops import edges
+
+    kw = dict(h.static_kwargs)
+    net = kw.pop("net", None)
+    raw = getattr(h.jit_fn, "__wrapped__", h.jit_fn)
+    args = h.make_args(0)
+    tally: list = []
+    with edges.tally_halo_gathers(tally):
+        if net is not None:
+            jax.eval_shape(lambda s: raw(net, s, *args, **kw), h.state)
+        else:
+            jax.eval_shape(lambda s: raw(s, *args, **kw), h.state)
+    out = {"total": len(tally)}
+    for kind in tally:
+        out[kind] = out.get(kind, 0) + 1
+    return out

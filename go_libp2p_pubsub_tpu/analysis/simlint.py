@@ -99,6 +99,8 @@ import dataclasses
 import os
 import re
 
+from .lift import name_copy_closure, single_assign_exprs
+
 #: device-scope prefixes (package-relative): the code that runs inside
 #: jitted steps or builds their constants
 DEVICE_SCOPE = ("models/", "ops/", "score/", "chaos/", "state.py")
@@ -226,24 +228,79 @@ def _traced_functions(tree: ast.Module):
 # per-file rules
 
 
+#: attribute reads whose result is a trace-time Python value even on a
+#: traced array — an expression rooted in one is host-level
+_HOST_ATTRS = frozenset({"shape", "dtype", "ndim", "size"})
+
+
+def _expr_has_jnp_call(expr: ast.AST) -> bool:
+    """True when the expression's VALUE is device-traced: it contains a
+    jnp-rooted call that is not under a .shape/.dtype/.ndim/.size read
+    (those yield trace-time Python values — `jnp.asarray(x).shape[-1]`
+    is host arithmetic, the same calibration the alias closure
+    applies)."""
+    stack = [expr]
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.Attribute) and sub.attr in _HOST_ATTRS:
+            continue
+        if isinstance(sub, ast.Call) and _call_root(sub.func).startswith(
+                _JNP_ROOTS):
+            return True
+        stack.extend(ast.iter_child_nodes(sub))
+    return False
+
+
+def _traced_alias_names(fn: ast.AST) -> set:
+    """Single-assignment locals whose value is a jnp-rooted expression
+    — the round-16 alias-blindness fix (shared resolver:
+    analysis/lift.py). ``w = jnp.any(x)`` makes ``w`` traced; a bare
+    Name copy (``v = w``) propagates it. Derived host values
+    (``n = x.shape[-1]``, ``flag = x is None``) deliberately do NOT:
+    shape reads and identity tests of a traced array are trace-time
+    Python values, the same calibration the host-sync rule applies."""
+    aliases = single_assign_exprs(fn)
+    seed = {n for n, e in aliases.items() if _expr_has_jnp_call(e)}
+    return name_copy_closure(aliases, seed)
+
+
 def _rule_traced_branch(rel, tree, out):
     if not _in_device_scope(rel):
         return
     for qual, fn in _iter_functions(tree):
+        traced_names = _traced_alias_names(fn)
         for node in _walk_shallow(fn):
             if not isinstance(node, (ast.If, ast.While, ast.Assert)):
                 continue
-            for sub in ast.walk(node.test):
+            hit = None
+            stack = [node.test]
+            while stack and hit is None:
+                sub = stack.pop()
+                # identity tests (`x is None`) are host-level even on
+                # a traced name — don't descend
+                if isinstance(sub, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in sub.ops):
+                    continue
                 if isinstance(sub, ast.Call):
                     root = _call_root(sub.func)
                     if root.startswith(_JNP_ROOTS):
-                        out.append(Violation(
-                            "traced-branch", rel, node.lineno, qual,
-                            f"Python {type(node).__name__.lower()} on a "
-                            f"device expression: {ast.unparse(node.test)[:80]}"
-                            " — use jnp.where/lax.cond or hoist to host",
-                        ))
+                        hit = "device expression"
                         break
+                # alias blindness fix: a test on a NAME that was
+                # assigned from a jnp-rooted expression is the same
+                # traced branch wearing a local alias
+                if isinstance(sub, ast.Name) and sub.id in traced_names:
+                    hit = f"device value (via local alias {sub.id!r})"
+                    break
+                stack.extend(ast.iter_child_nodes(sub))
+            if hit:
+                out.append(Violation(
+                    "traced-branch", rel, node.lineno, qual,
+                    f"Python {type(node).__name__.lower()} on a "
+                    f"{hit}: {ast.unparse(node.test)[:80]}"
+                    " — use jnp.where/lax.cond or hoist to host",
+                ))
 
 
 def _rule_host_sync(rel, tree, out):
@@ -282,6 +339,13 @@ def _rule_host_sync(rel, tree, out):
                         for t in ast.walk(tgt):
                             if isinstance(t, ast.Name):
                                 jnp_locals.add(t.id)
+        # alias-blindness fix (round 16, shared closure lift.py):
+        # a single-assignment bare-Name alias OF a traced local is
+        # traced too — ``y = jnp.sum(v); w = y; float(w)`` was
+        # previously missed (derived expressions keep their own
+        # host/device status, same calibration as traced-branch)
+        jnp_locals = name_copy_closure(single_assign_exprs(fn),
+                                       jnp_locals)
         traced_names = params | jnp_locals
         for node in _walk_shallow(fn):
             if isinstance(node, ast.Call):
@@ -470,9 +534,43 @@ _UNHASHABLE_ANN = re.compile(
 )
 
 
+def _decorator_alias_map(tree: ast.Module) -> dict:
+    """Module-level aliases of the dataclass decorators — the
+    config-hash alias-blindness fix (round 16): ``from dataclasses
+    import dataclass as dc``, ``from flax import struct as fs`` and
+    ``dc = dataclasses.dataclass(frozen=True)`` style bindings
+    previously made a ``*Config`` class invisible to the rule
+    (silently skipped, never audited). Values are
+    ``(resolved_source, frozen_hint)`` — a partial-call alias carries
+    its ``frozen=True`` keyword along."""
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.asname and (
+                        "dataclass" in alias.name or "struct" in alias.name
+                        or node.module in ("dataclasses", "flax",
+                                           "flax.struct")):
+                    out[alias.asname] = (f"{node.module}.{alias.name}",
+                                         None)
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            src = _call_root(node.value)
+            if "dataclass" in src or "struct" in src:
+                frozen_hint = None
+                if isinstance(node.value, ast.Call):
+                    for kw in node.value.keywords:
+                        if kw.arg == "frozen" and isinstance(
+                                kw.value, ast.Constant):
+                            frozen_hint = bool(kw.value.value)
+                out[node.targets[0].id] = (src, frozen_hint)
+    return out
+
+
 def _rule_config_hash(rel, tree, out):
     if not _in_device_scope(rel):
         return
+    dec_aliases = _decorator_alias_map(tree)
     for node in ast.walk(tree):
         if not (isinstance(node, ast.ClassDef)
                 and node.name.endswith("Config")):
@@ -480,6 +578,14 @@ def _rule_config_hash(rel, tree, out):
         is_dc, frozen = False, False
         for dec in node.decorator_list:
             src = _call_root(dec)
+            head0 = src.split("(", 1)[0].split(".", 1)[0]
+            if head0 in dec_aliases:
+                target, frozen_hint = dec_aliases[head0]
+                # substitute the alias head so dotted tails survive:
+                # fs.dataclass -> flax.struct.dataclass(...)
+                src = target + src[len(head0):]
+                if frozen_hint:
+                    frozen = True
             if "struct.dataclass" in src:
                 is_dc = False  # flax state trees are not static configs
                 break

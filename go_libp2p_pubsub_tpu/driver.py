@@ -154,6 +154,13 @@ def make_window(
     * ``observe`` is a device function ``state -> pytree`` evaluated
       after every dispatch; the per-dispatch stack comes back in
       ``ys["obs"]`` (leading axis D).
+    * ``consts`` (run-time argument, round 16) is a tuple of TRACED
+      window-invariant inputs appended to every step call after the
+      per-dispatch row — the lifted score plane's seat: a whole window
+      runs one weight set as ONE dispatch, and re-running the SAME
+      compiled window with a different plane is recompile-free
+      (tests/test_score_lift.py pins scanned-vs-loop parity and the
+      window-level one-compile A/B).
 
     The window requires ``D`` to be a multiple of
     ``lcm(len(heartbeat pattern), check_every)``; the checker runs once
@@ -169,13 +176,14 @@ def make_window(
     block = math.lcm(period, ce) if check is not None else period
     cpb = block // ce if check is not None else 0  # checks per block
 
-    def call(st, args, j):
+    def call(st, args, j, consts=()):
         if hb is None:
-            return step(st, *args)
-        return step(st, *args, do_heartbeat=hb[j % period])
+            return step(st, *args, *consts)
+        return step(st, *args, *consts, do_heartbeat=hb[j % period])
 
-    def run(st, xs, due=None):
+    def run(st, xs, due=None, consts=()):
         xs = tuple(xs)
+        consts = tuple(consts)
         if not xs:
             raise ValueError("make_window: xs must carry at least one "
                              "per-dispatch array (the dispatch count is "
@@ -214,7 +222,7 @@ def make_window(
             def inner_body(s, rows):
                 obs = []
                 for j in range(period):
-                    s = call(s, tuple(r[j] for r in rows), j)
+                    s = call(s, tuple(r[j] for r in rows), j, consts)
                     if observe is not None:
                         obs.append(observe(s))
                 ys = (jax.tree_util.tree_map(lambda *a: jnp.stack(a), *obs)
@@ -241,7 +249,7 @@ def make_window(
                 rows, drows = xs_blk
                 oks, obs = [], []
                 for j in range(block):
-                    s = call(s, tuple(r[j] for r in rows), j)
+                    s = call(s, tuple(r[j] for r in rows), j, consts)
                     if observe is not None:
                         obs.append(observe(s))
                     if check is not None and (j + 1) % ce == 0:
@@ -265,7 +273,7 @@ def make_window(
             def obs_body(s, rows):
                 obs = []
                 for j in range(block):
-                    s = call(s, tuple(r[j] for r in rows), j)
+                    s = call(s, tuple(r[j] for r in rows), j, consts)
                     obs.append(observe(s))
                 return s, jax.tree_util.tree_map(
                     lambda *a: jnp.stack(a), *obs)
@@ -275,7 +283,7 @@ def make_window(
         else:
             def plain_body(s, rows):
                 for j in range(block):
-                    s = call(s, tuple(r[j] for r in rows), j)
+                    s = call(s, tuple(r[j] for r in rows), j, consts)
                 return s, None
             st, _ = jax.lax.scan(plain_body, st, bx,
                                  unroll=max(1, int(unroll)))
@@ -355,7 +363,7 @@ def make_scan(
     win = make_window(step, heartbeat=sched, unroll=unroll, donate=False)
     raw = win.__wrapped__  # traced inside the adapter's own jit below
 
-    def run(st, po, pt, pv, up=None):
+    def run(st, po, pt, pv, up=None, consts=()):
         n_rounds = po.shape[0]
         if n_rounds % lcm != 0:
             raise ValueError(
@@ -373,6 +381,6 @@ def make_scan(
                 xs += (gro(up)[:, 0],)
         else:
             xs = (po, pt, pv) + (() if up is None else (up,))
-        st, _ = raw(st, xs)
+        st, _ = raw(st, xs, None, tuple(consts))
         return st
     return jax.jit(run, donate_argnums=0 if donate else ())

@@ -31,6 +31,7 @@ from .batch import (  # noqa: F401
     lift_floodsub,
     lift_step,
     sim_keys,
+    stack_planes,
     tile,
     unbatch,
     with_sim_key,

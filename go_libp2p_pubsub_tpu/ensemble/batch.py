@@ -85,6 +85,27 @@ def batch_states(state, n_sims: int, base_key: jax.Array | None = None):
     return jax.tree_util.tree_map(g, state)
 
 
+def stack_planes(planes):
+    """Stack a list of score/parameter pytrees (round-16
+    ``score.params.ScoreParams``) along a new leading S axis — the
+    configs×sims sweep input: pass the stacked plane as the lifted
+    step's trailing argument through :func:`lift_step` and ONE vmapped
+    program runs S *different parameterizations* (one compile, per
+    the recompile-free lift contract; tests/test_score_lift.py pins
+    row i == the single-sim run with plane i). Static aux fields
+    (``app_specific_weight``) must agree across the planes — they are
+    trace constants, not sweepable values."""
+    first = planes[0]
+    for p in planes[1:]:
+        if getattr(p, "app_specific_weight", None) != getattr(
+                first, "app_specific_weight", None):
+            raise ValueError(
+                "stack_planes: app_specific_weight is a STATIC (SHAPE) "
+                "field — every plane in a sweep must share it"
+            )
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *planes)
+
+
 def unbatch(states, sim_idx: int):
     """Slice sim ``sim_idx`` out of a batched state tree (host/analysis
     view; also the per-sim checkpoint-v6 compatibility path — the slice
@@ -145,26 +166,37 @@ def lift_step(step, *, net=None, static_kwargs: dict | None = None,
     return jax.jit(ens, **jit_kw)
 
 
-def lift_floodsub(net, chaos=None, queue_cap: int = 0, adversary=None):
+def lift_floodsub(net, chaos=None, queue_cap: int = 0, adversary=None,
+                  lift_scores: bool = False):
     """Convenience lift of the floodsub router (its step is a module-
     level jitted function taking ``net`` first, unlike the factories).
     Scheduled-chaos runs pass the per-round ``link_deny`` mask as a
     trailing positional (the gossipsub scheduled-build convention) —
     the adapter routes it to floodsub's keyword slot so it vmaps with
-    the other per-sim arrays instead of colliding with ``queue_cap``."""
+    the other per-sim arrays instead of colliding with ``queue_cap``.
+
+    ``lift_scores=True`` (round 16): the LAST trailing positional is a
+    score plane (stacked per sim — :func:`stack_planes`), routed to
+    floodsub's keyword-only ``score_plane`` seam. Floodsub ignores the
+    plane, but the adapter gives it the same trailing-positional slot
+    as the lifted gossipsub/phase/randomsub steps, so a configs×sims
+    sweep drives every router with one call convention."""
     from ..models import floodsub
 
     raw = getattr(floodsub.floodsub_step, "__wrapped__",
                   floodsub.floodsub_step)
 
-    def adapter(net_, s, po, pt, pv, *deny):
+    def adapter(net_, s, po, pt, pv, *rest):
         kw = {"queue_cap": queue_cap}
         if chaos is not None:
             kw["chaos"] = chaos
         if adversary is not None:
             kw["adversary"] = adversary
-        if deny:
-            kw["link_deny"] = deny[0]
+        rest = list(rest)
+        if lift_scores:
+            kw["score_plane"] = rest.pop()
+        if rest:
+            kw["link_deny"] = rest[0]
         return raw(net_, s, po, pt, pv, **kw)
 
     return lift_step(adapter, net=net)

@@ -66,6 +66,19 @@ def floodsub_step(
                             # so the attacker neighbor views trace as
                             # one [N] -> [N, K] gather per round (the
                             # factory engines bake them as constants)
+    score_plane=None,       # score.params.ScoreParams | None — the
+                            # round-16 lifted-plane seam, TRACED and
+                            # KEYWORD-ONLY in practice (the defaulted
+                            # statics above sit between it and the
+                            # pub arrays). The floodsub router has no
+                            # score machinery (floodsub.go has no
+                            # scoring), so the plane is accepted and
+                            # unused; configs×sims sweeps thread it
+                            # positionally through the
+                            # ensemble.lift_floodsub(lift_scores=True)
+                            # adapter, which keeps the four-engine
+                            # lifted call convention uniform
+                            # (docs/DESIGN.md §16)
 ) -> SimState:
     """One synchronous round: deliver in-flight messages one hop, then
     intern this round's publishes (they start propagating next round).

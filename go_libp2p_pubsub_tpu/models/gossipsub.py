@@ -494,11 +494,14 @@ def joined_msg_words(net: Net, msgs) -> jax.Array:
 
 def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
                        acc_ok: jax.Array, graft_in_raw: jax.Array,
-                       prune_in_raw: jax.Array, px_in_raw):
+                       prune_in_raw: jax.Array, px_in_raw, thr=None):
     """Process GRAFT/PRUNE received this round (handleGraft
     gossipsub.go:718-809, handlePrune :811-843). Returns updated state plus
     next round's PRUNE responses. `*_raw` are the pre-gathered edge views
-    from the step's merged wire exchange (already nbr_ok-masked)."""
+    from the step's merged wire exchange (already nbr_ok-masked).
+    ``thr`` is the threshold source — cfg (static floats, the default)
+    or the traced ScoreParams plane of a lifted build (round 16)."""
+    thr = cfg if thr is None else thr
     tick = st.core.tick
 
     graft_in = graft_in_raw & acc_ok[:, None, :]
@@ -508,7 +511,7 @@ def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: d
     # honored only if the pruner's score clears AcceptPXThreshold
     if cfg.do_px:
         px_in = px_in_raw & prune_in
-        px_ok = jnp.any(px_in, axis=1) & (st.scores >= cfg.accept_px_threshold)  # [N,K]
+        px_ok = jnp.any(px_in, axis=1) & (st.scores >= thr.accept_px_threshold)  # [N,K]
     else:
         px_ok = None
 
@@ -588,10 +591,12 @@ _prefix_cap_bits = bitset.prefix_cap_bits
 
 def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
                  joined_words: jax.Array, acc_ok: jax.Array,
-                 ihave_in_raw: jax.Array) -> GossipSubState:
+                 ihave_in_raw: jax.Array, thr=None) -> GossipSubState:
     """IHAVE received this round -> IWANT requests + a promise
     (handleIHave gossipsub.go:615-677). `ihave_in_raw` is the pre-gathered
-    edge view from the step's merged wire exchange."""
+    edge view from the step's merged wire exchange. ``thr`` is the
+    threshold source (cfg, or a lifted build's traced plane)."""
+    thr = cfg if thr is None else thr
     m = st.core.msgs.capacity
     tick = st.core.tick
     ihave_in = jnp.where(acc_ok[:, :, None], ihave_in_raw, jnp.uint32(0))
@@ -601,7 +606,7 @@ def handle_ihave(cfg: GossipSubConfig, net: Net, st: GossipSubState,
 
     ok = got
     if cfg.score_enabled:
-        ok = ok & (st.scores >= cfg.gossip_threshold)  # gossipsub.go:616-621
+        ok = ok & (st.scores >= thr.gossip_threshold)  # gossipsub.go:616-621
     ok = ok & (peerhave <= cfg.max_ihave_messages)     # gossipsub.go:624-628
     ok = ok & (st.iasked < cfg.max_ihave_length)       # gossipsub.go:630-633
 
@@ -643,7 +648,8 @@ def _served_capped(cfg: GossipSubConfig, lo: jax.Array, hi: jax.Array) -> jax.Ar
 
 
 def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState,
-                    nbr_score_of_me, window_g: jax.Array | None = None):
+                    nbr_score_of_me, window_g: jax.Array | None = None,
+                    thr=None):
     """The IWANT-response carry for this round's delivery + retransmission
     counter update (handleIWant gossipsub.go:679-716). `st.iwant_out` holds
     what I asked each neighbor last round; the neighbor serves from its full
@@ -651,7 +657,9 @@ def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     `nbr_score_of_me` [N,K] comes from the step's merged wire exchange
     (None only when scoring is disabled). ``window_g`` is the neighbors'
     gathered mcache-window plane when the coalesced wire exchange already
-    carried it (None: gather here, the legacy extra permute set)."""
+    carried it (None: gather here, the legacy extra permute set).
+    ``thr`` is the threshold source (cfg, or a lifted plane)."""
+    thr = cfg if thr is None else thr
     asked = st.iwant_out
     if window_g is None:
         sender_window = bitset.word_or_reduce(st.mcache, axis=1)   # [N,W]
@@ -667,7 +675,7 @@ def iwant_responses(cfg: GossipSubConfig, net: Net, st: GossipSubState,
         # responder ignores requesters below the gossip threshold
         # (gossipsub.go:681-685): the score the neighbor holds of me
         resp = jnp.where(
-            (nbr_score_of_me >= cfg.gossip_threshold)[:, :, None], resp, jnp.uint32(0)
+            (nbr_score_of_me >= thr.gossip_threshold)[:, :, None], resp, jnp.uint32(0)
         )
 
     # 2-bit saturating increment on served slots
@@ -754,7 +762,8 @@ def fanout_carry_words_packed(fp_pack: jax.Array, k: int,
 def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
                      joined_words: jax.Array, acc_ok: jax.Array,
                      slotw: jax.Array, msg_topic: jax.Array,
-                     flood_edges: jax.Array, nbr_score_of_me) -> jax.Array:
+                     flood_edges: jax.Array, nbr_score_of_me,
+                     thr=None) -> jax.Array:
     """[N,K,W] edge-carry mask: mesh push (forwarding along the sender's
     mesh, gossipsub.go:981-1002) + fanout push + floodsub-peer edges
     (protocol negotiation, gossipsub.go:973-978) + v1.1 flood-publish for
@@ -762,6 +771,7 @@ def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     graylist/gater.
 
     Sender-side packed outbox + word gather (no [N,K,M] traffic)."""
+    thr = cfg if thr is None else thr
     carry_out = sender_carry_words(st.mesh, slotw)
     if cfg.fanout_slots > 0:
         carry_out = carry_out | fanout_carry_words(
@@ -782,7 +792,7 @@ def gossip_edge_mask(cfg: GossipSubConfig, net: Net, st: GossipSubState,
         # elementwise compare fused into the pack
         origin_is_sender = st.core.msgs.origin[None, :] == net.nbr[..., None]  # [N,K,M]
         if cfg.score_enabled:
-            flood_ok = nbr_score_of_me >= cfg.publish_threshold
+            flood_ok = nbr_score_of_me >= thr.publish_threshold
         else:
             flood_ok = net.nbr_ok
         mask = mask | (
@@ -804,6 +814,7 @@ def update_fanout_on_publish(
     key: jax.Array,
     nbr_sub_words: jax.Array,  # [N,K,Wt] static: neighbors' topic-bit subs
     fp_pack: jax.Array | None = None,
+    thr=None,                  # threshold source (cfg | lifted plane)
 ):
     """Publishing to an unjoined topic creates/refreshes a fanout slot with
     D random eligible peers (gossipsub.go:983-998) and stamps lastpub.
@@ -812,6 +823,7 @@ def update_fanout_on_publish(
     packed [N,F] u32 peers form) is given, ``(state, fp_pack)`` with
     ``state.fanout_peers`` left untouched (stale; the phase tail unpacks
     the packed form back into it)."""
+    thr = cfg if thr is None else thr
     tick = st.core.tick
     p_dim = pub_origin.shape[0]
     f_dim = cfg.fanout_slots
@@ -865,7 +877,7 @@ def update_fanout_on_publish(
         & ~net.direct[o]
     )
     if cfg.score_enabled:
-        cand = cand & (st.scores[o] >= cfg.publish_threshold)
+        cand = cand & (st.scores[o] >= thr.publish_threshold)
     sel = select_random_mask(key, cand, cfg.D)  # [P,K]
 
     # commit: new slots take the fresh selection; matched slots keep
@@ -1005,7 +1017,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               present_ok: jax.Array | None = None,
               gossip_suppress: jax.Array | None = None,
               app_gathered: jax.Array | None = None,
-              adversary=None) -> GossipSubState:
+              adversary=None, thr=None) -> GossipSubState:
     """`net` is the live view (nbr_ok masked by churn/edge-liveness);
     `present_ok` is the static edge-presence mask, needed by directConnect
     to re-dial edges that are currently dormant (defaults to net.nbr_ok).
@@ -1019,7 +1031,10 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     pins sybil-held scores of fellow sybils, graft-spam overwrites the
     GRAFT outbox ignoring backoff (and zeroes the attackers' own
     backoff bookkeeping — raw-wire fakes keep no router state), and
-    lie-in-IHAVE advertises every live message id on every edge."""
+    lie-in-IHAVE advertises every live message id on every edge.
+    ``thr`` is the threshold source (cfg, or a lifted build's traced
+    ScoreParams plane — score_params is then that same plane)."""
+    thr = cfg if thr is None else thr
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -1171,7 +1186,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     if cfg.score_enabled and cfg.opportunistic_graft_ticks > 0:
         def _oppo_grafts():
             med = median_masked(scores_b, mesh)  # [N,S]
-            low = (med < cfg.opportunistic_graft_threshold) & (count_true(mesh) > 1)
+            low = (med < thr.opportunistic_graft_threshold) & (count_true(mesh) > 1)
             cand3 = cand & ~mesh & (scores_b > med[:, :, None])
             return select_random_mask(
                 k5, cand3, jnp.where(low, cfg.opportunistic_graft_peers, 0)
@@ -1207,7 +1222,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         fpeers = fpeers & f_live[:, :, None]
         # drop peers below the publish threshold (gossipsub.go:1528-1534)
         if cfg.score_enabled:
-            fpeers = fpeers & (scores[:, None, :] >= cfg.publish_threshold)
+            fpeers = fpeers & (scores[:, None, :] >= thr.publish_threshold)
         # neighbor-subscribes-fanout-topic via topic-bit extraction
         n_f, f_dim = ft.shape
         nbr_sub_f = bitset.bit_get(
@@ -1225,7 +1240,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         )
         cand_f = base_f & ~fpeers
         if cfg.score_enabled:
-            cand_f = cand_f & (scores[:, None, :] >= cfg.publish_threshold)
+            cand_f = cand_f & (scores[:, None, :] >= thr.publish_threshold)
         ineed_f = jnp.where(f_live, cfg.D - count_true(fpeers), 0)
         kf1, kf2 = jax.random.split(jax.random.fold_in(key, 11))
         fpeers = fpeers | select_random_mask(kf1, cand_f, ineed_f)
@@ -1236,7 +1251,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     if gossip_suppress is not None:
         gossip_cand = gossip_cand & ~gossip_suppress[:, None, :]
     if cfg.score_enabled:
-        gossip_cand = gossip_cand & (scores_b >= cfg.gossip_threshold)
+        gossip_cand = gossip_cand & (scores_b >= thr.gossip_threshold)
     n_cand = count_true(gossip_cand)
     target = jnp.maximum(
         cfg.Dlazy,
@@ -1257,7 +1272,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         if gossip_suppress is not None:
             gossip_cand_f = gossip_cand_f & ~gossip_suppress[:, None, :]
         if cfg.score_enabled:
-            gossip_cand_f = gossip_cand_f & (scores[:, None, :] >= cfg.gossip_threshold)
+            gossip_cand_f = gossip_cand_f & (scores[:, None, :] >= thr.gossip_threshold)
         n_cand_f = count_true(gossip_cand_f)
         target_f = jnp.where(
             (ft >= 0),
@@ -1636,13 +1651,15 @@ def live_step_views(cfg: GossipSubConfig, net: Net, st: GossipSubState,
 
 
 def accept_gates(cfg: GossipSubConfig, net_l: Net, st: GossipSubState,
-                 gater_params, key, tick):
+                 gater_params, key, tick, thr=None):
     """AcceptFrom gate (gossipsub.go:583-594): direct always accepted;
     graylisted dropped entirely; the gater's RED decision drops only
     the message plane (AcceptControl, peer_gater.go:362).
-    Returns (acc_ok, acc_msg) [N,K] bool."""
+    Returns (acc_ok, acc_msg) [N,K] bool. ``thr`` is the threshold
+    source (cfg, or a lifted build's traced ScoreParams plane)."""
+    thr = cfg if thr is None else thr
     if cfg.score_enabled:
-        acc_ok = (st.scores >= cfg.graylist_threshold) | net_l.direct
+        acc_ok = (st.scores >= thr.graylist_threshold) | net_l.direct
     else:
         acc_ok = net_l.nbr_ok
     if cfg.gater_enabled:
@@ -1881,10 +1898,24 @@ def make_gossipsub_step(
     sub_knowledge_holes: np.ndarray | None = None,
     telemetry=None,
     adversary=None,
+    lift_scores: bool = False,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
     step(state, pub_origin[P], pub_topic[P], pub_valid[P]) -> state
+
+    With ``lift_scores=True`` (round 16, docs/DESIGN.md §16) the step
+    takes a trailing TRACED ``score_plane`` argument (a
+    ``score.params.ScoreParams`` pytree): every score weight, decay
+    factor and v1.1 threshold the liftability audit proves VALUE-only
+    (LIFT_AUDIT.json) is read from the plane instead of the baked
+    statics, so two calls with different weight sets share ONE
+    compiled program (the recompile-free A/B sentinel) and a vmapped
+    plane axis sweeps weight populations. Matched values reproduce the
+    static build bit for bit (tests/test_score_lift.py). Requires
+    ``cfg.score_enabled``; the fused Pallas data plane is excluded
+    (its kernel takes thresholds as host constants — a SHAPE seam the
+    audit pins).
 
     With ``static_heartbeat=True`` (and ``cfg.heartbeat_every > 1``) the
     step takes a trailing *static* python bool ``do_heartbeat`` instead of
@@ -1937,6 +1968,11 @@ def make_gossipsub_step(
     program is the pre-adversary one, bit for bit
     (tests/test_adversary.py).
     """
+    if lift_scores and not cfg.score_enabled:
+        raise ValueError(
+            "lift_scores=True needs cfg.score_enabled — the lifted "
+            "plane parameterizes the v1.1 score machinery"
+        )
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
         sub_knowledge_holes, adversary_no_forward, adversary,
@@ -1976,6 +2012,9 @@ def make_gossipsub_step(
         and not _old_pallas
         and chaos is None  # the fused halo kernel predates the chaos plane
         and adv is None    # ... and the adversary plane
+        # the fused kernel bakes thresholds as host floats — a SHAPE
+        # seam (LIFT_AUDIT.json); lifted builds keep the XLA path
+        and not lift_scores
     )
     fused_interp = jax.default_backend() != "tpu"
     use_fused = fused_eligible and fused_env == "1"
@@ -1989,14 +2028,26 @@ def make_gossipsub_step(
 
     def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
                do_heartbeat: bool = True,
-               link_deny=None) -> GossipSubState:
+               link_deny=None, score_plane=None) -> GossipSubState:
+        # lifted score plane (round 16): the VALUE-proved score fields
+        # read from the traced plane — per-topic rows gathered to the
+        # same [N, S] views TopicParamsArrays.gather bakes, thresholds
+        # and scalar params from the plane's leaves. score_plane=None
+        # is the static path, byte-identical to the pre-lift program
+        # (thr=cfg routes every threshold read to the same Python
+        # floats it always read).
+        if score_plane is not None:
+            tp_r = score_plane.gather(net.my_topics)
+            sp_r, thr, wrt = score_plane, score_plane, score_plane.window_rounds
+        else:
+            tp_r, sp_r, thr, wrt = tp, score_params, cfg, window_rounds_t
         # telemetry: counters at step ENTRY (before the churn plane's
         # ADD/REMOVE_PEER accounting), so the row's EV deltas cover the
         # whole step and the panel sums telescope to the drained totals
         ev_prev = st.core.events if telemetry is not None else None
         # ---- peer lifecycle transitions (dynamic_peers only) ------------
         if dynamic_peers:
-            st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
+            st, live = apply_peer_transitions(cfg, net, st, up_next, tp_r)
         else:
             live = None
         net_l, nbr_sub_l, flood_from_l, nbr_sub_words_l = live_step_views(
@@ -2008,7 +2059,7 @@ def make_gossipsub_step(
         m = core.msgs.capacity
 
         acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
-                                       core.key, tick)
+                                       core.key, tick, thr=thr)
 
         # ---- chaos plane: this round's link outages ---------------------
         # TCP-flap semantics — the WHOLE link (control head + data, both
@@ -2069,7 +2120,8 @@ def make_gossipsub_step(
 
         # 1. GRAFT/PRUNE ingest
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
-            cfg, net_l, st, tp, acc_ok, graft_in_raw, prune_in_raw, px_in_raw
+            cfg, net_l, st, tp_r, acc_ok, graft_in_raw, prune_in_raw,
+            px_in_raw, thr=thr,
         )
         events = st.core.events
         if cfg.count_events:
@@ -2184,24 +2236,26 @@ def make_gossipsub_step(
             # carry) — the mcache-window gather rides the wire view, so a
             # flapped link's responses are lost (and its retransmission
             # counters don't tick: the data never arrived)
-            st2, iwant_resp = iwant_responses(cfg, net_w, st2, nbr_score_of_me)
+            st2, iwant_resp = iwant_responses(cfg, net_w, st2,
+                                              nbr_score_of_me, thr=thr)
 
             # 3. IHAVE ingest (advertisements -> next round's requests)
-            st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok, ihave_in_raw)
+            st2 = handle_ihave(cfg, net_l, st2, joined_words, acc_ok,
+                               ihave_in_raw, thr=thr)
 
             # 4. delivery: mesh/fanout push + flood edges + IWANT responses
             # floodsub-peer edges: sender floodsub => flood; receiver floodsub
             # => gossipsub sender still sends everything (score-gated,
             # gossipsub.go:973-978)
             if cfg.score_enabled:
-                recv_ok = nbr_score_of_me >= cfg.publish_threshold
+                recv_ok = nbr_score_of_me >= thr.publish_threshold
             else:
                 recv_ok = net_l.nbr_ok
             flood_edges = flood_from_l | (i_am_floodsub[:, None] & recv_ok & net_l.nbr_ok)
             edge_mask = gossip_edge_mask(
                 cfg, net_l, st2, joined_words, acc_msg, slotw,
                 core.msgs.topic, flood_edges,
-                nbr_score_of_me,
+                nbr_score_of_me, thr=thr,
             )
             if sender_fwd_ok is not None:
                 edge_mask = jnp.where(sender_fwd_ok[:, :, None], edge_mask, jnp.uint32(0))
@@ -2275,9 +2329,9 @@ def make_gossipsub_step(
         score = st2.score
         if cfg.score_enabled:
             score = on_deliveries(
-                score, net_l, st2.mesh, tp, info.trans, info.new_words,
+                score, net_l, st2.mesh, tp_r, info.trans, info.new_words,
                 dlv.fe_words, dlv.first_round,
-                core.msgs.topic, core.msgs.valid, tick, window_rounds_t,
+                core.msgs.topic, core.msgs.valid, tick, wrt,
                 msg_ignored=core.msgs.ignored,
                 slotw=slotw,
                 pending_words=(
@@ -2359,7 +2413,7 @@ def make_gossipsub_step(
             st2 = update_fanout_on_publish(
                 cfg, net_l, st2, pub_origin, pub_topic,
                 jax.random.fold_in(jax.random.fold_in(core.key, tick), 0xFA40),
-                nbr_sub_words_l,
+                nbr_sub_words_l, thr=thr,
             )
 
         if cfg.count_events:
@@ -2415,9 +2469,9 @@ def make_gossipsub_step(
         # through both branches, which costs real copies of the big arrays.
         def hb(s):
             return heartbeat(
-                cfg, net_l, s, tp, score_params, nbr_sub_l, gater_params,
+                cfg, net_l, s, tp_r, sp_r, nbr_sub_l, gater_params,
                 nbr_sub_words_l, present_ok=net.nbr_ok,
-                gossip_suppress=gossip_suppress, adversary=adv,
+                gossip_suppress=gossip_suppress, adversary=adv, thr=thr,
             )
 
         if cfg.heartbeat_every == 1:
@@ -2450,6 +2504,30 @@ def make_gossipsub_step(
         return st2.replace(core=st2.core.replace(tick=tick + 1))
 
     use_static_hb = static_heartbeat and cfg.heartbeat_every > 1
+    if lift_scores:
+        # lifted call convention: the TRACED score plane rides as the
+        # LAST positional, after the per-round arrays (up_next /
+        # link_deny keep their usual slots) — so ensemble.lift_step
+        # vmaps it like any other per-sim input, which is exactly the
+        # configs×sims sweep axis the ROADMAP parameter search needs
+        def _dispatch(st, pub_origin, pub_topic, pub_valid, rest,
+                      do_heartbeat=True):
+            up = rest[0] if dynamic_peers else None
+            deny = rest[int(dynamic_peers)] if chaos_sched else None
+            return _round(st, pub_origin, pub_topic, pub_valid, up,
+                          do_heartbeat, deny, score_plane=rest[-1])
+
+        if use_static_hb:
+            def step(st, pub_origin, pub_topic, pub_valid, *rest,
+                     do_heartbeat):
+                return _dispatch(st, pub_origin, pub_topic, pub_valid,
+                                 rest, do_heartbeat)
+            return jax.jit(step, donate_argnums=0,
+                           static_argnames=("do_heartbeat",))
+
+        def step(st, pub_origin, pub_topic, pub_valid, *rest):
+            return _dispatch(st, pub_origin, pub_topic, pub_valid, rest)
+        return jax.jit(step, donate_argnums=0)
     if use_static_hb:
         # do_heartbeat is REQUIRED here: a default would let a driver
         # silently heartbeat every round (or never) against the cadence.

@@ -252,8 +252,19 @@ def make_gossipsub_phase_step(
     admission_capped: bool = False,
     telemetry=None,
     adversary=None,
+    lift_scores: bool = False,
 ):
     """Build the jitted multi-round phase step.
+
+    With ``lift_scores=True`` (round 16, docs/DESIGN.md §16) the step
+    takes a trailing TRACED ``score_plane`` (score.params.ScoreParams):
+    weights/decays/thresholds read from the plane, one compiled
+    program across weight sets, bit-exact vs the static build at
+    matched values. The phase engine's static weight elision
+    (p3_live/p4_live) is a build-time STRUCTURE decision on weight
+    values, so the lifted build pins the conservative all-planes-live
+    structure — LIFT_AUDIT.json records those reads as the guarded
+    elision sites they are.
 
     phase_step(state, pub_origin[r,P], pub_topic[r,P], pub_valid[r,P],
                [up_next], *, do_heartbeat) -> state     (tick advances by r)
@@ -310,6 +321,11 @@ def make_gossipsub_phase_step(
     """
     r = int(rounds_per_phase)
     assert r >= 1
+    if lift_scores and not cfg.score_enabled:
+        raise ValueError(
+            "lift_scores=True needs cfg.score_enabled — the lifted "
+            "plane parameterizes the v1.1 score machinery"
+        )
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
         sub_knowledge_holes, adversary_no_forward, adversary,
@@ -362,16 +378,34 @@ def make_gossipsub_phase_step(
         np.any(_w3 != 0.0) or np.any((_w3b != 0.0) & (_thr3 > 0.0))
     )
     p4_live = exact_counters or bool(np.any(np.asarray(consts.tpa.w4) != 0.0))
+    if lift_scores:
+        # a TRACED weight cannot drive build-time structure: the lifted
+        # program keeps every attribution plane live so ONE compile is
+        # correct for every weight set the plane sweeps (the elision
+        # sites above are LIFT_AUDIT.json's guarded-elision evidence)
+        p3_live = p4_live = True
 
     def _phase(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
-               do_heartbeat: bool, link_deny=None) -> GossipSubState:
+               do_heartbeat: bool, link_deny=None,
+               score_plane=None) -> GossipSubState:
+        # lifted score plane (round 16): the VALUE-proved score fields
+        # read from the traced plane; score_plane=None is the static
+        # path, byte-identical to the pre-lift program (thr=cfg routes
+        # threshold reads to the same Python floats)
+        if score_plane is not None:
+            tp_r = score_plane.gather(net.my_topics)
+            sp_r, thr, wrt = (score_plane, score_plane,
+                              score_plane.window_rounds)
+        else:
+            tp_r, sp_r, thr, wrt = (tp, consts.score_params, cfg,
+                                    consts.window_rounds_t)
         # telemetry: counters at phase ENTRY, before the churn plane's
         # ADD/REMOVE_PEER accounting (the phase-tail row's deltas cover
         # the whole phase, so the panel sums telescope exactly)
         ev_prev = st.core.events if telemetry is not None else None
         # ---- control head (once per phase) ------------------------------
         if dynamic_peers:
-            st, live = apply_peer_transitions(cfg, net, st, up_next, tp)
+            st, live = apply_peer_transitions(cfg, net, st, up_next, tp_r)
         else:
             live = None
         net_l, nbr_sub_l, flood_from_l, nbr_sub_words_l = live_step_views(
@@ -417,7 +451,7 @@ def make_gossipsub_phase_step(
                 )
 
         acc_ok, acc_msg = accept_gates(cfg, net_l, st, gater_params,
-                                       core.key, tick0)
+                                       core.key, tick0, thr=thr)
 
         # ---- chaos plane: the phase-head round's link outages ----------
         # The control head crosses the wire ONCE, at round tick0 — its
@@ -459,7 +493,8 @@ def make_gossipsub_phase_step(
              nbr_score_of_me) = control_exchange(cfg, net, net_w, st)
             window_g = app_g = None
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
-            cfg, net_l, st, tp, acc_ok, graft_in_raw, prune_in_raw, px_in_raw
+            cfg, net_l, st, tp_r, acc_ok, graft_in_raw, prune_in_raw,
+            px_in_raw, thr=thr,
         )
         events = st.core.events
         if cfg.count_events:
@@ -469,9 +504,9 @@ def make_gossipsub_phase_step(
         # responses on a flapped link are lost and the retransmission
         # counters don't tick (the data never arrived)
         st2, iwant_resp = iwant_responses(cfg, net_w, st2, nbr_score_of_me,
-                                          window_g=window_g)
+                                          window_g=window_g, thr=thr)
         st2 = handle_ihave(cfg, net_l, st2, joined_msg_words(net_l, core.msgs),
-                           acc_ok, ihave_in_raw)
+                           acc_ok, ihave_in_raw, thr=thr)
         if consts.sender_fwd_ok is not None:
             iwant_resp = jnp.where(
                 consts.sender_fwd_ok[:, :, None], iwant_resp, jnp.uint32(0)
@@ -493,7 +528,7 @@ def make_gossipsub_phase_step(
         # mesh membership, scores, accept gates hold for the whole phase)
         mesh2 = st2.mesh
         if cfg.score_enabled:
-            send_score_ok = st.scores >= cfg.publish_threshold
+            send_score_ok = st.scores >= thr.publish_threshold
         else:
             send_score_ok = net_l.nbr_ok
         # floodsub-semantics edges, sender side: I speak only floodsub =>
@@ -767,7 +802,7 @@ def make_gossipsub_phase_step(
             if cfg.score_enabled and (p3_live or count_score):
                 # P3 window gate at this arrival's own tick (score.go:
                 # 944-974 markDuplicateMessageDelivery window check)
-                msg_window = consts.window_rounds_t[jnp.clip(msgs.topic, 0)]
+                msg_window = wrt[jnp.clip(msgs.topic, 0)]
                 within_i = bitset.pack(
                     (dlv.first_round >= 0)
                     & ((tick_i - dlv.first_round) <= msg_window[None, :])
@@ -949,7 +984,7 @@ def make_gossipsub_phase_step(
                         jax.random.fold_in(core.key, tick_i), 0xFA40
                     ),
                     nbr_sub_words_l,
-                    fp_pack=fp_pack,
+                    fp_pack=fp_pack, thr=thr,
                 )
                 if fp_pack is not None:
                     fanout_st, fp_pack = upd
@@ -979,14 +1014,14 @@ def make_gossipsub_phase_step(
         score = st2.score
         if count_score:
             score = apply_delivery_counts(
-                score, tp, fmd_counts, mmd_counts, imd_counts, mesh2
+                score, tp_r, fmd_counts, mmd_counts, imd_counts, mesh2
             )
         elif plane_score:
             score = on_deliveries(
-                score, net_l, mesh2, tp,
+                score, net_l, mesh2, tp_r,
                 accs.get("trans", zkw), accs.get("new"),
                 dlv.fe_words, dlv.first_round,
-                msgs.topic, msgs.valid, tick_last, consts.window_rounds_t,
+                msgs.topic, msgs.valid, tick_last, wrt,
                 msg_ignored=msgs.ignored,
                 slotw=slot_topic_words(net_l, msgs.topic),
                 recv_new_words=accs.get("recv"),
@@ -1066,10 +1101,10 @@ def make_gossipsub_phase_step(
 
         if do_heartbeat:
             st2 = heartbeat(
-                cfg, net_l, st2, tp, consts.score_params, nbr_sub_l,
+                cfg, net_l, st2, tp_r, sp_r, nbr_sub_l,
                 gater_params, nbr_sub_words_l, present_ok=net.nbr_ok,
                 gossip_suppress=gossip_suppress, app_gathered=app_g,
-                adversary=adv,
+                adversary=adv, thr=thr,
             )
 
         # telemetry row — one per phase, recorded LAST (after the
@@ -1088,6 +1123,20 @@ def make_gossipsub_phase_step(
             )
             st2 = st2.replace(core=core_f.replace(telem=telem))
         return st2.replace(core=st2.core.replace(tick=tick0 + r))
+
+    if lift_scores:
+        # lifted call convention (same as the per-round builder): the
+        # TRACED score plane is the LAST positional, after up_next /
+        # link_deny — ensemble.lift_step vmaps it like any per-sim
+        # input (the configs×sims sweep axis)
+        def step(st, pub_origin, pub_topic, pub_valid, *rest,
+                 do_heartbeat):
+            up = rest[0] if dynamic_peers else None
+            deny = rest[int(dynamic_peers)] if chaos_sched else None
+            return _phase(st, pub_origin, pub_topic, pub_valid, up,
+                          do_heartbeat, deny, score_plane=rest[-1])
+        return jax.jit(step, donate_argnums=0,
+                       static_argnames=("do_heartbeat",))
 
     # scheduled-chaos builds take the Scenario's forced-down link mask as
     # a REQUIRED trailing positional — ONE [N, K] plane per phase (like

@@ -41,7 +41,8 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
                         stacked: bool = True,
                         chaos: "chaos_faults.ChaosConfig | None" = None,
                         telemetry=None,
-                        adversary=None):
+                        adversary=None,
+                        lift_scores: bool = False):
     """Build the jitted per-round RandomSub step.
 
     `size_estimate` mirrors the reference's static network-size parameter:
@@ -77,7 +78,12 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
     plane's DATA behaviors — drop-on-forward and censorship, masked
     into the receiver gather with eager neighbor-view constants (zero
     extra halo permutes); the mesh/score behaviors have no randomsub
-    analogue. None elides it statically."""
+    analogue. None elides it statically.
+
+    ``lift_scores=True`` (round 16) makes the step take a trailing
+    TRACED ``score_plane`` — accepted and unused (randomsub has no
+    score machinery), threading the four-engine lifted call convention
+    so ensemble sweeps treat every router uniformly."""
     chaos = chaos_faults.resolve(chaos)
     chaos_sched = chaos is not None and chaos.scheduled
     adv_pop = adversary_mod.resolve(adversary)
@@ -165,7 +171,12 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
         return st.replace(tick=tick + 1, msgs=msgs, dlv=dlv, events=events,
                           telem=telem)
 
-    if chaos_sched:
+    if lift_scores:
+        # rest = ([link_deny,] score_plane); the plane is unused here
+        def step(st, pub_origin, pub_topic, pub_valid, *rest):
+            deny = rest[0] if chaos_sched else None
+            return _round(st, pub_origin, pub_topic, pub_valid, deny)
+    elif chaos_sched:
         def step(st, pub_origin, pub_topic, pub_valid, link_deny):
             return _round(st, pub_origin, pub_topic, pub_valid, link_deny)
     else:

@@ -108,6 +108,26 @@ SCAN_OFF = {"scan": None, "segment_rounds": None,
             "dispatches_per_window": None, "rounds_per_dispatch": None,
             "mesh_shape": None, "unroll": None, "check_every": None}
 
+#: the params defaults every artifact WITHOUT a fingerprint["params"]
+#: block reads back as (round 16): the whole pre-lift trajectory baked
+#: every config knob into the compiled program as a static — an
+#: explicit sentinel ("recorded": False), so readers can ask any
+#: artifact "which knobs were traced inputs" without special-casing
+#: age; the legacy answer is "all static, unrecorded split".
+PARAMS_STATIC = {"recorded": False, "lifted": False, "traced": []}
+
+
+def params_fingerprint(lifted: bool, traced=()) -> dict:
+    """The schema-v3 ``fingerprint["params"]`` block (round 16): the
+    traced-vs-static config split of the producing build. ``traced``
+    names the audit-namespace fields riding the lifted ScoreParams
+    plane (score.params.LIFTED_FIELD_NAMES for a lifted build; empty
+    when everything is static). Readers go through
+    :attr:`BenchRecord.params`, which defaults legacy lines to
+    :data:`PARAMS_STATIC`."""
+    return {"recorded": True, "lifted": bool(lifted),
+            "traced": sorted(str(t) for t in traced)}
+
 
 def execution_fingerprint(*, scan: bool, segment_rounds: int,
                           dispatches_per_window: int,
@@ -365,6 +385,23 @@ class BenchRecord:
     @property
     def invariants_on(self) -> bool:
         return bool(self.invariants["enabled"])
+
+    @property
+    def params(self) -> dict:
+        """The params block of the fingerprint (round 16): which config
+        knobs rode the compiled program as TRACED inputs (the lifted
+        ScoreParams plane) versus baked statics. LEGACY artifacts —
+        every line that predates the score lift — read back
+        :data:`PARAMS_STATIC` (recorded: False), an explicit
+        "all-static, split unrecorded" sentinel."""
+        fp = self.fingerprint or {}
+        out = dict(PARAMS_STATIC)
+        out.update(fp.get("params") or {})
+        return out
+
+    @property
+    def params_lifted(self) -> bool:
+        return bool(self.params["lifted"])
 
     @property
     def execution(self) -> dict:

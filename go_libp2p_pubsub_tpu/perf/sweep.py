@@ -105,7 +105,8 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
                 heartbeat_every: int = 1, rounds_per_phase: int = 1,
                 wire_coalesced: bool | None = None,
                 telemetry=None, count_events: bool | None = None,
-                edge_layout: str | None = None):
+                edge_layout: str | None = None,
+                lift_scores: bool = False):
     """Build (state, step, n_topics, honest) for a BENCH_CONFIG:
 
     default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
@@ -134,6 +135,12 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     ``count_events`` overrides the tracer-detached default (False);
     telemetry's EV columns only move when counters are live, so
     telemetry builds that reconcile pass ``count_events=True``.
+
+    ``lift_scores=True`` (round 16, docs/DESIGN.md §16) builds the
+    LIFTED variant: the step takes a trailing traced
+    ``score.params.ScoreParams`` plane — the same workload, with the
+    score weights/thresholds as a run-time input (one compile across
+    weight sets; bit-exact vs the static build at matched values).
     """
     import dataclasses as _dc
 
@@ -189,12 +196,14 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         step = make_gossipsub_phase_step(
             cfg, net, rounds_per_phase, score_params=sp, gater_params=gater,
             adversary_no_forward=adversary, telemetry=telemetry,
+            lift_scores=lift_scores,
         )
     else:
         step = make_gossipsub_step(cfg, net, score_params=sp, gater_params=gater,
                                    adversary_no_forward=adversary,
                                    static_heartbeat=heartbeat_every > 1,
-                                   telemetry=telemetry)
+                                   telemetry=telemetry,
+                                   lift_scores=lift_scores)
 
     n_dev = len(jax.devices())
     if n_dev > 1 and n_peers % n_dev == 0:
@@ -288,6 +297,16 @@ def _chaos_fingerprint():
     return chaos_fingerprint()
 
 
+def _params_fingerprint(lift_scores: bool):
+    from .artifacts import params_fingerprint
+
+    if not lift_scores:
+        return params_fingerprint(lifted=False)
+    from ..score.params import LIFTED_FIELD_NAMES
+
+    return params_fingerprint(lifted=True, traced=LIFTED_FIELD_NAMES)
+
+
 def workload_fingerprint(
     config: str,
     n_peers: int,
@@ -298,6 +317,7 @@ def workload_fingerprint(
     unroll: int | None = None,
     wire_coalesced: bool | None = None,
     edge_layout: str | None = None,
+    lift_scores: bool = False,
 ) -> dict:
     """The schema-v2 self-description of a bench cell: everything a
     future reader needs to know what the number measured, derived from
@@ -367,6 +387,11 @@ def workload_fingerprint(
         # — emit their generator/scenario here instead). Legacy artifacts
         # without the field read back as off (artifacts.BenchRecord.chaos)
         "chaos": _chaos_fingerprint(),
+        # the traced-vs-static config split (round 16, schema v3): a
+        # lifted build names the LIFT_AUDIT-proved fields riding the
+        # traced ScoreParams plane; legacy lines read back the
+        # PARAMS_STATIC sentinel via BenchRecord.params
+        "params": _params_fingerprint(lift_scores),
     }
     if seg_rounds is not None:
         fp["seg_rounds"] = int(seg_rounds)

@@ -12,3 +12,4 @@ from .engine import (  # noqa: F401
     on_prune,
     refresh_scores,
 )
+from .params import LIFTED_FIELD_NAMES, ScoreParams  # noqa: F401

@@ -187,8 +187,17 @@ def compute_scores(
 
     score = jnp.sum(topic * e(tp["topic_weight"]), axis=1)  # [N,K]
 
-    # topic score cap (score.go:315-317)
-    if params.topic_score_cap > 0:
+    # topic score cap (score.go:315-317). The lifted plane (round 16,
+    # score/params.py) carries the cap as a TRACED scalar, so the
+    # static elision becomes a jnp.where — value-identical at matched
+    # values (cap > 0: both paths apply the same minimum; cap == 0:
+    # the where selects the unclamped score, exactly what skipping the
+    # minimum produced). LIFT_AUDIT.json records this site as the
+    # guarded elision it is.
+    if getattr(params, "lifted", False):
+        score = jnp.where(params.topic_score_cap > 0,
+                          jnp.minimum(score, params.topic_score_cap), score)
+    elif params.topic_score_cap > 0:
         score = jnp.minimum(score, params.topic_score_cap)
 
     # P5 (score.go:320-321) — statically elided when the weight is zero

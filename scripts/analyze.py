@@ -67,7 +67,7 @@ def main(argv=None) -> int:
         guard_failures = guards.run()
         failures.extend(guard_failures)
         summary["guards"] = {
-            "engines": list(guards.ENGINES) + [guards.ENSEMBLE_ENGINE],
+            "engines": list(guards.ALL_ROWS),
             "failures": len(guard_failures),
             "updated": bool(os.environ.get("ANALYZE_UPDATE")),
         }

@@ -1,0 +1,258 @@
+"""``make hlo-audit`` — the compiled-program contract gate
+(docs/DESIGN.md §16, analysis/hloaudit.py).
+
+Audits the LOWERED StableHLO of every engine×layout build (the guards
+harness shapes, so the compile cache is shared with ``make analyze``;
+lowering is trace-only — no compile):
+
+  per_round / phase / floodsub / randomsub / csr / phase_csr / lifted
+      host-transfer-free program text, donation-marker coverage over
+      the program parameters, per-category op census, RNG
+      presence/absence contracts (floodsub must draw NOTHING).
+  dense-vs-csr tally
+      the trace-time halo-gather tally (ops/edges seams) must be EQUAL
+      between the dense and CSR builds of the same engine — the layout
+      must never change the halo budget (docs/DESIGN.md §15).
+  ragged gather bound
+      on a ragged random topology the seams lower to real gather ops,
+      so the program's gather-family census must be >= the tally (no
+      cross-peer movement outside the tally seams).
+  window scan
+      a make_window program carries its dispatch loop as
+      stablehlo.while (>= 1); the plain per-round step carries none.
+  recompile attribution
+      the attributor (hloaudit.attribute_recompile) must name EXACTLY
+      the changed static for a threshold-only config diff — and must
+      report an EMPTY diff for the same pair under the round-16 lifted
+      surface (the thresholds ride the traced plane).
+
+CPU + the gate PRNG (unsafe_rbg — RNG contracts count
+rng_bit_generator ops). Emits one JSON summary line; findings to
+stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: donation coverage floors per build (fraction of program parameters
+#: carrying donation markers; the state tree dominates the parameter
+#: list at these shapes — publish args and the lifted plane are the
+#: only non-donated inputs)
+DONATION_FLOOR = 0.5
+
+
+def _ragged_harness():
+    """A tiny RAGGED gossipsub build (random topology — no banded-roll
+    lowering, so every halo seam is a real gather op) for the
+    gather-bound leg."""
+    import jax
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.analysis.guards import EngineHarness, _pub_args
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+    from go_libp2p_pubsub_tpu.state import Net
+
+    n = 96
+    net = Net.build(graph.random_connect(n, d=6, seed=3),
+                    graph.subscribe_all(n, 1))
+    assert net.band_off is None, "random_connect should be ragged"
+    _tp, sp = bench_score_params("default", 1)
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds(),
+                                score_enabled=True)
+    st = GossipSubState.init(net, 64, cfg, score_params=sp)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    del jax
+    return EngineHarness("ragged", step, st,
+                         lambda i: _pub_args((4,), i), {})
+
+
+def _window_text():
+    """StableHLO of a small make_window program (the one-dispatch scan
+    contract)."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.analysis import guards
+    from go_libp2p_pubsub_tpu.driver import make_window
+
+    h = guards.build_engine("floodsub")
+    net = h.static_kwargs["net"]
+
+    def stepped(st, po, pt, pv):
+        from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+
+        return floodsub_step(net, st, po, pt, pv)
+
+    win = make_window(stepped)
+    d = 4
+    po = jnp.full((d, 4), -1, jnp.int32)
+    xs = (po, jnp.zeros((d, 4), jnp.int32), jnp.zeros((d, 4), bool))
+    return win.lower(h.state, xs).as_text()
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(os.path.join(REPO, ".jax_cache"))
+
+    import dataclasses as dc
+
+    from go_libp2p_pubsub_tpu.analysis import guards, hloaudit as ha
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSubConfig
+
+    failures: list[str] = []
+    report: dict = {}
+
+    cells = [
+        ("gossipsub", lambda: guards.build_engine("gossipsub"), True),
+        ("gossipsub_phase",
+         lambda: guards.build_engine("gossipsub_phase"), True),
+        ("floodsub", lambda: guards.build_engine("floodsub"), False),
+        ("randomsub", lambda: guards.build_engine("randomsub"), True),
+        ("csr", guards.build_csr_harness, True),
+        ("phase_csr", guards.build_phase_csr_harness, True),
+        ("lifted", guards.build_lifted_harness, True),
+    ]
+    tallies: dict = {}
+    for name, build, expect_rng in cells:
+        try:
+            h = build()
+            # tally_gathers traces the raw step body (cache-immune);
+            # the zero-check below is the belt-and-braces contract
+            tallies[name] = ha.tally_gathers(h)
+            text = ha.lowered_text(h)
+            if tallies[name]["total"] == 0:
+                raise ha.HloContractViolation(
+                    name, "census",
+                    "trace-time halo tally is ZERO — either the engine "
+                    "stopped routing through the ops/edges seams or the "
+                    "tally ran against a cached trace",
+                )
+            ha.check_no_host_transfer(name, text)
+            ratio = ha.check_donation_coverage(name, text, DONATION_FLOOR)
+            ha.check_rng(name, text, expect_rng)
+            census = ha.hlo_census(text)
+            report[name] = {
+                "donation_coverage": round(ratio, 3),
+                "halo_tally": tallies[name],
+                "census": {k: v for k, v in sorted(census.items())
+                           if k.startswith("cat:") or k == "while"},
+            }
+        except ha.HloContractViolation as e:
+            failures.append(str(e))
+        except Exception as e:  # noqa: BLE001 — any crash is a finding
+            failures.append(f"[{name}] audit crashed: "
+                            f"{type(e).__name__}: {str(e)[:300]}")
+
+    # dense vs CSR: the layout must not change the halo budget
+    for dense, sparse in (("gossipsub", "csr"),
+                          ("gossipsub_phase", "phase_csr")):
+        td, ts = tallies.get(dense), tallies.get(sparse)
+        if td is not None and ts is not None and td["total"] != ts["total"]:
+            failures.append(
+                f"[{sparse}] census: halo-gather tally {ts['total']} != "
+                f"dense build's {td['total']} — the edge layout changed "
+                "the halo budget (docs/DESIGN.md §15 contract)"
+            )
+    # lifted vs static: the score lift must not change the halo budget
+    tl, tg = tallies.get("lifted"), tallies.get("gossipsub")
+    if tl is not None and tg is not None and tl["total"] != tg["total"]:
+        failures.append(
+            f"[lifted] census: halo-gather tally {tl['total']} != static "
+            f"build's {tg['total']} — the traced plane added cross-peer "
+            "movement"
+        )
+
+    # ragged bound: HLO gather-family >= trace tally
+    try:
+        h = _ragged_harness()
+        tally = ha.tally_gathers(h)
+        text = ha.lowered_text(h)
+        if tally["total"] == 0:
+            raise ha.HloContractViolation(
+                "ragged", "census", "trace-time halo tally is ZERO")
+        ha.check_gather_bound("ragged", text, tally["total"])
+        report["ragged"] = {
+            "halo_tally": tally,
+            "gather_family": ha.hlo_census(text).get("cat:gather_family", 0),
+        }
+    except ha.HloContractViolation as e:
+        failures.append(str(e))
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"[ragged] audit crashed: "
+                        f"{type(e).__name__}: {str(e)[:300]}")
+
+    # window: the dispatch loop is a single top-level scan program
+    try:
+        wtext = _window_text()
+        ha.check_no_host_transfer("window", wtext)
+        n_while = ha.check_while_count("window", wtext, expect_min=1)
+        report["window"] = {"while": n_while}
+    except ha.HloContractViolation as e:
+        failures.append(str(e))
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"[window] audit crashed: "
+                        f"{type(e).__name__}: {str(e)[:300]}")
+
+    # recompile-cause attribution: a threshold diff is named under the
+    # static surface and vanishes under the lifted one
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+
+    cfg_a = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds(),
+                                  score_enabled=True)
+    cfg_b = dc.replace(cfg_a, gossip_threshold=-5.0)
+    _tp, sp_a = bench_score_params("default", 1)
+    sp_b = dc.replace(sp_a, topic_score_cap=50.0)
+    named = ha.attribute_recompile(
+        ha.static_fingerprint(cfg_a, score_params=sp_a),
+        ha.static_fingerprint(cfg_b, score_params=sp_b))
+    keys = sorted(n.split(":")[0] for n in named)
+    if keys != ["gossip_threshold", "score_params.topic_score_cap"]:
+        failures.append(
+            "[attributor] threshold+weight diff should name exactly "
+            f"the two changed statics, got {named}")
+    lifted_diff = ha.attribute_recompile(
+        ha.static_fingerprint(cfg_a, score_params=sp_a, lifted=True),
+        ha.static_fingerprint(cfg_b, score_params=sp_b, lifted=True))
+    if lifted_diff:
+        failures.append(
+            "[attributor] the lifted static surface still differs on a "
+            f"plane-carried field: {lifted_diff}")
+    report["attributor"] = {"static_diff": named, "lifted_diff": lifted_diff}
+
+    summary = {"hlo_audit": "FAIL" if failures else "PASS",
+               "cells": sorted(report), "failures": len(failures)}
+    if failures:
+        for f in failures:
+            print(f"hlo-audit FAIL: {f}", file=sys.stderr)
+    print(json.dumps(summary))
+    if os.environ.get("HLO_AUDIT_VERBOSE"):
+        print(json.dumps(report, indent=1, sort_keys=True), file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

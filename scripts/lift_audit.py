@@ -1,0 +1,87 @@
+"""``make lift-audit`` — the liftability audit gate (docs/DESIGN.md §16).
+
+Three legs, any failing exits non-zero:
+
+  1. **soundness** — every field the shipped ``score.params.ScoreParams``
+     plane lifts must be PROVEN liftable by the dataflow pass
+     (``analysis/lift.py``): verdict VALUE or VALUE_GUARDED, with at
+     least one classified use site. A SHAPE verdict on a lifted field
+     means the lift is unsound and the gate fails loudly.
+  2. **manifest parity** — the pass's ``SCORE_PLANE_FIELDS`` and the
+     plane's ``LIFTED_FIELD_NAMES`` must be identical sets, so the
+     audit and the shipped plane cannot drift apart.
+  3. **byte-identical reproduction** — the committed ``LIFT_AUDIT.json``
+     must equal this run's audit byte for byte (the MEM_AUDIT pattern:
+     the artifact is a deterministic function of the source tree).
+     ``LIFT_UPDATE=1`` rewrites it instead.
+
+Pure AST analysis — no jax import, no device, <1 s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    from go_libp2p_pubsub_tpu.analysis import lift
+    from go_libp2p_pubsub_tpu.score.params import LIFTED_FIELD_NAMES
+
+    failures: list[str] = []
+    payload = lift.audit()
+
+    failures.extend(lift.check_plane(payload["fields"]))
+
+    want = set(LIFTED_FIELD_NAMES)
+    got = set(lift.SCORE_PLANE_FIELDS)
+    if want != got:
+        failures.append(
+            "plane manifest drift: analysis/lift.py SCORE_PLANE_FIELDS "
+            f"vs score/params.py LIFTED_FIELD_NAMES — only in pass: "
+            f"{sorted(got - want)}; only in plane: {sorted(want - got)}"
+        )
+
+    path = lift.audit_path(REPO)
+    text = lift.dump_audit(payload)
+    update = bool(os.environ.get("LIFT_UPDATE"))
+    if update:
+        with open(path, "w") as f:
+            f.write(text)
+        action = "updated"
+    elif not os.path.exists(path):
+        failures.append(
+            f"{lift.AUDIT_NAME} missing — run LIFT_UPDATE=1 "
+            "scripts/lift_audit.py to record it"
+        )
+        action = "missing"
+    else:
+        with open(path) as f:
+            committed = f.read()
+        if committed != text:
+            failures.append(
+                f"{lift.AUDIT_NAME} does not reproduce byte-identical — "
+                "the device-scope sources changed the classification; "
+                "review the verdict diff and LIFT_UPDATE=1 to re-record"
+            )
+        action = "verified" if committed == text else "stale"
+
+    summary = {
+        "lift_audit": "FAIL" if failures else "PASS",
+        "artifact": action,
+        **payload["summary"],
+        "lifted_fields": len(lift.SCORE_PLANE_FIELDS),
+    }
+    if failures:
+        for f in failures:
+            print(f"lift-audit FAIL: {f}", file=sys.stderr)
+    print(json.dumps(summary))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -234,6 +234,20 @@ def artifact_execution(path: str) -> dict:
     return recs[-1].execution
 
 
+def artifact_params(path: str) -> dict:
+    """The ``params`` fingerprint block (round 16: the traced-vs-static
+    config split — which knobs rode the compiled program as the lifted
+    ScoreParams plane) of a bench artifact's last metric line; legacy
+    lines read back perf.artifacts.PARAMS_STATIC (recorded: false)."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    for rec in reversed(recs):
+        if rec.params.get("recorded"):
+            return rec.params
+    return recs[-1].params
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("tracefile")
@@ -251,6 +265,7 @@ def main():
         stats["invariants"] = artifact_invariants(args.artifact)
         stats["adversary"] = artifact_adversary(args.artifact)
         stats["execution"] = artifact_execution(args.artifact)
+        stats["params"] = artifact_params(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -302,6 +317,19 @@ def main():
                 f"(mesh {ex.get('mesh_shape')}, unroll {ex.get('unroll')}, "
                 f"check_every {ex.get('check_every')})"
             )
+    if "params" in stats:
+        pm = stats["params"]
+        if not pm.get("recorded"):
+            print("params: PARAMS_STATIC sentinel (artifact predates the "
+                  "round-16 score lift — every knob was a baked static)")
+        elif pm.get("lifted"):
+            print(
+                f"params: LIFTED — {len(pm.get('traced', []))} score "
+                "fields rode the traced ScoreParams plane "
+                "(recompile-free sweeps; LIFT_AUDIT.json has the proof)"
+            )
+        else:
+            print("params: all static (recorded; nothing lifted)")
     if "adversary" in stats:
         av = stats["adversary"]
         if av.get("enabled"):

@@ -90,6 +90,143 @@ def test_host_sync_conversion_in_traced_step_fires():
     assert sum(v.rule == "host-sync" for v in vs) == 2
 
 
+def test_traced_branch_through_alias_fires():
+    # the round-16 alias-blindness fix (shared resolver: lift.py):
+    # a Python if on a NAME assigned from a jnp expression was
+    # previously invisible to the rule
+    vs = lint("""
+        import jax.numpy as jnp
+        def step(x):
+            w = jnp.any(x > 0)
+            if w:
+                return x
+            return -x
+    """)
+    assert "traced-branch" in rules_of(vs)
+
+
+def test_traced_branch_alias_of_alias_fires():
+    vs = lint("""
+        import jax.numpy as jnp
+        def step(x):
+            y = jnp.any(x > 0)
+            w = y
+            if w:
+                return x
+            return -x
+    """)
+    assert "traced-branch" in rules_of(vs)
+
+
+def test_traced_branch_is_none_test_on_alias_ok():
+    # identity tests of a traced alias are host-level — the calibrated
+    # exception (window_g-style optional-plane plumbing)
+    vs = lint("""
+        import jax.numpy as jnp
+        def step(x, w=None):
+            w = jnp.sum(x) if w is None else w
+            if w is None:
+                return x
+            return w
+    """)
+    assert vs == []
+
+
+def test_traced_branch_shape_derived_alias_ok():
+    # shape reads of a traced array are trace-time Python ints — a
+    # branch on them is legal (bitset.pack's pad test), in both the
+    # two-statement and the inline single-expression form
+    vs = lint("""
+        import jax.numpy as jnp
+        def step(x):
+            y = jnp.asarray(x)
+            pad = y.shape[-1] % 32
+            if pad:
+                return y
+            return -y
+    """)
+    assert vs == []
+    vs = lint("""
+        import jax.numpy as jnp
+        def step(x):
+            pad = jnp.asarray(x).shape[-1] % 32
+            if pad:
+                return x
+            return -x
+    """)
+    assert vs == []
+
+
+def test_host_sync_through_alias_chain_fires():
+    # float() of an alias of a traced local — previously missed
+    vs = lint("""
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def step(state, pub):
+            y = jnp.sum(state)
+            w = y
+            return float(w)
+    """)
+    assert "host-sync" in rules_of(vs)
+
+
+def test_config_hash_through_decorator_alias_fires():
+    # `from dataclasses import dataclass as dc` previously made the
+    # class invisible to the rule (silently skipped)
+    vs = lint("""
+        from dataclasses import dataclass as dc
+        @dc
+        class FlapConfig:
+            x: int = 1
+    """)
+    assert "config-hash" in rules_of(vs)
+
+
+def test_config_hash_struct_alias_still_exempt():
+    vs = lint("""
+        from flax import struct
+        sd = struct.dataclass
+        @sd
+        class StateConfig:
+            x: int = 1
+    """)
+    assert "config-hash" not in rules_of(vs)
+
+
+def test_config_hash_struct_import_as_exempt():
+    # `from flax import struct as fs` — the dotted tail must survive
+    # the alias substitution so the struct exemption still fires
+    vs = lint("""
+        from flax import struct as fs
+        @fs.dataclass
+        class StateConfig:
+            x: int = 1
+    """)
+    assert "config-hash" not in rules_of(vs)
+
+
+def test_config_hash_frozen_through_partial_call_alias():
+    # `dc = dataclasses.dataclass(frozen=True)` carries its frozen
+    # keyword through the alias — no false 'mutable dataclass'
+    vs = lint("""
+        import dataclasses
+        dc = dataclasses.dataclass(frozen=True)
+        @dc
+        class FooConfig:
+            x: int = 1
+    """)
+    assert "config-hash" not in rules_of(vs)
+    vs = lint("""
+        import dataclasses
+        dc = dataclasses.dataclass(frozen=False)
+        @dc
+        class FooConfig:
+            x: int = 1
+    """)
+    assert "config-hash" in rules_of(vs)
+
+
 def test_host_sync_static_conversion_ok():
     # float()/int() of closure statics inside a traced step are
     # trace-time constants, not per-call syncs
@@ -525,3 +662,47 @@ def test_schema_engines_complete():
     baseline = guards.load_baseline(ROOT)
     assert baseline is not None
     assert set(baseline["engines"]) == set(guards.ENGINES)
+
+
+# ---------------------------------------------------------------------------
+# the declarative row registry (round 16): every derived harness is one
+# registry line; the new lifted-score and phase+csr rows are present
+# and their builders/runners resolve
+
+
+def test_guard_registry_rows():
+    names = [r.name for r in guards.DERIVED_ROWS]
+    assert names == ["ensemble", "telemetry", "csr", "phase_csr", "lifted"]
+    for row in guards.DERIVED_ROWS:
+        assert callable(getattr(guards, row.runner)), row.runner
+        assert row.base in guards.ENGINES, row
+    assert guards.ALL_ROWS == tuple(guards.ENGINES) + tuple(names)
+
+
+def test_lifted_plane_pair_distinct():
+    import numpy as np
+
+    pa, pb = guards.lifted_plane_pair()
+    # the A/B sentinel is vacuous unless the two planes differ on every
+    # surface the lift exists to sweep
+    for leaf in ("w2", "behaviour_penalty_weight", "gossip_threshold",
+                 "publish_threshold", "topic_score_cap"):
+        assert not np.array_equal(np.asarray(getattr(pa, leaf)),
+                                  np.asarray(getattr(pb, leaf))), leaf
+
+
+def test_lifted_schema_must_equal_base():
+    # seeded negative: a state tree that differs from the base rows
+    # trips the equal-base schema check with the lifted message
+    h = _harness(lambda s, a: {"x": s["x"], "extra": jnp.zeros((2,))},
+                 {"x": jnp.zeros((4,), jnp.int32)})
+    out = jax.eval_shape(lambda s: h.jit_fn(s, jnp.ones((4,), jnp.int32)),
+                         h.state)
+    base_rows = [{"path": "['x']", "dtype": "int32", "shape": [4],
+                  "weak_type": False}]
+    with pytest.raises(GuardViolation) as ei:
+        guards.check_schema_equal(h, out, base_rows, "gossipsub",
+                                  "the lifted score plane leaked into "
+                                  "the state tree")
+    assert ei.value.guard == "schema"
+    assert "leaked" in str(ei.value)

@@ -1,0 +1,187 @@
+"""Compiled-program contract tests (analysis/hloaudit.py, docs/
+DESIGN.md §16): every contract must FIRE on doctored HLO text
+(negative — the PR-4/PR-7 pattern), the attributor must name exactly
+the changed static, and one real build must pass end-to-end."""
+
+import dataclasses as dc
+
+import pytest
+
+from go_libp2p_pubsub_tpu.analysis import hloaudit as ha
+from go_libp2p_pubsub_tpu.analysis.hloaudit import HloContractViolation
+
+CLEAN = """
+module @jit_step {
+  func.func public @main(%arg0: tensor<4xi32> {tf.aliasing_output = 0 : i32},
+                         %arg1: tensor<4xi32> {tf.aliasing_output = 1 : i32}) -> tensor<4xi32> {
+    %0 = stablehlo.gather %arg0 : tensor<4xi32>
+    %1 = stablehlo.reduce %0 : tensor<4xi32>
+    %2 = stablehlo.rng_bit_generator %1 : tensor<4xi32>
+    return %2 : tensor<4xi32>
+  }
+}
+"""
+
+
+# ---------------------------------------------------------------------------
+# negatives: corrupt one thing, assert the exact contract trips
+
+
+def test_host_transfer_infeed_fires():
+    doctored = CLEAN.replace("stablehlo.reduce", "stablehlo.infeed")
+    with pytest.raises(HloContractViolation) as ei:
+        ha.check_no_host_transfer("broken", doctored)
+    assert ei.value.contract == "host-transfer"
+
+
+def test_host_transfer_callback_fires():
+    doctored = CLEAN + (
+        '\n%9 = stablehlo.custom_call @x(%arg0) '
+        '{call_target_name = "xla_python_cpu_callback"}\n'
+    )
+    with pytest.raises(HloContractViolation) as ei:
+        ha.check_no_host_transfer("broken", doctored)
+    assert ei.value.contract == "host-transfer"
+
+
+def test_donation_coverage_fires_on_stripped_markers():
+    doctored = CLEAN.replace(" {tf.aliasing_output = 0 : i32}", "").replace(
+        " {tf.aliasing_output = 1 : i32}", "")
+    with pytest.raises(HloContractViolation) as ei:
+        ha.check_donation_coverage("broken", doctored, 0.5)
+    assert ei.value.contract == "donation"
+    # the clean text passes the same floor
+    assert ha.check_donation_coverage("ok", CLEAN, 0.5) == 1.0
+
+
+def test_rng_contract_fires_both_directions():
+    with pytest.raises(HloContractViolation) as ei:
+        ha.check_rng("floodsub-like", CLEAN, expect_rng=False)
+    assert ei.value.contract == "rng"
+    no_rng = CLEAN.replace("stablehlo.rng_bit_generator", "stablehlo.abs")
+    with pytest.raises(HloContractViolation) as ei:
+        ha.check_rng("gossipsub-like", no_rng, expect_rng=True)
+    assert ei.value.contract == "rng"
+    ha.check_rng("ok", CLEAN, expect_rng=True)
+
+
+def test_gather_bound_fires():
+    with pytest.raises(HloContractViolation) as ei:
+        ha.check_gather_bound("broken", CLEAN, n_tally=5)
+    assert ei.value.contract == "census"
+    ha.check_gather_bound("ok", CLEAN, n_tally=1)
+
+
+def test_while_contract_fires():
+    with pytest.raises(HloContractViolation) as ei:
+        ha.check_while_count("window", CLEAN, expect_min=1)
+    assert ei.value.contract == "scan"
+    scanned = CLEAN + "\n%8 = stablehlo.while %arg0\n"
+    assert ha.check_while_count("window", scanned, expect_min=1) == 1
+    with pytest.raises(HloContractViolation):
+        ha.check_while_count("step", scanned, expect_min=0, expect_max=0)
+
+
+def test_census_categories():
+    c = ha.hlo_census(CLEAN)
+    assert c["cat:gather_family"] == 1
+    assert c["cat:reduction"] == 1
+    assert c["cat:rng"] == 1
+
+
+# ---------------------------------------------------------------------------
+# recompile-cause attribution
+
+
+def test_attributor_names_the_changed_static():
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSubConfig
+
+    cfg_a = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds(),
+                                  score_enabled=True)
+    cfg_b = dc.replace(cfg_a, gossip_threshold=-5.0, Dlazy=8)
+    named = ha.attribute_recompile(ha.static_fingerprint(cfg_a),
+                                   ha.static_fingerprint(cfg_b))
+    keys = [n.split(":")[0] for n in named]
+    assert keys == ["Dlazy", "gossip_threshold"]
+    # under the lifted surface the threshold is a traced input — only
+    # the mesh knob remains a recompile cause
+    named_l = ha.attribute_recompile(
+        ha.static_fingerprint(cfg_a, lifted=True),
+        ha.static_fingerprint(cfg_b, lifted=True))
+    assert [n.split(":")[0] for n in named_l] == ["Dlazy"]
+    # identical builds: empty diff
+    assert ha.attribute_recompile(ha.static_fingerprint(cfg_a),
+                                  ha.static_fingerprint(cfg_a)) == []
+
+
+def test_attributor_sees_baked_score_params():
+    # the engines close over score_params as trace constants — a
+    # weight-only change IS a recompile cause on the static path, and
+    # must vanish under the lifted surface
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSubConfig
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds(),
+                                score_enabled=True)
+    _tp, sp_a = bench_score_params("default", 1)
+    sp_b = dc.replace(sp_a, topic_score_cap=50.0)
+    named = ha.attribute_recompile(
+        ha.static_fingerprint(cfg, score_params=sp_a),
+        ha.static_fingerprint(cfg, score_params=sp_b))
+    assert [n.split(":")[0] for n in named] == [
+        "score_params.topic_score_cap"]
+    # a per-topic weight change too
+    tp_b = dc.replace(_tp, first_message_deliveries_weight=2.0)
+    sp_c = dc.replace(sp_a, topics={0: tp_b})
+    named = ha.attribute_recompile(
+        ha.static_fingerprint(cfg, score_params=sp_a),
+        ha.static_fingerprint(cfg, score_params=sp_c))
+    assert named and all(n.startswith("score_params.topics.0.")
+                         for n in named)
+    # both vanish under the lifted surface
+    assert ha.attribute_recompile(
+        ha.static_fingerprint(cfg, score_params=sp_a, lifted=True),
+        ha.static_fingerprint(cfg, score_params=sp_c, lifted=True)) == []
+
+
+def test_attributor_sees_net_meta():
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.config import GossipSubParams
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSubConfig
+    from go_libp2p_pubsub_tpu.state import Net
+
+    cfg = GossipSubConfig.build(GossipSubParams())
+    net_a = Net.build(graph.ring_lattice(64, d=4),
+                      graph.subscribe_all(64, 1))
+    net_b = Net.build(graph.ring_lattice(64, d=4),
+                      graph.subscribe_all(64, 1), edge_layout="csr")
+    named = ha.attribute_recompile(ha.static_fingerprint(cfg, net_a),
+                                   ha.static_fingerprint(cfg, net_b))
+    assert any(n.startswith("net.edge_layout") for n in named)
+
+
+# ---------------------------------------------------------------------------
+# one real build end-to-end (small — shares the guards shapes)
+
+
+def test_floodsub_hlo_contracts_end_to_end():
+    from go_libp2p_pubsub_tpu.analysis import guards
+
+    h = guards.build_engine("floodsub")
+    tally = ha.tally_gathers(h)  # cache-immune: traces the raw body
+    text = ha.lowered_text(h)
+    assert tally["total"] >= 1
+    ha.check_no_host_transfer("floodsub", text)
+    ratio = ha.check_donation_coverage("floodsub", text, 0.5)
+    assert 0.5 <= ratio <= 1.0
+    # floodsub draws no randomness — the reference defines it without
+    ha.check_rng("floodsub", text, expect_rng=False)
+    ha.check_while_count("floodsub", text, expect_min=0, expect_max=0)

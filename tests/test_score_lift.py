@@ -1,0 +1,327 @@
+"""Score-lift parity + recompile-free sentinels (round 16, docs/
+DESIGN.md §16): the lifted engines must reproduce the static builds
+BIT-EXACTLY at matched values on all four engines (phase at r in
+{1, 8}), one compiled program must serve >= 2 distinct weight sets,
+the stacked-plane ensemble sweep must equal its per-plane runs, and
+the params fingerprint block must round-trip."""
+
+import dataclasses as dc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.checkpoint import is_prng_key
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreThresholds,
+)
+from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+    make_gossipsub_phase_step,
+)
+from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+from go_libp2p_pubsub_tpu.score.params import ScoreParams
+from go_libp2p_pubsub_tpu.state import Net, SimState
+
+N, M, K_D = 96, 64, 8
+
+
+def build_net():
+    return Net.build(graph.ring_lattice(N, d=K_D),
+                     graph.subscribe_all(N, 1))
+
+
+def build_cfg(heartbeat_every=1):
+    # the sybil parameterization: every score plane live (P3 deficit,
+    # P4, P7), so the phase engine's static elision keeps all
+    # attribution planes on BOTH sides of the parity compare
+    _tp, sp = bench_score_params("sybil", 1)
+    cfg = GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True,
+        heartbeat_every=heartbeat_every,
+    )
+    return cfg, sp
+
+
+def assert_trees_equal(a, b, context=""):
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = {jax.tree_util.keystr(p): leaf
+          for p, leaf in jax.tree_util.tree_flatten_with_path(b)[0]}
+    assert len(la) == len(lb), f"{context}: leaf count differs"
+    for p, x in la:
+        k = jax.tree_util.keystr(p)
+        y = lb[k]
+        if is_prng_key(x):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"{context}: leaf {k}")
+
+
+def pub(i, r=None, width=4):
+    po = np.full((width,), -1, np.int32)
+    po[0] = i % N
+    args = [po, np.zeros((width,), np.int32), np.ones((width,), bool)]
+    if r:
+        args = [np.broadcast_to(a, (r,) + a.shape).copy() for a in args]
+    return tuple(jnp.asarray(a) for a in args)
+
+
+def second_plane():
+    """A plane moving EVERY lifted surface away from the bench values."""
+    tp_a, sp_a = bench_score_params("sybil", 1)
+    tp_b = dc.replace(
+        tp_a, first_message_deliveries_weight=2.0,
+        mesh_message_deliveries_weight=-0.25, time_in_mesh_weight=0.5,
+        invalid_message_deliveries_weight=-0.5,
+    )
+    sp_b = dc.replace(sp_a, topics={0: tp_b}, behaviour_penalty_weight=-2.0,
+                      topic_score_cap=50.0)
+    thr_b = PeerScoreThresholds(
+        gossip_threshold=-4.0, publish_threshold=-20.0,
+        graylist_threshold=-40.0, accept_px_threshold=5.0,
+        opportunistic_graft_threshold=10.0,
+    )
+    return ScoreParams.build(sp_b, thr_b, 1)
+
+
+# ---------------------------------------------------------------------------
+# bit-exact parity at matched values, all four engines
+
+
+def _gossipsub_parity(rounds=12):
+    net = build_net()
+    cfg, sp = build_cfg()
+    plane = ScoreParams.from_config(cfg, sp, 1)
+    st_s = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    st_l = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    step_s = make_gossipsub_step(cfg, net, score_params=sp)
+    step_l = make_gossipsub_step(cfg, net, score_params=sp,
+                                 lift_scores=True)
+    for i in range(rounds):
+        st_s = step_s(st_s, *pub(i))
+        st_l = step_l(st_l, *pub(i), plane)
+    return st_s, st_l, step_l, plane
+
+
+def test_gossipsub_lifted_parity():
+    st_s, st_l, _, _ = _gossipsub_parity()
+    assert_trees_equal(st_s, st_l, "gossipsub per-round lifted-vs-static")
+
+
+@pytest.mark.parametrize(
+    "r", [1, pytest.param(8, marks=pytest.mark.slow)])
+def test_phase_lifted_parity(r):
+    net = build_net()
+    cfg, sp = build_cfg(heartbeat_every=max(r, 1))
+    plane = ScoreParams.from_config(cfg, sp, 1)
+    st_s = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    st_l = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    ph_s = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+    ph_l = make_gossipsub_phase_step(cfg, net, r, score_params=sp,
+                                     lift_scores=True)
+    for i in range(3):
+        st_s = ph_s(st_s, *pub(i, r), do_heartbeat=True)
+        st_l = ph_l(st_l, *pub(i, r), plane, do_heartbeat=True)
+    assert_trees_equal(st_s, st_l, f"phase r={r} lifted-vs-static")
+
+
+def test_floodsub_plane_seam_parity():
+    net = build_net()
+    st_a = SimState.init(N, M, k=net.max_degree)
+    st_b = SimState.init(N, M, k=net.max_degree)
+    plane = second_plane()
+    for i in range(6):
+        st_a = floodsub_step(net, st_a, *pub(i))
+        st_b = floodsub_step(net, st_b, *pub(i), score_plane=plane)
+    assert_trees_equal(st_a, st_b, "floodsub plane seam")
+
+
+def test_randomsub_plane_seam_parity():
+    net = build_net()
+    st_a = SimState.init(N, M, k=net.max_degree)
+    st_b = SimState.init(N, M, k=net.max_degree)
+    plane = second_plane()
+    step = make_randomsub_step(net)
+    step_l = make_randomsub_step(net, lift_scores=True)
+    for i in range(6):
+        st_a = step(st_a, *pub(i))
+        st_b = step_l(st_b, *pub(i), plane)
+    assert_trees_equal(st_a, st_b, "randomsub plane seam")
+
+
+# ---------------------------------------------------------------------------
+# the recompile-free sentinel: one compile across >= 2 weight sets
+
+
+def test_one_compile_across_weight_sets():
+    _, st_l, step_l, plane = _gossipsub_parity(rounds=2)
+    plane_b = second_plane()
+    before = step_l._cache_size()
+    st = st_l
+    for i in range(4):
+        st = step_l(st, *pub(i), plane if i % 2 == 0 else plane_b)
+    assert step_l._cache_size() == before, (
+        "a weight-set change recompiled the lifted step"
+    )
+    assert step_l._cache_size() == 1
+
+
+def test_lifted_values_actually_differ():
+    # the A/B sentinel must not pass because the plane is ignored:
+    # different thresholds/weights must CHANGE the trajectory
+    net = build_net()
+    cfg, sp = build_cfg()
+    plane_a = ScoreParams.from_config(cfg, sp, 1)
+    plane_b = second_plane()
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               lift_scores=True)
+    st_a = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    st_b = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    for i in range(10):
+        st_a = step(st_a, *pub(i), plane_a)
+        st_b = step(st_b, *pub(i), plane_b)
+    # P1 weight differs (1.0 vs 0.5): held scores must diverge
+    assert not np.array_equal(np.asarray(st_a.scores),
+                              np.asarray(st_b.scores))
+
+
+# ---------------------------------------------------------------------------
+# configs×sims: a stacked plane axis sweeps weight sets in ONE program
+
+
+def test_stacked_plane_ensemble_sweep():
+    from go_libp2p_pubsub_tpu.ensemble import batch as ebatch
+
+    net = build_net()
+    cfg, sp = build_cfg()
+    plane_a = ScoreParams.from_config(cfg, sp, 1)
+    plane_b = second_plane()
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               lift_scores=True)
+    base = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    base_key = base.core.key
+    states = ebatch.batch_states(base, 2)
+    planes = ebatch.stack_planes([plane_a, plane_b])
+    ens = ebatch.lift_step(step)
+    for i in range(6):
+        args = tuple(ebatch.tile(a, 2) for a in pub(i))
+        states = ens(states, *args, planes)
+    assert ens._cache_size() == 1
+    # row i == the single-sim run with plane i (threefry vmaps
+    # bit-exactly — the ensemble plane's standing parity contract)
+    for idx, plane in ((0, plane_a), (1, plane_b)):
+        st = ebatch.with_sim_key(
+            GossipSubState.init(net, M, cfg, score_params=sp, seed=0),
+            base_key, idx)
+        for i in range(6):
+            st = step(st, *pub(i), plane)
+        assert_trees_equal(ebatch.unbatch(states, idx), st,
+                           f"sweep row {idx}")
+
+
+def test_lift_floodsub_plane_slot():
+    # the uniform trailing-plane slot for configs×sims sweeps: the
+    # lift_floodsub adapter routes the last positional to floodsub's
+    # keyword-only score_plane seam (inert — parity vs the plain lift)
+    from go_libp2p_pubsub_tpu.ensemble import batch as ebatch
+
+    net = build_net()
+    base = SimState.init(N, M, k=net.max_degree)
+    states_a = ebatch.batch_states(base, 2)
+    states_b = ebatch.batch_states(base, 2)
+    planes = ebatch.stack_planes([second_plane(), second_plane()])
+    ens_plain = ebatch.lift_floodsub(net)
+    ens_lift = ebatch.lift_floodsub(net, lift_scores=True)
+    for i in range(4):
+        args = tuple(ebatch.tile(a, 2) for a in pub(i))
+        states_a = ens_plain(states_a, *args)
+        states_b = ens_lift(states_b, *args, planes)
+    assert ens_lift._cache_size() == 1
+    assert_trees_equal(states_a, states_b, "lift_floodsub plane slot")
+
+
+def test_stack_planes_rejects_static_field_mismatch():
+    from go_libp2p_pubsub_tpu.ensemble import batch as ebatch
+
+    _tp, sp = bench_score_params("sybil", 1)
+    pa = ScoreParams.build(sp, PeerScoreThresholds(), 1)
+    sp_b = dc.replace(sp, app_specific_weight=1.0, skip_app_specific=True)
+    pb = ScoreParams.build(sp_b, PeerScoreThresholds(), 1)
+    with pytest.raises(ValueError, match="app_specific_weight"):
+        ebatch.stack_planes([pa, pb])
+
+
+# ---------------------------------------------------------------------------
+# scanned windows: the plane rides make_window/make_scan `consts`
+
+
+def test_scanned_window_lifted_parity():
+    from go_libp2p_pubsub_tpu.driver import make_scan
+
+    net = build_net()
+    cfg, sp = build_cfg()
+    plane = ScoreParams.from_config(cfg, sp, 1)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               lift_scores=True)
+    rounds = 8
+    po = np.full((rounds, 4), -1, np.int32)
+    po[:, 0] = np.arange(rounds) % N
+    pt = np.zeros((rounds, 4), np.int32)
+    pv = np.ones((rounds, 4), bool)
+    po_j, pt_j, pv_j = jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+    st_loop = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    for i in range(rounds):
+        st_loop = step(st_loop, jnp.asarray(po[i]), jnp.asarray(pt[i]),
+                       jnp.asarray(pv[i]), plane)
+
+    scan = make_scan(step, heartbeat_every=1, rounds_per_phase=1,
+                     static_heartbeat=False)
+    st_scan = scan(GossipSubState.init(net, M, cfg, score_params=sp, seed=0),
+                   po_j, pt_j, pv_j, None, (plane,))
+    assert_trees_equal(st_loop, st_scan, "scanned lifted window")
+
+    # the SAME compiled window serves a different weight set
+    before = scan._cache_size()
+    scan(GossipSubState.init(net, M, cfg, score_params=sp, seed=0),
+         po_j, pt_j, pv_j, None, (second_plane(),))
+    assert scan._cache_size() == before == 1
+
+
+# ---------------------------------------------------------------------------
+# artifact self-description
+
+
+def test_params_fingerprint_round_trip():
+    from go_libp2p_pubsub_tpu.perf import artifacts, sweep
+    from go_libp2p_pubsub_tpu.score.params import LIFTED_FIELD_NAMES
+
+    fp = sweep.workload_fingerprint("default", 1000, 64, 1, 1,
+                                    lift_scores=True)
+    assert fp["params"]["lifted"] is True
+    assert fp["params"]["traced"] == sorted(LIFTED_FIELD_NAMES)
+    rec = artifacts.record_from_line({
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 0.0,
+        "schema": 3, "fingerprint": fp,
+    })
+    assert rec.params_lifted
+    assert rec.params["recorded"] is True
+    # static builds record the split explicitly
+    fp_s = sweep.workload_fingerprint("default", 1000, 64, 1, 1)
+    assert fp_s["params"] == {"recorded": True, "lifted": False,
+                              "traced": []}
+    # legacy lines read back the PARAMS_STATIC sentinel
+    legacy = artifacts.record_from_line({
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 0.0,
+    })
+    assert legacy.params == artifacts.PARAMS_STATIC
+    assert not legacy.params_lifted
