@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -133,6 +133,23 @@ mem-audit:
 scale-smoke:
 	python scripts/scale_smoke.py
 
+# supervised-service-loop gate (scripts/service_smoke.py; docs/
+# DESIGN.md §17): the always-on recovery contract — a supervised run
+# (chaos + health probes + folded invariants) survives (1) SIGKILL at
+# a randomized seeded point INCLUDING mid-checkpoint-write, (2) a
+# truncated latest checkpoint (manifest fallback), and (3) an injected
+# NaN state leaf (rollback + per-dispatch replay naming the exact
+# violating dispatch) — in all three cases recovering/resuming to a
+# final-state digest bit-exact vs the uninterrupted control; plus the
+# one-compile-per-window-shape sentinel, heartbeat freshness, the
+# supervision-overhead ceiling (<= 10% warm-vs-warm over a bare
+# segmented WindowRunner; SERVICE_SMOKE_OVERHEAD overrides) and the
+# chaos-off census == on-image baseline (probes-off supervision adds
+# ZERO device ops). SERVICE_SMOKE_UPDATE=1 rewrites the committed
+# SERVICE_SMOKE.json rates. ~2 min warm on CPU.
+service-smoke:
+	python scripts/service_smoke.py --smoke
+
 # liftability audit (scripts/lift_audit.py; docs/DESIGN.md §16): the
 # interprocedural SHAPE/VALUE dataflow pass over every *Config /
 # score-parameter read in the device scope — proves which knobs may
@@ -197,6 +214,7 @@ quick:
 	python scripts/hlo_audit.py
 	python scripts/memstat.py
 	python scripts/scale_smoke.py
+	python scripts/service_smoke.py --smoke
 
 native:
 	$(MAKE) -C native

@@ -1483,23 +1483,36 @@ class Network:
         self._drain_deliveries(prev, new)
 
     def run(self, rounds: int = 1, checkpoint_every: int | None = None,
-            checkpoint_path: str | None = None) -> None:
+            checkpoint_path: str | None = None, keep_last: int = 1,
+            keep_every: int = 0) -> None:
         """Advance the simulation; distributes queued publishes over the
         first rounds (pub_width per round) and drains deliveries into
         subscriptions after each round.
 
         ``checkpoint_every=k, checkpoint_path=p`` auto-snapshots the
         DEVICE state through the npz checkpoint backend every k simulated
-        rounds (atomically overwriting ``p``), so long soaks — chaos
-        runs especially — are resumable after a host crash:
-        ``load_checkpoint(p)`` on an identically-built Network restores
-        the snapshot, and the resumed run continues the exact PRNG —
-        and therefore the exact chaos fault — stream (the generators are
-        functions of (key, tick), both in the snapshot; a GE chain's
-        state plane rides the pytree). In phase mode the snapshot
-        cadence quantizes up to phase boundaries. Host-side observation
-        state (subscription queues, trace sessions, message-id maps) is
-        NOT in the snapshot — resume on a freshly built Network."""
+        rounds, so long soaks — chaos runs especially — are resumable
+        after a host crash: ``load_checkpoint(p)`` on an identically-
+        built Network restores the snapshot, and the resumed run
+        continues the exact PRNG — and therefore the exact chaos fault —
+        stream (the generators are functions of (key, tick), both in the
+        snapshot; a GE chain's state plane rides the pytree).
+
+        With the default ``keep_last=1, keep_every=0`` the snapshot
+        atomically overwrites the single file ``p`` (the pre-round-17
+        behavior). ``keep_last=k`` and/or ``keep_every=m`` instead treat
+        ``p`` as a DIRECTORY driven by the same rolling
+        ``serve.store.CheckpointStore`` the supervised service loop
+        uses — checksummed snapshots, a manifest, the last k always
+        retained plus every m-th pinned forever, and
+        ``load_checkpoint(p)`` restoring the newest uncorrupted entry
+        (falling back past damaged files) — multi-snapshot durability
+        for API-layer soaks, for free.
+
+        In phase mode the snapshot cadence quantizes up to phase
+        boundaries. Host-side observation state (subscription queues,
+        trace sessions, message-id maps) is NOT in the snapshot — resume
+        on a freshly built Network."""
         # argument validation precedes start(): a bad call must not have
         # the irreversible side effect of compiling/freezing the topology
         if (checkpoint_every is None) != (checkpoint_path is None):
@@ -1509,6 +1522,11 @@ class Network:
             )
         if checkpoint_every is not None and checkpoint_every < 1:
             raise APIError("checkpoint_every must be >= 1")
+        if keep_last < 1 or keep_every < 0:
+            raise APIError(
+                "keep_last must be >= 1 and keep_every >= 0 "
+                f"(got keep_last={keep_last}, keep_every={keep_every})")
+        self._ckpt_retention = (int(keep_last), int(keep_every))
         if not self.started:
             self.start()
         if checkpoint_every is not None and not hasattr(self, "_last_ckpt_tick"):
@@ -1683,15 +1701,36 @@ class Network:
     def _maybe_checkpoint(self, every: int | None, path: str | None) -> None:
         """Auto-snapshot support for run(): save when >= ``every`` rounds
         of simulated time have passed since the last snapshot (phase mode
-        quantizes the cadence up to phase boundaries)."""
+        quantizes the cadence up to phase boundaries). A non-default
+        retention (run(keep_last=/keep_every=)) routes through the
+        rolling checkpoint store instead of the single-file overwrite."""
         if every is None:
             return
         tick = int(getattr(self.state, "core", self.state).tick)
         last = getattr(self, "_last_ckpt_tick", None)
         if last is not None and tick - last < every:
             return
-        self.save_checkpoint(path)
+        keep_last, keep_every = getattr(self, "_ckpt_retention", (1, 0))
+        if keep_last == 1 and keep_every == 0:
+            self.save_checkpoint(path)
+        else:
+            self._checkpoint_store(path, keep_last, keep_every).save(
+                self.state, tick=tick)
         self._last_ckpt_tick = tick
+
+    def _checkpoint_store(self, path: str, keep_last: int,
+                          keep_every: int):
+        """The lazily-built rolling store for retention-mode snapshots
+        (one per Network; rebuilt if the retention pair changes)."""
+        from .serve.store import CheckpointStore, RetentionPolicy
+
+        policy = RetentionPolicy(keep_last=keep_last, keep_every=keep_every)
+        store = getattr(self, "_ckpt_store", None)
+        if (store is None or store.root != str(path)
+                or store.policy != policy):
+            store = CheckpointStore(path, policy)
+            self._ckpt_store = store
+        return store
 
     def save_checkpoint(self, path: str) -> str:
         """Snapshot the device state through the npz checkpoint backend,
@@ -1716,17 +1755,34 @@ class Network:
         the network must be built and started with the same configs and
         topology — mismatches raise with the offending pytree paths).
 
+        ``path`` may also be a retention-mode store DIRECTORY (a run
+        with ``keep_last``/``keep_every``): the newest uncorrupted
+        manifest entry is restored, falling back past damaged snapshots
+        exactly like the supervised loop does.
+
         Only the device state is restored: the PRNG key and tick come
         with it, so the continued run replays the exact random — and
         chaos-fault — stream of an uninterrupted one. Host-side message
         bodies and trace sessions are not part of the snapshot; restore
         into a fresh Network when those matter."""
+        import os as _os
+
         from . import checkpoint as _ckpt
 
         if not self.started:
             raise APIError("load_checkpoint before start(): build the "
                            "template state first")
-        self.state = _ckpt.restore(path, self.state)
+        if _os.path.isdir(path):
+            from .serve.store import CheckpointStore
+
+            st, entry = CheckpointStore(path).restore_latest(self.state)
+            if st is None:
+                raise APIError(
+                    f"load_checkpoint({path!r}): the checkpoint store "
+                    "holds no loadable snapshot")
+            self.state = st
+        else:
+            self.state = _ckpt.restore(path, self.state)
         self._last_ckpt_tick = int(
             getattr(self.state, "core", self.state).tick
         )

@@ -19,9 +19,14 @@ Two backends:
 
 from __future__ import annotations
 
+import logging
+import zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 # v2: Delivery.first_edge [N,M] i8 replaced by packed fe_words [N,K,W] u32
 # v3: MsgTable grew the `ignored` verdict plane (ValidationIgnore)
@@ -47,7 +52,46 @@ import numpy as np
 #     pre-round-13 snapshot restoring into a new template fails the
 #     leaf-SHAPE check with the `.events` path named — the format itself
 #     is pytree-generic, so no version bump.
+#     Round 17 (service loop) keeps v6 and adds an INTEGRITY layer to
+#     the envelope, written backward-compatibly: `__header_len__` (the
+#     member count the writer emitted — a truncated member table is
+#     detected before any leaf is read), a `__crc32__` vector (one CRC32
+#     per leaf, over the raw bytes) and `__header_crc__` (CRC32 of the
+#     canonical header string + the crc vector). Readers of snapshots
+#     that predate the layer log a "no checksum" note and proceed;
+#     corruption now raises the typed CheckpointCorrupt error naming
+#     the failing section instead of a raw deserialization traceback
+#     (serve/store.py falls back to the previous manifest entry on it).
 _FORMAT_VERSION = 6
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint file failed an integrity check (truncated container,
+    bit-flipped member, CRC mismatch). ``section`` names what failed —
+    ``"container"``, ``"header"``, ``"member table"`` or the pytree path
+    of the damaged leaf — so the supervisor's fallback (and a human) can
+    tell corruption apart from a template mismatch, which stays a plain
+    ValueError."""
+
+    def __init__(self, path, section: str, detail: str = ""):
+        self.path = str(path)
+        self.section = section
+        msg = f"corrupt checkpoint {self.path}: {section}"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+
+
+def _crc(arr) -> int:
+    """CRC32 over a numpy array's raw bytes (the unit of the envelope's
+    per-leaf integrity vector)."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _header_crc(version: int, n_leaves: int, header_len: int,
+                crcs: np.ndarray) -> int:
+    canon = f"v{version};n{n_leaves};m{header_len};".encode()
+    return zlib.crc32(canon + np.ascontiguousarray(crcs).tobytes()) & 0xFFFFFFFF
 
 
 def is_prng_key(leaf) -> bool:
@@ -61,18 +105,30 @@ def is_prng_key(leaf) -> bool:
 _is_key = is_prng_key
 
 
-def save(path: str, state) -> None:
-    """Write the state pytree to a compressed .npz."""
+def save(path: str, state, *, compress: bool = True) -> None:
+    """Write the state pytree to an .npz with the round-17 integrity
+    layer (per-leaf CRC32 vector + header length + header CRC — see the
+    version history above). ``compress=False`` trades disk for write
+    throughput (the supervised loop's rolling store uses it — the
+    per-leaf CRCs carry the integrity either way)."""
     leaves = jax.tree_util.tree_leaves(state)
     out = {"__version__": np.int64(_FORMAT_VERSION),
            "__n_leaves__": np.int64(len(leaves))}
+    crcs = np.zeros(len(leaves), np.uint32)
     for i, leaf in enumerate(leaves):
         if _is_key(leaf):
             out[f"leaf_{i}"] = np.asarray(jax.random.key_data(leaf))
             out[f"leaf_{i}__is_key"] = np.bool_(True)
         else:
             out[f"leaf_{i}"] = np.asarray(leaf)
-    np.savez_compressed(path, **out)
+        crcs[i] = _crc(out[f"leaf_{i}"])
+    out["__crc32__"] = crcs
+    # member count INCLUDING the three integrity entries themselves
+    header_len = len(out) + 2
+    out["__header_len__"] = np.int64(header_len)
+    out["__header_crc__"] = np.uint32(
+        _header_crc(_FORMAT_VERSION, len(leaves), header_len, crcs))
+    (np.savez_compressed if compress else np.savez)(path, **out)
 
 
 def _leaf_paths(template) -> list[str]:
@@ -84,36 +140,121 @@ def _leaf_paths(template) -> list[str]:
     return [jax.tree_util.keystr(path) or "<root>" for path, _ in flat]
 
 
+def _open_envelope(path: str):
+    """np.load with container-level failures mapped to the typed error
+    (a missing file stays FileNotFoundError — absence is not damage)."""
+    try:
+        return np.load(path)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            path, "container", f"{type(e).__name__}: {e}") from e
+
+
+def _read_member(data, name: str, path: str, section: str):
+    """One npz member, with decompression/CRC failures (a bit-flipped
+    or truncated member) mapped to CheckpointCorrupt naming ``section``."""
+    try:
+        return data[name]
+    except KeyError:
+        raise CheckpointCorrupt(
+            path, "member table", f"missing member {name}") from None
+    except Exception as e:
+        raise CheckpointCorrupt(
+            path, section, f"{type(e).__name__}: {e}") from e
+
+
+def _validate_header(data, path: str):
+    """Shared header validation for :func:`restore` / :func:`verify`.
+
+    Returns ``(version, n_leaves, crcs_or_None)``; ``crcs`` is None for
+    snapshots predating the integrity layer (a "no checksum" note is
+    logged — they load unverified, backward-compatibly)."""
+    if "__version__" not in data.files or "__n_leaves__" not in data.files:
+        raise ValueError(f"{path} is not a go_libp2p_pubsub_tpu checkpoint")
+    version = int(_read_member(data, "__version__", path, "header"))
+    if version != _FORMAT_VERSION:
+        if version < _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format v{version} predates the current "
+                f"v{_FORMAT_VERSION} (state leaves changed shape/"
+                "meaning — see the version history at the top of "
+                "checkpoint.py; v6 grew the event-counter vector with "
+                "the chaos-plane counters and added the optional "
+                "Gilbert–Elliott generator state); re-create the "
+                "checkpoint from source state — no migration path is "
+                "provided"
+            )
+        raise ValueError(
+            f"checkpoint format v{version} is newer than this build's "
+            f"v{_FORMAT_VERSION}"
+        )
+    n = int(_read_member(data, "__n_leaves__", path, "header"))
+    if "__header_len__" in data.files:
+        want = int(_read_member(data, "__header_len__", path, "header"))
+        if len(data.files) != want:
+            raise CheckpointCorrupt(
+                path, "member table",
+                f"{len(data.files)} members on disk != {want} written "
+                "(truncated container)")
+    if "__crc32__" not in data.files:
+        _log.info(
+            "checkpoint %s predates the integrity layer (no checksum) — "
+            "loading unverified", path)
+        return version, n, None
+    crcs = np.asarray(
+        _read_member(data, "__crc32__", path, "header"), np.uint32)
+    if crcs.shape != (n,):
+        raise CheckpointCorrupt(
+            path, "header",
+            f"crc vector covers {crcs.shape[0] if crcs.ndim else '?'} "
+            f"leaves, header says {n}")
+    if "__header_crc__" in data.files:
+        want = int(_read_member(data, "__header_crc__", path, "header"))
+        hl = int(_read_member(data, "__header_len__", path, "header"))
+        if _header_crc(version, n, hl, crcs) != want:
+            raise CheckpointCorrupt(path, "header", "header CRC32 mismatch")
+    return version, n, crcs
+
+
+def verify(path: str) -> dict:
+    """Template-free integrity pass over a checkpoint envelope: header
+    consistency, member-table completeness, and every leaf's CRC32.
+    Raises :class:`CheckpointCorrupt` on damage (ValueError when the
+    file is not a checkpoint at all); returns a summary dict —
+    ``{"version", "n_leaves", "checksummed", "members"}`` — on success.
+    The serve/ checkpoint store runs this before trusting a manifest
+    entry."""
+    fpath = path if str(path).endswith(".npz") else str(path) + ".npz"
+    with _open_envelope(fpath) as data:
+        version, n, crcs = _validate_header(data, fpath)
+        for i in range(n):
+            arr = _read_member(data, f"leaf_{i}", fpath, f"leaf_{i}")
+            if crcs is not None and _crc(arr) != int(crcs[i]):
+                raise CheckpointCorrupt(
+                    fpath, f"leaf_{i}", "CRC32 mismatch")
+        return {"version": version, "n_leaves": n,
+                "checksummed": crcs is not None,
+                "members": len(data.files)}
+
+
 def restore(path: str, template):
     """Rebuild a state pytree from `path` using `template`'s structure.
 
     The template provides the treedef (and expected shapes/dtypes); its
     array values are ignored. Raises ValueError on any mismatch; the
-    message carries the PYTREE PATHS of every mismatching leaf.
+    message carries the PYTREE PATHS of every mismatching leaf. File
+    damage — truncation, bit flips, CRC mismatches — raises the typed
+    :class:`CheckpointCorrupt` naming the failing section instead
+    (round 17); snapshots predating the integrity layer load with a
+    logged "no checksum" note.
     """
-    with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as data:
-        if "__version__" not in data.files or "__n_leaves__" not in data.files:
-            raise ValueError(f"{path} is not a go_libp2p_pubsub_tpu checkpoint")
-        version = int(data["__version__"])
-        if version != _FORMAT_VERSION:
-            if version < _FORMAT_VERSION:
-                raise ValueError(
-                    f"checkpoint format v{version} predates the current "
-                    f"v{_FORMAT_VERSION} (state leaves changed shape/"
-                    "meaning — see the version history at the top of "
-                    "checkpoint.py; v6 grew the event-counter vector with "
-                    "the chaos-plane counters and added the optional "
-                    "Gilbert–Elliott generator state); re-create the "
-                    "checkpoint from source state — no migration path is "
-                    "provided"
-                )
-            raise ValueError(
-                f"checkpoint format v{version} is newer than this build's "
-                f"v{_FORMAT_VERSION}"
-            )
+    fpath = path if str(path).endswith(".npz") else str(path) + ".npz"
+    with _open_envelope(fpath) as data:
+        _, n, crcs = _validate_header(data, fpath)
         t_leaves, treedef = jax.tree_util.tree_flatten(template)
         paths = _leaf_paths(template)
-        n = int(data["__n_leaves__"])
         if n != len(t_leaves):
             raise ValueError(
                 f"checkpoint has {n} leaves, template has {len(t_leaves)} "
@@ -124,8 +265,10 @@ def restore(path: str, template):
         leaves = []
         errors = []
         for i, tmpl in enumerate(t_leaves):
-            arr = data[f"leaf_{i}"]
             where = f"{paths[i]} (leaf {i})"
+            arr = _read_member(data, f"leaf_{i}", fpath, where)
+            if crcs is not None and _crc(arr) != int(crcs[i]):
+                raise CheckpointCorrupt(fpath, where, "CRC32 mismatch")
             if f"leaf_{i}__is_key" in data.files:
                 if not _is_key(tmpl):
                     errors.append(

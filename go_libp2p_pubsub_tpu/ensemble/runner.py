@@ -214,6 +214,21 @@ class WindowRunner:
         except Exception:  # pragma: no cover — newer-jax fallback
             return None
 
+    def dispatch(self, states, xs, due=None):
+        """One window invocation, ASYNC (no blocking, no timing) — the
+        supervised service loop's seam (serve/supervisor.py): dispatch
+        segment k, assemble segment k+1's ``xs`` host-side while the
+        device executes, then read k's ``ys`` when needed. ``xs`` is a
+        :meth:`stack_args` tuple sized to this runner's window; ``due``
+        the segment's stacked due rows when invariants are folded
+        (defaults to this runner's own precompute — segment-LOCAL
+        ticks; schedule-aware callers pass their global rows)."""
+        if self.invariants is None:
+            return self.window(states, xs)
+        if due is None:
+            due = self.invariants.due_rows(self.segment_len)
+        return self.window(states, xs, due)
+
     def stack_args(self, make_args, lo: int, hi: int) -> tuple:
         """Stack per-dispatch arg tuples ``make_args(i)`` for
         ``i in [lo, hi)`` into the window's xs arrays ([D, ...])."""

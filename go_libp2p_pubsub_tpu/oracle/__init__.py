@@ -7,7 +7,11 @@ checked against.
   * the invariant oracle plane (invariants.py, docs/DESIGN.md §12) —
     the verification literature's safety/liveness properties
     (arXiv:2311.08859, arXiv:2507.19013) as vectorized on-device
-    predicates, checked every k rounds inside chaos/ensemble runs.
+    predicates, checked every k rounds inside chaos/ensemble runs;
+  * the health-probe plane (probes.py, docs/DESIGN.md §17) — the
+    shallow engine-agnostic segment-boundary predicates (NaN/Inf
+    sweep, events-monotone, delivery-floor) the supervised service
+    loop folds into every checkpoint quantum.
 """
 
 from .invariants import (  # noqa: F401
@@ -21,4 +25,10 @@ from .invariants import (  # noqa: F401
     due_vector,
     invariant_names,
     make_checker,
+)
+from .probes import (  # noqa: F401
+    PROBE_NAMES,
+    HealthConfig,
+    health_check,
+    make_health_probe,
 )

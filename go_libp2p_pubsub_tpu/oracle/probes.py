@@ -1,0 +1,108 @@
+"""Cheap per-segment health probes for the supervised service loop.
+
+The invariant oracle (oracle/invariants.py) is the deep end: 18
+engine-aware properties with a due/grace contract. Long always-on runs
+also need a SHALLOW end — a handful of engine-agnostic predicates cheap
+enough to fold into every segment boundary that turn silent state
+corruption (a NaN'd score plane from a flaky host, a counter that went
+backwards through a bad resume) into a detected, localized event the
+supervisor can roll back from (serve/supervisor.py, docs/DESIGN.md
+§17). Three probes:
+
+  * ``finite-state`` — every floating-point leaf of the state tree is
+    finite (one fused all-isfinite reduction; integer/bool/key leaves
+    are skipped — NaN/Inf can only live in float planes);
+  * ``events-monotone`` — the event-counter vector never decreases
+    across a segment (the same cross-snapshot property the oracle's
+    ``events-monotone`` invariant checks per dispatch, evaluated here
+    against the segment-entry snapshot);
+  * ``delivery-floor`` — the segment's ``EV.DELIVER_MESSAGE`` delta is
+    at least ``delivery_floor`` (0 keeps the probe vacuously
+    non-negative; a live workload sets the floor to its known minimum
+    so a wedged data plane trips the probe instead of burning hours).
+
+The probe is ONE jitted function ``(state, prev_events) -> [P] bool``
+(``[S, P]`` batched) that never donates — it reads the live state the
+loop keeps using — and it is only built when probes are enabled, so a
+probes-off supervised run adds zero device ops (the census leg of
+``make service-smoke``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..trace.events import EV
+
+#: probe evaluation order — the mask index space of every report
+PROBE_NAMES = ("finite-state", "events-monotone", "delivery-floor")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Which probes run, and the delivery floor (messages delivered per
+    segment — per sim for batched trees; 0 means "only require the
+    delta to be non-negative")."""
+
+    finite_state: bool = True
+    events_monotone: bool = True
+    delivery_floor: int = 0
+
+    @property
+    def names(self) -> tuple:
+        out = []
+        if self.finite_state:
+            out.append("finite-state")
+        if self.events_monotone:
+            out.append("events-monotone")
+        out.append("delivery-floor")
+        return tuple(out)
+
+
+def _core_of(st):
+    return st.core if hasattr(st, "core") else st
+
+
+def health_check(state, prev_events, cfg: HealthConfig):
+    """Eager probe predicate: ``[P] bool`` in ``cfg.names`` order.
+    ``prev_events`` is the segment-entry event-counter snapshot (the
+    supervisor's carry — ``jnp.copy``'d around the donation ring)."""
+    core = _core_of(state)
+    prev = jnp.asarray(prev_events, core.events.dtype)
+    oks = []
+    if cfg.finite_state:
+        finite = [
+            jnp.all(jnp.isfinite(leaf))
+            for leaf in jax.tree_util.tree_leaves(state)
+            if hasattr(leaf, "dtype")
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+        ]
+        oks.append(jnp.all(jnp.stack(finite)) if finite
+                   else jnp.asarray(True))
+    if cfg.events_monotone:
+        oks.append(jnp.all(core.events >= prev))
+    delta = (core.events[EV.DELIVER_MESSAGE]
+             - prev[EV.DELIVER_MESSAGE])
+    oks.append(delta >= jnp.asarray(cfg.delivery_floor, delta.dtype))
+    return jnp.stack(oks)
+
+
+def make_health_probe(cfg: HealthConfig, *, batched: bool = False):
+    """Build the jitted segment-boundary probe.
+
+    Returns ``(jit_fn, names)``: ``jit_fn(state, prev_events) -> [P]
+    bool`` (``[S, P]`` when ``batched`` — state and snapshot carry the
+    leading sim axis). One fresh jit, never donating; its compile-cache
+    size rides the service loop's one-compile sentinel."""
+
+    def check(state, prev_events):
+        return health_check(state, prev_events, cfg)
+
+    if batched:
+        fn = jax.jit(jax.vmap(check))
+    else:
+        fn = jax.jit(check)
+    return fn, cfg.names

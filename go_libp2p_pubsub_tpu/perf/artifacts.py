@@ -116,6 +116,39 @@ SCAN_OFF = {"scan": None, "segment_rounds": None,
 #: age; the legacy answer is "all static, unrecorded split".
 PARAMS_STATIC = {"recorded": False, "lifted": False, "traced": []}
 
+#: the service defaults every artifact WITHOUT a fingerprint["service"]
+#: block reads back as (round 17): the run was NOT driven by the
+#: supervised service loop — no checkpoint retention, no health probes,
+#: no recoveries to report. Explicit sentinel so readers can ask any
+#: artifact "was this number cut under supervision, and did the run
+#: recover mid-flight" without special-casing age.
+SERVICE_OFF = {"enabled": False, "segment_rounds": 0,
+               "retention": {"keep_last": 0, "keep_every": 0},
+               "probes": [], "recoveries": 0, "segments": 0, "resumes": 0}
+
+
+def service_fingerprint(*, segment_rounds: int, keep_last: int,
+                        keep_every: int, probes=(), recoveries: int = 0,
+                        segments: int = 0, resumes: int = 0) -> dict:
+    """The schema-v3 ``fingerprint["service"]`` block (round 17): the
+    supervised service loop's self-description — checkpoint quantum in
+    rounds, the retention policy pair, which health probes were armed,
+    and how eventful the run was (recoveries performed, segments
+    committed, resumes from disk). Emitted by ``ServiceReport.
+    fingerprint()`` (serve/supervisor.py) and the service-smoke gate;
+    readers go through :attr:`BenchRecord.service`, which defaults
+    legacy lines to :data:`SERVICE_OFF`."""
+    return {
+        "enabled": True,
+        "segment_rounds": int(segment_rounds),
+        "retention": {"keep_last": int(keep_last),
+                      "keep_every": int(keep_every)},
+        "probes": [str(p) for p in probes],
+        "recoveries": int(recoveries),
+        "segments": int(segments),
+        "resumes": int(resumes),
+    }
+
 
 def params_fingerprint(lifted: bool, traced=()) -> dict:
     """The schema-v3 ``fingerprint["params"]`` block (round 16): the
@@ -414,6 +447,27 @@ class BenchRecord:
         out = dict(SCAN_OFF)
         out.update(fp.get("execution") or {})
         return out
+
+    @property
+    def service(self) -> dict:
+        """The service block of the fingerprint (round 17). LEGACY
+        artifacts — every line that predates the supervised loop — read
+        back :data:`SERVICE_OFF`, so readers can ask any artifact "was
+        this cut under supervision / did it recover mid-run" without
+        special-casing age."""
+        fp = self.fingerprint or {}
+        out = dict(SERVICE_OFF)
+        # the only sentinel with a NESTED dict: copy it too, or a caller
+        # mutating rec.service["retention"] corrupts the module default
+        # for every later legacy read
+        out["retention"] = dict(SERVICE_OFF["retention"])
+        out["probes"] = list(SERVICE_OFF["probes"])
+        out.update(fp.get("service") or {})
+        return out
+
+    @property
+    def service_on(self) -> bool:
+        return bool(self.service["enabled"])
 
     @property
     def scanned(self) -> bool | None:

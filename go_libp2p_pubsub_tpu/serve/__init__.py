@@ -1,0 +1,42 @@
+"""Supervised service loop (docs/DESIGN.md §17): the always-on face of
+the simulator.
+
+  supervisor — the double-buffered segment pipeline over
+               ``ensemble.WindowRunner``: async window dispatch,
+               segment-boundary health probes + folded invariants,
+               rollback-and-replay localization, retry/backoff/
+               degradation, heartbeat + incremental HTML report
+  store      — rolling checksummed v6 checkpoints: atomic writes,
+               keep-last/keep-every retention, manifest with
+               corrupted-snapshot fallback
+  faults     — harness-level fault injection (SIGKILL crash points incl.
+               mid-checkpoint-write, transient dispatch failures, NaN
+               state corruption, checkpoint file damage) driving the
+               recovery tests and ``make service-smoke``
+
+Entry points: ``scripts/service_smoke.py`` (``make service-smoke``) and
+``python -m go_libp2p_pubsub_tpu.serve._child`` (the subprocess cell
+the crash-recovery tests SIGKILL and resume).
+"""
+
+from .faults import (  # noqa: F401
+    KILL_SITES,
+    FaultPlan,
+    TransientDispatchError,
+    corrupt_leaf_member,
+    flip_bit,
+    truncate_file,
+)
+from .store import (  # noqa: F401
+    MANIFEST_NAME,
+    CheckpointStore,
+    RetentionPolicy,
+)
+from .supervisor import (  # noqa: F401
+    ServiceConfig,
+    ServiceError,
+    ServiceHalted,
+    ServiceReport,
+    Supervisor,
+    state_digest,
+)

@@ -1,0 +1,226 @@
+"""Harness-level fault injection: chaos engineering for the simulator
+itself, not the simulated network.
+
+The chaos/ plane injects faults INTO the simulation (lossy links,
+partitions, sybils). This module injects faults into the MACHINERY
+AROUND it — the supervised service loop (serve/supervisor.py) — to
+drive the recovery tests and ``make service-smoke``:
+
+  * **SIGKILL crash points** (:meth:`FaultPlan.maybe_kill` + the
+    checkpoint store's ``write_hook`` seam): die at a segment boundary,
+    mid-checkpoint-write (tmp written and TRUNCATED, final not yet in
+    place — the dirtiest window), after the snapshot rename but before
+    the manifest commit. The recovery contract: resuming the killed run
+    finishes bit-exact vs an uninterrupted control.
+  * **transient dispatch failures** (:meth:`FaultPlan.before_dispatch`):
+    raise :class:`TransientDispatchError` the first k attempts of a
+    segment's dispatch, exercising the supervisor's
+    backoff-retry-degrade ladder.
+  * **state corruption** (:meth:`FaultPlan.corrupt_state`): overwrite
+    one element of a named floating-point state leaf with NaN (or drive
+    an event counter backwards) after a chosen dispatch — the silent
+    host/device corruption the health probes exist to catch. The fault
+    fires once on the windowed pass and once more on the supervisor's
+    rollback REPLAY (so the per-dispatch localizer sees it at the same
+    point), then exhausts — a transient, recoverable corruption. Raise
+    ``corrupt_max_fires`` to model persistent damage (the supervisor
+    then halts with the forensic bundle).
+  * **checkpoint file damage** (module helpers): truncate a snapshot,
+    flip a bit, or rewrite one leaf member under an unchanged CRC
+    vector — the three flavors ``checkpoint.CheckpointCorrupt`` must
+    classify and the store's manifest fallback must survive.
+
+Everything is deterministic given the plan (no wall-clock, no ambient
+randomness), so a killed child and its resumed sibling — and the
+windowed pass and its replay — see identical fault streams.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TransientDispatchError(RuntimeError):
+    """A dispatch failed in a way worth retrying (the injected stand-in
+    for flaky host↔device transport / allocator hiccups)."""
+
+
+#: write_hook stages (serve/store.py) a kill_site may name
+KILL_SITES = ("post-segment", "mid-write", "post-rename")
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One run's fault schedule. Segment indices are the supervisor's
+    loop ordinals (0-based); ``corrupt_dispatch`` is the dispatch index
+    WITHIN the segment (-1 = the segment's last dispatch)."""
+
+    #: SIGKILL this process when the site is reached for the segment
+    kill_segment: int | None = None
+    kill_site: str = "post-segment"
+    #: segment -> number of transient dispatch failures to inject
+    fail_dispatches: dict = dataclasses.field(default_factory=dict)
+    #: NaN-corrupt a state leaf after (segment, dispatch)
+    corrupt_segment: int | None = None
+    corrupt_dispatch: int = -1
+    corrupt_leaf: str = "scores"
+    corrupt_kind: str = "nan"          # "nan" | "events"
+    corrupt_max_fires: int = 2         # windowed pass + rollback replay
+
+    def __post_init__(self):
+        if self.kill_site not in KILL_SITES:
+            raise ValueError(
+                f"kill_site must be one of {KILL_SITES}, "
+                f"got {self.kill_site!r}")
+        self._fails_left = {int(k): int(v)
+                            for k, v in self.fail_dispatches.items()}
+        self._corrupt_fires = 0
+
+    # -- crash points ---------------------------------------------------
+
+    def maybe_kill(self, site: str, segment: int) -> None:
+        """SIGKILL — not an exception; the point is that NOTHING
+        downstream runs, exactly like a host power loss."""
+        if self.kill_segment is not None and site == self.kill_site \
+                and segment == self.kill_segment:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def store_hook(self, segment_fn):
+        """A serve/store.py ``write_hook`` bound to this plan.
+        ``segment_fn()`` reports the supervisor's current segment (the
+        store doesn't know it). ``mid-write`` truncates the tmp file
+        first so the crash really is a partial write."""
+
+        def hook(stage: str, path: str) -> None:
+            seg = segment_fn()
+            if stage == "tmp-written" and self.kill_site == "mid-write" \
+                    and self.kill_segment == seg:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(max(1, size // 2))
+                os.kill(os.getpid(), signal.SIGKILL)
+            if stage == "renamed":
+                self.maybe_kill("post-rename", seg)
+
+        return hook
+
+    # -- transient dispatch failures -------------------------------------
+
+    def before_dispatch(self, segment: int) -> None:
+        """The injectable dispatch seam: raises while this segment's
+        transient-failure budget remains (the real window call never
+        starts, so the un-donated state stays retryable — matching the
+        transport failures this models, which fail before launch)."""
+        left = self._fails_left.get(int(segment), 0)
+        if left > 0:
+            self._fails_left[int(segment)] = left - 1
+            raise TransientDispatchError(
+                f"injected transient dispatch failure (segment {segment}, "
+                f"{left - 1} more to come)")
+
+    # -- state corruption ------------------------------------------------
+
+    def wants_corruption(self, segment: int) -> bool:
+        return (self.corrupt_segment == segment
+                and self._corrupt_fires < self.corrupt_max_fires)
+
+    def resolved_dispatch(self, segment_len: int) -> int:
+        """The segment-local dispatch index the corruption targets."""
+        return (self.corrupt_dispatch if self.corrupt_dispatch >= 0
+                else segment_len - 1)
+
+    def corrupt_state(self, state, segment: int, dispatch: int,
+                      segment_len: int):
+        """Apply the scheduled corruption after dispatch ``dispatch`` of
+        ``segment`` (both loop-local). Returns the (possibly new) state;
+        counts a fire only when it actually applied."""
+        target = (self.corrupt_dispatch if self.corrupt_dispatch >= 0
+                  else segment_len - 1)
+        if not self.wants_corruption(segment) or dispatch != target:
+            return state
+        self._corrupt_fires += 1
+        if self.corrupt_kind == "events":
+            core = state.core if hasattr(state, "core") else state
+            ev = core.events.at[0].set(-1)   # counters are born >= 0
+            core = core.replace(events=ev)
+            return (state.replace(core=core) if hasattr(state, "core")
+                    else core)
+        return _nan_leaf(state, self.corrupt_leaf)
+
+    @property
+    def corrupt_fires(self) -> int:
+        return self._corrupt_fires
+
+
+def _nan_leaf(state, needle: str):
+    """Overwrite element 0 of the first floating-point leaf whose
+    pytree path contains ``needle`` with NaN."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    hit = None
+    for i, (path, leaf) in enumerate(flat):
+        key = jax.tree_util.keystr(path)
+        if needle in key and hasattr(leaf, "dtype") \
+                and jnp.issubdtype(leaf.dtype, jnp.floating):
+            hit = i
+            break
+    if hit is None:
+        raise ValueError(
+            f"no floating-point state leaf matches {needle!r}; "
+            f"float leaves: "
+            f"{[jax.tree_util.keystr(p) for p, l in flat if hasattr(l, 'dtype') and jnp.issubdtype(l.dtype, jnp.floating)]}")
+    leaves = [leaf for _, leaf in flat]
+    bad = leaves[hit]
+    leaves[hit] = bad.at[(0,) * bad.ndim].set(jnp.nan)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint file damage
+
+
+def truncate_file(path: str, frac: float = 0.5) -> None:
+    """Cut a file to ``frac`` of its size — the mid-write / partial-copy
+    shape of damage."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, int(size * frac)))
+
+
+def flip_bit(path: str, offset: int | None = None, seed: int = 0) -> None:
+    """XOR one byte; default offset is a seeded draw from the middle
+    half of the file (deterministic per seed)."""
+    size = os.path.getsize(path)
+    if offset is None:
+        rng = np.random.default_rng(seed)
+        offset = int(rng.integers(size // 4, max(size // 4 + 1,
+                                                 3 * size // 4)))
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def corrupt_leaf_member(path: str, leaf_idx: int) -> None:
+    """Rewrite ``leaf_<idx>``'s bytes while keeping the envelope's
+    committed CRC vector — a VALID zip whose content lies, so the
+    round-17 per-leaf CRC (not the container's) must be what catches it
+    and names the leaf."""
+    with np.load(path) as data:
+        members = {k: data[k] for k in data.files}
+    name = f"leaf_{leaf_idx}"
+    if name not in members:
+        raise ValueError(f"{path} has no member {name}")
+    arr = np.array(members[name])
+    if arr.size == 0:
+        raise ValueError(f"{name} is empty — nothing to corrupt")
+    flat = arr.reshape(-1).view(np.uint8)
+    flat[0] ^= 0xFF
+    members[name] = arr
+    np.savez_compressed(path, **members)

@@ -248,6 +248,20 @@ def artifact_params(path: str) -> dict:
     return recs[-1].params
 
 
+def artifact_service(path: str) -> dict:
+    """The ``service`` fingerprint block (round 17: was the run driven
+    by the supervised service loop — checkpoint quantum, retention,
+    armed probes, recoveries performed) of a bench artifact's last
+    metric line; legacy lines read back perf.artifacts.SERVICE_OFF."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    for rec in reversed(recs):
+        if rec.service_on:
+            return rec.service
+    return recs[-1].service
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("tracefile")
@@ -266,6 +280,7 @@ def main():
         stats["adversary"] = artifact_adversary(args.artifact)
         stats["execution"] = artifact_execution(args.artifact)
         stats["params"] = artifact_params(args.artifact)
+        stats["service"] = artifact_service(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -330,6 +345,20 @@ def main():
             )
         else:
             print("params: all static (recorded; nothing lifted)")
+    if "service" in stats:
+        sv = stats["service"]
+        if sv.get("enabled"):
+            ret = sv.get("retention", {})
+            print(
+                f"service: SUPERVISED — {sv.get('segments')} segments of "
+                f"{sv.get('segment_rounds')} rounds, retention keep_last="
+                f"{ret.get('keep_last')} keep_every={ret.get('keep_every')}"
+                f", probes {sv.get('probes')}, {sv.get('recoveries')} "
+                f"recovery(ies), {sv.get('resumes')} resume(s)"
+            )
+        else:
+            print("service: SERVICE_OFF (bare window/loop run, or the "
+                  "artifact predates the supervised service loop)")
     if "adversary" in stats:
         av = stats["adversary"]
         if av.get("enabled"):

@@ -231,3 +231,108 @@ def test_phase_engine_roundtrip_resume(tmp_path):
     direct = drive(mid, 3, 7)
     resumed = drive(resumed_mid, 3, 7)
     _assert_tree_equal(direct, resumed)
+
+
+# ---------------------------------------------------------------------------
+# round 17: the integrity layer (CRC32 envelope + CheckpointCorrupt)
+
+
+def test_envelope_carries_integrity_layer(tmp_path):
+    st = SimState.init(8, 16, seed=3, k=4)
+    path = str(tmp_path / "crc.npz")
+    checkpoint.save(path, st)
+    info = checkpoint.verify(path)
+    assert info["checksummed"] is True
+    assert info["n_leaves"] == len(jax.tree_util.tree_leaves(st))
+    with np.load(path) as data:
+        assert "__crc32__" in data.files
+        assert "__header_len__" in data.files
+        assert "__header_crc__" in data.files
+        assert int(data["__header_len__"]) == len(data.files)
+
+
+def test_truncated_checkpoint_raises_typed_error(tmp_path):
+    st = SimState.init(8, 16, seed=3, k=4)
+    path = str(tmp_path / "trunc.npz")
+    checkpoint.save(path, st)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    with pytest.raises(checkpoint.CheckpointCorrupt):
+        checkpoint.restore(path, SimState.init(8, 16, seed=0, k=4))
+    with pytest.raises(checkpoint.CheckpointCorrupt):
+        checkpoint.verify(path)
+
+
+def test_bitflipped_checkpoint_raises_typed_error(tmp_path):
+    st = SimState.init(8, 16, seed=3, k=4)
+    path = str(tmp_path / "flip.npz")
+    checkpoint.save(path, st)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(checkpoint.CheckpointCorrupt):
+        checkpoint.restore(path, SimState.init(8, 16, seed=0, k=4))
+
+
+def test_leaf_corruption_named_by_section(tmp_path):
+    """A VALID zip whose leaf bytes were rewritten under the committed
+    CRC vector: only the round-17 per-leaf CRC can catch it, and the
+    error must name the damaged leaf's pytree path."""
+    from go_libp2p_pubsub_tpu.serve import corrupt_leaf_member
+
+    st = SimState.init(8, 16, seed=3, k=4)
+    path = str(tmp_path / "leaf.npz")
+    checkpoint.save(path, st)
+    corrupt_leaf_member(path, 2)
+    with pytest.raises(checkpoint.CheckpointCorrupt) as ei:
+        checkpoint.restore(path, SimState.init(8, 16, seed=0, k=4))
+    assert "leaf 2" in str(ei.value)
+    assert "CRC32 mismatch" in str(ei.value)
+
+
+def test_pre_integrity_snapshot_loads_with_note(tmp_path, caplog):
+    """Snapshots written before the integrity layer (no __crc32__) load
+    backward-compatibly with a logged 'no checksum' note."""
+    import logging
+
+    st = SimState.init(8, 16, seed=3, k=4)
+    leaves = jax.tree_util.tree_leaves(st)
+    legacy = {"__version__": np.int64(6),
+              "__n_leaves__": np.int64(len(leaves))}
+    for i, leaf in enumerate(leaves):
+        if checkpoint.is_prng_key(leaf):
+            legacy[f"leaf_{i}"] = np.asarray(jax.random.key_data(leaf))
+            legacy[f"leaf_{i}__is_key"] = np.bool_(True)
+        else:
+            legacy[f"leaf_{i}"] = np.asarray(leaf)
+    path = str(tmp_path / "legacy.npz")
+    np.savez_compressed(path, **legacy)
+    with caplog.at_level(logging.INFO,
+                         logger="go_libp2p_pubsub_tpu.checkpoint"):
+        back = checkpoint.restore(path, SimState.init(8, 16, seed=0, k=4))
+    _assert_tree_equal(st, back)
+    assert any("no checksum" in r.message for r in caplog.records)
+    assert checkpoint.verify(path)["checksummed"] is False
+
+
+def test_template_mismatch_stays_plain_valueerror(tmp_path):
+    """Corruption is CheckpointCorrupt; a WRONG TEMPLATE must stay the
+    plain ValueError contract (the store's fallback must not swallow
+    caller bugs)."""
+    st = SimState.init(8, 16, seed=3, k=4)
+    path = str(tmp_path / "tmpl.npz")
+    checkpoint.save(path, st)
+    with pytest.raises(ValueError) as ei:
+        checkpoint.restore(path, SimState.init(12, 16, seed=0, k=4))
+    assert not isinstance(ei.value, checkpoint.CheckpointCorrupt)
+
+
+def test_uncompressed_save_roundtrips(tmp_path):
+    st = SimState.init(8, 16, seed=5, k=4)
+    path = str(tmp_path / "raw.npz")
+    checkpoint.save(path, st, compress=False)
+    assert checkpoint.verify(path)["checksummed"] is True
+    back = checkpoint.restore(path, SimState.init(8, 16, seed=0, k=4))
+    _assert_tree_equal(st, back)

@@ -480,3 +480,89 @@ def test_adversary_and_score_weight_blocks_round_trip():
         r = artifacts.load_bench_artifact(p)
         assert not r.adversary_on
         assert r.adversary["n_sybils"] == 0
+
+
+def test_service_block_round_trips_and_legacy_sentinel():
+    """Round 17: the `service` fingerprint block (the supervised
+    service loop's self-description) round-trips through the line
+    format, and LEGACY lines read back the typed SERVICE_OFF sentinel
+    — never a KeyError or a silently-assumed bare run."""
+    fp = {
+        "service": artifacts.service_fingerprint(
+            segment_rounds=8, keep_last=3, keep_every=4,
+            probes=("finite-state", "events-monotone"),
+            recoveries=2, segments=40, resumes=1),
+    }
+    rec = artifacts.BenchRecord(
+        metric="service_loop_rounds_per_sec", value=32.0, unit="rounds/s",
+        vs_baseline=0.0, schema=3, fingerprint=fp,
+    )
+    back = artifacts.record_from_line(json.loads(artifacts.dump_record(rec)))
+    assert back.service_on
+    assert back.service["segment_rounds"] == 8
+    assert back.service["retention"] == {"keep_last": 3, "keep_every": 4}
+    assert back.service["probes"] == ["finite-state", "events-monotone"]
+    assert back.service["recoveries"] == 2 and back.service["resumes"] == 1
+
+    legacy = artifacts.record_from_line(
+        {"metric": "m", "value": 1.0, "unit": "x", "vs_baseline": 0.0})
+    assert legacy.service == artifacts.SERVICE_OFF
+    assert not legacy.service_on
+
+    # every committed BENCH_r* line reads the sentinel without error
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
+        r = artifacts.load_bench_artifact(p)
+        assert r.service["enabled"] is False
+
+
+def test_service_report_fingerprint_matches_block(tmp_path):
+    """ServiceReport.fingerprint() emits exactly the artifacts block
+    shape (the execution/params-block pattern), and tracestat's
+    artifact reader surfaces it."""
+    import sys
+
+    from go_libp2p_pubsub_tpu.oracle import probes as _probes
+    from go_libp2p_pubsub_tpu.serve import RetentionPolicy
+    from go_libp2p_pubsub_tpu.serve.supervisor import ServiceReport
+
+    rep = ServiceReport(
+        states=None, n_dispatches=16, rounds=16, segments=4,
+        segment_rounds=4, seconds=1.0, recoveries=1, retries=2,
+        degradations=[], resumed_from=8, window_compiles={"L4": 1},
+        checkpoints=[], heartbeat_path="", invariant_checks=4,
+        probes=_probes.HealthConfig().names,
+        retention=RetentionPolicy(keep_last=2, keep_every=3), bundles=[])
+    block = rep.fingerprint()
+    assert block["enabled"] and block["segment_rounds"] == 4
+    assert block["retention"] == {"keep_last": 2, "keep_every": 3}
+    assert block["resumes"] == 1
+
+    rec = artifacts.BenchRecord(
+        metric="m", value=1.0, unit="x", vs_baseline=0.0, schema=3,
+        fingerprint={"service": block})
+    art = tmp_path / "svc.json"
+    art.write_text(artifacts.dump_record(rec) + "\n")
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        from tracestat import artifact_service
+
+        got = artifact_service(str(art))
+    finally:
+        sys.path.pop(0)
+    assert got == block
+
+
+def test_service_off_sentinel_is_mutation_safe():
+    """Review regression: SERVICE_OFF is the only sentinel with nested
+    containers — a caller mutating a legacy record's service block must
+    not corrupt the module default for later reads."""
+    legacy = artifacts.record_from_line(
+        {"metric": "m", "value": 1.0, "unit": "x", "vs_baseline": 0.0})
+    sv = legacy.service
+    sv["retention"]["keep_last"] = 99
+    sv["probes"].append("bogus")
+    fresh = artifacts.record_from_line(
+        {"metric": "m2", "value": 1.0, "unit": "x",
+         "vs_baseline": 0.0}).service
+    assert fresh["retention"] == {"keep_last": 0, "keep_every": 0}
+    assert fresh["probes"] == []
