@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -111,6 +111,10 @@ scan-smoke:
 # S=8 ensemble window placed via shard_ensemble_state(axis="sims+peers")
 # — bit-exact vs unplaced, halo permutes only (no all-gathers); writes
 # the MULTICHIP_r06.json artifact scan-smoke's projection refresh reads
+# PLUS the round-18 sharded-CSR cell (MULTICHIP_r07.json): the same
+# window on edge_layout="csr" with the CSR-RESIDENT flat [S, E, W]
+# planes sharded over (sims, peers) — bit-exact vs unplaced, zero
+# all-gathers, trace-time halo tally EQUAL to the dense build's
 mesh2d-audit:
 	python scripts/mesh2d_dryrun.py --write
 
@@ -132,6 +136,19 @@ mem-audit:
 # constrained boxes — RSS/rate gates then skip). ~25 s on CPU.
 scale-smoke:
 	python scripts/scale_smoke.py
+
+# power-law sparse-plane A/B gate (scripts/topo_smoke.py; docs/
+# DESIGN.md §18): both edge layouts run the identical power-law
+# attestation-storm window (one canonical edge list, identical per-sim
+# chaos/PRNG streams, S=4 vmapped, one compile per layout) and the gate
+# asserts the csr layout BEATS dense on delivery-rounds/s (committed
+# rate_lift_floor) AND on audited bytes moved (trace-time halo-bytes
+# tally; the ratio IS the topology density), while per-sim event
+# counters stay BIT-IDENTICAL across layouts (the pairing).
+# TOPO_SMOKE_UPDATE=1 rewrites TOPO_SMOKE.json + the BENCH_r07.json
+# artifact pair (fingerprint["topology"] block). ~60 s warm on CPU.
+topo-smoke:
+	python scripts/topo_smoke.py
 
 # supervised-service-loop gate (scripts/service_smoke.py; docs/
 # DESIGN.md §17): the always-on recovery contract — a supervised run
@@ -214,6 +231,7 @@ quick:
 	python scripts/hlo_audit.py
 	python scripts/memstat.py
 	python scripts/scale_smoke.py
+	python scripts/topo_smoke.py
 	python scripts/service_smoke.py --smoke
 
 native:

@@ -96,12 +96,13 @@ _TELEM_PREFIX = ".core.telem"
 
 #: the sparse-data-plane path (round 15): the gossipsub bench step built
 #: with ``edge_layout="csr"`` (ops/csr.py — the flat [E] edge exchange)
-#: runs the same guard set. The CSR layout lives entirely in the Net
-#: (the state tree is leaf-identical to the dense build), so its schema
-#: is NOT committed separately: the rows must EQUAL the committed
-#: ``gossipsub`` rows exactly — any drift means the layout leaked into
-#: the state, which would break checkpoint v6's no-version-bump
-#: contract (docs/DESIGN.md §15).
+#: runs the same guard set. Its schema is NOT committed separately:
+#: since round 18 the csr build carries the CSR-RESIDENT state tier
+#: (fe_words/served_* as [E, W], peerhave/iasked as [E] — docs/
+#: DESIGN.md §18), so the rows must equal the committed ``gossipsub``
+#: rows transformed by :func:`csr_variant_rows` — exactly those five
+#: leaves flat, everything else byte-equal. Any other drift means the
+#: layout leaked beyond the sanctioned tier.
 CSR_ENGINE = "csr"
 CSR_BASE = "gossipsub"
 
@@ -109,8 +110,8 @@ CSR_BASE = "gossipsub"
 #: engine built on the flat-[E] edge layout — a cell with real bugs to
 #: catch (the stacked wire head AND every sub-round exchange route
 #: through the CSR seams) that previously had no guard coverage. Its
-#: schema must EQUAL the committed ``gossipsub_phase`` rows exactly
-#: (the layout lives in the Net, never the state).
+#: schema must equal the committed ``gossipsub_phase`` rows under the
+#: same round-18 csr-variant transformation.
 PHASE_CSR_ENGINE = "phase_csr"
 PHASE_CSR_BASE = "gossipsub_phase"
 
@@ -351,13 +352,46 @@ def check_schema_equal(h: EngineHarness, out_tree, base_rows: list | None,
     return rows
 
 
+def csr_variant_rows(base_rows: list, n_edges: int) -> list:
+    """The CSR VARIANT of a dense engine's schema rows (round 18): the
+    five CSR-resident leaves (state.CSR_RESIDENT_SUFFIXES — the single
+    source of the tier's membership) take their flat shapes ([E, W]
+    word planes, [E] counters); every other row must stay byte-equal to
+    the dense baseline — so the dense STATE_SCHEMA.json rows remain the
+    single committed source and the variant is derived, never
+    duplicated (the same pattern as the ensemble strip)."""
+    from ..state import CSR_RESIDENT_COUNTERS, CSR_RESIDENT_WORD_PLANES
+
+    out = []
+    for r in base_rows:
+        p = r["path"]
+        if p.endswith(CSR_RESIDENT_WORD_PLANES):
+            out.append({**r, "shape": [n_edges, list(r["shape"])[-1]]})
+        elif p.endswith(CSR_RESIDENT_COUNTERS):
+            out.append({**r, "shape": [n_edges]})
+        else:
+            out.append(r)
+    return out
+
+
+def _harness_n_edges(h: EngineHarness) -> int:
+    """E of a CSR harness, read off the flat first-arrival plane."""
+    core = getattr(h.state, "core", h.state)
+    return int(core.dlv.fe_words.shape[0])
+
+
 def check_schema_csr(h: EngineHarness, out_tree,
                      base_rows: list | None) -> list:
-    """Schema guard for the CSR engine (exact equality with the base —
-    the checkpoint-v6 no-version-bump contract)."""
+    """Schema guard for the CSR engine: exact equality with the base
+    rows TRANSFORMED to the CSR-resident variant (csr_variant_rows) —
+    any drift beyond the five sanctioned flat leaves means the layout
+    leaked somewhere it must not (the checkpoint contract: dense and
+    csr snapshots differ in exactly those leaf shapes)."""
+    base = (csr_variant_rows(base_rows, _harness_n_edges(h))
+            if base_rows is not None else None)
     return check_schema_equal(
-        h, out_tree, base_rows, CSR_BASE,
-        "the csr layout leaked into the state tree",
+        h, out_tree, base, CSR_BASE,
+        "the csr layout leaked beyond the resident tier",
     )
 
 
@@ -713,12 +747,16 @@ def run_csr_engine(base_rows: list | None) -> list:
 
 def run_phase_csr_engine(base_rows: list | None) -> list:
     """All guards for the combined phase+CSR row (round 16): schema
-    must equal the committed ``gossipsub_phase`` rows exactly."""
+    must equal the committed ``gossipsub_phase`` rows transformed to
+    the CSR-resident variant (round 18: the five per-edge planes
+    allocate flat against a csr Net)."""
     h = build_phase_csr_harness()
     out_tree = strict_trace(h)
+    base = (csr_variant_rows(base_rows, _harness_n_edges(h))
+            if base_rows is not None else None)
     rows = check_schema_equal(
-        h, out_tree, base_rows, PHASE_CSR_BASE,
-        "the csr layout leaked into the phase state tree",
+        h, out_tree, base, PHASE_CSR_BASE,
+        "the csr layout leaked beyond the resident tier (phase)",
     )
     check_donation(h)
     run_rounds_guarded(h)

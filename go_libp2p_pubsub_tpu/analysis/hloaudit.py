@@ -313,28 +313,12 @@ def lowered_text(h) -> str:
 
 
 def tally_gathers(h) -> dict:
-    """Trace-time halo-gather tally for one harness call, by kind.
-
-    Traces the UNJITTED step body (``__wrapped__``): jax's tracing
-    cache is keyed on the jitted function, so evaluating the jit could
-    hit a cached jaxpr from an earlier trace and silently record ZERO
-    gathers — the raw body re-traces every time, so the seams always
-    fire."""
-    import jax
-
+    """Trace-time halo-gather tally for one harness call, by kind
+    (edges.tally_step owns the unjitted-body caveat: tracing the jit
+    could hit a cached jaxpr and silently record ZERO gathers)."""
     from ..ops import edges
 
     kw = dict(h.static_kwargs)
     net = kw.pop("net", None)
-    raw = getattr(h.jit_fn, "__wrapped__", h.jit_fn)
-    args = h.make_args(0)
-    tally: list = []
-    with edges.tally_halo_gathers(tally):
-        if net is not None:
-            jax.eval_shape(lambda s: raw(net, s, *args, **kw), h.state)
-        else:
-            jax.eval_shape(lambda s: raw(s, *args, **kw), h.state)
-    out = {"total": len(tally)}
-    for kind in tally:
-        out[kind] = out.get(kind, 0) + 1
-    return out
+    return edges.fold_tally(edges.tally_step(
+        h.jit_fn, h.state, h.make_args(0), kw, net=net))

@@ -318,7 +318,8 @@ def run_window(ens_step, states, make_args, n_steps: int, *,
     ).run(states, make_args, on_segment=on_segment)
 
 
-def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers"):
+def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers",
+                         n_edges: int | None = None):
     """Place a BATCHED state tree onto a device mesh (see the module
     docstring for the three layouts). ``axis="peers"`` shards dim 1 of
     every leaf whose dim-1 extent is ``n_peers`` (the batched analogue
@@ -327,11 +328,22 @@ def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers"):
     ``axis="sims+peers"`` composes both on a 2-D
     ``parallel.make_mesh_2d`` mesh (named axes ``sims``/``peers``):
     every leaf's leading sim dim rides the ``sims`` mesh axis and
-    peer-dim-1 leaves are additionally split over ``peers``."""
+    peer-dim-1 leaves are additionally split over ``peers``.
+
+    ``n_edges`` (round 18) extends the dim-1 rule to the CSR-RESIDENT
+    flat planes ([S, E, ...] leaves): the row-owner-ordered edge axis
+    partitions with the peer axis (parallel.state_shardings has the
+    alignment argument). Pass ``net.n_edges`` — None on dense builds."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..parallel.sharding import peer_spec
+
+    def _row_dim(leaf) -> bool:
+        if not (hasattr(leaf, "shape") and leaf.ndim >= 2):
+            return False
+        return leaf.shape[1] == n_peers or (
+            n_edges is not None and leaf.shape[1] == n_edges)
 
     if axis == "sims":
         # peer_spec is "all mesh axes on one dim" — reused here for the
@@ -349,8 +361,7 @@ def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers"):
         sims_only = NamedSharding(mesh, P("sims"))
 
         def choose2d(leaf):
-            if (hasattr(leaf, "shape") and leaf.ndim >= 2
-                    and leaf.shape[1] == n_peers):
+            if _row_dim(leaf):
                 return both
             return sims_only
 
@@ -368,8 +379,7 @@ def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers"):
     repl = NamedSharding(mesh, P())
 
     def choose(leaf):
-        if (hasattr(leaf, "shape") and leaf.ndim >= 2
-                and leaf.shape[1] == n_peers):
+        if _row_dim(leaf):
             return peer
         return repl
 

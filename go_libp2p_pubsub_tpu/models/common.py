@@ -177,11 +177,19 @@ def delivery_round(
     n, k_slots = net.nbr.shape
     m = msgs.capacity
 
-    assert dlv.fe_words.shape[1] == k_slots, (
-        "Delivery.fe_words edge axis does not match the topology's "
-        f"max_degree ({dlv.fe_words.shape[1]} != {k_slots}) — construct the "
-        "state with SimState.init(..., k=net.max_degree)"
-    )
+    if dlv.fe_words.ndim == 2:
+        # CSR-RESIDENT first-arrival plane (round 18): [E, W] flat
+        assert net.edge_layout == "csr" and (
+            dlv.fe_words.shape[0] == net.n_edges), (
+            "flat fe_words needs a matching edge_layout='csr' Net "
+            f"({dlv.fe_words.shape[0]} != E={net.n_edges})"
+        )
+    else:
+        assert dlv.fe_words.shape[1] == k_slots, (
+            "Delivery.fe_words edge axis does not match the topology's "
+            f"max_degree ({dlv.fe_words.shape[1]} != {k_slots}) — construct "
+            "the state with SimState.init(..., k=net.max_degree)"
+        )
     # the pipeline's presence in the state IS the configuration — deriving
     # it here means a caller can never mismatch the two
     val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
@@ -219,12 +227,29 @@ def delivery_round(
         # attribution, IWANT merge, telemetry popcounts), so the
         # delivery semantics stay single-source and dense-vs-CSR
         # parity is bit-exact (tests/test_csr.py, all four engines).
+        flat_resident = dlv.fe_words.ndim == 2
         fwd_e = net.peer_gather_flat(dlv.fwd)                    # [E, W]
-        echo_e = net.edge_gather_flat(net.pack_edges(dlv.fe_words))
+        echo_e = net.edge_gather_flat(
+            dlv.fe_words if flat_resident
+            else net.pack_edges(dlv.fe_words)
+        )
         mask_e = net.pack_edges(edge_mask)
-        # receiver-side gate, read at each edge's owner (a local gather)
-        not_mine_e = not_mine[net.csr_row]
-        trans = net.unpack_edges(fwd_e & ~echo_e & mask_e & not_mine_e)
+        # receiver-side gate, read at each edge's owner (a local read)
+        not_mine_e = net.owner_gather(not_mine)
+        trans_e = fwd_e & ~echo_e & mask_e & not_mine_e
+        if flat_resident:
+            # fully-flat commit (round 18): the reductions back to the
+            # peer axis run as ONE segmented scan over [E, W] and the
+            # first-arrival plane commits flat — the dense [N, K, W]
+            # transmit tensor is never materialized. This is the path
+            # the power-law topo-smoke A/B wins on (dead padded slots
+            # cost nothing, at rest or in flight).
+            return finish_delivery_flat(
+                net, msgs, dlv, trans_e, tick, forward_mask=forward_mask,
+                count_events=count_events, queue_cap=queue_cap,
+                val_delay_topic=val_delay_topic,
+            )
+        trans = net.unpack_edges(trans_e)
         return finish_delivery(
             net, msgs, dlv, trans, tick, forward_mask=forward_mask,
             count_events=count_events, queue_cap=queue_cap,
@@ -324,6 +349,96 @@ def finish_delivery(
     if count_events and val_delay > 0:
         # arrival-cohort counters (duplicates/rpc) are already arrival-based
         # inside _round_info only when the cohorts coincide; recompute here
+        n_new = bitset.popcount(new_words, axis=None).astype(jnp.int32).sum()
+        info = info.replace(n_duplicate=info.n_rpc - n_new)
+    return dlv, info
+
+
+def finish_delivery_flat(
+    net: Net,
+    msgs: MsgTable,
+    dlv: Delivery,
+    trans_e: jax.Array,  # [E, W] u32: the round's flat transmit plane
+    tick: jax.Array,
+    forward_mask: jax.Array | None = None,
+    count_events: bool = True,
+    queue_cap: int = 0,
+    val_delay_topic: tuple | None = None,
+) -> tuple[Delivery, RoundInfo]:
+    """The CSR-RESIDENT commit tail (round 18): cap + dedup +
+    first-arrival attribution + pipeline + forward update, with every
+    per-edge quantity staying on the flat [E, W] plane. Exact-equal to
+    ``finish_delivery`` on the unpacked tensor (tests/test_csr.py):
+
+      * the per-peer receive OR and the first-arrival isolation both
+        fall out of ONE segmented prefix-OR over the row segments
+        (ops/csr.segment_or_scan) — ``inc`` at each row's last edge is
+        the receive set, ``x & ~exc`` keeps each bit's first carrying
+        edge, and flat row-major order IS ascending dense slot order,
+        so the attribution matches ``first_set_per_bit`` bit for bit;
+      * the first-arrival plane commits flat — dead padded slots are
+        never resident OR in flight;
+      * ``RoundInfo.trans`` carries the FLAT plane (popcount-compatible
+        with the dense form — absent slots transmit nothing either
+        way). Engines that need the dense tensor (scoring attribution)
+        run the dense-resident path instead.
+    """
+    from ..ops import csr
+
+    m = msgs.capacity
+    val_delay = 0 if dlv.pending is None else dlv.pending.shape[1]
+
+    n_drop = jnp.int32(0)
+    if queue_cap > 0:
+        # per-directed-link budget: one flat row IS one (receiver, edge)
+        # pair, so the cap applies exactly as in the dense form
+        want = trans_e
+        trans_e = bitset.keep_lowest_bits(want, queue_cap, m)
+        n_drop = bitset.popcount(want & ~trans_e, axis=None).sum().astype(jnp.int32)
+
+    inc, exc = csr.segment_or_scan(trans_e, net.csr_seg_start)
+    recv_words = jnp.where(
+        net.csr_row_nonempty[:, None],
+        inc[jnp.clip(net.csr_row_last, 0)], jnp.uint32(0),
+    )  # [N, W]
+    new_words = recv_words & ~dlv.have
+
+    # first-arrival edge, isolated flat: the first edge of each row
+    # carrying each new bit (exc = OR of the row's earlier edges)
+    new_e = net.owner_gather(new_words)
+    fa_e = trans_e & ~exc & new_e
+    valid_words = bitset.pack(msgs.valid)  # [W]
+
+    if val_delay > 0:
+        validated = dlv.pending[:, -1]
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(dlv.pending[:, :1]), dlv.pending[:, :-1]], axis=1
+        )
+        pending = pipeline_insert(shifted, new_words, msgs.topic, val_delay_topic)
+    else:
+        validated = new_words
+        pending = dlv.pending
+
+    validated_bits = bitset.unpack(validated, m)
+    first_round = jnp.where(validated_bits, tick, dlv.first_round)
+
+    fwd_next = validated & valid_words[None, :]
+    if forward_mask is not None:
+        fwd_next = fwd_next & forward_mask
+
+    dlv = dlv.replace(
+        have=dlv.have | new_words,
+        fwd=fwd_next,
+        first_round=first_round,
+        # same overwrite-on-new-receipt rule as the dense commit, on the
+        # flat plane (new_words read at each edge's owner row)
+        fe_words=(dlv.fe_words & ~new_e) | fa_e,
+        pending=pending,
+    )
+
+    info = _round_info(trans_e, validated, m, valid_words, count_events)
+    info = info.replace(recv_new_words=new_words, n_drop=n_drop)
+    if count_events and val_delay > 0:
         n_new = bitset.popcount(new_words, axis=None).astype(jnp.int32).sum()
         info = info.replace(n_duplicate=info.n_rpc - n_new)
     return dlv, info

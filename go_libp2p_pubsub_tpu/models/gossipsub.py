@@ -71,7 +71,7 @@ from ..score.engine import (
     slot_topic_words,
 )
 from ..score.gater import GaterState, gater_accept, gater_decay, gater_on_round
-from ..state import Net, SimState, allocate_publishes
+from ..state import Net, SimState, allocate_publishes, wrap_csr_resident
 from ..trace.events import EV
 from .common import (
     RoundInfo,
@@ -424,13 +424,22 @@ class GossipSubState:
             )
         else:
             p6 = jnp.zeros((n, k), jnp.float32)
+        # CSR-resident tier (round 18): against an edge_layout="csr" Net
+        # the per-edge planes allocate FLAT — fe_words/served_* as
+        # [E, W], peerhave/iasked as [E] — dead padded slots are not
+        # resident (MEM_AUDIT.json's csr rows; the steps densify them
+        # transiently, state.wrap_csr_resident)
+        e = net.n_edges  # None on dense builds
+        ph_shape = (n, k) if e is None else (e,)
+        sv_shape = (n, k, w) if e is None else (e, w)
         return cls(
             core=SimState.init(n, msg_slots, seed, k=k,
                                val_delay=cfg.validation_delay_rounds,
                                wire_block=wire_block,
                                chaos_ge=(cfg.chaos is not None
                                          and cfg.chaos.needs_state),
-                               telemetry=telemetry),
+                               telemetry=telemetry,
+                               n_edges=e),
             mesh=jnp.zeros((n, s, k), bool),
             backoff_expire=jnp.zeros((n, s, k), jnp.int32),
             backoff_present=jnp.zeros((n, s, k), bool),
@@ -443,11 +452,11 @@ class GossipSubState:
             # narrowing contract (cfg.narrow_counters — exact: heartbeat-
             # cleared, cap-bounded; build() refuses caps outside range)
             peerhave=jnp.zeros(
-                (n, k), jnp.int16 if cfg.narrow_counters else jnp.int32),
+                ph_shape, jnp.int16 if cfg.narrow_counters else jnp.int32),
             iasked=jnp.zeros(
-                (n, k), jnp.int16 if cfg.narrow_counters else jnp.int32),
-            served_lo=jnp.zeros((n, k, w), jnp.uint32),
-            served_hi=jnp.zeros((n, k, w), jnp.uint32),
+                ph_shape, jnp.int16 if cfg.narrow_counters else jnp.int32),
+            served_lo=jnp.zeros(sv_shape, jnp.uint32),
+            served_hi=jnp.zeros(sv_shape, jnp.uint32),
             promise_mid=jnp.full((n, k), -1, jnp.int32),
             promise_expire=jnp.zeros((n, k), jnp.int32),
             score=ScoreState.empty(n, s, k),
@@ -2502,6 +2511,14 @@ def make_gossipsub_step(
             st2 = st2.replace(core=core_f.replace(telem=telem))
 
         return st2.replace(core=st2.core.replace(tick=tick + 1))
+
+    if net.edge_layout == "csr":
+        # CSR-resident state tier (round 18, docs/DESIGN.md §18): the
+        # per-edge planes live FLAT in the carry (fe_words/served_*/
+        # peerhave/iasked as [E, ...]); densify at entry, re-pack at
+        # exit — the step body above stays the dense-written program,
+        # bit-exact, while checkpoints/scan carries hold the flat tier
+        _round = wrap_csr_resident(net, _round)
 
     use_static_hb = static_heartbeat and cfg.heartbeat_every > 1
     if lift_scores:

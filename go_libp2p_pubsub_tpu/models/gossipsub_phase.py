@@ -110,7 +110,7 @@ from ..score.engine import (
     slot_topic_words,
 )
 from ..score.gater import gater_on_round
-from ..state import Net, PhasePubPlan, allocate_publishes
+from ..state import Net, PhasePubPlan, allocate_publishes, wrap_csr_resident
 from ..trace.events import EV
 from .common import RoundInfo, accumulate_round_events, finish_delivery
 from .gossipsub import (
@@ -1123,6 +1123,11 @@ def make_gossipsub_phase_step(
             )
             st2 = st2.replace(core=core_f.replace(telem=telem))
         return st2.replace(core=st2.core.replace(tick=tick0 + r))
+
+    if net.edge_layout == "csr":
+        # CSR-resident state tier (round 18): flat planes in the carry,
+        # dense views inside the phase — same wrap as the per-round step
+        _phase = wrap_csr_resident(net, _phase)
 
     if lift_scores:
         # lifted call convention (same as the per-round builder): the

@@ -185,6 +185,21 @@ def masked_keep(planes: list, keep: jax.Array) -> list:
         return out
     n = live[0][1].shape[0]
     w = keep.shape[-1]
+    if any(p.shape[0] != n for _, p in live):
+        # mixed leading dims (a CSR-resident flat [E, W] plane among
+        # [N, ...] planes): fold as one [rows, W] concatenation instead
+        # — elementwise either way, bit-identical to the per-plane ANDs.
+        # The dense all-[N]-leading path below keeps its exact original
+        # shape so the census-pinned programs don't move.
+        flat = [p.reshape(-1, w) for _, p in live]
+        sizes = [f.shape[0] for f in flat]
+        cat = jnp.concatenate(flat, axis=0) & keep[None, :]
+        off = 0
+        for (i, p), sz in zip(live, sizes):
+            out[i] = jax.lax.slice_in_dim(
+                cat, off, off + sz, axis=0).reshape(p.shape)
+            off += sz
+        return out
     flat = [p.reshape(n, -1, w) for _, p in live]
     sizes = [f.shape[1] for f in flat]
     cat = jnp.concatenate(flat, axis=1) & keep[None, None, :]

@@ -43,11 +43,23 @@ Reductions back to peers come in two exact-equivalent forms:
     OR reduction is either a segmented associative scan (log-depth
     passes over [E, W] — the fully-flat form) or the capacity-bounded
     gather (``unpack_edges`` + ``bitset.word_or_reduce`` — one
-    bounded-width pass). The delivery engine uses the bounded-gather
-    form: at bench densities the K-bounded pass reads less than the
-    log2(E) scan sweeps, and its [N, K, W] intermediate is needed for
-    the RoundInfo transmit tensor anyway (docs/DESIGN.md §15 has the
-    tradeoff table). Both are property-tested equal.
+    bounded-width pass). Both are property-tested equal. Which one the
+    delivery engine uses follows the STATE residency (round 18): a
+    CSR-RESIDENT state (flat [E, W] fe_words) takes the fully-flat
+    commit (models/common.finish_delivery_flat — one scan yields both
+    the receive OR and the first-arrival isolation, and the dense
+    [N, K, W] transmit tensor never materializes: the low-density win
+    `make topo-smoke` measures), while a dense-resident state against
+    a csr Net keeps the bounded-gather form (its [N, K, W]
+    intermediate feeds RoundInfo's dense consumers — the gossipsub
+    scoring path; docs/DESIGN.md §15/§18 have the tradeoff table).
+
+Sharding (round 18): the flat edge space partitions WITH the peer
+axis — row-owner order means block boundaries chosen at row_ptr
+entries (``block_boundaries``) give each shard whole rows, and
+``pad_csr_blocks`` equalizes the blocks with inert padding edges so
+GSPMD block sharding is legal on any ragged graph
+(state.Net.build(edge_shards=...), parallel.state_shardings).
 
 Word-dtype hygiene: every literal in a packed-word op below is an
 explicit ``jnp.uint32`` (simlint ``word-dtype``); no traced Python
@@ -91,29 +103,135 @@ class CsrTopology:
         return self.col.shape[0]
 
     @property
+    def n_real_edges(self) -> int:
+        """Present (non-padding) edge count — equals ``n_edges`` except
+        on block-padded builds (pad_csr_blocks), whose inert padding
+        edges never appear in ``e_of_nk``."""
+        return int((self.e_of_nk >= 0).sum())
+
+    @property
     def density(self) -> float:
-        """E / (N*K): the fraction of padded slots that hold an edge —
-        the dense-vs-CSR byte ratio for per-edge exchange traffic."""
-        return self.n_edges / float(self.n_peers * self.max_degree)
+        """Real E / (N*K): the fraction of padded slots that hold an
+        edge — the dense-vs-CSR byte ratio for per-edge exchange
+        traffic. Padding edges don't count."""
+        return self.n_real_edges / float(self.n_peers * self.max_degree)
 
     @property
     def seg_start(self) -> np.ndarray:
-        """[E] bool: True at the first edge of each (nonempty) row —
-        the segmented-scan reset flags."""
-        s = np.zeros(self.n_edges, bool)
-        starts = self.row_ptr[:-1][self.row_ptr[:-1] < self.row_ptr[1:]]
-        s[starts] = True
+        """[E] bool: True at the first edge of each flat row segment —
+        the segmented-scan reset flags. Derived from the flat ``row``
+        ordering (NOT row_ptr, which no longer indexes the edge axis on
+        block-padded builds): padding edges extend their block's last
+        row segment and carry zeros, so reductions never see them."""
+        s = np.ones(self.n_edges, bool)
+        if self.n_edges:
+            s[1:] = self.row[1:] != self.row[:-1]
         return s
 
     @property
     def row_last(self) -> np.ndarray:
-        """[N] i32: index of each row's last edge (clip-safe junk for
-        empty rows — pair with ``row_nonempty``)."""
-        return np.maximum(self.row_ptr[1:] - 1, 0).astype(np.int32)
+        """[N] i32: flat index of each row's last edge (clip-safe junk
+        for empty rows — pair with ``row_nonempty``). searchsorted over
+        the sorted flat ``row``, so padded builds resolve to the end of
+        the row's segment (trailing padding edges carry zeros inside
+        the same segment — the inclusive scan's value is unchanged)."""
+        return np.maximum(
+            np.searchsorted(self.row, np.arange(self.n_peers),
+                            side="right") - 1, 0).astype(np.int32)
 
     @property
     def row_nonempty(self) -> np.ndarray:
-        return (self.row_ptr[1:] > self.row_ptr[:-1])
+        """[N] bool: rows owning at least one REAL edge."""
+        return (self.e_of_nk >= 0).any(axis=1)
+
+
+def block_boundaries(row_ptr: np.ndarray, n_blocks: int) -> np.ndarray:
+    """[n_blocks+1] edge indices partitioning [0, E) into ``n_blocks``
+    row-ptr-ALIGNED spans: every boundary is a ``row_ptr`` entry (each
+    block owns whole rows), each chosen as the row boundary nearest the
+    ideal equal split ``E*i/n_blocks``. Monotone by construction —
+    blocks can be empty on pathologically skewed graphs (one hub row
+    holding more than E/n_blocks edges), which padding then equalizes."""
+    row_ptr = np.asarray(row_ptr, np.int64)
+    e = int(row_ptr[-1])
+    bounds = np.zeros(n_blocks + 1, np.int64)
+    bounds[-1] = e
+    for i in range(1, n_blocks):
+        ideal = (e * i) // n_blocks
+        # nearest row boundary to the ideal split
+        j = int(np.searchsorted(row_ptr, ideal))
+        lo = row_ptr[j - 1] if j > 0 else row_ptr[0]
+        hi = row_ptr[j] if j < row_ptr.shape[0] else row_ptr[-1]
+        bounds[i] = int(hi if (hi - ideal) <= (ideal - lo) else lo)
+    # enforce monotonicity (degenerate skew can make neighbors cross)
+    np.maximum.accumulate(bounds, out=bounds)
+    return bounds.astype(np.int32)
+
+
+def pad_csr_blocks(ct: CsrTopology, n_blocks: int
+                   ) -> tuple["CsrTopology", np.ndarray]:
+    """Pad a CSR build so the edge axis splits into ``n_blocks`` EQUAL
+    row-owner-aligned blocks — the shape contract GSPMD block sharding
+    needs (parallel: the [E] planes partition by row owner, so each
+    shard's halo is its boundary rows, never a row split mid-way).
+
+    Padding edges are inert by construction: ``e_valid`` is False,
+    ``eperm`` self-points (the involution stays an involution),
+    ``e_of_nk`` never maps a dense slot to them (unpack ignores them),
+    and ``pack_edges``/``peer_gather_flat`` mask them to zero via
+    ``e_valid`` — so every flat plane carries 0 there forever and
+    segment reductions see no contribution. ``row`` takes the owning
+    block's last real row (keeps the sorted-row invariant segment_sum
+    relies on). Returns ``(padded_topology, e_valid[E'])``."""
+    bounds = block_boundaries(ct.row_ptr, n_blocks)
+    seg_lens = np.diff(bounds)
+    block = int(seg_lens.max()) if n_blocks else 0
+    e_new = block * n_blocks
+    n, k = ct.e_of_nk.shape
+
+    col = np.zeros(e_new, np.int32)
+    row = np.zeros(e_new, np.int32)
+    slot = np.zeros(e_new, np.int32)
+    e2nk = np.zeros(e_new, np.int32)
+    eperm = np.zeros(e_new, np.int32)
+    e_valid = np.zeros(e_new, bool)
+    e_of_nk = np.full((n, k), -1, np.int32)
+    new_of_old = np.zeros(ct.n_edges, np.int32)
+    for b in range(n_blocks):
+        lo, hi = int(bounds[b]), int(bounds[b + 1])
+        dst = b * block
+        sl = slice(dst, dst + (hi - lo))
+        new_of_old[lo:hi] = np.arange(dst, dst + (hi - lo), dtype=np.int32)
+        col[sl] = ct.col[lo:hi]
+        row[sl] = ct.row[lo:hi]
+        slot[sl] = ct.slot[lo:hi]
+        e2nk[sl] = ct.e2nk[lo:hi]
+        e_valid[sl] = True
+        pad = slice(dst + (hi - lo), dst + block)
+        # inert rows: the block's last owned row (sorted-row invariant);
+        # an empty block inherits the previous boundary's row
+        pad_row = int(ct.row[hi - 1]) if hi > lo else (
+            int(ct.row[lo - 1]) if lo > 0 else 0)
+        row[pad] = pad_row
+        col[pad] = pad_row
+        e2nk[pad] = pad_row * k  # junk target; masked by e_valid
+        eperm[pad] = np.arange(dst + (hi - lo), dst + block, dtype=np.int32)
+    eperm[e_valid] = new_of_old[ct.eperm]
+    e_of_nk[ct.row, ct.slot] = new_of_old
+
+    row_ptr = np.zeros(n + 1, np.int64)
+    np.cumsum(np.bincount(ct.row, minlength=n), out=row_ptr[1:])
+    # row_ptr keeps addressing the REAL edges of each row — but the flat
+    # axis is no longer contiguous per row across block boundaries, so
+    # the padded build keeps the original row_ptr only as degree info
+    padded = CsrTopology(
+        row_ptr=row_ptr.astype(np.int32),
+        col=col, row=row, slot=slot, e2nk=e2nk,
+        e_of_nk=e_of_nk, eperm=eperm,
+    )
+    if not (padded.eperm[padded.eperm] == np.arange(e_new)).all():
+        raise AssertionError("pad_csr_blocks: padded eperm lost involution")
+    return padded, e_valid
 
 
 def build_csr(nbr: np.ndarray, rev: np.ndarray,
@@ -191,14 +309,15 @@ def unpack_edges(x_e: jax.Array, e_of_nk: jax.Array,
 def edge_permute_flat(x_e: jax.Array, eperm: jax.Array) -> jax.Array:
     """The edge involution in flat space: out[e] = x_e[eperm[e]] —
     E-sized cross-peer movement (the dense form moves N*K)."""
-    _edges._tally("edge")
+    _edges._tally("edge", x_e)
     return x_e[eperm]
 
 
 def peer_gather_flat(v: jax.Array, col: jax.Array) -> jax.Array:
     """Flat neighbor view: out[e] = v[col[e]] ([N, ...] -> [E, ...])."""
-    _edges._tally("peer")
-    return v[col]
+    out = v[col]
+    _edges._tally("peer", out)
+    return out
 
 
 # ---------------------------------------------------------------------------

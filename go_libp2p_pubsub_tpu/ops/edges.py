@@ -34,11 +34,18 @@ WORD = 32
 # keeping the hot path untouched.
 
 _TALLY: list | None = None
+_BYTES_TALLY: list | None = None
 
 
-def _tally(kind: str) -> None:
+def _tally(kind: str, moved=None) -> None:
     if _TALLY is not None:
         _TALLY.append(kind)
+    if _BYTES_TALLY is not None:
+        nbytes = None
+        if moved is not None and hasattr(moved, "size"):
+            # traced shapes are static, so the audited volume is exact
+            nbytes = int(moved.size) * moved.dtype.itemsize
+        _BYTES_TALLY.append((kind, nbytes))
 
 
 @contextlib.contextmanager
@@ -56,6 +63,58 @@ def tally_halo_gathers(out: list):
         _TALLY = prev
 
 
+@contextlib.contextmanager
+def tally_halo_bytes(out: list):
+    """Collect ``(kind, nbytes)`` per cross-peer gather traced inside
+    the block — the AUDITED bytes-moved accounting (round 18): nbytes
+    is the byte volume of the moved tensor (the edge involution moves
+    its whole operand, a peer gather moves its neighbor-view output),
+    so on the flat CSR layout the same seam audits E-sized movement
+    where the dense layout audits N·K — the topo-smoke A/B's second
+    leg. Entries whose seam predates the accounting read None."""
+    global _BYTES_TALLY
+    prev = _BYTES_TALLY
+    _BYTES_TALLY = out
+    try:
+        yield out
+    finally:
+        _BYTES_TALLY = prev
+
+
+def tally_step(step, state, args=(), kwargs=None, *, net=None,
+               count_bytes: bool = False) -> list:
+    """Trace ONE step call under the armed halo tally and return the
+    raw tally list — the shared harness behind `make hlo-audit`'s
+    equal-tally legs, mesh2d_dryrun's halo census, and topo-smoke's
+    audited-bytes leg. Unwraps to the UNJITTED body itself because the
+    caveat lives here, once: jax's tracing cache is keyed on the jitted
+    function, so eval_shape of the jit can hit a cached jaxpr from an
+    earlier trace and silently record ZERO seams — the raw body
+    re-traces every time. ``net`` is threaded as the leading positional
+    for engine bodies that take it (the guards harness convention);
+    ``count_bytes`` switches the tally to (kind, nbytes) entries."""
+    import jax
+
+    raw = getattr(step, "__wrapped__", step)
+    kwargs = dict(kwargs or {})
+    out: list = []
+    ctx = tally_halo_bytes(out) if count_bytes else tally_halo_gathers(out)
+    with ctx:
+        if net is not None:
+            jax.eval_shape(lambda s: raw(net, s, *args, **kwargs), state)
+        else:
+            jax.eval_shape(lambda s: raw(s, *args, **kwargs), state)
+    return out
+
+
+def fold_tally(tally: list) -> dict:
+    """{"total": n, kind: count, ...} of a tally_halo_gathers list."""
+    out = {"total": len(tally)}
+    for kind in tally:
+        out[kind] = out.get(kind, 0) + 1
+    return out
+
+
 def n_topic_words(n_topics: int) -> int:
     return (n_topics + WORD - 1) // WORD
 
@@ -71,7 +130,7 @@ def build_edge_perm(nbr: np.ndarray, rev: np.ndarray, nbr_ok: np.ndarray) -> np.
 
 def edge_permute(x: jax.Array, perm: jax.Array) -> jax.Array:
     """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] as a flat row gather."""
-    _tally("edge")
+    _tally("edge", x)
     n, k = perm.shape
     flat = x.reshape((n * k,) + x.shape[2:])
     return flat[perm.reshape(-1)].reshape(x.shape)
@@ -97,7 +156,7 @@ def edge_permute_banded(
     x: jax.Array, off: tuple[int, ...], rev: tuple[int, ...]
 ) -> jax.Array:
     """Banded-regular edge_permute: out[j,k] = x[(j+off[k]) % N, rev[k]]."""
-    _tally("edge")
+    _tally("edge", x)
     cols = [jnp.roll(x[:, r], -o, axis=0) for o, r in zip(off, rev)]
     return jnp.stack(cols, axis=1)
 
@@ -136,8 +195,9 @@ def edge_permute_banded_flat(
 
 def peer_gather_banded(v: jax.Array, off: tuple[int, ...]) -> jax.Array:
     """Banded-regular v[nbr]: out[j,k] = v[(j+off[k]) % N]."""
-    _tally("peer")
-    return jnp.stack([jnp.roll(v, -o, axis=0) for o in off], axis=1)
+    out = jnp.stack([jnp.roll(v, -o, axis=0) for o in off], axis=1)
+    _tally("peer", out)
+    return out
 
 
 def topic_pack(x: jax.Array, my_topics: jax.Array, n_topics: int) -> jax.Array:

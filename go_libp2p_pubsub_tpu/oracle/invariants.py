@@ -298,7 +298,11 @@ def _fwd_subset_have(ctx) -> jax.Array:
         "P3/P7 scoring reads)")
 def _first_edge_wf(ctx) -> jax.Array:
     dlv = ctx.core.dlv
-    fe = dlv.fe_words                    # [N, K, W]
+    fe = dlv.fe_words                    # [N, K, W] ([E, W] CSR-resident)
+    if fe.ndim == 2:
+        # CSR-resident flat plane (round 18): the checker never donates
+        # and runs off the hot path, so the transient unpack is fine
+        fe = ctx.net.unpack_edges(fe)
     k_dim = fe.shape[1]
     acc = jnp.zeros_like(dlv.have)
     multi = jnp.zeros_like(dlv.have)

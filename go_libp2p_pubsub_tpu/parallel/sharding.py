@@ -90,24 +90,41 @@ def peer_spec(mesh: Mesh) -> P:
     return P(tuple(mesh.axis_names)) if len(mesh.axis_names) > 1 else P(mesh.axis_names[0])
 
 
-def state_shardings(state, mesh: Mesh, n_peers: int):
+def state_shardings(state, mesh: Mesh, n_peers: int,
+                    n_edges: int | None = None):
     """Pytree of NamedShardings: leaves with leading dim == n_peers are
     sharded along the peer axes (all mesh axes); everything else is
-    replicated."""
+    replicated.
+
+    ``n_edges`` (round 18) extends the rule to the CSR-RESIDENT flat
+    planes: leaves with leading dim == E shard over the SAME peer axes.
+    Because the flat edge space is row-owner-ordered (ops/csr.py) and —
+    on ``edge_shards=`` builds — padded to row-owner-ALIGNED equal
+    blocks (pad_csr_blocks), each peer shard owns whole rows of the
+    edge axis: the [E] partition follows the [N] partition, so a
+    shard's cross-peer traffic stays the same boundary halo the dense
+    involution pays. Pass ``net.n_edges`` (None on dense builds)."""
     peer = NamedSharding(mesh, peer_spec(mesh))
     repl = NamedSharding(mesh, P())
 
     def choose(leaf):
-        if hasattr(leaf, "shape") and leaf.ndim >= 1 and leaf.shape[0] == n_peers:
+        if not hasattr(leaf, "shape") or leaf.ndim < 1:
+            return repl
+        if leaf.shape[0] == n_peers:
+            return peer
+        if n_edges is not None and leaf.shape[0] == n_edges:
             return peer
         return repl
 
     return jax.tree_util.tree_map(choose, state)
 
 
-def shard_state(state, mesh: Mesh, n_peers: int):
-    """Place a state pytree onto the mesh with peer-axis sharding."""
-    return jax.device_put(state, state_shardings(state, mesh, n_peers))
+def shard_state(state, mesh: Mesh, n_peers: int,
+                n_edges: int | None = None):
+    """Place a state pytree onto the mesh with peer-axis sharding
+    (``n_edges`` shards the CSR-resident flat planes too)."""
+    return jax.device_put(
+        state, state_shardings(state, mesh, n_peers, n_edges=n_edges))
 
 
 def collective_profile(hlo_text: str) -> dict:

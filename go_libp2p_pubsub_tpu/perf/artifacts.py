@@ -127,6 +127,48 @@ SERVICE_OFF = {"enabled": False, "segment_rounds": 0,
                "probes": [], "recoveries": 0, "segments": 0, "resumes": 0}
 
 
+#: the topology defaults every artifact WITHOUT a
+#: fingerprint["topology"] block reads back as (round 18): the whole
+#: pre-round-18 bench trajectory was cut on the banded bench ring
+#: (graph.ring_lattice d=8) — an explicit sentinel naming that shape,
+#: so readers can ask any artifact "what graph did this number run on"
+#: without special-casing age. ``recorded: False`` marks the answer as
+#: the historical default, not a measured emission.
+TOPOLOGY_BANDED = {"recorded": False, "generator": "ring_lattice",
+                   "family": "banded-regular", "params": {},
+                   "n_edges": None, "mean_degree": None,
+                   "max_degree": None, "density": None, "seed": None,
+                   "link_classes": None, "workload_pattern": None}
+
+
+def topology_fingerprint(*, generator: str, family: str, params: dict,
+                         n_edges: int, mean_degree: float,
+                         max_degree: int, density: float,
+                         seed: int | None = None,
+                         link_classes: dict | None = None,
+                         workload_pattern: str | None = None) -> dict:
+    """The schema-v3 ``fingerprint["topology"]`` block (round 18): the
+    generated graph a cell ran on — generator name + parameters, the
+    edge count / degree statistics that price the sparse plane
+    (``density`` = E/(N·K) IS the dense-vs-csr byte ratio), optional
+    geo link-class counts, and the workload pattern riding the publish
+    xs. Legacy lines read back :data:`TOPOLOGY_BANDED` via
+    ``BenchRecord.topology``."""
+    return {
+        "recorded": True,
+        "generator": str(generator),
+        "family": str(family),
+        "params": dict(params),
+        "n_edges": int(n_edges),
+        "mean_degree": round(float(mean_degree), 4),
+        "max_degree": int(max_degree),
+        "density": round(float(density), 6),
+        "seed": None if seed is None else int(seed),
+        "link_classes": dict(link_classes) if link_classes else None,
+        "workload_pattern": workload_pattern,
+    }
+
+
 def service_fingerprint(*, segment_rounds: int, keep_last: int,
                         keep_every: int, probes=(), recoveries: int = 0,
                         segments: int = 0, resumes: int = 0) -> dict:
@@ -468,6 +510,22 @@ class BenchRecord:
     @property
     def service_on(self) -> bool:
         return bool(self.service["enabled"])
+
+    @property
+    def topology(self) -> dict:
+        """The topology block of the fingerprint (round 18). LEGACY
+        artifacts — the whole pre-round-18 trajectory — read back
+        :data:`TOPOLOGY_BANDED` (the banded bench ring, explicitly
+        marked unrecorded), so readers can ask any artifact "what
+        graph did this run on" without special-casing age."""
+        fp = self.fingerprint or {}
+        out = dict(TOPOLOGY_BANDED)
+        out.update(fp.get("topology") or {})
+        return out
+
+    @property
+    def topology_recorded(self) -> bool:
+        return bool(self.topology["recorded"])
 
     @property
     def scanned(self) -> bool | None:

@@ -274,6 +274,24 @@ class ScaleProjection:
         return out
 
 
+def audit_bytes_per_peer(audit: dict, engine: str = "gossipsub",
+                         edge_layout: str = "dense",
+                         density: float = 1.0) -> float:
+    """Resident bytes/peer for the ACTIVE layout, from a MEM_AUDIT.json
+    dict (round 18 — the headroom fix: a csr run's memory term prices
+    the CSR-RESIDENT tier at ITS density E/(N·K), instead of always
+    charging dense capacity). ``edge_layout="dense"`` reads the classic
+    totals, so every committed projection reproduces unchanged."""
+    if edge_layout == "dense":
+        return float(
+            audit["engines"][engine]["totals"]["bytes_per_peer"])
+    tier = audit["csr_tier"]["engines"][f"{engine}_csr"]
+    return float(
+        tier["dense_engine_bytes_per_peer"]
+        - tier["flat_bytes_per_peer_at_full_density"]
+        * (1.0 - float(density)))
+
+
 def project_at_scale(n_peers: int, rounds_per_phase: int = 16,
                      n_shards: int = 8, *,
                      bytes_per_peer: float | None = None,
@@ -281,7 +299,10 @@ def project_at_scale(n_peers: int, rounds_per_phase: int = 16,
                      shard_rates: dict | None = None,
                      permute_sets_per_phase: int | None = None,
                      dispatch_overhead_ms: float = 0.0,
-                     dispatches_per_round: float | None = None
+                     dispatches_per_round: float | None = None,
+                     audit: dict | None = None,
+                     edge_layout: str = "dense",
+                     density: float = 1.0,
                      ) -> ScaleProjection:
     """Project the v5e-8 rate at an ARBITRARY peer count (the round-15
     ask: the 10k-ticks/s target priced at 1M peers, not just 100k).
@@ -302,10 +323,19 @@ def project_at_scale(n_peers: int, rounds_per_phase: int = 16,
     launch latency at any shard size (the round-3 cost model), and the
     permute COUNT is topology-band-bound, not N-bound.
 
+    Round 18: pass ``audit=`` (the loaded MEM_AUDIT.json dict) with
+    ``edge_layout``/``density`` instead of a hand-picked
+    ``bytes_per_peer`` and the memory term prices the ACTIVE layout —
+    on ``edge_layout="csr"`` the CSR-resident tier's bytes/peer DROPS
+    with the topology density (:func:`audit_bytes_per_peer`).
+
     Defaults change nothing committed: :func:`project` and
     :func:`project_from_artifacts` are untouched, so every pre-round-15
     projection reproduces byte-identical (tests/test_perf.py round-5
     pin; tests/test_csr.py pins this function against the table)."""
+    if bytes_per_peer is None and audit is not None:
+        bytes_per_peer = audit_bytes_per_peer(
+            audit, edge_layout=edge_layout, density=density)
     if n_shards < 1:
         raise ValueError(f"n_shards must be >= 1, got {n_shards}")
     shard_n = int(n_peers) // int(n_shards)

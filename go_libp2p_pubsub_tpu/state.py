@@ -63,6 +63,29 @@ class Net:
     csr_eperm: jax.Array | None = None    # [E] i32 flat involution
     csr_e2nk: jax.Array | None = None     # [E] i32 pack gather (n*K+k)
     csr_e_of_nk: jax.Array | None = None  # [N,K] i32 unpack map, -1 absent
+    # flat segment structure (round 18): row-segment starts / per-row
+    # last-edge index / nonempty rows — what the fully-flat delivery
+    # commit's segmented reductions need (models/common.py; derived from
+    # the FLAT ordering so they stay correct on block-padded builds)
+    csr_seg_start: jax.Array | None = None     # [E] bool
+    csr_row_last: jax.Array | None = None      # [N] i32 (clip-safe junk
+                                               #  on empty rows)
+    csr_row_nonempty: jax.Array | None = None  # [N] bool
+    # block padding (edge-space sharding, round 18): present only on
+    # ``edge_shards=...`` builds — inert padding edges equalize the
+    # row-owner-aligned shard blocks (ops/csr.pad_csr_blocks); every
+    # flat plane carries 0 there forever
+    csr_e_valid: jax.Array | None = None       # [E] bool, None = no pad
+    # static aux structure of the flat layout (trace-time, like band_off):
+    # csr_identity — e2nk == arange(E) (full-density row-major build), so
+    # pack/unpack are pure RESHAPES (GSPMD splits the sharded edge axis
+    # without collectives); csr_band_* — the banded-regular roll structure
+    # detected on the underlying topology, so the FLAT cross-peer gathers
+    # lower to the same static rolls (= halo collective-permutes under
+    # GSPMD) the dense involution compiles to
+    csr_identity: bool = struct.field(pytree_node=False, default=False)
+    csr_band_off: tuple = struct.field(pytree_node=False, default=None)
+    csr_band_rev: tuple = struct.field(pytree_node=False, default=None)
 
     def edge_gather(self, x: jax.Array) -> jax.Array:
         """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] (the edge involution).
@@ -70,10 +93,11 @@ class Net:
         (self-pointing — both layouts reproduce the same values, so
         dense-vs-CSR parity is bit-exact even on unmasked planes)."""
         if self.edge_layout == "csr":
-            got = csr.unpack_edges(
-                csr.edge_permute_flat(self.pack_edges(x), self.csr_eperm),
-                self.csr_e_of_nk,
+            got = self.unpack_edges(
+                self.edge_gather_flat(self.pack_edges(x))
             )
+            if self.csr_identity:
+                return got  # every slot present — no junk to fill
             # absent slots: the dense perm self-points (build_edge_perm),
             # so the junk value is the slot's own entry
             present = (self.csr_e_of_nk >= 0).reshape(
@@ -88,36 +112,83 @@ class Net:
         contract as edge_gather (absent slots read v[0] in both layouts —
         the dense path's clip(-1, 0))."""
         if self.edge_layout == "csr":
-            got = csr.unpack_edges(
-                csr.peer_gather_flat(v, self.csr_col), self.csr_e_of_nk,
-            )
+            got = self.unpack_edges(self.peer_gather_flat(v))
+            if self.csr_identity:
+                return got
             present = (self.csr_e_of_nk >= 0).reshape(
                 self.csr_e_of_nk.shape + (1,) * (v.ndim - 1))
             return jnp.where(present, got, v[0])
         if self.band_off is not None:
             return edges.peer_gather_banded(v, self.band_off)
-        edges._tally("peer")
-        return v[jnp.clip(self.nbr, 0)]
+        out = v[jnp.clip(self.nbr, 0)]
+        edges._tally("peer", out)
+        return out
 
     # -- flat-edge-space face (edge_layout="csr" only) ---------------------
 
     def pack_edges(self, x: jax.Array) -> jax.Array:
         """[N, K, ...] -> [E, ...]: the present slots, row-major (a
-        LOCAL relayout — adds nothing to the halo-permute budget)."""
-        return csr.pack_edges(x, self.csr_e2nk, self.max_degree)
+        LOCAL relayout — adds nothing to the halo-permute budget). On a
+        full-density row-major build (``csr_identity``) this is a pure
+        reshape — GSPMD splits the sharded axis with no collective."""
+        if self.csr_identity:
+            n, k = x.shape[:2]
+            return x.reshape((n * k,) + x.shape[2:])
+        got = csr.pack_edges(x, self.csr_e2nk, self.max_degree)
+        if self.csr_e_valid is not None:
+            keep = self.csr_e_valid.reshape(
+                (-1,) + (1,) * (got.ndim - 1))
+            got = jnp.where(keep, got, jnp.zeros((), got.dtype))
+        return got
 
     def unpack_edges(self, x_e: jax.Array, fill=None) -> jax.Array:
-        """[E, ...] -> [N, K, ...]; absent slots take ``fill`` (zero)."""
+        """[E, ...] -> [N, K, ...]; absent slots take ``fill`` (zero).
+        Padding edges of a block-padded build are never addressed by
+        ``e_of_nk``, so they simply vanish here."""
+        if self.csr_identity:
+            n, k = self.csr_e_of_nk.shape
+            return x_e.reshape((n, k) + x_e.shape[1:])
         return csr.unpack_edges(x_e, self.csr_e_of_nk, fill)
 
     def edge_gather_flat(self, x_e: jax.Array) -> jax.Array:
         """The involution on a flat edge plane: out[e] = x_e[eperm[e]]
-        — E-sized cross-peer movement."""
+        — E-sized cross-peer movement. On a banded-regular full-density
+        build the gather lowers as the dense banded ROLLS (the same
+        halo collective-permute structure under GSPMD)."""
+        if self.csr_band_off is not None:
+            n, k = self.csr_e_of_nk.shape
+            out = edges.edge_permute_banded(
+                x_e.reshape((n, k) + x_e.shape[1:]),
+                self.csr_band_off, self.csr_band_rev,
+            )
+            return out.reshape((n * k,) + x_e.shape[1:])
         return csr.edge_permute_flat(x_e, self.csr_eperm)
 
+    def owner_gather(self, v: jax.Array) -> jax.Array:
+        """v[N, ...] read at each edge's OWNER row: out[e] = v[row[e]].
+        A LOCAL read — each edge shard reads its own rows (row-owner
+        partition), so this never crosses the peer axis; on identity
+        builds it is a broadcast+reshape, so GSPMD sees no gather at
+        all (the sharded-CSR zero-all-gather contract)."""
+        if self.csr_identity:
+            n, k = self.csr_e_of_nk.shape
+            out = jnp.broadcast_to(v[:, None], (n, k) + v.shape[1:])
+            return out.reshape((n * k,) + v.shape[1:])
+        return v[self.csr_row]
+
     def peer_gather_flat(self, v: jax.Array) -> jax.Array:
-        """Flat neighbor view: out[e] = v[col[e]]."""
-        return csr.peer_gather_flat(v, self.csr_col)
+        """Flat neighbor view: out[e] = v[col[e]] (rolls on a
+        banded-regular full-density build, like the dense form)."""
+        if self.csr_band_off is not None:
+            n, k = self.csr_e_of_nk.shape
+            out = edges.peer_gather_banded(v, self.csr_band_off)
+            return out.reshape((n * k,) + v.shape[1:])
+        got = csr.peer_gather_flat(v, self.csr_col)
+        if self.csr_e_valid is not None:
+            keep = self.csr_e_valid.reshape(
+                (-1,) + (1,) * (got.ndim - 1))
+            got = jnp.where(keep, got, jnp.zeros((), got.dtype))
+        return got
 
     @classmethod
     def build(
@@ -128,6 +199,7 @@ class Net:
         direct: np.ndarray | None = None,
         protocol: np.ndarray | None = None,
         edge_layout: str = "dense",
+        edge_shards: int | None = None,
     ) -> "Net":
         n = topo.n_peers
         if ip_group is None:
@@ -140,18 +212,55 @@ class Net:
             raise ValueError(
                 f"edge_layout must be 'dense' or 'csr', got {edge_layout!r}"
             )
+        if edge_shards is not None and edge_layout != "csr":
+            raise ValueError(
+                "edge_shards is an edge-space sharding knob — it needs "
+                "edge_layout='csr'"
+            )
         csr_kw: dict = {}
         if edge_layout == "csr":
             ct = csr.build_csr(topo.nbr, topo.rev, topo.nbr_ok)
+            e_valid = None
+            if edge_shards is not None and edge_shards > 1:
+                ct, e_valid = csr.pad_csr_blocks(ct, int(edge_shards))
+                if e_valid.all():
+                    # blocks divided evenly — no padding, no mask cost
+                    e_valid = None
+            e = ct.n_edges
+            # flat segment structure from the FLAT ordering (the
+            # CsrTopology properties derive it from ct.row, so it stays
+            # correct on block-padded builds: padding edges extend
+            # their block's last row segment and carry zeros)
+            seg_start = ct.seg_start
+            row_last = ct.row_last
+            row_nonempty = topo.degree > 0
+            # static flat structure: identity pack/unpack (full-density
+            # row-major) and the banded-roll lowering for the flat
+            # gathers (both require every padded slot present)
+            identity = bool((ct.e2nk == np.arange(e)).all())
+            band_flat = (
+                edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
+                if identity else None
+            )
             csr_kw = dict(
                 csr_col=jnp.asarray(ct.col),
                 csr_row=jnp.asarray(ct.row),
                 csr_eperm=jnp.asarray(ct.eperm),
                 csr_e2nk=jnp.asarray(ct.e2nk),
                 csr_e_of_nk=jnp.asarray(ct.e_of_nk),
+                csr_seg_start=jnp.asarray(seg_start),
+                csr_row_last=jnp.asarray(row_last),
+                csr_row_nonempty=jnp.asarray(row_nonempty),
+                csr_e_valid=(
+                    jnp.asarray(e_valid) if e_valid is not None else None
+                ),
+                csr_identity=identity,
+                csr_band_off=band_flat[0] if band_flat else None,
+                csr_band_rev=band_flat[1] if band_flat else None,
             )
-            # the banded-roll and Pallas fast paths key off band_off;
-            # a CSR build must never fall into them
+            # the DENSE banded-roll and Pallas fast paths key off
+            # band_off; a CSR build must never fall into them (the flat
+            # analogue rides csr_band_off above)
             band = None
         else:
             band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
@@ -306,7 +415,12 @@ class Delivery:
     have: jax.Array         # [N, W] u32
     fwd: jax.Array          # [N, W] u32
     first_round: jax.Array  # [N, M] i32
-    fe_words: jax.Array     # [N, K, W] u32
+    fe_words: jax.Array     # [N, K, W] u32 dense; [E, W] u32 on a
+                            # CSR-RESIDENT build (round 18): states built
+                            # against an edge_layout="csr" Net keep the
+                            # per-edge plane flat — dead padded slots are
+                            # not resident (the next memory tier in
+                            # MEM_AUDIT.json). ndim distinguishes the two.
     # async-validation pipeline (survey §7 hard-part (c); the reference's
     # parallel validation workers, validation.go:123-135): receipts sit in
     # V shift stages between arrival and their validation verdict; absent
@@ -317,16 +431,29 @@ class Delivery:
     def first_edge(self) -> jax.Array:
         """[N, M] i8: first-arrival edge slot per message, -1 when none
         (local publish or never received)."""
+        if self.fe_words.ndim == 2:
+            raise ValueError(
+                "first_edge needs the dense [N, K, W] plane, but this "
+                "state is CSR-resident (flat [E, W] fe_words) — densify "
+                "first: state.densify_edge_planes(net, st)"
+            )
         return bitset.first_edge_of(self.fe_words, self.first_round.shape[-1])
 
     @classmethod
-    def empty(cls, n: int, m: int, k: int = 0, val_delay: int = 0) -> "Delivery":
+    def empty(cls, n: int, m: int, k: int = 0, val_delay: int = 0,
+              n_edges: int | None = None) -> "Delivery":
+        """``n_edges`` selects the CSR-RESIDENT first-arrival plane:
+        ``fe_words`` allocates flat ``[E, W]`` instead of ``[N, K, W]``
+        (pass ``net.n_edges`` — None on a dense build, so
+        ``n_edges=net.n_edges`` does the right thing for both
+        layouts)."""
         w = bitset.n_words(m)
+        fe_shape = (n, k, w) if n_edges is None else (n_edges, w)
         return cls(
             have=jnp.zeros((n, w), jnp.uint32),
             fwd=jnp.zeros((n, w), jnp.uint32),
             first_round=jnp.full((n, m), -1, jnp.int32),
-            fe_words=jnp.zeros((n, k, w), jnp.uint32),
+            fe_words=jnp.zeros(fe_shape, jnp.uint32),
             pending=jnp.zeros((n, val_delay, w), jnp.uint32) if val_delay > 0 else None,
         )
 
@@ -372,7 +499,8 @@ class SimState:
     @classmethod
     def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0,
              val_delay: int = 0, wire_block: bool = False,
-             chaos_ge: bool = False, telemetry=None) -> "SimState":
+             chaos_ge: bool = False, telemetry=None,
+             n_edges: int | None = None) -> "SimState":
         """`k` is the topology's padded max degree (net.max_degree) — it
         sizes the packed first-arrival-edge plane. k=0 is only for states
         that never enter a delivery round (e.g. checkpoint plumbing).
@@ -382,7 +510,10 @@ class SimState:
         `chaos_ge` adds the Gilbert–Elliott link-fault chain plane
         (required iff the build's ChaosConfig.needs_state).
         `telemetry` (a telemetry.TelemetryConfig) allocates the on-device
-        time-series panel — required iff the build's step records one."""
+        time-series panel — required iff the build's step records one.
+        `n_edges` (round 18) selects the CSR-RESIDENT first-arrival plane
+        ([E, W] instead of [N, K, W]) — pass ``net.n_edges``, which is
+        None on dense builds so the same call works for both layouts."""
         if telemetry is not None:
             from .telemetry.panel import TelemetryState
 
@@ -393,11 +524,104 @@ class SimState:
             tick=jnp.int32(0),
             key=jax.random.key(seed),
             msgs=MsgTable.empty(msg_slots, wire_block=wire_block),
-            dlv=Delivery.empty(n_peers, msg_slots, k, val_delay),
+            dlv=Delivery.empty(n_peers, msg_slots, k, val_delay,
+                               n_edges=n_edges),
             events=zero_counters(),
             chaos=ChaosState.empty(n_peers, k) if chaos_ge else None,
             telem=telem,
         )
+
+
+# ---------------------------------------------------------------------------
+# CSR-resident plane conversion (round 18)
+#
+# States built against an edge_layout="csr" Net keep their per-edge
+# planes FLAT at rest — Delivery.fe_words as [E, W], and the gossipsub
+# control tier (served_lo/served_hi as [E, W], peerhave/iasked as [E]).
+# The core delivery engine consumes the flat fe plane natively
+# (models/common.delivery_round's flat commit); the gossipsub control
+# plane is written against the dense [N, K, ...] views, so its steps
+# densify at entry and re-pack at exit (wrap_csr_resident below) — the
+# RESIDENT tier (scan carries, checkpoints, HBM at rest) is flat, the
+# in-step temporaries are the same dense intermediates the dense build
+# materializes anyway (the transmit tensor is [N, K, W] in both).
+# Exactness: every dense per-edge plane is zero on absent slots by
+# construction (their update masks are nbr_ok/acc_ok-gated), so
+# pack -> unpack round-trips bit-exactly and dense-vs-CSR state parity
+# holds under unpacking (tests/test_csr.py).
+
+
+#: leaf-path suffixes of the CSR-resident tier — the ONLY sanctioned
+#: layout-dependent leaves, named ONCE next to the pack/unpack code
+#: that moves them. Word planes ride [E, W] flat, counters ride [E].
+#: analysis.guards derives the csr schema variant from these and
+#: scripts/memstat.py prices the tier off them, so adding the next
+#: flat plane here updates the schema guard and the memory audit
+#: together (or trips them, which is the point).
+CSR_RESIDENT_WORD_PLANES = (".fe_words", ".served_lo", ".served_hi")
+CSR_RESIDENT_COUNTERS = (".peerhave", ".iasked")
+CSR_RESIDENT_SUFFIXES = CSR_RESIDENT_WORD_PLANES + CSR_RESIDENT_COUNTERS
+
+
+def densify_edge_planes(net: "Net", st):
+    """CSR-resident flat planes -> their transient dense forms.
+    Accepts a SimState or a gossipsub-like state (anything with
+    ``.core`` plus the served/peerhave planes); a state already dense
+    passes through unchanged (idempotent)."""
+    gossip = hasattr(st, "core")
+    core = st.core if gossip else st
+    core = core.replace(dlv=core.dlv.replace(
+        fe_words=(net.unpack_edges(core.dlv.fe_words)
+                  if core.dlv.fe_words.ndim == 2 else core.dlv.fe_words)))
+    if not gossip:
+        return core
+    st = st.replace(core=core)
+    if getattr(st, "served_lo", None) is not None and st.served_lo.ndim == 2:
+        st = st.replace(
+            served_lo=net.unpack_edges(st.served_lo),
+            served_hi=net.unpack_edges(st.served_hi),
+            peerhave=net.unpack_edges(st.peerhave),
+            iasked=net.unpack_edges(st.iasked),
+        )
+    return st
+
+
+def flatten_edge_planes(net: "Net", st):
+    """Dense per-edge planes -> the CSR-resident flat forms (the
+    inverse of :func:`densify_edge_planes`; exact — dense absent slots
+    are zero by construction). Idempotent."""
+    gossip = hasattr(st, "core")
+    core = st.core if gossip else st
+    core = core.replace(dlv=core.dlv.replace(
+        fe_words=(net.pack_edges(core.dlv.fe_words)
+                  if core.dlv.fe_words.ndim == 3 else core.dlv.fe_words)))
+    if not gossip:
+        return core
+    st = st.replace(core=core)
+    if getattr(st, "served_lo", None) is not None and st.served_lo.ndim == 3:
+        st = st.replace(
+            served_lo=net.pack_edges(st.served_lo),
+            served_hi=net.pack_edges(st.served_hi),
+            peerhave=net.pack_edges(st.peerhave),
+            iasked=net.pack_edges(st.iasked),
+        )
+    return st
+
+
+def wrap_csr_resident(net: "Net", fn):
+    """Wrap an engine's round/phase body for a CSR-resident state:
+    densify the flat planes at entry, run the dense-written body
+    unchanged, re-pack at exit. The wrapped body is what the engine
+    factories jit, so the scan carry (and every checkpoint cut from it)
+    stays flat while in-step temporaries are dense."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(st, *args, **kwargs):
+        out = fn(densify_edge_planes(net, st), *args, **kwargs)
+        return flatten_edge_planes(net, out)
+
+    return wrapped
 
 
 # ---------------------------------------------------------------------------
@@ -645,7 +869,9 @@ def allocate_publishes(
     else:
         have_c = dlv.have & keep[None, :]
         fwd_c = dlv.fwd & keep[None, :]
-        fe_c = dlv.fe_words & keep[None, None, :]
+        # trailing-dim broadcast covers both the dense [N, K, W] and the
+        # CSR-resident flat [E, W] first-arrival plane
+        fe_c = dlv.fe_words & keep
         pending_c = (
             dlv.pending & keep[None, None, :]
             if dlv.pending is not None else None

@@ -1,0 +1,33 @@
+"""Heterogeneous topology + workload plane (round 18, docs/DESIGN.md
+§18): the graphs and publish schedules the paper's deployments actually
+run on — power-law degree distributions (ETH2/Filecoin's long-tail
+connectivity; dissemination on complex networks is arXiv:1507.08417's
+subject), small-world rewirings, and geographically clustered link
+classes — emitted as BOTH dense-padded and CSR nets from one canonical
+edge list, plus stacked publish-burst workloads (attestation storms,
+flash crowds) that are plain scan xs over the existing engines.
+
+This is the plane that turns the sparse data path (ops/csr.py) from a
+parity-proven tradeoff into a measured win: at mean degree ≪ the
+capacity cap K, the dense [N, K] slot space is mostly dead padding that
+the CSR layout never allocates, moves, or reduces (`make topo-smoke`)."""
+
+from .generators import (
+    EdgeList,
+    build_nets,
+    geo_clusters,
+    powerlaw,
+    small_world,
+    to_topology,
+)
+from .workloads import publish_bursts
+
+__all__ = [
+    "EdgeList",
+    "build_nets",
+    "geo_clusters",
+    "powerlaw",
+    "small_world",
+    "to_topology",
+    "publish_bursts",
+]

@@ -1,0 +1,273 @@
+"""Topology generators: one canonical edge list, two emissions.
+
+Every generator produces an :class:`EdgeList` — a deterministic,
+seed-reproducible array of undirected ``(a, b)`` pairs (``a < b``,
+lexicographically sorted) plus optional per-edge link classes — and the
+emission helpers turn ONE edge list into BOTH layouts:
+
+  * :func:`to_topology` -> the dense-padded ``graph.Topology`` (the
+    adjacency every engine already consumes);
+  * :func:`build_nets` -> the ``(dense, csr)`` Net pair built from the
+    SAME Topology object, so dense-vs-CSR A/B cells are guaranteed to
+    run the byte-identical graph (the PR-11 parity tests' precondition,
+    now a construction invariant).
+
+Generators (all host-side numpy; determinism is pinned by
+tests/test_topo.py — same seed ⇒ byte-identical edge list):
+
+  powerlaw      capacity-bounded power-law: degrees drawn from a
+                truncated zipf pmf ``P(d) ∝ d^-exponent`` on
+                ``[d_min, max_degree]``, wired by seeded stub matching
+                with self/multi-edge rejection. The max-degree cap IS
+                the padded K — the graph the sparse plane wins on has
+                mean degree ≪ K (ETH2's observed long-tail;
+                arXiv:1507.08417).
+  small_world   Watts–Strogatz ring rewiring: a d-regular ring lattice
+                whose far endpoints rewire with probability ``beta``,
+                under the same capacity cap.
+  geo_clusters  geographically clustered links with LATENCY CLASSES:
+                peers in clusters, each node dialing local /
+                regional / global edges tagged class 0/1/2 with a
+                per-class latency (rounds). The class partition covers
+                every edge exactly once (sum-preserving — pinned by
+                tests), so per-class byte/latency accounting always
+                adds up to the whole graph.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import graph as graphlib
+
+#: default per-class latency in rounds for geo link classes
+#: (local intra-cluster, regional neighbor-cluster, global long-haul)
+GEO_CLASS_LATENCY = (1, 2, 8)
+
+
+@dataclass(frozen=True)
+class EdgeList:
+    """Canonical undirected edge list (see module docstring)."""
+
+    n: int
+    edges: np.ndarray                    # [E_u, 2] i32, a < b, sorted
+    link_class: np.ndarray | None = None  # [E_u] i8 (geo classes)
+    class_latency: tuple | None = None    # rounds per class
+
+    @property
+    def n_undirected(self) -> int:
+        return int(self.edges.shape[0])
+
+    @property
+    def degree(self) -> np.ndarray:
+        """[N] i64 undirected degree."""
+        return np.bincount(self.edges.reshape(-1), minlength=self.n)
+
+    @property
+    def max_degree(self) -> int:
+        return int(self.degree.max()) if self.n_undirected else 0
+
+    @property
+    def mean_degree(self) -> float:
+        return 2.0 * self.n_undirected / self.n
+
+    def canonical_bytes(self) -> bytes:
+        """The determinism pin: the byte-identical canonical form both
+        emissions are built from."""
+        return np.ascontiguousarray(self.edges, np.int32).tobytes()
+
+
+def _canonical(n: int, pairs) -> np.ndarray:
+    """Sorted [E_u, 2] i32 canonical form of a set of (a, b) pairs."""
+    if not len(pairs):
+        return np.zeros((0, 2), np.int32)
+    arr = np.asarray(sorted({(min(a, b), max(a, b)) for a, b in pairs}),
+                     np.int32)
+    return arr
+
+
+def _degree_sequence(rng, n: int, exponent: float, d_min: int,
+                     d_max: int) -> np.ndarray:
+    """Truncated-zipf degree sequence with an even stub total."""
+    ds = np.arange(d_min, d_max + 1, dtype=np.float64)
+    pmf = ds ** (-float(exponent))
+    pmf /= pmf.sum()
+    deg = rng.choice(ds.astype(np.int64), size=n, p=pmf)
+    if deg.sum() % 2:  # stub matching needs an even total
+        below = np.flatnonzero(deg < d_max)
+        if below.size:
+            deg[below[0]] += 1
+        else:  # every node at the cap — the cap is hard, so shrink one
+            deg[0] -= 1
+    return deg
+
+
+def powerlaw(n: int, exponent: float = 2.2, d_min: int = 2,
+             max_degree: int = 64, seed: int = 0,
+             match_rounds: int = 64) -> EdgeList:
+    """Capacity-bounded power-law graph (module docstring). Stub
+    matching with rejection: unmatched conflicting stubs are re-shuffled
+    ``match_rounds`` times, then dropped — degrees can only shrink, so
+    the cap holds at every node by construction."""
+    if not 0 < d_min <= max_degree:
+        raise ValueError(f"need 0 < d_min <= max_degree, got "
+                         f"{d_min}/{max_degree}")
+    rng = np.random.default_rng(seed)
+    deg = _degree_sequence(rng, n, exponent, d_min, max_degree)
+    stubs = np.repeat(np.arange(n, dtype=np.int64), deg)
+    have: set = set()
+    for _ in range(match_rounds):
+        if stubs.shape[0] < 2:
+            break
+        rng.shuffle(stubs)
+        half = stubs.shape[0] // 2
+        a, b = stubs[:half], stubs[half:2 * half]
+        keep = np.ones(half, bool)
+        for i in range(half):
+            x, y = int(a[i]), int(b[i])
+            key = (min(x, y), max(x, y))
+            if x == y or key in have:
+                continue  # conflicting stub pair — retry next round
+            have.add(key)
+            keep[i] = False
+        # unmatched stubs (self/multi conflicts + the odd tail) retry
+        leftovers = [a[keep], b[keep]]
+        if stubs.shape[0] > 2 * half:
+            leftovers.append(stubs[2 * half:])
+        stubs = np.concatenate(leftovers)
+    return EdgeList(n=n, edges=_canonical(n, have))
+
+
+def small_world(n: int, d: int = 4, beta: float = 0.1, seed: int = 0,
+                max_degree: int | None = None) -> EdgeList:
+    """Watts–Strogatz rewiring of a d-regular ring under a degree cap
+    (default cap 2d + 4 slack — rewiring concentrates a few hubs)."""
+    cap = max_degree if max_degree is not None else 2 * d + 4
+    if cap < 2 * d:
+        raise ValueError(f"max_degree {cap} is below the seed ring "
+                         f"degree {2 * d} — the ring itself would "
+                         f"violate the cap before any rewiring")
+    rng = np.random.default_rng(seed)
+    have = {(i, (i + o) % n) if i < (i + o) % n else ((i + o) % n, i)
+            for i in range(n) for o in range(1, d + 1)}
+    have = set(have)
+    deg = np.zeros(n, np.int64)
+    for a, b in have:
+        deg[a] += 1
+        deg[b] += 1
+    edges = sorted(have)
+    for a, b in edges:
+        if rng.random() >= beta:
+            continue
+        # rewire the far endpoint b -> uniform c with spare capacity
+        for _ in range(8):  # bounded retries, then keep the edge
+            c = int(rng.integers(0, n))
+            key = (min(a, c), max(a, c))
+            if c == a or key in have or deg[c] >= cap:
+                continue
+            have.discard((a, b))
+            deg[b] -= 1
+            have.add(key)
+            deg[c] += 1
+            break
+    return EdgeList(n=n, edges=_canonical(n, have))
+
+
+def geo_clusters(n: int, n_clusters: int = 8, d_local: int = 6,
+                 d_regional: int = 2, d_global: int = 1, seed: int = 0,
+                 class_latency: tuple = GEO_CLASS_LATENCY) -> EdgeList:
+    """Geographically clustered topology with latency link classes
+    (module docstring). Every edge gets exactly one class — class 0
+    (local) ⊂ same cluster, class 1 (regional) ⊂ adjacent clusters,
+    class 2 (global) the rest — so per-class counts sum to E."""
+    if n_clusters < 2:
+        raise ValueError("geo_clusters needs >= 2 clusters")
+    rng = np.random.default_rng(seed)
+    # contiguous id blocks per cluster: consecutive peer ids share a
+    # region, so peer-axis sharding keeps most links shard-local (the
+    # same relabeling argument parallel/sharding.py makes for bands)
+    cluster = (np.arange(n, dtype=np.int64) * n_clusters) // n
+    members = [np.flatnonzero(cluster == c) for c in range(n_clusters)]
+    have: set = set()
+
+    def dial(i: int, pool: np.ndarray, count: int):
+        pool = pool[pool != i]
+        if pool.shape[0] == 0 or count <= 0:
+            return
+        picks = rng.choice(pool, size=min(count, pool.shape[0]),
+                           replace=False)
+        for j in picks:
+            have.add((min(i, int(j)), max(i, int(j))))
+
+    all_ids = np.arange(n, dtype=np.int64)
+    for i in range(n):
+        c = int(cluster[i])
+        dial(i, members[c], d_local)
+        regional = np.concatenate([
+            members[(c + 1) % n_clusters], members[(c - 1) % n_clusters]])
+        dial(i, regional, d_regional)
+        dial(i, all_ids, d_global)
+
+    edges = _canonical(n, have)
+    ca, cb = cluster[edges[:, 0]], cluster[edges[:, 1]]
+    adj = (np.minimum((ca - cb) % n_clusters, (cb - ca) % n_clusters) == 1)
+    link_class = np.where(
+        ca == cb, np.int8(0), np.where(adj, np.int8(1), np.int8(2)))
+    return EdgeList(n=n, edges=edges, link_class=link_class.astype(np.int8),
+                    class_latency=tuple(class_latency))
+
+
+# ---------------------------------------------------------------------------
+# emission: one canonical edge list -> both layouts
+
+
+def to_topology(el: EdgeList, max_degree: int | None = None
+                ) -> graphlib.Topology:
+    """The dense-padded adjacency of an edge list (graph.from_edges on
+    the canonical pairs — deterministic slot order)."""
+    return graphlib.from_edges(el.n, [tuple(e) for e in el.edges],
+                               max_degree=max_degree)
+
+
+def build_nets(el: EdgeList, subs, max_degree: int | None = None,
+               edge_shards: int | None = None, **net_kw):
+    """(dense, csr) Net pair from ONE Topology built off the canonical
+    edge list — the A/B construction invariant: both layouts run the
+    byte-identical graph. ``edge_shards`` pads the csr build's edge
+    axis into row-owner-aligned equal blocks (GSPMD edge sharding)."""
+    from ..state import Net
+
+    topo = to_topology(el, max_degree=max_degree)
+    dense = Net.build(topo, subs, **net_kw)
+    csr = Net.build(topo, subs, edge_layout="csr",
+                    edge_shards=edge_shards, **net_kw)
+    return topo, dense, csr
+
+
+def link_class_planes(el: EdgeList, topo: graphlib.Topology
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-directed-slot views of the geo link classes:
+    ``(edge_class[N, K] i8, latency_rounds[N, K] i32)`` with -1/0 on
+    absent slots. ``latency_rounds`` is ready to drive per-class link
+    scheduling (a class-c edge modeled as delivering every
+    ``latency`` rounds) or reporting."""
+    if el.link_class is None:
+        raise ValueError("edge list carries no link classes "
+                         "(geo_clusters builds them)")
+    lut = {}
+    for (a, b), c in zip(el.edges, el.link_class):
+        lut[(int(a), int(b))] = int(c)
+        lut[(int(b), int(a))] = int(c)
+    n, k = topo.nbr.shape
+    cls = np.full((n, k), -1, np.int8)
+    for i in range(n):
+        for s in range(k):
+            if topo.nbr_ok[i, s]:
+                cls[i, s] = lut[(i, int(topo.nbr[i, s]))]
+    lat = np.zeros((n, k), np.int32)
+    latency = el.class_latency or GEO_CLASS_LATENCY
+    for c, rounds in enumerate(latency):
+        lat[cls == c] = rounds
+    return cls, lat

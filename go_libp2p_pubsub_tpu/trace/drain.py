@@ -107,11 +107,21 @@ class Snapshot:
     edge_live: np.ndarray | None = None   # [N,K] bool
 
 
-def snapshot(st) -> Snapshot:
+def snapshot(st, net=None) -> Snapshot:
     """Pull a Snapshot from any router state: GossipSubState (exposes
-    `.core`) or a bare SimState; mesh/up captured when present."""
+    `.core`) or a bare SimState; mesh/up captured when present. A
+    CSR-resident state (flat [E, W] fe_words, round 18) needs ``net``
+    so the first-arrival edge view can be densified here."""
     core = getattr(st, "core", st)
     exact = getattr(st, "dup_trans", None) is not None
+    dlv = core.dlv
+    if dlv.fe_words.ndim == 2:
+        if net is None:
+            raise ValueError(
+                "snapshot() of a CSR-resident state needs net= to "
+                "densify the first-arrival plane (or densify the whole "
+                "state first: state.densify_edge_planes(net, st))")
+        dlv = dlv.replace(fe_words=net.unpack_edges(dlv.fe_words))
     return Snapshot(
         tick=int(core.tick),
         cursor=int(core.msgs.cursor),
@@ -119,8 +129,8 @@ def snapshot(st) -> Snapshot:
         msg_origin=np.asarray(core.msgs.origin),
         msg_valid=np.asarray(core.msgs.valid),
         msg_ignored=np.asarray(core.msgs.ignored),
-        first_round=np.asarray(core.dlv.first_round),
-        first_edge=np.asarray(core.dlv.first_edge),
+        first_round=np.asarray(dlv.first_round),
+        first_edge=np.asarray(dlv.first_edge),
         events=np.asarray(core.events),
         mesh=np.asarray(st.mesh) if hasattr(st, "mesh") else None,
         up=np.asarray(st.up) if hasattr(st, "up") else None,

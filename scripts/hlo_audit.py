@@ -81,6 +81,51 @@ def _ragged_harness():
                          lambda i: _pub_args((4,), i), {})
 
 
+def _csr_sharded_harness():
+    """The round-18 sharded-CSR row: the guard-shape gossipsub step on
+    an ``edge_shards=4`` csr build — the row-owner-aligned BLOCK-PADDED
+    edge layout the GSPMD edge sharding partitions (ops/csr.
+    pad_csr_blocks). Participates in the equal-tally leg below: the
+    sharding layout must not change the halo budget either (the GSPMD
+    collective contract itself is pinned on the 8-virtual-device
+    harness — scripts/mesh2d_dryrun.py, MULTICHIP_r07.json)."""
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.analysis.guards import (
+        GUARD_M,
+        GUARD_N,
+        EngineHarness,
+        _pub_args,
+    )
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+    from go_libp2p_pubsub_tpu.state import Net
+    import dataclasses as _dc
+
+    net = Net.build(graph.ring_lattice(GUARD_N, d=8),
+                    graph.subscribe_all(GUARD_N, 1),
+                    edge_layout="csr", edge_shards=4)
+    _tp, sp = bench_score_params("default", 1)
+    # mirror the bench config exactly (build_bench: flood_publish off,
+    # tracer detached, no fanout slots) so the tally equality against
+    # the dense/csr bench rows compares LAYOUTS, not configs
+    cfg = GossipSubConfig.build(
+        _dc.replace(GossipSubParams(), flood_publish=False),
+        PeerScoreThresholds(), score_enabled=True, edge_layout="csr")
+    cfg = _dc.replace(cfg, count_events=False, fanout_slots=0)
+    st = GossipSubState.init(net, GUARD_M, cfg, score_params=sp)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    return EngineHarness("csr_sharded", step, st,
+                         lambda i: _pub_args((4,), i), {})
+
+
 def _window_text():
     """StableHLO of a small make_window program (the one-dispatch scan
     contract)."""
@@ -133,6 +178,7 @@ def main() -> int:
         ("floodsub", lambda: guards.build_engine("floodsub"), False),
         ("randomsub", lambda: guards.build_engine("randomsub"), True),
         ("csr", guards.build_csr_harness, True),
+        ("csr_sharded", _csr_sharded_harness, True),
         ("phase_csr", guards.build_phase_csr_harness, True),
         ("lifted", guards.build_lifted_harness, True),
     ]
@@ -167,8 +213,10 @@ def main() -> int:
             failures.append(f"[{name}] audit crashed: "
                             f"{type(e).__name__}: {str(e)[:300]}")
 
-    # dense vs CSR: the layout must not change the halo budget
+    # dense vs CSR: the layout must not change the halo budget (the
+    # csr-sharded row holds the same equality — round 18)
     for dense, sparse in (("gossipsub", "csr"),
+                          ("gossipsub", "csr_sharded"),
                           ("gossipsub_phase", "phase_csr")):
         td, ts = tallies.get(dense), tallies.get(sparse)
         if td is not None and ts is not None and td["total"] != ts["total"]:

@@ -51,7 +51,9 @@ TARGETS = (100_000, 1_000_000, 10_000_000)
 #: power-law regime
 DENSITIES = (1.0, 0.6, 0.25)
 
-ENGINES = ("gossipsub", "gossipsub_narrow", "floodsub")
+ENGINES = ("gossipsub", "gossipsub_narrow", "floodsub",
+           "gossipsub_csr", "floodsub_csr")
+
 
 
 def _state_tree(engine: str, n: int):
@@ -61,9 +63,20 @@ def _state_tree(engine: str, n: int):
     from go_libp2p_pubsub_tpu import graph
     from go_libp2p_pubsub_tpu.state import Net, SimState
 
-    if engine == "floodsub":
-        def build():
-            return SimState.init(n, AUDIT_M, k=2 * AUDIT_DEGREE_D)
+    csr = engine.endswith("_csr")
+    layout = "csr" if csr else "dense"
+    if engine.startswith("floodsub"):
+        if csr:
+            topo = graph.ring_lattice(n, d=AUDIT_DEGREE_D)
+            subs = graph.subscribe_all(n, 1)
+            net = Net.build(topo, subs, edge_layout="csr")
+
+            def build():
+                return SimState.init(n, AUDIT_M, k=net.max_degree,
+                                     n_edges=net.n_edges)
+        else:
+            def build():
+                return SimState.init(n, AUDIT_M, k=2 * AUDIT_DEGREE_D)
 
         return jax.eval_shape(build)
 
@@ -79,11 +92,12 @@ def _state_tree(engine: str, n: int):
 
     topo = graph.ring_lattice(n, d=AUDIT_DEGREE_D)
     subs = graph.subscribe_all(n, 1)
-    net = Net.build(topo, subs)
+    net = Net.build(topo, subs, edge_layout=layout)
     _tp, sp = bench_score_params("default", 1)
     cfg = GossipSubConfig.build(
         GossipSubParams(), PeerScoreThresholds(), score_enabled=True,
         narrow_counters=(engine == "gossipsub_narrow"),
+        edge_layout=layout,
     )
 
     def build():
@@ -95,6 +109,9 @@ def _state_tree(engine: str, n: int):
 def _leaf_rows(engine: str) -> list[dict]:
     import jax
     import jax.tree_util as jtu
+
+    # the tier's membership is named once, next to the pack/unpack code
+    from go_libp2p_pubsub_tpu.state import CSR_RESIDENT_SUFFIXES
 
     def flat(n):
         tree = _state_tree(engine, n)
@@ -116,6 +133,7 @@ def _leaf_rows(engine: str) -> list[dict]:
     lo, hi = flat(N_LO), flat(N_HI)
     assert set(lo) == set(hi), "leaf set changed with N"
     k_dim = 2 * AUDIT_DEGREE_D
+    csr_resident = engine.endswith("_csr")
     rows = []
     for path in sorted(lo):
         dt, shape_lo, b_lo = lo[path]
@@ -129,14 +147,25 @@ def _leaf_rows(engine: str) -> list[dict]:
             d == k_dim and i not in n_axes
             for i, d in enumerate(shape_lo)
         )
-        rows.append({
+        row = {
             "path": path,
             "dtype": dt,
             "shape_at_lo": shape_lo,
             "bytes_per_peer": slope,
             "const_bytes": const,
             "edge_axis": bool(edge_axis),
-        })
+        }
+        # round-18 CSR-resident tier: the flat [E, ...] planes — the
+        # fit in N is the fit in E on the audit ring (E = K·N there,
+        # density 1), so the PER-EDGE cost is slope/K: const+slope·E
+        # from the same two eval_shape points. At density δ the tier's
+        # resident bytes/peer are δ·slope — the dense build's never
+        # shrink (that delta is the csr_tier block below).
+        if csr_resident and any(path.endswith(sf)
+                                for sf in CSR_RESIDENT_SUFFIXES):
+            row["edge_resident"] = True
+            row["bytes_per_edge"] = slope / k_dim
+        rows.append(row)
     return rows
 
 
@@ -178,24 +207,70 @@ def _exchange_block() -> dict:
     }
 
 
+def _csr_tier_block(blocks: dict) -> dict:
+    """The round-18 CSR-resident tier: which bytes scale with E instead
+    of N·K, and the dense-vs-csr bytes/peer delta by density (at
+    density δ the flat planes cost δ × their dense capacity — the
+    dense build always pays full capacity)."""
+    out_engines = {}
+    for eng in ("gossipsub_csr", "floodsub_csr"):
+        rows = [r for r in blocks[eng]["leaves"] if r.get("edge_resident")]
+        flat_bpp = sum(r["bytes_per_peer"] for r in rows)
+        dense_eng = eng[: -len("_csr")]
+        dense_bpp = blocks[dense_eng]["totals"]["bytes_per_peer"]
+        out_engines[eng] = {
+            "edge_resident_leaves": [r["path"] for r in rows],
+            "bytes_per_edge": sum(r["bytes_per_edge"] for r in rows),
+            "flat_bytes_per_peer_at_full_density": flat_bpp,
+            "dense_engine_bytes_per_peer": dense_bpp,
+            "bytes_per_peer_by_density": {
+                str(d): round(dense_bpp - flat_bpp * (1.0 - d), 2)
+                for d in DENSITIES
+            },
+            "saved_bytes_per_peer_by_density": {
+                str(d): round(flat_bpp * (1.0 - d), 2) for d in DENSITIES
+            },
+        }
+    return {
+        "note": ("CSR-resident state tier (round 18): flat [E, ...] "
+                 "planes cost density x capacity; the dense build "
+                 "always pays full capacity (docs/DESIGN.md §18)"),
+        "engines": out_engines,
+    }
+
+
 def build_audit() -> dict:
     blocks = {e: _engine_block(e) for e in ENGINES}
     gs = blocks["gossipsub"]["totals"]["bytes_per_peer"]
     narrow = blocks["gossipsub_narrow"]["totals"]["bytes_per_peer"]
     return {
-        "schema": 1,
+        "schema": 2,
         "note": ("bytes/peer audit of the live state trees "
                  "(scripts/memstat.py; MEM_AUDIT_UPDATE=1 rewrites)"),
         "shape": {"degree_d": AUDIT_DEGREE_D, "k": 2 * AUDIT_DEGREE_D,
                   "msg_slots": AUDIT_M, "n_lo": N_LO, "n_hi": N_HI},
         "engines": blocks,
         "exchange": _exchange_block(),
+        "csr_tier": _csr_tier_block(blocks),
         "narrowing": {
             "gossipsub_bytes_per_peer": gs,
             "narrow_counters_bytes_per_peer": narrow,
             "saved_bytes_per_peer": gs - narrow,
         },
     }
+
+
+def bytes_per_peer_for(audit: dict, engine: str = "gossipsub",
+                       edge_layout: str = "dense",
+                       density: float = 1.0) -> float:
+    """Resident bytes/peer for the ACTIVE layout (the round-18 headroom
+    fix: a csr run's memory term must price the flat tier at ITS
+    density, not the always-dense capacity). ``density`` is E/(N·K).
+    Thin alias of the one pricing rule in perf.projection so the
+    printed headroom table and ``project_at_scale`` cannot drift."""
+    from go_libp2p_pubsub_tpu.perf.projection import audit_bytes_per_peer
+
+    return audit_bytes_per_peer(audit, engine, edge_layout, density)
 
 
 def main() -> int:
@@ -220,13 +295,25 @@ def main() -> int:
             return 1
         print("mem-audit: OK — committed baseline reproduces")
 
-    # human-readable summary: the headroom table + top leaves
+    # human-readable summary: the headroom table + top leaves. The
+    # table prices each engine row under its OWN layout (round-18 fix:
+    # the csr rows are the flat tier, not the always-dense capacity)
     for eng in ENGINES:
         tot = audit["engines"][eng]["totals"]
         print(f"\n[{eng}] {tot['bytes_per_peer']:.1f} bytes/peer; "
               "resident state:")
         for n, mb in tot["resident_mb"].items():
             print(f"  N={int(n):>10,}: {mb:>10.2f} MB")
+    tier = audit["csr_tier"]["engines"]["gossipsub_csr"]
+    print("\ncsr-resident tier (gossipsub): "
+          f"{tier['flat_bytes_per_peer_at_full_density']:.0f} B/peer of "
+          f"capacity rides flat [E] planes ({tier['bytes_per_edge']:.1f} "
+          "B/edge); dense-vs-csr bytes/peer by density:")
+    for d in DENSITIES:
+        print(f"  density {d}: dense "
+              f"{tier['dense_engine_bytes_per_peer']:.0f} vs csr "
+              f"{tier['bytes_per_peer_by_density'][str(d)]} "
+              f"(saves {tier['saved_bytes_per_peer_by_density'][str(d)]})")
     top = sorted(audit["engines"]["gossipsub"]["leaves"],
                  key=lambda r: -r["bytes_per_peer"])[:8]
     print("\nheaviest gossipsub leaves (bytes/peer):")

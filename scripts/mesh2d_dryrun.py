@@ -19,7 +19,19 @@ dispatch, and checks:
 Writes the MULTICHIP_r06.json wrapper (same shape the driver's
 multichip artifacts carry: n_devices/rc/ok/skipped/tail, plus the mesh
 shape and collective profile) that scan-smoke's projection refresh
-gates on. Usage:
+gates on.
+
+Round 18 adds the **sharded-CSR cell** (MULTICHIP_r07.json): the same
+S=8 window built on ``edge_layout="csr"`` — CSR-RESIDENT flat [S, E, W]
+state planes placed via ``shard_ensemble_state(axis="sims+peers",
+n_edges=E)`` (the edge axis partitions with the peer axis; row-owner
+alignment is free on the full-density bench ring). Asserts the same
+three contracts as the dense cell — bit-exact vs unplaced, halo
+collective-permutes present, ZERO all-gathers (the flat gathers lower
+through the banded-roll structure, state.Net.csr_band_off) — plus the
+trace-time halo-gather tally EQUAL to the dense build's (the sparse
+plane must not change the halo budget; `make hlo-audit` pins the same
+equality at guard shapes). Usage:
 
     python scripts/mesh2d_dryrun.py [--n 4096] [--rounds 8] [--write]
 """
@@ -35,10 +47,26 @@ _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 
 ARTIFACT_NAME = "MULTICHIP_r06.json"
+CSR_ARTIFACT_NAME = "MULTICHIP_r07.json"
+
+
+def _halo_tally(step, state) -> dict:
+    """Trace-time halo-gather tally of one step call (edges.tally_step
+    owns the unjitted-body caveat)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.ops import edges
+    from go_libp2p_pubsub_tpu.perf.sweep import PUBS_PER_ROUND
+
+    po = jnp.asarray(np.zeros((PUBS_PER_ROUND,), np.int32))
+    pt = jnp.zeros((PUBS_PER_ROUND,), jnp.int32)
+    pv = jnp.ones((PUBS_PER_ROUND,), bool)
+    return edges.fold_tally(edges.tally_step(step, state, (po, pt, pv)))
 
 
 def run_dryrun(n: int, rounds: int, sims: int = 8,
-               mesh_rows: int = 2) -> dict:
+               mesh_rows: int = 2, edge_layout: str = "dense") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -58,7 +86,15 @@ def run_dryrun(n: int, rounds: int, sims: int = 8,
                 "tail": f"needs >= {mesh_rows * 2} devices, have {n_dev}"}
     mesh = make_mesh_2d(mesh_rows, n_dev // mesh_rows)
 
-    st0, step, n_topics, _ = build_bench(n, 64, config="default")
+    bench_kw = dict(config="default")
+    if edge_layout != "dense":
+        bench_kw["edge_layout"] = edge_layout
+    st0, step, n_topics, _ = build_bench(n, 64, **bench_kw)
+    # CSR-resident flat planes: the edge axis E shards with the peers
+    # axis (row-owner-aligned for free on the full-density bench ring)
+    n_edges = None
+    if edge_layout == "csr":
+        n_edges = int(st0.core.dlv.fe_words.shape[0])
     ens = ensemble.lift_step(step)
     rng = np.random.default_rng(0)
     po = jnp.asarray(np.stack([
@@ -71,13 +107,14 @@ def run_dryrun(n: int, rounds: int, sims: int = 8,
 
     def batched():
         return ensemble.batch_states(
-            build_bench(n, 64, config="default")[0], sims)
+            build_bench(n, 64, **bench_kw)[0], sims)
 
     gold, _ = window(batched(), (po, pt, pv))
     jax.block_until_ready(gold)
 
     placed = ensemble.shard_ensemble_state(batched(), mesh, n,
-                                           axis="sims+peers")
+                                           axis="sims+peers",
+                                           n_edges=n_edges)
     lowered = window.lower(placed, (po, pt, pv))
     compiled = lowered.compile()
     prof = collective_profile(compiled.as_text())
@@ -97,8 +134,8 @@ def run_dryrun(n: int, rounds: int, sims: int = 8,
           and prof["all-gather"] == 0
           and prof["collective-permute"] > 0)
     tail = (f"2-D mesh {mesh_rows}x{n_dev // mesh_rows} (sims x peers), "
-            f"S={sims}, N={n}, {rounds}-round window as ONE dispatch; "
-            f"collectives={prof}; "
+            f"S={sims}, N={n}, {rounds}-round window as ONE dispatch, "
+            f"edge_layout={edge_layout}; collectives={prof}; "
             + ("bit-exact vs unplaced" if not mismatches
                else f"MISMATCHED leaves: {mismatches[:5]}"))
     return {
@@ -111,8 +148,36 @@ def run_dryrun(n: int, rounds: int, sims: int = 8,
         "n_peers": n,
         "n_sims": sims,
         "rounds": rounds,
+        "edge_layout": edge_layout,
+        "n_edges": n_edges,
         "tail": tail,
     }
+
+
+def run_dryrun_csr(n: int, rounds: int, sims: int = 8,
+                   mesh_rows: int = 2) -> dict:
+    """The round-18 sharded-CSR cell (module docstring): the csr
+    window's contracts plus the dense-vs-csr halo-tally equality."""
+    from go_libp2p_pubsub_tpu.perf.sweep import build_bench
+
+    res = run_dryrun(n, rounds, sims=sims, mesh_rows=mesh_rows,
+                     edge_layout="csr")
+    if res.get("skipped"):
+        return res
+    st_d, step_d, _, _ = build_bench(n, 64, config="default")
+    st_c, step_c, _, _ = build_bench(n, 64, config="default",
+                                     edge_layout="csr")
+    tally_d = _halo_tally(step_d, st_d)
+    tally_c = _halo_tally(step_c, st_c)
+    res["halo_tally"] = {"dense": tally_d, "csr": tally_c}
+    if tally_d != tally_c:
+        res["ok"] = False
+        res["rc"] = 1
+        res["tail"] += (f"; HALO TALLY DRIFT dense={tally_d} vs "
+                        f"csr={tally_c}")
+    else:
+        res["tail"] += f"; halo tally equal to dense ({tally_d})"
+    return res
 
 
 def main(argv=None) -> int:
@@ -145,13 +210,20 @@ def main(argv=None) -> int:
 
     res = run_dryrun(args.n, args.rounds)
     print(json.dumps(res))
+    res_csr = run_dryrun_csr(args.n, args.rounds)
+    print(json.dumps(res_csr))
     if args.write:
         path = os.path.join(root, ARTIFACT_NAME)
         with open(path, "w") as f:
             json.dump(res, f, indent=2)
             f.write("\n")
         print(f"wrote {path}", file=sys.stderr)
-    return 0 if res["ok"] else 1
+        path = os.path.join(root, CSR_ARTIFACT_NAME)
+        with open(path, "w") as f:
+            json.dump(res_csr, f, indent=2)
+            f.write("\n")
+        print(f"wrote {path}", file=sys.stderr)
+    return 0 if (res["ok"] and res_csr["ok"]) else 1
 
 
 if __name__ == "__main__":

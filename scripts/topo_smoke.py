@@ -1,0 +1,363 @@
+"""`make topo-smoke`: the power-law sparse-plane A/B gate (round 18).
+
+The PR-11 sparse data plane was committed as a tradeoff number — dense
+rolls beat CSR 3× on the 100%-dense banded bench ring (BENCH_r06).
+This gate runs the A/B on the graph family the paper's deployments
+actually have (power-law degree distributions with mean degree ≪ the
+capacity cap K; arXiv:1507.08417) and asserts the sparse plane WINS
+there, on both axes:
+
+  * **delivery-rounds/s** — both layouts run the identical
+    attestation-storm workload (one canonical edge list, one publish
+    schedule, identical per-sim chaos/PRNG streams) as ONE scanned
+    S-sim window per layout; warm-vs-warm, csr must beat dense by at
+    least the committed ``rate_lift_floor``;
+  * **audited bytes moved** — the trace-time halo-bytes tally
+    (ops/edges.tally_halo_bytes: the edge involution + neighbor-view
+    seams) per round; the csr/dense ratio is the topology density by
+    construction, and the gate asserts csr < dense;
+
+while the PAIRING holds: per-sim delivered/duplicate/RPC counters must
+be BIT-IDENTICAL across the two layouts (same graph, same streams —
+the layout changes how, never what), and each layout's window compiles
+exactly once (cache sentinel).
+
+TOPO_SMOKE_UPDATE=1 rewrites TOPO_SMOKE.json from this run (floors at
+wide margins — scale-feasibility style, not perf-regression style) and
+refreshes the committed BENCH_r07.json artifact pair: schema-v3 lines
+with the new ``fingerprint["topology"]`` block (generator, E, degree
+stats, density, workload pattern — legacy artifacts read back the
+TOPOLOGY_BANDED sentinel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(REPO, "TOPO_SMOKE.json")
+BENCH_PATH = os.path.join(REPO, "BENCH_r07.json")
+
+N = int(os.environ.get("TOPO_SMOKE_N", 4096))
+MAX_DEGREE = int(os.environ.get("TOPO_SMOKE_K", 64))
+EXPONENT = 2.2
+D_MIN = 2
+MSG_SLOTS = 64
+ROUNDS = int(os.environ.get("TOPO_SMOKE_ROUNDS", 32))
+PUB_WIDTH = 8
+SIMS = 4
+SEED = 0
+LOSS = 0.1
+
+#: update-mode margins: the lift floor commits at half the measured
+#: margin above 1.0 (never below 1.0 — "csr beats dense" is the gate)
+RATE_MARGIN = 0.5
+
+
+def _bytes_per_round(step_fn, state, args) -> int:
+    """Audited bytes moved by one traced step: the halo seams' moved
+    tensors, exact from static shapes (ops/edges.tally_halo_bytes;
+    edges.tally_step owns the unjitted-body caveat)."""
+    from go_libp2p_pubsub_tpu.ops import edges
+
+    out = edges.tally_step(step_fn, state, args, count_bytes=True)
+    assert out, "halo-bytes tally is empty — engine moved nothing?"
+    missing = [k for k, b in out if b is None]
+    assert not missing, f"halo seams without byte accounting: {missing}"
+    return sum(b for _, b in out)
+
+
+def run_cell(layout: str, net, el):
+    """One layout's S-sim scanned window: returns (rate, per-sim event
+    counters, bytes/round, compile-count sentinel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import driver, ensemble, topo
+    from go_libp2p_pubsub_tpu.chaos.faults import ChaosConfig
+    from go_libp2p_pubsub_tpu.models.floodsub import floodsub_step
+    from go_libp2p_pubsub_tpu.state import SimState
+
+    chaos = ChaosConfig(generator="iid", loss_rate=LOSS)
+
+    def step(st, po, pt, pv):
+        return floodsub_step(net, st, po, pt, pv, chaos=chaos)
+
+    po, pt, pv = topo.publish_bursts(
+        "attestation_storm", ROUNDS, PUB_WIDTH, N, seed=1,
+        period=8, burst_len=2)
+    xs = (jnp.asarray(np.repeat(po[:, None], SIMS, axis=1)),
+          jnp.asarray(np.repeat(pt[:, None], SIMS, axis=1)),
+          jnp.asarray(np.repeat(pv[:, None], SIMS, axis=1)))
+
+    ens = ensemble.lift_step(jax.jit(step, donate_argnums=0))
+    window = driver.make_window(ens)
+
+    def fresh():
+        return ensemble.batch_states(
+            SimState.init(N, MSG_SLOTS, k=net.max_degree,
+                          n_edges=net.n_edges), SIMS)
+
+    st, _ = window(fresh(), xs)         # compile + warm
+    jax.block_until_ready(st.events)
+
+    st2 = fresh()
+    jax.block_until_ready(st2.events)
+    t0 = time.perf_counter()
+    st2, _ = window(st2, xs)
+    jax.block_until_ready(st2.events)
+    warm_s = time.perf_counter() - t0
+
+    try:
+        n_compiles = int(window._cache_size())
+    except Exception:  # pragma: no cover — older jax without the API
+        n_compiles = -1  # sentinel: UNKNOWN, skips the gate visibly
+    events = np.asarray(st2.events)      # [S, N_EVENTS]
+
+    # audited bytes: trace the UNJITTED step body (a jitted call under
+    # eval_shape can hit the jaxpr cache and tally nothing)
+    def raw_step(st, po_r, pt_r, pv_r):
+        return floodsub_step.__wrapped__(net, st, po_r, pt_r, pv_r,
+                                         chaos=chaos)
+
+    bpr = _bytes_per_round(
+        raw_step, SimState.init(N, MSG_SLOTS, k=net.max_degree,
+                                n_edges=net.n_edges),
+        (jnp.asarray(po[0]), jnp.asarray(pt[0]), jnp.asarray(pv[0])))
+    return {
+        "layout": layout,
+        "rounds_per_sec": round(ROUNDS / warm_s, 3),
+        "warm_s": round(warm_s, 4),
+        "events_per_sim": events,
+        "bytes_per_round": int(bpr),
+        "n_compiles": int(n_compiles),
+    }
+
+
+def run_smoke() -> dict:
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import graph, topo
+    from go_libp2p_pubsub_tpu.trace.events import EV
+
+    el = topo.powerlaw(N, exponent=EXPONENT, d_min=D_MIN,
+                       max_degree=MAX_DEGREE, seed=SEED)
+    subs = graph.subscribe_all(N, 1)
+    _t, net_d, net_c = topo.build_nets(el, subs, max_degree=MAX_DEGREE)
+
+    dense = run_cell("dense", net_d, el)
+    csr = run_cell("csr", net_c, el)
+
+    ev_d, ev_c = dense.pop("events_per_sim"), csr.pop("events_per_sim")
+    paired_exact = bool(np.array_equal(ev_d, ev_c))
+    delivered = [int(x) for x in ev_d[:, EV.DELIVER_MESSAGE]]
+    return {
+        "n_peers": N,
+        "generator": "powerlaw",
+        "exponent": EXPONENT,
+        "max_degree": MAX_DEGREE,
+        "n_edges": int(net_c.n_edges),
+        "mean_degree": round(el.mean_degree, 3),
+        "density": round(net_c.n_edges / float(N * net_d.max_degree), 4),
+        "rounds": ROUNDS,
+        "n_sims": SIMS,
+        "workload": "attestation_storm",
+        "engine": "floodsub",
+        "loss_rate": LOSS,
+        "dense": dense,
+        "csr": csr,
+        "rate_lift": round(csr["rounds_per_sec"]
+                           / max(dense["rounds_per_sec"], 1e-9), 3),
+        "bytes_ratio": round(csr["bytes_per_round"]
+                             / max(dense["bytes_per_round"], 1), 4),
+        "paired_per_sim_counters_exact": paired_exact,
+        "delivered_per_sim": delivered,
+        "el": el,
+    }
+
+
+def bench_records(res: dict) -> dict:
+    """The BENCH_r07 wrapper: dense + csr delivery-rounds/s lines with
+    the round-18 fingerprint["topology"] block."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        NORTH_STAR_RATE,
+        chaos_fingerprint,
+        ensemble_fingerprint,
+        topology_fingerprint,
+    )
+    from go_libp2p_pubsub_tpu.chaos.faults import ChaosConfig
+
+    el = res["el"]
+    topo_block = topology_fingerprint(
+        generator="powerlaw",
+        family="power-law",
+        params={"exponent": EXPONENT, "d_min": D_MIN,
+                "max_degree": MAX_DEGREE},
+        n_edges=res["n_edges"],
+        mean_degree=el.mean_degree,
+        max_degree=el.max_degree,
+        density=res["density"],
+        seed=SEED,
+        workload_pattern=res["workload"],
+    )
+    import jax
+
+    def line(cell):
+        rate = cell["rounds_per_sec"]
+        return {
+            "schema": 3,
+            "metric": (f"floodsub_delivery_rounds_per_sec_n{N}_"
+                       f"powerlaw_{cell['layout']}"),
+            "value": rate,
+            "unit": "delivery-rounds/s",
+            "vs_baseline": round(rate / NORTH_STAR_RATE, 6),
+            "unit_note": ("power-law topo-smoke cell (scripts/"
+                          "topo_smoke.py): S-sim scanned window, warm; "
+                          "CPU-image measurement like BENCH_r06"),
+            "fingerprint": {
+                "config": "topo_powerlaw",
+                "n_peers": N,
+                "msg_slots": MSG_SLOTS,
+                "degree": MAX_DEGREE,
+                "n_topics": 1,
+                "rounds_per_phase": 1,
+                "heartbeat_every": 1,
+                "pubs_per_round": PUB_WIDTH,
+                "engine": {"mode": "per_round",
+                           "edge_layout": cell["layout"],
+                           "router": "floodsub"},
+                "chaos": chaos_fingerprint(
+                    ChaosConfig(generator="iid", loss_rate=LOSS)),
+                "ensemble": ensemble_fingerprint(n_sims=SIMS),
+                "topology": topo_block,
+                "bytes_per_round_audited": cell["bytes_per_round"],
+                "platform": jax.default_backend(),
+            },
+        }
+
+    return {
+        "n": 7,
+        "cmd": "python scripts/topo_smoke.py (TOPO_SMOKE_UPDATE=1)",
+        "rc": 0,
+        "note": ("round-18 power-law A/B: the first cell where the csr "
+                 "layout BEATS dense on both delivery-rounds/s and "
+                 "audited bytes moved (paired per-sim counters "
+                 "bit-identical; fingerprint['topology'] block is new "
+                 "in this round — legacy lines read TOPOLOGY_BANDED)"),
+        "parsed": line(res["csr"]),
+        "parsed_dense": line(res["dense"]),
+    }
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(os.path.join(REPO, ".jax_cache"))
+
+    res = run_smoke()
+    el = res.pop("el")
+    print(json.dumps(res, indent=1))
+
+    failures = []
+    if not res["paired_per_sim_counters_exact"]:
+        failures.append("per-sim counters differ across layouts — the "
+                        "pairing (identical graph + streams) broke")
+    if any(d <= 0 for d in res["delivered_per_sim"]):
+        failures.append("a sim delivered nothing — dead wire")
+    compiles = (res["dense"]["n_compiles"], res["csr"]["n_compiles"])
+    if -1 in compiles:
+        # UNKNOWN must not read as the passing value 1 — say so out loud
+        print("topo-smoke: one-compile sentinel UNAVAILABLE "
+              "(window._cache_size missing) — compile-count gate skipped")
+    elif compiles != (1, 1):
+        failures.append(
+            f"one-compile sentinel: dense={res['dense']['n_compiles']} "
+            f"csr={res['csr']['n_compiles']}")
+    if res["bytes_ratio"] >= 1.0:
+        failures.append(
+            f"audited bytes: csr/dense ratio {res['bytes_ratio']} >= 1 "
+            "— the sparse layout stopped saving wire bytes")
+    if res["rate_lift"] <= 1.0:
+        failures.append(
+            f"rate: csr {res['csr']['rounds_per_sec']} <= dense "
+            f"{res['dense']['rounds_per_sec']} delivery-rounds/s — the "
+            "sparse plane lost on its own regime")
+
+    update = bool(os.environ.get("TOPO_SMOKE_UPDATE"))
+    if update or not os.path.exists(BASELINE_PATH):
+        if failures:
+            print("topo-smoke: FAIL (refusing to baseline a broken run):")
+            for f in failures:
+                print("  -", f)
+            return 1
+        lift_floor = round(1.0 + (res["rate_lift"] - 1.0) * RATE_MARGIN, 3)
+        baseline = {
+            "note": ("topo-smoke baseline (scripts/topo_smoke.py; "
+                     "TOPO_SMOKE_UPDATE=1 rewrites)"),
+            "n_peers": N,
+            "max_degree": MAX_DEGREE,
+            "rounds": ROUNDS,
+            "n_sims": SIMS,
+            "engine": "floodsub",
+            "workload": "attestation_storm",
+            "density": res["density"],
+            "rate_lift_floor": max(lift_floor, 1.0),
+            "bytes_ratio_ceiling": round(
+                min(res["bytes_ratio"] * 1.25, 0.999), 4),
+        }
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baseline, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"topo-smoke: wrote {BASELINE_PATH}")
+        res["el"] = el
+        wrapper = bench_records(res)
+        with open(BENCH_PATH, "w") as f:
+            json.dump(wrapper, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"topo-smoke: wrote {BENCH_PATH}")
+        return 0
+
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    shape_keys = ("n_peers", "max_degree", "rounds", "n_sims", "engine",
+                  "workload")
+    mismatched = [k for k in shape_keys if res[k] != base.get(k)]
+    if not mismatched:
+        if res["rate_lift"] < base["rate_lift_floor"]:
+            failures.append(
+                f"rate lift {res['rate_lift']} below the committed floor "
+                f"{base['rate_lift_floor']}")
+        if res["bytes_ratio"] > base["bytes_ratio_ceiling"]:
+            failures.append(
+                f"bytes ratio {res['bytes_ratio']} above the committed "
+                f"ceiling {base['bytes_ratio_ceiling']}")
+    else:
+        print("topo-smoke: NOTE — run shape differs from the committed "
+              "baseline on %s; lift/bytes gates SKIPPED (pairing + "
+              "delivery + one-compile gates still apply)" % mismatched)
+
+    if failures:
+        print("topo-smoke: FAIL")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print("topo-smoke: PASS — csr %.1f vs dense %.1f delivery-rounds/s "
+          "(lift %.2fx) at density %.3f; audited bytes ratio %.3f; "
+          "paired per-sim counters bit-identical"
+          % (res["csr"]["rounds_per_sec"], res["dense"]["rounds_per_sec"],
+             res["rate_lift"], res["density"], res["bytes_ratio"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

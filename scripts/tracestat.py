@@ -262,6 +262,21 @@ def artifact_service(path: str) -> dict:
     return recs[-1].service
 
 
+def artifact_topology(path: str) -> dict:
+    """The ``topology`` fingerprint block (round 18: which generated
+    graph the cell ran on — generator/params, E, degree stats, geo link
+    classes, workload pattern) of a bench artifact's last metric line;
+    legacy lines read back perf.artifacts.TOPOLOGY_BANDED (the banded
+    bench ring, recorded: false)."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    for rec in reversed(recs):
+        if rec.topology_recorded:
+            return rec.topology
+    return recs[-1].topology
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("tracefile")
@@ -281,6 +296,7 @@ def main():
         stats["execution"] = artifact_execution(args.artifact)
         stats["params"] = artifact_params(args.artifact)
         stats["service"] = artifact_service(args.artifact)
+        stats["topology"] = artifact_topology(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -359,6 +375,21 @@ def main():
         else:
             print("service: SERVICE_OFF (bare window/loop run, or the "
                   "artifact predates the supervised service loop)")
+    if "topology" in stats:
+        tp = stats["topology"]
+        if tp.get("recorded"):
+            print(
+                f"topology: {tp.get('generator')} ({tp.get('family')}) — "
+                f"E={tp.get('n_edges')}, mean degree "
+                f"{tp.get('mean_degree')} / cap {tp.get('max_degree')} "
+                f"(density {tp.get('density')}), "
+                f"workload {tp.get('workload_pattern') or 'steady'}"
+                + (f", link classes {tp.get('link_classes')}"
+                   if tp.get("link_classes") else "")
+            )
+        else:
+            print("topology: TOPOLOGY_BANDED sentinel (the banded bench "
+                  "ring; artifact predates the round-18 topology block)")
     if "adversary" in stats:
         av = stats["adversary"]
         if av.get("enabled"):

@@ -34,9 +34,11 @@ def test_v2_readers_parse_all_committed_bench_artifacts():
     recs = [artifacts.load_bench_artifact(p) for p in paths]
     for rec in recs:
         assert rec.value > 0
-        assert rec.metric.startswith("gossipsub_v1.1_")
+        # rounds 1-6 are the gossipsub headline; round 7 (round-18
+        # topo-smoke) is the power-law floodsub A/B cell
+        assert rec.metric.startswith(("gossipsub_v1.1_", "floodsub_"))
         assert rec.schema in (1, 2, 3)
-        assert rec.config == "default"
+        assert rec.config in ("default", "topo_powerlaw")
     # rounds 1-5: the 100k headline; round 6+ record their own N in the
     # fingerprint (r06 is the CPU-container scanned-window artifact)
     assert all(r.n_peers == 100_000 for r in recs[:5])
@@ -51,6 +53,16 @@ def test_v2_readers_parse_all_committed_bench_artifacts():
         assert csr.edge_layout == "csr" and csr.value > 0
         assert csr.n_peers == variants["parsed"].n_peers
         assert csr.rounds_per_phase == variants["parsed"].rounds_per_phase
+    r07_paths = [p for p, r in zip(paths, recs) if r.round_index == 7]
+    if r07_paths:
+        variants = artifacts.load_bench_variants(r07_paths[0])
+        # round 18: the headline IS the csr cell (it wins here), the
+        # dense sibling stays reader-visible at the same shape
+        assert variants["parsed"].edge_layout == "csr"
+        assert variants["parsed"].topology_recorded
+        dense = variants["parsed_dense"]
+        assert dense.edge_layout == "dense" and dense.value > 0
+        assert variants["parsed"].value > dense.value
     # the metric-name fallbacks recover cadence for v1 lines
     assert [r.rounds_per_phase for r in recs[:5]] == [1, 1, 1, 8, 8]
     # trajectory ordering by driver round
@@ -513,6 +525,51 @@ def test_service_block_round_trips_and_legacy_sentinel():
     for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
         r = artifacts.load_bench_artifact(p)
         assert r.service["enabled"] is False
+
+
+def test_topology_block_round_trips_and_legacy_sentinel():
+    """Round 18: the `topology` fingerprint block (which generated
+    graph a cell ran on) round-trips through the line format; LEGACY
+    lines read back the typed TOPOLOGY_BANDED sentinel (the banded
+    bench ring, recorded: false) — never a KeyError."""
+    fp = {
+        "topology": artifacts.topology_fingerprint(
+            generator="powerlaw", family="power-law",
+            params={"exponent": 2.2, "d_min": 2, "max_degree": 64},
+            n_edges=10186, mean_degree=4.97, max_degree=61,
+            density=0.078, seed=0,
+            link_classes={"local": 100, "regional": 40, "global": 10},
+            workload_pattern="attestation_storm"),
+    }
+    rec = artifacts.BenchRecord(
+        metric="powerlaw_rounds_per_sec", value=117.0,
+        unit="delivery-rounds/s", vs_baseline=0.0117, schema=3,
+        fingerprint=fp,
+    )
+    back = artifacts.record_from_line(json.loads(artifacts.dump_record(rec)))
+    assert back.topology_recorded
+    assert back.topology["generator"] == "powerlaw"
+    assert back.topology["n_edges"] == 10186
+    assert back.topology["density"] == pytest.approx(0.078)
+    assert back.topology["workload_pattern"] == "attestation_storm"
+    assert back.topology["link_classes"]["regional"] == 40
+
+    legacy = artifacts.record_from_line(
+        {"metric": "m", "value": 1.0, "unit": "x", "vs_baseline": 0.0})
+    assert legacy.topology == artifacts.TOPOLOGY_BANDED
+    assert not legacy.topology_recorded
+    assert legacy.topology["generator"] == "ring_lattice"
+
+    # the committed BENCH_r07 pair carries the block; every earlier
+    # committed line reads the sentinel without error
+    variants = artifacts.load_bench_variants(
+        os.path.join(ROOT, "BENCH_r07.json"))
+    assert variants["parsed"].topology_recorded
+    assert variants["parsed"].edge_layout == "csr"
+    assert variants["parsed_dense"].topology == variants["parsed"].topology
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
+        r = artifacts.load_bench_artifact(p)
+        assert isinstance(r.topology["generator"], str)
 
 
 def test_service_report_fingerprint_matches_block(tmp_path):

@@ -1,0 +1,419 @@
+"""Topology + workload plane (round 18, docs/DESIGN.md §18).
+
+Pins the generator contracts:
+
+  * determinism — same seed ⇒ byte-identical canonical edge list, and
+    the dense/CSR emissions are built from ONE Topology (identical
+    adjacency bytes);
+  * capacity bounds — the degree cap holds at EVERY node for every
+    generator;
+  * geo link classes are sum-preserving (each edge in exactly one
+    class) and their per-slot planes cover exactly the present slots;
+  * dense-vs-CSR engine parity stays BIT-EXACT on a generated
+    power-law graph for all four engines (the ragged long-tail regime
+    the sparse plane wins on — r=8 phase slow-marked);
+  * workload schedules are deterministic scan xs with the documented
+    burst shapes;
+  * the row-owner-aligned block padding (ops/csr.pad_csr_blocks) keeps
+    the flat involution + engine parity intact (the edge-sharding
+    layout, MULTICHIP_r07);
+  * the round-18 audit/projection seams: the CSR-resident tier rows in
+    MEM_AUDIT.json and the density-priced memory term.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph, topo
+from go_libp2p_pubsub_tpu.chaos.faults import ChaosConfig
+from go_libp2p_pubsub_tpu.config import (
+    GossipSubParams,
+    PeerScoreThresholds,
+    default_peer_score_params,
+)
+from go_libp2p_pubsub_tpu.models import floodsub
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+    make_gossipsub_phase_step,
+)
+from go_libp2p_pubsub_tpu.models.randomsub import make_randomsub_step
+from go_libp2p_pubsub_tpu.ops import csr as csrops
+from go_libp2p_pubsub_tpu.state import (
+    Net,
+    SimState,
+    densify_edge_planes,
+)
+from go_libp2p_pubsub_tpu.topo.generators import link_class_planes
+
+N = 128
+M = 32
+PUBW = 3
+CAP = 16
+
+CHAOS = ChaosConfig(generator="iid", loss_rate=0.25)
+
+GENERATORS = [
+    ("powerlaw", lambda seed: topo.powerlaw(
+        N, exponent=2.2, d_min=2, max_degree=CAP, seed=seed)),
+    ("small_world", lambda seed: topo.small_world(
+        N, d=4, beta=0.2, seed=seed, max_degree=CAP)),
+    ("geo", lambda seed: topo.geo_clusters(
+        N, n_clusters=4, d_local=4, d_regional=1, d_global=1, seed=seed)),
+]
+
+
+def _powerlaw_nets(seed=0):
+    el = topo.powerlaw(N, exponent=2.2, d_min=2, max_degree=CAP, seed=seed)
+    subs = graph.subscribe_all(N, 1)
+    return topo.build_nets(el, subs, max_degree=CAP)
+
+
+def canon(net, st):
+    return (densify_edge_planes(net, st)
+            if net.edge_layout == "csr" else st)
+
+
+def assert_trees_equal(a, b, tag=""):
+    la = jtu.tree_flatten_with_path(a)[0]
+    lb = jtu.tree_flatten_with_path(b)[0]
+    assert len(la) == len(lb), f"{tag}: leaf count differs"
+    for (p, x), (_, y) in zip(la, lb):
+        if hasattr(x, "dtype") and "key" in str(x.dtype):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y),
+            err_msg=f"{tag}: mismatch at {jtu.keystr(p)}")
+
+
+def publish_schedule(rounds, seed=0):
+    rng = np.random.default_rng(seed)
+    po = rng.integers(-1, N, size=(rounds, PUBW)).astype(np.int32)
+    pt = np.zeros((rounds, PUBW), np.int32)
+    pv = np.ones((rounds, PUBW), bool)
+    return jnp.asarray(po), jnp.asarray(pt), jnp.asarray(pv)
+
+
+# ---------------------------------------------------------------------------
+# generator determinism + capacity bounds
+
+
+@pytest.mark.parametrize("name,gen", GENERATORS, ids=[g[0] for g in GENERATORS])
+def test_generator_deterministic_and_capped(name, gen):
+    a, b = gen(7), gen(7)
+    # same seed ⇒ byte-identical canonical edge list
+    assert a.canonical_bytes() == b.canonical_bytes()
+    # a different seed moves it (the rng is actually consulted)
+    assert a.canonical_bytes() != gen(8).canonical_bytes()
+    # degree cap at EVERY node; no self/duplicate edges (canonical form
+    # is a sorted set of a<b pairs by construction — verify anyway)
+    deg = a.degree
+    cap = CAP if name != "geo" else a.max_degree
+    assert deg.max() <= cap
+    assert (a.edges[:, 0] < a.edges[:, 1]).all()
+    assert len({tuple(e) for e in a.edges}) == a.n_undirected
+    # the graph is usable: nobody isolated, edges exist
+    assert a.n_undirected > 0
+    assert deg.min() >= 1
+
+
+def test_one_edge_list_two_emissions_identical_graph():
+    """The A/B construction invariant: both layouts are built from ONE
+    Topology whose adjacency is a deterministic function of the
+    canonical edge list."""
+    el = topo.powerlaw(N, exponent=2.2, d_min=2, max_degree=CAP, seed=3)
+    t1, net_d, net_c = topo.build_nets(el, graph.subscribe_all(N, 1),
+                                       max_degree=CAP)
+    t2 = topo.to_topology(el, max_degree=CAP)
+    assert t1.nbr.tobytes() == t2.nbr.tobytes()
+    assert t1.rev.tobytes() == t2.rev.tobytes()
+    # the two Nets see the same adjacency
+    np.testing.assert_array_equal(np.asarray(net_d.nbr),
+                                  np.asarray(net_c.nbr))
+    assert net_d.edge_layout == "dense" and net_c.edge_layout == "csr"
+    assert int(net_c.n_edges) == int(t1.nbr_ok.sum())
+    # E is the undirected count doubled (symmetric directed edges)
+    assert int(net_c.n_edges) == 2 * el.n_undirected
+
+
+def test_powerlaw_is_the_sparse_regime():
+    """mean degree ≪ K: the density the topo-smoke win lives on."""
+    el = topo.powerlaw(2048, exponent=2.2, d_min=2, max_degree=64, seed=0)
+    assert el.max_degree <= 64
+    assert el.mean_degree < 64 * 0.25  # long tail, not a regular graph
+    # a zipf-ish tail: some node is far above the mean
+    assert el.degree.max() >= 4 * el.mean_degree
+
+
+# ---------------------------------------------------------------------------
+# geo link classes
+
+
+def test_geo_link_classes_sum_preserving():
+    el = topo.geo_clusters(N, n_clusters=4, d_local=4, d_regional=2,
+                           d_global=1, seed=5)
+    assert el.link_class is not None
+    counts = np.bincount(el.link_class, minlength=3)
+    # every edge in EXACTLY one class
+    assert counts.sum() == el.n_undirected
+    assert (el.link_class >= 0).all() and (el.link_class <= 2).all()
+    # all three classes occur at this shape
+    assert (counts > 0).all()
+
+    t = topo.to_topology(el)
+    cls, lat = link_class_planes(el, t)
+    # class plane covers exactly the present slots
+    assert ((cls >= 0) == t.nbr_ok).all()
+    # symmetric over the involution (an undirected edge has one class)
+    j, k = np.nonzero(t.nbr_ok)
+    assert (cls[j, k] == cls[t.nbr[j, k], t.rev[j, k]]).all()
+    # latency plane maps classes through class_latency, 0 on absent
+    for c, rounds in enumerate(el.class_latency):
+        assert (lat[cls == c] == rounds).all()
+    assert (lat[~t.nbr_ok] == 0).all()
+    # directed class counts are the undirected ones doubled
+    dir_counts = np.bincount(cls[cls >= 0], minlength=3)
+    np.testing.assert_array_equal(dir_counts, counts * 2)
+
+
+# ---------------------------------------------------------------------------
+# workload plane
+
+
+def test_publish_bursts_patterns_and_determinism():
+    for pat in topo.workloads.PATTERNS:
+        a = topo.publish_bursts(pat, 32, 8, N, seed=3)
+        b = topo.publish_bursts(pat, 32, 8, N, seed=3)
+        for x, y in zip(a, b):
+            assert x.tobytes() == y.tobytes()
+        po, pt, pv = a
+        assert po.shape == (32, 8) and pv.all()
+        assert ((po >= -1) & (po < N)).all()
+
+    po, _, _ = topo.publish_bursts("attestation_storm", 32, 8, N,
+                                   seed=1, period=8, burst_len=2,
+                                   base_rate=1)
+    width = (po >= 0).sum(axis=1)
+    assert (width[(np.arange(32) % 8) < 2] == 8).all()
+    assert (width[(np.arange(32) % 8) >= 2] == 1).all()
+
+    po, pt, _ = topo.publish_bursts("flash_crowd", 30, 6, N, seed=1,
+                                    onset=10, duration=5, base_rate=2)
+    width = (po >= 0).sum(axis=1)
+    assert (width[10:15] == 6).all()
+    # the crowd lands on the hot topic
+    assert (pt[10:15][po[10:15] >= 0] == 0).all()
+    assert (width[:10] == 2).all() and (width[15:] == 2).all()
+
+    with pytest.raises(ValueError, match="unknown pattern"):
+        topo.publish_bursts("nope", 8, 4, N)
+
+
+# ---------------------------------------------------------------------------
+# dense-vs-CSR parity on the generated power-law graph (all 4 engines)
+
+
+def test_floodsub_powerlaw_parity():
+    _t, net_d, net_c = _powerlaw_nets()
+    po, pt, pv = publish_schedule(6)
+
+    def run(net):
+        st = SimState.init(N, M, k=net.max_degree, n_edges=net.n_edges)
+        for i in range(6):
+            st = floodsub.floodsub_step(net, st, po[i], pt[i], pv[i],
+                                        chaos=CHAOS)
+        return canon(net, st)
+
+    assert_trees_equal(run(net_d), run(net_c), "floodsub/powerlaw")
+
+
+def test_randomsub_powerlaw_parity():
+    _t, net_d, net_c = _powerlaw_nets()
+    po, pt, pv = publish_schedule(6)
+
+    def run(net):
+        step = make_randomsub_step(net, chaos=CHAOS)
+        st = SimState.init(N, M, k=net.max_degree, n_edges=net.n_edges)
+        for i in range(6):
+            st = step(st, po[i], pt[i], pv[i])
+        return canon(net, st)
+
+    assert_trees_equal(run(net_d), run(net_c), "randomsub/powerlaw")
+
+
+def _gossip_cfg(layout, **kw):
+    return GossipSubConfig.build(
+        GossipSubParams(), PeerScoreThresholds(), score_enabled=True,
+        chaos=CHAOS, edge_layout=layout, **kw)
+
+
+def test_gossipsub_powerlaw_parity():
+    _t, net_d, net_c = _powerlaw_nets()
+    sp = default_peer_score_params(1)
+    po, pt, pv = publish_schedule(8)
+
+    def run(net):
+        cfg = _gossip_cfg(net.edge_layout)
+        st = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+        step = make_gossipsub_step(cfg, net, score_params=sp)
+        for i in range(8):
+            st = step(st, po[i], pt[i], pv[i])
+        return canon(net, st)
+
+    assert_trees_equal(run(net_d), run(net_c), "gossipsub/powerlaw")
+
+
+@pytest.mark.parametrize("r", [4, pytest.param(8, marks=pytest.mark.slow)])
+def test_gossipsub_phase_powerlaw_parity(r):
+    _t, net_d, net_c = _powerlaw_nets()
+    sp = default_peer_score_params(1)
+    po, pt, pv = publish_schedule(2 * r)
+
+    def run(net):
+        cfg = _gossip_cfg(net.edge_layout, heartbeat_every=r)
+        st = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+        step = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+        for p in range(2):
+            st = step(st, po[p * r:(p + 1) * r], pt[:r], pv[:r],
+                      do_heartbeat=True)
+        return canon(net, st)
+
+    assert_trees_equal(run(net_d), run(net_c), f"phase/powerlaw r={r}")
+
+
+# ---------------------------------------------------------------------------
+# edge-space sharding layout (row-owner-aligned block padding)
+
+
+def test_block_boundaries_row_aligned():
+    el = topo.powerlaw(N, exponent=2.2, d_min=2, max_degree=CAP, seed=1)
+    t = topo.to_topology(el, max_degree=CAP)
+    ct = csrops.build_csr(t.nbr, t.rev, t.nbr_ok)
+    for n_blocks in (2, 4, 8):
+        bounds = csrops.block_boundaries(ct.row_ptr, n_blocks)
+        assert bounds[0] == 0 and bounds[-1] == ct.n_edges
+        assert (np.diff(bounds) >= 0).all()
+        # every boundary is a row boundary: whole rows per block
+        assert np.isin(bounds, ct.row_ptr).all()
+
+
+def test_pad_csr_blocks_structure_and_parity():
+    el = topo.powerlaw(N, exponent=2.2, d_min=2, max_degree=CAP, seed=1)
+    subs = graph.subscribe_all(N, 1)
+    _t, net_d, net_p = topo.build_nets(el, subs, max_degree=CAP,
+                                       edge_shards=4)
+    assert net_p.csr_e_valid is not None
+    assert net_p.n_edges % 4 == 0
+    ev = np.asarray(net_p.csr_e_valid)
+    # padding never owned by e_of_nk; real edges all mapped
+    eon = np.asarray(net_p.csr_e_of_nk)
+    mapped = eon[eon >= 0]
+    assert ev[mapped].all()
+    assert mapped.shape[0] == int(ev.sum())
+    # flat involution survives the padding
+    eperm = np.asarray(net_p.csr_eperm)
+    assert (eperm[eperm] == np.arange(net_p.n_edges)).all()
+    # row ids stay sorted (segment reductions rely on it)
+    assert (np.diff(np.asarray(net_p.csr_row)) >= 0).all()
+
+    # engine parity: padded csr == dense, and padding stays zero
+    po, pt, pv = publish_schedule(6)
+
+    def run(net):
+        st = SimState.init(N, M, k=net.max_degree, n_edges=net.n_edges)
+        for i in range(6):
+            st = floodsub.floodsub_step(net, st, po[i], pt[i], pv[i],
+                                        chaos=CHAOS)
+        return st
+
+    a, b = run(net_d), run(net_p)
+    assert_trees_equal(a, canon(net_p, b), "padded-csr floodsub")
+    assert (np.asarray(b.dlv.fe_words)[~ev] == 0).all()
+
+
+def test_edge_sharding_specs():
+    """state_shardings recognizes [E]-leading leaves (single-device
+    spec check — the placed-window contract lives in mesh2d_dryrun /
+    MULTICHIP_r07.json)."""
+    from go_libp2p_pubsub_tpu.parallel import make_mesh, state_shardings
+
+    _t, _net_d, net_c = _powerlaw_nets()
+    st = SimState.init(N, M, k=net_c.max_degree, n_edges=net_c.n_edges)
+    mesh = make_mesh(1)
+    sh = state_shardings(st, mesh, N, n_edges=int(net_c.n_edges))
+    flat = jtu.tree_flatten_with_path(sh)[0]
+    specs = {jtu.keystr(p): s.spec for p, s in flat}
+    fe_key = next(k for k in specs if "fe_words" in k)
+    have_key = next(k for k in specs if k.endswith("have") or "have" in k)
+    assert specs[fe_key] == specs[have_key]
+    # replicated leaves stay replicated
+    ev_key = next(k for k in specs if "events" in k)
+    assert len(specs[ev_key]) == 0
+
+
+# ---------------------------------------------------------------------------
+# round-18 audit + projection seams
+
+
+def test_mem_audit_csr_tier():
+    import json
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "scripts"))
+    import memstat
+
+    with open(memstat.AUDIT_PATH) as f:
+        audit = json.load(f)
+    tier = audit["csr_tier"]["engines"]["gossipsub_csr"]
+    # the named planes ride the tier
+    leaves = tier["edge_resident_leaves"]
+    for sf in (".fe_words", ".served_lo", ".served_hi", ".peerhave",
+               ".iasked"):
+        assert any(p.endswith(sf) for p in leaves), sf
+    # density prices the tier: full density saves nothing, sparse saves
+    assert tier["saved_bytes_per_peer_by_density"]["1.0"] == 0.0
+    assert tier["saved_bytes_per_peer_by_density"]["0.25"] > 0
+    # the helper agrees with the block
+    assert memstat.bytes_per_peer_for(
+        audit, "gossipsub", "csr", 1.0) == pytest.approx(
+            audit["engines"]["gossipsub"]["totals"]["bytes_per_peer"])
+    assert memstat.bytes_per_peer_for(
+        audit, "gossipsub", "csr", 0.25) < memstat.bytes_per_peer_for(
+            audit, "gossipsub", "dense")
+
+
+def test_project_at_scale_csr_tier():
+    import json
+    import os
+
+    from go_libp2p_pubsub_tpu.perf.projection import (
+        audit_bytes_per_peer,
+        project_at_scale,
+    )
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "MEM_AUDIT.json")) as f:
+        audit = json.load(f)
+    dense = project_at_scale(1_000_000, audit=audit)
+    sparse = project_at_scale(1_000_000, audit=audit, edge_layout="csr",
+                              density=0.25)
+    # bytes/peer DROPS at the audit's density on the csr tier
+    assert sparse.bytes_per_peer < dense.bytes_per_peer
+    assert sparse.hbm_headroom > dense.hbm_headroom
+    # full density: the tier saves nothing — identical memory term
+    even = project_at_scale(1_000_000, audit=audit, edge_layout="csr",
+                            density=1.0)
+    assert even.bytes_per_peer == pytest.approx(dense.bytes_per_peer)
+    # the helper is the audit's own arithmetic
+    assert audit_bytes_per_peer(audit, edge_layout="csr", density=0.25) \
+        == pytest.approx(sparse.bytes_per_peer)
