@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -186,6 +186,25 @@ lift-audit:
 hlo-audit:
 	python scripts/hlo_audit.py
 
+# static device-cost gate (scripts/cost_audit.py; docs/DESIGN.md §19):
+# the jaxpr-level cost interpreter prices every engine×layout build —
+# per-round {flops, hbm_bytes (unfused upper bound), audited
+# halo_bytes, rng_bits, gather/scatter bytes} as committed const +
+# slope*N fits — and enforces the hard contracts: csr/dense halo ratio
+# == power-law density AND == the measured tally_halo_bytes; floodsub
+# rng == 0; telemetry flop delta and invariant-checker flops under
+# their static share ceilings. Committed COST_AUDIT.json must
+# reproduce byte-identical (COST_UPDATE=1 rewrites; a mismatch NAMES
+# the diverging keys). Trace-only, ~15 s.
+cost-audit:
+	python scripts/cost_audit.py
+
+# the whole static suite as ONE verdict (round 19): simlint + guards +
+# lift-audit + hlo-audit + cost-audit, one machine-readable JSON block
+# (per-pass pass/fail + artifact paths), one exit code.
+static:
+	python scripts/analyze.py --json
+
 # analysis-plane gate (scripts/analyze.py; docs/DESIGN.md §9): simlint
 # — the repo-specific AST lint pass (traced branches, host syncs, PRNG
 # discipline, packed-word dtype hygiene, import-time execution, static-
@@ -198,11 +217,14 @@ hlo-audit:
 # per multi-round run, buffer donation audited, and every state leaf
 # pinned against the committed STATE_SCHEMA.json (ANALYZE_UPDATE=1
 # rewrites). CPU-only by contract. Since round 16 the target also
-# runs the lift-audit and hlo-audit legs above.
+# runs the lift-audit and hlo-audit legs above; since round 19 the
+# cost-audit leg too (`make static` is the same suite as one JSON
+# verdict).
 analyze:
 	python scripts/analyze.py
 	python scripts/lift_audit.py
 	python scripts/hlo_audit.py
+	python scripts/cost_audit.py
 
 # declarative (config x N x r) sweep — e.g. the eth2 shard table:
 #   make sweep SWEEP_ARGS='--config eth2 --n 12500,25000,50000 --r 16'
@@ -229,6 +251,7 @@ quick:
 	python scripts/analyze.py
 	python scripts/lift_audit.py
 	python scripts/hlo_audit.py
+	python scripts/cost_audit.py
 	python scripts/memstat.py
 	python scripts/scale_smoke.py
 	python scripts/topo_smoke.py

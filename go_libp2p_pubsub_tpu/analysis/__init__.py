@@ -17,9 +17,21 @@ invariants* that make those outputs trustworthy as the codebase grows:
     a multi-round run), audits buffer donation, and diffs every state
     leaf against the committed ``STATE_SCHEMA.json`` baseline
     (``ANALYZE_UPDATE=1`` rewrites — the PERF_SMOKE pattern).
+  * ``lift`` / ``hloaudit`` — the round-16 passes: interprocedural
+    SHAPE/VALUE dataflow over every config read (LIFT_AUDIT.json) and
+    the lowered-StableHLO contract auditor with the recompile-cause
+    attributor (docs/DESIGN.md §16).
+  * ``costmodel`` — the round-19 static device-cost auditor: a
+    jaxpr-level interpreter pricing every engine×layout build's
+    per-round flops / hbm bytes / audited halo bytes / rng bits as
+    committed const+slope·N fits (COST_AUDIT.json), with hard
+    contracts (halo ratio == density == measured tally; floodsub
+    rng == 0; telemetry/oracle flop-share ceilings) and the v5e-8
+    roofline term perf.projection arms from it (docs/DESIGN.md §19).
 
 Entry point: ``scripts/analyze.py`` / ``make analyze`` (wired into
-``make quick``). docs/DESIGN.md §9 has the rule catalog.
+``make quick``); ``make static`` emits the whole five-pass suite as
+one JSON verdict. docs/DESIGN.md §9 has the rule catalog.
 """
 
 from __future__ import annotations

@@ -86,6 +86,16 @@ Rule catalog (ids are stable; docs/DESIGN.md §9):
                  trip is a rubber stamp, the exact failure mode the
                  oracle plane exists to prevent).
 
+  donated-reuse  (round 19 — the only CALL-SITE rule: it lints the
+                 repo's tests/ and scripts/ trees, not the package)
+                 reuse of a state tree after it was passed to a
+                 donating jitted step/window — the documented container
+                 footgun: donation deletes the old buffers, so a later
+                 read crashes or reads garbage. ``st = step(st, …)``
+                 rebinding is the sanctioned idiom; ``make_*``/
+                 ``build_*`` constructors and ``on_*`` observer hooks
+                 never donate and are exempt.
+
 Allowlist: ``analysis/ALLOWLIST`` lines of ``<rule> <relpath>`` or
 ``<rule> <relpath>::<qualname>`` (``#`` comments). Entries match every
 violation of that rule in that file (or function). Keep it short — an
@@ -933,6 +943,173 @@ def _rule_invariant_registry(pkg_root: str) -> list:
 
 
 # ---------------------------------------------------------------------------
+# call-site rule: donated-state reuse (tests/ and scripts/)
+
+
+#: bare callee names (or attribute terminals) that by repo convention
+#: are jitted, state-DONATING callables: the ``step`` a ``make_*``
+#: builder returns, a ``make_window`` window, the guards/ensemble
+#: ``jit_fn``/``ens`` handles. ``make_*``/``build_*`` calls merely
+#: CONSTRUCT such callables and never donate.
+_DONATING_NAMES = frozenset({"step", "window", "win", "jit_fn", "ens",
+                             "step_fn"})
+
+#: argument names that look like a state tree (the donated pytree) —
+#: "st", "st2", "st_a", "state*", "states*", "tree*"; NOT "step" (the
+#: callable, not the tree)
+_STATEISH = re.compile(r"^(st(\d+|_\w+)?|states?\w*|tree\w*)$",
+                       re.IGNORECASE)
+
+
+def _terminal_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _is_donating_call(node: ast.Call) -> bool:
+    name = _terminal_name(node.func)
+    # make_*/build_* CONSTRUCT steps; on_* are observer hooks
+    # (InvariantHook.on_step reads the live state, never donates)
+    if not name or name.startswith(("make_", "build_", "on_")):
+        return False
+    if isinstance(node.func, ast.Name):
+        return (name in _DONATING_NAMES
+                or name.endswith(("_step", "_window")))
+    # method-style callees: only the conventional jitted handles and
+    # the module-level engine steps (floodsub.floodsub_step) — a bare
+    # *_step method name is usually an unrelated helper
+    return name in _DONATING_NAMES or name.endswith("sub_step")
+
+
+def _rule_donated_reuse(rel, tree, out):
+    """Flag reuse of a state tree AFTER it was passed to a donating
+    jitted step/window — the documented container footgun (CHANGES
+    rounds 10+): jitted steps and scanned windows DONATE their state
+    buffers, so the old tree's arrays are deleted and any later read
+    either crashes or (worse, under some backends) reads freed memory.
+    The correct idiom rebinds the same name (``st = step(st, ...)``)
+    or builds a fresh tree per run. Applies to the CALL SITES — tests/
+    and scripts/ — not the package (engine internals are functional)."""
+    scopes = [("", tree)] + list(_iter_functions(tree))
+    for qual, fn in scopes:
+        body = (fn.body if isinstance(
+            fn, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef))
+            else [])
+        if not body:
+            continue
+        donations = []   # (name, call_line, rebound_same_stmt)
+        rebinds = {}     # name -> rebind lines
+        loads = {}       # name -> load lines
+        loops = []       # (lineno, end_lineno) of every loop statement
+        assigned_calls = set()  # Call ids already handled via an Assign
+        nodes = list(_walk_shallow(fn))
+        # two passes: _walk_shallow is a DFS stack, not source order, so
+        # the Assign handling must run before its inner Call is seen by
+        # the bare-call branch
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                targets = [t.id for tgt in node.targets
+                           for t in ast.walk(tgt)
+                           if isinstance(t, ast.Name)]
+                for t in targets:
+                    rebinds.setdefault(t, []).append(node.lineno)
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call) and _is_donating_call(sub):
+                        assigned_calls.add(id(sub))
+                        for arg in sub.args[:3]:
+                            if (isinstance(arg, ast.Name)
+                                    and _STATEISH.match(arg.id)):
+                                # the statement's END line, so a
+                                # multi-line call's own argument loads
+                                # never read as after-donation reuse
+                                donations.append(
+                                    (arg.id,
+                                     node.end_lineno or node.lineno,
+                                     arg.id in targets))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        rebinds.setdefault(t.id, []).append(node.lineno)
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                loops.append((node.lineno, node.end_lineno or node.lineno))
+        for node in nodes:
+            if (isinstance(node, ast.Call) and _is_donating_call(node)
+                    and id(node) not in assigned_calls):
+                for arg in node.args[:3]:
+                    if isinstance(arg, ast.Name) and _STATEISH.match(arg.id):
+                        donations.append(
+                            (arg.id, node.end_lineno or node.lineno,
+                             False))
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                loads.setdefault(node.id, []).append(node.lineno)
+        for name, line, rebound in donations:
+            if rebound:
+                continue  # st = step(st, ...) — the correct idiom
+            next_rebind = min(
+                (ln for ln in rebinds.get(name, []) if ln > line),
+                default=None)
+            reuse = [ln for ln in loads.get(name, [])
+                     if ln > line and (next_rebind is None
+                                       or ln < next_rebind)]
+            if not reuse:
+                # the loop back-edge: a donation inside a loop whose
+                # state name is never rebound ANYWHERE in that loop
+                # re-reads the donated buffers on iteration 2 — the
+                # canonical form of the footgun, with no load on a
+                # later line
+                enclosing = [(lo, hi) for lo, hi in loops
+                             if lo <= line <= hi]
+                if enclosing:
+                    lo, hi = min(enclosing, key=lambda p: p[1] - p[0])
+                    if not any(lo <= ln <= hi
+                               for ln in rebinds.get(name, [])):
+                        reuse = [line]
+            if reuse:
+                out.append(Violation(
+                    "donated-reuse", rel, reuse[0], qual,
+                    f"state tree {name!r} is read at line {reuse[0]} "
+                    f"after being DONATED to a jitted step/window at "
+                    f"line {line} — donation deletes the old buffers; "
+                    "rebind the result to the same name or build a "
+                    "fresh tree per run",
+                ))
+
+
+def lint_donated_reuse(src: str, rel: str) -> list:
+    """The donated-reuse rule on one source string (the negative-test
+    surface, like :func:`lint_source` for the device-scope rules)."""
+    out: list[Violation] = []
+    _rule_donated_reuse(rel, ast.parse(src), out)
+    return out
+
+
+def lint_callsites(repo_root: str) -> list:
+    """The donated-reuse rule over the repo's call-site trees (tests/
+    and scripts/); rels are repo-relative (``tests/test_x.py``) so the
+    ALLOWLIST grammar covers them unchanged."""
+    out: list[Violation] = []
+    for sub in ("tests", "scripts"):
+        d = os.path.join(repo_root, sub)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if not fname.endswith(".py"):
+                continue
+            rel = f"{sub}/{fname}"
+            with open(os.path.join(d, fname)) as f:
+                src = f.read()
+            try:
+                out.extend(lint_donated_reuse(src, rel))
+            except SyntaxError as e:  # pragma: no cover
+                out.append(Violation("parse", rel, e.lineno or 1, "",
+                                     str(e)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # drivers
 
 
@@ -1007,9 +1184,13 @@ def filter_allowed(violations, allowlist):
 
 
 def run(pkg_root: str | None = None) -> tuple:
-    """Lint the package with the committed allowlist applied. Returns
-    (violations, allowed)."""
+    """Lint the package — plus the repo call-site trees (tests/,
+    scripts/) under the donated-reuse rule — with the committed
+    allowlist applied. Returns (violations, allowed)."""
     if pkg_root is None:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     allow = load_allowlist(os.path.join(pkg_root, "analysis", "ALLOWLIST"))
-    return filter_allowed(lint_package(pkg_root), allow)
+    found = lint_package(pkg_root) + lint_callsites(
+        os.path.dirname(pkg_root))
+    found.sort(key=lambda v: (v.rel, v.line, v.rule))
+    return filter_allowed(found, allow)

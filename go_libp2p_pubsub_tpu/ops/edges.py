@@ -37,6 +37,21 @@ _TALLY: list | None = None
 _BYTES_TALLY: list | None = None
 
 
+class TallyCacheHit(RuntimeError):
+    """``tally_step`` traced a step and recorded ZERO halo seams.
+
+    Every engine body routes its cross-peer movement through the tally
+    seams above, so an empty tally means the trace never actually ran
+    the body: jax caches jaxprs per jitted callable, and a callable that
+    hides a jit INSIDE it (a wrapper without ``__wrapped__``, a window
+    closing over a jitted step) can satisfy ``eval_shape`` from that
+    cache without re-executing the Python — silently reading zero into
+    every halo-budget gate built on the tally (hlo-audit's equal-tally
+    legs, topo-smoke's audited bytes, the cost audit). The round-16
+    CHANGES NOTE documented the footgun; since round 19 it is a typed
+    error instead of a zero."""
+
+
 def _tally(kind: str, moved=None) -> None:
     if _TALLY is not None:
         _TALLY.append(kind)
@@ -85,14 +100,19 @@ def tally_step(step, state, args=(), kwargs=None, *, net=None,
                count_bytes: bool = False) -> list:
     """Trace ONE step call under the armed halo tally and return the
     raw tally list — the shared harness behind `make hlo-audit`'s
-    equal-tally legs, mesh2d_dryrun's halo census, and topo-smoke's
-    audited-bytes leg. Unwraps to the UNJITTED body itself because the
-    caveat lives here, once: jax's tracing cache is keyed on the jitted
-    function, so eval_shape of the jit can hit a cached jaxpr from an
-    earlier trace and silently record ZERO seams — the raw body
-    re-traces every time. ``net`` is threaded as the leading positional
-    for engine bodies that take it (the guards harness convention);
-    ``count_bytes`` switches the tally to (kind, nbytes) entries."""
+    equal-tally legs, mesh2d_dryrun's halo census, topo-smoke's
+    audited-bytes leg and the cost audit's halo cross-check. Unwraps to
+    the UNJITTED body itself because the caveat lives here, once: jax's
+    tracing cache is keyed on the jitted function, so eval_shape of the
+    jit can hit a cached jaxpr from an earlier trace and silently
+    record ZERO seams — the raw body re-traces every time. A body the
+    unwrap cannot reach (a jit hidden INSIDE a plain wrapper) can still
+    satisfy the trace from the cache, so an EMPTY tally raises the
+    typed :class:`TallyCacheHit` instead of returning zero — no gate
+    built on the tally can mistake a cache hit for a seam-free engine.
+    ``net`` is threaded as the leading positional for engine bodies
+    that take it (the guards harness convention); ``count_bytes``
+    switches the tally to (kind, nbytes) entries."""
     import jax
 
     raw = getattr(step, "__wrapped__", step)
@@ -104,6 +124,14 @@ def tally_step(step, state, args=(), kwargs=None, *, net=None,
             jax.eval_shape(lambda s: raw(net, s, *args, **kwargs), state)
         else:
             jax.eval_shape(lambda s: raw(s, *args, **kwargs), state)
+    if not out:
+        raise TallyCacheHit(
+            f"halo tally of {getattr(step, '__name__', step)!r} recorded "
+            "ZERO cross-peer seams — either an inner jit satisfied the "
+            "trace from a cached jaxpr (pass the raw body; the unwrap "
+            "only reaches __wrapped__) or the engine stopped routing "
+            "through the ops/edges seams; both break every halo-budget "
+            "gate, so this is an error, never a silent zero")
     return out
 
 

@@ -141,6 +141,44 @@ TOPOLOGY_BANDED = {"recorded": False, "generator": "ring_lattice",
                    "link_classes": None, "workload_pattern": None}
 
 
+#: the cost defaults every artifact WITHOUT a fingerprint["cost"] block
+#: reads back as (round 19): the producing build was never priced by
+#: the static device-cost audit (analysis/costmodel.py) — an explicit
+#: COST_UNAUDITED sentinel, so readers can ask any artifact "what does
+#: one round of this build cost, statically" without special-casing
+#: age; the legacy answer is "unrecorded", never a silently-assumed
+#: zero.
+COST_UNAUDITED = {"recorded": False, "build": None,
+                  "flops_per_round": None, "hbm_bytes_per_round": None,
+                  "halo_bytes_per_round": None, "rng_bits_per_round": None,
+                  "arithmetic_intensity": None}
+
+
+def cost_fingerprint(*, build: str, flops_per_round: float,
+                     hbm_bytes_per_round: float,
+                     halo_bytes_per_round: float,
+                     rng_bits_per_round: float) -> dict:
+    """The schema-v3 ``fingerprint["cost"]`` block (round 19): the
+    statically-priced per-round cost of the producing build — the
+    COST_AUDIT.json fit evaluated at the artifact's own N (flops, the
+    unfused-traffic hbm bytes, the audited halo bytes, rng bits), plus
+    the derived arithmetic intensity the v5e-8 roofline term consumes
+    (perf.projection.roofline_block). Readers go through
+    :attr:`BenchRecord.cost`, which defaults legacy lines to
+    :data:`COST_UNAUDITED`."""
+    flops = float(flops_per_round)
+    hbm = float(hbm_bytes_per_round)
+    return {
+        "recorded": True,
+        "build": str(build),
+        "flops_per_round": round(flops, 1),
+        "hbm_bytes_per_round": round(hbm, 1),
+        "halo_bytes_per_round": round(float(halo_bytes_per_round), 1),
+        "rng_bits_per_round": round(float(rng_bits_per_round), 1),
+        "arithmetic_intensity": round(flops / hbm, 6) if hbm else None,
+    }
+
+
 def topology_fingerprint(*, generator: str, family: str, params: dict,
                          n_edges: int, mean_degree: float,
                          max_degree: int, density: float,
@@ -526,6 +564,22 @@ class BenchRecord:
     @property
     def topology_recorded(self) -> bool:
         return bool(self.topology["recorded"])
+
+    @property
+    def cost(self) -> dict:
+        """The cost block of the fingerprint (round 19): the static
+        per-round flop/byte price of the producing build
+        (analysis/costmodel.py). LEGACY artifacts — every line that
+        predates the cost audit — read back :data:`COST_UNAUDITED`,
+        an explicit "never statically priced" sentinel."""
+        fp = self.fingerprint or {}
+        out = dict(COST_UNAUDITED)
+        out.update(fp.get("cost") or {})
+        return out
+
+    @property
+    def cost_audited(self) -> bool:
+        return bool(self.cost["recorded"])
 
     @property
     def scanned(self) -> bool | None:

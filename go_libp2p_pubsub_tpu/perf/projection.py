@@ -71,6 +71,72 @@ ROUND5_SHARD_RATES_R16 = {
 #: model checks a shard against (16 GB HBM2E per v5e chip)
 HBM_BYTES_PER_CHIP = 16 * 1024 ** 3
 
+#: v5e per-chip peak compute (bf16 MXU, 197 TFLOP/s) — the OPTIMISTIC
+#: compute ceiling of the roofline term: no program beats it, so the
+#: implied rate is a hard upper bound on the day a slice is measured
+V5E_PEAK_FLOPS = 197e12
+#: v5e per-chip HBM bandwidth (GB/s)
+V5E_HBM_GBPS = 819.0
+
+
+def roofline_ms_per_round(flops_per_round: float,
+                          hbm_bytes_per_round: float, *,
+                          peak_flops: float = V5E_PEAK_FLOPS,
+                          hbm_gbps: float = V5E_HBM_GBPS) -> float:
+    """The static v5e roofline time of one PER-CHIP round (round 19):
+    ``max(flops/peak, bytes/bandwidth)`` over the cost audit's
+    statically-priced per-round work (analysis/costmodel.py — evaluate
+    the committed fit at the SHARD peer count and pass the result
+    here). Semantics, stated honestly: the flop term is a hard bound
+    (nothing beats MXU peak), while ``hbm_bytes`` is the audit's
+    UNFUSED-traffic upper bound — XLA fuses aggressively, so the
+    bandwidth term is a conservative (pessimistic) envelope, not a
+    prediction. The term is reported BESIDE the measured anchors and
+    never mixed into the committed rate model (disarmed by default —
+    round-5 projections reproduce byte-identically)."""
+    if flops_per_round < 0 or hbm_bytes_per_round < 0:
+        raise ValueError("roofline terms must be >= 0")
+    compute_ms = flops_per_round / peak_flops * 1000.0
+    bw_ms = hbm_bytes_per_round / (hbm_gbps * 1e9) * 1000.0
+    return max(compute_ms, bw_ms)
+
+
+def roofline_block(cost_audit: dict, shard_n: int,
+                   build: str = "gossipsub") -> dict:
+    """The roofline summary block from a loaded ``COST_AUDIT.json``
+    dict: the committed per-round fit (``costmodel.eval_fit``)
+    evaluated at the shard peer count, the arithmetic intensity, and
+    the two bound rates (the bound itself via
+    :func:`roofline_ms_per_round` — ONE copy of the formula, and its
+    negative-input guard applies: a pathological fit fails loudly
+    instead of emitting negative rates). Attached to
+    :class:`ScaleProjection` summaries only when the caller ARMS it
+    (``project_at_scale(cost_audit=...)``)."""
+    from ..analysis.costmodel import eval_fit
+
+    rows = cost_audit["builds"][build]["per_round"]
+    flops = eval_fit(rows, "flops", shard_n)
+    hbm = eval_fit(rows, "hbm_bytes", shard_n)
+    compute_ms = roofline_ms_per_round(flops, 0.0)
+    bw_ms = roofline_ms_per_round(0.0, hbm)
+    ms = roofline_ms_per_round(flops, hbm)
+    return {
+        "build": build,
+        "shard_n": int(shard_n),
+        "flops_per_round": round(flops, 1),
+        "hbm_bytes_per_round": round(hbm, 1),
+        "halo_bytes_per_round": round(
+            eval_fit(rows, "halo_bytes", shard_n), 1),
+        "arithmetic_intensity": round(flops / hbm, 6) if hbm else None,
+        # hard ceiling: the compute-peak bound alone
+        "compute_ceiling_rounds_per_sec": (
+            round(1000.0 / compute_ms) if compute_ms > 0 else None),
+        # conservative envelope: the unfused-traffic bandwidth bound
+        "unfused_hbm_ms_per_round": round(bw_ms, 6),
+        "roofline_ms_per_round": round(ms, 6),
+        "roofline_rounds_per_sec": round(1000.0 / ms) if ms > 0 else None,
+    }
+
 
 def permutes_per_round(rounds_per_phase: int,
                        permute_sets_per_phase: int | None = None) -> float:
@@ -256,6 +322,11 @@ class ScaleProjection:
     hbm_bytes: int
     fits_hbm: bool | None           # None when bytes_per_peer is None
     hbm_headroom: float | None      # hbm / shard_state_bytes
+    #: the round-19 statically-priced roofline block
+    #: (:func:`roofline_block`) — None unless the caller armed it with
+    #: ``cost_audit=``, so every committed projection summary
+    #: reproduces byte-identically
+    roofline: dict | None = None
 
     def summary(self) -> dict:
         out = {
@@ -271,6 +342,8 @@ class ScaleProjection:
                 fits_hbm=self.fits_hbm,
                 hbm_headroom=round(float(self.hbm_headroom), 2),
             )
+        if self.roofline is not None:
+            out["roofline"] = dict(self.roofline)
         return out
 
 
@@ -303,6 +376,8 @@ def project_at_scale(n_peers: int, rounds_per_phase: int = 16,
                      audit: dict | None = None,
                      edge_layout: str = "dense",
                      density: float = 1.0,
+                     cost_audit: dict | None = None,
+                     cost_build: str = "gossipsub",
                      ) -> ScaleProjection:
     """Project the v5e-8 rate at an ARBITRARY peer count (the round-15
     ask: the 10k-ticks/s target priced at 1M peers, not just 100k).
@@ -328,6 +403,13 @@ def project_at_scale(n_peers: int, rounds_per_phase: int = 16,
     ``bytes_per_peer`` and the memory term prices the ACTIVE layout —
     on ``edge_layout="csr"`` the CSR-resident tier's bytes/peer DROPS
     with the topology density (:func:`audit_bytes_per_peer`).
+
+    Round 19: pass ``cost_audit=`` (the loaded COST_AUDIT.json dict) to
+    ARM the statically-priced roofline term — the committed per-round
+    flop/byte fit (analysis/costmodel.py) evaluated at THIS shard size,
+    reported beside the measured anchors as ``summary()["roofline"]``
+    (:func:`roofline_block`). Disarmed by default: the committed
+    projections carry no roofline keys and reproduce byte-identically.
 
     Defaults change nothing committed: :func:`project` and
     :func:`project_from_artifacts` are untouched, so every pre-round-15
@@ -359,6 +441,8 @@ def project_at_scale(n_peers: int, rounds_per_phase: int = 16,
         projection=proj, bytes_per_peer=bytes_per_peer,
         shard_state_bytes=shard_bytes, hbm_bytes=int(hbm_bytes),
         fits_hbm=fits, hbm_headroom=headroom,
+        roofline=(roofline_block(cost_audit, shard_n, cost_build)
+                  if cost_audit is not None else None),
     )
 
 

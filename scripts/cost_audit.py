@@ -1,0 +1,126 @@
+"""``make cost-audit`` — the static device-cost gate (docs/DESIGN.md
+§19, analysis/costmodel.py).
+
+Three legs, any failing exits non-zero:
+
+  1. **contracts** — the jaxpr-level cost interpreter walks every
+     engine×layout build (per-round + phase × dense/csr, floodsub,
+     randomsub, lifted, a scanned window) and the hard contracts must
+     hold: csr/dense halo-bytes ratio == power-law topology density AND
+     == the measured ``ops/edges.tally_halo_bytes`` accounting (routed
+     through the guarded ``tally_step`` path — a cached jaxpr raises
+     ``TallyCacheHit`` instead of reading zero); floodsub rng_bits ==
+     0; telemetry-on flop delta under the static share ceiling; the
+     invariant checker's flops under a bounded share of step flops.
+  2. **byte-identical reproduction** — the committed ``COST_AUDIT.json``
+     must equal this run's audit byte for byte (the MEM_AUDIT pattern);
+     a mismatch NAMES the diverging keys. ``COST_UPDATE=1`` rewrites.
+  3. **roofline sanity** — the v5e-8 roofline term built from the
+     audit's gossipsub fit must be finite and DISARMED by default in
+     the projection (committed round-5 projections reproduce
+     byte-identically; tests/test_perf.py pins the numbers).
+
+Pure tracing — no compile, no execution; the metrics are
+PRNG-impl-independent at jaxpr level (the impl rides the key dtype,
+not the primitives). ~15 s warm. Emits one JSON summary line; findings
+to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from go_libp2p_pubsub_tpu.analysis import costmodel as cm
+    from go_libp2p_pubsub_tpu.perf import projection
+
+    failures: list[str] = []
+    try:
+        payload = cm.build_audit()
+    except cm.CostContractViolation as e:
+        print(f"cost-audit FAIL: {e}", file=sys.stderr)
+        print(json.dumps({"cost_audit": "FAIL", "artifact": "contract",
+                          "failures": 1}))
+        return 1
+
+    path = cm.audit_path(REPO)
+    text = cm.dump_audit(payload)
+    update = bool(os.environ.get("COST_UPDATE"))
+    if update:
+        with open(path, "w") as f:
+            f.write(text)
+        action = "updated"
+    elif not os.path.exists(path):
+        failures.append(
+            f"{cm.AUDIT_NAME} missing — run COST_UPDATE=1 "
+            "scripts/cost_audit.py to record it")
+        action = "missing"
+    else:
+        with open(path) as f:
+            committed_text = f.read()
+        if committed_text == text:
+            action = "verified"
+        else:
+            action = "stale"
+            try:
+                diverged = cm.baseline_divergences(
+                    json.loads(committed_text), payload)
+                detail = ("diverging keys: " + "; ".join(diverged)
+                          if diverged else
+                          "artifacts parse equal — formatting-only "
+                          "drift (re-serialize with COST_UPDATE=1)")
+            except json.JSONDecodeError:
+                detail = "committed artifact is not parseable JSON"
+            failures.append(
+                f"{cm.AUDIT_NAME} does not reproduce byte-identical — "
+                f"the device programs moved the cost budget; {detail} "
+                "(review, then COST_UPDATE=1 to re-record)")
+
+    # roofline sanity: the term must price finite numbers from the
+    # committed fit, and stay DISARMED in the default projection
+    gs = payload["builds"]["gossipsub"]["per_round"]
+    shard_n = 12_500
+    ms = projection.roofline_ms_per_round(
+        cm.eval_fit(gs, "flops", shard_n),
+        cm.eval_fit(gs, "hbm_bytes", shard_n))
+    if not (ms > 0 and ms < 1e6):
+        failures.append(
+            f"roofline term priced a nonsense bound ({ms} ms/round at "
+            f"shard N={shard_n})")
+    # project_at_scale is the surface that gained the field — its
+    # default summary must stay roofline-free (project()'s summary is
+    # a fixed literal and cannot regress here)
+    default_summary = projection.project_at_scale(100_000, 16).summary()
+    if any("roofline" in k for k in default_summary):
+        failures.append(
+            "the default project_at_scale summary carries roofline "
+            "keys — the term must stay disarmed so committed "
+            "projections reproduce byte-identically")
+
+    summary = {
+        "cost_audit": "FAIL" if failures else "PASS",
+        "artifact": action,
+        "builds": sorted(payload["builds"]),
+        "contracts": sorted(payload["contracts"]),
+        "roofline_ms_per_round_at_12500": round(ms, 6),
+        "failures": len(failures),
+    }
+    if failures:
+        for f in failures:
+            print(f"cost-audit FAIL: {f}", file=sys.stderr)
+    print(json.dumps(summary))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,10 +28,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def main() -> int:
+def main(repo: str | None = None) -> int:
+    """``repo`` overrides the artifact root (the doctored-artifact
+    negative tests point it at a tmp copy; default: this checkout)."""
     from go_libp2p_pubsub_tpu.analysis import lift
     from go_libp2p_pubsub_tpu.score.params import LIFTED_FIELD_NAMES
 
+    repo = repo or REPO
     failures: list[str] = []
     payload = lift.audit()
 
@@ -46,7 +49,7 @@ def main() -> int:
             f"{sorted(got - want)}; only in plane: {sorted(want - got)}"
         )
 
-    path = lift.audit_path(REPO)
+    path = lift.audit_path(repo)
     text = lift.dump_audit(payload)
     update = bool(os.environ.get("LIFT_UPDATE"))
     if update:
@@ -63,10 +66,27 @@ def main() -> int:
         with open(path) as f:
             committed = f.read()
         if committed != text:
+            # name the diverging keys (round-19 satellite — the shared
+            # walker every byte-identity gate uses); fall back to the
+            # generic message when the committed file is not even JSON
+            try:
+                from go_libp2p_pubsub_tpu.analysis.costmodel import (
+                    baseline_divergences,
+                )
+
+                diverged = baseline_divergences(
+                    json.loads(committed), json.loads(text))
+                detail = (" — diverging keys: " + "; ".join(diverged)
+                          if diverged else
+                          " — artifacts parse equal: formatting-only "
+                          "drift (re-serialize with LIFT_UPDATE=1)")
+            except (json.JSONDecodeError, ValueError):
+                detail = " — committed artifact is not parseable JSON"
             failures.append(
                 f"{lift.AUDIT_NAME} does not reproduce byte-identical — "
                 "the device-scope sources changed the classification; "
                 "review the verdict diff and LIFT_UPDATE=1 to re-record"
+                + detail
             )
         action = "verified" if committed == text else "stale"
 

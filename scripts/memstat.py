@@ -273,6 +273,23 @@ def bytes_per_peer_for(audit: dict, engine: str = "gossipsub",
     return audit_bytes_per_peer(audit, engine, edge_layout, density)
 
 
+def check_committed(committed: dict, fresh: dict) -> list[str]:
+    """The byte-identity gate on explicit inputs (the negative-test
+    surface): a reproduction failure NAMES the diverging keys (round-19
+    satellite — shared walker: analysis/costmodel.py)."""
+    if committed == fresh:
+        return []
+    from go_libp2p_pubsub_tpu.analysis.costmodel import baseline_divergences
+
+    diverged = baseline_divergences(committed, fresh)
+    return [
+        "live state trees no longer match the committed MEM_AUDIT.json "
+        "(a state-plane change moved the byte budget; "
+        "MEM_AUDIT_UPDATE=1 rewrites after review) — diverging keys: "
+        + "; ".join(diverged)
+    ]
+
+
 def main() -> int:
     import jax
 
@@ -287,11 +304,10 @@ def main() -> int:
     else:
         with open(AUDIT_PATH) as f:
             committed = json.load(f)
-        if committed != audit:
-            print("mem-audit: FAIL — live state trees no longer match "
-                  "the committed MEM_AUDIT.json (a state-plane change "
-                  "moved the byte budget; MEM_AUDIT_UPDATE=1 rewrites "
-                  "after review)")
+        failures = check_committed(committed, audit)
+        if failures:
+            for msg in failures:
+                print(f"mem-audit: FAIL — {msg}")
             return 1
         print("mem-audit: OK — committed baseline reproduces")
 

@@ -59,19 +59,6 @@ LOSS = 0.1
 RATE_MARGIN = 0.5
 
 
-def _bytes_per_round(step_fn, state, args) -> int:
-    """Audited bytes moved by one traced step: the halo seams' moved
-    tensors, exact from static shapes (ops/edges.tally_halo_bytes;
-    edges.tally_step owns the unjitted-body caveat)."""
-    from go_libp2p_pubsub_tpu.ops import edges
-
-    out = edges.tally_step(step_fn, state, args, count_bytes=True)
-    assert out, "halo-bytes tally is empty — engine moved nothing?"
-    missing = [k for k, b in out if b is None]
-    assert not missing, f"halo seams without byte accounting: {missing}"
-    return sum(b for _, b in out)
-
-
 def run_cell(layout: str, net, el):
     """One layout's S-sim scanned window: returns (rate, per-sim event
     counters, bytes/round, compile-count sentinel)."""
@@ -120,22 +107,36 @@ def run_cell(layout: str, net, el):
         n_compiles = -1  # sentinel: UNKNOWN, skips the gate visibly
     events = np.asarray(st2.events)      # [S, N_EVENTS]
 
-    # audited bytes: trace the UNJITTED step body (a jitted call under
-    # eval_shape can hit the jaxpr cache and tally nothing)
+    # audited bytes + the round-19 static price, from ONE trace of the
+    # UNJITTED step body (a jitted call under tracing can hit the
+    # jaxpr cache and tally nothing — edges.TallyCacheHit owns that):
+    # costmodel.cost_of arms the same ops/edges byte-tally seams the
+    # old tally_step leg measured, so halo_bytes IS the audited
+    # bytes-moved number, and flops/hbm ride along for the
+    # fingerprint["cost"] block. The independent model-vs-tally
+    # cross-check lives in `make cost-audit`'s halo-measured contract.
+    from go_libp2p_pubsub_tpu.analysis import costmodel
+
     def raw_step(st, po_r, pt_r, pv_r):
         return floodsub_step.__wrapped__(net, st, po_r, pt_r, pv_r,
                                          chaos=chaos)
 
-    bpr = _bytes_per_round(
-        raw_step, SimState.init(N, MSG_SLOTS, k=net.max_degree,
-                                n_edges=net.n_edges),
-        (jnp.asarray(po[0]), jnp.asarray(pt[0]), jnp.asarray(pv[0])))
+    args1 = (jnp.asarray(po[0]), jnp.asarray(pt[0]), jnp.asarray(pv[0]))
+    cost = costmodel.cost_of(
+        lambda s: raw_step(s, *args1),
+        SimState.init(N, MSG_SLOTS, k=net.max_degree,
+                      n_edges=net.n_edges))
+    bpr = cost["halo_bytes"]
+    assert bpr > 0, "halo-bytes tally is empty — engine moved nothing?"
     return {
         "layout": layout,
         "rounds_per_sec": round(ROUNDS / warm_s, 3),
         "warm_s": round(warm_s, 4),
         "events_per_sim": events,
         "bytes_per_round": int(bpr),
+        "cost_per_round": {k: cost[k] for k in
+                           ("flops", "hbm_bytes", "halo_bytes",
+                            "rng_bits")},
         "n_compiles": int(n_compiles),
     }
 
@@ -188,6 +189,7 @@ def bench_records(res: dict) -> dict:
     from go_libp2p_pubsub_tpu.perf.artifacts import (
         NORTH_STAR_RATE,
         chaos_fingerprint,
+        cost_fingerprint,
         ensemble_fingerprint,
         topology_fingerprint,
     )
@@ -237,6 +239,15 @@ def bench_records(res: dict) -> dict:
                 "ensemble": ensemble_fingerprint(n_sims=SIMS),
                 "topology": topo_block,
                 "bytes_per_round_audited": cell["bytes_per_round"],
+                # the round-19 static price (legacy lines read back
+                # perf.artifacts.COST_UNAUDITED via BenchRecord.cost)
+                "cost": cost_fingerprint(
+                    build=f"floodsub_{cell['layout']}",
+                    flops_per_round=cell["cost_per_round"]["flops"],
+                    hbm_bytes_per_round=cell["cost_per_round"]["hbm_bytes"],
+                    halo_bytes_per_round=cell["cost_per_round"]["halo_bytes"],
+                    rng_bits_per_round=cell["cost_per_round"]["rng_bits"],
+                ),
                 "platform": jax.default_backend(),
             },
         }

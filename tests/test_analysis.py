@@ -540,8 +540,128 @@ def test_allowlist_parse_rejects_garbage(tmp_path):
 
 
 def test_repo_lints_clean():
-    """The enforced state: zero unallowed violations on the package."""
+    """The enforced state: zero unallowed violations on the package
+    (and, since round 19, the tests/ + scripts/ call-site trees under
+    the donated-reuse rule — simlint.run covers both)."""
     kept, _allowed = simlint.run(PKG)
+    assert kept == [], "\n".join(v.format() for v in kept)
+
+
+# ---------------------------------------------------------------------------
+# donated-reuse: the call-site rule (round 19) — seeded negatives
+
+
+def dlint(src, rel="tests/test_broken.py"):
+    return simlint.lint_donated_reuse(textwrap.dedent(src), rel)
+
+
+def test_donated_reuse_fires_on_reuse_after_step():
+    vs = dlint("""
+        def t(step, fresh):
+            st = fresh()
+            out = step(st, po)
+            return st.events
+    """)
+    assert rules_of(vs) == {"donated-reuse"}
+    assert "DONATED" in vs[0].msg
+
+
+def test_donated_reuse_fires_on_window_call():
+    vs = dlint("""
+        def t(window, fresh, xs):
+            states = fresh()
+            out, ys = window(states, xs)
+            return states
+    """)
+    assert rules_of(vs) == {"donated-reuse"}
+
+
+def test_donated_reuse_fires_on_module_level_engine_step():
+    vs = dlint("""
+        def t(net, st):
+            out = floodsub_step(net, st, po, pt, pv)
+            return st.events
+    """)
+    assert rules_of(vs) == {"donated-reuse"}
+
+
+def test_donated_reuse_fires_on_loop_backedge():
+    # the canonical loop form of the footgun: donation inside a loop,
+    # state never rebound — iteration 2 reads the donated buffers
+    vs = dlint("""
+        def t(step, fresh):
+            st = fresh()
+            for i in range(4):
+                out = step(st, po)
+            return out
+    """)
+    assert rules_of(vs) == {"donated-reuse"}
+
+
+def test_donated_reuse_fresh_build_inside_loop_ok():
+    vs = dlint("""
+        def t(step, fresh):
+            for i in range(4):
+                st = fresh()
+                out = step(st, po)
+            return out
+    """)
+    assert vs == []
+
+
+def test_donated_reuse_multiline_call_ok():
+    # a donating call wrapped across lines must not read its own
+    # argument as after-donation reuse
+    vs = dlint("""
+        def t(step, fresh):
+            st = fresh()
+            out = step(
+                st, po)
+            return out
+    """)
+    assert vs == []
+
+
+def test_donated_reuse_rebind_idiom_ok():
+    vs = dlint("""
+        def t(step, fresh):
+            st = fresh()
+            for i in range(4):
+                st = step(st, po)
+            return st.events
+    """)
+    assert vs == []
+
+
+def test_donated_reuse_fresh_rebind_after_donation_ok():
+    vs = dlint("""
+        def t(step, fresh):
+            st = fresh()
+            out = step(st, po)
+            st = fresh()
+            return st.events
+    """)
+    assert vs == []
+
+
+def test_donated_reuse_make_and_observer_calls_exempt():
+    # make_* builds a step (never donates); hook.on_step observes the
+    # LIVE state (never donates) — both must stay clean
+    vs = dlint("""
+        def t(cfg, net, fresh, hook):
+            st = fresh()
+            step = make_gossipsub_step(cfg, net)
+            st = step(st, po)
+            hook.on_step(0, st)
+            return st.events
+    """)
+    assert vs == []
+
+
+def test_donated_reuse_callsite_trees_clean():
+    """tests/ and scripts/ follow the donation discipline — the rule
+    holds repo-wide with the ALLOWLIST still empty."""
+    kept = simlint.lint_callsites(ROOT)
     assert kept == [], "\n".join(v.format() for v in kept)
 
 

@@ -1,0 +1,419 @@
+"""Static device-cost auditor tests (docs/DESIGN.md §19,
+analysis/costmodel.py): the jaxpr interpreter's accounting rules on
+tiny known programs, every hard contract TRIPPED by a doctored jaxpr
+(negative), the TallyCacheHit footgun fix, the byte-identity gates'
+named-divergence satellite, and the roofline term's disarmed-by-default
+contract."""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from go_libp2p_pubsub_tpu.analysis import costmodel as cm
+from go_libp2p_pubsub_tpu.ops import edges
+from go_libp2p_pubsub_tpu.perf import artifacts, projection
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# interpreter accounting rules on known programs
+
+
+def _cost(fn, *args):
+    return cm.cost_closed(jax.make_jaxpr(fn)(*args))
+
+
+def test_dot_general_flops():
+    a = jnp.ones((8, 16), jnp.float32)
+    b = jnp.ones((16, 4), jnp.float32)
+    c = _cost(lambda x: x @ b, a)
+    # 2 * out.size * K = 2 * (8*4) * 16
+    assert c["flops"] == 2 * 8 * 4 * 16
+
+
+def test_elementwise_and_reduce_flops():
+    x = jnp.ones((32,), jnp.float32)
+    c = _cost(lambda v: jnp.sum(v * v), x)
+    # one mul (32) + one reduce_sum charging its input (32)
+    assert c["flops"] == 64
+
+
+def test_scan_multiplies_body():
+    x = jnp.ones((4,), jnp.float32)
+
+    def f(v):
+        def body(carry, _):
+            return carry * v, None
+
+        out, _ = jax.lax.scan(body, v, None, length=10)
+        return out
+
+    once = _cost(lambda v: v * v, x)["flops"]
+    scanned = _cost(f, x)["flops"]
+    assert scanned == 10 * once
+
+
+def test_gather_scatter_bytes():
+    x = jnp.arange(64, dtype=jnp.int32)
+    idx = jnp.array([3, 5], jnp.int32)
+    c = _cost(lambda v: v[idx], x)
+    assert c["gather_bytes"] == 2 * 4
+    c2 = _cost(lambda v: v.at[idx].add(1), x)
+    assert c2["scatter_bytes"] == 2 * 4
+
+
+def test_rng_bits_counted_and_key_ops_free():
+    key = jax.random.key(0)
+    c = _cost(lambda k: jax.random.bits(
+        jax.random.fold_in(k, 1), (16,), jnp.uint32), key)
+    assert c["rng_bits"] == 16 * 32
+
+
+def test_shape_ops_are_flop_free():
+    x = jnp.ones((8, 8), jnp.float32)
+    c = _cost(lambda v: jnp.broadcast_to(v.reshape(64)[None], (2, 64)), x)
+    assert c["flops"] == 0
+    assert c["hbm_bytes"] > 0  # traffic still priced (unfused bound)
+
+
+def test_cond_charges_max_branch():
+    x = jnp.ones((16,), jnp.float32)
+
+    def f(v):
+        return jax.lax.cond(v[0] > 0,
+                            lambda u: u * u * u,  # 2 muls
+                            lambda u: u * 2.0,    # 1 mul
+                            v)
+
+    c = _cost(f, x)
+    assert c["flops"] >= 32  # the expensive branch (2 * 16)
+
+
+# ---------------------------------------------------------------------------
+# halo accounting + the TallyCacheHit footgun (round-19 satellite)
+
+
+def _seam_fn(x):
+    # one real ops/edges seam: a [N, K] edge involution
+    n, k = x.shape
+    perm = jnp.arange(n * k, dtype=jnp.int32).reshape(n, k)
+    return edges.edge_permute(x, perm)
+
+
+def test_cost_of_arms_the_byte_tally():
+    x = jnp.ones((8, 4), jnp.uint32)
+    c = cm.cost_of(lambda v: _seam_fn(v), x)
+    assert c["halo_bytes"] == 8 * 4 * 4
+
+
+def test_tally_step_ok_on_raw_body():
+    x = jnp.ones((8, 4), jnp.uint32)
+    out = edges.tally_step(_seam_fn, x, count_bytes=True)
+    assert sum(b for _, b in out) == 8 * 4 * 4
+
+
+def test_cost_of_raises_on_empty_halo_tally():
+    """cost_of must never record a silent zero halo fit: a cached
+    inner jaxpr (or a seam-free program costed with with_halo=True)
+    raises the same typed TallyCacheHit the tally_step path uses."""
+    inner = jax.jit(_seam_fn)
+    x = jnp.ones((8, 4), jnp.uint32)
+    inner.lower(x)
+
+    def outer(v):
+        return inner(v)
+
+    with pytest.raises(edges.TallyCacheHit):
+        cm.cost_of(outer, x)
+    # seam-free programs are fine when halo is explicitly not asked for
+    c = cm.cost_of(lambda v: v + jnp.uint32(1), x, with_halo=False)
+    assert c["halo_bytes"] == 0
+
+
+def test_tally_cache_hit_raises_typed_error():
+    """The CHANGES-r16 footgun as a regression test: a jit hidden
+    INSIDE a plain wrapper satisfies eval_shape from its cached jaxpr,
+    so the seams never re-run — that must raise TallyCacheHit, never
+    return a silent zero."""
+    inner = jax.jit(_seam_fn)
+    x = jnp.ones((8, 4), jnp.uint32)
+    inner.lower(x)  # populate the tracing cache
+
+    def outer(v):  # no __wrapped__ to unwrap through
+        return inner(v)
+
+    with pytest.raises(edges.TallyCacheHit):
+        edges.tally_step(outer, x, count_bytes=True)
+    # the gather tally path raises too
+    with pytest.raises(edges.TallyCacheHit):
+        edges.tally_step(outer, x)
+
+
+# ---------------------------------------------------------------------------
+# contracts: each tripped by a doctored jaxpr
+
+
+def test_floodsub_rng_contract_trips_on_doctored_jaxpr():
+    key = jax.random.key(3)
+    doctored = _cost(lambda k: jax.random.bits(k, (8,), jnp.uint32), key)
+    with pytest.raises(cm.CostContractViolation) as e:
+        cm.check_floodsub_rng("floodsub", doctored)
+    assert e.value.contract == "floodsub-rng"
+
+
+def test_halo_density_contract_trips_on_doctored_ratio():
+    # doctored pair: the "csr" program moves MORE than density*dense
+    dense = jnp.ones((16, 4), jnp.uint32)
+    csr = jnp.ones((16, 3), jnp.uint32)  # 48 edges of 64 -> ratio 0.75
+    cd = cm.cost_of(lambda v: _seam_fn(v), dense)
+    cc = cm.cost_of(lambda v: _seam_fn(v), csr)
+    with pytest.raises(cm.CostContractViolation) as e:
+        cm.check_halo_density(cd["halo_bytes"], cc["halo_bytes"],
+                              density=0.5)
+    assert e.value.contract == "halo-density"
+    # and the exact ratio passes
+    assert cm.check_halo_density(
+        cd["halo_bytes"], cc["halo_bytes"], density=0.75) == 0.75
+
+
+def test_halo_measured_contract_trips_on_mismatch():
+    x = jnp.ones((8, 4), jnp.uint32)
+    model = cm.cost_of(lambda v: _seam_fn(v), x)["halo_bytes"]
+    measured = sum(b for _, b in edges.tally_step(
+        _seam_fn, x, count_bytes=True))
+    cm.check_halo_measured("seam", model, measured)  # agrees
+    with pytest.raises(cm.CostContractViolation) as e:
+        cm.check_halo_measured("seam", model, measured + 4)
+    assert e.value.contract == "halo-measured"
+
+
+def test_telemetry_flop_ceiling_trips_on_doctored_pair():
+    x = jnp.ones((64,), jnp.float32)
+    off = _cost(lambda v: v * v, x)["flops"]
+    on = _cost(lambda v: jnp.tanh(v * v) * v + v, x)["flops"]
+    assert on > off * (1 + cm.TELEMETRY_FLOP_SHARE_CEILING)
+    with pytest.raises(cm.CostContractViolation) as e:
+        cm.check_telemetry_flops(off, on)
+    assert e.value.contract == "telemetry-flops"
+    cm.check_telemetry_flops(off, off)  # zero delta passes
+
+
+def test_oracle_flop_ceiling_trips_on_doctored_pair():
+    x = jnp.ones((64,), jnp.float32)
+    step = _cost(lambda v: v + 1.0, x)["flops"]
+    checker = _cost(lambda v: jnp.sum(v * v) + jnp.sum(v), x)["flops"]
+    assert checker > step * cm.ORACLE_FLOP_SHARE_CEILING
+    with pytest.raises(cm.CostContractViolation) as e:
+        cm.check_oracle_flops(step, checker)
+    assert e.value.contract == "oracle-flops"
+
+
+def test_floodsub_cell_draws_no_randomness():
+    """The live contract on the real build (small shape — trace only):
+    floodsub prices zero rng bits; randomsub prices some."""
+    flood = cm.per_round_cost(cm.build_cell("floodsub", cm.N_LO))
+    cm.check_floodsub_rng("floodsub", flood)
+    rnd = cm.per_round_cost(cm.build_cell("randomsub", cm.N_LO))
+    assert rnd["rng_bits"] > 0
+    assert flood["halo_bytes"] > 0
+
+
+def test_committed_audit_contract_blocks_all_pass():
+    """The committed COST_AUDIT.json carries pass=True on every
+    contract row (the gate refuses to write otherwise) and prices
+    every registry build."""
+    with open(os.path.join(ROOT, cm.AUDIT_NAME)) as f:
+        audit = json.load(f)
+    assert set(audit["builds"]) == set(cm.AUDIT_BUILDS)
+    assert audit["contracts"], "no contract rows committed"
+    for name, row in audit["contracts"].items():
+        assert row["pass"] is True, name
+    # the halo-density row commits ratio == density exactly
+    hd = audit["contracts"]["halo_density"]
+    assert hd["ratio"] == hd["density"]
+    # every build prices positive per-round flops and hbm traffic
+    for name, b in audit["builds"].items():
+        assert b["per_round"]["flops"]["at_hi"] > 0, name
+        assert b["per_round"]["hbm_bytes"]["at_hi"] > 0, name
+    # floodsub's committed rng row is zero at both fit points
+    fs = audit["builds"]["floodsub"]["per_round"]["rng_bits"]
+    assert fs["at_lo"] == 0 and fs["at_hi"] == 0
+
+
+# ---------------------------------------------------------------------------
+# byte-identity gates name their divergence (round-19 satellite)
+
+
+def test_baseline_divergences_names_the_key():
+    a = {"x": {"y": [1, 2], "z": 3}, "w": "s"}
+    b = {"x": {"y": [1, 5], "z": 3}, "w": "s"}
+    d = cm.baseline_divergences(a, b)
+    assert d == ["x.y[1]: 2 != 5"]
+    assert cm.baseline_divergences(a, a) == []
+    d2 = cm.baseline_divergences({"k": 1}, {})
+    assert "missing from this run" in d2[0]
+
+
+def test_doctored_mem_audit_row_fails_naming_key(capsys, monkeypatch,
+                                                 tmp_path):
+    """A doctored MEM_AUDIT.json row must fail `make mem-audit` with an
+    error NAMING the diverging key."""
+    memstat = _load_script("memstat")
+    with open(os.path.join(ROOT, "MEM_AUDIT.json")) as f:
+        doctored = json.load(f)
+    doctored["engines"]["gossipsub"]["totals"]["bytes_per_peer"] += 1.0
+    p = tmp_path / "MEM_AUDIT.json"
+    p.write_text(json.dumps(doctored))
+    monkeypatch.setattr(memstat, "AUDIT_PATH", str(p))
+    rc = memstat.main()
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL" in out
+    assert "engines.gossipsub.totals.bytes_per_peer" in out
+
+
+def test_doctored_lift_audit_verdict_fails_naming_key(capsys, tmp_path):
+    """A doctored LIFT_AUDIT.json verdict must fail `make lift-audit`
+    with an error NAMING the diverging key."""
+    lift_audit = _load_script("lift_audit")
+    with open(os.path.join(ROOT, "LIFT_AUDIT.json")) as f:
+        committed = json.load(f)
+    field = sorted(committed["fields"])[0]
+    committed["fields"][field]["verdict"] = "DOCTORED"
+    (tmp_path / "LIFT_AUDIT.json").write_text(
+        json.dumps(committed, indent=1, sort_keys=True) + "\n")
+    rc = lift_audit.main(repo=str(tmp_path))
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert f"fields.{field}.verdict" in err
+    assert "DOCTORED" in err
+
+
+def test_doctored_cost_audit_fails_naming_key():
+    """The cost gate's divergence walker over a doctored committed
+    audit names the exact fit row that moved."""
+    with open(os.path.join(ROOT, cm.AUDIT_NAME)) as f:
+        committed = json.load(f)
+    doctored = json.loads(json.dumps(committed))
+    doctored["builds"]["floodsub"]["per_round"]["flops"]["slope"] += 1.0
+    d = cm.baseline_divergences(doctored, committed)
+    assert any("builds.floodsub.per_round.flops.slope" in x for x in d)
+
+
+# ---------------------------------------------------------------------------
+# roofline term: disarmed by default, armed via the committed audit
+
+
+def test_projection_default_summary_has_no_roofline_keys():
+    s = projection.project(0.425, 16).summary()
+    assert not any("roofline" in k for k in s)
+    sp = projection.project_at_scale(100_000, 16).summary()
+    assert "roofline" not in sp
+
+
+def test_roofline_block_from_committed_audit():
+    with open(os.path.join(ROOT, cm.AUDIT_NAME)) as f:
+        audit = json.load(f)
+    blk = projection.roofline_block(audit, 12_500)
+    assert blk["build"] == "gossipsub"
+    assert blk["roofline_ms_per_round"] > 0
+    assert blk["compute_ceiling_rounds_per_sec"] > 0
+    # the bandwidth envelope dominates (intensity << 1 flop/byte)
+    assert blk["arithmetic_intensity"] < 1.0
+    assert blk["roofline_ms_per_round"] == blk["unfused_hbm_ms_per_round"]
+    sp = projection.project_at_scale(100_000, 16, cost_audit=audit)
+    assert sp.summary()["roofline"]["shard_n"] == 12_500
+
+
+def test_eval_fit_reads_committed_rows():
+    with open(os.path.join(ROOT, cm.AUDIT_NAME)) as f:
+        audit = json.load(f)
+    rows = audit["builds"]["gossipsub"]["per_round"]
+    at_lo = cm.eval_fit(rows, "flops", cm.N_LO)
+    assert at_lo == pytest.approx(rows["flops"]["at_lo"])
+
+
+def test_roofline_ms_per_round_max_of_terms():
+    # compute-bound case
+    ms = projection.roofline_ms_per_round(
+        1e12, 1.0, peak_flops=1e12, hbm_gbps=1000.0)
+    assert ms == pytest.approx(1000.0)
+    # bandwidth-bound case
+    ms = projection.roofline_ms_per_round(
+        1.0, 819e9, peak_flops=1e20, hbm_gbps=819.0)
+    assert ms == pytest.approx(1000.0)
+    with pytest.raises(ValueError):
+        projection.roofline_ms_per_round(-1.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# the fingerprint["cost"] block (schema v3) + legacy sentinel
+
+
+def test_cost_fingerprint_roundtrip_and_legacy_sentinel():
+    blk = artifacts.cost_fingerprint(
+        build="floodsub_csr", flops_per_round=1000.0,
+        hbm_bytes_per_round=8000.0, halo_bytes_per_round=512.0,
+        rng_bits_per_round=0.0)
+    line = {"schema": 3, "metric": "m", "value": 1.0, "unit": "u",
+            "vs_baseline": 0.0, "fingerprint": {"cost": blk}}
+    rec = artifacts.record_from_line(json.loads(json.dumps(line)))
+    assert rec.cost_audited
+    assert rec.cost["build"] == "floodsub_csr"
+    assert rec.cost["arithmetic_intensity"] == pytest.approx(0.125)
+    # round-trips through the line emitter
+    rec2 = artifacts.record_from_line(rec.to_line())
+    assert rec2.cost == rec.cost
+    # legacy: no block -> the explicit COST_UNAUDITED sentinel
+    legacy = artifacts.record_from_line(
+        {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 0.0})
+    assert not legacy.cost_audited
+    assert legacy.cost == artifacts.COST_UNAUDITED
+    # the committed BENCH_r07 pair predates the block -> sentinel
+    variants = artifacts.load_bench_variants(
+        os.path.join(ROOT, "BENCH_r07.json"))
+    assert not variants["parsed"].cost_audited
+
+
+# ---------------------------------------------------------------------------
+# the `make static` umbrella (subprocess — slow tier)
+
+
+@pytest.mark.slow
+def test_analyze_json_umbrella_verdict_block():
+    import subprocess
+    import sys
+
+    # a CLEAN environment: the conftest's 8-virtual-device XLA_FLAGS
+    # would shard the guard builds and trip their transfer guard —
+    # `make static` is defined on the plain 1-device CPU config
+    env = {k: v for k, v in os.environ.items()
+           if k != "XLA_FLAGS" and not k.startswith("JAX_")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "analyze.py"),
+         "--json"],
+        capture_output=True, text=True, cwd=ROOT, timeout=570, env=env)
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")][-1]
+    block = json.loads(line)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert block["static"] == "PASS"
+    assert set(block["passes"]) == {"simlint", "guards", "lift", "hlo",
+                                    "cost"}
+    for name, p in block["passes"].items():
+        assert p["status"] == "PASS", name
+        assert "artifacts" in p
+    assert block["passes"]["cost"]["artifacts"] == ["COST_AUDIT.json"]
