@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static tune-smoke tune-check
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -199,9 +199,30 @@ hlo-audit:
 cost-audit:
 	python scripts/cost_audit.py
 
+# ensemble parameter-search gate (scripts/tune_report.py; docs/
+# DESIGN.md §20): a 2-generation, 8-candidate x 4-sim micro-search on
+# the sybil-flood cell — one compile in generation 1 and ZERO warm
+# recompiles (a new candidate population re-dispatches the same
+# window), one dispatch per generation, defaults pinned as candidate
+# 0, every candidate row cost-priced, and the tight-envelope negative
+# check disqualifying a wide-mesh candidate through the folded
+# invariant gate; the committed TUNE_SMOKE.json must reproduce
+# byte-identical (TUNE_SMOKE_UPDATE=1 rewrites). ~60 s warm on CPU.
+tune-smoke:
+	python scripts/tune_report.py --smoke
+
+# search-space legality proof (scripts/tune_check.py; the `make
+# analyze --json` tune leg): every tune/space.py box corner + a seeded
+# uniform sweep materializes through the real config.py validators,
+# and the defaults-as-candidate-0 encode/decode round-trip holds.
+# Pure host-side config arithmetic, <1 s.
+tune-check:
+	python scripts/tune_check.py
+
 # the whole static suite as ONE verdict (round 19): simlint + guards +
-# lift-audit + hlo-audit + cost-audit, one machine-readable JSON block
-# (per-pass pass/fail + artifact paths), one exit code.
+# lift-audit + hlo-audit + cost-audit + tune-check, one
+# machine-readable JSON block (per-pass pass/fail + artifact paths),
+# one exit code.
 static:
 	python scripts/analyze.py --json
 
@@ -252,6 +273,8 @@ quick:
 	python scripts/lift_audit.py
 	python scripts/hlo_audit.py
 	python scripts/cost_audit.py
+	python scripts/tune_check.py
+	python scripts/tune_report.py --smoke
 	python scripts/memstat.py
 	python scripts/scale_smoke.py
 	python scripts/topo_smoke.py

@@ -75,6 +75,11 @@ PARAM_ROOTS = {
     # ScoreParams plane on the lifted one; either way the read is a
     # GossipSubConfig-namespace threshold use
     "thr": "GossipSubConfig",
+    # the mesh-degree-source convention (round 20): handlers read the
+    # degree knobs through ``msh`` — cfg on the static path, the traced
+    # MeshParams plane on the candidate-lifted one; either way the read
+    # is a GossipSubConfig-namespace degree use
+    "msh": "GossipSubConfig",
     "window_rounds_t":
         "FIELD:TopicScoreParams.mesh_message_deliveries_window",
 }
@@ -187,6 +192,21 @@ SCORE_PLANE_FIELDS = (
     "TopicScoreParams.time_in_mesh_quantum",
     "TopicScoreParams.time_in_mesh_weight",
     "TopicScoreParams.topic_weight",
+)
+
+#: fields lifted into the traced MeshParams plane (round 20): the mesh
+#: degree knobs, liftable once every selection width rides the
+#: masked-width kernels (ops/select.masked_width_* — rank the full
+#: padded axis, clip the traced width). Cross-checked against
+#: score.params.MESH_LIFTED_FIELD_NAMES by scripts/lift_audit.py.
+MESH_PLANE_FIELDS = (
+    "GossipSubConfig.D",
+    "GossipSubConfig.Dhi",
+    "GossipSubConfig.Dlazy",
+    "GossipSubConfig.Dlo",
+    "GossipSubConfig.Dout",
+    "GossipSubConfig.Dscore",
+    "GossipSubConfig.gossip_factor",
 )
 
 #: fields DECLARED shape regardless of site classification, with the
@@ -707,7 +727,8 @@ def field_verdicts(sites: list) -> dict:
         else:
             verdict = "VALUE"
         entry = {"verdict": verdict, "sites": rows,
-                 "lifted": field in SCORE_PLANE_FIELDS}
+                 "lifted": (field in SCORE_PLANE_FIELDS
+                            or field in MESH_PLANE_FIELDS)}
         if field in DECLARED_SHAPE:
             entry["declared_shape"] = DECLARED_SHAPE[field]
         out[field] = entry
@@ -720,7 +741,7 @@ def check_plane(verdicts: dict) -> list:
     every DECLARED_SHAPE field must be outside the plane. Returns
     failure strings (empty = the lift is proven)."""
     failures = []
-    for field in SCORE_PLANE_FIELDS:
+    for field in SCORE_PLANE_FIELDS + MESH_PLANE_FIELDS:
         v = verdicts.get(field)
         if v is None:
             failures.append(
@@ -736,7 +757,7 @@ def check_plane(verdicts: dict) -> list:
                 + "; ".join(f"{r['file']}:{r['line']} ({r['context']})"
                             for r in bad[:3]))
     for field in DECLARED_SHAPE:
-        if field in SCORE_PLANE_FIELDS:
+        if field in SCORE_PLANE_FIELDS + MESH_PLANE_FIELDS:
             failures.append(
                 f"{field} is declared SHAPE but listed in the lifted "
                 "plane — contradiction")
@@ -766,6 +787,7 @@ def audit(pkg_root: str | None = None) -> dict:
         "summary": {"fields": len(verdicts), "sites": len(sites),
                     **counts},
         "lifted_plane": sorted(SCORE_PLANE_FIELDS),
+        "mesh_plane": sorted(MESH_PLANE_FIELDS),
         "fields": verdicts,
     }
 

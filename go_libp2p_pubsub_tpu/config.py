@@ -113,12 +113,21 @@ class GossipSubParams:
 
     def validate(self) -> None:
         if self.D < 0 or self.Dlo < 0 or self.Dhi < self.Dlo or self.D < self.Dlo or self.D > self.Dhi:
-            raise ConfigError("invalid degree params; need Dlo <= D <= Dhi")
+            raise ConfigError(
+                "invalid degree params; need 0 <= Dlo <= D <= Dhi, got "
+                f"Dlo={self.Dlo} D={self.D} Dhi={self.Dhi}"
+            )
         if self.Dscore < 0 or self.Dscore > self.D:
-            raise ConfigError("invalid Dscore; must be within [0, D]")
+            raise ConfigError(
+                "invalid Dscore; must be within [0, D], got "
+                f"Dscore={self.Dscore} D={self.D}"
+            )
         # Dout must be set below Dlo and must not exceed D/2 (gossipsub.go:89)
         if self.Dout >= self.Dlo or self.Dout > self.D // 2:
-            raise ConfigError("invalid Dout; must be < Dlo and <= D/2")
+            raise ConfigError(
+                "invalid Dout; must be < Dlo and <= D/2, got "
+                f"Dout={self.Dout} Dlo={self.Dlo} D={self.D}"
+            )
         # gossip slots cannot exceed history slots (mcache.go:23-28)
         if self.history_gossip > self.history_length:
             raise ConfigError("invalid mcache params; history_gossip must be <= history_length")

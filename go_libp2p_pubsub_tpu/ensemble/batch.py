@@ -92,9 +92,13 @@ def stack_planes(planes):
     step's trailing argument through :func:`lift_step` and ONE vmapped
     program runs S *different parameterizations* (one compile, per
     the recompile-free lift contract; tests/test_score_lift.py pins
-    row i == the single-sim run with plane i). Static aux fields
-    (``app_specific_weight``) must agree across the planes — they are
-    trace constants, not sweepable values."""
+    row i == the single-sim run with plane i). Works on bare
+    ``ScoreParams`` and on the round-20 combined candidate plane
+    (``score.params.CandidateParams`` — score + traced MeshParams
+    stacked together, the tune/ generation input). Static aux fields
+    (``app_specific_weight``; surfaced from the nested score plane by
+    the combined form) must agree across the planes — they are trace
+    constants, not sweepable values."""
     first = planes[0]
     for p in planes[1:]:
         if getattr(p, "app_specific_weight", None) != getattr(

@@ -214,7 +214,7 @@ class WindowRunner:
         except Exception:  # pragma: no cover — newer-jax fallback
             return None
 
-    def dispatch(self, states, xs, due=None):
+    def dispatch(self, states, xs, due=None, consts=()):
         """One window invocation, ASYNC (no blocking, no timing) — the
         supervised service loop's seam (serve/supervisor.py): dispatch
         segment k, assemble segment k+1's ``xs`` host-side while the
@@ -222,12 +222,16 @@ class WindowRunner:
         :meth:`stack_args` tuple sized to this runner's window; ``due``
         the segment's stacked due rows when invariants are folded
         (defaults to this runner's own precompute — segment-LOCAL
-        ticks; schedule-aware callers pass their global rows)."""
+        ticks; schedule-aware callers pass their global rows).
+        ``consts`` are window-invariant TRACED trailing args appended
+        to every step call (driver.make_window's contract) — the tune/
+        generation passes the stacked candidate plane here, so a new
+        candidate population re-dispatches the SAME compiled window."""
         if self.invariants is None:
-            return self.window(states, xs)
+            return self.window(states, xs, None, tuple(consts))
         if due is None:
             due = self.invariants.due_rows(self.segment_len)
-        return self.window(states, xs, due)
+        return self.window(states, xs, due, tuple(consts))
 
     def stack_args(self, make_args, lo: int, hi: int) -> tuple:
         """Stack per-dispatch arg tuples ``make_args(i)`` for
@@ -241,12 +245,15 @@ class WindowRunner:
         return tuple(jnp.stack([r[k] for r in rows])
                      for k in range(width.pop()))
 
-    def run(self, states, make_args, *, on_segment=None) -> EnsembleRun:
+    def run(self, states, make_args, *, on_segment=None,
+            consts=()) -> EnsembleRun:
         """Execute the window: ONE dispatch per segment. ``make_args``
         is the run_rounds contract (per-dispatch arg tuples, leading S
         axis per array for lifted steps). ``on_segment(seg_idx,
         states)`` fires between segments — the checkpoint hook
-        (checkpoint_every == segment_len, docs/DESIGN.md §14)."""
+        (checkpoint_every == segment_len, docs/DESIGN.md §14).
+        ``consts`` are window-invariant traced trailing step args
+        (see :meth:`dispatch`) shared by every segment."""
         import jax
 
         leaves = jax.tree_util.tree_leaves(states)
@@ -255,6 +262,7 @@ class WindowRunner:
         due = (self.invariants.due_rows(D)
                if self.invariants is not None else None)
         cpseg = seg // self.invariants.check_every if due is not None else 0
+        consts = tuple(consts)
         before = self._cache_size()
         oks, obs = [], []
         t0 = time.perf_counter()
@@ -262,8 +270,7 @@ class WindowRunner:
             xs = self.stack_args(make_args, g * seg, (g + 1) * seg)
             dseg = (due[g * cpseg:(g + 1) * cpseg]
                     if due is not None else None)
-            states, ys = (self.window(states, xs) if dseg is None
-                          else self.window(states, xs, dseg))
+            states, ys = self.window(states, xs, dseg, consts)
             if "ok" in ys:
                 oks.append(ys["ok"])
             if "obs" in ys:
@@ -302,7 +309,8 @@ class WindowRunner:
 def run_window(ens_step, states, make_args, n_steps: int, *,
                rounds_per_phase: int = 1, heartbeat_fn=None,
                invariants=None, observe=None, segment_len=None,
-               unroll: int = 1, on_segment=None) -> EnsembleRun:
+               unroll: int = 1, on_segment=None,
+               consts=()) -> EnsembleRun:
     """One-shot :class:`WindowRunner`: compile the whole run as a scan
     window and execute it (ONE dispatch per segment; default one
     segment = one dispatch for the entire run). Drop-in for
@@ -315,7 +323,7 @@ def run_window(ens_step, states, make_args, n_steps: int, *,
         ens_step, n_steps, rounds_per_phase=rounds_per_phase,
         heartbeat_fn=heartbeat_fn, invariants=invariants, observe=observe,
         segment_len=segment_len, unroll=unroll,
-    ).run(states, make_args, on_segment=on_segment)
+    ).run(states, make_args, on_segment=on_segment, consts=consts)
 
 
 def shard_ensemble_state(states, mesh, n_peers: int, axis: str = "peers",

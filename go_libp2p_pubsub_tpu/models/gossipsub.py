@@ -55,7 +55,14 @@ from ..config import (
 )
 from ..ops import bitset, edges
 from ..ops import fused_round as fr
-from ..ops.select import count_true, median_masked, select_random_mask, select_topk_mask
+from ..ops.select import (
+    count_true,
+    masked_width_random,
+    masked_width_topk,
+    median_masked,
+    select_random_mask,
+    select_topk_mask,
+)
 from ..score.engine import (
     ScoreState,
     TopicParamsArrays,
@@ -503,14 +510,18 @@ def joined_msg_words(net: Net, msgs) -> jax.Array:
 
 def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
                        acc_ok: jax.Array, graft_in_raw: jax.Array,
-                       prune_in_raw: jax.Array, px_in_raw, thr=None):
+                       prune_in_raw: jax.Array, px_in_raw, thr=None,
+                       msh=None):
     """Process GRAFT/PRUNE received this round (handleGraft
     gossipsub.go:718-809, handlePrune :811-843). Returns updated state plus
     next round's PRUNE responses. `*_raw` are the pre-gathered edge views
     from the step's merged wire exchange (already nbr_ok-masked).
     ``thr`` is the threshold source — cfg (static floats, the default)
-    or the traced ScoreParams plane of a lifted build (round 16)."""
+    or the traced ScoreParams plane of a lifted build (round 16).
+    ``msh`` is the mesh-degree source — cfg, or the traced MeshParams
+    plane of a candidate-lifted build (round 20)."""
     thr = cfg if thr is None else thr
+    msh = cfg if msh is None else msh
     tick = st.core.tick
 
     graft_in = graft_in_raw & acc_ok[:, None, :]
@@ -555,7 +566,7 @@ def handle_graft_prune(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: d
 
     mesh_deg = count_true(mesh)  # [N,S]
     rej_full = (
-        want & (mesh_deg[:, :, None] >= cfg.Dhi) & ~net.outbound[:, None, :]
+        want & (mesh_deg[:, :, None] >= msh.Dhi) & ~net.outbound[:, None, :]
     )  # gossipsub.go:785-792
 
     rejected = rej_direct | rej_backoff | rej_score | rej_full
@@ -824,6 +835,7 @@ def update_fanout_on_publish(
     nbr_sub_words: jax.Array,  # [N,K,Wt] static: neighbors' topic-bit subs
     fp_pack: jax.Array | None = None,
     thr=None,                  # threshold source (cfg | lifted plane)
+    msh=None,                  # mesh-degree source (cfg | MeshParams)
 ):
     """Publishing to an unjoined topic creates/refreshes a fanout slot with
     D random eligible peers (gossipsub.go:983-998) and stamps lastpub.
@@ -833,6 +845,7 @@ def update_fanout_on_publish(
     ``state.fanout_peers`` left untouched (stale; the phase tail unpacks
     the packed form back into it)."""
     thr = cfg if thr is None else thr
+    msh = cfg if msh is None else msh
     tick = st.core.tick
     p_dim = pub_origin.shape[0]
     f_dim = cfg.fanout_slots
@@ -887,7 +900,7 @@ def update_fanout_on_publish(
     )
     if cfg.score_enabled:
         cand = cand & (st.scores[o] >= thr.publish_threshold)
-    sel = select_random_mask(key, cand, cfg.D)  # [P,K]
+    sel = masked_width_random(key, cand, msh.D, net.max_degree)  # [P,K]
 
     # commit: new slots take the fresh selection; matched slots keep
     # theirs. A static fold of P masked selects over the [N, F] planes —
@@ -1026,7 +1039,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
               present_ok: jax.Array | None = None,
               gossip_suppress: jax.Array | None = None,
               app_gathered: jax.Array | None = None,
-              adversary=None, thr=None) -> GossipSubState:
+              adversary=None, thr=None, msh=None) -> GossipSubState:
     """`net` is the live view (nbr_ok masked by churn/edge-liveness);
     `present_ok` is the static edge-presence mask, needed by directConnect
     to re-dial edges that are currently dormant (defaults to net.nbr_ok).
@@ -1042,8 +1055,13 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     backoff bookkeeping — raw-wire fakes keep no router state), and
     lie-in-IHAVE advertises every live message id on every edge.
     ``thr`` is the threshold source (cfg, or a lifted build's traced
-    ScoreParams plane — score_params is then that same plane)."""
+    ScoreParams plane — score_params is then that same plane).
+    ``msh`` is the mesh-degree source (cfg, or a candidate-lifted
+    build's traced MeshParams plane, round 20): every degree width it
+    feeds goes through ops/select's masked-width kernels with the
+    padded neighbor axis as the static ceiling."""
     thr = cfg if thr is None else thr
+    msh = cfg if msh is None else msh
     tick = st.core.tick
     n, s_dim, k_dim = st.mesh.shape
     m = st.core.msgs.capacity
@@ -1135,10 +1153,10 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
 
     # |mesh| < Dlo -> graft to D (gossipsub.go:1371-1385)
     deg = count_true(mesh)
-    ineed = jnp.where(deg < cfg.Dlo, cfg.D - deg, 0)
+    ineed = jnp.where(deg < msh.Dlo, msh.D - deg, 0)
     grafts = jax.lax.cond(
         jnp.any(ineed > 0),
-        lambda: select_random_mask(k1, cand, ineed),
+        lambda: masked_width_random(k1, cand, ineed, k_dim),
         lambda: jnp.zeros_like(mesh),
     )
     mesh = mesh | grafts
@@ -1147,18 +1165,20 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     # |mesh| > Dhi -> keep Dscore best + random to D, Dout outbound
     # (gossipsub.go:1388-1448)
     deg = count_true(mesh)
-    over = (deg > cfg.Dhi)[:, :, None]
+    over = (deg > msh.Dhi)[:, :, None]
     outb = jnp.broadcast_to(net.outbound[:, None, :], mesh.shape)
 
     def _over_subscribed():
         noise = jax.random.uniform(k2, mesh.shape)
         if cfg.score_enabled:
-            topscore = select_topk_mask(scores_b, mesh, cfg.Dscore, key=k3)
+            topscore = masked_width_topk(scores_b, mesh, msh.Dscore, k_dim,
+                                         key=k3)
         else:
-            topscore = select_random_mask(k3, mesh, cfg.Dscore)
-        rest_rand = select_topk_mask(noise, mesh & ~topscore, cfg.D - cfg.Dscore)
+            topscore = masked_width_random(k3, mesh, msh.Dscore, k_dim)
+        rest_rand = masked_width_topk(noise, mesh & ~topscore,
+                                      msh.D - msh.Dscore, k_dim)
         keep = topscore | rest_rand
-        x_need = jnp.maximum(cfg.Dout - count_true(keep & outb), 0)
+        x_need = jnp.maximum(msh.Dout - count_true(keep & outb), 0)
         bring = select_topk_mask(noise, mesh & outb & ~keep, x_need)
         drop = select_topk_mask(-noise, keep & ~outb & ~topscore, count_true(bring))
         keep = (keep & ~drop) | bring
@@ -1181,11 +1201,11 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     # outbound quota top-up at Dlo <= |mesh| (gossipsub.go:1451-1476)
     deg = count_true(mesh)
     need_out = jnp.where(
-        deg >= cfg.Dlo, jnp.maximum(cfg.Dout - count_true(mesh & outb), 0), 0
+        deg >= msh.Dlo, jnp.maximum(msh.Dout - count_true(mesh & outb), 0), 0
     )
     grafts2 = jax.lax.cond(
         jnp.any(need_out > 0),
-        lambda: select_random_mask(k4, cand & outb & ~mesh, need_out),
+        lambda: masked_width_random(k4, cand & outb & ~mesh, need_out, k_dim),
         lambda: jnp.zeros_like(mesh),
     )
     mesh = mesh | grafts2
@@ -1250,9 +1270,9 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         cand_f = base_f & ~fpeers
         if cfg.score_enabled:
             cand_f = cand_f & (scores[:, None, :] >= thr.publish_threshold)
-        ineed_f = jnp.where(f_live, cfg.D - count_true(fpeers), 0)
+        ineed_f = jnp.where(f_live, msh.D - count_true(fpeers), 0)
         kf1, kf2 = jax.random.split(jax.random.fold_in(key, 11))
-        fpeers = fpeers | select_random_mask(kf1, cand_f, ineed_f)
+        fpeers = fpeers | masked_width_random(kf1, cand_f, ineed_f, k_dim)
 
     # ---- emitGossip (gossipsub.go:1669-1723) ----------------------------
     gwin = bitset.word_or_reduce(st.mcache[:, : cfg.history_gossip, :], axis=1)  # [N,W]
@@ -1263,11 +1283,11 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         gossip_cand = gossip_cand & (scores_b >= thr.gossip_threshold)
     n_cand = count_true(gossip_cand)
     target = jnp.maximum(
-        cfg.Dlazy,
-        (jnp.float32(cfg.gossip_factor) * n_cand.astype(jnp.float32))
-        .astype(jnp.int32),
+        msh.Dlazy,
+        (jnp.asarray(msh.gossip_factor, jnp.float32)
+         * n_cand.astype(jnp.float32)).astype(jnp.int32),
     )
-    chosen = select_random_mask(k6, gossip_cand, target)  # [N,S,K]
+    chosen = masked_width_random(k6, gossip_cand, target, k_dim)  # [N,S,K]
 
     slot_tw = slot_topic_words(net, st.core.msgs.topic)  # [N,S,W]
     adv = jnp.where(
@@ -1286,13 +1306,13 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         target_f = jnp.where(
             (ft >= 0),
             jnp.maximum(
-                cfg.Dlazy,
-                (jnp.float32(cfg.gossip_factor) * n_cand_f.astype(jnp.float32))
-                .astype(jnp.int32),
+                msh.Dlazy,
+                (jnp.asarray(msh.gossip_factor, jnp.float32)
+                 * n_cand_f.astype(jnp.float32)).astype(jnp.int32),
             ),
             0,
         )
-        chosen_f = select_random_mask(kf2, gossip_cand_f, target_f)  # [N,F,K]
+        chosen_f = masked_width_random(kf2, gossip_cand_f, target_f, k_dim)  # [N,F,K]
         ftw = fanout_topic_words(ft, st.core.msgs.topic)
         adv_f = jnp.where(
             chosen_f[..., None], (gwin[:, None, :] & ftw)[:, :, None, :], jnp.uint32(0)
@@ -2045,11 +2065,18 @@ def make_gossipsub_step(
         # is the static path, byte-identical to the pre-lift program
         # (thr=cfg routes every threshold read to the same Python
         # floats it always read).
+        # a combined candidate plane (round 20, score.params.
+        # CandidateParams) nests the score plane with a traced MeshParams
+        # — detect it by its `mesh` attribute; a bare ScoreParams keeps
+        # the score-only semantics unchanged
+        mesh_plane = getattr(score_plane, "mesh", None)
         if score_plane is not None:
-            tp_r = score_plane.gather(net.my_topics)
-            sp_r, thr, wrt = score_plane, score_plane, score_plane.window_rounds
+            sc = score_plane.score if mesh_plane is not None else score_plane
+            tp_r = sc.gather(net.my_topics)
+            sp_r, thr, wrt = sc, sc, sc.window_rounds
         else:
             tp_r, sp_r, thr, wrt = tp, score_params, cfg, window_rounds_t
+        msh = cfg if mesh_plane is None else mesh_plane
         # telemetry: counters at step ENTRY (before the churn plane's
         # ADD/REMOVE_PEER accounting), so the row's EV deltas cover the
         # whole step and the panel sums telescope to the drained totals
@@ -2130,7 +2157,7 @@ def make_gossipsub_step(
         # 1. GRAFT/PRUNE ingest
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
             cfg, net_l, st, tp_r, acc_ok, graft_in_raw, prune_in_raw,
-            px_in_raw, thr=thr,
+            px_in_raw, thr=thr, msh=msh,
         )
         events = st.core.events
         if cfg.count_events:
@@ -2422,7 +2449,7 @@ def make_gossipsub_step(
             st2 = update_fanout_on_publish(
                 cfg, net_l, st2, pub_origin, pub_topic,
                 jax.random.fold_in(jax.random.fold_in(core.key, tick), 0xFA40),
-                nbr_sub_words_l, thr=thr,
+                nbr_sub_words_l, thr=thr, msh=msh,
             )
 
         if cfg.count_events:
@@ -2481,6 +2508,7 @@ def make_gossipsub_step(
                 cfg, net_l, s, tp_r, sp_r, nbr_sub_l, gater_params,
                 nbr_sub_words_l, present_ok=net.nbr_ok,
                 gossip_suppress=gossip_suppress, adversary=adv, thr=thr,
+                msh=msh,
             )
 
         if cfg.heartbeat_every == 1:

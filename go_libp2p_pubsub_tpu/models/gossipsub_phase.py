@@ -392,13 +392,17 @@ def make_gossipsub_phase_step(
         # read from the traced plane; score_plane=None is the static
         # path, byte-identical to the pre-lift program (thr=cfg routes
         # threshold reads to the same Python floats)
+        # a combined candidate plane (round 20) nests score + MeshParams;
+        # detect by its `mesh` attribute, bare ScoreParams is unchanged
+        mesh_plane = getattr(score_plane, "mesh", None)
         if score_plane is not None:
-            tp_r = score_plane.gather(net.my_topics)
-            sp_r, thr, wrt = (score_plane, score_plane,
-                              score_plane.window_rounds)
+            sc = score_plane.score if mesh_plane is not None else score_plane
+            tp_r = sc.gather(net.my_topics)
+            sp_r, thr, wrt = sc, sc, sc.window_rounds
         else:
             tp_r, sp_r, thr, wrt = (tp, consts.score_params, cfg,
                                     consts.window_rounds_t)
+        msh = cfg if mesh_plane is None else mesh_plane
         # telemetry: counters at phase ENTRY, before the churn plane's
         # ADD/REMOVE_PEER accounting (the phase-tail row's deltas cover
         # the whole phase, so the panel sums telescope exactly)
@@ -494,7 +498,7 @@ def make_gossipsub_phase_step(
             window_g = app_g = None
         st2, prune_resp, px_resp, px_ok, n_graft, n_prune = handle_graft_prune(
             cfg, net_l, st, tp_r, acc_ok, graft_in_raw, prune_in_raw,
-            px_in_raw, thr=thr,
+            px_in_raw, thr=thr, msh=msh,
         )
         events = st.core.events
         if cfg.count_events:
@@ -984,7 +988,7 @@ def make_gossipsub_phase_step(
                         jax.random.fold_in(core.key, tick_i), 0xFA40
                     ),
                     nbr_sub_words_l,
-                    fp_pack=fp_pack, thr=thr,
+                    fp_pack=fp_pack, thr=thr, msh=msh,
                 )
                 if fp_pack is not None:
                     fanout_st, fp_pack = upd
@@ -1104,7 +1108,7 @@ def make_gossipsub_phase_step(
                 cfg, net_l, st2, tp_r, sp_r, nbr_sub_l,
                 gater_params, nbr_sub_words_l, present_ok=net.nbr_ok,
                 gossip_suppress=gossip_suppress, app_gathered=app_g,
-                adversary=adv, thr=thr,
+                adversary=adv, thr=thr, msh=msh,
             )
 
         # telemetry row — one per phase, recorded LAST (after the

@@ -60,7 +60,12 @@ def select_topk_mask(
 
     `k` may be a scalar or an array broadcastable to values.shape[:-1]."""
     ranks = rank_desc(values, mask, key)
-    k_arr = jnp.asarray(k)[..., None] if jnp.ndim(k) else jnp.asarray(k)
+    # unconditional trailing broadcast axis: a scalar k becomes shape (1,),
+    # which compares against [..., K] ranks identically to the raw scalar.
+    # (An `if jnp.ndim(k)` conditional expression here would make the width
+    # a SHAPE decision in the liftability audit — this form keeps every
+    # degree knob a pure VALUE read, so it can ride a traced plane.)
+    k_arr = jnp.asarray(k)[..., None]
     return (ranks < k_arr) & mask
 
 
@@ -69,6 +74,35 @@ def select_random_mask(key: jax.Array, mask: jax.Array, k) -> jax.Array:
     `getPeers`/`shufflePeers` (gossipsub.go:1852-1909)."""
     noise = jax.random.uniform(key, mask.shape)
     return select_topk_mask(noise, mask, k)
+
+
+def masked_width_topk(
+    values: jax.Array, mask: jax.Array, width, width_max: int,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Top-k selection at a TRACED width, bounded by a static ceiling.
+
+    The masked-width contract (docs/DESIGN.md §20): the selection kernel
+    always ranks the full padded axis (so program shape depends only on
+    ``width_max``, the search space's Dhi ceiling), and the candidate's
+    actual width arrives as a traced value clipped into [0, width_max].
+    At ``width == k`` for any static k <= width_max this is bit-exact
+    with ``select_topk_mask(values, mask, k, key)`` — the rank compare
+    is the only consumer of the width, and clipping a legal width is the
+    identity. This is what lets D/Dlo/Dhi/Dscore/Dout ride the traced
+    mesh plane: one compiled program serves every degree profile.
+    """
+    w = jnp.clip(jnp.asarray(width, jnp.int32), 0, jnp.int32(width_max))
+    return select_topk_mask(values, mask, w, key)
+
+
+def masked_width_random(
+    key: jax.Array, mask: jax.Array, width, width_max: int
+) -> jax.Array:
+    """Random-k selection at a traced width bounded by a static ceiling —
+    the `select_random_mask` counterpart of :func:`masked_width_topk`."""
+    w = jnp.clip(jnp.asarray(width, jnp.int32), 0, jnp.int32(width_max))
+    return select_random_mask(key, mask, w)
 
 
 def count_true(mask: jax.Array, axis: int = -1) -> jax.Array:

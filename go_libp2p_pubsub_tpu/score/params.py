@@ -26,9 +26,12 @@ What stays static, per the audit:
   mesh; score/engine.py compute_scores, the phase head's
   ``include_app``). Program structure, census-pinned — the plane
   carries it as static aux (``pytree_node=False``).
-* the mesh degree knobs (D/Dlo/Dhi/Dscore/Dout/Dlazy) — they feed
-  top-k selection widths and stay out of this plane (the audit records
-  their verdicts; lifting them is the follow-on).
+* the mesh degree knobs (D/Dlo/Dhi/Dscore/Dout/Dlazy/gossip_factor)
+  rode as static until round 20: the masked-width selection contract
+  (``ops/select.masked_width_topk`` — rank the full padded axis, clip
+  the traced width) removed the last SHAPE site, so they now lift as
+  :class:`MeshParams` and join the candidate plane
+  (:class:`CandidateParams`) the tune/ search sweeps.
 * the phase engine's static weight elision (p3_live/p4_live) — a
   build-time STRUCTURE decision on weight values. The lifted build
   pins the conservative all-planes-live structure instead (a traced
@@ -212,3 +215,80 @@ class ScoreParams:
             return jnp.where(live, v, jnp.asarray(0, v.dtype))
 
         return {name: g(getattr(self, name)) for name in TOPIC_ROW_FIELDS}
+
+
+#: GossipSubConfig mesh degree knobs the mesh plane lifts — i32 widths
+#: plus the f32 gossip factor. Audit-proved VALUE (round 20: the
+#: masked-width selection contract removed the one SHAPE site,
+#: ops/select's conditional-expression broadcast).
+MESH_INT_FIELDS = ("D", "Dlo", "Dhi", "Dscore", "Dout", "Dlazy")
+MESH_FLOAT_FIELDS = ("gossip_factor",)
+
+#: audit-namespace names the mesh plane carries traced —
+#: scripts/lift_audit.py cross-checks this against LIFT_AUDIT.json
+MESH_LIFTED_FIELD_NAMES = tuple(sorted(
+    f"GossipSubConfig.{f}" for f in MESH_INT_FIELDS + MESH_FLOAT_FIELDS
+))
+
+
+@struct.dataclass
+class MeshParams:
+    """The traced mesh-degree plane (round 20).
+
+    Attribute names match GossipSubConfig's, so inside the engines a
+    MeshParams duck-types as the degree-knob source the same way
+    ScoreParams duck-types as the threshold source (the ``msh = cfg if
+    msh is None else msh`` seam). All widths reach selection kernels
+    through ``ops/select.masked_width_*`` with the padded neighbor axis
+    as the static ceiling, so program shape never depends on a leaf."""
+
+    D: jax.Array        # i32 0-d
+    Dlo: jax.Array
+    Dhi: jax.Array
+    Dscore: jax.Array
+    Dout: jax.Array
+    Dlazy: jax.Array
+    gossip_factor: jax.Array  # f32 0-d
+
+    lifted = True  # class marker, not a field
+
+    @classmethod
+    def from_config(cls, cfg) -> "MeshParams":
+        """Matched-values constructor: a step fed this plane reproduces
+        the static build bit for bit (a traced i32 width compares and
+        subtracts exactly like the Python int it replaces)."""
+        kw = {f: jnp.int32(getattr(cfg, f)) for f in MESH_INT_FIELDS}
+        for f in MESH_FLOAT_FIELDS:
+            kw[f] = jnp.float32(getattr(cfg, f))
+        return cls(**kw)
+
+
+@struct.dataclass
+class CandidateParams:
+    """One tune/ candidate: the score plane and the mesh plane, stacked
+    together as a single pytree so ``ensemble.stack_planes`` sweeps both
+    along the plane axis. The lifted engines detect the combined form by
+    its ``mesh`` attribute (``getattr(plane, "mesh", None)``) and fall
+    back to score-only semantics otherwise, so every pre-round-20 call
+    site keeps working unchanged."""
+
+    score: ScoreParams
+    mesh: MeshParams
+
+    lifted = True  # class marker, not a field
+
+    @property
+    def app_specific_weight(self) -> float:
+        # static aux rides on the nested score plane; surface it so
+        # ensemble.stack_planes' aux-agreement check sees it
+        return self.score.app_specific_weight
+
+    @classmethod
+    def from_config(cls, cfg, score_params: PeerScoreParams,
+                    n_topics: int = 1,
+                    heartbeat_interval: float = 1.0) -> "CandidateParams":
+        return cls(
+            score=ScoreParams.from_config(cfg, score_params, n_topics,
+                                          heartbeat_interval),
+            mesh=MeshParams.from_config(cfg),
+        )

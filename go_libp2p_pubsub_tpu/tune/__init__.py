@@ -1,0 +1,41 @@
+"""Ensemble-scale protocol parameter search (round 20, docs/DESIGN.md
+§20): one evolutionary generation = ONE scanned configs×sims window.
+
+* :mod:`.space` — the declarative knob space over the mesh degrees,
+  score weights/decays/caps and v1.1 thresholds, reparameterized so
+  every sampled point decodes to a config ``validate()`` accepts by
+  construction.
+* :mod:`.fitness` — the evaluation cell: a candidate population rides
+  the stacked :class:`score.params.CandidateParams` plane through one
+  ``WindowRunner`` dispatch under the sybil-flood adversary; fitness is
+  the paired per-sim delivery/latency lift against the defaults
+  (candidate 0), invariant violations disqualify, and every candidate
+  is priced by the static cost auditor.
+* :mod:`.driver` — the (mu, lambda) evolution-strategy loop with an
+  optional CMA-style covariance update, resumable from a rolling
+  JSON checkpoint.
+"""
+
+from .driver import (  # noqa: F401
+    ESConfig,
+    es_ask,
+    es_init,
+    es_tell,
+    load_es_state,
+    save_es_state,
+    search,
+)
+from .fitness import (  # noqa: F401
+    EvalResult,
+    evaluate,
+    make_cell,
+    rank_scores,
+    sybil_profile,
+)
+from .space import (  # noqa: F401
+    Knob,
+    Profile,
+    SearchSpace,
+    check_space,
+    default_space,
+)

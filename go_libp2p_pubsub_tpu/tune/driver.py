@@ -1,0 +1,300 @@
+"""The (mu, lambda) evolution-strategy generation loop.
+
+Host-side NumPy only (the device runs simulations, not the optimizer):
+a gaussian search distribution over the normalized genome cube
+``[0, 1]^dim`` with log-rank recombination weights, a 1/5th-style
+step-size adaptation, and an optional CMA-style rank-mu covariance
+update (``ESConfig.cma``). Candidate 0 of EVERY generation is the
+defaults genome — the pairing baseline fitness.py measures lift
+against — so the search can never lose sight of the thing it must
+beat; sampled candidates fill rows 1..C-1.
+
+Resumability: the full ES state (mean, sigma, covariance, the NumPy
+bit-generator state, generation counter, incumbent) round-trips
+through a JSON checkpoint bit-identically — resuming generation k
+reproduces the straight-through run's generation k exactly
+(tests/test_tune.py pins it). The checkpoint records the space
+fingerprint and refuses to resume across a changed knob set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+from .fitness import TuneCell, evaluate
+from .space import SearchSpace
+
+ES_SCHEMA = 1
+
+
+@dataclasses.dataclass
+class ESConfig:
+    """Loop shape: ``n_candidates`` includes the pinned defaults row
+    (lambda = n_candidates - 1 sampled offspring), ``mu`` parents
+    recombine (log-rank weighted)."""
+
+    n_candidates: int = 8
+    mu: int = 3
+    sigma0: float = 0.15
+    sigma_min: float = 0.02
+    sigma_max: float = 0.5
+    cma: bool = False
+    #: CMA rank-mu learning rate (only with cma=True)
+    c_mu: float = 0.3
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.n_candidates < 2:
+            raise ValueError("n_candidates must be >= 2 (defaults row "
+                             "+ at least one offspring)")
+        if not (1 <= self.mu < self.n_candidates):
+            raise ValueError(
+                f"mu must be in [1, n_candidates), got {self.mu}")
+
+
+@dataclasses.dataclass
+class ESState:
+    """Everything the next generation depends on."""
+
+    mean: np.ndarray          # [dim] search-distribution mean
+    sigma: float
+    cov: np.ndarray | None    # [dim, dim] (cma) or None (isotropic)
+    rng: np.random.Generator
+    generation: int = 0
+    best_score: float = -np.inf
+    best_values: dict | None = None
+    best_generation: int = -1
+
+
+def _rank_weights(mu: int) -> np.ndarray:
+    w = np.log(mu + 0.5) - np.log(np.arange(1, mu + 1))
+    return w / w.sum()
+
+
+def es_init(space: SearchSpace, escfg: ESConfig,
+            base_genome: np.ndarray) -> ESState:
+    escfg.validate()
+    return ESState(
+        mean=np.asarray(base_genome, float).copy(),
+        sigma=float(escfg.sigma0),
+        cov=np.eye(space.dim) if escfg.cma else None,
+        rng=np.random.default_rng(escfg.seed),
+    )
+
+
+def es_ask(es: ESState, space: SearchSpace, escfg: ESConfig,
+           base_genome: np.ndarray) -> np.ndarray:
+    """[C, dim] genomes: row 0 = the defaults (always re-evaluated —
+    it IS the pairing baseline), rows 1.. ~ N(mean, sigma^2 C) clipped
+    to the cube."""
+    c, d = escfg.n_candidates, space.dim
+    z = es.rng.standard_normal((c - 1, d))
+    if es.cov is not None:
+        # numpy cholesky is deterministic — safe for bit-exact resume
+        z = z @ np.linalg.cholesky(
+            es.cov + 1e-9 * np.eye(d)).T
+    x = np.clip(es.mean[None, :] + es.sigma * z, 0.0, 1.0)
+    return np.concatenate([np.asarray(base_genome, float)[None, :], x])
+
+
+def es_tell(es: ESState, escfg: ESConfig, genomes: np.ndarray,
+            scores: np.ndarray, values_list: list) -> None:
+    """Rank the generation, recombine the mu best into the new mean,
+    adapt sigma (success rule: did the incumbent improve?), update the
+    covariance (rank-mu) when armed, and advance the incumbent."""
+    scores = np.asarray(scores, float)
+    order = np.argsort(-scores, kind="stable")
+    parents = order[:escfg.mu]
+    finite = np.isfinite(scores[parents])
+    if finite.any():
+        w = _rank_weights(escfg.mu)[finite]
+        w = w / w.sum()
+        sel = genomes[parents[finite]]
+        old_mean = es.mean
+        es.mean = np.clip(w @ sel, 0.0, 1.0)
+        if es.cov is not None and es.sigma > 0:
+            y = (sel - old_mean[None, :]) / es.sigma
+            rank_mu = (w[:, None] * y).T @ y
+            es.cov = ((1.0 - escfg.c_mu) * es.cov
+                      + escfg.c_mu * rank_mu)
+    top = float(scores[order[0]])
+    improved = top > es.best_score
+    es.sigma = float(np.clip(
+        es.sigma * (1.1 if improved else 0.9),
+        escfg.sigma_min, escfg.sigma_max))
+    if improved:
+        es.best_score = top
+        es.best_values = dict(values_list[int(order[0])])
+        es.best_generation = es.generation
+    es.generation += 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint (JSON, bit-identical resume)
+
+
+def save_es_state(path: str, es: ESState, space: SearchSpace,
+                  escfg: ESConfig) -> None:
+    payload = {
+        "schema": ES_SCHEMA,
+        "space": space.fingerprint(),
+        "escfg": dataclasses.asdict(escfg),
+        "generation": es.generation,
+        "mean": es.mean.tolist(),
+        "sigma": es.sigma,
+        "cov": None if es.cov is None else es.cov.tolist(),
+        "rng": es.rng.bit_generator.state,
+        "best_score": (None if not np.isfinite(es.best_score)
+                       else es.best_score),
+        "best_values": es.best_values,
+        "best_generation": es.best_generation,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    os.replace(tmp, path)   # rolling checkpoint: atomic swap
+
+
+def load_es_state(path: str, space: SearchSpace) -> tuple:
+    """-> (ESState, ESConfig). Refuses a checkpoint from a different
+    knob set (resuming into a reshaped genome would be silent
+    garbage)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("schema") != ES_SCHEMA:
+        raise ValueError(
+            f"ES checkpoint schema {payload.get('schema')} != "
+            f"{ES_SCHEMA}")
+    if payload["space"] != space.fingerprint():
+        raise ValueError(
+            "ES checkpoint was recorded against a different search "
+            f"space ({payload['space']} != {space.fingerprint()})")
+    escfg = ESConfig(**payload["escfg"])
+    rng = np.random.default_rng()
+    rng.bit_generator.state = payload["rng"]
+    es = ESState(
+        mean=np.asarray(payload["mean"], float),
+        sigma=float(payload["sigma"]),
+        cov=(None if payload["cov"] is None
+             else np.asarray(payload["cov"], float)),
+        rng=rng,
+        generation=int(payload["generation"]),
+        best_score=(-np.inf if payload["best_score"] is None
+                    else float(payload["best_score"])),
+        best_values=payload["best_values"],
+        best_generation=int(payload["best_generation"]),
+    )
+    return es, escfg
+
+
+# ---------------------------------------------------------------------------
+# the search loop
+
+
+def _round_floats(obj, ndigits: int = 6):
+    if isinstance(obj, float):
+        return round(obj, ndigits)
+    if isinstance(obj, dict):
+        return {k: _round_floats(v, ndigits) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_round_floats(v, ndigits) for v in obj]
+    return obj
+
+
+def search(cell: TuneCell, *, generations: int,
+           escfg: ESConfig | None = None, cost_weight: float = 0.0,
+           checkpoint_path: str | None = None, resume: bool = False,
+           log=None) -> dict:
+    """Run the generation loop on a built cell: sample -> stack planes
+    -> ONE window dispatch -> rank -> adapt, checkpointing the ES
+    state after every generation. Returns the machine-readable search
+    record (the tune-smoke artifact's body): per-generation rows with
+    every candidate's values, fitness, invariant verdict and
+    ``fingerprint["cost"]``, plus the incumbent."""
+    from ..perf.artifacts import params_fingerprint
+    from ..score.params import MESH_LIFTED_FIELD_NAMES
+    from ..score.params import LIFTED_FIELD_NAMES as SCORE_FIELDS
+
+    escfg = escfg or ESConfig(n_candidates=cell.n_candidates)
+    if escfg.n_candidates != cell.n_candidates:
+        raise ValueError(
+            f"escfg.n_candidates {escfg.n_candidates} != cell's "
+            f"{cell.n_candidates}")
+    base_genome = cell.space.encode(cell.base_values)
+    if resume and checkpoint_path and os.path.exists(checkpoint_path):
+        es, escfg = load_es_state(checkpoint_path, cell.space)
+    else:
+        es = es_init(cell.space, escfg, base_genome)
+
+    pfp = params_fingerprint(
+        True, traced=sorted(SCORE_FIELDS + MESH_LIFTED_FIELD_NAMES))
+    gens = []
+    while es.generation < generations:
+        g = es.generation
+        genomes = es_ask(es, cell.space, escfg, base_genome)
+        values_list = [cell.space.decode(x) for x in genomes]
+        res = evaluate(cell, values_list, cost_weight=cost_weight)
+        es_tell(es, escfg, genomes, res.score, values_list)
+        if checkpoint_path:
+            save_es_state(checkpoint_path, es, cell.space, escfg)
+        order = np.argsort(-res.score, kind="stable")
+        rows = []
+        for rank, ci in enumerate(order):
+            ci = int(ci)
+            rows.append(_round_floats({
+                "rank": rank,
+                "candidate": ci,
+                "defaults": ci == 0,
+                "values": values_list[ci],
+                "ok": bool(res.ok[ci]),
+                "fitness": (None if not np.isfinite(res.fitness[ci])
+                            else float(res.fitness[ci])),
+                "score": (None if not np.isfinite(res.score[ci])
+                          else float(res.score[ci])),
+                "delivery": res.delivery[ci].tolist(),
+                "delivery_lift": res.delivery_lift[ci].tolist(),
+                "mean_latency": res.mean_latency[ci].tolist(),
+                "cost_rel": float(res.cost_rel[ci]),
+                "fingerprint": {"cost": res.costs[ci],
+                                "params": pfp},
+            }))
+        grec = {
+            "generation": g,
+            "compiles": res.compiles,
+            "dispatches": res.dispatches,
+            "disqualified": int((~res.ok).sum()),
+            "sigma": round(es.sigma, 6),
+            "best_candidate": int(order[0]),
+            "best_score": rows[0]["score"],
+            "candidates": rows,
+        }
+        gens.append(grec)
+        if log is not None:
+            log(grec)
+    return {
+        "schema": 1,
+        "space": cell.space.fingerprint(),
+        "dim": cell.space.dim,
+        "escfg": dataclasses.asdict(escfg),
+        "cost_weight": cost_weight,
+        "cell": {
+            "n": int(np.asarray(cell.net.nbr).shape[0]),
+            "n_candidates": cell.n_candidates,
+            "n_sims": cell.n_sims,
+            "rounds": cell.rounds,
+            "born": list(cell.born),
+            "seed": cell.seed,
+            "mean_degree": round(cell.mean_degree, 4),
+        },
+        "generations": gens,
+        "best": _round_floats({
+            "score": (None if not np.isfinite(es.best_score)
+                      else float(es.best_score)),
+            "generation": es.best_generation,
+            "values": es.best_values,
+        }),
+    }

@@ -1,0 +1,377 @@
+"""The evaluation cell: one candidate population = ONE window dispatch.
+
+Layout (the configs×sims sweep, docs/DESIGN.md §20): a generation of
+``C`` candidates × ``S`` sims runs as ``R = C*S`` ensemble rows in one
+``WindowRunner`` program. Row ``c*S + s`` carries sim ``s``'s folded
+PRNG key for EVERY candidate ``c`` — so the chaos fault streams, the
+adversary behaviors and the heartbeat sampler draws are IDENTICAL
+across candidates at matched sim index (the chaos-smoke pairing
+discipline, threefry's elementwise vmap batching), and the per-sim
+delivery/latency delta against candidate 0 (the defaults, pinned by
+the driver) is the candidate's causal effect. The stacked
+:class:`score.params.CandidateParams` plane rides the window's
+``consts`` seam (driver.make_window round 16), repeated ``S``× along
+the row axis — a new population re-dispatches the SAME compiled
+window: one compile per search, zero warm recompiles.
+
+Gating and pricing:
+
+* the folded ``oracle.ScanInvariants`` checker runs under the space's
+  ENVELOPE config (widest in-space degree bounds); any violated check
+  row hard-disqualifies its candidate (fitness -> -inf);
+* every candidate's artifact row carries ``fingerprint["cost"]``: the
+  static auditor (analysis/costmodel.cost_of) prices the shared
+  program once, and the candidate-dependent wire term scales the
+  byte-traffic metrics by the mesh fan-out it actually configures
+  (``D + Dlazy + gossip_factor * mean_degree``, the per-edge byte
+  model) — ``cost_weight`` trades paired lift against hbm bytes/round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from .space import Profile, SearchSpace
+
+#: smoke-shape defaults — the scripts/attack_report.py sybil-flood
+#: cell shrunk to generation cadence (n=64 keeps a C=8 x S=4 window
+#: in the tens of seconds warm on CPU)
+TUNE_N = 64
+TUNE_DEGREE = 4
+TUNE_ROUNDS = 48
+TUNE_ONSET = 10
+TUNE_FRACTION = 0.2
+TUNE_LOSS = 0.10
+TUNE_BORN = (TUNE_ONSET + 4, TUNE_ONSET + 24)
+TUNE_MSG_SLOTS = 128
+#: latency histogram depth (rounds); also the latency-lift normalizer
+MAX_LAT = 16
+#: latency weight inside the scalar fitness (delivery lift dominates)
+LAT_WEIGHT = 0.25
+
+
+def sybil_profile() -> Profile:
+    """The searched baseline: scripts/attack_report.py's sybil-flood
+    plane — the low-degree v1.1 overlay plus the attack score profile
+    (every attacker-catching term live). The profile's own values run
+    as candidate 0, so 'beat the defaults on the sybil cell' is the
+    headline fitness reads directly."""
+    tp = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.0,
+        first_message_deliveries_weight=0.5,
+        first_message_deliveries_cap=50.0,
+        first_message_deliveries_decay=0.9,
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_decay=0.9,
+        mesh_message_deliveries_cap=20.0,
+        mesh_message_deliveries_threshold=0.5,
+        mesh_message_deliveries_window=2.0,
+        mesh_message_deliveries_activation=8.0,
+        mesh_failure_penalty_weight=-1.0,
+        mesh_failure_penalty_decay=0.9,
+    )
+    sp = PeerScoreParams(
+        topics={0: tp},
+        skip_app_specific=True,
+        behaviour_penalty_weight=-10.0,
+        behaviour_penalty_threshold=0.0,
+        behaviour_penalty_decay=0.9,
+        ip_colocation_factor_weight=0.0,
+    )
+    th = PeerScoreThresholds(
+        gossip_threshold=-2.0,
+        publish_threshold=-4.0,
+        graylist_threshold=-8.0,
+        accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0,
+    )
+    params = GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1,
+                             history_length=6, history_gossip=4)
+    return Profile(params=params, tp=tp, sp=sp, thresholds=th)
+
+
+def _honest_publish_schedule(rng, honest_ids, rounds, pub_rounds,
+                             width=2):
+    """Publish batches from HONEST origins only (the attack_report
+    discipline: the measured window must start from honest sources)."""
+    po = np.full((rounds, width), -1, np.int32)
+    for t in range(*pub_rounds):
+        po[t] = rng.choice(honest_ids, size=width)
+    pt = np.zeros((rounds, width), np.int32)
+    pv = np.ones((rounds, width), bool)
+    return po, pt, pv
+
+
+def _block_tile(states, n_candidates: int, n_sims: int):
+    """[S, ...] batched tree -> [C*S, ...] with row ``c*S + s`` equal
+    to batched row ``s`` (a gather, so PRNG-key leaves tile too)."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(np.tile(np.arange(n_sims), n_candidates))
+    return jax.tree_util.tree_map(lambda x: x[idx], states)
+
+
+def _wire_units(values: dict, mean_degree: float) -> float:
+    """The candidate-dependent wire fan-out in per-peer edge units:
+    mesh forwarding floods D edges, gossip IHAVEs cover
+    ``max(Dlazy, gossip_factor * candidates)`` non-mesh neighbors —
+    the degree-scaled factor the byte metrics move with when the
+    program itself is shared across the population."""
+    gossip = max(float(values["Dlazy"]),
+                 float(values["gossip_factor"]) * float(mean_degree))
+    return float(values["D"]) + gossip
+
+
+@dataclasses.dataclass
+class TuneCell:
+    """One compiled evaluation cell, reused across generations."""
+
+    space: SearchSpace
+    profile: Profile
+    net: object
+    cfg: object            # the base (defaults) build the step traces
+    env_cfg: object        # the invariant checker's envelope config
+    sp: PeerScoreParams
+    st0: object            # unbatched state template (never donated)
+    runner: object         # ensemble.WindowRunner
+    po: np.ndarray
+    pt: np.ndarray
+    pv: np.ndarray
+    is_sybil: np.ndarray
+    n_candidates: int
+    n_sims: int
+    rounds: int
+    born: tuple
+    seed: int
+    base_values: dict
+    base_cost: dict        # static per-round metrics of one row
+    mean_degree: float
+
+    @property
+    def n_rows(self) -> int:
+        return self.n_candidates * self.n_sims
+
+    def build_states(self):
+        """Fresh [C*S, ...] row states (the window donates its input
+        buffers, so every generation rebuilds from the template)."""
+        from .. import ensemble
+
+        return _block_tile(ensemble.batch_states(self.st0, self.n_sims),
+                           self.n_candidates, self.n_sims)
+
+    def make_args(self, i: int):
+        from .. import ensemble
+
+        r = self.n_rows
+        return (ensemble.tile(self.po[i], r), ensemble.tile(self.pt[i], r),
+                ensemble.tile(self.pv[i], r))
+
+    def candidate_cost(self, values: dict) -> dict:
+        """The candidate's ``fingerprint["cost"]`` block: the audited
+        shared-program metrics with the byte terms scaled by the wire
+        model (flops/rng are population-invariant — one program)."""
+        from ..perf.artifacts import cost_fingerprint
+
+        scale = (_wire_units(values, self.mean_degree)
+                 / max(_wire_units(self.base_values, self.mean_degree),
+                       1e-9))
+        return cost_fingerprint(
+            build="tune/sybil-cell",
+            flops_per_round=self.base_cost["flops"],
+            hbm_bytes_per_round=self.base_cost["hbm_bytes"] * scale,
+            halo_bytes_per_round=self.base_cost["halo_bytes"] * scale,
+            rng_bits_per_round=self.base_cost["rng_bits"],
+        )
+
+
+def make_cell(space: SearchSpace, *, n_candidates: int, n_sims: int,
+              profile: Profile | None = None, n: int = TUNE_N,
+              rounds: int = TUNE_ROUNDS, seed: int = 0,
+              fraction: float = TUNE_FRACTION, loss: float = TUNE_LOSS,
+              onset: int = TUNE_ONSET, born: tuple = TUNE_BORN,
+              adversary: bool = True, envelope="space",
+              check_every: int = 8) -> TuneCell:
+    """Build the cell: topology, adversary, publish schedule, the
+    lifted step, the window runner (invariants folded under the
+    envelope config) and the static cost audit — everything that stays
+    fixed while generations sweep candidate planes through it.
+
+    ``envelope`` selects the invariant checker's config: ``"space"``
+    (default) widens the base config's degree bounds to the space
+    envelope, ``"tight"`` keeps the base config's own bounds — the
+    negative gate's setting, proving an in-space wide-mesh candidate
+    IS disqualified when the envelope doesn't cover it — and a config
+    object is used as-is."""
+    import jax.numpy as jnp
+
+    from .. import ensemble, graph
+    from ..analysis import costmodel
+    from ..chaos import AttackScenario, ChaosConfig
+    from ..models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from ..oracle import invariants as oracle_inv
+    from ..score.params import CandidateParams
+    from ..state import Net
+
+    profile = profile or sybil_profile()
+    topo = graph.random_connect(n, d=TUNE_DEGREE, seed=seed)
+    net = Net.build(topo, graph.subscribe_all(n, 1))
+    cfg = GossipSubConfig.build(
+        profile.params, profile.thresholds,
+        score_enabled=profile.score_enabled,
+        chaos=ChaosConfig(loss_rate=loss) if loss else None)
+    sp = profile.sp
+
+    adv = None
+    is_sybil = np.zeros(n, bool)
+    if adversary:
+        scenario = AttackScenario(
+            n_peers=n, sybil_fraction=fraction,
+            behaviors=("drop_forward", "lie_ihave", "graft_spam",
+                       "self_promo"),
+            onset=onset, seed=seed)
+        adv = scenario.build()
+        is_sybil = np.asarray(adv.is_sybil, bool)
+    honest_ids = np.flatnonzero(~is_sybil)
+    rng = np.random.default_rng(seed)
+    po, pt, pv = _honest_publish_schedule(
+        rng, honest_ids, rounds, (2, min(born[1] + 4, rounds)))
+    assert 2 * (born[1] + 2) <= TUNE_MSG_SLOTS, \
+        "publish volume must not recycle message slots"
+
+    st0 = GossipSubState.init(net, TUNE_MSG_SLOTS, cfg, score_params=sp,
+                              seed=seed)
+    step = make_gossipsub_step(cfg, net, score_params=sp, adversary=adv,
+                               lift_scores=True)
+
+    # static audit of ONE row's program (candidates share it): the raw
+    # unjitted body traced with the defaults plane bound in a closure
+    base_plane = CandidateParams.from_config(cfg, sp)
+    raw = getattr(step, "__wrapped__", step)
+    args0 = (jnp.asarray(po[0]), jnp.asarray(pt[0]), jnp.asarray(pv[0]))
+    base_cost = costmodel.cost_of(
+        lambda s: raw(s, *args0, base_plane), st0)
+
+    if envelope == "space":
+        env_cfg = space.envelope_config(cfg)
+    elif envelope == "tight":
+        env_cfg = cfg
+    else:
+        env_cfg = envelope
+    hook = oracle_inv.ScanInvariants(
+        "gossipsub", net, env_cfg,
+        oracle_inv.InvariantConfig(check_every=check_every,
+                                   delivery_window=12))
+    runner = ensemble.WindowRunner(ensemble.lift_step(step), rounds,
+                                   invariants=hook)
+    return TuneCell(
+        space=space, profile=profile, net=net, cfg=cfg, env_cfg=env_cfg,
+        sp=sp, st0=st0, runner=runner, po=po, pt=pt, pv=pv,
+        is_sybil=is_sybil, n_candidates=int(n_candidates),
+        n_sims=int(n_sims), rounds=int(rounds), born=tuple(born),
+        seed=int(seed), base_values=space.base_values(profile),
+        base_cost=base_cost,
+        mean_degree=float(np.asarray(net.nbr_ok).sum() / n),
+    )
+
+
+@dataclasses.dataclass
+class EvalResult:
+    """One generation's measurements, all [C]-leading host arrays."""
+
+    delivery: np.ndarray      # [C, S] honest delivery ratios
+    mean_latency: np.ndarray  # [C, S] mean first-delivery latency
+    delivery_lift: np.ndarray  # [C, S] paired delta vs candidate 0
+    latency_lift: np.ndarray   # [C, S] paired (lat0 - latc)/MAX_LAT
+    ok: np.ndarray            # [C] bool — invariant gate per candidate
+    fitness: np.ndarray       # [C] lift scalar (-inf = disqualified)
+    score: np.ndarray         # [C] fitness - cost_weight * excess cost
+    cost_rel: np.ndarray      # [C] hbm bytes/round vs candidate 0
+    costs: list               # [C] fingerprint["cost"] dicts
+    compiles: int
+    dispatches: int
+    seconds: float
+
+
+def rank_scores(fitness: np.ndarray, cost_rel: np.ndarray,
+                cost_weight: float) -> np.ndarray:
+    """The ranking scalar: paired lift minus the priced cost excess.
+    ``cost_weight`` is lift-per-relative-byte — 0 ranks on lift alone;
+    disqualified candidates (-inf fitness) stay -inf at any weight."""
+    return np.where(
+        np.isfinite(fitness),
+        fitness - float(cost_weight) * (np.asarray(cost_rel) - 1.0),
+        -np.inf)
+
+
+def evaluate(cell: TuneCell, values_list: list, *,
+             cost_weight: float = 0.0) -> EvalResult:
+    """Evaluate one population (decoded values dicts, candidate 0 =
+    the pairing baseline) in ONE window dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ensemble
+    from ..ensemble import stats as estats
+
+    c, s = cell.n_candidates, cell.n_sims
+    if len(values_list) != c:
+        raise ValueError(
+            f"population size {len(values_list)} != cell's {c}")
+    planes = [cell.space.to_plane(v, cell.profile, cell.cfg)
+              for v in values_list]
+    plane = ensemble.stack_planes(planes)                      # [C]
+    plane_rows = jax.tree_util.tree_map(
+        lambda x: jnp.repeat(x, s, axis=0), plane)             # [C*S]
+
+    run = cell.runner.run(cell.build_states(), cell.make_args,
+                          consts=(plane_rows,))
+    core = run.states.core
+    delivery = np.asarray(estats.sim_delivery_ratios(
+        core.dlv.first_round, core.msgs.birth, core.msgs.topic,
+        core.msgs.origin, cell.net.subscribed, born_in=cell.born,
+        receivers=~cell.is_sybil)).reshape(c, s)
+    lat_counts = np.asarray(estats.latency_cdf_counts(
+        core.dlv.first_round, core.msgs.birth, core.msgs.topic,
+        core.msgs.origin, cell.net.subscribed, MAX_LAT,
+        born_in=cell.born)).reshape(c, s, MAX_LAT + 1)
+    delivered = lat_counts.sum(axis=-1)
+    mean_lat = (lat_counts * np.arange(MAX_LAT + 1)).sum(axis=-1) \
+        / np.maximum(delivered, 1)
+
+    rep = run.invariant_report
+    ok = (rep.ok.all(axis=(0, 2)).reshape(c, s).all(axis=1)
+          if rep is not None and rep.n_checks else np.ones(c, bool))
+
+    delivery_lift = delivery - delivery[:1]
+    latency_lift = (mean_lat[:1] - mean_lat) / float(MAX_LAT)
+    fitness = np.where(
+        ok,
+        delivery_lift.mean(axis=1) + LAT_WEIGHT * latency_lift.mean(axis=1),
+        -np.inf)
+    costs = [cell.candidate_cost(v) for v in values_list]
+    cost_rel = np.array([
+        ct["hbm_bytes_per_round"] / max(costs[0]["hbm_bytes_per_round"],
+                                        1e-9)
+        for ct in costs])
+    return EvalResult(
+        delivery=delivery, mean_latency=mean_lat,
+        delivery_lift=delivery_lift, latency_lift=latency_lift,
+        ok=np.asarray(ok, bool), fitness=fitness,
+        score=rank_scores(fitness, cost_rel, cost_weight),
+        cost_rel=cost_rel, costs=costs,
+        compiles=run.compiles, dispatches=run.dispatches,
+        seconds=run.seconds)

@@ -1,0 +1,375 @@
+"""The declarative search space over the protocol's tunable surface.
+
+Every knob the search moves is a field the liftability audit proves
+VALUE-only (LIFT_AUDIT.json): the seven mesh degree knobs ride the
+round-20 :class:`score.params.MeshParams` plane, the score weights /
+decays / caps and the five v1.1 thresholds ride the round-16
+:class:`score.params.ScoreParams` plane — so a whole candidate
+population shares ONE compiled program.
+
+Legality by construction: the box constraints do not sample the config
+fields directly (independent boxes over D/Dlo/Dhi/Dscore/Dout cannot
+express ``Dlo <= D <= Dhi``, ``Dscore <= D``, ``Dout < Dlo``,
+``Dout <= D//2``), they sample a REPARAMETERIZATION whose image is
+inside the accepted region of ``config.py``'s validators:
+
+* ``Dlo`` is a box; ``D = Dlo + D_extra``; ``Dhi = D + Dhi_extra``
+  (extras are non-negative boxes) — the degree chain holds.
+* ``Dscore = round(Dscore_frac * D)`` with the fraction in [0, 1] —
+  inside ``[0, D]``.
+* ``Dout = round(Dout_frac * min(Dlo - 1, D // 2))`` — strictly below
+  ``Dlo`` and at most ``D // 2`` (``Dlo >= 2`` keeps the bound >= 0).
+* thresholds chain downward: ``gossip <= 0`` is a box,
+  ``publish = gossip - publish_extra``, ``graylist = publish -
+  graylist_extra`` with non-negative extras.
+* weight boxes carry the validators' sign conventions (P2 >= 0,
+  P3/P3b/P4/P7 <= 0), decays live strictly inside (0, 1).
+
+``decode`` is still only *claimed* legal — :meth:`SearchSpace.
+materialize` routes every candidate through the real
+``GossipSubParams.validate()`` / ``PeerScoreParams.validate()`` /
+``PeerScoreThresholds.validate()``, and :func:`check_space` (the
+``make analyze`` tune leg, scripts/tune_check.py) proves the claim by
+materializing every box corner plus a seeded random sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+
+import numpy as np
+
+from ..config import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+
+# ---------------------------------------------------------------------------
+# knobs
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One searched dimension: a closed box ``[lo, hi]`` in decoded
+    units (``integer`` rounds to the nearest int). The normalized
+    genome the ES moves lives in ``[0, 1]^dim``; knob ``i`` decodes as
+    ``lo + x_i * (hi - lo)``."""
+
+    name: str
+    lo: float
+    hi: float
+    integer: bool = False
+
+    def decode(self, x: float):
+        v = self.lo + float(np.clip(x, 0.0, 1.0)) * (self.hi - self.lo)
+        return int(round(v)) if self.integer else float(v)
+
+    def encode(self, v) -> float:
+        if self.hi == self.lo:
+            return 0.0
+        return float(np.clip((float(v) - self.lo) / (self.hi - self.lo),
+                             0.0, 1.0))
+
+
+#: the default searched surface. Reparameterized names (``D_extra``,
+#: ``Dscore_frac``, ``publish_extra``, ...) are decoded by
+#: :meth:`SearchSpace.decode` into the real config fields; plain names
+#: map one-to-one.
+DEFAULT_KNOBS = (
+    # --- mesh degrees (MeshParams plane) ---
+    Knob("Dlo", 2, 6, integer=True),
+    Knob("D_extra", 0, 4, integer=True),        # D = Dlo + D_extra
+    Knob("Dhi_extra", 0, 6, integer=True),      # Dhi = D + Dhi_extra
+    Knob("Dscore_frac", 0.0, 1.0),              # Dscore = round(f * D)
+    Knob("Dout_frac", 0.0, 1.0),  # Dout = round(f * min(Dlo-1, D//2))
+    Knob("Dlazy", 0, 12, integer=True),
+    Knob("gossip_factor", 0.0, 1.0),
+    # --- P2: first message deliveries (ScoreParams w2/decay2/cap2) ---
+    Knob("first_message_deliveries_weight", 0.0, 2.0),
+    Knob("first_message_deliveries_decay", 0.5, 0.99),
+    Knob("first_message_deliveries_cap", 10.0, 200.0),
+    # --- P3: mesh delivery deficit (w3/decay3/cap3/thr3) ---
+    Knob("mesh_message_deliveries_weight", -4.0, 0.0),
+    Knob("mesh_message_deliveries_decay", 0.5, 0.99),
+    Knob("mesh_message_deliveries_cap", 5.0, 50.0),
+    Knob("mesh_message_deliveries_threshold", 0.1, 5.0),
+    # --- P3b: sticky mesh failure penalty (w3b/decay3b) ---
+    Knob("mesh_failure_penalty_weight", -4.0, 0.0),
+    Knob("mesh_failure_penalty_decay", 0.5, 0.99),
+    # --- P4: invalid messages (w4/decay4) ---
+    Knob("invalid_message_deliveries_weight", -4.0, 0.0),
+    Knob("invalid_message_deliveries_decay", 0.1, 0.9),
+    # --- P7: behaviour penalty ---
+    Knob("behaviour_penalty_weight", -20.0, 0.0),
+    Knob("behaviour_penalty_decay", 0.5, 0.99),
+    # --- v1.1 thresholds, chained downward ---
+    Knob("gossip_threshold", -8.0, 0.0),
+    Knob("publish_extra", 0.0, 8.0),    # publish = gossip - extra
+    Knob("graylist_extra", 0.0, 8.0),   # graylist = publish - extra
+    Knob("accept_px_threshold", 0.0, 20.0),
+    Knob("opportunistic_graft_threshold", 0.0, 5.0),
+)
+
+
+#: decoded-value names produced by the degree reparameterization
+_DERIVED = ("D", "Dhi", "Dscore", "Dout", "publish_threshold",
+            "graylist_threshold")
+
+
+@dataclasses.dataclass
+class Profile:
+    """The static half of a candidate: everything the search does NOT
+    move — topology-independent base params, the score profile whose
+    un-searched fields candidates inherit, and the build switches.
+    The profile's own values ARE candidate 0 (the defaults baseline
+    every fitness delta is paired against)."""
+
+    params: GossipSubParams
+    tp: TopicScoreParams
+    sp: PeerScoreParams
+    thresholds: PeerScoreThresholds
+    score_enabled: bool = True
+
+
+class SearchSpace:
+    """The knob tuple + the decode/encode/materialize machinery."""
+
+    def __init__(self, knobs=DEFAULT_KNOBS):
+        self.knobs = tuple(knobs)
+        names = [k.name for k in self.knobs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate knob names: {names}")
+        self._index = {k.name: i for i, k in enumerate(self.knobs)}
+
+    @property
+    def dim(self) -> int:
+        return len(self.knobs)
+
+    def fingerprint(self) -> str:
+        """Stable hash of the knob definitions — ES checkpoints refuse
+        to resume across a changed space."""
+        payload = [(k.name, k.lo, k.hi, k.integer) for k in self.knobs]
+        return hashlib.sha256(
+            json.dumps(payload).encode()).hexdigest()[:16]
+
+    # -- genome <-> decoded values ------------------------------------
+
+    def sample(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        """[n, dim] uniform genomes (the ES seeds its own gaussians;
+        this is the cold-start / random-search face)."""
+        return rng.random((n, self.dim))
+
+    def decode(self, x) -> dict:
+        """Genome -> decoded candidate values: every knob's box value
+        plus the derived config fields the reparameterization fixes."""
+        x = np.asarray(x, float)
+        if x.shape != (self.dim,):
+            raise ValueError(f"genome shape {x.shape} != ({self.dim},)")
+        v = {k.name: k.decode(x[i]) for i, k in enumerate(self.knobs)}
+        v["D"] = v["Dlo"] + v.pop("D_extra")
+        v["Dhi"] = v["D"] + v.pop("Dhi_extra")
+        v["Dscore"] = int(round(v.pop("Dscore_frac") * v["D"]))
+        dout_max = min(v["Dlo"] - 1, v["D"] // 2)
+        v["Dout"] = int(round(v.pop("Dout_frac") * max(dout_max, 0)))
+        v["publish_threshold"] = v["gossip_threshold"] - v.pop(
+            "publish_extra")
+        v["graylist_threshold"] = v["publish_threshold"] - v.pop(
+            "graylist_extra")
+        return v
+
+    def encode(self, values: dict) -> np.ndarray:
+        """Decoded config values -> genome (the inverse map; clips to
+        the boxes). Round-trips exactly on in-box values:
+        ``decode(encode(v))`` reproduces every config field — the
+        defaults-as-candidate-0 assertion depends on it."""
+        v = dict(values)
+        v["D_extra"] = v["D"] - v["Dlo"]
+        v["Dhi_extra"] = v["Dhi"] - v["D"]
+        v["Dscore_frac"] = v["Dscore"] / v["D"] if v["D"] else 0.0
+        dout_max = min(v["Dlo"] - 1, v["D"] // 2)
+        v["Dout_frac"] = (v["Dout"] / dout_max) if dout_max > 0 else 0.0
+        v["publish_extra"] = v["gossip_threshold"] - v["publish_threshold"]
+        v["graylist_extra"] = (v["publish_threshold"]
+                               - v["graylist_threshold"])
+        return np.array([k.encode(v[k.name]) for k in self.knobs], float)
+
+    def base_values(self, profile: Profile) -> dict:
+        """The profile's own knob values — candidate 0's decoded dict
+        (read from the same structs ``materialize`` writes into)."""
+        p, tp, sp, th = (profile.params, profile.tp, profile.sp,
+                         profile.thresholds)
+        out = {}
+        for k in self.knobs:
+            name = k.name
+            if name in ("D_extra", "Dhi_extra", "Dscore_frac",
+                        "Dout_frac", "publish_extra", "graylist_extra"):
+                continue
+            for src in (p, tp, sp, th):
+                if hasattr(src, name):
+                    out[name] = getattr(src, name)
+                    break
+            else:
+                raise KeyError(f"knob {name!r} matches no profile field")
+        for name in _DERIVED:
+            for src in (p, th):
+                if hasattr(src, name):
+                    out[name] = getattr(src, name)
+        return out
+
+    # -- candidate -> validated config structs ------------------------
+
+    def materialize(self, values: dict, profile: Profile):
+        """Decoded values -> ``(GossipSubParams, TopicScoreParams,
+        PeerScoreParams, PeerScoreThresholds)``, all passed through the
+        REAL config validators — the legality claim is enforced here,
+        not assumed. Raises ``config.ConfigError`` on an illegal
+        candidate (the doctored-space negative tests hit this)."""
+        pick = lambda src, names: {n: values[n] for n in names  # noqa: E731
+                                   if n in values and hasattr(src, n)}
+        params = dataclasses.replace(profile.params, **pick(
+            profile.params,
+            ("D", "Dlo", "Dhi", "Dscore", "Dout", "Dlazy",
+             "gossip_factor")))
+        tp = dataclasses.replace(profile.tp, **pick(profile.tp, (
+            "first_message_deliveries_weight",
+            "first_message_deliveries_decay",
+            "first_message_deliveries_cap",
+            "mesh_message_deliveries_weight",
+            "mesh_message_deliveries_decay",
+            "mesh_message_deliveries_cap",
+            "mesh_message_deliveries_threshold",
+            "mesh_failure_penalty_weight",
+            "mesh_failure_penalty_decay",
+            "invalid_message_deliveries_weight",
+            "invalid_message_deliveries_decay",
+            "topic_weight",
+        )))
+        topics = dict(profile.sp.topics)
+        topics[0] = tp
+        sp = dataclasses.replace(profile.sp, topics=topics,
+                                 **pick(profile.sp, (
+                 "behaviour_penalty_weight",
+                 "behaviour_penalty_decay",
+                 "topic_score_cap",
+                 )))
+        th = dataclasses.replace(profile.thresholds, **pick(
+            profile.thresholds, (
+                "gossip_threshold", "publish_threshold",
+                "graylist_threshold", "accept_px_threshold",
+                "opportunistic_graft_threshold",
+            )))
+        params.validate()
+        sp.validate()     # validates tp through topics={0: tp}
+        th.validate()
+        return params, tp, sp, th
+
+    def to_plane(self, values: dict, profile: Profile, base_cfg,
+                 n_topics: int = 1):
+        """Decoded values -> the traced :class:`score.params.
+        CandidateParams` plane a lifted step consumes. Built from the
+        candidate's own VALIDATED config (via the same
+        ``GossipSubConfig.build`` arithmetic the static path uses), so
+        matched values reproduce a static build of that candidate bit
+        for bit."""
+        from ..models.gossipsub import GossipSubConfig
+        from ..score.params import CandidateParams
+
+        params, _tp, sp, th = self.materialize(values, profile)
+        cfg = GossipSubConfig.build(
+            params, th, score_enabled=profile.score_enabled,
+            heartbeat_every=base_cfg.heartbeat_every,
+            chaos=base_cfg.chaos)
+        return CandidateParams.from_config(
+            cfg, sp, n_topics=n_topics,
+            heartbeat_interval=params.heartbeat_interval)
+
+    # -- invariant envelope -------------------------------------------
+
+    def degree_envelope(self) -> dict:
+        """The widest degree bounds any in-space candidate can reach:
+        ``Dlo`` at its box minimum, ``Dhi``/``Dout`` at their derived
+        maxima — the invariant checker's config must be AT LEAST this
+        wide or legal candidates would trip ``mesh-degree-bounds``."""
+        lo = {k.name: k.lo for k in self.knobs}
+        hi = {k.name: k.hi for k in self.knobs}
+        d_max = int(hi["Dlo"] + hi["D_extra"])
+        return {
+            "Dlo": int(lo["Dlo"]),
+            "Dhi": int(d_max + hi["Dhi_extra"]),
+            "Dout": int(min(hi["Dlo"] - 1, d_max // 2)),
+        }
+
+    def envelope_config(self, cfg):
+        """``cfg`` with the degree bounds widened to the space envelope
+        — feed this to ``oracle.ScanInvariants`` so the folded checks
+        gate PROTOCOL violations, not in-space degree diversity."""
+        env = self.degree_envelope()
+        return dataclasses.replace(
+            cfg, Dlo=min(cfg.Dlo, env["Dlo"]),
+            Dhi=max(cfg.Dhi, env["Dhi"]),
+            Dout=max(cfg.Dout, env["Dout"]))
+
+
+def default_space() -> SearchSpace:
+    return SearchSpace(DEFAULT_KNOBS)
+
+
+# ---------------------------------------------------------------------------
+# the analyze-leg proof: every box point materializes legally
+
+
+def _corner_genomes(space: SearchSpace) -> np.ndarray:
+    """All-lo / all-hi / mid, plus each knob pinned to its lo and hi
+    with the others mid — the box extremes where a bad reparameter-
+    ization breaks first (2*dim + 3 genomes, not 2^dim)."""
+    mid = np.full(space.dim, 0.5)
+    rows = [np.zeros(space.dim), np.ones(space.dim), mid]
+    for i in range(space.dim):
+        for v in (0.0, 1.0):
+            g = mid.copy()
+            g[i] = v
+            rows.append(g)
+    return np.stack(rows)
+
+
+def check_space(space: SearchSpace, profile: Profile, *,
+                n_random: int = 64, seed: int = 0) -> list:
+    """Prove the space's legality-by-construction claim against the
+    REAL validators: materialize every box corner plus ``n_random``
+    seeded uniform genomes; return the failure messages (empty =
+    proven). A doctored space (a box reaching outside ``config.py``'s
+    accepted region) fails here — the tune leg's negative test."""
+    from ..config import ConfigError
+
+    genomes = [_corner_genomes(space)]
+    if n_random:
+        genomes.append(space.sample(np.random.default_rng(seed),
+                                    n_random))
+    failures = []
+    for x in np.concatenate(genomes):
+        try:
+            values = space.decode(x)
+            space.materialize(values, profile)
+        except (ConfigError, ValueError, KeyError) as e:
+            failures.append(
+                f"genome {np.round(x, 3).tolist()} decodes ILLEGAL: {e}")
+            if len(failures) >= 8:
+                failures.append("... (further failures suppressed)")
+                break
+    # the round-trip half of the claim: candidate 0 IS the defaults
+    base = space.base_values(profile)
+    rt = space.decode(space.encode(base))
+    for name, want in base.items():
+        got = rt[name]
+        same = (got == want if isinstance(want, int)
+                else math.isclose(float(got), float(want),
+                                  rel_tol=1e-9, abs_tol=1e-9))
+        if not same:
+            failures.append(
+                f"defaults round-trip drift: {name} {want!r} -> {got!r}")
+    return failures

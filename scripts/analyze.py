@@ -45,6 +45,7 @@ _SUBPROCESS_PASSES = (
     ("lift", "lift_audit.py", ("LIFT_AUDIT.json",)),
     ("hlo", "hlo_audit.py", ()),
     ("cost", "cost_audit.py", ("COST_AUDIT.json",)),
+    ("tune", "tune_check.py", ()),
 )
 
 

@@ -32,7 +32,10 @@ def main(repo: str | None = None) -> int:
     """``repo`` overrides the artifact root (the doctored-artifact
     negative tests point it at a tmp copy; default: this checkout)."""
     from go_libp2p_pubsub_tpu.analysis import lift
-    from go_libp2p_pubsub_tpu.score.params import LIFTED_FIELD_NAMES
+    from go_libp2p_pubsub_tpu.score.params import (
+        LIFTED_FIELD_NAMES,
+        MESH_LIFTED_FIELD_NAMES,
+    )
 
     repo = repo or REPO
     failures: list[str] = []
@@ -47,6 +50,17 @@ def main(repo: str | None = None) -> int:
             "plane manifest drift: analysis/lift.py SCORE_PLANE_FIELDS "
             f"vs score/params.py LIFTED_FIELD_NAMES — only in pass: "
             f"{sorted(got - want)}; only in plane: {sorted(want - got)}"
+        )
+
+    want_m = set(MESH_LIFTED_FIELD_NAMES)
+    got_m = set(lift.MESH_PLANE_FIELDS)
+    if want_m != got_m:
+        failures.append(
+            "mesh plane manifest drift: analysis/lift.py "
+            "MESH_PLANE_FIELDS vs score/params.py "
+            f"MESH_LIFTED_FIELD_NAMES — only in pass: "
+            f"{sorted(got_m - want_m)}; only in plane: "
+            f"{sorted(want_m - got_m)}"
         )
 
     path = lift.audit_path(repo)
@@ -95,6 +109,7 @@ def main(repo: str | None = None) -> int:
         "artifact": action,
         **payload["summary"],
         "lifted_fields": len(lift.SCORE_PLANE_FIELDS),
+        "mesh_fields": len(lift.MESH_PLANE_FIELDS),
     }
     if failures:
         for f in failures:

@@ -1,0 +1,57 @@
+"""The ``make analyze`` tune leg: the search space's legality proof.
+
+Asserts that every box constraint in ``tune/space.py`` decodes inside
+``config.py``'s accepted region — the legality-by-construction claim
+the evaluation loop relies on (an illegal candidate would abort a
+generation mid-search). :func:`tune.space.check_space` materializes
+every box corner (each knob pinned to lo/hi with the others mid, plus
+the all-lo / all-hi / mid genomes) and a seeded uniform sweep through
+the REAL validators (``GossipSubParams.validate()`` /
+``PeerScoreParams.validate()`` / ``PeerScoreThresholds.validate()``),
+and proves the defaults-as-candidate-0 round-trip.
+
+Pure host-side config arithmetic — no jax import, no device, <1 s.
+The doctored-space negative test (tests/test_tune.py) calls
+check_space with an out-of-region box and asserts it fails.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_RANDOM = 64
+
+
+def main(argv=None) -> int:
+    from go_libp2p_pubsub_tpu.tune.fitness import sybil_profile
+    from go_libp2p_pubsub_tpu.tune.space import (
+        _corner_genomes,
+        check_space,
+        default_space,
+    )
+
+    space = default_space()
+    profile = sybil_profile()
+    failures = check_space(space, profile, n_random=N_RANDOM, seed=0)
+
+    summary = {
+        "tune_check": "FAIL" if failures else "PASS",
+        "knobs": space.dim,
+        "space": space.fingerprint(),
+        "corners": int(_corner_genomes(space).shape[0]),
+        "random_points": N_RANDOM,
+    }
+    if failures:
+        for f in failures:
+            print(f"tune-check FAIL: {f}", file=sys.stderr)
+    print(json.dumps(summary))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,267 @@
+"""``make tune-smoke`` — the ensemble parameter-search gate (round 20).
+
+A 2-generation, 8-candidate × 4-sim micro-search on the sybil-flood
+cell, CPU-pinned, asserting the tune/ subsystem's acceptance claims:
+
+  * **one compile per search** — generation 1's window compiles
+    exactly once; every later generation re-dispatches the SAME
+    program with a new candidate plane (compiles == 0 warm);
+  * **one dispatch per generation** — the whole C*S-row,
+    all-rounds, invariant-checked window is a single XLA dispatch;
+  * **defaults are candidate 0** — the profile's own values decode/
+    encode round-trip exactly and run as the pairing baseline in
+    every generation;
+  * **the invariant gate is live** — the negative check evaluates an
+    IN-SPACE wide-mesh candidate under a deliberately TIGHT envelope
+    (the base config's own degree bounds) and must disqualify it
+    while the defaults row passes;
+  * **every candidate row carries fingerprint["cost"]** priced by the
+    static auditor plus the degree-scaled wire model;
+  * **byte-identical reproduction** — the committed ``TUNE_SMOKE.json``
+    must equal this run's record byte for byte (the LIFT_AUDIT /
+    MEM_AUDIT pattern); ``TUNE_SMOKE_UPDATE=1`` rewrites it.
+
+The gate pins the THREEFRY PRNG (not unsafe_rbg): the paired-lift
+claim needs batched rows with equal sim keys to draw identical
+streams, which is exactly threefry's elementwise vmap batching
+(ensemble/batch.py's bit-exactness contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACT = "TUNE_SMOKE.json"
+
+#: smoke shape: small enough for `make quick`, big enough that the
+#: attack window and the score machinery are genuinely exercised
+SMOKE_GENERATIONS = 2
+SMOKE_CANDIDATES = 8
+SMOKE_SIMS = 4
+NEG_N = 48
+NEG_ROUNDS = 24
+
+
+def run_search(seed: int = 0, generations: int = SMOKE_GENERATIONS,
+               n_candidates: int = SMOKE_CANDIDATES,
+               n_sims: int = SMOKE_SIMS, cost_weight: float = 0.0,
+               checkpoint: str | None = None, resume: bool = False):
+    from go_libp2p_pubsub_tpu import tune
+
+    space = tune.default_space()
+    cell = tune.make_cell(space, n_candidates=n_candidates,
+                          n_sims=n_sims, seed=seed)
+    rec = tune.search(
+        cell, generations=generations,
+        escfg=tune.ESConfig(n_candidates=n_candidates, mu=3, seed=seed),
+        cost_weight=cost_weight, checkpoint_path=checkpoint,
+        resume=resume)
+    return space, cell, rec
+
+
+def run_negative(space, seed: int = 0) -> dict:
+    """The seeded-violation check: a lossless, adversary-free cell
+    whose invariant checker keeps the BASE config's tight degree
+    bounds, evaluated on {defaults, in-space wide mesh}. The wide
+    candidate grafts past Dhi+overshoot and must be disqualified; the
+    defaults row must stay clean."""
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import tune
+
+    cell = tune.make_cell(space, n_candidates=2, n_sims=2, n=NEG_N,
+                          rounds=NEG_ROUNDS, seed=seed, adversary=False,
+                          loss=0.0, envelope="tight")
+    wide = dict(cell.base_values)
+    # the space's widest mesh: legal by construction, far outside the
+    # base profile's Dhi=4 (+ Dout + opportunistic overshoot) bound
+    wide.update(D=10, Dlo=6, Dhi=16, Dscore=5, Dout=5, Dlazy=12)
+    res = tune.evaluate(cell, [cell.base_values, wide])
+    return {
+        "n": NEG_N,
+        "rounds": NEG_ROUNDS,
+        "envelope": "tight",
+        "wide_candidate": {k: wide[k]
+                           for k in ("D", "Dlo", "Dhi", "Dscore",
+                                     "Dout", "Dlazy")},
+        "ok": [bool(v) for v in res.ok],
+        "disqualified": int((~res.ok).sum()),
+        "defaults_ok": bool(res.ok[0]),
+        "compiles": res.compiles,
+        "dispatches": res.dispatches,
+        "fitness": [None if not np.isfinite(v) else round(float(v), 6)
+                    for v in res.fitness],
+    }
+
+
+def build_record(seed: int = 0) -> dict:
+    from go_libp2p_pubsub_tpu import tune
+
+    space, cell, rec = run_search(seed=seed)
+    base = cell.base_values
+    roundtrip = space.decode(space.encode(base))
+    defaults_ok = all(
+        roundtrip[k] == base[k] if isinstance(base[k], int)
+        else abs(float(roundtrip[k]) - float(base[k])) < 1e-9
+        for k in base)
+    env = space.degree_envelope()
+    rec["defaults_candidate0"] = bool(defaults_ok)
+    rec["space_check_failures"] = len(
+        tune.check_space(space, cell.profile, n_random=32, seed=seed))
+    rec["envelope"] = env
+    rec["negative_check"] = run_negative(space, seed=seed)
+    best_gen = rec["generations"][-1]
+    rec["paired_lift_best"] = next(
+        r["delivery_lift"] for r in best_gen["candidates"]
+        if r["candidate"] == best_gen["best_candidate"])
+    return rec
+
+
+def check_record(rec: dict) -> list:
+    failures = []
+    gens = rec["generations"]
+    if len(gens) != SMOKE_GENERATIONS:
+        failures.append(f"expected {SMOKE_GENERATIONS} generations, "
+                        f"got {len(gens)}")
+    for g in gens:
+        want = (-1, 1) if g["generation"] == 0 else (-1, 0)
+        if g["compiles"] not in want:
+            failures.append(
+                f"generation {g['generation']} ran {g['compiles']} "
+                f"compiles (expected {want[1]} — one compile per "
+                "search, zero warm recompiles)")
+        if g["dispatches"] != 1:
+            failures.append(
+                f"generation {g['generation']} executed as "
+                f"{g['dispatches']} dispatches (expected ONE window)")
+        for row in g["candidates"]:
+            cost = row.get("fingerprint", {}).get("cost", {})
+            if not cost.get("recorded"):
+                failures.append(
+                    f"generation {g['generation']} candidate "
+                    f"{row['candidate']} carries no audited "
+                    "fingerprint['cost']")
+                break
+    if not rec.get("defaults_candidate0"):
+        failures.append(
+            "defaults-as-candidate-0 round-trip failed: "
+            "space.decode(space.encode(base)) != base")
+    if rec.get("space_check_failures"):
+        failures.append(
+            f"{rec['space_check_failures']} space-legality failures "
+            "(every box point must materialize through the real "
+            "validators)")
+    neg = rec.get("negative_check", {})
+    if neg.get("ok") != [True, False]:
+        failures.append(
+            "negative check: expected the tight-envelope gate to pass "
+            "the defaults and disqualify the wide-mesh candidate, got "
+            f"ok={neg.get('ok')}")
+    if neg.get("compiles") not in (-1, 1):
+        failures.append(
+            f"negative check ran {neg.get('compiles')} compiles "
+            "(expected 1 — its own window, invariants folded)")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the acceptance gates + the committed "
+                         "artifact; exit 1 on failure")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--generations", type=int, default=SMOKE_GENERATIONS)
+    ap.add_argument("--candidates", type=int, default=SMOKE_CANDIDATES)
+    ap.add_argument("--sims", type=int, default=SMOKE_SIMS)
+    ap.add_argument("--cost-weight", type=float, default=0.0,
+                    help="lift traded per relative hbm byte/round "
+                         "(fitness.rank_scores)")
+    ap.add_argument("--checkpoint", type=str, default=None,
+                    help="rolling ES-state checkpoint path")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from --checkpoint if present")
+    args = ap.parse_args(argv)
+
+    # CPU + threefry by contract (see module docstring), warm compiles
+    # served from the persistent cache like every smoke gate
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    enable_persistent_cache(os.path.join(repo_root(), ".jax_cache"))
+
+    if not args.smoke:
+        # report mode: run the requested search and print the record
+        _space, _cell, rec = run_search(
+            seed=args.seed, generations=args.generations,
+            n_candidates=args.candidates, n_sims=args.sims,
+            cost_weight=args.cost_weight, checkpoint=args.checkpoint,
+            resume=args.resume)
+        print(json.dumps(rec))
+        return 0
+
+    rec = build_record(seed=args.seed)
+    failures = check_record(rec)
+
+    path = os.path.join(repo_root(), ARTIFACT)
+    text = json.dumps(rec, indent=1, sort_keys=True) + "\n"
+    update = bool(os.environ.get("TUNE_SMOKE_UPDATE"))
+    if update:
+        with open(path, "w") as f:
+            f.write(text)
+        action = "updated"
+    elif not os.path.exists(path):
+        failures.append(
+            f"{ARTIFACT} missing — run TUNE_SMOKE_UPDATE=1 "
+            "scripts/tune_report.py --smoke to record it")
+        action = "missing"
+    else:
+        with open(path) as f:
+            committed = f.read()
+        action = "verified" if committed == text else "stale"
+        if committed != text:
+            try:
+                from go_libp2p_pubsub_tpu.analysis.costmodel import (
+                    baseline_divergences,
+                )
+
+                diverged = baseline_divergences(
+                    json.loads(committed), json.loads(text))
+                detail = (" — diverging keys: " + "; ".join(diverged[:8])
+                          if diverged else
+                          " — artifacts parse equal: formatting-only "
+                          "drift (re-serialize with TUNE_SMOKE_UPDATE=1)")
+            except (json.JSONDecodeError, ValueError):
+                detail = " — committed artifact is not parseable JSON"
+            failures.append(
+                f"{ARTIFACT} does not reproduce byte-identical — the "
+                "search record changed; review the diff and "
+                "TUNE_SMOKE_UPDATE=1 to re-record" + detail)
+
+    summary = {
+        "tune_smoke": "FAIL" if failures else "PASS",
+        "artifact": action,
+        "generations": len(rec["generations"]),
+        "candidates": rec["cell"]["n_candidates"],
+        "sims": rec["cell"]["n_sims"],
+        "compiles": [g["compiles"] for g in rec["generations"]],
+        "disqualified_negative": rec["negative_check"]["disqualified"],
+        "best_score": rec["best"]["score"],
+    }
+    if failures:
+        for f in failures:
+            print(f"tune-smoke FAIL: {f}", file=sys.stderr)
+    print(json.dumps(summary))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,23 @@ def test_gossipsub_invalid(kw):
         dataclasses.replace(GossipSubParams(), **kw).validate()
 
 
+@pytest.mark.parametrize(
+    "kw,fragment",
+    [
+        # the degree rejections must carry the ACTUAL values — tune/
+        # candidates that trip a validator surface a debuggable message
+        ({"D": 20}, "Dlo=5 D=20 Dhi=12"),
+        ({"Dlo": 7}, "Dlo=7 D=6 Dhi=12"),
+        ({"Dscore": 9}, "Dscore=9 D=6"),
+        ({"Dout": 5}, "Dout=5 Dlo=5 D=6"),
+    ],
+)
+def test_degree_errors_carry_values(kw, fragment):
+    with pytest.raises(ConfigError) as e:
+        dataclasses.replace(GossipSubParams(), **kw).validate()
+    assert fragment in str(e.value)
+
+
 def test_topic_score_defaults_valid():
     TopicScoreParams().validate()
 

@@ -1,0 +1,421 @@
+"""tune/ subsystem tests (round 20, docs/DESIGN.md §20).
+
+Four claims pinned here, mirroring the tune-smoke gates at unit scale:
+
+  * masked-width selection is BIT-EXACT with the static kernels at
+    matched widths, from the ops level up through the gossipsub and
+    phase engines fed a matched-values CandidateParams plane;
+  * one compiled program serves a heterogeneous 16-candidate
+    CandidateParams plane stack, and every stacked row equals its
+    single-sim run (the configs×sims pairing contract);
+  * the ES checkpoint resumes BIT-IDENTICALLY (and refuses a changed
+    space), with no simulator in the loop;
+  * the space's legality-by-construction claim is falsifiable: a
+    doctored box fails check_space, and --cost-weight measurably
+    reorders the ranking.
+"""
+
+import dataclasses as dc
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.checkpoint import is_prng_key
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub_phase import (
+    make_gossipsub_phase_step,
+)
+from go_libp2p_pubsub_tpu.ops import select
+from go_libp2p_pubsub_tpu.tune import (
+    ESConfig,
+    default_space,
+    es_ask,
+    es_init,
+    es_tell,
+    load_es_state,
+    rank_scores,
+    save_es_state,
+    sybil_profile,
+)
+from go_libp2p_pubsub_tpu.tune.space import Knob, SearchSpace, check_space
+
+N, M, K_D = 48, 32, 8
+
+
+def build_net():
+    return graph.ring_lattice(N, d=K_D)
+
+
+def build_cell_statics(heartbeat_every=1):
+    """(net, cfg, sp, space, profile): the tune profile's static half,
+    on a small lattice — parity runs at the SAME values the search's
+    candidate 0 decodes to."""
+    from go_libp2p_pubsub_tpu.state import Net
+
+    profile = sybil_profile()
+    space = default_space()
+    net = Net.build(build_net(), graph.subscribe_all(N, 1))
+    cfg = GossipSubConfig.build(
+        profile.params, profile.thresholds, score_enabled=True,
+        heartbeat_every=heartbeat_every)
+    return net, cfg, profile.sp, space, profile
+
+
+def assert_trees_equal(a, b, context=""):
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = {jax.tree_util.keystr(p): leaf
+          for p, leaf in jax.tree_util.tree_flatten_with_path(b)[0]}
+    assert len(la) == len(lb), f"{context}: leaf count differs"
+    for p, x in la:
+        k = jax.tree_util.keystr(p)
+        y = lb[k]
+        if is_prng_key(x):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"{context}: leaf {k}")
+
+
+def trees_differ(a, b) -> bool:
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        if is_prng_key(x):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            return True
+    return False
+
+
+def pub(i, r=None, width=4):
+    po = np.full((width,), -1, np.int32)
+    po[0] = i % N
+    args = [po, np.zeros((width,), np.int32), np.ones((width,), bool)]
+    if r:
+        args = [np.broadcast_to(a, (r,) + a.shape).copy() for a in args]
+    return tuple(jnp.asarray(a) for a in args)
+
+
+# ---------------------------------------------------------------------------
+# masked-width kernels: bit-exact vs the static selection at matched k
+
+
+@pytest.mark.parametrize("k", [0, 1, 3, 8])
+def test_masked_width_topk_matches_static(k):
+    rng = np.random.default_rng(7)
+    values = jnp.asarray(rng.normal(size=(12, K_D)), jnp.float32)
+    mask = jnp.asarray(rng.random((12, K_D)) < 0.7)
+    key = jax.random.PRNGKey(k)
+    static = select.select_topk_mask(values, mask, k, key)
+    traced = select.masked_width_topk(
+        values, mask, jnp.int32(k), K_D, key)
+    np.testing.assert_array_equal(np.asarray(static), np.asarray(traced))
+
+
+@pytest.mark.parametrize("k", [0, 2, 8])
+def test_masked_width_random_matches_static(k):
+    rng = np.random.default_rng(11)
+    mask = jnp.asarray(rng.random((12, K_D)) < 0.7)
+    key = jax.random.PRNGKey(k + 100)
+    static = select.select_random_mask(key, mask, k)
+    traced = select.masked_width_random(key, mask, jnp.int32(k), K_D)
+    np.testing.assert_array_equal(np.asarray(static), np.asarray(traced))
+
+
+def test_masked_width_clips_to_ceiling():
+    # a width past the static ceiling behaves as width_max, never as a
+    # shape change
+    rng = np.random.default_rng(3)
+    values = jnp.asarray(rng.normal(size=(6, K_D)), jnp.float32)
+    mask = jnp.ones((6, K_D), bool)
+    at_max = select.masked_width_topk(values, mask, jnp.int32(K_D), K_D)
+    over = select.masked_width_topk(
+        values, mask, jnp.int32(K_D + 40), K_D)
+    np.testing.assert_array_equal(np.asarray(at_max), np.asarray(over))
+
+
+# ---------------------------------------------------------------------------
+# engine parity: a matched-values CandidateParams plane reproduces the
+# static build bit for bit (candidate 0's pairing claim)
+
+
+def test_gossipsub_candidate_plane_parity():
+    net, cfg, sp, space, profile = build_cell_statics()
+    plane = space.to_plane(space.base_values(profile), profile, cfg)
+    st_s = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    st_l = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    step_s = make_gossipsub_step(cfg, net, score_params=sp)
+    step_l = make_gossipsub_step(cfg, net, score_params=sp,
+                                 lift_scores=True)
+    for i in range(12):
+        st_s = step_s(st_s, *pub(i))
+        st_l = step_l(st_l, *pub(i), plane)
+    assert_trees_equal(st_s, st_l, "gossipsub candidate-plane parity")
+
+
+@pytest.mark.parametrize(
+    "r", [1, pytest.param(8, marks=pytest.mark.slow)])
+def test_phase_candidate_plane_parity(r):
+    net, cfg, sp, space, profile = build_cell_statics(
+        heartbeat_every=max(r, 1))
+    plane = space.to_plane(space.base_values(profile), profile, cfg)
+    st_s = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    st_l = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    ph_s = make_gossipsub_phase_step(cfg, net, r, score_params=sp)
+    ph_l = make_gossipsub_phase_step(cfg, net, r, score_params=sp,
+                                     lift_scores=True)
+    for i in range(3):
+        st_s = ph_s(st_s, *pub(i, r), do_heartbeat=True)
+        st_l = ph_l(st_l, *pub(i, r), plane, do_heartbeat=True)
+    assert_trees_equal(st_s, st_l, f"phase r={r} candidate-plane parity")
+
+
+def test_mesh_plane_values_actually_steer():
+    # the parity above must not pass because the mesh half is ignored:
+    # a wide-mesh candidate on the SAME compiled program must change
+    # the trajectory, without recompiling
+    net, cfg, sp, space, profile = build_cell_statics()
+    base = space.base_values(profile)
+    wide = dict(base)
+    wide.update(D=10, Dlo=6, Dhi=16, Dscore=5, Dout=5, Dlazy=12)
+    plane_a = space.to_plane(base, profile, cfg)
+    plane_b = space.to_plane(wide, profile, cfg)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               lift_scores=True)
+    st_a = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    st_b = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    for i in range(10):
+        st_a = step(st_a, *pub(i), plane_a)
+        st_b = step(st_b, *pub(i), plane_b)
+    assert step._cache_size() == 1, (
+        "a mesh-degree change recompiled the lifted step")
+    assert trees_differ(st_a, st_b), (
+        "wide-mesh candidate left the trajectory unchanged — the mesh "
+        "plane is being ignored")
+
+
+# ---------------------------------------------------------------------------
+# configs×sims: 16 heterogeneous candidates, one program, row parity
+
+
+def test_sixteen_candidate_stack_one_compile():
+    from go_libp2p_pubsub_tpu.ensemble import batch as ebatch
+
+    net, cfg, sp, space, profile = build_cell_statics()
+    c = 16
+    genomes = space.sample(np.random.default_rng(0), c - 1)
+    values = [space.base_values(profile)] + [
+        space.decode(g) for g in genomes]
+    plane_list = [space.to_plane(v, profile, cfg) for v in values]
+    planes = ebatch.stack_planes(plane_list)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               lift_scores=True)
+    base = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    base_key = base.core.key
+    states = ebatch.batch_states(base, c)
+    ens = ebatch.lift_step(step)
+    rounds = 4
+    for i in range(rounds):
+        args = tuple(ebatch.tile(a, c) for a in pub(i))
+        states = ens(states, *args, planes)
+    assert ens._cache_size() == 1, (
+        "16 heterogeneous mesh+score candidates did not share one "
+        "compiled program")
+    # stacked row idx == the single-sim run with plane idx (threefry
+    # vmaps bit-exactly — the paired-fitness contract)
+    for idx in (0, 9):
+        st = ebatch.with_sim_key(
+            GossipSubState.init(net, M, cfg, score_params=sp, seed=0),
+            base_key, idx)
+        for i in range(rounds):
+            st = step(st, *pub(i), plane_list[idx])
+        assert_trees_equal(ebatch.unbatch(states, idx), st,
+                           f"candidate-stack row {idx}")
+
+
+# ---------------------------------------------------------------------------
+# ES driver: bit-identical checkpoint/resume, no simulator needed
+
+
+def _fake_scores(genomes: np.ndarray) -> np.ndarray:
+    # deterministic, genome-only fitness: a bowl with its optimum off
+    # the defaults so the mean actually moves
+    return -np.sum((genomes - 0.3) ** 2, axis=1)
+
+
+def _drive(es, space, escfg, base, gens):
+    for _ in range(gens):
+        x = es_ask(es, space, escfg, base)
+        vals = [space.decode(g) for g in x]
+        es_tell(es, escfg, x, _fake_scores(x), vals)
+
+
+def test_es_checkpoint_resume_bit_identical(tmp_path):
+    space = default_space()
+    profile = sybil_profile()
+    base = space.encode(space.base_values(profile))
+    escfg = ESConfig(n_candidates=6, mu=2, seed=3)
+    path = str(tmp_path / "es.json")
+
+    es_a = es_init(space, escfg, base)
+    _drive(es_a, space, escfg, base, 4)
+
+    es_b = es_init(space, escfg, base)
+    _drive(es_b, space, escfg, base, 2)
+    save_es_state(path, es_b, space, escfg)
+    es_c, escfg_c = load_es_state(path, space)
+    assert escfg_c == escfg
+    _drive(es_c, space, escfg, base, 2)
+
+    np.testing.assert_array_equal(es_a.mean, es_c.mean)
+    assert es_a.sigma == es_c.sigma
+    assert es_a.generation == es_c.generation == 4
+    assert es_a.best_score == es_c.best_score
+    assert es_a.best_generation == es_c.best_generation
+    assert (es_a.rng.bit_generator.state
+            == es_c.rng.bit_generator.state), (
+        "resumed PRNG stream diverged from the straight-through run")
+    # and the NEXT generation's population is identical too
+    np.testing.assert_array_equal(
+        es_ask(es_a, space, escfg, base),
+        es_ask(es_c, space, escfg, base))
+
+
+def test_es_checkpoint_refuses_changed_space(tmp_path):
+    space = default_space()
+    profile = sybil_profile()
+    base = space.encode(space.base_values(profile))
+    escfg = ESConfig(n_candidates=4, mu=1, seed=0)
+    path = str(tmp_path / "es.json")
+    save_es_state(path, es_init(space, escfg, base), space, escfg)
+    doctored = SearchSpace(
+        tuple(space.knobs[:-1])
+        + (Knob("opportunistic_graft_threshold", 0.0, 9.0),))
+    with pytest.raises(ValueError, match="different search space"):
+        load_es_state(path, doctored)
+
+
+def test_es_defaults_always_candidate_zero():
+    space = default_space()
+    profile = sybil_profile()
+    base = space.encode(space.base_values(profile))
+    escfg = ESConfig(n_candidates=5, mu=2, seed=1)
+    es = es_init(space, escfg, base)
+    for _ in range(3):
+        x = es_ask(es, space, escfg, base)
+        assert x.shape == (5, space.dim)
+        np.testing.assert_array_equal(x[0], base)
+        es_tell(es, escfg, x, _fake_scores(x),
+                [space.decode(g) for g in x])
+
+
+# ---------------------------------------------------------------------------
+# cost pricing: --cost-weight measurably reorders the ranking (pinned)
+
+
+def test_cost_weight_reorders_ranking():
+    # candidate 0: better lift, 2x the relative wire bytes;
+    # candidate 1: smaller lift at baseline cost
+    fitness = np.array([0.10, 0.08])
+    cost_rel = np.array([2.0, 1.0])
+    free = rank_scores(fitness, cost_rel, 0.0)
+    assert np.argmax(free) == 0
+    np.testing.assert_allclose(free, fitness)
+    priced = rank_scores(fitness, cost_rel, 0.05)
+    assert np.argmax(priced) == 1, (
+        "cost_weight=0.05 must flip the ranking: 0.10 - 0.05*(2-1) "
+        "< 0.08")
+    np.testing.assert_allclose(priced, [0.05, 0.08])
+
+
+def test_cost_weight_keeps_disqualified_at_neg_inf():
+    scores = rank_scores(np.array([-np.inf, 0.1]),
+                         np.array([0.5, 1.0]), 0.2)
+    assert scores[0] == -np.inf
+    assert np.isfinite(scores[1])
+
+
+# ---------------------------------------------------------------------------
+# space legality: the claim holds for the default space, and a
+# doctored box is caught (the falsifiability half)
+
+
+def test_default_space_proves_legal():
+    assert check_space(default_space(), sybil_profile(),
+                       n_random=8, seed=0) == []
+
+
+def _doctored(name, lo, hi):
+    space = default_space()
+    knobs = tuple(
+        Knob(name, lo, hi, integer=k.integer) if k.name == name else k
+        for k in space.knobs)
+    return SearchSpace(knobs)
+
+
+@pytest.mark.parametrize(
+    "name,lo,hi",
+    [
+        ("gossip_factor", 0.0, 1.5),                  # > 1 rejected
+        ("mesh_message_deliveries_weight", -4.0, 0.5),  # must be <= 0
+        ("first_message_deliveries_decay", 0.5, 1.2),   # decay < 1
+    ],
+)
+def test_doctored_space_fails_check(name, lo, hi):
+    failures = check_space(_doctored(name, lo, hi), sybil_profile(),
+                           n_random=0, seed=0)
+    assert failures, (
+        f"a {name} box of [{lo}, {hi}] reaches outside config.py's "
+        "accepted region but check_space did not flag it")
+    assert any("ILLEGAL" in f for f in failures)
+
+
+def test_defaults_round_trip_exact():
+    space = default_space()
+    profile = sybil_profile()
+    base = space.base_values(profile)
+    rt = space.decode(space.encode(base))
+    assert set(rt) == set(base)
+    for name, want in base.items():
+        got = rt[name]
+        if isinstance(want, int):
+            assert got == want, f"{name}: {want} -> {got}"
+        else:
+            assert math.isclose(float(got), float(want),
+                                rel_tol=1e-9, abs_tol=1e-9), (
+                f"{name}: {want} -> {got}")
+
+
+def test_degree_envelope_covers_space():
+    space = default_space()
+    env = space.degree_envelope()
+    assert env == {"Dlo": 2, "Dhi": 16, "Dout": 5}
+    _net, cfg, _sp, _space, _profile = build_cell_statics()
+    widened = space.envelope_config(cfg)
+    assert widened.Dlo == min(cfg.Dlo, env["Dlo"])
+    assert widened.Dhi == max(cfg.Dhi, env["Dhi"])
+    assert widened.Dout == max(cfg.Dout, env["Dout"])
+    # every in-space candidate's mesh fits inside the envelope bounds
+    for g in space.sample(np.random.default_rng(5), 32):
+        v = space.decode(g)
+        assert env["Dlo"] <= v["Dlo"]
+        assert v["Dhi"] <= env["Dhi"]
+        assert v["Dout"] <= env["Dout"]
+
+
+def test_fingerprint_tracks_knob_edits():
+    space = default_space()
+    assert space.fingerprint() == default_space().fingerprint()
+    assert (space.fingerprint()
+            != _doctored("gossip_factor", 0.0, 1.5).fingerprint())
+
+
+def test_space_rejects_duplicate_knobs():
+    with pytest.raises(ValueError, match="duplicate"):
+        SearchSpace((Knob("Dlazy", 0, 12, integer=True),) * 2)
