@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static tune-smoke tune-check
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static tune-smoke tune-check fuse-smoke
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -199,6 +199,20 @@ hlo-audit:
 cost-audit:
 	python scripts/cost_audit.py
 
+# fused-plane gate (scripts/fuse_smoke.py; docs/DESIGN.md §21): the
+# bench gossipsub step on the CSR edge plane fused-off vs fused-on —
+# the fused-off compiled kernel census must EQUAL the on-image
+# baseline (flipping the flag off recovers the pre-round-21 program
+# exactly), the fused-on thunk delta must stay under the committed
+# FUSE_SMOKE.json pin (the sort-composite's constant overhead; growth
+# = lost fusion), the committed COST_AUDIT.json fusion contract's
+# >= 20% hbm_bytes/round drop is re-asserted next to the census, one
+# compile across the fused run window, and warm fused-vs-unfused
+# delivery-rounds/s recorded. FUSE_SMOKE_UPDATE=1 rewrites. ~30 s
+# warm on CPU.
+fuse-smoke:
+	python scripts/fuse_smoke.py
+
 # ensemble parameter-search gate (scripts/tune_report.py; docs/
 # DESIGN.md §20): a 2-generation, 8-candidate x 4-sim micro-search on
 # the sybil-flood cell — one compile in generation 1 and ZERO warm
@@ -278,6 +292,7 @@ quick:
 	python scripts/memstat.py
 	python scripts/scale_smoke.py
 	python scripts/topo_smoke.py
+	python scripts/fuse_smoke.py
 	python scripts/service_smoke.py --smoke
 
 native:

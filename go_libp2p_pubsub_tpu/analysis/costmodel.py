@@ -100,6 +100,21 @@ POWERLAW_SEED = 0
 TELEMETRY_FLOP_SHARE_CEILING = 0.05
 ORACLE_FLOP_SHARE_CEILING = 0.25
 
+#: fusion contract (round 21): the fused csr build must price at most
+#: this fraction of the unfused build's hbm bytes/round — at_hi AND
+#: slope (the acceptance floor is a 20% cut; measured ~0.6). The phase
+#: row's delivery is dense-commit (its csr traffic rides edge_gather),
+#: so only the shared heartbeat fuses there: FALLING, no fixed cut.
+FUSED_HBM_RATIO_CEILING = 0.8
+PHASE_FUSED_HBM_RATIO_CEILING = 1.0
+
+#: hbm-ceilings contract (round 21): every build row commits
+#: ceiling = measured hbm_bytes/round at_hi × (1 + margin) into
+#: COST_AUDIT.json; a later audit whose fresh at_hi exceeds the
+#: COMMITTED ceiling trips the gate — a named cost regression, not
+#: just a byte-identity diff
+HBM_CEILING_MARGIN = 0.05
+
 #: tolerance of the halo-density equality (the ratio is exact shape
 #: arithmetic; the epsilon only absorbs float division)
 HALO_DENSITY_TOL = 1e-9
@@ -110,9 +125,14 @@ METRICS = ("flops", "hbm_bytes", "halo_bytes", "rng_bits",
            "gather_bytes", "scatter_bytes", "collective_bytes")
 
 #: every engine×layout build the audit prices (the guards/hloaudit
-#: registry plus the scanned window)
+#: registry plus the scanned window). Round 21: the csr/phase_csr rows
+#: price the FUSED builds (sort-composite selection + capacity-bounded
+#: segmented scan — the shipping configuration); the *_unfused rows
+#: keep the legacy pairwise/log2(E) pricing live so the fusion
+#: contract has a same-trace denominator.
 AUDIT_BUILDS = ("gossipsub", "gossipsub_phase", "floodsub", "randomsub",
-                "csr", "phase_csr", "lifted", "window")
+                "csr", "phase_csr", "csr_unfused", "phase_csr_unfused",
+                "lifted", "window")
 
 
 class CostContractViolation(Exception):
@@ -394,11 +414,12 @@ class BuildCell:
 def build_cell(name: str, n: int) -> BuildCell:
     from ..perf.sweep import build_bench
 
-    if name in ("gossipsub", "csr", "lifted"):
-        layout = "csr" if name == "csr" else None
+    if name in ("gossipsub", "csr", "csr_unfused", "lifted"):
+        layout = "csr" if name.startswith("csr") else None
         st, step, _, _ = build_bench(
             n, AUDIT_M, heartbeat_every=1, rounds_per_phase=1,
-            edge_layout=layout, lift_scores=(name == "lifted"))
+            edge_layout=layout, lift_scores=(name == "lifted"),
+            fused=(name == "csr"))
         raw = getattr(step, "__wrapped__", step)
         args = _pub_args((PUB_WIDTH,), n)
         if name == "lifted":
@@ -407,10 +428,11 @@ def build_cell(name: str, n: int) -> BuildCell:
             plane, _ = lifted_plane_pair()
             return BuildCell(name, lambda s: raw(s, *args, plane), st, 1, 1)
         return BuildCell(name, lambda s: raw(s, *args), st, 1, 1)
-    if name in ("gossipsub_phase", "phase_csr"):
+    if name in ("gossipsub_phase", "phase_csr", "phase_csr_unfused"):
         st, step, _, _ = build_bench(
             n, AUDIT_M, heartbeat_every=PHASE_R, rounds_per_phase=PHASE_R,
-            edge_layout=("csr" if name == "phase_csr" else None))
+            edge_layout=("csr" if name.startswith("phase_csr") else None),
+            fused=(name == "phase_csr"))
         raw = getattr(step, "__wrapped__", step)
         args = _pub_args((PHASE_R, PUB_WIDTH), n)
         return BuildCell(
@@ -567,6 +589,58 @@ def check_oracle_flops(step_flops: float, checker_flops: float, *,
             f"(> static ceiling {ceiling}) — the oracle plane stopped "
             "being a cheap observer")
     return share
+
+
+def check_fused_hbm(build: str, fused: dict, unfused: dict, *,
+                    ceiling: float = FUSED_HBM_RATIO_CEILING) -> dict:
+    """The fused build's hbm_bytes/round must price at most ``ceiling``
+    × the unfused build's — on the at_hi point AND the N-slope (both
+    fit rows are ``per_round['hbm_bytes']``). The fused path exists to
+    move fewer bytes; a composite that stops cutting traffic is a
+    regression even while staying bit-exact."""
+    out = {}
+    for field in ("at_hi", "slope"):
+        f, u = fused["hbm_bytes"][field], unfused["hbm_bytes"][field]
+        if u <= 0:
+            raise CostContractViolation(
+                build, "fused-hbm",
+                f"unfused hbm_bytes {field} is {u} — broken cell")
+        ratio = f / u
+        if ratio > ceiling or ratio >= 1.0:
+            raise CostContractViolation(
+                build, "fused-hbm",
+                f"fused/unfused hbm_bytes {field} ratio {ratio:.4f} "
+                f"(ceiling {ceiling}) — the fused build stopped "
+                "cutting traffic")
+        out[field] = ratio
+    return out
+
+
+def hbm_ceilings(builds: dict, *,
+                 margin: float = HBM_CEILING_MARGIN) -> dict:
+    """Per-build hbm_bytes/round ceilings from this audit's measured
+    at_hi points — the numbers COMMITTED into COST_AUDIT.json that
+    ``check_hbm_ceilings`` gates later runs against."""
+    return {name: row["per_round"]["hbm_bytes"]["at_hi"] * (1 + margin)
+            for name, row in builds.items()}
+
+
+def check_hbm_ceilings(committed: dict, builds: dict) -> None:
+    """Every fresh build row's hbm_bytes/round at_hi must stay under
+    the COMMITTED ceiling — the cost-regression gate of ``make
+    cost-audit`` (byte-identity says "something moved"; this says
+    "the byte budget REGRESSED, in this build, past the margin")."""
+    for name, row in builds.items():
+        if name not in committed:
+            continue  # a new build has no committed budget yet
+        fresh = row["per_round"]["hbm_bytes"]["at_hi"]
+        if fresh > committed[name]:
+            raise CostContractViolation(
+                name, "hbm-ceiling",
+                f"hbm_bytes/round at N_HI is {fresh:.6g}, over the "
+                f"committed ceiling {committed[name]:.6g} — the device "
+                "program grew its byte budget (review, then "
+                "COST_UPDATE=1 to re-commit)")
 
 
 # ---------------------------------------------------------------------------
@@ -741,6 +815,27 @@ def build_audit() -> dict:
     contracts["oracle_flops"] = {
         "step_flops": step_flops, "checker_flops": checker_flops,
         "share": oshare, "ceiling": ORACLE_FLOP_SHARE_CEILING,
+        "pass": True,
+    }
+
+    fusion = {}
+    for fused_name, ceil in (("csr", FUSED_HBM_RATIO_CEILING),
+                             ("phase_csr", PHASE_FUSED_HBM_RATIO_CEILING)):
+        f_rows = builds[fused_name]["per_round"]
+        u_rows = builds[f"{fused_name}_unfused"]["per_round"]
+        ratios = check_fused_hbm(fused_name, f_rows, u_rows, ceiling=ceil)
+        fusion[fused_name] = {
+            "fused_hbm_at_hi": f_rows["hbm_bytes"]["at_hi"],
+            "unfused_hbm_at_hi": u_rows["hbm_bytes"]["at_hi"],
+            "ratio_at_hi": ratios["at_hi"],
+            "ratio_slope": ratios["slope"],
+            "ceiling": ceil,
+        }
+    contracts["fusion"] = {**fusion, "pass": True}
+
+    contracts["hbm_ceilings"] = {
+        "margin": HBM_CEILING_MARGIN,
+        "ceilings": hbm_ceilings(builds),
         "pass": True,
     }
 

@@ -125,6 +125,22 @@ PHASE_CSR_BASE = "gossipsub_phase"
 LIFTED_ENGINE = "lifted"
 LIFTED_BASE = "gossipsub"
 
+#: the fused-plane paths (round 21, docs/DESIGN.md §21). ``csr_fused``
+#: is the csr row rebuilt with ``fused=True`` — the sort-composite
+#: selection and capacity-bounded segmented scan under the full guard
+#: set (fusion is a pure recomposition: schema must stay the csr
+#: variant of the committed ``gossipsub`` rows). ``lifted_fused`` is
+#: the lifted row rebuilt with ``fused=True`` AND the PUBSUB_FUSED
+#: dense Pallas data plane armed: the former ``float(threshold)``
+#: SHAPE seam excluded lifted builds from that kernel — now the
+#: thresholds ride the traced ``thr`` param, so the alternating-plane
+#: one-compile sentinel runs THROUGH the fused kernel (the A/B
+#: acceptance invariant of the seam close).
+CSR_FUSED_ENGINE = "csr_fused"
+CSR_FUSED_BASE = "gossipsub"
+LIFTED_FUSED_ENGINE = "lifted_fused"
+LIFTED_FUSED_BASE = "gossipsub"
+
 #: StableHLO markers proving the state argument is donated
 _DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
@@ -326,6 +342,55 @@ def build_lifted_harness() -> EngineHarness:
             plane_a if i % 2 == 0 else plane_b,)
 
     return EngineHarness(LIFTED_ENGINE, step, st, make_args, {})
+
+
+def build_csr_fused_harness() -> EngineHarness:
+    """The fused sparse-plane path (round 21): the csr harness rebuilt
+    with ``fused=True`` on both the Net and the config — the
+    sort-composite top-k/random selection and the capacity-bounded
+    segmented scan replace the pairwise/log2(E) forms inside the same
+    step, bit-exact, under the full guard set."""
+    from ..perf.sweep import build_bench
+
+    st, step, _, _ = build_bench(
+        GUARD_N, GUARD_M, heartbeat_every=1, rounds_per_phase=1,
+        edge_layout="csr", fused=True,
+    )
+    return EngineHarness(
+        CSR_FUSED_ENGINE, step, st,
+        lambda i: _pub_args((PUB_WIDTH,), i), {},
+    )
+
+
+def build_lifted_fused_harness() -> EngineHarness:
+    """The lifted+fused path (round 21): ``lift_scores=True`` AND
+    ``fused=True`` AND the PUBSUB_FUSED dense Pallas delivery kernel
+    armed (env read at factory time — set around the build, restored
+    after). Before round 21 the kernel's ``float(threshold)`` calls
+    forced SHAPE on the lifted plane, so this build fell back to the
+    XLA path; the thresholds now ride the traced ``thr`` param and the
+    alternating-plane A/B run exercises the kernel itself."""
+    from ..perf.sweep import build_bench
+
+    old = os.environ.get("PUBSUB_FUSED")
+    os.environ["PUBSUB_FUSED"] = "1"
+    try:
+        st, step, _, _ = build_bench(
+            GUARD_N, GUARD_M, heartbeat_every=1, rounds_per_phase=1,
+            lift_scores=True, fused=True,
+        )
+    finally:
+        if old is None:
+            os.environ.pop("PUBSUB_FUSED", None)
+        else:
+            os.environ["PUBSUB_FUSED"] = old
+    plane_a, plane_b = lifted_plane_pair()
+
+    def make_args(i):
+        return _pub_args((PUB_WIDTH,), i) + (
+            plane_a if i % 2 == 0 else plane_b,)
+
+    return EngineHarness(LIFTED_FUSED_ENGINE, step, st, make_args, {})
 
 
 def check_schema_equal(h: EngineHarness, out_tree, base_rows: list | None,
@@ -781,6 +846,39 @@ def run_lifted_engine(base_rows: list | None) -> list:
     return rows
 
 
+def run_csr_fused_engine(base_rows: list | None) -> list:
+    """All guards for the fused csr row (round 21): the schema must
+    stay the csr variant of the committed ``gossipsub`` rows — fusion
+    recomposes the selection/scan programs and must not touch the
+    state tree — plus donation and the one-compile/transfer-guard
+    run over the fused step."""
+    h = build_csr_fused_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_csr(h, out_tree, base_rows)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
+def run_lifted_fused_engine(base_rows: list | None) -> list:
+    """All guards for the lifted+fused row (round 21): schema equal to
+    the committed ``gossipsub`` rows (neither the score plane nor the
+    fused kernel may leak into state), donation, and the alternating
+    A/B plane run under transfer_guard with the one-compile sentinel —
+    run THROUGH the PUBSUB_FUSED Pallas delivery kernel, pinning the
+    ``float(threshold)`` seam closed (a recompile here means a
+    threshold re-entered the program as a Python scalar)."""
+    h = build_lifted_fused_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_equal(
+        h, out_tree, base_rows, LIFTED_FUSED_BASE,
+        "the lifted plane or the fused kernel leaked into the state tree",
+    )
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
 def run_telemetry_engine(base_rows: list | None) -> list:
     """All guards for the telemetry-on path: strict-dtype trace, the
     telem-leaf pin + base-row comparison, buffer-donation audit, and
@@ -823,6 +921,9 @@ DERIVED_ROWS = (
     GuardRow(CSR_ENGINE, "run_csr_engine", CSR_BASE),
     GuardRow(PHASE_CSR_ENGINE, "run_phase_csr_engine", PHASE_CSR_BASE),
     GuardRow(LIFTED_ENGINE, "run_lifted_engine", LIFTED_BASE),
+    GuardRow(CSR_FUSED_ENGINE, "run_csr_fused_engine", CSR_FUSED_BASE),
+    GuardRow(LIFTED_FUSED_ENGINE, "run_lifted_fused_engine",
+             LIFTED_FUSED_BASE),
 )
 
 #: all row names, for reporting (scripts/analyze.py)

@@ -35,6 +35,13 @@ from ..trace.events import EV
 # a future libtpu). The XLA path stays the production default.
 USE_PALLAS = os.environ.get("PUBSUB_PALLAS", "") == "1"
 
+# opt-in fused Pallas kernels for the flat-[E] CSR plane (round 21,
+# ops/pallas_csr.py — exact parity with the fused composite,
+# tests/test_pallas_csr.py). Same Mosaic caveat and interpret-mode
+# gating as PUBSUB_PALLAS; requires a `fused=True` Net (the composite
+# and the kernel share the capacity-bounded scan contract).
+USE_PALLAS_CSR = os.environ.get("PUBSUB_PALLAS_CSR", "") == "1"
+
 
 def _pallas_block() -> int:
     return int(os.environ.get("PUBSUB_PALLAS_BLOCK", "2000"))
@@ -228,6 +235,14 @@ def delivery_round(
         # delivery semantics stay single-source and dense-vs-CSR
         # parity is bit-exact (tests/test_csr.py, all four engines).
         flat_resident = dlv.fe_words.ndim == 2
+        if (flat_resident and net.fused and USE_PALLAS_CSR
+                and val_delay == 0 and queue_cap == 0):
+            got = _delivery_round_pallas_csr(
+                net, msgs, dlv, edge_mask, not_mine, tick,
+                forward_mask=forward_mask, count_events=count_events,
+            )
+            if got is not None:
+                return got
         fwd_e = net.peer_gather_flat(dlv.fwd)                    # [E, W]
         echo_e = net.edge_gather_flat(
             dlv.fe_words if flat_resident
@@ -396,7 +411,12 @@ def finish_delivery_flat(
         trans_e = bitset.keep_lowest_bits(want, queue_cap, m)
         n_drop = bitset.popcount(want & ~trans_e, axis=None).sum().astype(jnp.int32)
 
-    inc, exc = csr.segment_or_scan(trans_e, net.csr_seg_start)
+    # fused build (round 21): the capacity bound K caps every row
+    # segment, so the scan runs ceil(log2 K) shifted levels instead of
+    # log2(E) — the dominant delivery-chain term the cost audit's
+    # fusion contract pins. Bit-exact either way.
+    cap = net.max_degree if net.fused else None
+    inc, exc = csr.segment_or_scan(trans_e, net.csr_seg_start, cap=cap)
     recv_words = jnp.where(
         net.csr_row_nonempty[:, None],
         inc[jnp.clip(net.csr_row_last, 0)], jnp.uint32(0),
@@ -507,6 +527,62 @@ def _delivery_round_pallas(net, msgs, dlv, edge_mask, tick, block=None,
         fe_words=bitset.edge_eq_words(fe2, k_slots),
     )
     return dlv2, _round_info(trans, new_words, m, valid_words, count_events)
+
+
+def _pick_div(total: int, lo: int, want: int) -> int | None:
+    """Largest divisor of ``total`` in [lo, want] (static block sizing)."""
+    for b in range(min(want, total), lo - 1, -1):
+        if total % b == 0:
+            return b
+    return None
+
+
+def _delivery_round_pallas_csr(net, msgs, dlv, edge_mask, not_mine, tick,
+                               forward_mask=None, count_events=True):
+    """The CSR-resident round through the fused Pallas kernels
+    (ops/pallas_csr.csr_delivery — the three-call form of the flat
+    gather/scan/commit chain). Bit-identical to the composite path
+    below (tests/test_pallas_csr.py); opt-in via PUBSUB_PALLAS_CSR=1 on
+    a fused Net. Returns None when the static block preconditions don't
+    hold (the caller falls through to the composite)."""
+    from ..ops import edges as _edges
+    from ..ops import pallas_csr as pcsr
+
+    e = net.n_edges
+    cap = net.max_degree
+    want = _pallas_block()
+    block = _pick_div(e, cap, want)
+    block_rows = _pick_div(net.n_peers, 1, want)
+    if (block is None or block_rows is None
+            or not pcsr.pallas_csr_supported(e, block, cap)):
+        return None
+    interpret = os.environ.get("PUBSUB_PALLAS_COMPILE", "") != "1"
+    m = msgs.capacity
+    mask_e = net.pack_edges(edge_mask)
+    valid_words = bitset.pack(msgs.valid)
+    # the kernel's col/eperm gathers ARE the flat peer/edge halo set the
+    # composite path tallies (peer_gather_flat / edge_gather_flat)
+    _edges._tally("peer", dlv.fe_words)
+    _edges._tally("edge", dlv.fe_words)
+    res = pcsr.csr_delivery(
+        dlv.fwd, dlv.fe_words, mask_e, not_mine, dlv.have,
+        dlv.first_round, valid_words[None, :], tick,
+        net.csr_col, net.csr_row, net.csr_eperm, net.csr_seg_start,
+        net.csr_row_last, net.csr_row_nonempty,
+        cap=cap, block=block, block_rows=block_rows, interpret=interpret,
+    )
+    fwd_next = res["fwd"]
+    if forward_mask is not None:
+        fwd_next = fwd_next & forward_mask
+    dlv2 = dlv.replace(
+        have=res["have"], fwd=fwd_next, first_round=res["first_round"],
+        fe_words=res["fe"],
+    )
+    new_words = res["new"]
+    info = _round_info(res["trans_e"], new_words, m, valid_words,
+                       count_events)
+    info = info.replace(recv_new_words=new_words)
+    return dlv2, info
 
 
 def accumulate_round_events(events: jax.Array, info: RoundInfo, n_publish) -> jax.Array:

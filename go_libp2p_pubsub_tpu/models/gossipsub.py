@@ -183,6 +183,17 @@ class GossipSubConfig:
     # build traces exactly ONE layout, zero runtime branching; the Net
     # must be built with the same value (prepare_step_consts enforces).
     edge_layout: str = "dense"
+    # fused composite kernels (round 21, docs/DESIGN.md §21): statically
+    # select the bandwidth-lean forms on the hot path — the sort-form
+    # selection (ops/select fused=True: O(K) bytes/row instead of the
+    # pairwise form's O(K^2) compare planes) in the heartbeat, fanout
+    # and gossip-target blocks, and the capacity-bounded segmented OR in
+    # the CSR delivery commit (via the matching Net.build(fused=True)).
+    # A frozen static like edge_layout: False traces the pre-fusion
+    # program bit for bit (the census gate's contract); True is
+    # bit-exact in VALUES (tests/test_pallas_csr.py, all four engines)
+    # and is what `make cost-audit`'s fusion contract prices.
+    fused: bool = False
     # int-packed control counters (round 15 narrowing contract, docs/
     # DESIGN.md §15): store the per-edge IHAVE flood-protection counters
     # (peerhave/iasked) as int16 instead of int32. EXACT by range
@@ -235,6 +246,7 @@ class GossipSubConfig:
         chaos: "ChaosConfig | None" = None,
         edge_layout: str = "dense",
         narrow_counters: bool = False,
+        fused: bool = False,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
@@ -307,6 +319,7 @@ class GossipSubConfig:
             chaos=chaos,
             edge_layout=edge_layout,
             narrow_counters=narrow_counters,
+            fused=fused,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if chaos is not None:
@@ -900,7 +913,8 @@ def update_fanout_on_publish(
     )
     if cfg.score_enabled:
         cand = cand & (st.scores[o] >= thr.publish_threshold)
-    sel = masked_width_random(key, cand, msh.D, net.max_degree)  # [P,K]
+    sel = masked_width_random(key, cand, msh.D, net.max_degree,
+                              fused=cfg.fused)  # [P,K]
 
     # commit: new slots take the fresh selection; matched slots keep
     # theirs. A static fold of P masked selects over the [N, F] planes —
@@ -1156,7 +1170,7 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     ineed = jnp.where(deg < msh.Dlo, msh.D - deg, 0)
     grafts = jax.lax.cond(
         jnp.any(ineed > 0),
-        lambda: masked_width_random(k1, cand, ineed, k_dim),
+        lambda: masked_width_random(k1, cand, ineed, k_dim, fused=cfg.fused),
         lambda: jnp.zeros_like(mesh),
     )
     mesh = mesh | grafts
@@ -1172,15 +1186,19 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         noise = jax.random.uniform(k2, mesh.shape)
         if cfg.score_enabled:
             topscore = masked_width_topk(scores_b, mesh, msh.Dscore, k_dim,
-                                         key=k3)
+                                         key=k3, fused=cfg.fused)
         else:
-            topscore = masked_width_random(k3, mesh, msh.Dscore, k_dim)
+            topscore = masked_width_random(k3, mesh, msh.Dscore, k_dim,
+                                           fused=cfg.fused)
         rest_rand = masked_width_topk(noise, mesh & ~topscore,
-                                      msh.D - msh.Dscore, k_dim)
+                                      msh.D - msh.Dscore, k_dim,
+                                      fused=cfg.fused)
         keep = topscore | rest_rand
         x_need = jnp.maximum(msh.Dout - count_true(keep & outb), 0)
-        bring = select_topk_mask(noise, mesh & outb & ~keep, x_need)
-        drop = select_topk_mask(-noise, keep & ~outb & ~topscore, count_true(bring))
+        bring = select_topk_mask(noise, mesh & outb & ~keep, x_need,
+                                 fused=cfg.fused)
+        drop = select_topk_mask(-noise, keep & ~outb & ~topscore,
+                                count_true(bring), fused=cfg.fused)
         keep = (keep & ~drop) | bring
         pruned_over = mesh & ~keep & over
         return jnp.where(over, mesh & keep, mesh), pruned_over
@@ -1205,7 +1223,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     )
     grafts2 = jax.lax.cond(
         jnp.any(need_out > 0),
-        lambda: masked_width_random(k4, cand & outb & ~mesh, need_out, k_dim),
+        lambda: masked_width_random(k4, cand & outb & ~mesh, need_out, k_dim,
+                                    fused=cfg.fused),
         lambda: jnp.zeros_like(mesh),
     )
     mesh = mesh | grafts2
@@ -1218,7 +1237,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
             low = (med < thr.opportunistic_graft_threshold) & (count_true(mesh) > 1)
             cand3 = cand & ~mesh & (scores_b > med[:, :, None])
             return select_random_mask(
-                k5, cand3, jnp.where(low, cfg.opportunistic_graft_peers, 0)
+                k5, cand3, jnp.where(low, cfg.opportunistic_graft_peers, 0),
+                fused=cfg.fused,
             )
 
         grafts3 = jax.lax.cond(
@@ -1272,7 +1292,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
             cand_f = cand_f & (scores[:, None, :] >= thr.publish_threshold)
         ineed_f = jnp.where(f_live, msh.D - count_true(fpeers), 0)
         kf1, kf2 = jax.random.split(jax.random.fold_in(key, 11))
-        fpeers = fpeers | masked_width_random(kf1, cand_f, ineed_f, k_dim)
+        fpeers = fpeers | masked_width_random(kf1, cand_f, ineed_f, k_dim,
+                                              fused=cfg.fused)
 
     # ---- emitGossip (gossipsub.go:1669-1723) ----------------------------
     gwin = bitset.word_or_reduce(st.mcache[:, : cfg.history_gossip, :], axis=1)  # [N,W]
@@ -1287,7 +1308,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         (jnp.asarray(msh.gossip_factor, jnp.float32)
          * n_cand.astype(jnp.float32)).astype(jnp.int32),
     )
-    chosen = masked_width_random(k6, gossip_cand, target, k_dim)  # [N,S,K]
+    chosen = masked_width_random(k6, gossip_cand, target, k_dim,
+                                 fused=cfg.fused)  # [N,S,K]
 
     slot_tw = slot_topic_words(net, st.core.msgs.topic)  # [N,S,W]
     adv = jnp.where(
@@ -1312,7 +1334,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
             ),
             0,
         )
-        chosen_f = masked_width_random(kf2, gossip_cand_f, target_f, k_dim)  # [N,F,K]
+        chosen_f = masked_width_random(kf2, gossip_cand_f, target_f, k_dim,
+                                       fused=cfg.fused)  # [N,F,K]
         ftw = fanout_topic_words(ft, st.core.msgs.topic)
         adv_f = jnp.where(
             chosen_f[..., None], (gwin[:, None, :] & ftw)[:, :, None, :], jnp.uint32(0)
@@ -1509,6 +1532,16 @@ def prepare_step_consts(
             f"cfg.edge_layout={cfg.edge_layout!r} but the Net was built "
             f"with edge_layout={net.edge_layout!r} — build both with the "
             "same layout (Net.build(..., edge_layout=...))"
+        )
+    if cfg.fused != net.fused:
+        # same frozen-static contract as edge_layout (round 21): the
+        # fused flag selects one kernel set per build — the config
+        # drives the selection/heartbeat blocks, the net drives the
+        # shared delivery seam, and a mismatch would trace half of each
+        raise ValueError(
+            f"cfg.fused={cfg.fused!r} but the Net was built with "
+            f"fused={net.fused!r} — build both with the same flag "
+            "(Net.build(..., fused=...))"
         )
     if cfg.gater_enabled:
         assert gater_params is not None
@@ -1942,9 +1975,10 @@ def make_gossipsub_step(
     compiled program (the recompile-free A/B sentinel) and a vmapped
     plane axis sweeps weight populations. Matched values reproduce the
     static build bit for bit (tests/test_score_lift.py). Requires
-    ``cfg.score_enabled``; the fused Pallas data plane is excluded
-    (its kernel takes thresholds as host constants — a SHAPE seam the
-    audit pins).
+    ``cfg.score_enabled``. Since round 21 the fused Pallas data
+    plane is eligible too: its kernel takes the thresholds as a traced
+    [1, 2] f32 row, closing the float(threshold) SHAPE seam the audit
+    used to pin.
 
     With ``static_heartbeat=True`` (and ``cfg.heartbeat_every > 1``) the
     step takes a trailing *static* python bool ``do_heartbeat`` instead of
@@ -2041,9 +2075,10 @@ def make_gossipsub_step(
         and not _old_pallas
         and chaos is None  # the fused halo kernel predates the chaos plane
         and adv is None    # ... and the adversary plane
-        # the fused kernel bakes thresholds as host floats — a SHAPE
-        # seam (LIFT_AUDIT.json); lifted builds keep the XLA path
-        and not lift_scores
+        # lifted ScoreParams builds are eligible since round 21: the
+        # kernel takes thresholds as a traced [1, 2] f32 row, so the
+        # former float(threshold) SHAPE seam is closed (the lifted+fused
+        # guards row pins the one-compile A/B sentinel on this path)
     )
     fused_interp = jax.default_backend() != "tpu"
     use_fused = fused_eligible and fused_env == "1"
@@ -2198,7 +2233,7 @@ def make_gossipsub_step(
                 # the receiver-side origin compare, because nbr_score_of_me
                 # at the receiver IS the sender's score of that edge
                 fp_ok = (
-                    (st.scores >= cfg.publish_threshold)
+                    (st.scores >= thr.publish_threshold)
                     if cfg.score_enabled else net_l.nbr_ok
                 )
                 carry = carry | jnp.where(
@@ -2225,8 +2260,8 @@ def make_gossipsub_step(
                 w=w_dim, score_enabled=cfg.score_enabled,
                 want_cohorts=cfg.count_events,
                 retrans_cap=cfg.gossip_retransmission,
-                gossip_thr=float(cfg.gossip_threshold),
-                publish_thr=float(cfg.publish_threshold),
+                gossip_thr=jnp.asarray(thr.gossip_threshold, jnp.float32),
+                publish_thr=jnp.asarray(thr.publish_threshold, jnp.float32),
                 interpret=fused_interp,
             )
             new_words_f = res["new"]

@@ -120,7 +120,9 @@ def make_randomsub_step(net: Net, d: int = RANDOMSUB_D,
 
         # fresh random fanout per sender/slot/round
         key = jax.random.fold_in(st.key, tick)
-        sel = select_random_mask(key, elig_random, target_ns) | always  # [N,S,K]
+        sel = (select_random_mask(key, elig_random, target_ns,
+                                  fused=net.fused)
+               | always)  # [N,S,K]
         sel = jnp.where(i_am_floodsub[:, None, None], eligible, sel)
 
         # sender-side packed outbox, word-gathered by receivers

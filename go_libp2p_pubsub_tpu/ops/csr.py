@@ -343,7 +343,8 @@ def segment_popcount(words_e: jax.Array, row: jax.Array,
     return segment_sum_edges(per_edge, row, n_peers)
 
 
-def segment_or_scan(words_e: jax.Array, seg_start: jax.Array
+def segment_or_scan(words_e: jax.Array, seg_start: jax.Array,
+                    cap: int | None = None
                     ) -> tuple[jax.Array, jax.Array]:
     """Segmented prefix-OR over a flat packed-word plane.
 
@@ -352,16 +353,45 @@ def segment_or_scan(words_e: jax.Array, seg_start: jax.Array
     the same row (zero at row starts), which is exactly the mask the
     first-arrival isolation needs (``x & ~exclusive`` keeps each bit's
     first carrying edge, the flat analogue of
-    ``bitset.first_set_per_bit``). Log-depth associative scan; see the
-    module docstring for when the capacity-bounded gather form wins."""
+    ``bitset.first_set_per_bit``).
+
+    ``cap=None`` (default) runs the log2(E)-depth associative scan.
+    With ``cap`` (the capacity bound K of the edge pool — every row
+    segment has length <= cap by construction, ops/csr.build) the scan
+    runs as ceil(log2(cap)) shifted OR levels instead (the round-21
+    fused composite, ``cfg.fused``): at E=8k/K=16 that is 4 levels vs
+    13, and the cost audit charges each level's [E, W] operand bytes,
+    so the bounded form is the one whose hbm_bytes/round the fusion
+    contract pins. Bit-exact with the unbounded scan for any legal
+    ``cap`` (tests/test_pallas_csr.py) — both realize the same
+    segmented-OR monoid, the bound only truncates provably-masked
+    levels."""
     flags = jnp.asarray(seg_start, bool)
+    if cap is None:
+        def comb(a, b):
+            av, af = a
+            bv, bf = b
+            return jnp.where(bf[..., None], bv, av | bv), af | bf
 
-    def comb(a, b):
-        av, af = a
-        bv, bf = b
-        return jnp.where(bf[..., None], bv, av | bv), af | bf
-
-    inc, _ = jax.lax.associative_scan(comb, (words_e, flags), axis=0)
+        inc, _ = jax.lax.associative_scan(comb, (words_e, flags), axis=0)
+    else:
+        # Hillis-Steele over the segmented monoid: element e folds in
+        # element e-d unless a segment start lies in (e-d, e]. Shift
+        # distances 1, 2, 4, .. cover lookback 2^L - 1 >= cap - 1, which
+        # reaches every element's segment start. Out-of-range positions
+        # contribute (0, started=True) — global edge 0 starts a segment.
+        inc, started = words_e, flags
+        d = 1
+        while d < cap:
+            prev_inc = jnp.concatenate(
+                [jnp.zeros_like(inc[:d]), inc[:-d]], axis=0
+            )
+            prev_started = jnp.concatenate(
+                [jnp.ones((d,), bool), started[:-d]], axis=0
+            )
+            inc = jnp.where(started[:, None], inc, inc | prev_inc)
+            started = started | prev_started
+            d *= 2
     shifted = jnp.concatenate(
         [jnp.zeros_like(inc[:1]), inc[:-1]], axis=0
     )
@@ -371,11 +401,12 @@ def segment_or_scan(words_e: jax.Array, seg_start: jax.Array
 
 def segment_or_words(words_e: jax.Array, seg_start: jax.Array,
                      row_last: jax.Array,
-                     row_nonempty: jax.Array) -> jax.Array:
+                     row_nonempty: jax.Array,
+                     cap: int | None = None) -> jax.Array:
     """[E, W] -> [N, W] per-peer word-OR via the segmented scan (the
     fully-flat form; property-tested equal to unpack +
     ``bitset.word_or_reduce``)."""
-    inc, _ = segment_or_scan(words_e, seg_start)
+    inc, _ = segment_or_scan(words_e, seg_start, cap=cap)
     out = inc[jnp.clip(row_last, 0)]
     return jnp.where(
         jnp.asarray(row_nonempty, bool)[:, None], out, jnp.uint32(0)

@@ -219,13 +219,13 @@ def _delivery_kernel(
     nbrsc,                         # [B, K] f32 (score variant; else absent)
     *rest,
     b, k_dim, w, offsets, revs, score_enabled, want_cohorts,
-    retrans_cap, gossip_thr, publish_thr,
+    retrans_cap,
 ):
     if not score_enabled:
         rest = (nbrsc,) + rest
         nbrsc = None
     (asked, slo, shi, flags, have_ref, origin_ref, joined_ref, valid_ref,
-     *outs) = rest
+     thr_ref, *outs) = rest
     (trans_out, fe_out, slo_out, shi_out, peer_out) = outs[0:5]
     outs = outs[5:]
     if want_cohorts:
@@ -263,7 +263,7 @@ def _delivery_kernel(
 
         if score_enabled:
             s_k = nbrsc[:, k : k + 1]
-            recv_ok = s_k >= jnp.float32(publish_thr)
+            recv_ok = s_k >= thr_ref[0, 1]
         else:
             recv_ok = live
         flood = _gate(_bit(f, F_FLOOD_FROM)) | (
@@ -280,7 +280,7 @@ def _delivery_kernel(
         capped = served_capped_mask(retrans_cap, slo_k, shi_k)
         resp = asked_k & mcw_s & ~capped & live_g
         if score_enabled:
-            resp = resp & _gate(s_k >= jnp.float32(gossip_thr))
+            resp = resp & _gate(s_k >= thr_ref[0, 0])
         sat = shi_k & slo_k
         inc = resp & ~sat
         cy = slo_k & inc
@@ -324,7 +324,7 @@ def _delivery_kernel(
     jax.jit,
     static_argnames=(
         "block", "offsets", "revs", "w", "score_enabled", "want_cohorts",
-        "retrans_cap", "gossip_thr", "publish_thr", "interpret",
+        "retrans_cap", "interpret",
     ),
 )
 def fused_delivery(
@@ -341,8 +341,9 @@ def fused_delivery(
     origin_w,    # [N, W] u32
     joined_w,    # [N, W] u32
     valid_row,   # [1, W] u32
+    gossip_thr=0.0, publish_thr=0.0,
     *, block, offsets, revs, w, score_enabled, want_cohorts,
-    retrans_cap, gossip_thr, publish_thr, interpret=False,
+    retrans_cap, interpret=False,
 ):
     """The full delivery plane of one round. Returns a dict with trans,
     fe, served_lo, served_hi, new, have, fwd (all post-round), plus
@@ -385,9 +386,19 @@ def fused_delivery(
         spec(k_dim, i0),                            # flags
         spec(w, i0), spec(w, i0), spec(w, i0),      # have, origin, joined
         pl.BlockSpec((1, w), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.VMEM),
     ]
+    # thresholds ride as a TRACED [1, 2] f32 row (gossip, publish) —
+    # round 21 closes the float(threshold) SHAPE seam that excluded this
+    # kernel from lifted ScoreParams builds (LIFT_AUDIT round 16): a
+    # lifted plane's traced thresholds now reach the kernel as values,
+    # so one compile serves every weight set here too
+    thr_row = jnp.stack([
+        jnp.asarray(gossip_thr, jnp.float32),
+        jnp.asarray(publish_thr, jnp.float32),
+    ]).reshape(1, 2)
     args += [asked, served_lo, served_hi, flags, have, origin_w, joined_w,
-             valid_row]
+             valid_row, thr_row]
 
     out_specs = [
         spec(kw, i0),   # trans
@@ -415,7 +426,6 @@ def fused_delivery(
             _delivery_kernel, b=b, k_dim=k_dim, w=w, offsets=soff,
             revs=revs, score_enabled=score_enabled,
             want_cohorts=want_cohorts, retrans_cap=retrans_cap,
-            gossip_thr=gossip_thr, publish_thr=publish_thr,
         ),
         grid=(nb,),
         in_specs=in_specs,

@@ -106,7 +106,8 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
                 wire_coalesced: bool | None = None,
                 telemetry=None, count_events: bool | None = None,
                 edge_layout: str | None = None,
-                lift_scores: bool = False):
+                lift_scores: bool = False,
+                fused: bool = False):
     """Build (state, step, n_topics, honest) for a BENCH_CONFIG:
 
     default — GossipSub v1.1, single topic, live scoring (the BASELINE.json
@@ -141,6 +142,13 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
     ``score.params.ScoreParams`` plane — the same workload, with the
     score weights/thresholds as a run-time input (one compile across
     weight sets; bit-exact vs the static build at matched values).
+
+    ``fused=True`` (round 21, docs/DESIGN.md §21) builds the FUSED
+    variant: sort-composite top-k/random selection and the
+    capacity-bounded CSR segmented scan replace the pairwise-rank /
+    log2(E) forms — bit-exact, fewer hbm bytes per round. The flag is
+    threaded to both ``Net.build`` and ``GossipSubConfig.build`` (they
+    must match; prepare_step_consts enforces it).
     """
     import dataclasses as _dc
 
@@ -167,7 +175,7 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         n_topics = 1
         subs = graph.subscribe_all(n_peers, 1)
     layout = bench_edge_layout(edge_layout)
-    net = Net.build(topo, subs, edge_layout=layout)
+    net = Net.build(topo, subs, edge_layout=layout, fused=fused)
 
     params = _dc.replace(GossipSubParams(), flood_publish=False)
     _tp, sp = bench_score_params(config, n_topics)
@@ -182,6 +190,7 @@ def build_bench(n_peers: int, msg_slots: int, seed: int = 0, config: str = "defa
         heartbeat_every=heartbeat_every,
         wire_coalesced=bench_wire_coalesced(wire_coalesced),
         edge_layout=layout,
+        fused=fused,
     )
     # tracer-detached configuration (tracing is opt-in in the reference):
     # no aggregate event counters; no fanout slots when every peer

@@ -86,6 +86,14 @@ class Net:
     csr_identity: bool = struct.field(pytree_node=False, default=False)
     csr_band_off: tuple = struct.field(pytree_node=False, default=None)
     csr_band_rev: tuple = struct.field(pytree_node=False, default=None)
+    # fused data plane (round 21, docs/DESIGN.md §21): statically select
+    # the bandwidth-lean composite kernels on the shared delivery seam —
+    # the capacity-bounded segmented OR in the flat commit
+    # (ops/csr.segment_or_scan cap=K) and, in engines that read it, the
+    # sort-form selection (ops/select fused=True). Pytree-AUX like
+    # edge_layout: one build traces exactly ONE kernel set, False traces
+    # the pre-fusion program bit for bit (the census gate's contract).
+    fused: bool = struct.field(pytree_node=False, default=False)
 
     def edge_gather(self, x: jax.Array) -> jax.Array:
         """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] (the edge involution).
@@ -200,6 +208,7 @@ class Net:
         protocol: np.ndarray | None = None,
         edge_layout: str = "dense",
         edge_shards: int | None = None,
+        fused: bool = False,
     ) -> "Net":
         n = topo.n_peers
         if ip_group is None:
@@ -266,6 +275,7 @@ class Net:
             band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
         return cls(
             edge_layout=edge_layout,
+            fused=bool(fused),
             **csr_kw,
             band_off=band[0] if band else None,
             band_rev=band[1] if band else None,

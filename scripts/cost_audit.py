@@ -68,6 +68,20 @@ def main() -> int:
     else:
         with open(path) as f:
             committed_text = f.read()
+        # cost-REGRESSION gate (round 21): the fresh per-build
+        # hbm_bytes/round must stay under the COMMITTED ceilings —
+        # independent of byte-identity, so a regression is NAMED as a
+        # budget breach, not just a diverging key
+        try:
+            ceilings = (json.loads(committed_text)
+                        .get("contracts", {})
+                        .get("hbm_ceilings", {})
+                        .get("ceilings", {}))
+            cm.check_hbm_ceilings(ceilings, payload["builds"])
+        except json.JSONDecodeError:
+            pass  # the byte-identity leg below reports unparseable JSON
+        except cm.CostContractViolation as e:
+            failures.append(str(e))
         if committed_text == text:
             action = "verified"
         else:

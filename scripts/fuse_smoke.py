@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Fused-plane gate (``make fuse-smoke``; docs/DESIGN.md §21).
+
+Builds the bench gossipsub per-round step on the flat-[E] CSR plane
+twice — ``fused=False`` (the round-14 data plane, unchanged) and
+``fused=True`` (the round-21 fused delivery/selection composites) —
+and asserts the fusion contract end to end:
+
+  1. **fused-off census unchanged** — the compiled-HLO kernel census
+     of the fused-off build must EQUAL the measured-on-this-image
+     baseline (``.jax_cache/CENSUS_ONIMAGE.json``, variant
+     ``csr_fused_off``): flipping the flag off must recover the
+     pre-round-21 compiled program exactly. Strict equality, not a
+     tolerance — same image, same shape, same PRNG impl.
+  2. **fused-on census delta pinned** — on XLA:CPU the fused build
+     trades kernel COUNT for kernel WIDTH: the sort-composite rank
+     adds a constant handful of thunks (sorts don't fuse) while the
+     capacity-bounded scan shrinks the E-length fusion bodies. The
+     gate pins that trade: the fused-minus-unfused thunk delta must
+     not exceed the committed ``census_delta_thunks`` (FUSE_SMOKE.json)
+     — growth means the fused composites stopped fusing.
+  3. **the drop** — the fused build's actual win is HBM traffic, and
+     the static cost audit prices it: the committed COST_AUDIT.json
+     fusion contract's csr ratio_at_hi must stay under
+     FUSED_HBM_RATIO_CEILING (0.8 — i.e. a >= 20% hbm_bytes/round
+     drop). fuse-smoke re-reads the committed artifact so the drop is
+     pinned HERE too, next to the census numbers it explains.
+  4. **one compile** — the fused run's full ROUNDS-round window
+     compiles the step exactly once (cache-size sentinel); warm
+     fused-vs-unfused delivery-rounds/s are recorded (informational —
+     CPU timing of a TPU-shaped trade).
+
+``FUSE_SMOKE_UPDATE=1`` rewrites FUSE_SMOKE.json and reseeds the
+on-image census entries (the PERF_SMOKE / TELEMETRY_SMOKE workflow).
+CPU-only by contract like the other smoke gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+
+import numpy as np  # noqa: E402
+
+BASELINE_NAME = "FUSE_SMOKE.json"
+SMOKE_ROUNDS = 32
+DEFAULT_N = 512
+#: the committed csr fused/unfused hbm ratio must stay under this —
+#: mirrored from analysis/costmodel.FUSED_HBM_RATIO_CEILING so a
+#: stale-artifact edit can't silently relax the drop
+HBM_RATIO_CEILING = 0.8
+TIMING_REPS = 3
+
+
+def _fresh(state):
+    """Donatable copy of a state tree (the jitted step donates its
+    state argument; key leaves need the key_data round-trip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.checkpoint import is_prng_key
+
+    def cp(x):
+        if is_prng_key(x):
+            return jax.random.wrap_key_data(
+                jnp.copy(jax.random.key_data(x)), impl=jax.random.key_impl(x))
+        return jnp.copy(x)
+
+    return jax.tree_util.tree_map(cp, state)
+
+
+def _pub_args(n: int, rounds: int):
+    """One valid publish per round from a rotating origin."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.perf.sweep import PUBS_PER_ROUND
+
+    out = []
+    for i in range(rounds):
+        po = np.full((PUBS_PER_ROUND,), -1, np.int32)
+        po[0] = i % n
+        out.append((jnp.asarray(po),
+                    jnp.asarray(np.zeros((PUBS_PER_ROUND,), np.int32)),
+                    jnp.asarray(np.ones((PUBS_PER_ROUND,), bool))))
+    return out
+
+
+def _build(n: int, fused: bool):
+    """(state, step) — the bench gossipsub per-round step on the CSR
+    edge plane; only the ``fused`` flag differs between the builds."""
+    from go_libp2p_pubsub_tpu.perf.sweep import build_bench
+
+    st, step, _, _ = build_bench(n, 64, heartbeat_every=1,
+                                 rounds_per_phase=1,
+                                 edge_layout="csr", fused=fused)
+    return st, step
+
+
+def _census(step, state, n: int) -> dict:
+    """Compiled-HLO thunk census of the per-round step (r=1), shaped
+    for perf.profile.on_image_census_baseline's key."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.perf.profile import (hlo_kernel_census,
+                                                   require_gate_prng)
+    from go_libp2p_pubsub_tpu.perf.sweep import PUBS_PER_ROUND
+
+    require_gate_prng()
+    po = jnp.asarray(np.full((PUBS_PER_ROUND,), -1, np.int32))
+    pt = jnp.asarray(np.zeros((PUBS_PER_ROUND,), np.int32))
+    pv = jnp.asarray(np.ones((PUBS_PER_ROUND,), bool))
+    census = hlo_kernel_census(
+        step.lower(state, po, pt, pv).compile().as_text())
+    census["n_peers"] = int(n)
+    census["rounds_per_phase"] = 1
+    return census
+
+
+def _timed_window(step, state, args) -> float:
+    import jax
+
+    t0 = time.perf_counter()
+    for a in args:
+        state = step(state, *a)
+    jax.block_until_ready(state)
+    return time.perf_counter() - t0
+
+
+def _committed_hbm_ratio(root: str):
+    """(ratio_at_hi, failure | None) from the committed COST_AUDIT.json
+    fusion contract — the drop this gate pins."""
+    from go_libp2p_pubsub_tpu.analysis import costmodel as cm
+
+    path = cm.audit_path(root)
+    if not os.path.exists(path):
+        return None, (f"{cm.AUDIT_NAME} missing — the fused hbm drop is "
+                      "unpinned (run COST_UPDATE=1 scripts/cost_audit.py)")
+    try:
+        with open(path) as f:
+            fusion = json.load(f)["contracts"]["fusion"]["csr"]
+        ratio = float(fusion["ratio_at_hi"])
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+        return None, (f"{cm.AUDIT_NAME} carries no parseable fusion "
+                      "contract for the csr build")
+    if ratio > HBM_RATIO_CEILING:
+        return ratio, (
+            f"fused hbm drop lost: committed csr fused/unfused "
+            f"hbm_bytes ratio {ratio:.4f} is over the {HBM_RATIO_CEILING} "
+            "ceiling — the fused build no longer cuts >= 20% of traffic")
+    return ratio, None
+
+
+def run_gate(n: int, rounds: int) -> dict:
+    import jax
+
+    from go_libp2p_pubsub_tpu.ensemble.runner import _cache_size
+    from go_libp2p_pubsub_tpu.perf.profile import on_image_census_baseline
+
+    failures: list[str] = []
+    args = _pub_args(n, rounds)
+    upd = bool(os.environ.get("FUSE_SMOKE_UPDATE"))
+
+    st_off, step_off = _build(n, fused=False)
+    st_on, step_on = _build(n, fused=True)
+
+    # --- censuses + on-image comparison ------------------------------
+    census_off = _census(step_off, st_off, n)
+    census_on = _census(step_on, st_on, n)
+    oni_off = on_image_census_baseline(census_off, variant="csr_fused_off",
+                                       update=upd)
+    oni_on = on_image_census_baseline(census_on, variant="csr_fused_on",
+                                      update=upd)
+    seeded = oni_off["seeded"] or oni_on["seeded"]
+    if not seeded:
+        # fused-off must recover the pre-fusion compiled program EXACTLY
+        if census_off["total"] != oni_off["total"]:
+            failures.append(
+                f"fused-off census changed: {census_off['total']} != "
+                f"on-image baseline {oni_off['total']} — the fused=False "
+                "build must compile to the unchanged CSR program")
+        if census_on["total"] != oni_on["total"]:
+            failures.append(
+                f"fused-on census moved: {census_on['total']} != "
+                f"on-image baseline {oni_on['total']}")
+
+    # --- guarded fused run: one compile over the whole window --------
+    before = _cache_size(step_on)
+    st_fin = _fresh(st_on)
+    with jax.transfer_guard("disallow"):
+        for a in args:
+            st_fin = step_on(st_fin, *a)
+        jax.block_until_ready(st_fin)
+    after = _cache_size(step_on)
+    compiles = -1 if before is None or after is None else after - before
+    if compiles not in (-1, 1):
+        failures.append(
+            f"one-compile: the fused step compiled {compiles} times "
+            f"across the {rounds}-round run (expected exactly 1)")
+
+    # --- warm fused-vs-unfused delivery rounds/s ---------------------
+    _timed_window(step_off, _fresh(st_off), args)  # warm the off build
+    t_on = min(_timed_window(step_on, _fresh(st_on), args)
+               for _ in range(TIMING_REPS))
+    t_off = min(_timed_window(step_off, _fresh(st_off), args)
+                for _ in range(TIMING_REPS))
+
+    # --- the pinned drop: committed fusion-contract hbm ratio --------
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    ratio, ratio_failure = _committed_hbm_ratio(repo_root())
+    if ratio_failure:
+        failures.append(ratio_failure)
+
+    return {
+        "failures": failures,
+        "compiles": compiles,
+        "n_peers": n,
+        "rounds": rounds,
+        "census_fused_off": census_off["total"],
+        "census_fused_on": census_on["total"],
+        "census_delta_thunks": census_on["total"] - census_off["total"],
+        "census_off_on_image": oni_off["total"],
+        "census_on_on_image": oni_on["total"],
+        "on_image_seeded": seeded,
+        "rate_fused_on": round(rounds / t_on, 2),
+        "rate_fused_off": round(rounds / t_off, 2),
+        "hbm_ratio_at_hi": ratio,
+        "hbm_drop_frac": (None if ratio is None else round(1.0 - ratio, 4)),
+    }
+
+
+def check_baseline(root: str, res: dict) -> list[str]:
+    """Committed-baseline leg: the fused-on thunk delta may not GROW
+    past the committed pin (the sort-composite's constant overhead);
+    rate numbers are informational."""
+    out: list[str] = []
+    path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(path):
+        if not os.environ.get("FUSE_SMOKE_UPDATE"):
+            out.append(f"{BASELINE_NAME} missing — run FUSE_SMOKE_UPDATE=1 "
+                       "scripts/fuse_smoke.py to record it")
+        return out
+    if os.environ.get("FUSE_SMOKE_UPDATE"):
+        return out
+    with open(path) as f:
+        base = json.load(f)
+    if int(base.get("n_peers", res["n_peers"])) != res["n_peers"]:
+        return out  # reshape run: the committed delta is shape-specific
+    pinned = base.get("census_delta_thunks")
+    if pinned is not None and res["census_delta_thunks"] > int(pinned):
+        out.append(
+            f"fused-on census delta grew: +{res['census_delta_thunks']} "
+            f"thunks over fused-off (committed pin +{int(pinned)}) — the "
+            "fused composites stopped fusing")
+    committed_off = base.get("census_fused_off")
+    if (committed_off is not None
+            and res["census_fused_off"] != committed_off):
+        print(
+            f"fuse-smoke NOTE: fused-off census {res['census_fused_off']} "
+            f"!= committed {committed_off} ({BASELINE_NAME}) — "
+            "informational pin; the hard gate uses the on-image baseline "
+            f"{res['census_off_on_image']}", file=sys.stderr)
+    return out
+
+
+def write_baseline(root: str, res: dict) -> str:
+    path = os.path.join(root, BASELINE_NAME)
+    doc = {
+        "schema": 1,
+        "note": ("fused-CSR-plane smoke baseline (scripts/fuse_smoke.py); "
+                 "FUSE_SMOKE_UPDATE=1 rewrites. census_* are compiled "
+                 "per-round-step thunk counts on the gate image; "
+                 "census_delta_thunks pins the fused build's constant "
+                 "sort-machinery overhead (growth = lost fusion); "
+                 "hbm_drop_frac is the committed COST_AUDIT.json fusion "
+                 "contract's csr traffic cut; rate_* are warm CPU "
+                 "rounds/s, informational."),
+        **{k: res[k] for k in (
+            "n_peers", "rounds", "census_fused_off", "census_fused_on",
+            "census_delta_thunks", "rate_fused_on", "rate_fused_off",
+            "hbm_ratio_at_hi", "hbm_drop_frac")},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int,
+                    default=int(os.environ.get("FUSE_SMOKE_N", 0)) or None)
+    ap.add_argument("--rounds", type=int, default=SMOKE_ROUNDS)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # smoke-gate policy: CPU-only, bench PRNG
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    root = repo_root()
+    enable_persistent_cache(os.path.join(root, ".jax_cache"))
+    n = args.n or DEFAULT_N
+
+    res = run_gate(n, args.rounds)
+    failures = list(res["failures"]) + check_baseline(root, res)
+    if os.environ.get("FUSE_SMOKE_UPDATE") and not res["failures"]:
+        print(f"wrote {write_baseline(root, res)}")
+
+    print(json.dumps({
+        "fuse_smoke": "PASS" if not failures else "FAIL",
+        **{k: v for k, v in res.items() if k != "failures"},
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,12 +22,29 @@ be BIT-IDENTICAL across the two layouts (same graph, same streams —
 the layout changes how, never what), and each layout's window compiles
 exactly once (cache sentinel).
 
+Round 21 adds the FUSED csr cell (``Net.build(..., fused=True)`` —
+the capacity-bounded delivery composites, docs/DESIGN.md §21) as a
+third A/B arm: its per-sim counters must stay bit-identical to the
+unfused csr run (the fusion changes how, never what) and its
+statically-priced hbm_bytes/round must stay within a tight ceiling of
+the unfused price. NOTE the measured sign: on THIS cell the fused arm
+prices slightly ABOVE unfused (~1.04x) — floodsub has no heartbeat, so
+none of the fused selection win applies, and at max_degree=64 the
+capacity-bounded scan pays ceil(log2(64))=6 full-width passes where
+the work-efficient associative scan amortizes below that. The >= 20%
+fused traffic CUT lives where the heartbeat does: the gossipsub csr
+bench row (COST_AUDIT.json's fusion contract; `make fuse-smoke`). The
+committed artifact records both sides of that tradeoff.
+
 TOPO_SMOKE_UPDATE=1 rewrites TOPO_SMOKE.json from this run (floors at
 wide margins — scale-feasibility style, not perf-regression style) and
-refreshes the committed BENCH_r07.json artifact pair: schema-v3 lines
-with the new ``fingerprint["topology"]`` block (generator, E, degree
-stats, density, workload pattern — legacy artifacts read back the
-TOPOLOGY_BANDED sentinel).
+cuts the BENCH_r08.json artifact triple: schema-v3 lines with the
+``fingerprint["topology"]`` block AND the round-19
+``fingerprint["cost"]`` block now POPULATED (the committed BENCH_r07
+pair predates the cost audit and reads back the COST_UNAUDITED
+sentinel — r08 retires that read for the power-law cell; the headline
+``parsed`` line is the fused csr run, ``parsed_unfused`` /
+``parsed_dense`` ride alongside).
 """
 
 from __future__ import annotations
@@ -41,7 +58,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 BASELINE_PATH = os.path.join(REPO, "TOPO_SMOKE.json")
-BENCH_PATH = os.path.join(REPO, "BENCH_r07.json")
+BENCH_PATH = os.path.join(REPO, "BENCH_r08.json")
 
 N = int(os.environ.get("TOPO_SMOKE_N", 4096))
 MAX_DEGREE = int(os.environ.get("TOPO_SMOKE_K", 64))
@@ -57,6 +74,12 @@ LOSS = 0.1
 #: update-mode margins: the lift floor commits at half the measured
 #: margin above 1.0 (never below 1.0 — "csr beats dense" is the gate)
 RATE_MARGIN = 0.5
+
+#: fused/unfused csr hbm price ceiling on this (heartbeat-less,
+#: cap=64) cell: the fused scan pays a small known premium here (see
+#: module docstring) and may never grow past it — growth means the
+#: fused composites regressed, not just traded
+FUSED_HBM_CEILING = 1.10
 
 
 def run_cell(layout: str, net, el):
@@ -151,12 +174,19 @@ def run_smoke() -> dict:
                        max_degree=MAX_DEGREE, seed=SEED)
     subs = graph.subscribe_all(N, 1)
     _t, net_d, net_c = topo.build_nets(el, subs, max_degree=MAX_DEGREE)
+    # the round-21 arm: same edge list, same streams, fused composites
+    from go_libp2p_pubsub_tpu.state import Net
+
+    net_f = Net.build(_t, subs, edge_layout="csr", fused=True)
 
     dense = run_cell("dense", net_d, el)
     csr = run_cell("csr", net_c, el)
+    fused = run_cell("csr_fused", net_f, el)
 
     ev_d, ev_c = dense.pop("events_per_sim"), csr.pop("events_per_sim")
+    ev_f = fused.pop("events_per_sim")
     paired_exact = bool(np.array_equal(ev_d, ev_c))
+    fused_exact = bool(np.array_equal(ev_c, ev_f))
     delivered = [int(x) for x in ev_d[:, EV.DELIVER_MESSAGE]]
     return {
         "n_peers": N,
@@ -173,11 +203,16 @@ def run_smoke() -> dict:
         "loss_rate": LOSS,
         "dense": dense,
         "csr": csr,
+        "csr_fused": fused,
         "rate_lift": round(csr["rounds_per_sec"]
                            / max(dense["rounds_per_sec"], 1e-9), 3),
         "bytes_ratio": round(csr["bytes_per_round"]
                              / max(dense["bytes_per_round"], 1), 4),
+        "fused_hbm_ratio": round(
+            fused["cost_per_round"]["hbm_bytes"]
+            / max(csr["cost_per_round"]["hbm_bytes"], 1e-9), 4),
         "paired_per_sim_counters_exact": paired_exact,
+        "fused_per_sim_counters_exact": fused_exact,
         "delivered_per_sim": delivered,
         "el": el,
     }
@@ -212,6 +247,8 @@ def bench_records(res: dict) -> dict:
 
     def line(cell):
         rate = cell["rounds_per_sec"]
+        fused = cell["layout"].endswith("_fused")
+        layout = cell["layout"].removesuffix("_fused")
         return {
             "schema": 3,
             "metric": (f"floodsub_delivery_rounds_per_sec_n{N}_"
@@ -232,7 +269,8 @@ def bench_records(res: dict) -> dict:
                 "heartbeat_every": 1,
                 "pubs_per_round": PUB_WIDTH,
                 "engine": {"mode": "per_round",
-                           "edge_layout": cell["layout"],
+                           "edge_layout": layout,
+                           "fused": fused,
                            "router": "floodsub"},
                 "chaos": chaos_fingerprint(
                     ChaosConfig(generator="iid", loss_rate=LOSS)),
@@ -253,15 +291,19 @@ def bench_records(res: dict) -> dict:
         }
 
     return {
-        "n": 7,
+        "n": 8,
         "cmd": "python scripts/topo_smoke.py (TOPO_SMOKE_UPDATE=1)",
         "rc": 0,
-        "note": ("round-18 power-law A/B: the first cell where the csr "
-                 "layout BEATS dense on both delivery-rounds/s and "
-                 "audited bytes moved (paired per-sim counters "
-                 "bit-identical; fingerprint['topology'] block is new "
-                 "in this round — legacy lines read TOPOLOGY_BANDED)"),
-        "parsed": line(res["csr"]),
+        "note": ("round-21 power-law A/B/C: the fused csr plane "
+                 "(headline) vs the unfused csr and dense arms — per-sim "
+                 "counters bit-identical across all three (the fusion "
+                 "and the layout change how, never what), and every "
+                 "line's fingerprint['cost'] block is POPULATED (the "
+                 "BENCH_r07 pair predates the cost audit and reads the "
+                 "COST_UNAUDITED sentinel; this artifact retires that "
+                 "read for the power-law cell)"),
+        "parsed": line(res["csr_fused"]),
+        "parsed_unfused": line(res["csr"]),
         "parsed_dense": line(res["dense"]),
     }
 
@@ -283,17 +325,29 @@ def main() -> int:
     if not res["paired_per_sim_counters_exact"]:
         failures.append("per-sim counters differ across layouts — the "
                         "pairing (identical graph + streams) broke")
+    if not res["fused_per_sim_counters_exact"]:
+        failures.append("per-sim counters differ fused-vs-unfused on the "
+                        "csr plane — the fused composites changed WHAT "
+                        "was delivered, not just how")
+    if res["fused_hbm_ratio"] > FUSED_HBM_CEILING:
+        failures.append(
+            f"static price: fused/unfused csr hbm_bytes ratio "
+            f"{res['fused_hbm_ratio']} over the {FUSED_HBM_CEILING} "
+            "ceiling — the fused composites regressed past their known "
+            "heartbeat-less premium on this cell")
     if any(d <= 0 for d in res["delivered_per_sim"]):
         failures.append("a sim delivered nothing — dead wire")
-    compiles = (res["dense"]["n_compiles"], res["csr"]["n_compiles"])
+    compiles = (res["dense"]["n_compiles"], res["csr"]["n_compiles"],
+                res["csr_fused"]["n_compiles"])
     if -1 in compiles:
         # UNKNOWN must not read as the passing value 1 — say so out loud
         print("topo-smoke: one-compile sentinel UNAVAILABLE "
               "(window._cache_size missing) — compile-count gate skipped")
-    elif compiles != (1, 1):
+    elif compiles != (1, 1, 1):
         failures.append(
             f"one-compile sentinel: dense={res['dense']['n_compiles']} "
-            f"csr={res['csr']['n_compiles']}")
+            f"csr={res['csr']['n_compiles']} "
+            f"csr_fused={res['csr_fused']['n_compiles']}")
     if res["bytes_ratio"] >= 1.0:
         failures.append(
             f"audited bytes: csr/dense ratio {res['bytes_ratio']} >= 1 "
@@ -325,6 +379,9 @@ def main() -> int:
             "rate_lift_floor": max(lift_floor, 1.0),
             "bytes_ratio_ceiling": round(
                 min(res["bytes_ratio"] * 1.25, 0.999), 4),
+            # informational: the fused arm's static traffic cut on this
+            # cell (the hard <1.0 gate is unconditional in main())
+            "fused_hbm_ratio": res["fused_hbm_ratio"],
         }
         with open(BASELINE_PATH, "w") as f:
             json.dump(baseline, f, indent=1, sort_keys=True)
@@ -364,9 +421,11 @@ def main() -> int:
         return 1
     print("topo-smoke: PASS — csr %.1f vs dense %.1f delivery-rounds/s "
           "(lift %.2fx) at density %.3f; audited bytes ratio %.3f; "
-          "paired per-sim counters bit-identical"
+          "fused/unfused hbm ratio %.3f; per-sim counters bit-identical "
+          "across all three arms"
           % (res["csr"]["rounds_per_sec"], res["dense"]["rounds_per_sec"],
-             res["rate_lift"], res["density"], res["bytes_ratio"]))
+             res["rate_lift"], res["density"], res["bytes_ratio"],
+             res["fused_hbm_ratio"]))
     return 0
 
 

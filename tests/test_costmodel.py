@@ -221,6 +221,66 @@ def test_oracle_flop_ceiling_trips_on_doctored_pair():
     assert e.value.contract == "oracle-flops"
 
 
+def test_fused_hbm_contract_trips_on_doctored_ratio():
+    # doctored pair: the "fused" build only shaves 10% — over the
+    # 0.8 ceiling, so the contract must name the lost traffic cut
+    unfused = {"hbm_bytes": {"at_hi": 100.0, "slope": 1.0}}
+    bad = {"hbm_bytes": {"at_hi": 90.0, "slope": 0.9}}
+    with pytest.raises(cm.CostContractViolation) as e:
+        cm.check_fused_hbm("csr", bad, unfused, ceiling=0.8)
+    assert e.value.contract == "fused-hbm"
+    # a fused build that stopped helping entirely (ratio >= 1) trips
+    # even under a permissive ceiling
+    flat = {"hbm_bytes": {"at_hi": 100.0, "slope": 1.0}}
+    with pytest.raises(cm.CostContractViolation):
+        cm.check_fused_hbm("phase_csr", flat, unfused, ceiling=2.0)
+    # under the ceiling passes and returns the per-field ratios
+    good = {"hbm_bytes": {"at_hi": 70.0, "slope": 0.7}}
+    ratios = cm.check_fused_hbm("csr", good, unfused, ceiling=0.8)
+    assert ratios["at_hi"] == 0.7 and ratios["slope"] == 0.7
+
+
+def test_hbm_ceiling_gate_trips_on_doctored_build():
+    """The cost-REGRESSION leg: a build whose fresh hbm_bytes/round
+    rises past its committed ceiling must trip with the budget named
+    — independent of the byte-identity walk."""
+    with open(os.path.join(ROOT, cm.AUDIT_NAME)) as f:
+        committed = json.load(f)
+    ceilings = committed["contracts"]["hbm_ceilings"]["ceilings"]
+    assert set(ceilings) == set(cm.AUDIT_BUILDS)
+    builds = json.loads(json.dumps(committed["builds"]))
+    cm.check_hbm_ceilings(ceilings, builds)  # the committed audit passes
+    builds["csr"]["per_round"]["hbm_bytes"]["at_hi"] = (
+        ceilings["csr"] * 1.01)
+    with pytest.raises(cm.CostContractViolation) as e:
+        cm.check_hbm_ceilings(ceilings, builds)
+    assert e.value.contract == "hbm-ceiling"
+    assert "csr" in str(e.value)
+    # a build the committed artifact never priced is skipped, not KeyError'd
+    builds["brand_new_engine"] = builds["floodsub"]
+    del builds["csr"]
+    cm.check_hbm_ceilings(ceilings, builds)
+
+
+def test_committed_fusion_contract_pins_the_drop():
+    """The committed fusion row IS the round-21 acceptance number:
+    the fused csr build cuts >= 20% of hbm_bytes/round against the
+    same-trace unfused denominator."""
+    with open(os.path.join(ROOT, cm.AUDIT_NAME)) as f:
+        audit = json.load(f)
+    fusion = audit["contracts"]["fusion"]
+    assert fusion["csr"]["ratio_at_hi"] <= cm.FUSED_HBM_RATIO_CEILING
+    assert fusion["csr"]["ratio_slope"] <= cm.FUSED_HBM_RATIO_CEILING
+    assert fusion["phase_csr"]["ratio_at_hi"] < 1.0
+    # the unfused rows are the round-20 denominators: they must price
+    # STRICTLY MORE traffic than their fused twins
+    for name in ("csr", "phase_csr"):
+        f_hi = audit["builds"][name]["per_round"]["hbm_bytes"]["at_hi"]
+        u_hi = (audit["builds"][f"{name}_unfused"]
+                ["per_round"]["hbm_bytes"]["at_hi"])
+        assert f_hi < u_hi, name
+
+
 def test_floodsub_cell_draws_no_randomness():
     """The live contract on the real build (small shape — trace only):
     floodsub prices zero rng bits; randomsub prices some."""
@@ -386,6 +446,21 @@ def test_cost_fingerprint_roundtrip_and_legacy_sentinel():
     variants = artifacts.load_bench_variants(
         os.path.join(ROOT, "BENCH_r07.json"))
     assert not variants["parsed"].cost_audited
+    # ...and the round-21 re-cut retires that read for the power-law
+    # cell: every BENCH_r08 arm carries a POPULATED cost block
+    r08 = artifacts.load_bench_variants(
+        os.path.join(ROOT, "BENCH_r08.json"))
+    assert set(r08) == {"parsed", "parsed_unfused", "parsed_dense"}
+    for key, rec in r08.items():
+        assert rec.cost_audited, key
+        assert rec.cost["hbm_bytes_per_round"] > 0, key
+    assert r08["parsed"].cost["build"] == "floodsub_csr_fused"
+    assert r08["parsed_unfused"].cost["build"] == "floodsub_csr"
+    # the headline fused arm stays within the known heartbeat-less
+    # premium of its unfused twin (scripts/topo_smoke.py docstring)
+    ratio = (r08["parsed"].cost["hbm_bytes_per_round"]
+             / r08["parsed_unfused"].cost["hbm_bytes_per_round"])
+    assert ratio <= 1.10
 
 
 # ---------------------------------------------------------------------------
