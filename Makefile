@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static tune-smoke tune-check fuse-smoke
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static tune-smoke tune-check fuse-smoke churn-smoke
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -294,6 +294,24 @@ quick:
 	python scripts/topo_smoke.py
 	python scripts/fuse_smoke.py
 	python scripts/service_smoke.py --smoke
+	python scripts/churn_smoke.py --smoke
+
+# dynamic-overlay churn-storm gate (scripts/churn_smoke.py; docs/
+# DESIGN.md §22): a power-law cell whose edge pool MUTATES mid-window
+# (20% of peers killed + replaced, edges rewired, preferential-
+# attachment joins) from one host-compiled MutationSchedule riding the
+# scan xs — exactly ONE window compile across the mutating window
+# (recompile-free sentinel), zero invariant violations with the
+# topo-involution probe armed, mesh reform within one segment of the
+# replacement with post-heal delivery inside the paired band,
+# dense-vs-CSR per-sim counters bit-identical under mutation, an
+# injected involution-breaking mutation localized to its exact
+# dispatch by the supervisor's rollback replay (recovering bit-exact),
+# mid-storm checkpoint-v6 resume bit-exact vs the uninterrupted
+# control, and the mutation-off kernel census == on-image baseline.
+# CHURN_SMOKE_UPDATE=1 rewrites CHURN_SMOKE.json. ~3 min warm on CPU.
+churn-smoke:
+	python scripts/churn_smoke.py --smoke
 
 native:
 	$(MAKE) -C native

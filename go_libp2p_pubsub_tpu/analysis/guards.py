@@ -141,6 +141,21 @@ CSR_FUSED_BASE = "gossipsub"
 LIFTED_FUSED_ENGINE = "lifted_fused"
 LIFTED_FUSED_BASE = "gossipsub"
 
+#: the dynamic-overlay path (round 22, docs/DESIGN.md §22): the
+#: gossipsub step built with ``dynamic_peers=True, dynamic_topo=True``
+#: on an unbanded net, driven through a REAL mutation storm
+#: (topo.dynamics.churn_storm — kill/replace/rewire/join write batches
+#: ride the per-round args). Its schema is NOT committed separately:
+#: the state gains EXACTLY the ``.core.topo`` overlay plane (pinned
+#: here against the Net's [N, K] geometry); stripping it must yield
+#: the committed ``gossipsub`` rows byte-equal. Its GUARD_ROUNDS run
+#: under ``transfer_guard('disallow')`` with the one-compile sentinel
+#: IS the recompile-free-mutation acceptance invariant: the topology
+#: changes every dispatch and the program never re-traces.
+DYNAMIC_ENGINE = "dynamic"
+DYNAMIC_BASE = "gossipsub"
+_TOPO_PREFIX = ".core.topo"
+
 #: StableHLO markers proving the state argument is donated
 _DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
@@ -478,6 +493,107 @@ def build_telemetry_harness() -> EngineHarness:
         TELEMETRY_ENGINE, step, st,
         lambda i: _pub_args((PUB_WIDTH,), i), {},
     )
+
+
+def build_dynamic_harness() -> EngineHarness:
+    """The dynamic-overlay path: the bench-default gossipsub build on
+    an unbanded dynamic Net (``Net.build(dynamic=True)``) with the
+    mutable topo plane in the state, its per-round args carrying a
+    churn-storm's liveness rows and mutation write batches — so every
+    guard runs against a step whose topology actually changes."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    from .. import graph
+    from ..config import GossipSubParams, PeerScoreThresholds
+    from ..models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from ..perf.sweep import bench_score_params, bench_wire_coalesced
+    from ..state import Net
+    from ..topo.dynamics import churn_storm
+
+    topo = graph.ring_lattice(GUARD_N, d=8)
+    subs = graph.subscribe_all(GUARD_N, 1)
+    net = Net.build(topo, subs, dynamic=True)
+    params = _dc.replace(GossipSubParams(), flood_publish=False)
+    _tp, sp = bench_score_params("default", 1)
+    cfg = GossipSubConfig.build(
+        params, PeerScoreThresholds(), score_enabled=True,
+        validation_capacity=0, heartbeat_every=1,
+        wire_coalesced=bench_wire_coalesced(None),
+    )
+    cfg = _dc.replace(cfg, count_events=False, fanout_slots=0)
+    st = GossipSubState.init(net, GUARD_M, cfg, score_params=sp, seed=0,
+                             dynamic_topo=True)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               dynamic_peers=True, dynamic_topo=True)
+    sched = churn_storm(topo, n_dispatches=GUARD_ROUNDS, kill_frac=0.1,
+                        rewires=4, joins=1, join_links=2, seed=0)
+    writes, up = sched.build()
+
+    def make_args(i):
+        d = i % GUARD_ROUNDS
+        return _pub_args((PUB_WIDTH,), i) + (
+            jnp.asarray(up[d]), jnp.asarray(writes[d]))
+
+    return EngineHarness(DYNAMIC_ENGINE, step, st, make_args, {})
+
+
+def check_schema_dynamic(h: EngineHarness, out_tree,
+                         base_rows: list | None) -> list:
+    """Schema guard for the dynamic engine: weak-type audit, pin the
+    five ``.core.topo`` overlay leaves (state.TopoState — int32/bool
+    [N, K] against the harness Net's geometry), then the REMAINING
+    rows must equal the base engine's committed rows — dynamic_topo
+    only ADDS the overlay plane; any other drift is a real state
+    change hiding behind the flag (the mutation-off-statically-free
+    contract, from the schema side)."""
+    rows = schema_of(out_tree)
+    weak = [r["path"] for r in rows if r["weak_type"]]
+    if weak:
+        raise GuardViolation(
+            h.name, "schema",
+            f"weak-typed state leaves {weak[:4]} in the dynamic step",
+        )
+    shape = list(h.state.core.topo.nbr.shape)
+    want_topo = {
+        f"{_TOPO_PREFIX}.nbr": "int32",
+        f"{_TOPO_PREFIX}.nbr_ok": "bool",
+        f"{_TOPO_PREFIX}.rev": "int32",
+        f"{_TOPO_PREFIX}.edge_perm": "int32",
+        f"{_TOPO_PREFIX}.epoch": "int32",
+    }
+    got_topo = {r["path"]: r for r in rows
+                if r["path"].startswith(_TOPO_PREFIX)}
+    for path, dt in want_topo.items():
+        r = got_topo.get(path)
+        if r is None or r["dtype"] != dt or r["shape"] != shape:
+            raise GuardViolation(
+                h.name, "schema",
+                f"overlay leaf {path} expected {dt} {shape}, got {r} — "
+                "the topo plane does not match the Net's [N, K] geometry",
+            )
+    if set(got_topo) != set(want_topo):
+        raise GuardViolation(
+            h.name, "schema",
+            "unexpected overlay leaves "
+            f"{sorted(set(got_topo) - set(want_topo))}",
+        )
+    stripped = [r for r in rows if not r["path"].startswith(_TOPO_PREFIX)]
+    if base_rows is not None:
+        mism = diff_schema(h.name, stripped, base_rows)
+        if mism:
+            raise GuardViolation(
+                h.name, "schema",
+                f"{len(mism)} non-overlay leaf drift(s) vs the "
+                f"{DYNAMIC_BASE!r} baseline after stripping "
+                f"{_TOPO_PREFIX}.*: " + "; ".join(mism[:5]),
+            )
+    return stripped
 
 
 def check_schema_telemetry(h: EngineHarness, out_tree,
@@ -894,6 +1010,23 @@ def run_telemetry_engine(base_rows: list | None) -> list:
     return rows
 
 
+def run_dynamic_engine(base_rows: list | None) -> list:
+    """All guards for the dynamic-overlay row (round 22): strict-dtype
+    trace of the mutating step, the topo-leaf pin + base-row
+    comparison, buffer donation (the overlay planes must ride the
+    donated state, not copy), and the GUARD_ROUNDS run driving a real
+    churn storm under ``transfer_guard('disallow')`` — its one-compile
+    sentinel is the recompile-free-mutation acceptance invariant
+    (every dispatch rewrites topology; the program never re-traces).
+    Returns the stripped (non-overlay) rows."""
+    h = build_dynamic_harness()
+    out_tree = strict_trace(h)
+    rows = check_schema_dynamic(h, out_tree, base_rows)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
 @dataclasses.dataclass(frozen=True)
 class GuardRow:
     """One declarative harness row (round-16 dedup of the per-engine
@@ -924,6 +1057,7 @@ DERIVED_ROWS = (
     GuardRow(CSR_FUSED_ENGINE, "run_csr_fused_engine", CSR_FUSED_BASE),
     GuardRow(LIFTED_FUSED_ENGINE, "run_lifted_fused_engine",
              LIFTED_FUSED_BASE),
+    GuardRow(DYNAMIC_ENGINE, "run_dynamic_engine", DYNAMIC_BASE),
 )
 
 #: all row names, for reporting (scripts/analyze.py)

@@ -180,22 +180,61 @@ def chaos_seed(key: jax.Array) -> jax.Array:
     return s
 
 
-def link_uniform(seed: jax.Array, nbr: jax.Array, tick, salt: int) -> jax.Array:
-    """[N, K] u32 per-LINK uniform draw for one round: both directions
-    of an edge hash the same canonical (lo, hi) endpoint pair, so the
-    result is symmetric over the edge involution by construction —
-    no cross-peer gather needed. ``salt`` separates the independent
-    streams (iid vs the two GE transition draws)."""
-    n = nbr.shape[0]
-    i = jnp.arange(n, dtype=jnp.int32)[:, None]
-    j = jnp.clip(nbr, 0)
-    lo = jnp.minimum(i, j).astype(jnp.uint32)
-    hi = jnp.maximum(i, j).astype(jnp.uint32)
+def _link_key_planes(nbr: jax.Array, topo=None):
+    """The canonical symmetric link identity each draw hashes.
+
+    Static topology (``topo=None``): the undirected PEER pair
+    (min(i, j), max(i, j)) — the original keying, traced bit for bit.
+
+    Dynamic overlay (``topo`` a state.TopoState, round 22): peer ids no
+    longer identify a link (a rewired slot connects different peers
+    over time, and a replaced peer's row must NOT inherit the old
+    link's fault phase), so the key becomes the canonical SLOT pair
+    (min/max of the flat slot and its involution partner) plus the two
+    slots' write-epoch sum — slot×epoch re-keying: every rewire bumps
+    an endpoint epoch, deterministically re-drawing that link's stream,
+    while untouched links keep theirs. Both endpoint slots compute the
+    same (lo, hi, eps), so symmetry still costs no extra structure; the
+    epoch-partner read is ONE [N, K] i32 involution gather per round.
+    Checkpoint-exact resume holds because (key, tick, topo planes) are
+    all in the checkpoint."""
+    if topo is None:
+        n = nbr.shape[0]
+        i = jnp.arange(n, dtype=jnp.int32)[:, None]
+        j = jnp.clip(nbr, 0)
+        lo = jnp.minimum(i, j).astype(jnp.uint32)
+        hi = jnp.maximum(i, j).astype(jnp.uint32)
+        return lo, hi, None
+    n, k = topo.nbr.shape
+    own = jnp.arange(n * k, dtype=jnp.int32).reshape(n, k)
+    p = topo.edge_perm
+    lo = jnp.minimum(own, p).astype(jnp.uint32)
+    hi = jnp.maximum(own, p).astype(jnp.uint32)
+    ep_partner = topo.epoch.reshape(-1)[p.reshape(-1)].reshape(n, k)
+    eps = (topo.epoch + ep_partner).astype(jnp.uint32)
+    return lo, hi, eps
+
+
+def _link_uniform_keyed(seed, lo, hi, eps, tick, salt: int) -> jax.Array:
     h = _mix(seed ^ jnp.uint32(salt))
     h = h ^ (jnp.asarray(tick).astype(jnp.uint32) * jnp.uint32(_GOLD))
     u = _mix(h ^ (lo * jnp.uint32(_C1)))
     u = _mix(u ^ (hi * jnp.uint32(_C2)))
+    if eps is not None:
+        u = _mix(u ^ (eps * jnp.uint32(_GOLD)))
     return u
+
+
+def link_uniform(seed: jax.Array, nbr: jax.Array, tick, salt: int,
+                 topo=None) -> jax.Array:
+    """[N, K] u32 per-LINK uniform draw for one round: both directions
+    of an edge hash the same canonical link identity, so the result is
+    symmetric over the edge involution by construction — no cross-peer
+    gather needed (one epoch gather under a dynamic overlay; see
+    ``_link_key_planes``). ``salt`` separates the independent streams
+    (iid vs the two GE transition draws)."""
+    lo, hi, eps = _link_key_planes(nbr, topo)
+    return _link_uniform_keyed(seed, lo, hi, eps, tick, salt)
 
 
 def _threshold(p: float) -> jnp.uint32:
@@ -203,31 +242,44 @@ def _threshold(p: float) -> jnp.uint32:
     return jnp.uint32(min(int(round(p * 4294967296.0)), 0xFFFFFFFF))
 
 
-def iid_link_down(seed, nbr, tick, loss_rate: float) -> jax.Array:
+def iid_link_down(seed, nbr, tick, loss_rate: float, topo=None) -> jax.Array:
     """[N, K] bool: link down this round under the i.i.d. generator."""
-    return link_uniform(seed, nbr, tick, salt=0x11D) < _threshold(loss_rate)
+    return (link_uniform(seed, nbr, tick, salt=0x11D, topo=topo)
+            < _threshold(loss_rate))
 
 
 def ge_advance(seed, nbr, tick, bad: jax.Array,
-               p_down: float, p_up: float) -> jax.Array:
+               p_down: float, p_up: float, topo=None) -> jax.Array:
     """One Gilbert–Elliott transition for every link: returns the new
     [N, K] bad plane (symmetric whenever ``bad`` is — transitions use
-    symmetric per-link draws)."""
-    go_down = link_uniform(seed, nbr, tick, salt=0x6E0D) < _threshold(p_down)
-    go_up = link_uniform(seed, nbr, tick, salt=0x75E1) < _threshold(p_up)
+    symmetric per-link draws). Under a dynamic overlay the chain's
+    [N, K] ``bad`` plane stays slot-resident across rewires — a rewired
+    slot INHERITS its chain state for one round but its transition
+    draws re-key immediately (slot×epoch), so streams decorrelate
+    deterministically; the documented semantic is 'the replacement
+    connection starts in the old connection's weather'."""
+    lo, hi, eps = _link_key_planes(nbr, topo)
+    go_down = (_link_uniform_keyed(seed, lo, hi, eps, tick, 0x6E0D)
+               < _threshold(p_down))
+    go_up = (_link_uniform_keyed(seed, lo, hi, eps, tick, 0x75E1)
+             < _threshold(p_up))
     return jnp.where(bad, ~go_up, go_down)
 
 
 def round_link_ok(chaos: ChaosConfig, seed, nbr, tick,
                   ge_bad: jax.Array | None,
-                  link_deny: jax.Array | None):
+                  link_deny: jax.Array | None,
+                  topo=None):
     """The per-round link mask: ``(link_ok [N, K] bool, ge_bad')``.
 
     ``link_ok`` is True where the link carries traffic this round;
     callers AND it into the receiver-side gather masks (data plane and
     control head — TCP semantics: the whole link drops). ``ge_bad'``
     is the advanced chain state (unchanged input for non-GE
-    generators). The composition order is deny ∨ generator-down."""
+    generators). The composition order is deny ∨ generator-down.
+    ``topo`` (a state.TopoState, round 22) switches the draws to the
+    slot×epoch keying — pass the post-mutation plane so a rewired link
+    re-keys the round it changes."""
     down = None
     if chaos.generator == "ge" and chaos.generator_enabled:
         assert ge_bad is not None, (
@@ -236,10 +288,10 @@ def round_link_ok(chaos: ChaosConfig, seed, nbr, tick,
             "this from cfg.chaos)"
         )
         ge_bad = ge_advance(seed, nbr, tick, ge_bad,
-                            chaos.ge_p_down, chaos.ge_p_up)
+                            chaos.ge_p_down, chaos.ge_p_up, topo=topo)
         down = ge_bad
     elif chaos.generator_enabled:
-        down = iid_link_down(seed, nbr, tick, chaos.loss_rate)
+        down = iid_link_down(seed, nbr, tick, chaos.loss_rate, topo=topo)
     if link_deny is not None:
         deny = jnp.asarray(link_deny, bool)
         down = deny if down is None else (down | deny)

@@ -62,6 +62,13 @@ _log = logging.getLogger(__name__)
 #     corruption now raises the typed CheckpointCorrupt error naming
 #     the failing section instead of a raw deserialization traceback
 #     (serve/store.py falls back to the previous manifest entry on it).
+#     Round 22 (dynamic overlay) rides v6 UNCHANGED: the mutable
+#     topology is five new state leaves (`.core.topo.{nbr,nbr_ok,rev,
+#     edge_perm,epoch}`, present only on dynamic_topo builds) and the
+#     format is pytree-generic, so a mid-storm snapshot restores the
+#     mutated graph bit-exactly and the remaining mutation schedule
+#     replays from the checkpointed tick (tests/test_dynamics.py,
+#     scripts/churn_smoke.py check_resume).
 _FORMAT_VERSION = 6
 
 

@@ -78,7 +78,13 @@ from ..score.engine import (
     slot_topic_words,
 )
 from ..score.gater import GaterState, gater_accept, gater_decay, gater_on_round
-from ..state import Net, SimState, allocate_publishes, wrap_csr_resident
+from ..state import (
+    Net,
+    SimState,
+    TopoState,
+    allocate_publishes,
+    wrap_csr_resident,
+)
 from ..trace.events import EV
 from .common import (
     RoundInfo,
@@ -431,6 +437,7 @@ class GossipSubState:
         dormant: np.ndarray | None = None,
         wire_block: bool = False,
         telemetry=None,
+        dynamic_topo: bool = False,
     ) -> "GossipSubState":
         n, k = net.nbr.shape
         s = net.n_slots
@@ -459,7 +466,13 @@ class GossipSubState:
                                chaos_ge=(cfg.chaos is not None
                                          and cfg.chaos.needs_state),
                                telemetry=telemetry,
-                               n_edges=e),
+                               n_edges=e,
+                               # the state-resident mutable overlay
+                               # (dynamic_topo builds): seeded from the
+                               # build topology, mutated in place by the
+                               # step's write batches
+                               topo=(TopoState.from_net(net)
+                                     if dynamic_topo else None)),
             mesh=jnp.zeros((n, s, k), bool),
             backoff_expire=jnp.zeros((n, s, k), jnp.int32),
             backoff_present=jnp.zeros((n, s, k), bool),
@@ -1511,6 +1524,33 @@ class StepConsts:
             setattr(self, k, kw[k])
 
 
+def topology_views(net: Net):
+    """The neighbor-derived topology views the step reads every round:
+    (nbr_sub, flood_from, nbr_sub_words). Static builds compute them
+    once, eagerly, in `prepare_step_consts`; dynamic-overlay builds
+    (``dynamic_topo=True``) recompute them on device each round from the
+    mutated edge planes — same expressions, traced instead of baked, so
+    the two paths can never drift apart.
+
+    ``i_am_floodsub`` is NOT here: a peer's protocol never changes
+    across mutations (death + replacement revives the same peer id with
+    its protocol), so it stays a jit constant even under dynamics."""
+    # mesh candidates require a mesh-capable far end (gossipsub_feat.go
+    # GossipSubFeatureMesh; checked at gossipsub.go:1374,1692)
+    mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
+    nbr_sub = gather_nbr_subscribed(net) & mesh_capable[:, None, :]
+    # floodsub-semantics edges: the far end only speaks /floodsub/1.0.0
+    flood_from = (net.protocol[jnp.clip(net.nbr, 0)] == 0) & net.nbr_ok
+    # neighbors' full subscriptions as topic-bit words (for fanout checks)
+    subscribed_words_t = bitset.pack(net.subscribed)  # [N, Wt]
+    nbr_sub_words = jnp.where(
+        net.nbr_ok[:, :, None],
+        subscribed_words_t[jnp.clip(net.nbr, 0)],
+        jnp.uint32(0),
+    )  # [N,K,Wt]
+    return nbr_sub, flood_from, nbr_sub_words
+
+
 def prepare_step_consts(
     cfg: GossipSubConfig,
     net: Net,
@@ -1564,10 +1604,7 @@ def prepare_step_consts(
         tpa = TopicParamsArrays.build(score_params, net.n_topics)
     tp = tpa.gather(net.my_topics)
     window_rounds_t = jnp.asarray(tpa.window_rounds)
-    # mesh candidates require a mesh-capable far end (gossipsub_feat.go
-    # GossipSubFeatureMesh; checked at gossipsub.go:1374,1692)
-    mesh_capable = (net.protocol[jnp.clip(net.nbr, 0)] >= 1) & net.nbr_ok
-    nbr_sub_const = gather_nbr_subscribed(net) & mesh_capable[:, None, :]
+    nbr_sub_const, flood_from, nbr_sub_words = topology_views(net)
     # announce-visibility holes (pubsub.go:842-901): sub_knowledge_holes
     # [N,K,T] marks (receiver i, edge k, topic t) triples whose SubOpts
     # announcement has not yet arrived — the unannounced subscriber is
@@ -1582,16 +1619,7 @@ def prepare_step_consts(
         ).transpose(0, 2, 1)                            # [N,S,K]
         _hs = _hs & (_mt >= 0)[:, :, None]
         nbr_sub_const = nbr_sub_const & ~jnp.asarray(_hs)
-    # floodsub-semantics edges: the far end only speaks /floodsub/1.0.0
-    flood_from = (net.protocol[jnp.clip(net.nbr, 0)] == 0) & net.nbr_ok
     i_am_floodsub = net.protocol == 0
-    # neighbors' full subscriptions as topic-bit words (for fanout checks)
-    subscribed_words_t = bitset.pack(net.subscribed)  # [N, Wt]
-    nbr_sub_words = jnp.where(
-        net.nbr_ok[:, :, None],
-        subscribed_words_t[jnp.clip(net.nbr, 0)],
-        jnp.uint32(0),
-    )  # [N,K,Wt]
     if sub_knowledge_holes is not None:
         # unannounced subscriptions are invisible to fanout selection too
         nbr_sub_words = nbr_sub_words & ~bitset.pack(
@@ -1686,6 +1714,59 @@ def apply_peer_transitions(cfg: GossipSubConfig, net: Net, st: GossipSubState,
     )
     live = net.nbr_ok & st.up[:, None] & net.peer_gather(st.up)
     return st, live
+
+
+def clear_mutated_edges(cfg: GossipSubConfig, st: GossipSubState,
+                        wr_edge: jax.Array, tp: dict) -> GossipSubState:
+    """Dead-edge cleanup for mutated slots (dynamic_topo builds): a
+    written slot names a NEW connection — whatever edge occupied it
+    before (possibly nothing) is gone, so every per-edge soft-state
+    plane clears exactly the way `apply_peer_transitions` clears the
+    edges of a departing peer: score retention converts standing mesh
+    deficits into the sticky P3b penalty before the stats drop, and the
+    control outboxes / promise / gossip counters reset.
+
+    Two deliberate differences from peer departure. Backoff ALSO clears
+    here: the reference's backoff map is keyed by peer id, and a rewired
+    slot is a different peer — keeping the old slot's backoff would
+    wrongly embargo the new connection (while the genuinely-backed-off
+    old peer, if re-attached later, re-earns backoff on its next PRUNE).
+    And per-peer planes (seen-cache, mcache, forward set) do NOT clear:
+    both endpoints stay up across a rewire — only the edge died.
+
+    ``wr_edge`` is the [N, K] written-slot mask from
+    `topo.dynamics.written_edge_mask` (padding rows excluded)."""
+    we3 = wr_edge[:, None, :]
+    score0 = st.score
+    if cfg.score_enabled:
+        score0 = on_prune(score0, st.mesh & we3, tp)
+        score0 = clear_mesh_status(score0, wr_edge)
+        score0 = clear_edges(score0, wr_edge)
+    # first-arrival attribution credits the OLD far end of the slot;
+    # the new edge starts with a clean delivery record
+    dlv0 = st.core.dlv.replace(
+        fe_words=jnp.where(
+            wr_edge[:, :, None], jnp.uint32(0), st.core.dlv.fe_words
+        ),
+    )
+    return st.replace(
+        core=st.core.replace(dlv=dlv0),
+        mesh=st.mesh & ~we3,
+        fanout_peers=st.fanout_peers & ~we3,
+        graft_out=st.graft_out & ~we3,
+        prune_out=st.prune_out & ~we3,
+        ihave_out=jnp.where(wr_edge[:, :, None], jnp.uint32(0), st.ihave_out),
+        iwant_out=jnp.where(wr_edge[:, :, None], jnp.uint32(0), st.iwant_out),
+        served_lo=jnp.where(wr_edge[:, :, None], jnp.uint32(0), st.served_lo),
+        served_hi=jnp.where(wr_edge[:, :, None], jnp.uint32(0), st.served_hi),
+        peerhave=jnp.where(wr_edge, 0, st.peerhave).astype(st.peerhave.dtype),
+        iasked=jnp.where(wr_edge, 0, st.iasked).astype(st.iasked.dtype),
+        promise_mid=jnp.where(wr_edge, -1, st.promise_mid),
+        backoff_present=jnp.where(we3, False, st.backoff_present),
+        backoff_expire=jnp.where(we3, 0, st.backoff_expire),
+        congested_in=st.congested_in & ~wr_edge,
+        score=score0,
+    )
 
 
 def live_step_views(cfg: GossipSubConfig, net: Net, st: GossipSubState,
@@ -1961,6 +2042,7 @@ def make_gossipsub_step(
     telemetry=None,
     adversary=None,
     lift_scores: bool = False,
+    dynamic_topo: bool = False,
 ):
     """Build the jitted per-round step for a fixed config + topology.
 
@@ -2030,12 +2112,74 @@ def make_gossipsub_step(
     (or an all-off population) elides the plane statically: the traced
     program is the pre-adversary one, bit for bit
     (tests/test_adversary.py).
+
+    With ``dynamic_topo=True`` (round 22, docs/DESIGN.md §22) the step
+    takes an extra REQUIRED ``mut_writes [B, 4] i32`` trailing positional
+    (after ``up_next`` and the scheduled-chaos ``link_deny`` when
+    present, before the lifted ``score_plane``): a padded batch of edge
+    writes ``(slot, peer, rev, ok)`` from a host-compiled
+    `topo.MutationSchedule` — applied device-side to the state-resident
+    `TopoState` overlay at round entry (join / death-replacement /
+    rewire with zero recompiles across a window; padding rows carry
+    ``topo.dynamics.PAD_SLOT`` and drop). Requires ``dynamic_peers=True``
+    (death/replacement rides the up plane), a net built with
+    ``Net.build(..., dynamic=True)``, and none of the planes that bake
+    neighbor identity into jit constants (adversary, announce holes,
+    PX / edge-liveness, fused/banded kernels). No schedule — i.e.
+    ``dynamic_topo=False``, the default — elides the plane statically:
+    the traced program, kernel census and state tree are the pre-dynamics
+    ones, bit for bit (tests/test_dynamics.py).
     """
     if lift_scores and not cfg.score_enabled:
         raise ValueError(
             "lift_scores=True needs cfg.score_enabled — the lifted "
             "plane parameterizes the v1.1 score machinery"
         )
+    if dynamic_topo:
+        # every rejected combination below bakes neighbor identity (or
+        # the banded edge geometry) into an eager jit constant that a
+        # device-side mutation could not update without a recompile —
+        # exactly what dynamic_topo exists to avoid
+        if not dynamic_peers:
+            raise ValueError(
+                "dynamic_topo=True requires dynamic_peers=True — node "
+                "death/replacement rides the up_next plane"
+            )
+        if net.band_off is not None or net.fused or cfg.fused:
+            raise ValueError(
+                "dynamic_topo=True needs an unbanded net "
+                "(Net.build(..., dynamic=True)) — the banded/fused halo "
+                "kernels bake the edge geometry at trace time"
+            )
+        if net.edge_layout == "csr" and (
+            not net.csr_identity
+            or net.n_edges != net.n_peers * net.max_degree
+        ):
+            raise ValueError(
+                "dynamic_topo=True on CSR needs the full-capacity "
+                "identity plane (Net.build(..., edge_layout='csr', "
+                "dynamic=True)) — a degree-compacted CSR cannot gain "
+                "edges without a rebuild"
+            )
+        if adversary is not None or adversary_no_forward is not None:
+            raise ValueError(
+                "dynamic_topo=True is incompatible with the adversary "
+                "planes — their behavior masks and neighbor views are "
+                "eager jit constants over the static topology"
+            )
+        if sub_knowledge_holes is not None:
+            raise ValueError(
+                "dynamic_topo=True is incompatible with "
+                "sub_knowledge_holes — the announce-hole mask is indexed "
+                "by static (receiver, slot) edge identity"
+            )
+        if cfg.do_px or cfg.edge_liveness:
+            raise ValueError(
+                "dynamic_topo=True is incompatible with do_px/"
+                "edge_liveness — the edge_live plane binds activation to "
+                "static slot identity; topology changes go through the "
+                "mutation schedule instead"
+            )
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
         sub_knowledge_holes, adversary_no_forward, adversary,
@@ -2089,10 +2233,20 @@ def make_gossipsub_step(
         sender_fwd_ok if sender_fwd_ok is not None
         else jnp.ones(net.nbr.shape, bool)
     )
+    if dynamic_topo:
+        # lazy import: the static build's module graph (and trace) stays
+        # byte-identical to the pre-dynamics one
+        from ..topo import dynamics as topo_dynamics
 
+    # `net=net, consts=consts` are default-bound parameters, NOT closure
+    # reads: the dynamic_topo block below rebinds them to the mutated
+    # overlay, and a closure variable assigned anywhere in the body
+    # would be local EVERYWHERE in it (UnboundLocalError on the static
+    # path). Callers never pass them.
     def _round(st: GossipSubState, pub_origin, pub_topic, pub_valid, up_next,
                do_heartbeat: bool = True,
-               link_deny=None, score_plane=None) -> GossipSubState:
+               link_deny=None, score_plane=None, mut_writes=None,
+               *, net=net, consts=consts) -> GossipSubState:
         # lifted score plane (round 16): the VALUE-proved score fields
         # read from the traced plane — per-topic rows gathered to the
         # same [N, S] views TopicParamsArrays.gather bakes, thresholds
@@ -2112,6 +2266,30 @@ def make_gossipsub_step(
         else:
             tp_r, sp_r, thr, wrt = tp, score_params, cfg, window_rounds_t
         msh = cfg if mesh_plane is None else mesh_plane
+        # ---- dynamic overlay mutation (dynamic_topo builds) -------------
+        # the round's write batch lands FIRST: the whole step — peer
+        # transitions, control exchange, delivery, heartbeat — runs on
+        # the post-mutation topology, so a round that rewires an edge and
+        # a round that merely uses it trace the same program (recompile-
+        # free by construction: writes are a traced [B, 4] operand)
+        if dynamic_topo:
+            topo1 = topo_dynamics.apply_mutation(st.core.topo, mut_writes)
+            wr_edge = topo_dynamics.written_edge_mask(
+                mut_writes, net.n_peers, net.max_degree
+            )
+            net = net.with_overlay(topo1)
+            nsc, ffr, nsw = topology_views(net)
+            consts = StepConsts(
+                score_params=consts.score_params, tp=consts.tp,
+                tpa=consts.tpa, window_rounds_t=consts.window_rounds_t,
+                nbr_sub_const=nsc, flood_from=ffr,
+                i_am_floodsub=consts.i_am_floodsub, nbr_sub_words=nsw,
+                sender_fwd_ok=consts.sender_fwd_ok, adv=consts.adv,
+            )
+            st = clear_mutated_edges(cfg, st, wr_edge, tp_r)
+            st = st.replace(core=st.core.replace(topo=topo1))
+        else:
+            topo1 = None
         # telemetry: counters at step ENTRY (before the churn plane's
         # ADD/REMOVE_PEER accounting), so the row's EV deltas cover the
         # whole step and the panel sums telescope to the drained totals
@@ -2143,7 +2321,7 @@ def make_gossipsub_step(
             ge_bad0 = core.chaos.ge_bad if core.chaos is not None else None
             link_ok, ge_bad_next = chaos_faults.round_link_ok(
                 chaos, chaos_faults.chaos_seed(core.key), net.nbr, tick,
-                ge_bad0, link_deny,
+                ge_bad0, link_deny, topo=topo1,
             )
             net_w = net_l.replace(nbr_ok=net_l.nbr_ok & link_ok)
             # data-plane gate: acc_msg feeds gossip_edge_mask and the
@@ -2594,8 +2772,13 @@ def make_gossipsub_step(
                       do_heartbeat=True):
             up = rest[0] if dynamic_peers else None
             deny = rest[int(dynamic_peers)] if chaos_sched else None
+            writes = (
+                rest[int(dynamic_peers) + int(chaos_sched)]
+                if dynamic_topo else None
+            )
             return _round(st, pub_origin, pub_topic, pub_valid, up,
-                          do_heartbeat, deny, score_plane=rest[-1])
+                          do_heartbeat, deny, score_plane=rest[-1],
+                          mut_writes=writes)
 
         if use_static_hb:
             def step(st, pub_origin, pub_topic, pub_valid, *rest,
@@ -2615,7 +2798,20 @@ def make_gossipsub_step(
         # down link mask as a REQUIRED trailing positional ([N, K] bool,
         # True = link down this round) — a default would silently run
         # the scenario with no partitions.
-        if dynamic_peers and chaos_sched:
+        if dynamic_topo and chaos_sched:
+            # mut_writes is REQUIRED for the same reason link_deny is: a
+            # default would silently run the window with no mutations
+            def step(st, pub_origin, pub_topic, pub_valid, up_next,
+                     link_deny, mut_writes, *, do_heartbeat):
+                return _round(st, pub_origin, pub_topic, pub_valid, up_next,
+                              do_heartbeat, link_deny,
+                              mut_writes=mut_writes)
+        elif dynamic_topo:
+            def step(st, pub_origin, pub_topic, pub_valid, up_next,
+                     mut_writes, *, do_heartbeat):
+                return _round(st, pub_origin, pub_topic, pub_valid, up_next,
+                              do_heartbeat, mut_writes=mut_writes)
+        elif dynamic_peers and chaos_sched:
             def step(st, pub_origin, pub_topic, pub_valid, up_next,
                      link_deny, *, do_heartbeat):
                 return _round(st, pub_origin, pub_topic, pub_valid, up_next,
@@ -2636,7 +2832,16 @@ def make_gossipsub_step(
         return jax.jit(step, donate_argnums=0,
                        static_argnames=("do_heartbeat",))
 
-    if dynamic_peers and chaos_sched:
+    if dynamic_topo and chaos_sched:
+        def step(st, pub_origin, pub_topic, pub_valid, up_next, link_deny,
+                 mut_writes):
+            return _round(st, pub_origin, pub_topic, pub_valid, up_next,
+                          link_deny=link_deny, mut_writes=mut_writes)
+    elif dynamic_topo:
+        def step(st, pub_origin, pub_topic, pub_valid, up_next, mut_writes):
+            return _round(st, pub_origin, pub_topic, pub_valid, up_next,
+                          mut_writes=mut_writes)
+    elif dynamic_peers and chaos_sched:
         def step(st, pub_origin, pub_topic, pub_valid, up_next, link_deny):
             return _round(st, pub_origin, pub_topic, pub_valid, up_next,
                           link_deny=link_deny)

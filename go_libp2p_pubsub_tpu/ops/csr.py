@@ -274,6 +274,45 @@ def build_csr(nbr: np.ndarray, rev: np.ndarray,
     )
 
 
+def build_csr_full(nbr: np.ndarray, rev: np.ndarray,
+                   nbr_ok: np.ndarray) -> tuple[CsrTopology, np.ndarray]:
+    """FULL-CAPACITY identity CSR (round 22 dynamic overlay): every
+    padded ``[N, K]`` slot — present or absent — owns a flat edge,
+    E = N*K in row-major slot order. The flat structure (e2nk, e_of_nk,
+    seg_start, row_last) is then a pure function of the CAPACITY, never
+    of the edge list, which is what lets the overlay rewire on device
+    without reshaping anything: only col/eperm/e_valid change, as traced
+    [E] planes (state.Net.with_overlay). Absent slots are inert exactly
+    like pad_csr_blocks padding edges — the returned ``e_valid``
+    (= nbr_ok flat) masks them in the flat gathers and every flat plane
+    carries 0 there; their eperm self-points (the dense absent-slot junk
+    convention, ops/edges.build_edge_perm)."""
+    nbr = np.asarray(nbr)
+    rev = np.asarray(rev)
+    nbr_ok = np.asarray(nbr_ok, bool)
+    n, k = nbr.shape
+    e = n * k
+    ar = np.arange(e, dtype=np.int32)
+    perm = _edges.build_edge_perm(nbr, rev, nbr_ok).reshape(e)
+    if not (perm[perm] == ar).all():
+        raise ValueError("build_csr_full: rev mapping is not an involution")
+    okf = nbr_ok.reshape(e)
+    nbrf = nbr.reshape(e)
+    row = (ar // k).astype(np.int32)
+    if not (okf[perm] == okf).all() or not (nbrf[perm][okf] == row[okf]).all():
+        raise ValueError("build_csr_full: topology is not symmetric")
+    ct = CsrTopology(
+        row_ptr=(np.arange(n + 1, dtype=np.int64) * k).astype(np.int32),
+        col=np.clip(nbrf, 0, None).astype(np.int32),
+        row=row,
+        slot=(ar % k).astype(np.int32),
+        e2nk=ar.copy(),
+        e_of_nk=ar.reshape(n, k).copy(),
+        eperm=perm.astype(np.int32),
+    )
+    return ct, okf.copy()
+
+
 # ---------------------------------------------------------------------------
 # device kernels — local relayouts (no halo cost)
 

@@ -156,6 +156,44 @@ def build_edge_perm(nbr: np.ndarray, rev: np.ndarray, nbr_ok: np.ndarray) -> np.
     return np.where(nbr_ok, perm, own)
 
 
+def involution_wf(nbr: jax.Array, rev: jax.Array, nbr_ok: jax.Array,
+                  edge_perm: jax.Array) -> jax.Array:
+    """Scalar bool: the (nbr, rev, nbr_ok, edge_perm) planes form a
+    well-formed capacity-bounded edge pool — the structural contract
+    ``build_edge_perm``/``build_csr`` establish at build time and the
+    dynamic overlay (topo/dynamics.py) must PRESERVE under every
+    mutation batch:
+
+      * edge_perm is a self-inverse permutation of [0, N*K);
+      * absent slots self-point (the junk convention every masked
+        gather relies on);
+      * present slots agree with their partner: partner present, the
+        partner's nbr points back, perm == nbr*K + rev, no self-edges,
+        nbr/rev in range.
+
+    Device-side (jit-safe) — the oracle's edge-involution-wf predicate
+    body (oracle/invariants.py)."""
+    n, k = nbr.shape
+    e = n * k
+    ar = jnp.arange(e, dtype=jnp.int32)
+    pf = edge_perm.reshape(e).astype(jnp.int32)
+    okf = nbr_ok.reshape(e)
+    nbrf = nbr.reshape(e).astype(jnp.int32)
+    revf = rev.reshape(e).astype(jnp.int32)
+    in_range = jnp.all((pf >= 0) & (pf < e))
+    ps = jnp.clip(pf, 0, e - 1)  # clip-safe partner index
+    invol = jnp.all(pf[ps] == ar)
+    absent_self = jnp.all(okf | (pf == ar))
+    partner_ok = jnp.all(~okf | okf[ps])
+    back = jnp.all(~okf | (nbrf[ps] == (ar // k)))
+    agree = jnp.all(~okf | (pf == nbrf * k + revf))
+    no_self = jnp.all(~okf | (nbrf != (ar // k)))
+    bounds = jnp.all(~okf | ((nbrf >= 0) & (nbrf < n)
+                             & (revf >= 0) & (revf < k)))
+    return (in_range & invol & absent_self & partner_ok & back & agree
+            & no_self & bounds)
+
+
 def edge_permute(x: jax.Array, perm: jax.Array) -> jax.Array:
     """x[N, K, ...] -> x[nbr[j,k], rev[j,k], ...] as a flat row gather."""
     _tally("edge", x)

@@ -61,7 +61,7 @@ ENGINES = ("gossipsub", "phase", "floodsub", "randomsub")
 CORE_ENGINES = ("gossipsub", "phase", "floodsub", "randomsub")
 GOSSIP_ENGINES = ("gossipsub", "phase")
 
-#: due-vector layout (i32[6], device): the host-known schedule context a
+#: due-vector layout (i32[7], device): the host-known schedule context a
 #: check runs under. -1 sentinels disable a clause.
 #:   QUIET_LO/QUIET_HI — fresh-publish eventual-delivery window: a valid
 #:       message is due iff birth >= QUIET_LO and birth + W <= QUIET_HI
@@ -77,14 +77,22 @@ DUE_R_LO = 2
 DUE_R_HI = 3
 DUE_R_DEADLINE = 4
 DUE_GRACE = 5
-DUE_LEN = 6
+#: round-22 dynamic overlay: 1 while a topology-mutation batch landed
+#: inside this check's window — the mutation-aware invariants
+#: (mesh-in-topology, first-edge-wf) grace the one-check re-peering
+#: transient instead of mis-flagging state keyed to pre-mutation edges
+DUE_MUT_GRACE = 6
+DUE_LEN = 7
 
 
-def due_vector(quiet=None, recover=None, grace: bool = False) -> np.ndarray:
+def due_vector(quiet=None, recover=None, grace: bool = False,
+               mut_grace: bool = False) -> np.ndarray:
     """Host-side due-vector builder. ``quiet`` is ``(lo, hi)`` — the
     quiet interval for the fresh-publish delivery clause; ``recover``
     is ``(born_lo, born_hi, deadline)`` — the heal-recovery clause;
-    ``grace`` suspends the fault-scoped safety clauses."""
+    ``grace`` suspends the fault-scoped safety clauses; ``mut_grace``
+    suspends the mutation-scoped clauses around topology-mutation
+    ticks (topo/dynamics.MutationSchedule.due_fn sets it)."""
     out = np.full((DUE_LEN,), -1, np.int32)
     if quiet is not None:
         out[DUE_QUIET_LO], out[DUE_QUIET_HI] = int(quiet[0]), int(quiet[1])
@@ -93,6 +101,7 @@ def due_vector(quiet=None, recover=None, grace: bool = False) -> np.ndarray:
         out[DUE_R_HI] = int(recover[1])
         out[DUE_R_DEADLINE] = int(recover[2])
     out[DUE_GRACE] = 1 if grace else 0
+    out[DUE_MUT_GRACE] = 1 if mut_grace else 0
     return out
 
 
@@ -196,7 +205,7 @@ class Ctx:
     core: object             # SimState
     gs: object               # GossipSubState | None
     tick: jax.Array          # i32 (post-step: rounds executed so far)
-    due: jax.Array           # i32[6]
+    due: jax.Array           # i32[DUE_LEN]
     prev_events: jax.Array   # [N_EVENTS] i32 (last check's counters)
     nbr_sub: object          # [N,S,K] bool static mesh-eligibility const
     up: jax.Array            # [N] bool effective liveness
@@ -295,7 +304,8 @@ def _fwd_subset_have(ctx) -> jax.Array:
     doc="first-arrival attribution well-formedness: at most one "
         "first-arrival edge per (peer, message), and every attributed "
         "message is in the seen-cache (the delivery-attribution plane "
-        "P3/P7 scoring reads)")
+        "P3/P7 scoring reads); mutation-aware — graced inside the "
+        "DUE_MUT_GRACE window around topology-mutation ticks")
 def _first_edge_wf(ctx) -> jax.Array:
     dlv = ctx.core.dlv
     fe = dlv.fe_words                    # [N, K, W] ([E, W] CSR-resident)
@@ -309,7 +319,33 @@ def _first_edge_wf(ctx) -> jax.Array:
     for k in range(k_dim):               # K is a small static axis
         multi = multi | (acc & fe[:, k])
         acc = acc | fe[:, k]
-    return ~jnp.any(multi) & ~jnp.any(acc & ~dlv.have)
+    ok = ~jnp.any(multi) & ~jnp.any(acc & ~dlv.have)
+    return (ctx.due[DUE_MUT_GRACE] != 0) | ok
+
+
+@invariant(
+    "edge-involution-wf", kind="safety", engines=CORE_ENGINES,
+    doc="the edge pool is structurally sound: edge_perm is a "
+        "self-inverse permutation, absent slots self-point, present "
+        "slots are partner-consistent (reverse present and pointing "
+        "back, no self-edges, indices in range) — the involution "
+        "contract every masked gather assumes, which dynamic-overlay "
+        "mutation must preserve batch by batch (arXiv:1507.08417 "
+        "dynamic-complex-network dissemination regime)")
+def _edge_involution_wf(ctx) -> jax.Array:
+    from ..ops import edges as _ops_edges
+
+    topo = getattr(ctx.core, "topo", None)
+    if topo is None:
+        # frozen overlay: the planes are trace constants validated once
+        # at Net.build — nothing on device can corrupt them, and
+        # auditing them here would only knock-on every net-corrupting
+        # seeded negative in tests/test_invariants.py
+        return jnp.bool_(True)
+    net = ctx.net  # already overlay-rebound for dynamic states
+    ok = _ops_edges.involution_wf(net.nbr, net.rev, net.nbr_ok,
+                                  net.edge_perm)
+    return ok & jnp.all(topo.epoch >= 0)
 
 
 @invariant(
@@ -398,12 +434,19 @@ def _no_self_mesh(ctx) -> jax.Array:
     "mesh-in-topology", kind="safety", engines=GOSSIP_ENGINES,
     doc="mesh edges exist: every mesh member rides a present topology "
         "edge whose both endpoints are up and unblacklisted (dead-peer "
-        "cleanup, pubsub.go:648-689)")
+        "cleanup, pubsub.go:648-689); mutation-aware — reads the "
+        "overlay-rebound net and is graced inside the DUE_MUT_GRACE "
+        "window around topology-mutation ticks")
 def _mesh_in_topology(ctx) -> jax.Array:
     gs = ctx.gs
     up_nbr = ctx.up[jnp.clip(ctx.net.nbr, 0)]
     edge_ok = ctx.net.nbr_ok & up_nbr & ctx.up[:, None]
-    return ~jnp.any(gs.mesh & ~edge_ok[:, None, :])
+    ok = ~jnp.any(gs.mesh & ~edge_ok[:, None, :])
+    # mutation-aware (round 22): ctx.net is overlay-rebound, so mesh
+    # state keyed to a just-rewired slot is cleared in the same round
+    # the edge changes — the DUE_MUT_GRACE window covers exactly the
+    # checks whose window saw a mutation batch
+    return (ctx.due[DUE_MUT_GRACE] != 0) | ok
 
 
 @invariant(
@@ -600,6 +643,14 @@ def check_state(engine: str, net, state, cfg=None,
         raise ValueError("gossipsub-state checks need the GossipSubConfig")
     if due is None:
         due = due_vector()
+    if getattr(core, "topo", None) is not None:
+        # round-22 dynamic overlay: the state CARRIES the current edge
+        # pool — every topology-reading property must see it, not the
+        # build-time net, and any hoisted mesh-eligibility const is
+        # stale by construction (presence is structural, so this branch
+        # is trace-time: static builds trace the pre-dynamics program)
+        net = net.with_overlay(core.topo)
+        nbr_sub = None
     if nbr_sub is None and gs is not None:
         nbr_sub = _mesh_eligible_const(net)
     n = net.nbr.shape[0]

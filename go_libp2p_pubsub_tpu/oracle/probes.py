@@ -19,7 +19,14 @@ supervisor can roll back from (serve/supervisor.py, docs/DESIGN.md
   * ``delivery-floor`` — the segment's ``EV.DELIVER_MESSAGE`` delta is
     at least ``delivery_floor`` (0 keeps the probe vacuously
     non-negative; a live workload sets the floor to its known minimum
-    so a wedged data plane trips the probe instead of burning hours).
+    so a wedged data plane trips the probe instead of burning hours);
+  * ``topo-involution`` (opt-in, dynamic-overlay runs) — the mutable
+    edge plane (``state.core.topo``, round 22) is still a well-formed
+    involution: a host-compiled mutation schedule that emitted a bad
+    write batch — or a corrupted checkpoint resume — shows up at the
+    very next segment boundary instead of silently corrupting every
+    masked gather from then on (``ops.edges.involution_wf``, the same
+    predicate the deep oracle's ``edge-involution-wf`` checks).
 
 The probe is ONE jitted function ``(state, prev_events) -> [P] bool``
 (``[S, P]`` batched) that never donates — it reads the live state the
@@ -38,17 +45,21 @@ import jax.numpy as jnp
 from ..trace.events import EV
 
 #: probe evaluation order — the mask index space of every report
-PROBE_NAMES = ("finite-state", "events-monotone", "delivery-floor")
+PROBE_NAMES = ("finite-state", "events-monotone", "topo-involution",
+               "delivery-floor")
 
 
 @dataclasses.dataclass(frozen=True)
 class HealthConfig:
     """Which probes run, and the delivery floor (messages delivered per
     segment — per sim for batched trees; 0 means "only require the
-    delta to be non-negative")."""
+    delta to be non-negative"). ``topo_involution`` is opt-in and only
+    valid against dynamic-overlay states (``state.core.topo`` present —
+    ``GossipSubState.init(dynamic_topo=True)``)."""
 
     finite_state: bool = True
     events_monotone: bool = True
+    topo_involution: bool = False
     delivery_floor: int = 0
 
     @property
@@ -58,6 +69,8 @@ class HealthConfig:
             out.append("finite-state")
         if self.events_monotone:
             out.append("events-monotone")
+        if self.topo_involution:
+            out.append("topo-involution")
         out.append("delivery-floor")
         return tuple(out)
 
@@ -84,6 +97,17 @@ def health_check(state, prev_events, cfg: HealthConfig):
                    else jnp.asarray(True))
     if cfg.events_monotone:
         oks.append(jnp.all(core.events >= prev))
+    if cfg.topo_involution:
+        topo = getattr(core, "topo", None)
+        if topo is None:
+            raise ValueError(
+                "HealthConfig.topo_involution=True needs a dynamic-"
+                "overlay state (state.core.topo is None — build the "
+                "state with dynamic_topo=True)")
+        from ..ops import edges as _edges
+
+        oks.append(_edges.involution_wf(topo.nbr, topo.rev, topo.nbr_ok,
+                                        topo.edge_perm))
     delta = (core.events[EV.DELIVER_MESSAGE]
              - prev[EV.DELIVER_MESSAGE])
     oks.append(delta >= jnp.asarray(cfg.delivery_floor, delta.dtype))

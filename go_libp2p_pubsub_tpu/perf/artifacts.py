@@ -154,6 +154,45 @@ COST_UNAUDITED = {"recorded": False, "build": None,
                   "arithmetic_intensity": None}
 
 
+#: the dynamics defaults every artifact WITHOUT a
+#: fingerprint["dynamics"] block reads back as (round 22): the overlay
+#: was FROZEN for the whole window — no device-side topology mutation,
+#: no kills/joins/rewires, no mutation schedule riding the scan xs.
+#: Explicit sentinel so readers can ask any artifact "did the graph
+#: move under this number, and how hard" without special-casing age;
+#: the legacy answer is "static overlay", which is exactly what every
+#: pre-round-22 run was.
+DYNAMICS_OFF = {"enabled": False, "mutation_dispatches": 0,
+                "writes_per_dispatch": 0, "kills": 0, "joins": 0,
+                "rewires": 0, "schedule_hash": None}
+
+
+def dynamics_fingerprint(*, mutation_dispatches: int,
+                         writes_per_dispatch: int, kills: int = 0,
+                         joins: int = 0, rewires: int = 0,
+                         schedule_hash: str | None = None) -> dict:
+    """The schema-v3 ``fingerprint["dynamics"]`` block (round 22): the
+    dynamic-overlay plane's self-description — how many dispatches of
+    the window carried a non-empty mutation batch, the padded write-row
+    budget per dispatch (the ``[B, 4]`` xs width), the churn
+    composition (peers killed/joined, edges rewired), and the
+    MutationSchedule's content hash so two runs can be matched on the
+    exact mutation stream. Emitted by ``MutationSchedule``-driven
+    producers (``make churn-smoke``); readers go through
+    :attr:`BenchRecord.dynamics`, which defaults legacy lines to
+    :data:`DYNAMICS_OFF`."""
+    return {
+        "enabled": True,
+        "mutation_dispatches": int(mutation_dispatches),
+        "writes_per_dispatch": int(writes_per_dispatch),
+        "kills": int(kills),
+        "joins": int(joins),
+        "rewires": int(rewires),
+        "schedule_hash": (None if schedule_hash is None
+                          else str(schedule_hash)),
+    }
+
+
 def cost_fingerprint(*, build: str, flops_per_round: float,
                      hbm_bytes_per_round: float,
                      halo_bytes_per_round: float,
@@ -580,6 +619,23 @@ class BenchRecord:
     @property
     def cost_audited(self) -> bool:
         return bool(self.cost["recorded"])
+
+    @property
+    def dynamics(self) -> dict:
+        """The dynamics block of the fingerprint (round 22): whether —
+        and how hard — the overlay mutated under the measurement
+        (kills/joins/rewires per window, schedule hash). LEGACY
+        artifacts — every line that predates the dynamic overlay —
+        read back :data:`DYNAMICS_OFF`: the graph was frozen, which is
+        literally true of every pre-round-22 run."""
+        fp = self.fingerprint or {}
+        out = dict(DYNAMICS_OFF)
+        out.update(fp.get("dynamics") or {})
+        return out
+
+    @property
+    def dynamics_on(self) -> bool:
+        return bool(self.dynamics["enabled"])
 
     @property
     def scanned(self) -> bool | None:

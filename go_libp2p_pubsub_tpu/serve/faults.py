@@ -70,7 +70,7 @@ class FaultPlan:
     corrupt_segment: int | None = None
     corrupt_dispatch: int = -1
     corrupt_leaf: str = "scores"
-    corrupt_kind: str = "nan"          # "nan" | "events"
+    corrupt_kind: str = "nan"          # "nan" | "events" | "topo"
     corrupt_max_fires: int = 2         # windowed pass + rollback replay
 
     def __post_init__(self):
@@ -149,6 +149,25 @@ class FaultPlan:
             core = state.core if hasattr(state, "core") else state
             ev = core.events.at[0].set(-1)   # counters are born >= 0
             core = core.replace(events=ev)
+            return (state.replace(core=core) if hasattr(state, "core")
+                    else core)
+        if self.corrupt_kind == "topo":
+            # a bad mutation: re-aim one present edge's reverse pointer
+            # at its flat neighbor — the plane stops being a self-inverse
+            # permutation, which is exactly what the edge-involution-wf
+            # invariant (oracle/invariants.py) exists to trip, and what a
+            # buggy host-side MutationSchedule would silently produce
+            core = state.core if hasattr(state, "core") else state
+            if getattr(core, "topo", None) is None:
+                raise ValueError(
+                    "corrupt_kind='topo' needs a dynamic-overlay state "
+                    "(state.core.topo is None — build with dynamic_topo)")
+            t = core.topo
+            pf = t.edge_perm.reshape(-1)
+            e = pf.shape[0]
+            bad = t.edge_perm.reshape(-1).at[0].set((pf[0] + 1) % e)
+            core = core.replace(
+                topo=t.replace(edge_perm=bad.reshape(t.edge_perm.shape)))
             return (state.replace(core=core) if hasattr(state, "core")
                     else core)
         return _nan_leaf(state, self.corrupt_leaf)

@@ -209,7 +209,16 @@ class Net:
         edge_layout: str = "dense",
         edge_shards: int | None = None,
         fused: bool = False,
+        dynamic: bool = False,
     ) -> "Net":
+        """``dynamic=True`` (round 22, docs/DESIGN.md §22) builds the
+        net for the MUTABLE overlay plane: a CSR build allocates the
+        full-capacity identity layout (E = N*K, absent slots inert via
+        e_valid — ops/csr.build_csr_full) so rewiring only rewrites
+        traced [E] planes, and banded-roll detection is skipped on both
+        layouts (band structure is static; a mutating graph must never
+        key the roll fast paths). Pair with ``Net.with_overlay`` and a
+        ``TopoState`` plane in the sim state."""
         n = topo.n_peers
         if ip_group is None:
             ip_group = np.arange(n, dtype=np.int32)  # unique IPs
@@ -226,8 +235,40 @@ class Net:
                 "edge_shards is an edge-space sharding knob — it needs "
                 "edge_layout='csr'"
             )
+        if dynamic and fused:
+            raise ValueError(
+                "dynamic=True is incompatible with the fused kernel set "
+                "(cfg.fused) — the composites assume a static edge list"
+            )
+        if dynamic and edge_shards is not None:
+            raise ValueError(
+                "dynamic=True needs the full-capacity identity layout — "
+                "block padding (edge_shards) would break E == N*K"
+            )
         csr_kw: dict = {}
-        if edge_layout == "csr":
+        if edge_layout == "csr" and dynamic:
+            ct, e_valid_full = csr.build_csr_full(
+                topo.nbr, topo.rev, topo.nbr_ok)
+            csr_kw = dict(
+                csr_col=jnp.asarray(ct.col),
+                csr_row=jnp.asarray(ct.row),
+                csr_eperm=jnp.asarray(ct.eperm),
+                csr_e2nk=jnp.asarray(ct.e2nk),
+                csr_e_of_nk=jnp.asarray(ct.e_of_nk),
+                csr_seg_start=jnp.asarray(ct.seg_start),
+                csr_row_last=jnp.asarray(ct.row_last),
+                # all-True, NOT degree > 0: an empty row may gain edges
+                # mid-window and this plane is not overlay-rebound;
+                # full-capacity rows always own their K-slot segment
+                # (absent entries carry zeros — the padding convention)
+                csr_row_nonempty=jnp.asarray(np.ones((n,), bool)),
+                csr_e_valid=jnp.asarray(e_valid_full),
+                csr_identity=True,
+                csr_band_off=None,
+                csr_band_rev=None,
+            )
+            band = None
+        elif edge_layout == "csr":
             ct = csr.build_csr(topo.nbr, topo.rev, topo.nbr_ok)
             e_valid = None
             if edge_shards is not None and edge_shards > 1:
@@ -272,7 +313,8 @@ class Net:
             # analogue rides csr_band_off above)
             band = None
         else:
-            band = edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok)
+            band = (None if dynamic
+                    else edges.detect_banded(topo.nbr, topo.rev, topo.nbr_ok))
         return cls(
             edge_layout=edge_layout,
             fused=bool(fused),
@@ -297,6 +339,38 @@ class Net:
     @property
     def n_peers(self) -> int:
         return self.nbr.shape[0]
+
+    def with_overlay(self, topo: "TopoState") -> "Net":
+        """Rebind the MUTABLE overlay planes (round 22 dynamic
+        topology, docs/DESIGN.md §22): nbr / nbr_ok / rev / edge_perm
+        from a ``TopoState``, plus the flat col / eperm / e_valid faces
+        on a CSR build. Trace-safe — every replaced plane is a traced
+        array of unchanged shape, all pytree-AUX fields stay put, so a
+        jitted step that rebinds per round recompiles NOTHING. Requires
+        a ``Net.build(..., dynamic=True)`` net: no banded-roll
+        structure on either layout, and the CSR face must be the
+        full-capacity identity layout (E == N*K)."""
+        if self.band_off is not None or self.csr_band_off is not None:
+            raise ValueError(
+                "with_overlay: banded-roll structure is static — build "
+                "the net with Net.build(..., dynamic=True)"
+            )
+        kw = dict(nbr=topo.nbr, nbr_ok=topo.nbr_ok, rev=topo.rev,
+                  edge_perm=topo.edge_perm)
+        if self.edge_layout == "csr":
+            e = self.n_peers * self.max_degree
+            if not self.csr_identity or self.n_edges != e:
+                raise ValueError(
+                    "with_overlay: the CSR face must be the "
+                    "full-capacity identity layout (E == N*K) — build "
+                    "the net with Net.build(..., dynamic=True)"
+                )
+            kw.update(
+                csr_col=jnp.clip(topo.nbr, 0).reshape(e),
+                csr_eperm=topo.edge_perm.reshape(e),
+                csr_e_valid=topo.nbr_ok.reshape(e),
+            )
+        return self.replace(**kw)
 
     @property
     def n_edges(self) -> int | None:
@@ -487,6 +561,49 @@ class ChaosState:
 
 
 @struct.dataclass
+class TopoState:
+    """Device state of the DYNAMIC overlay plane (round 22,
+    docs/DESIGN.md §22): the mutable mirror of the Net's edge-pool
+    planes, carried in ``SimState`` so topology mutation is ordinary
+    state evolution — scanned, donated, checkpointed (rides format v6
+    with no version bump; presence changes the leaf count exactly like
+    the chaos/telemetry planes).
+
+    A step in dynamic mode rebinds its Net from this plane every round
+    (``Net.with_overlay``) after applying the dispatch's host-compiled
+    mutation batch (topo/dynamics.apply_mutation). ``epoch`` counts
+    writes per slot — the chaos plane keys its per-link fault streams
+    on slot×epoch so a REWIRED slot deterministically re-keys
+    (chaos/faults.py) with checkpoint-exact resume.
+
+    Static per-slot attributes (``Net.outbound``, ``Net.direct``) are
+    NOT mirrored: a mutated slot keeps its build-time outbound/direct
+    flag. That is the documented approximation of this plane — both
+    only bias mesh selection (Dout / direct peering), never
+    correctness."""
+
+    nbr: jax.Array        # [N, K] i32, -1 absent
+    nbr_ok: jax.Array     # [N, K] bool
+    rev: jax.Array        # [N, K] i32
+    edge_perm: jax.Array  # [N, K] i32 flat involution, absent self-point
+    epoch: jax.Array      # [N, K] i32 — bumped on every slot write
+
+    @classmethod
+    def from_net(cls, net: "Net") -> "TopoState":
+        # COPIES, not asarray views: the state tree is donated by every
+        # step, and an aliased plane would delete the Net's own buffers
+        # on the first dispatch (breaking every later eager read of the
+        # net — checker construction, a second template_fn() call)
+        return cls(
+            nbr=jnp.array(net.nbr, jnp.int32, copy=True),
+            nbr_ok=jnp.array(net.nbr_ok, bool, copy=True),
+            rev=jnp.array(net.rev, jnp.int32, copy=True),
+            edge_perm=jnp.array(net.edge_perm, jnp.int32, copy=True),
+            epoch=jnp.zeros(net.nbr.shape, jnp.int32),
+        )
+
+
+@struct.dataclass
 class SimState:
     """Carry for the jitted step loop (router-agnostic core)."""
 
@@ -505,12 +622,18 @@ class SimState:
     # state tree is leaf-identical to a pre-telemetry build, same
     # presence contract as the chaos/wire_block planes
     telem: object | None = None  # TelemetryState | None
+    # dynamic overlay plane (round 22): the mutable topology mirror.
+    # None = static topology (the default) — leaf-identical to a
+    # pre-dynamics build, same presence contract as chaos/telem, and
+    # rides checkpoint format v6 with no version bump
+    topo: TopoState | None = None
 
     @classmethod
     def init(cls, n_peers: int, msg_slots: int, seed: int = 0, k: int = 0,
              val_delay: int = 0, wire_block: bool = False,
              chaos_ge: bool = False, telemetry=None,
-             n_edges: int | None = None) -> "SimState":
+             n_edges: int | None = None,
+             topo: TopoState | None = None) -> "SimState":
         """`k` is the topology's padded max degree (net.max_degree) — it
         sizes the packed first-arrival-edge plane. k=0 is only for states
         that never enter a delivery round (e.g. checkpoint plumbing).
@@ -523,7 +646,9 @@ class SimState:
         time-series panel — required iff the build's step records one.
         `n_edges` (round 18) selects the CSR-RESIDENT first-arrival plane
         ([E, W] instead of [N, K, W]) — pass ``net.n_edges``, which is
-        None on dense builds so the same call works for both layouts."""
+        None on dense builds so the same call works for both layouts.
+        `topo` (round 22) installs the dynamic overlay plane — pass
+        ``TopoState.from_net(net)`` for a mutable-topology build."""
         if telemetry is not None:
             from .telemetry.panel import TelemetryState
 
@@ -539,6 +664,7 @@ class SimState:
             events=zero_counters(),
             chaos=ChaosState.empty(n_peers, k) if chaos_ge else None,
             telem=telem,
+            topo=topo,
         )
 
 

@@ -12,6 +12,12 @@ parity-proven tradeoff into a measured win: at mean degree ≪ the
 capacity cap K, the dense [N, K] slot space is mostly dead padding that
 the CSR layout never allocates, moves, or reduces (`make topo-smoke`)."""
 
+from .dynamics import (
+    MutationSchedule,
+    apply_mutation,
+    churn_storm,
+    written_edge_mask,
+)
 from .generators import (
     EdgeList,
     build_nets,
@@ -24,10 +30,14 @@ from .workloads import publish_bursts
 
 __all__ = [
     "EdgeList",
+    "MutationSchedule",
+    "apply_mutation",
     "build_nets",
+    "churn_storm",
     "geo_clusters",
     "powerlaw",
     "small_world",
     "to_topology",
     "publish_bursts",
+    "written_edge_mask",
 ]

@@ -1,0 +1,392 @@
+"""Dynamic overlay plane (round 22, docs/DESIGN.md §22): recompile-free
+device-side topology mutation.
+
+Real overlays grow, lose nodes, and re-peer continuously — dissemination
+on DYNAMIC complex networks is exactly the regime arXiv:1507.08417
+studies, and the v1.1 hardening analysis (arXiv:2007.02754) assumes
+attackers exploit re-peering. The repo's churn plane toggled peers
+up/down on a FROZEN edge list; this module makes the edge list itself a
+mutable device plane:
+
+  * **device kernel** — ``apply_mutation``: a batch of ``[B, 4]``
+    ``(slot, peer, rev, ok)`` write rows scattered onto the
+    ``state.TopoState`` planes (nbr / nbr_ok / rev / edge_perm / epoch)
+    with OOB-slot padding dropped, so every dispatch applies a
+    FIXED-SHAPE batch — zero recompiles across a window, the same
+    static-shape discipline as the ``chaos.Scenario → link_deny``
+    schedule hook.
+  * **host compiler** — ``MutationSchedule``: maintains an exact host
+    mirror of the evolving edge pool and emits involution-correct write
+    batches for edge add / remove / rewire, node death+replacement
+    (riding the EXISTING ``dynamic_peers`` churn for cleanup), and
+    preferential-attachment joins. Involution preservation is BY
+    CONSTRUCTION on the host (both endpoint slots of an edge are
+    written in the same batch; slot conflicts raise at schedule-build
+    time) and AUDITED on device by the oracle's ``edge-involution-wf``
+    invariant (ops/edges.involution_wf).
+
+The write-row encoding over the existing absent-slot junk conventions
+(ops/edges.build_edge_perm): ``ok=1`` rows install ``nbr[slot]=peer,
+rev[slot]=rev, edge_perm[slot]=peer*K+rev``; ``ok=0`` rows clear the
+slot back to the absent convention (``nbr=-1``, self-pointing perm).
+Every written slot bumps ``epoch`` — the chaos plane's slot×epoch
+re-keying counter (chaos/faults.py)."""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..oracle import invariants as _oinv
+
+#: pad sentinel: a write row whose slot is >= N*K is dropped by the
+#: scatter (mode="drop") — schedules pad every dispatch to a fixed
+#: batch width B with these
+PAD_SLOT = np.iinfo(np.int32).max
+
+
+def apply_mutation(topo, writes: jax.Array):
+    """Apply one fixed-shape mutation batch to the overlay plane.
+
+    ``writes`` is ``[B, 4] i32`` rows ``(slot, peer, rev, ok)`` over the
+    FLAT ``[N*K]`` slot space; rows with an out-of-range slot (the
+    ``PAD_SLOT`` padding) are dropped by the scatter. The host compiler
+    guarantees rows within a batch touch distinct slots and keep the
+    involution closed, so the scatters commute. Returns the new
+    ``TopoState`` with every written slot's ``epoch`` bumped."""
+    n, k = topo.nbr.shape
+    slot = writes[:, 0]
+    peer = writes[:, 1]
+    rv = writes[:, 2]
+    ok = writes[:, 3] != 0
+    nbr_new = jnp.where(ok, peer, -1)
+    rev_new = jnp.where(ok, rv, 0)
+    perm_new = jnp.where(ok, peer * k + rv, slot)
+
+    def scat(plane, vals):
+        flat = plane.reshape(n * k)
+        return flat.at[slot].set(vals.astype(flat.dtype),
+                                 mode="drop").reshape(n, k)
+
+    return topo.replace(
+        nbr=scat(topo.nbr, nbr_new),
+        nbr_ok=scat(topo.nbr_ok, ok),
+        rev=scat(topo.rev, rev_new),
+        edge_perm=scat(topo.edge_perm, perm_new),
+        epoch=topo.epoch.reshape(n * k).at[slot]
+                  .add(1, mode="drop").reshape(n, k),
+    )
+
+
+def written_edge_mask(writes: jax.Array, n: int, k: int) -> jax.Array:
+    """[N, K] bool: slots touched by this batch (padding rows excluded)
+    — the engine's per-round clear mask for edge-keyed protocol state
+    (models/gossipsub.clear_mutated_edges)."""
+    m = jnp.zeros((n * k,), bool).at[writes[:, 0]].set(True, mode="drop")
+    return m.reshape(n, k)
+
+
+class ScheduleError(ValueError):
+    """Raised by MutationSchedule on an ill-formed mutation program."""
+
+
+class MutationSchedule:
+    """Host-compiled mutation program over a fixed dispatch window.
+
+    Mirrors the evolving edge pool in numpy (the same planes the device
+    carries) and records, per dispatch, a batch of write rows plus the
+    peer-liveness row the ``dynamic_peers`` churn consumes. ``build()``
+    pads every batch to one static width and returns the scan xs:
+    ``writes [D, B, 4] i32`` and ``up [D, N] bool``.
+
+    All mutation ops take the DISPATCH index they land on; ops must be
+    recorded in non-decreasing dispatch order (the mirror advances with
+    the program). One slot may be written at most once per dispatch —
+    violating programs raise instead of producing scatter races."""
+
+    def __init__(self, nbr, nbr_ok, rev, n_dispatches: int,
+                 rounds_per_dispatch: int = 1):
+        self.nbr = np.array(nbr, np.int32, copy=True)
+        self.nbr_ok = np.array(nbr_ok, bool, copy=True)
+        self.rev = np.array(rev, np.int32, copy=True)
+        self.n, self.k = self.nbr.shape
+        self.n_dispatches = int(n_dispatches)
+        self.rounds_per_dispatch = int(rounds_per_dispatch)
+        self.up = np.ones((self.n,), bool)
+        self._rows: list[list[tuple[int, int, int, int]]] = [
+            [] for _ in range(self.n_dispatches)]
+        self._up_rows = np.ones((self.n_dispatches, self.n), bool)
+        self._touched: list[set[int]] = [set()
+                                         for _ in range(self.n_dispatches)]
+        self._cursor = 0
+        #: op-kind tallies the artifact fingerprint reports
+        #: (perf.artifacts.dynamics_fingerprint)
+        self.n_kills = 0
+        self.n_joins = 0
+        self.n_rewires = 0
+
+    # -- mirror bookkeeping -------------------------------------------------
+
+    def _write(self, d: int, slot: int, peer: int, rv: int, ok: int):
+        if not (0 <= d < self.n_dispatches):
+            raise ScheduleError(f"dispatch {d} outside window")
+        if d < self._cursor:
+            raise ScheduleError(
+                f"dispatch {d} recorded after dispatch {self._cursor} — "
+                "ops must arrive in non-decreasing dispatch order")
+        self._cursor = d
+        if slot in self._touched[d]:
+            raise ScheduleError(
+                f"slot {slot} written twice in dispatch {d} — scatter "
+                "rows within a batch must be unique")
+        self._touched[d].add(slot)
+        self._rows[d].append((slot, peer, rv, ok))
+        i, ki = divmod(slot, self.k)
+        if ok:
+            self.nbr[i, ki] = peer
+            self.rev[i, ki] = rv
+            self.nbr_ok[i, ki] = True
+        else:
+            self.nbr[i, ki] = -1
+            self.rev[i, ki] = 0
+            self.nbr_ok[i, ki] = False
+
+    def _slot_of(self, u: int, v: int) -> int:
+        ks = np.flatnonzero((self.nbr[u] == v) & self.nbr_ok[u])
+        if ks.size == 0:
+            raise ScheduleError(f"no edge {u}->{v} in the mirror")
+        return int(ks[0])
+
+    def _free_slot(self, u: int, d: int | None = None) -> int | None:
+        """First absent slot of u — excluding, when ``d`` is given,
+        slots already written in dispatch d's batch: a remove/rewire
+        earlier in the batch frees a slot in the MIRROR immediately,
+        but re-targeting it in the same scatter would be two rows on
+        one slot (the race ``_write`` rejects)."""
+        ks = np.flatnonzero(~self.nbr_ok[u])
+        if d is not None:
+            touched = self._touched[d]
+            ks = ks[[u * self.k + int(s) not in touched for s in ks]] \
+                if ks.size else ks
+        return int(ks[0]) if ks.size else None
+
+    def degree(self, u: int | None = None):
+        d = self.nbr_ok.sum(axis=1).astype(np.int64)
+        return d if u is None else int(d[u])
+
+    def has_edge(self, u: int, v: int) -> bool:
+        return bool(((self.nbr[u] == v) & self.nbr_ok[u]).any())
+
+    # -- mutation ops -------------------------------------------------------
+
+    def add_edge(self, d: int, u: int, v: int) -> bool:
+        """Install the undirected edge u—v (both direction slots, one
+        batch). Returns False (recording nothing) when either endpoint
+        is at capacity — or when its only free slots were already
+        written this dispatch; raises on self-edges / duplicates."""
+        if u == v:
+            raise ScheduleError(f"self-edge {u}")
+        if self.has_edge(u, v):
+            raise ScheduleError(f"edge {u}-{v} already present")
+        ku, kv = self._free_slot(u, d), self._free_slot(v, d)
+        if ku is None or kv is None:
+            return False
+        self._write(d, u * self.k + ku, v, kv, 1)
+        self._write(d, v * self.k + kv, u, ku, 1)
+        return True
+
+    def remove_edge(self, d: int, u: int, v: int):
+        """Clear the undirected edge u—v (both slots back to absent)."""
+        ku = self._slot_of(u, v)
+        kv = self._slot_of(v, u)
+        self._write(d, u * self.k + ku, 0, 0, 0)
+        self._write(d, v * self.k + kv, 0, 0, 0)
+
+    def rewire(self, d: int, u: int, v: int, t: int) -> bool:
+        """Move u's edge off v onto t: exactly three write rows —
+        u's slot re-aims at t, v's reverse slot clears, t gains a slot
+        pointing back. Returns False when t is at capacity."""
+        if t == u or self.has_edge(u, t):
+            return False
+        ku = self._slot_of(u, v)
+        kv = self._slot_of(v, u)
+        kt = self._free_slot(t, d)
+        if kt is None:
+            return False
+        if {u * self.k + ku, v * self.k + kv} & self._touched[d]:
+            # the edge being moved was itself written earlier in this
+            # batch (added by a join, or the tail of another rewire) —
+            # refuse rather than compile a scatter race
+            return False
+        self._write(d, u * self.k + ku, t, kt, 1)
+        self._write(d, v * self.k + kv, 0, 0, 0)
+        self._write(d, t * self.k + kt, u, ku, 1)
+        self.n_rewires += 1
+        return True
+
+    def kill(self, d: int, p: int):
+        """Peer p goes DOWN from dispatch d (edges stay in the pool —
+        the dynamic_peers liveness churn masks them; rejoining later is
+        the death+replacement pattern)."""
+        self.up[p] = False
+        self._up_rows[d:, p] = False
+        self.n_kills += 1
+
+    def revive(self, d: int, p: int):
+        """Peer p comes back UP from dispatch d (the replacement node
+        taking over the dead peer's row)."""
+        self.up[p] = True
+        self._up_rows[d:, p] = True
+
+    def join(self, d: int, p: int, n_links: int,
+             rng: np.random.Generator) -> int:
+        """Preferential-attachment join: connect p to ``n_links``
+        distinct targets drawn with probability ∝ (degree+1) over live
+        peers (the Barabási–Albert rule the power-law generator's
+        stationary regime assumes). Returns the number of links
+        actually installed (capacity may refuse some)."""
+        deg = self.degree().astype(np.float64) + 1.0
+        w = np.where(self.up, deg, 0.0)
+        w[p] = 0.0
+        # exclude existing neighbors
+        for v in self.nbr[p][self.nbr_ok[p]]:
+            w[int(v)] = 0.0
+        made = 0
+        for _ in range(n_links):
+            if w.sum() <= 0 or self._free_slot(p) is None:
+                break
+            t = int(rng.choice(self.n, p=w / w.sum()))
+            if self.add_edge(d, p, t):
+                made += 1
+            w[t] = 0.0
+        self.n_joins += 1
+        return made
+
+    # -- compilation --------------------------------------------------------
+
+    @property
+    def mutation_dispatches(self) -> list[int]:
+        return [d for d in range(self.n_dispatches) if self._rows[d]]
+
+    def build(self, batch: int | None = None):
+        """Pad to one static batch width and return the scan xs:
+        ``(writes [D, B, 4] i32, up [D, N] bool)``."""
+        widest = max((len(r) for r in self._rows), default=0)
+        b = widest if batch is None else int(batch)
+        if widest > b:
+            raise ScheduleError(
+                f"batch width {b} < widest dispatch ({widest} rows)")
+        b = max(b, 1)  # a zero-width xs axis would degenerate the scan
+        writes = np.full((self.n_dispatches, b, 4), 0, np.int32)
+        writes[:, :, 0] = PAD_SLOT
+        for d, rows in enumerate(self._rows):
+            for j, row in enumerate(rows):
+                writes[d, j] = row
+        return writes, self._up_rows.copy()
+
+    def due_fn(self, check_every: int, grace_checks: int = 1,
+               recover=None, quiet=None):
+        """Oracle due-row factory for this program: sets the
+        ``DUE_MUT_GRACE`` flag on every check whose window saw a
+        mutation batch (plus ``grace_checks - 1`` further checks), so
+        the mutation-aware invariants (mesh-in-topology, first-edge-wf)
+        grace the re-peering transient exactly around mutation ticks.
+        ``recover``/``quiet`` pass through to ``oracle.due_vector``."""
+        mut_ticks = sorted(t * self.rounds_per_dispatch
+                           for t in self.mutation_dispatches)
+        span = int(check_every) * int(grace_checks)
+
+        def fn(tick: int) -> np.ndarray:
+            row = _oinv.due_vector(quiet=quiet, recover=recover)
+            lo = tick - span
+            if any(lo <= mt < tick + 1 for mt in mut_ticks):
+                row[_oinv.DUE_MUT_GRACE] = 1
+            return row
+
+        return fn
+
+    def schedule_hash(self) -> str:
+        """sha256 over the compiled program — the artifact fingerprint
+        of WHICH mutation storm ran (perf/artifacts.py dynamics
+        block)."""
+        writes, up = self.build()
+        h = hashlib.sha256()
+        h.update(np.int64([self.n, self.k, self.n_dispatches,
+                           self.rounds_per_dispatch]).tobytes())
+        h.update(writes.tobytes())
+        h.update(np.packbits(up).tobytes())
+        return h.hexdigest()
+
+
+def churn_storm(topo, *, n_dispatches: int, kill_frac: float = 0.2,
+                kill_at: int | None = None, replace_at: int | None = None,
+                rewires: int = 8, joins: int = 2, join_links: int = 2,
+                rounds_per_dispatch: int = 1,
+                seed: int = 0) -> MutationSchedule:
+    """The standard churn-storm program (the churn-smoke cell): kill
+    ``kill_frac`` of the peers at ``kill_at``, REPLACE them at
+    ``replace_at`` (same rows come back up and immediately re-peer via
+    preferential attachment), and spread ``rewires`` edge rewires plus
+    ``joins`` preferential-attachment join events across the window.
+
+    ``topo`` is a ``graph.Topology`` (nbr / nbr_ok / rev planes)."""
+    rng = np.random.default_rng(seed)
+    s = MutationSchedule(topo.nbr, topo.nbr_ok, topo.rev, n_dispatches,
+                         rounds_per_dispatch=rounds_per_dispatch)
+    n = s.n
+    kill_at = n_dispatches // 4 if kill_at is None else int(kill_at)
+    replace_at = (n_dispatches // 2 if replace_at is None
+                  else int(replace_at))
+    victims = rng.choice(n, size=max(1, int(round(kill_frac * n))),
+                         replace=False)
+    victims_set = set(int(v) for v in victims)
+    # spread rewires/joins over dispatches, avoiding the kill/replace
+    # dispatches so each batch stays narrow (and the storm covers the
+    # window rather than spiking)
+    slots = [d for d in range(1, n_dispatches)
+             if d not in (kill_at, replace_at)]
+    ops: list[tuple[int, str]] = []
+    for j in range(rewires):
+        ops.append((slots[(j * len(slots)) // max(rewires, 1) % len(slots)],
+                    "rewire"))
+    for j in range(joins):
+        off = [d for d in slots if d > replace_at] or slots
+        ops.append((off[(j * len(off)) // max(joins, 1) % len(off)], "join"))
+    ops.sort(key=lambda t: t[0])
+
+    done_kill = done_replace = False
+    for d in range(n_dispatches):
+        if d == kill_at and not done_kill:
+            for v in sorted(victims_set):
+                s.kill(d, v)
+            done_kill = True
+        if d == replace_at and not done_replace:
+            for v in sorted(victims_set):
+                s.revive(d, v)
+                s.join(d, v, join_links, rng)
+            done_replace = True
+        for od, kind in ops:
+            if od != d:
+                continue
+            if kind == "rewire":
+                live = np.flatnonzero(s.up & (s.degree() > 1))
+                rng.shuffle(live)
+                for u in live:
+                    u = int(u)
+                    nb = s.nbr[u][s.nbr_ok[u]]
+                    if nb.size == 0:
+                        continue
+                    v = int(rng.choice(nb))
+                    cand = np.flatnonzero(s.up)
+                    t = int(rng.choice(cand))
+                    if t not in (u, v) and not s.has_edge(u, t):
+                        if s.rewire(d, u, v, t):
+                            break
+            elif kind == "join":
+                live = np.flatnonzero(s.up)
+                p = int(rng.choice(live))
+                s.join(d, p, join_links, rng)
+    return s

@@ -1,0 +1,560 @@
+#!/usr/bin/env python
+"""Dynamic-overlay churn-storm gate (``make churn-smoke``;
+docs/DESIGN.md §22).
+
+Drives a power-law gossipsub cell whose edge pool MUTATES mid-window —
+20% of the peers killed and replaced, edges rewired, preferential-
+attachment joins — entirely device-side from one host-compiled
+``topo.MutationSchedule``, and asserts the round-22 contract:
+
+  1. **storm control** — the supervised service loop runs the full
+     storm with ZERO recoveries, the ``topo-involution`` probe and the
+     mutation-aware folded invariants green at every boundary, and
+     exactly ONE window compile across the whole mutating window (the
+     recompile-free sentinel: joins/kills/rewires ride the scan ``xs``,
+     never the program).
+  2. **mesh reform + delivery bands** — after the killed cohort is
+     replaced, the fraction of live peers holding at least one mesh
+     edge recovers past ``CHURN_SMOKE_MESH`` (default 0.9) within one
+     segment, and the post-heal per-dispatch delivery rate stays within
+     ``CHURN_SMOKE_BAND`` (default 0.5) of the pre-kill rate —
+     non-vacuously (the post-heal window must actually deliver).
+  3. **dense-vs-CSR parity under mutation** — the SAME storm through
+     the dense ``[N, K]`` and flat-``[E]`` CSR faces finishes with
+     bit-identical event counters, delivery planes and topology planes.
+  4. **bad-mutation localization** — an injected involution-breaking
+     topology corruption (``FaultPlan(corrupt_kind="topo")``) trips the
+     ``topo-involution`` probe at the segment boundary; the
+     supervisor's rollback replay names EXACTLY the injected dispatch,
+     the forensic bundle records both the probe and the
+     ``edge-involution-wf`` oracle invariant, and the recovered run
+     still finishes digest-identical to the control.
+  5. **mid-storm resume** — a run checkpointed (format v6, no version
+     bump) BETWEEN the kill and the replacement resumes from disk and
+     finishes bit-exact vs the uninterrupted control.
+  6. **census** — the dynamic plane is opt-in: the mutation-off
+     compiled kernel census must still equal the on-image baseline
+     (the chaos-report census leg, reused).
+
+``CHURN_SMOKE_UPDATE=1`` rewrites CHURN_SMOKE.json from this run.
+Env knobs: CHURN_SMOKE_N / _D / _SEG (shape), CHURN_SMOKE_SEED,
+CHURN_SMOKE_MESH, CHURN_SMOKE_BAND, CHURN_SMOKE_TOL. CPU-only by
+contract; census under the gate PRNG.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+
+import numpy as np  # noqa: E402
+
+BASELINE_NAME = "CHURN_SMOKE.json"
+CELL_N = 48
+CELL_D = 32
+CELL_SEG = 8
+CELL_MSG_SLOTS = 64
+CELL_DEGREE = 14
+KILL_FRAC = 0.2
+DEFAULT_MESH = 0.9
+DEFAULT_BAND = 0.5
+DEFAULT_TOL = 0.4
+
+
+def build_cell(n: int, d: int, seg: int, seed: int,
+               edge_layout: str = "dense"):
+    """The storm cell: a power-law overlay with spare capacity slots
+    (joins/rewires need free slots), a churn_storm schedule, and the
+    dynamic step + make_args/template_fn triple the supervisor
+    consumes."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu import topo as topolib
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    el = topolib.powerlaw(n, max_degree=CELL_DEGREE - 4, seed=seed)
+    tp = topolib.to_topology(el, max_degree=CELL_DEGREE)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(tp, subs, edge_layout=edge_layout, dynamic=True)
+    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
+    thr = PeerScoreThresholds(
+        gossip_threshold=-2.0, publish_threshold=-4.0,
+        graylist_threshold=-8.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0)
+    cfg = GossipSubConfig.build(params, thr, score_enabled=False,
+                                edge_layout=edge_layout)
+    sched = topolib.churn_storm(tp, n_dispatches=d, kill_frac=KILL_FRAC,
+                                rewires=8, joins=2, join_links=2,
+                                seed=seed)
+    writes, up = sched.build()
+    # one publish per dispatch from a peer that is UP at that dispatch
+    # (a dead origin would make the post-kill delivery band vacuous)
+    n_pub = 4
+    po = np.full((d, n_pub), -1, np.int32)
+    pt = np.zeros((d, n_pub), np.int32)
+    pv = np.zeros((d, n_pub), bool)
+    for i in range(d):
+        live = np.flatnonzero(up[i])
+        po[i, 0] = int(live[i % len(live)])
+        pv[i, 0] = True
+
+    step = make_gossipsub_step(cfg, net, dynamic_peers=True,
+                               dynamic_topo=True)
+
+    def make_args(i: int):
+        return (po[i], pt[i], pv[i], up[i], writes[i])
+
+    def template_fn():
+        return GossipSubState.init(net, CELL_MSG_SLOTS, cfg, seed=seed,
+                                   dynamic_topo=True)
+
+    del jnp
+    return {
+        "net": net, "cfg": cfg, "sched": sched, "writes": writes,
+        "up": up, "step": step, "make_args": make_args,
+        "template_fn": template_fn, "kill_at": d // 4,
+        "replace_at": d // 2,
+    }
+
+
+def make_invariants(cell, seg: int):
+    from go_libp2p_pubsub_tpu.oracle import InvariantConfig, ScanInvariants
+
+    return ScanInvariants(
+        "gossipsub", cell["net"], cell["cfg"],
+        InvariantConfig(check_every=seg, delivery_window=16),
+        batched=False, due_fn=cell["sched"].due_fn(check_every=seg))
+
+
+def make_supervisor(cell, root: str, n_dispatches: int, seg: int, *,
+                    observe=None, faults=None):
+    from go_libp2p_pubsub_tpu.oracle import HealthConfig
+    from go_libp2p_pubsub_tpu.serve import (
+        RetentionPolicy,
+        ServiceConfig,
+        Supervisor,
+    )
+
+    svc = ServiceConfig(
+        n_dispatches=n_dispatches, segment_len=seg,
+        health=HealthConfig(topo_involution=True, delivery_floor=1),
+        retention=RetentionPolicy(keep_last=8),
+        report_name=None)
+    return Supervisor(cell["step"], cell["make_args"],
+                      cell["template_fn"], root, svc,
+                      invariants=make_invariants(cell, seg),
+                      observe=observe, faults=faults)
+
+
+def check_control(cell, work: str, n: int, d: int, seg: int,
+                  failures: list):
+    """Storm control: zero recoveries, one compile, green invariants,
+    mesh reform + paired delivery bands from the folded observer."""
+    from go_libp2p_pubsub_tpu.serve import state_digest
+    from go_libp2p_pubsub_tpu.trace.events import EV
+
+    def observe(st):
+        return {"delivered": st.core.events[EV.DELIVER_MESSAGE],
+                "mesh_any": st.mesh.any(axis=(1, 2))}
+
+    sup = make_supervisor(cell, os.path.join(work, "control"), d, seg,
+                          observe=observe)
+    t0 = time.perf_counter()
+    report = sup.run(fresh=True)
+    dt = time.perf_counter() - t0
+    if report.recoveries or report.retries:
+        failures.append(
+            f"control: clean storm reported recoveries="
+            f"{report.recoveries} retries={report.retries}")
+    bad = {k: v for k, v in report.window_compiles.items() if v != 1}
+    if bad:
+        failures.append(
+            f"recompile-free: the mutating window compiled "
+            f"{report.window_compiles} — joins/kills/rewires must ride "
+            "the scan xs, never the program (exactly 1 per shape)")
+    if not report.invariant_checks:
+        failures.append("control: no invariant checks ran (vacuous gate)")
+
+    obs = report.observations
+    up = cell["up"]
+    kill_at, replace_at = cell["kill_at"], cell["replace_at"]
+    deliv = np.asarray(obs["delivered"], np.int64)
+    deltas = np.diff(np.concatenate([[0], deliv]))
+    mesh_any = np.asarray(obs["mesh_any"])
+    live_frac = ((mesh_any & up).sum(axis=1)
+                 / np.maximum(up.sum(axis=1), 1))
+
+    mesh_floor = float(os.environ.get("CHURN_SMOKE_MESH", DEFAULT_MESH))
+    reform = next((i for i in range(replace_at, d)
+                   if live_frac[i] >= mesh_floor), None)
+    latency = None if reform is None else reform - replace_at + 1
+    if latency is None or latency > seg:
+        failures.append(
+            f"mesh-reform: live-peer mesh coverage did not recover to "
+            f"{mesh_floor:.2f} within one segment of the replacement "
+            f"(latency={latency}, coverage after replace: "
+            f"{np.round(live_frac[replace_at:], 3).tolist()})")
+
+    band = float(os.environ.get("CHURN_SMOKE_BAND", DEFAULT_BAND))
+    pre = float(deltas[:kill_at].mean())
+    post = float(deltas[replace_at + seg:].mean())
+    if pre <= 0 or post <= 0:
+        failures.append(
+            f"delivery-band: vacuous storm (pre-kill {pre:.1f}, "
+            f"post-heal {post:.1f} deliveries/dispatch — both must be "
+            "positive)")
+    elif post < band * pre:
+        failures.append(
+            f"delivery-band: post-heal delivery rate {post:.1f} < "
+            f"{band:.2f} x pre-kill {pre:.1f} per dispatch "
+            "(CHURN_SMOKE_BAND overrides)")
+    return {
+        "digest": state_digest(report.states),
+        "report": report,
+        "rounds_per_sec": round(d / dt, 2) if dt > 0 else 0.0,
+        "reform_latency_dispatches": latency,
+        "pre_kill_deliveries_per_dispatch": round(pre, 2),
+        "post_heal_deliveries_per_dispatch": round(post, 2),
+        "mesh_coverage_final": round(float(live_frac[-1]), 4),
+    }
+
+
+def check_parity(n: int, d: int, seg: int, seed: int, failures: list):
+    """The same storm through the dense and CSR faces, scanned — every
+    event counter, the delivery plane and the topology planes must be
+    bit-identical."""
+    from go_libp2p_pubsub_tpu.ensemble import WindowRunner
+
+    finals = {}
+    for layout in ("dense", "csr"):
+        cell = build_cell(n, d, seg, seed, edge_layout=layout)
+        runner = WindowRunner(cell["step"], d, segment_len=seg,
+                              invariants=make_invariants(cell, seg))
+        res = runner.run(cell["template_fn"](), cell["make_args"])
+        if res.compiles not in (0, 1):
+            failures.append(
+                f"parity: {layout} storm window compiled {res.compiles} "
+                "times (expected at most 1)")
+        if res.invariant_report is not None \
+                and not res.invariant_report.all_ok:
+            failures.append(
+                f"parity: {layout} storm violated invariants: "
+                f"{res.invariant_report.violations()}")
+        finals[layout] = res.states
+    a, b = finals["dense"], finals["csr"]
+    pairs = [("events", a.core.events, b.core.events),
+             ("dlv.have", a.core.dlv.have, b.core.dlv.have),
+             ("topo.nbr", a.core.topo.nbr, b.core.topo.nbr),
+             ("topo.nbr_ok", a.core.topo.nbr_ok, b.core.topo.nbr_ok),
+             ("topo.rev", a.core.topo.rev, b.core.topo.rev),
+             ("topo.edge_perm", a.core.topo.edge_perm,
+              b.core.topo.edge_perm),
+             ("topo.epoch", a.core.topo.epoch, b.core.topo.epoch)]
+    mismatch = [name for name, x, y in pairs
+                if not np.array_equal(np.asarray(x), np.asarray(y))]
+    if mismatch:
+        failures.append(
+            f"parity: dense vs CSR diverged under mutation on {mismatch}"
+            " — the two faces must be bit-identical")
+    ev = np.asarray(a.core.events)
+    return {"bit_exact": not mismatch,
+            "events_head": ev[:8].tolist()}
+
+
+def check_bad_mutation(cell, work: str, d: int, seg: int, control: dict,
+                       failures: list):
+    """An involution-breaking corruption must be caught same-segment by
+    the topo-involution probe, localized to its dispatch by the replay,
+    and recovered bit-exact."""
+    from go_libp2p_pubsub_tpu.serve import FaultPlan, state_digest
+
+    bad_seg, bad_disp = 1, 3
+    expect_bad = bad_seg * seg + bad_disp
+    plan = FaultPlan(corrupt_segment=bad_seg, corrupt_dispatch=bad_disp,
+                     corrupt_kind="topo")
+    sup = make_supervisor(cell, os.path.join(work, "bad"), d, seg,
+                          faults=plan)
+    report = sup.run(fresh=True)
+    if report.recoveries != 1:
+        failures.append(
+            f"bad-mutation: {report.recoveries} recoveries, expected "
+            "exactly 1 (probe trips once, then the replay exhausts the "
+            "transient)")
+    if not report.bundles:
+        failures.append("bad-mutation: no forensic bundle emitted")
+        return {}
+    bundle = report.bundles[0]
+    if bundle["first_bad_dispatch"] != expect_bad:
+        failures.append(
+            f"bad-mutation: replay localized dispatch "
+            f"{bundle['first_bad_dispatch']}, expected {expect_bad}")
+    if "topo-involution" not in bundle.get("window_probe_failures", []):
+        failures.append(
+            f"bad-mutation: boundary probe named "
+            f"{bundle.get('window_probe_failures')} — topo-involution "
+            "must catch the corruption in ITS OWN segment")
+    replay_names = bundle.get("replay_failures") or []
+    if "topo-involution" not in replay_names:
+        failures.append(
+            f"bad-mutation: replay failures {replay_names} missing the "
+            "topo-involution probe")
+    if "invariant:edge-involution-wf" not in replay_names:
+        failures.append(
+            f"bad-mutation: replay failures {replay_names} missing "
+            "invariant:edge-involution-wf — the deep oracle must agree "
+            "with the probe")
+    digest = state_digest(report.states)
+    if digest != control["digest"]:
+        failures.append(
+            "bad-mutation: recovered digest differs from control — a "
+            "transient bad mutation must recover bit-exact")
+    return {"first_bad": bundle["first_bad_dispatch"],
+            "recoveries": report.recoveries,
+            "replay_failures": replay_names,
+            "bit_exact": digest == control["digest"]}
+
+
+def check_resume(cell, work: str, d: int, seg: int, control: dict,
+                 failures: list):
+    """Checkpoint mid-storm (between the kill and the replacement),
+    resume from disk, finish bit-exact vs the uninterrupted control —
+    the mutable topology plane rides checkpoint v6 with NO version
+    bump."""
+    from go_libp2p_pubsub_tpu import checkpoint
+    from go_libp2p_pubsub_tpu.serve import state_digest
+
+    if checkpoint._FORMAT_VERSION != 6:
+        failures.append(
+            f"resume: checkpoint format bumped to "
+            f"{checkpoint._FORMAT_VERSION} — the TopoState plane must "
+            "ride v6 pytree-generically")
+    root = os.path.join(work, "resume")
+    mid = cell["replace_at"]  # kill is live, the replacement has not run
+    make_supervisor(cell, root, mid, seg).run(fresh=True)
+    report = make_supervisor(cell, root, d, seg).run(fresh=False)
+    if report.resumed_from != mid:
+        failures.append(
+            f"resume: resumed_from={report.resumed_from}, expected "
+            f"{mid} (the mid-storm checkpoint)")
+    digest = state_digest(report.states)
+    if digest != control["digest"]:
+        failures.append(
+            "resume: mid-storm resumed digest differs from the "
+            "uninterrupted control — v6 round-trip of the mutated "
+            "topology is NOT bit-exact")
+    return {"resumed_from": report.resumed_from,
+            "bit_exact": digest == control["digest"]}
+
+
+def check_census(failures: list) -> dict:
+    """Mutation-off is statically free: the chaos-off compiled kernel
+    census must equal the on-image baseline (chaos_report leg,
+    reused)."""
+    from chaos_report import check_census as _chaos_census
+
+    census = _chaos_census()
+    if not census["equal"]:
+        failures.append(
+            f"census: mutation-off kernel census {census['total']} != "
+            f"on-image baseline {census['on_image']} — the dynamic "
+            "overlay must add zero device ops when not requested")
+    return census
+
+
+def emit_artifact(cell, control: dict, res: dict, n: int, d: int,
+                  seg: int) -> None:
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        BenchRecord,
+        dump_record,
+        dynamics_fingerprint,
+        execution_fingerprint,
+        topology_fingerprint,
+    )
+
+    sched, writes = cell["sched"], cell["writes"]
+    tp_ok = np.asarray(cell["sched"].nbr_ok)
+    deg = tp_ok.sum(axis=1)
+    rec = BenchRecord(
+        metric=f"churn_storm_rounds_per_sec_n{n}_seg{seg}",
+        value=control["rounds_per_sec"],
+        unit="rounds/s",
+        vs_baseline=0.0,
+        schema=3,
+        fingerprint={
+            "execution": execution_fingerprint(
+                scan=True, segment_rounds=seg, dispatches_per_window=1,
+                rounds_per_dispatch=1),
+            "dynamics": dynamics_fingerprint(
+                mutation_dispatches=len(sched.mutation_dispatches),
+                writes_per_dispatch=int(writes.shape[1]),
+                kills=sched.n_kills, joins=sched.n_joins,
+                rewires=sched.n_rewires,
+                schedule_hash=sched.schedule_hash()),
+            "service": control["report"].fingerprint(),
+            "topology": topology_fingerprint(
+                generator="powerlaw", family="power-law",
+                params={"max_degree": CELL_DEGREE},
+                n_edges=int(tp_ok.sum()) // 2,
+                mean_degree=float(deg.mean()),
+                max_degree=int(deg.max()),
+                density=float(tp_ok.mean())),
+        },
+        extras={
+            "reform_latency_dispatches":
+                control["reform_latency_dispatches"],
+            "pre_kill_deliveries_per_dispatch":
+                control["pre_kill_deliveries_per_dispatch"],
+            "post_heal_deliveries_per_dispatch":
+                control["post_heal_deliveries_per_dispatch"],
+            "bad_mutation": res.get("bad", {}),
+            "resume": res.get("resume", {}),
+        },
+    )
+    print(dump_record(rec), flush=True)
+
+
+def check_baseline(root: str, cell, control: dict, n: int, d: int,
+                   seg: int) -> list:
+    path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(path) or os.environ.get("CHURN_SMOKE_UPDATE"):
+        return []
+    with open(path) as f:
+        base = json.load(f)
+    if (int(base.get("n_peers", n)) != n
+            or int(base.get("dispatches", d)) != d
+            or int(base.get("segment_len", seg)) != seg
+            or int(base.get("seed", -1))
+            != int(os.environ.get("CHURN_SMOKE_SEED", 0))):
+        return []  # reshape run: committed numbers are cell-specific
+    out = []
+    committed_hash = base.get("schedule_hash")
+    live_hash = cell["sched"].schedule_hash()
+    if committed_hash and committed_hash != live_hash:
+        out.append(
+            f"schedule drift: the storm compiled to {live_hash[:16]} "
+            f"but {BASELINE_NAME} pins {committed_hash[:16]} — the "
+            "mutation program is no longer deterministic (or it "
+            "changed intentionally: CHURN_SMOKE_UPDATE=1 rewrites)")
+    tol = float(os.environ.get("CHURN_SMOKE_TOL", DEFAULT_TOL))
+    committed = base.get("rounds_per_sec")
+    if committed and control["rounds_per_sec"] < tol * committed:
+        out.append(
+            f"storm rate regressed: {control['rounds_per_sec']:.1f} < "
+            f"{tol:.2f} x committed {committed:.1f} rounds/s "
+            f"({BASELINE_NAME}; CHURN_SMOKE_TOL overrides, "
+            "CHURN_SMOKE_UPDATE=1 rewrites)")
+    return out
+
+
+def write_baseline(root: str, cell, control: dict, n: int, d: int,
+                   seg: int) -> str:
+    path = os.path.join(root, BASELINE_NAME)
+    sched = cell["sched"]
+    doc = {
+        "schema": 1,
+        "note": (
+            "dynamic-overlay churn-storm smoke baseline (scripts/"
+            "churn_smoke.py); CHURN_SMOKE_UPDATE=1 rewrites. "
+            "rounds_per_sec is the supervised storm cell (probes + "
+            "folded invariants + observer) on the gate machine; "
+            "schedule_hash pins the compiled mutation program "
+            "(determinism witness). The rate floor gates at "
+            "CHURN_SMOKE_TOL; reform latency and delivery bands gate "
+            "absolutely inside the script."),
+        "n_peers": n, "dispatches": d, "segment_len": seg,
+        "seed": int(os.environ.get("CHURN_SMOKE_SEED", 0)),
+        "rounds_per_sec": control["rounds_per_sec"],
+        "reform_latency_dispatches": control["reform_latency_dispatches"],
+        "pre_kill_deliveries_per_dispatch":
+            control["pre_kill_deliveries_per_dispatch"],
+        "post_heal_deliveries_per_dispatch":
+            control["post_heal_deliveries_per_dispatch"],
+        "schedule_hash": sched.schedule_hash(),
+        "mutation_dispatches": len(sched.mutation_dispatches),
+        "kills": sched.n_kills, "joins": sched.n_joins,
+        "rewires": sched.n_rewires,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="exit non-zero on any gate failure")
+    ap.add_argument("--no-census", action="store_true",
+                    help="skip the mutation-off kernel-census leg")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+    from go_libp2p_pubsub_tpu.perf.regress import repo_root
+
+    root = repo_root()
+    enable_persistent_cache(os.path.join(root, ".jax_cache"))
+
+    n = int(os.environ.get("CHURN_SMOKE_N", CELL_N))
+    d = int(os.environ.get("CHURN_SMOKE_D", CELL_D))
+    seg = int(os.environ.get("CHURN_SMOKE_SEG", CELL_SEG))
+    seed = int(os.environ.get("CHURN_SMOKE_SEED", 0))
+
+    failures: list = []
+    work = tempfile.mkdtemp(prefix="churn_smoke_")
+    cell = build_cell(n, d, seg, seed)
+    control = check_control(cell, work, n, d, seg, failures)
+    res = {
+        "parity": check_parity(n, d, seg, seed, failures),
+        "bad": check_bad_mutation(cell, work, d, seg, control, failures),
+        "resume": check_resume(cell, work, d, seg, control, failures),
+    }
+    if not args.no_census:
+        res["census"] = check_census(failures)
+        if res["census"].get("seeded"):
+            print("churn-smoke NOTE: on-image census baseline was "
+                  "seeded by this run", file=sys.stderr)
+    emit_artifact(cell, control, res, n, d, seg)
+    failures += check_baseline(root, cell, control, n, d, seg)
+    if os.environ.get("CHURN_SMOKE_UPDATE") and not failures:
+        print(f"wrote {write_baseline(root, cell, control, n, d, seg)}")
+
+    summary = {
+        "churn_smoke": "PASS" if not failures else "FAIL",
+        "control": {k: v for k, v in control.items() if k != "report"},
+        **{k: v for k, v in res.items()},
+        "failures": failures,
+    }
+    if args.smoke and failures:
+        for f in failures:
+            print(f"churn-smoke FAIL: {f}", file=sys.stderr)
+        print(json.dumps(summary))
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,10 @@ the line exactly), and emits:
     CSR form (ops/csr.py) at representative densities — the byte ratio
     IS the topology density, which is the whole sparse-plane argument;
   * the narrowing delta: the ``narrow_counters`` (int16) build's
-    bytes/peer against the default, leaf-exact.
+    bytes/peer against the default, leaf-exact;
+  * the round-22 dynamic-topology tier: what the opt-in mutable overlay
+    planes (``dynamic_topo=True`` -> state.TopoState) add, as
+    const+slope·N rows plus a 1M/10M headroom table.
 
 Everything is shape arithmetic — deterministic, platform-independent —
 so the committed MEM_AUDIT.json baseline must reproduce byte-identical
@@ -239,12 +242,102 @@ def _csr_tier_block(blocks: dict) -> dict:
     }
 
 
+def _dynamics_rows(n: int) -> dict:
+    """The ``.core.topo`` plane's leaves (dtype, shape, bytes) at one N —
+    abstract (eval_shape), like every other audit row."""
+    import jax
+    import jax.tree_util as jtu
+
+    from go_libp2p_pubsub_tpu import graph
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+    )
+    from go_libp2p_pubsub_tpu.state import Net
+
+    topo = graph.ring_lattice(n, d=AUDIT_DEGREE_D)
+    subs = graph.subscribe_all(n, 1)
+    net = Net.build(topo, subs, dynamic=True)
+    cfg = GossipSubConfig.build(GossipSubParams(), PeerScoreThresholds(),
+                                score_enabled=False)
+    tree = jax.eval_shape(
+        lambda: GossipSubState.init(net, AUDIT_M, cfg, seed=0,
+                                    dynamic_topo=True))
+    out = {}
+    for path, leaf in jtu.tree_flatten_with_path(tree)[0]:
+        key = jtu.keystr(path)
+        if ".topo." not in key:
+            continue
+        out[key] = (str(leaf.dtype), list(leaf.shape),
+                    int(leaf.size) * leaf.dtype.itemsize)
+    return out
+
+
+def _dynamics_block(blocks: dict) -> dict:
+    """The round-22 dynamic-topology tier: what carrying the overlay in
+    the state tree (``dynamic_topo=True`` — the mutable nbr/nbr_ok/rev/
+    edge_perm/epoch planes of state.TopoState) costs on top of the
+    frozen build, as const+slope·N rows plus the 1M/10M headroom table.
+    The tier is pure opt-in: with the flag off the planes do not exist
+    and the tree is bit-identical to pre-round-22 (the mutation-off
+    test pins that), so the baseline engine blocks above are unchanged
+    by construction."""
+    lo, hi = _dynamics_rows(N_LO), _dynamics_rows(N_HI)
+    assert set(lo) == set(hi), "topo leaf set changed with N"
+    rows = []
+    for path in sorted(lo):
+        dt, shape_lo, b_lo = lo[path]
+        _, _, b_hi = hi[path]
+        slope = (b_hi - b_lo) / (N_HI - N_LO)
+        const = b_lo - slope * N_LO
+        rows.append({
+            "path": path,
+            "dtype": dt,
+            "shape_at_lo": shape_lo,
+            "bytes_per_peer": slope,
+            "const_bytes": const,
+        })
+    bpp = sum(r["bytes_per_peer"] for r in rows)
+    const = sum(r["const_bytes"] for r in rows)
+    base = blocks["gossipsub"]["totals"]["bytes_per_peer"]
+    return {
+        "note": ("dynamic-topology tier (round 22): the mutable overlay "
+                 "planes GossipSubState.init(dynamic_topo=True) adds "
+                 "(state.TopoState; docs/DESIGN.md §22). Off by default "
+                 "— the frozen build pays zero bytes for it"),
+        "leaves": rows,
+        "totals": {
+            "bytes_per_peer": bpp,
+            "const_bytes": const,
+            "resident_mb": {
+                str(n): round((const + bpp * n) / 1024 ** 2, 2)
+                for n in TARGETS
+            },
+        },
+        "headroom": {
+            # what turning mutation on costs at the scale targets, next
+            # to the frozen gossipsub build it rides on
+            str(n): {
+                "frozen_mb": round(base * n / 1024 ** 2, 2),
+                "dynamic_mb": round((base + bpp) * n / 1024 ** 2, 2),
+                "added_mb": round(bpp * n / 1024 ** 2, 2),
+                "added_frac": round(bpp / base, 4),
+            }
+            for n in (1_000_000, 10_000_000)
+        },
+    }
+
+
 def build_audit() -> dict:
     blocks = {e: _engine_block(e) for e in ENGINES}
     gs = blocks["gossipsub"]["totals"]["bytes_per_peer"]
     narrow = blocks["gossipsub_narrow"]["totals"]["bytes_per_peer"]
     return {
-        "schema": 2,
+        "schema": 3,
         "note": ("bytes/peer audit of the live state trees "
                  "(scripts/memstat.py; MEM_AUDIT_UPDATE=1 rewrites)"),
         "shape": {"degree_d": AUDIT_DEGREE_D, "k": 2 * AUDIT_DEGREE_D,
@@ -252,6 +345,7 @@ def build_audit() -> dict:
         "engines": blocks,
         "exchange": _exchange_block(),
         "csr_tier": _csr_tier_block(blocks),
+        "dynamics_tier": _dynamics_block(blocks),
         "narrowing": {
             "gossipsub_bytes_per_peer": gs,
             "narrow_counters_bytes_per_peer": narrow,
@@ -330,6 +424,14 @@ def main() -> int:
               f"{tier['dense_engine_bytes_per_peer']:.0f} vs csr "
               f"{tier['bytes_per_peer_by_density'][str(d)]} "
               f"(saves {tier['saved_bytes_per_peer_by_density'][str(d)]})")
+    dyn = audit["dynamics_tier"]
+    print(f"\ndynamic-topology tier (opt-in): "
+          f"{dyn['totals']['bytes_per_peer']:.0f} B/peer of overlay "
+          "planes; headroom over the frozen gossipsub build:")
+    for n, row in dyn["headroom"].items():
+        print(f"  N={int(n):>10,}: +{row['added_mb']:>9.2f} MB "
+              f"({row['frozen_mb']:.2f} -> {row['dynamic_mb']:.2f}, "
+              f"+{row['added_frac'] * 100:.1f}%)")
     top = sorted(audit["engines"]["gossipsub"]["leaves"],
                  key=lambda r: -r["bytes_per_peer"])[:8]
     print("\nheaviest gossipsub leaves (bytes/peer):")

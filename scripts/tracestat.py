@@ -262,6 +262,21 @@ def artifact_service(path: str) -> dict:
     return recs[-1].service
 
 
+def artifact_dynamics(path: str) -> dict:
+    """The ``dynamics`` fingerprint block (round 22: did the overlay
+    mutate under the measurement — mutation dispatches, write-row
+    budget, kills/joins/rewires, schedule hash) of a bench artifact's
+    last metric line; legacy lines read back perf.artifacts.
+    DYNAMICS_OFF (frozen overlay)."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    for rec in reversed(recs):
+        if rec.dynamics_on:
+            return rec.dynamics
+    return recs[-1].dynamics
+
+
 def artifact_topology(path: str) -> dict:
     """The ``topology`` fingerprint block (round 18: which generated
     graph the cell ran on — generator/params, E, degree stats, geo link
@@ -297,6 +312,7 @@ def main():
         stats["params"] = artifact_params(args.artifact)
         stats["service"] = artifact_service(args.artifact)
         stats["topology"] = artifact_topology(args.artifact)
+        stats["dynamics"] = artifact_dynamics(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -390,6 +406,20 @@ def main():
         else:
             print("topology: TOPOLOGY_BANDED sentinel (the banded bench "
                   "ring; artifact predates the round-18 topology block)")
+    if "dynamics" in stats:
+        dy = stats["dynamics"]
+        if dy.get("enabled"):
+            print(
+                f"dynamics: MUTATING overlay — "
+                f"{dy.get('mutation_dispatches')} mutation dispatch(es) "
+                f"of <= {dy.get('writes_per_dispatch')} write rows, "
+                f"{dy.get('kills')} kill(s) / {dy.get('joins')} join(s) "
+                f"/ {dy.get('rewires')} rewire(s), schedule "
+                f"{(dy.get('schedule_hash') or '')[:16]}"
+            )
+        else:
+            print("dynamics: DYNAMICS_OFF (frozen overlay, or the "
+                  "artifact predates the round-22 dynamic plane)")
     if "adversary" in stats:
         av = stats["adversary"]
         if av.get("enabled"):

@@ -1,0 +1,404 @@
+"""Dynamic overlay plane tests (topo/dynamics.py; round 22,
+docs/DESIGN.md §22): the host-compiled mutation schedule, the
+device-side write-batch kernel, and the contracts the dynamic build
+makes with the rest of the repo —
+
+  * schedule compilation is deterministic (same seed, same program,
+    same ``schedule_hash``) and involution-correct batch by batch;
+  * ``apply_mutation`` tracks the host mirror bit for bit and bumps
+    epoch exactly once per real write row;
+  * mutation-off is FREE: a ``dynamic_topo=True`` run fed all-padding
+    batches matches the plain ``dynamic_peers`` build bit-exactly on
+    every non-overlay leaf, and the overlay planes never move;
+  * the same storm through the dense [N, K] and flat-[E] CSR faces is
+    bit-identical, scanned or loop-stepped;
+  * chaos fault streams re-key per (slot-pair × epoch): symmetric over
+    the involution, deterministic, and local — bumping one edge's epoch
+    redraws exactly that link's stream (chaos/faults.py);
+  * the mutated topology rides checkpoint v6 with no version bump;
+  * the schema-v3 ``dynamics`` fingerprint block round-trips, with the
+    ``DYNAMICS_OFF`` sentinel on legacy lines;
+  * ``make_gossipsub_step`` rejects the build combinations that would
+    bake neighbor identity into the program.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import checkpoint, graph
+from go_libp2p_pubsub_tpu import topo as topolib
+from go_libp2p_pubsub_tpu.chaos import faults as chaos_faults
+from go_libp2p_pubsub_tpu.config import GossipSubParams, PeerScoreThresholds
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.ops.edges import involution_wf
+from go_libp2p_pubsub_tpu.state import Net, TopoState
+from go_libp2p_pubsub_tpu.topo import dynamics
+
+N = 32
+M = 64
+D = 8          # storm dispatches
+DEGREE = 10    # capacity cap K (slack above the power-law tail)
+
+
+def _topology(seed=0):
+    el = topolib.powerlaw(N, max_degree=DEGREE - 4, seed=seed)
+    return topolib.to_topology(el, max_degree=DEGREE)
+
+
+def _storm(tp, seed=0, d=D):
+    return topolib.churn_storm(tp, n_dispatches=d, kill_frac=0.2,
+                               rewires=4, joins=1, join_links=2, seed=seed)
+
+
+def _cell(seed=0, edge_layout="dense", dynamic_topo=True):
+    tp = _topology(seed)
+    subs = graph.subscribe_all(N, 1)
+    net = Net.build(tp, subs, edge_layout=edge_layout, dynamic=True)
+    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
+                                score_enabled=False,
+                                edge_layout=edge_layout)
+    st = GossipSubState.init(net, M, cfg, seed=seed,
+                             dynamic_topo=dynamic_topo)
+    step = make_gossipsub_step(cfg, net, dynamic_peers=True,
+                               dynamic_topo=dynamic_topo)
+    return tp, net, cfg, st, step
+
+
+def _publishes(d=D, seed=0):
+    rng = np.random.default_rng(seed)
+    po = np.full((d, 4), -1, np.int32)
+    po[:, 0] = rng.integers(0, N, size=d)
+    pt = np.zeros((d, 4), np.int32)
+    pv = np.zeros((d, 4), bool)
+    pv[:, 0] = True
+    return po, pt, pv
+
+
+def _pad_writes(d=D, b=4):
+    w = np.zeros((d, b, 4), np.int32)
+    w[:, :, 0] = dynamics.PAD_SLOT
+    return w
+
+
+def _leaves(tree, skip_topo=False):
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        if skip_topo and ".topo." in key:
+            continue
+        if jnp.issubdtype(getattr(leaf, "dtype", None), jax.dtypes.prng_key):
+            leaf = jax.random.key_data(leaf)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# schedule compilation
+
+
+def test_schedule_deterministic_and_hashed():
+    tp = _topology()
+    a, b = _storm(tp), _storm(tp)
+    wa, ua = a.build()
+    wb, ub = b.build()
+    assert np.array_equal(wa, wb) and np.array_equal(ua, ub)
+    assert a.schedule_hash() == b.schedule_hash()
+    assert a.schedule_hash() != _storm(tp, seed=1).schedule_hash()
+    assert a.mutation_dispatches
+    assert a.n_kills > 0 and a.n_joins > 0 and a.n_rewires > 0
+
+
+def test_schedule_rejects_malformed_programs():
+    tp = _topology()
+    s = dynamics.MutationSchedule(tp.nbr, tp.nbr_ok, tp.rev, 4)
+    with pytest.raises(dynamics.ScheduleError):
+        s.add_edge(0, 3, 3)                  # self-edge
+    u = int(np.argwhere(np.asarray(tp.nbr_ok))[0][0])
+    v = int(np.asarray(tp.nbr)[u][np.asarray(tp.nbr_ok)[u]][0])
+    with pytest.raises(dynamics.ScheduleError):
+        s.add_edge(0, u, v)                  # duplicate edge
+    s.remove_edge(2, u, v)
+    with pytest.raises(dynamics.ScheduleError):
+        s.add_edge(1, u, v)                  # out-of-order dispatch
+    with pytest.raises(dynamics.ScheduleError):
+        s.build(batch=1)                     # batch < widest dispatch
+
+
+def test_storm_generator_never_compiles_scatter_races():
+    """A rewire frees a slot in the mirror mid-batch; a join later in
+    the SAME dispatch must not re-target it (two rows on one slot is
+    the race ``_write`` rejects). churn_storm routes around touched
+    slots — fuzz it over seeds and verify every program applies clean
+    and mirror-exact. (Regression: N=64/D=32/seed=3 raised
+    ScheduleError before the dispatch-aware ``_free_slot``.)"""
+    el = topolib.powerlaw(64, max_degree=8, seed=7)
+    tp = topolib.to_topology(el, max_degree=12)
+    topolib.churn_storm(tp, n_dispatches=32, kill_frac=0.2, rewires=8,
+                        joins=2, join_links=2, seed=3).build()
+    for seed in range(8):
+        tp2 = _topology(seed)
+        s2 = topolib.churn_storm(tp2, n_dispatches=16, kill_frac=0.3,
+                                 rewires=12, joins=4, join_links=3,
+                                 seed=seed)
+        w2, _ = s2.build()
+        t2 = TopoState.from_net(
+            Net.build(tp2, graph.subscribe_all(N, 1), dynamic=True))
+        for dw in w2:
+            t2 = dynamics.apply_mutation(t2, jnp.asarray(dw))
+        assert bool(involution_wf(t2.nbr, t2.rev, t2.nbr_ok,
+                                  t2.edge_perm)), seed
+        assert np.array_equal(np.asarray(t2.nbr), s2.nbr), seed
+
+
+def test_apply_mutation_tracks_mirror_and_preserves_involution():
+    """Every dispatch batch applied on device keeps the involution
+    closed, and the final device planes equal the schedule's host
+    mirror bit for bit; epoch counts exactly the real write rows."""
+    tp = _topology()
+    subs = graph.subscribe_all(N, 1)
+    net = Net.build(tp, subs, dynamic=True)
+    sched = _storm(tp)
+    writes, _ = sched.build()
+    topo_st = TopoState.from_net(net)
+    assert bool(involution_wf(topo_st.nbr, topo_st.rev, topo_st.nbr_ok,
+                              topo_st.edge_perm))
+    for dw in writes:
+        topo_st = dynamics.apply_mutation(topo_st, jnp.asarray(dw))
+        assert bool(involution_wf(topo_st.nbr, topo_st.rev,
+                                  topo_st.nbr_ok, topo_st.edge_perm))
+    assert np.array_equal(np.asarray(topo_st.nbr), sched.nbr)
+    assert np.array_equal(np.asarray(topo_st.nbr_ok), sched.nbr_ok)
+    assert np.array_equal(np.asarray(topo_st.rev), sched.rev)
+    real_rows = int((writes[:, :, 0] != dynamics.PAD_SLOT).sum())
+    assert int(np.asarray(topo_st.epoch).sum()) == real_rows
+
+
+def test_written_edge_mask_matches_batch():
+    tp = _topology()
+    sched = _storm(tp)
+    writes, _ = sched.build()
+    d = sched.mutation_dispatches[0]
+    m = np.asarray(dynamics.written_edge_mask(
+        jnp.asarray(writes[d]), sched.n, sched.k))
+    rows = writes[d][writes[d][:, 0] != dynamics.PAD_SLOT]
+    want = np.zeros((sched.n * sched.k,), bool)
+    want[rows[:, 0]] = True
+    assert np.array_equal(m.reshape(-1), want)
+
+
+# ---------------------------------------------------------------------------
+# engine contracts
+
+
+def test_mutation_off_bit_exact():
+    """The mutation-off contract (satellite a): a dynamic_topo build
+    fed all-padding batches matches the plain dynamic_peers build
+    bit-exactly on every non-overlay leaf, and the overlay planes
+    never move (epoch stays zero)."""
+    _, net, cfg, st_dyn, step_dyn = _cell(dynamic_topo=True)
+    *_, st_ref, step_ref = _cell(dynamic_topo=False)
+    po, pt, pv = _publishes()
+    writes = _pad_writes()
+    up = jnp.ones((N,), bool)
+    init_topo = _leaves(st_dyn.core.topo)
+    for t in range(D):
+        args = (jnp.asarray(po[t]), jnp.asarray(pt[t]), jnp.asarray(pv[t]))
+        st_dyn = step_dyn(st_dyn, *args, up, jnp.asarray(writes[t]))
+        st_ref = step_ref(st_ref, *args, up)
+    got = _leaves(st_dyn, skip_topo=True)
+    want = _leaves(st_ref)
+    assert set(got) == set(want)
+    diff = [k for k in want if not np.array_equal(got[k], want[k])]
+    assert not diff, f"mutation-off diverged on {diff}"
+    final_topo = _leaves(st_dyn.core.topo)
+    assert all(np.array_equal(final_topo[k], init_topo[k])
+               for k in init_topo)
+    assert int(final_topo[".epoch"].sum()) == 0
+
+
+def test_dense_csr_parity_under_mutation():
+    """The same storm through the dense and full-capacity CSR faces
+    finishes with bit-identical counters, delivery and topology."""
+    finals = {}
+    for layout in ("dense", "csr"):
+        tp, net, cfg, st, step = _cell(edge_layout=layout)
+        sched = _storm(tp)
+        writes, up = sched.build()
+        po, pt, pv = _publishes()
+        for t in range(D):
+            st = step(st, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                      jnp.asarray(pv[t]), jnp.asarray(up[t]),
+                      jnp.asarray(writes[t]))
+        finals[layout] = st
+    a, b = finals["dense"], finals["csr"]
+    assert np.array_equal(np.asarray(a.core.events),
+                          np.asarray(b.core.events))
+    assert np.array_equal(np.asarray(a.core.dlv.have),
+                          np.asarray(b.core.dlv.have))
+    ta, tb = _leaves(a.core.topo), _leaves(b.core.topo)
+    assert all(np.array_equal(ta[k], tb[k]) for k in ta)
+
+
+def test_scan_vs_loop_parity():
+    """The storm scanned (mutation batches riding the xs) equals the
+    python-loop dispatch sequence bit-exactly on every leaf."""
+    tp, net, cfg, st0, step = _cell()
+    sched = _storm(tp)
+    writes, up = sched.build()
+    po, pt, pv = _publishes()
+
+    st_loop = st0
+    for t in range(D):
+        st_loop = step(st_loop, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                       jnp.asarray(pv[t]), jnp.asarray(up[t]),
+                       jnp.asarray(writes[t]))
+
+    *_, st1, _ = _cell()   # fresh state: the loop donated st0's buffers
+
+    def body(st, xs):
+        return step(st, *xs), None
+
+    xs = tuple(jnp.asarray(x) for x in (po, pt, pv, up, writes))
+    st_scan = jax.jit(lambda s, x: jax.lax.scan(body, s, x)[0])(st1, xs)
+    got, want = _leaves(st_scan), _leaves(st_loop)
+    diff = [k for k in want if not np.array_equal(got[k], want[k])]
+    assert not diff, f"scan vs loop diverged on {diff}"
+
+
+# ---------------------------------------------------------------------------
+# chaos re-keying
+
+
+def test_chaos_rekey_symmetric_deterministic_and_local():
+    tp = _topology()
+    subs = graph.subscribe_all(N, 1)
+    net = Net.build(tp, subs, dynamic=True)
+    topo_st = TopoState.from_net(net)
+    seed = jnp.uint32(0xABCD1234)
+
+    u1 = np.asarray(chaos_faults.link_uniform(seed, net.nbr, 5, 0x11D,
+                                              topo=topo_st))
+    u2 = np.asarray(chaos_faults.link_uniform(seed, net.nbr, 5, 0x11D,
+                                              topo=topo_st))
+    assert np.array_equal(u1, u2)
+
+    # symmetric over the involution: both directions of a present edge
+    # draw the same stream
+    nbr = np.asarray(net.nbr)
+    rev = np.asarray(net.rev)
+    ok = np.asarray(net.nbr_ok)
+    for i, k in np.argwhere(ok)[:16]:
+        j, kr = nbr[i, k], rev[i, k]
+        assert u1[i, k] == u1[j, kr]
+
+    # local: bumping ONE edge's endpoint epochs redraws exactly that
+    # link's stream (both directions), nothing else
+    i, k = [int(v) for v in np.argwhere(ok)[0]]
+    j, kr = int(nbr[i, k]), int(rev[i, k])
+    ep = topo_st.epoch.at[i, k].add(1)
+    ep = ep.at[j, kr].add(1)
+    u3 = np.asarray(chaos_faults.link_uniform(
+        seed, net.nbr, 5, 0x11D, topo=topo_st.replace(epoch=ep)))
+    assert u3[i, k] != u1[i, k]
+    assert u3[i, k] == u3[j, kr]
+    changed = u3 != u1
+    changed[i, k] = changed[j, kr] = False
+    assert not changed.any()
+
+    # the static path (topo=None) ignores the overlay entirely
+    s1 = np.asarray(chaos_faults.link_uniform(seed, net.nbr, 5, 0x11D))
+    s2 = np.asarray(chaos_faults.link_uniform(seed, net.nbr, 5, 0x11D))
+    assert np.array_equal(s1, s2)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint + artifact surfaces
+
+
+def test_checkpoint_v6_roundtrip_mid_storm(tmp_path):
+    """The mutated overlay rides checkpoint v6 pytree-generically — no
+    format bump — and restores bit-exact mid-storm."""
+    assert checkpoint._FORMAT_VERSION == 6
+    tp, net, cfg, st, step = _cell()
+    sched = _storm(tp)
+    writes, up = sched.build()
+    po, pt, pv = _publishes()
+    mid = D // 2
+    for t in range(mid):
+        st = step(st, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                  jnp.asarray(pv[t]), jnp.asarray(up[t]),
+                  jnp.asarray(writes[t]))
+    assert int(np.asarray(st.core.topo.epoch).sum()) > 0  # storm is live
+    path = str(tmp_path / "mid.ckpt")
+    checkpoint.save(path, st)
+    template = _cell()[3]
+    back = checkpoint.restore(path, template)
+    got, want = _leaves(back), _leaves(st)
+    assert all(np.array_equal(got[k], want[k]) for k in want)
+
+
+def test_dynamics_fingerprint_roundtrip(tmp_path):
+    from go_libp2p_pubsub_tpu.perf.artifacts import (
+        DYNAMICS_OFF,
+        BenchRecord,
+        dump_record,
+        dynamics_fingerprint,
+        load_bench_lines,
+    )
+
+    fp = dynamics_fingerprint(mutation_dispatches=3, writes_per_dispatch=8,
+                              kills=2, joins=1, rewires=4,
+                              schedule_hash="ab" * 32)
+    rec = BenchRecord(metric="m", value=1.0, unit="r/s", vs_baseline=0.0,
+                      schema=3, fingerprint={"dynamics": fp})
+    path = str(tmp_path / "bench.json")
+    with open(path, "w") as f:
+        f.write(dump_record(rec) + "\n")
+    back = load_bench_lines(path)[0]
+    assert back.dynamics == fp
+    assert back.dynamics_on
+
+    legacy = BenchRecord(metric="m", value=1.0, unit="r/s",
+                         vs_baseline=0.0)
+    assert legacy.dynamics == DYNAMICS_OFF
+    assert not legacy.dynamics_on
+
+
+# ---------------------------------------------------------------------------
+# build validation
+
+
+def test_make_step_validation_raises():
+    tp = _topology()
+    subs = graph.subscribe_all(N, 1)
+    net = Net.build(tp, subs, dynamic=True)
+    params = dataclasses.replace(GossipSubParams(), flood_publish=False)
+    cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
+                                score_enabled=False)
+    with pytest.raises(ValueError, match="dynamic_peers"):
+        make_gossipsub_step(cfg, net, dynamic_topo=True)
+
+    # a banded (non-dynamic) net bakes edge geometry at trace time
+    ring = graph.ring_lattice(N, d=4)
+    net_banded = Net.build(ring, subs)
+    if net_banded.band_off is not None:
+        with pytest.raises(ValueError, match="unbanded"):
+            make_gossipsub_step(cfg, net_banded, dynamic_peers=True,
+                                dynamic_topo=True)
+
+    # do_px binds connection state to static slot identity
+    px_params = dataclasses.replace(params, do_px=True)
+    px_cfg = GossipSubConfig.build(px_params, PeerScoreThresholds(),
+                                   score_enabled=False)
+    with pytest.raises(ValueError, match="do_px"):
+        make_gossipsub_step(px_cfg, net, dynamic_peers=True,
+                            dynamic_topo=True)

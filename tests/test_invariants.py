@@ -431,9 +431,11 @@ def test_invariant_config_validation():
 
 def test_due_vector_layout():
     d = inv.due_vector()
-    assert d.tolist() == [-1, -1, -1, -1, -1, 0]
+    assert d.tolist() == [-1, -1, -1, -1, -1, 0, 0]
     d = inv.due_vector(quiet=(3, 9), recover=(5, 7, 40), grace=True)
-    assert d.tolist() == [3, 9, 5, 7, 40, 1]
+    assert d.tolist() == [3, 9, 5, 7, 40, 1, 0]
+    d = inv.due_vector(mut_grace=True)
+    assert d.tolist() == [-1, -1, -1, -1, -1, 0, 1]
 
 
 def test_check_state_rejects_bare_simstate_for_mesh_engine(net):

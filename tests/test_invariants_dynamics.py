@@ -1,0 +1,183 @@
+"""Seeded-violation negatives for the round-22 dynamic-overlay
+invariants (oracle/invariants.py; topo/dynamics.py; docs/DESIGN.md §22).
+
+Same contract as tests/test_invariants.py: a lived-in DYNAMIC state
+(the gossipsub step built with ``dynamic_topo=True`` — the mutable
+``.core.topo`` plane rides the state tree and the checker rebinds the
+net through ``Net.with_overlay``) passes every property clean, and each
+overlay property is tripped by its own one-leaf corruption with the
+EXACT expected failure set:
+
+  * "edge-involution-wf" — a present slot whose ``edge_perm`` stops
+    being partner-consistent (the involution contract every masked
+    gather assumes, which mutation batches must preserve), and the
+    epoch plane going negative;
+  * "mesh-in-topology" (mutation-aware) — a schedule-driven node kill
+    landing without the engine's same-round mesh cleanup; the same
+    violation is SUSPENDED under ``DUE_MUT_GRACE`` (the re-peering
+    transient window ``MutationSchedule.due_fn`` emits around mutation
+    ticks) and trips again outside it.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu import graph
+from go_libp2p_pubsub_tpu.config import GossipSubParams, PeerScoreThresholds
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSubConfig,
+    GossipSubState,
+    make_gossipsub_step,
+)
+from go_libp2p_pubsub_tpu.oracle import invariants as inv
+from go_libp2p_pubsub_tpu.state import Net
+from go_libp2p_pubsub_tpu.topo import dynamics
+
+N = 48
+M = 64
+ROUNDS = 24
+W = 12
+PAD_B = 4            # static mutation-batch width of the no-op rows
+
+QUIET = inv.due_vector(quiet=(0, ROUNDS))
+
+
+def _params():
+    return GossipSubParams(D=3, Dlo=2, Dhi=4, Dscore=2, Dout=1,
+                           history_length=6, history_gossip=4)
+
+
+def _score_params():
+    from go_libp2p_pubsub_tpu.perf.sweep import bench_score_params
+
+    return bench_score_params("default", 1)[1]
+
+
+def _pad_writes():
+    """One all-padding mutation batch: every row's slot is PAD_SLOT, so
+    the scatter drops all of them (the mutation-off dispatch shape)."""
+    w = np.zeros((PAD_B, 4), np.int32)
+    w[:, 0] = dynamics.PAD_SLOT
+    return jnp.asarray(w)
+
+
+@pytest.fixture(scope="module")
+def lived_in():
+    """(topo, net, cfg, state) after ROUNDS dynamic dispatches (all-pad
+    write batches — the overlay plane rides the carry, untouched): mesh
+    formed, messages delivered. The checker never donates, so tests may
+    read and .at[].set-copy this tree freely."""
+    topo = graph.random_connect(N, d=4, seed=0)
+    subs = graph.subscribe_all(N, 1)
+    net = Net.build(topo, subs, dynamic=True)
+    sp = _score_params()
+    cfg = GossipSubConfig.build(_params(), PeerScoreThresholds(),
+                                score_enabled=True)
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=0,
+                             dynamic_topo=True)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               dynamic_peers=True, dynamic_topo=True)
+    rng = np.random.default_rng(0)
+    up = jnp.ones((N,), bool)
+    writes = _pad_writes()
+    for t in range(ROUNDS):
+        po = np.full((4,), -1, np.int32)
+        if 2 <= t < 5:
+            po[:] = rng.integers(0, N, size=4)
+        st = step(st, jnp.asarray(po), jnp.zeros((4,), jnp.int32),
+                  jnp.ones((4,), bool), up, writes)
+    return topo, net, cfg, st
+
+
+def _check(net, st, cfg, due=None):
+    names = inv.invariant_names("gossipsub")
+    ok = np.asarray(inv.check_state(
+        "gossipsub", net, st, cfg,
+        inv.InvariantConfig(delivery_window=W), due=due))
+    return dict(zip(names, ok.tolist()))
+
+
+def _mesh_edge(st):
+    idx = np.argwhere(np.asarray(st.mesh))
+    assert idx.size, "lived-in dynamic state has an empty mesh"
+    return tuple(int(v) for v in idx[0])
+
+
+def test_clean_dynamic_passes_all(lived_in):
+    """Positive half: the dynamic build's state (overlay plane and all)
+    passes every gossipsub property, delivery clause non-vacuous."""
+    topo, net, cfg, st = lived_in
+    res = _check(net, st, cfg, due=QUIET)
+    assert all(res.values()), {k: v for k, v in res.items() if not v}
+    births = np.asarray(st.core.msgs.birth)
+    assert ((births >= 0) & (births + W <= ROUNDS)).any()
+
+
+def test_edge_involution_violation_trips(lived_in):
+    """A present slot whose edge_perm self-points (instead of aiming at
+    its partner slot) trips exactly "edge-involution-wf" through the
+    overlay-rebound net."""
+    topo, net, cfg, st = lived_in
+    tp = st.core.topo
+    k_dim = tp.nbr.shape[1]
+    i, k = [int(v) for v in np.argwhere(np.asarray(tp.nbr_ok))[0]]
+    tp2 = tp.replace(edge_perm=tp.edge_perm.at[i, k].set(i * k_dim + k))
+    st2 = st.replace(core=st.core.replace(topo=tp2))
+    res = _check(net, st2, cfg)
+    failed = {k_ for k_, v in res.items() if not v}
+    assert failed == {"edge-involution-wf"}, sorted(failed)
+
+
+def test_negative_epoch_trips_involution(lived_in):
+    """The epoch plane is a monotone mutation counter; a negative entry
+    (a torn or miswritten scatter) trips exactly "edge-involution-wf"."""
+    topo, net, cfg, st = lived_in
+    tp = st.core.topo
+    tp2 = tp.replace(epoch=tp.epoch.at[0, 0].set(-1))
+    st2 = st.replace(core=st.core.replace(topo=tp2))
+    res = _check(net, st2, cfg)
+    failed = {k for k, v in res.items() if not v}
+    assert failed == {"edge-involution-wf"}, sorted(failed)
+
+
+def test_mutation_kill_trips_mesh_in_topology(lived_in):
+    """Mutation-aware "mesh-in-topology": a schedule kill takes a mesh
+    neighbor DOWN without the engine's same-round cleanup — the checker
+    trips exactly that property outside the grace window and suspends
+    it inside DUE_MUT_GRACE (the re-peering transient the schedule's
+    due_fn emits around mutation ticks)."""
+    topo, net, cfg, st = lived_in
+    i, s, k = _mesh_edge(st)
+    j = int(np.asarray(st.core.topo.nbr)[i, k])
+    sched = dynamics.MutationSchedule(topo.nbr, topo.nbr_ok, topo.rev,
+                                      n_dispatches=1)
+    sched.kill(0, j)
+    _, up_rows = sched.build()
+    st2 = st.replace(up=jnp.asarray(up_rows[0]))
+    res = _check(net, st2, cfg)
+    failed = {k_ for k_, v in res.items() if not v}
+    assert failed == {"mesh-in-topology"}, sorted(failed)
+    graced = _check(net, st2, cfg, due=inv.due_vector(mut_grace=True))
+    assert graced["mesh-in-topology"]
+
+
+def test_first_edge_wf_graced_under_mutation(lived_in):
+    """The mutation-aware grace also scopes "first-edge-wf": the same
+    double-attribution corruption that trips it outside the window
+    (tests/test_invariants.py) is suspended inside DUE_MUT_GRACE."""
+    topo, net, cfg, st = lived_in
+    dlv = st.core.dlv
+    slot = int(np.argwhere(np.asarray(st.core.msgs.valid))[0][0])
+    w, b = slot // 32, np.uint32(1) << np.uint32(slot % 32)
+    have = dlv.have.at[0, w].set(dlv.have[0, w] | b)
+    fe = dlv.fe_words
+    fe = fe.at[0, 0, w].set(fe[0, 0, w] | b)
+    fe = fe.at[0, 1, w].set(fe[0, 1, w] | b)
+    st2 = st.replace(core=st.core.replace(
+        dlv=dlv.replace(have=have, fe_words=fe)))
+    res = _check(net, st2, cfg)
+    failed = {k for k, v in res.items() if not v}
+    assert failed == {"first-edge-wf"}, sorted(failed)
+    graced = _check(net, st2, cfg, due=inv.due_vector(mut_grace=True))
+    assert graced["first-edge-wf"]
