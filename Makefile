@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit static tune-smoke tune-check fuse-smoke churn-smoke
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit range-audit static tune-smoke tune-check fuse-smoke churn-smoke
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -199,6 +199,20 @@ hlo-audit:
 cost-audit:
 	python scripts/cost_audit.py
 
+# static range/overflow gate (scripts/range_audit.py; docs/DESIGN.md
+# §23): the jaxpr-level interval interpreter walks every engine×layout
+# build and proves the value-range contracts — sub-i32 arithmetic
+# non-wrapping (the narrow_counters int16 proof, machine-checked),
+# every gather/scatter index in-bounds or named in the sanctioned
+# mode=drop catalog, explicit PROVEN_I32/NEEDS_I64 verdicts per
+# flat-index site at 100k/1M/10M under audit + flood-envelope
+# geometries, per-EV-counter overflow horizons above the floor, and the
+# source .astype narrowing manifest. Committed RANGE_AUDIT.json must
+# reproduce byte-identical (RANGE_UPDATE=1 rewrites; a mismatch NAMES
+# the diverging keys). Trace-only, ~15 s.
+range-audit:
+	python scripts/range_audit.py
+
 # fused-plane gate (scripts/fuse_smoke.py; docs/DESIGN.md §21): the
 # bench gossipsub step on the CSR edge plane fused-off vs fused-on —
 # the fused-off compiled kernel census must EQUAL the on-image
@@ -234,7 +248,7 @@ tune-check:
 	python scripts/tune_check.py
 
 # the whole static suite as ONE verdict (round 19): simlint + guards +
-# lift-audit + hlo-audit + cost-audit + tune-check, one
+# lift-audit + hlo-audit + cost-audit + tune-check + range-audit, one
 # machine-readable JSON block (per-pass pass/fail + artifact paths),
 # one exit code.
 static:
@@ -253,13 +267,14 @@ static:
 # pinned against the committed STATE_SCHEMA.json (ANALYZE_UPDATE=1
 # rewrites). CPU-only by contract. Since round 16 the target also
 # runs the lift-audit and hlo-audit legs above; since round 19 the
-# cost-audit leg too (`make static` is the same suite as one JSON
-# verdict).
+# cost-audit leg too, and since round 23 the range-audit leg (`make
+# static` is the same suite as one JSON verdict).
 analyze:
 	python scripts/analyze.py
 	python scripts/lift_audit.py
 	python scripts/hlo_audit.py
 	python scripts/cost_audit.py
+	python scripts/range_audit.py
 
 # declarative (config x N x r) sweep — e.g. the eth2 shard table:
 #   make sweep SWEEP_ARGS='--config eth2 --n 12500,25000,50000 --r 16'
@@ -287,6 +302,7 @@ quick:
 	python scripts/lift_audit.py
 	python scripts/hlo_audit.py
 	python scripts/cost_audit.py
+	python scripts/range_audit.py
 	python scripts/tune_check.py
 	python scripts/tune_report.py --smoke
 	python scripts/memstat.py

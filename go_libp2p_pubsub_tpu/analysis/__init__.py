@@ -28,9 +28,16 @@ invariants* that make those outputs trustworthy as the codebase grows:
     contracts (halo ratio == density == measured tally; floodsub
     rng == 0; telemetry/oracle flop-share ceilings) and the v5e-8
     roofline term perf.projection arms from it (docs/DESIGN.md §19).
+  * ``ranges`` — the round-23 static range/overflow auditor: interval
+    abstract interpretation over the same engine×layout jaxprs proving
+    sub-i32 arithmetic non-wrapping, gather/scatter indices in-bounds
+    (or named in a sanctioned drop catalog), explicit i32/i64
+    index-width verdicts at 100k/1M/10M, and per-EV-counter overflow
+    horizons (RANGE_AUDIT.json; docs/DESIGN.md §23). simlint's
+    ``narrow-dtype`` rule cross-checks its .astype manifest.
 
 Entry point: ``scripts/analyze.py`` / ``make analyze`` (wired into
-``make quick``); ``make static`` emits the whole five-pass suite as
+``make quick``); ``make static`` emits the whole six-pass suite as
 one JSON verdict. docs/DESIGN.md §9 has the rule catalog.
 """
 

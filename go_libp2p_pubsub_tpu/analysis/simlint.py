@@ -86,6 +86,17 @@ Rule catalog (ids are stable; docs/DESIGN.md §9):
                  trip is a rubber stamp, the exact failure mode the
                  oracle plane exists to prevent).
 
+  narrow-dtype   (round 23) every ``.astype`` to a sub-i32 integer
+                 dtype in device scope must correspond, positionally
+                 per file, to the declared manifest the range auditor
+                 commits into ``RANGE_AUDIT.json``
+                 (``narrow_astype_manifest`` — each entry carries its
+                 range justification in analysis/ranges.py's
+                 ``NARROW_ASTYPE_MANIFEST``). A new narrowing cast
+                 without a committed range argument is exactly how the
+                 next int16/int8 wrap ships; run ``make range-audit``
+                 after extending the manifest.
+
   donated-reuse  (round 19 — the only CALL-SITE rule: it lints the
                  repo's tests/ and scripts/ trees, not the package)
                  reuse of a state tree after it was passed to a
@@ -1110,6 +1121,99 @@ def lint_callsites(repo_root: str) -> list:
 
 
 # ---------------------------------------------------------------------------
+# package rule: narrow-dtype (the RANGE_AUDIT manifest cross-check)
+
+
+#: sub-i32 integer dtype names a ``.astype`` may narrow to — the set
+#: the range auditor's manifest must account for
+_NARROW_INT_NAMES = frozenset({"int8", "int16", "uint8", "uint16"})
+
+
+def _narrow_dtype_of(node: ast.AST) -> str | None:
+    """The sub-i32 integer dtype one ``.astype`` argument names, else
+    None (widening casts, float casts and dynamic dtypes pass)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _NARROW_INT_NAMES else None
+    if isinstance(node, ast.Attribute):
+        return node.attr if node.attr in _NARROW_INT_NAMES else None
+    if isinstance(node, ast.Name):
+        return node.id if node.id in _NARROW_INT_NAMES else None
+    return None
+
+
+def narrow_astype_sites(src: str, rel: str) -> list:
+    """Ordered ``(line, dtype)`` of every sub-i32 integer ``.astype``
+    callsite in one source — the scanner analysis/ranges.py uses to
+    build the committed manifest and this rule replays against it."""
+    out = []
+    for node in ast.walk(ast.parse(src)):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype" and node.args):
+            dt = _narrow_dtype_of(node.args[0])
+            if dt is not None:
+                out.append((node.lineno, dt))
+    out.sort()
+    return out
+
+
+def iter_device_sources(pkg_root: str):
+    """(rel, src) for every device-scope package source."""
+    for rel, src in _iter_package_sources(pkg_root):
+        if _in_device_scope(rel):
+            yield rel, src
+
+
+def check_narrow_dtype(found: dict, manifest: dict) -> list:
+    """The narrow-dtype rule on explicit inputs (unit-testable):
+    ``found`` maps rel -> ordered (line, dtype) scan results, and the
+    per-file dtype sequence must EQUAL the committed manifest — extra
+    sites are unaudited narrowing casts, missing ones mean the
+    manifest (and its range justification) is stale."""
+    out = []
+    for rel in sorted(set(found) | set(manifest)):
+        sites = list(found.get(rel, ()))
+        got = [dt for _line, dt in sites]
+        want = list(manifest.get(rel, ()))
+        if got == want:
+            continue
+        line = sites[0][0] if sites else 1
+        out.append(Violation(
+            "narrow-dtype", rel, line, "",
+            f"sub-i32 .astype sites {got} do not match the committed "
+            f"RANGE_AUDIT manifest {want} — every narrowing cast in "
+            "device scope needs a range justification in "
+            "analysis/ranges.py NARROW_ASTYPE_MANIFEST; extend it and "
+            "re-record with RANGE_UPDATE=1 make range-audit",
+        ))
+    return out
+
+
+def _rule_narrow_dtype(pkg_root: str) -> list:
+    import json
+
+    audit_p = os.path.join(os.path.dirname(pkg_root), "RANGE_AUDIT.json")
+    if not os.path.exists(audit_p):
+        return [Violation(
+            "narrow-dtype", "analysis/ranges.py", 1, "",
+            "RANGE_AUDIT.json is missing — the narrow-dtype manifest "
+            "cross-check needs the committed artifact; run "
+            "RANGE_UPDATE=1 make range-audit",
+        )]
+    with open(audit_p) as f:
+        manifest = json.load(f).get("narrow_astype_manifest", {})
+    found = {}
+    for rel, src in iter_device_sources(pkg_root):
+        try:
+            sites = narrow_astype_sites(src, rel)
+        except SyntaxError:  # pragma: no cover - parse rule reports it
+            continue
+        if sites:
+            found[rel] = sites
+    return check_narrow_dtype(found, manifest)
+
+
+# ---------------------------------------------------------------------------
 # drivers
 
 
@@ -1144,6 +1248,7 @@ def lint_package(pkg_root: str) -> list:
     out.extend(_rule_ev_drain(pkg_root))
     out.extend(_rule_telemetry_panel(pkg_root))
     out.extend(_rule_invariant_registry(pkg_root))
+    out.extend(_rule_narrow_dtype(pkg_root))
     return sorted(out, key=lambda v: (v.rel, v.line, v.rule))
 
 

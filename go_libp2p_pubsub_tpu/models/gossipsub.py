@@ -260,22 +260,26 @@ class GossipSubConfig:
             raise ValueError(
                 f"edge_layout must be 'dense' or 'csr', got {edge_layout!r}"
             )
-        if narrow_counters and p.max_ihave_length >= 2 ** 15:
+        # derived from the counter dtype, not hard-coded — the range
+        # auditor (analysis/ranges.py, contract narrow-nonwrap) proves
+        # the int16 sites non-wrapping under exactly these caps
+        i16_cap = int(np.iinfo(np.int16).max) + 1
+        if narrow_counters and p.max_ihave_length >= i16_cap:
             # the iasked counter saturates at the cap it gates on; a cap
             # outside int16 range would overflow before the gate fires
             raise ValueError(
-                f"narrow_counters needs max_ihave_length < {2**15} "
+                f"narrow_counters needs max_ihave_length < {i16_cap} "
                 f"(got {p.max_ihave_length}) — the int16 iasked counter "
                 "must be able to represent its own cap"
             )
-        if narrow_counters and heartbeat_every >= 2 ** 15:
+        if narrow_counters and heartbeat_every >= i16_cap:
             # peerhave's true bound is the heartbeat clear cadence, not
             # max_ihave_messages: it counts one IHAVE batch per round
             # (handle_ihave) and only clearIHaveCounters resets it, so
             # an edge advertising every round reaches heartbeat_every
             # before the clear
             raise ValueError(
-                f"narrow_counters needs heartbeat_every < {2**15} "
+                f"narrow_counters needs heartbeat_every < {i16_cap} "
                 f"(got {heartbeat_every}) — the int16 peerhave counter "
                 "grows once per round until the heartbeat clear"
             )

@@ -185,6 +185,46 @@ def state_digest(state) -> str:
     return h.hexdigest()
 
 
+def overflow_horizon_note(total_rounds: int | None = None,
+                          repo_root: str | None = None) -> str | None:
+    """One-line startup note from the committed range audit
+    (``RANGE_AUDIT.json``, analysis/ranges.py §23): the tightest proven
+    int32 event-counter horizon and its f32 telemetry-exactness analogue,
+    compared against the planned run length when given. Reads the JSON
+    artifact directly — no interpreter import, so startup cost is one
+    file read — and returns ``None`` when the artifact is absent or
+    malformed (a missing audit never blocks serving; ``make range-audit``
+    is the gate that enforces its presence in CI, not the service)."""
+    root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        with open(os.path.join(root, "RANGE_AUDIT.json")) as f:
+            horizons = json.load(f)["horizons"]
+        active = [(name, row) for name, row in horizons["events"].items()
+                  if row["i32_horizon_rounds"] is not None]
+        if not active:
+            return None
+        i32_name, i32_row = min(active,
+                                key=lambda kv: kv[1]["i32_horizon_rounds"])
+        f32_name, f32_row = min(active,
+                                key=lambda kv: kv[1]["f32_exact_horizon_rounds"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    i32_h = int(i32_row["i32_horizon_rounds"])
+    f32_h = int(f32_row["f32_exact_horizon_rounds"])
+    note = (
+        f"range audit horizons: tightest int32 event counter is {i32_name} "
+        f"at {i32_h} rounds (per-round delta bound "
+        f"{int(i32_row['per_round_delta_hi'])}); f32 telemetry columns stay "
+        f"exact to {f32_name} at {f32_h} rounds"
+    )
+    if total_rounds is not None:
+        worst = min(i32_h, f32_h)
+        note += (f"; planned {int(total_rounds)} rounds "
+                 + ("fits every horizon" if total_rounds <= worst else
+                    f"EXCEEDS the {worst}-round horizon — drain counters "
+                    "(trace.drain.counter_events) within that window"))
+    return note
 
 
 class Supervisor:
@@ -590,6 +630,9 @@ class Supervisor:
         inv_checks = 0
         obs_acc: list = []
         self._heartbeat(start, "running")
+        note = overflow_horizon_note(total_rounds=total * rps)
+        if note:
+            _log.info("%s", note)
         while start < total:
             L = min(self._seg_len, total - start)
             seg = start // svc.segment_len

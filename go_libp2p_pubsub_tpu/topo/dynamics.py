@@ -59,12 +59,21 @@ def apply_mutation(topo, writes: jax.Array):
     ``TopoState`` with every written slot's ``epoch`` bumped."""
     n, k = topo.nbr.shape
     slot = writes[:, 0]
-    peer = writes[:, 1]
-    rv = writes[:, 2]
+    # clamp the untrusted fields into their planes' ranges BEFORE they
+    # land (range-audit finding, docs/DESIGN.md §23): a malformed batch
+    # row with an in-range slot but an out-of-range peer/rev would
+    # otherwise write an out-of-range (or i32-overflowed peer*K+rev)
+    # edge_perm entry that next round's permute gather indexes with —
+    # the scatter's drop mode only guards the SLOT column. The clamp is
+    # identity for every batch MutationSchedule emits. The written
+    # perm value clamps too (clear rows self-point in [0, N*K)); the
+    # scatter INDEX stays unclamped so padding rows still drop.
+    peer = jnp.clip(writes[:, 1], 0, n - 1)
+    rv = jnp.clip(writes[:, 2], 0, k - 1)
     ok = writes[:, 3] != 0
     nbr_new = jnp.where(ok, peer, -1)
     rev_new = jnp.where(ok, rv, 0)
-    perm_new = jnp.where(ok, peer * k + rv, slot)
+    perm_new = jnp.where(ok, peer * k + rv, jnp.clip(slot, 0, n * k - 1))
 
     def scat(plane, vals):
         flat = plane.reshape(n * k)

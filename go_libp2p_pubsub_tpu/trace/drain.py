@@ -69,6 +69,30 @@ PROTOCOL_NAMES = {0: "/floodsub/1.0.0", 1: "/meshsub/1.0.0", 2: "/meshsub/1.1.0"
 COUNTER_ONLY_EVENTS = (EV.LINK_DOWN, EV.IWANT_RECOVER,
                        EV.ADV_DROP, EV.ADV_IHAVE_LIE, EV.ADV_GRAFT_SPAM)
 
+#: The r>1 accounting caveats, as one machine-surfaced note. This is the
+#: single source of truth: ``TraceSession.accounting_caveats()`` returns
+#: it once the session has observed a step with ``new.tick - prev.tick
+#: > 1``, and ``scripts/tracestat.py`` attaches the same text to its
+#: ``phase_cadence`` caveat flag when its timestamp heuristic detects a
+#: phase trace after the fact (ADVICE round 5: the caveats used to live
+#: only in the ``observe()`` docstring, invisible to ``--json``
+#: consumers).
+PHASE_CADENCE_NOTE = (
+    "phase-cadence trace (control events land at phase "
+    "boundaries): GRAFT/PRUNE event streams can undercount the "
+    "device mutation counters (graft+prune cancellation within "
+    "one phase); the synthesized DROP_RPC queue model excludes "
+    "duplicate arrivals; a late duplicate of a slot recycled "
+    "within its death phase resolves against the end-of-phase "
+    "message id. The chaos-plane counters (LINK_DOWN / "
+    "IWANT_RECOVER, trace/events.py) are exact totals but "
+    "accumulate at phase cadence too — latencies derived from "
+    "them quantize to multiples of r (the delivery plane's "
+    "first_round stamps keep 1-round resolution at every "
+    "cadence). See trace/drain.py \"Phase cadence\" and "
+    "chaos/metrics.py."
+)
+
 
 def peer_id(i: int) -> bytes:
     """Stable opaque peer-id bytes for a peer index."""
@@ -187,6 +211,7 @@ class TraceSession:
         m_cap = None  # learned from first snapshot
         self._m_cap = m_cap
         self.slot_mid: dict[int, bytes] = {}     # slot -> message id bytes
+        self.max_tick_stride = 0  # widest observed new.tick - prev.tick
 
     # -- emission helpers --------------------------------------------------
 
@@ -230,6 +255,17 @@ class TraceSession:
         for s in self.sinks:
             s.close()
 
+    def accounting_caveats(self) -> dict[str, str]:
+        """Caveat-flag -> prose for the strides this session has actually
+        observed. Empty at per-round cadence (every stride == 1): the
+        event stream then reconciles exactly against the device counters
+        with no coarsening. At phase cadence (any ``new.tick - prev.tick
+        > 1``) the phase-boundary caveats apply — same map shape as
+        ``tracestat --json``'s ``caveat_notes`` so callers can merge."""
+        if self.max_tick_stride > 1:
+            return {"phase_cadence": PHASE_CADENCE_NOTE}
+        return {}
+
     # -- per-round / per-phase observation ---------------------------------
 
     def observe(self, prev: Snapshot, new: Snapshot,
@@ -247,13 +283,15 @@ class TraceSession:
           phase gathers prev outboxes once, at its head) and when peer
           transitions apply. Boundary coarsening is the drain-side
           analogue of the engine's r-round control latency; totals stay
-          exact (the accounting suite reconciles them at r > 1 too). One
-          caveat: a mesh edge grafted at the phase head and pruned at
-          the same phase's tail heartbeat (or vice versa) cancels in the
-          boundary diff, so GRAFT/PRUNE *event streams* can undercount
-          the device's mutation counters at r > 1 (rare: requires ingest
-          + immediate heartbeat reversal within one phase).
+          exact (the accounting suite reconciles them at r > 1 too).
+          The caveats that coarsening implies (GRAFT/PRUNE undercount
+          via same-phase graft+prune cancellation, the duplicate-queue
+          exclusion, chaos-counter quantization) are machine-surfaced:
+          once any observed stride exceeds 1, ``accounting_caveats()``
+          returns ``PHASE_CADENCE_NOTE``.
         """
+        self.max_tick_stride = max(self.max_tick_stride,
+                                   int(new.tick) - int(prev.tick))
         tick = prev.tick  # the step's first executed round
         m = len(new.msg_topic)
         # the slot->mid mapping as of the step's START: duplicate arrivals

@@ -22,10 +22,10 @@ human-readable findings go to stderr.
 Flags: ``--lint-only`` / ``--guards-only`` / ``--json``.
 
 ``--json`` (round 19, ``make static``) runs the WHOLE static suite —
-simlint, guards, lift-audit, hlo-audit, cost-audit — and emits ONE
-machine-readable verdict block: per-pass pass/fail plus the committed
-artifact path(s) each pass gates on, with a single exit code over all
-five. The audit passes run as subprocesses (each pins its own
+simlint, guards, lift-audit, hlo-audit, cost-audit, range-audit — and
+emits ONE machine-readable verdict block: per-pass pass/fail plus the
+committed artifact path(s) each pass gates on, with a single exit code
+over all six. The audit passes run as subprocesses (each pins its own
 platform/PRNG policy); their one-line JSON summaries are embedded.
 """
 
@@ -46,6 +46,7 @@ _SUBPROCESS_PASSES = (
     ("hlo", "hlo_audit.py", ()),
     ("cost", "cost_audit.py", ("COST_AUDIT.json",)),
     ("tune", "tune_check.py", ()),
+    ("ranges", "range_audit.py", ("RANGE_AUDIT.json",)),
 )
 
 

@@ -320,16 +320,30 @@ def _dynamics_block(blocks: dict) -> dict:
         },
         "headroom": {
             # what turning mutation on costs at the scale targets, next
-            # to the frozen gossipsub build it rides on
+            # to the frozen gossipsub build it rides on; index_width is
+            # the range auditor's symbolic flat-index verdict at this N
+            # (analysis/ranges.py scale leg — the audit geometry this
+            # table's projections assume, plus the growth-envelope
+            # geometry as the honest qualifier)
             str(n): {
                 "frozen_mb": round(base * n / 1024 ** 2, 2),
                 "dynamic_mb": round((base + bpp) * n / 1024 ** 2, 2),
                 "added_mb": round(bpp * n / 1024 ** 2, 2),
                 "added_frac": round(bpp / base, 4),
+                "index_width": _index_width(n, "audit"),
+                "index_width_envelope": _index_width(n, "envelope"),
             }
             for n in (1_000_000, 10_000_000)
         },
     }
+
+
+def _index_width(n: int, geometry: str) -> str:
+    """The range auditor's flat-index verdict at one peer count — the
+    headroom table's i32-validity column (analysis/ranges.py)."""
+    from go_libp2p_pubsub_tpu.analysis.ranges import index_width_verdict
+
+    return index_width_verdict(n, geometry)
 
 
 def build_audit() -> dict:
@@ -413,7 +427,8 @@ def main() -> int:
         print(f"\n[{eng}] {tot['bytes_per_peer']:.1f} bytes/peer; "
               "resident state:")
         for n, mb in tot["resident_mb"].items():
-            print(f"  N={int(n):>10,}: {mb:>10.2f} MB")
+            print(f"  N={int(n):>10,}: {mb:>10.2f} MB  "
+                  f"index_width={_index_width(int(n), 'audit')}")
     tier = audit["csr_tier"]["engines"]["gossipsub_csr"]
     print("\ncsr-resident tier (gossipsub): "
           f"{tier['flat_bytes_per_peer_at_full_density']:.0f} B/peer of "
@@ -431,7 +446,9 @@ def main() -> int:
     for n, row in dyn["headroom"].items():
         print(f"  N={int(n):>10,}: +{row['added_mb']:>9.2f} MB "
               f"({row['frozen_mb']:.2f} -> {row['dynamic_mb']:.2f}, "
-              f"+{row['added_frac'] * 100:.1f}%)")
+              f"+{row['added_frac'] * 100:.1f}%) "
+              f"index_width={row['index_width']} "
+              f"(envelope {row['index_width_envelope']})")
     top = sorted(audit["engines"]["gossipsub"]["leaves"],
                  key=lambda r: -r["bytes_per_peer"])[:8]
     print("\nheaviest gossipsub leaves (bytes/peer):")

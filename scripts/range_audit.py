@@ -1,0 +1,105 @@
+"""``make range-audit`` — the static range/overflow gate (docs/DESIGN.md
+§23, analysis/ranges.py).
+
+Two legs, either failing exits non-zero:
+
+  1. **contracts** — the jaxpr-level interval interpreter walks every
+     engine×layout build (the cost-audit registry plus the dynamic
+     overlay, ``narrow_counters`` and event-counting cells) and the
+     hard contracts must hold: every sub-i32 arithmetic site proven
+     non-wrapping; every gather/scatter index proven in-bounds or
+     NAMED in the sanctioned-drop catalog; the 100k/1M/10M symbolic
+     index-width leg carries an explicit PROVEN_I32/NEEDS_I64 verdict
+     per flat-index site with no unacknowledged audit-geometry
+     refutation; every EV counter's overflow horizon above the floor;
+     the source ``.astype`` narrowing sites equal to the declared
+     manifest.
+  2. **byte-identical reproduction** — the committed
+     ``RANGE_AUDIT.json`` must equal this run's audit byte for byte
+     (the COST_AUDIT pattern); a mismatch NAMES the diverging keys.
+     ``RANGE_UPDATE=1`` rewrites.
+
+Pure tracing + numpy interval arithmetic — no compile, no execution,
+PRNG-impl-independent. ~15 s warm. Emits one JSON summary line;
+findings to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from go_libp2p_pubsub_tpu.analysis import ranges as rg
+
+    failures: list[str] = []
+    try:
+        payload = rg.build_audit()
+    except rg.RangeContractViolation as e:
+        print(f"range-audit FAIL: {e}", file=sys.stderr)
+        print(json.dumps({"range_audit": "FAIL", "artifact": "contract",
+                          "contract": e.contract, "build": e.build,
+                          "failures": 1}))
+        return 1
+
+    path = rg.audit_path(REPO)
+    text = rg.dump_audit(payload)
+    update = bool(os.environ.get("RANGE_UPDATE"))
+    if update:
+        with open(path, "w") as f:
+            f.write(text)
+        action = "updated"
+    elif not os.path.exists(path):
+        failures.append(
+            f"{rg.AUDIT_NAME} missing — run RANGE_UPDATE=1 "
+            "scripts/range_audit.py to record it")
+        action = "missing"
+    else:
+        with open(path) as f:
+            committed_text = f.read()
+        if committed_text == text:
+            action = "verified"
+        else:
+            action = "stale"
+            try:
+                diverged = rg.baseline_divergences(
+                    json.loads(committed_text), payload)
+                detail = ("diverging keys: " + "; ".join(diverged)
+                          if diverged else
+                          "artifacts parse equal — formatting-only "
+                          "drift (re-serialize with RANGE_UPDATE=1)")
+            except json.JSONDecodeError:
+                detail = "committed artifact is not parseable JSON"
+            failures.append(
+                f"{rg.AUDIT_NAME} does not reproduce byte-identical — "
+                f"the value ranges moved; {detail} "
+                "(review, then RANGE_UPDATE=1 to re-record)")
+
+    summary = {
+        "range_audit": "FAIL" if failures else "PASS",
+        "artifact": action,
+        "builds": sorted(payload["builds"]),
+        "contracts": sorted(payload["contracts"]),
+        "needs_i64": payload["index_width"]["needs_i64"],
+        "min_i32_horizon_rounds": payload["contracts"]
+            ["overflow_horizon"]["min_i32_horizon_rounds"],
+        "failures": len(failures),
+    }
+    if failures:
+        for f in failures:
+            print(f"range-audit FAIL: {f}", file=sys.stderr)
+    print(json.dumps(summary))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,25 +98,15 @@ def _cadence_note(data_ts: set, control_ts: set) -> dict | None:
         ctrl = math.gcd(ctrl, t)
     if len(control_ts) < 2 or not base or not ctrl or ctrl <= base or ctrl % base:
         return None
+    from go_libp2p_pubsub_tpu.trace.drain import PHASE_CADENCE_NOTE
+
     return {
         "tick_ns": base,
         "control_stride_ns": ctrl,
         "rounds_per_phase_estimate": ctrl // base,
-        "note": (
-            "phase-cadence trace (control events land at phase "
-            "boundaries): GRAFT/PRUNE event streams can undercount the "
-            "device mutation counters (graft+prune cancellation within "
-            "one phase); the synthesized DROP_RPC queue model excludes "
-            "duplicate arrivals; a late duplicate of a slot recycled "
-            "within its death phase resolves against the end-of-phase "
-            "message id. The chaos-plane counters (LINK_DOWN / "
-            "IWANT_RECOVER, trace/events.py) are exact totals but "
-            "accumulate at phase cadence too — latencies derived from "
-            "them quantize to multiples of r (the delivery plane's "
-            "first_round stamps keep 1-round resolution at every "
-            "cadence). See trace/drain.py \"Phase cadence\" and "
-            "chaos/metrics.py."
-        ),
+        # single source of truth: the drain session surfaces the same
+        # text live via TraceSession.accounting_caveats()
+        "note": PHASE_CADENCE_NOTE,
     }
 
 

@@ -517,6 +517,45 @@ def test_invariant_registry_rule_on_repo_source():
     assert simlint._rule_invariant_registry(PKG) == []
 
 
+def test_narrow_dtype_rule_negatives():
+    """The narrow-dtype rule (round 23): every sub-i32 ``.astype`` in
+    device scope must appear, positionally, in the committed
+    RANGE_AUDIT.json manifest — an unlisted narrowing cast is an
+    unaudited wrap hazard, a listed-but-vanished one is a stale range
+    justification."""
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+        def pack(x):
+            a = x.astype(jnp.int16)
+            b = x.astype("uint8")
+            return a, b.astype(np.float32)  # widening/float casts pass
+    """)
+    sites = simlint.narrow_astype_sites(src, "ops/broken.py")
+    assert [dt for _ln, dt in sites] == ["int16", "uint8"]
+
+    # unlisted site (seeded negative)
+    vs = simlint.check_narrow_dtype({"ops/broken.py": sites}, {})
+    assert vs and all(v.rule == "narrow-dtype" for v in vs)
+    assert any("do not match the committed RANGE_AUDIT manifest" in v.msg
+               for v in vs)
+    # exact positional match passes; a reorder or a stale entry fails
+    assert simlint.check_narrow_dtype(
+        {"ops/broken.py": sites}, {"ops/broken.py": ("int16", "uint8")}) == []
+    assert simlint.check_narrow_dtype(
+        {"ops/broken.py": sites}, {"ops/broken.py": ("uint8", "int16")})
+    assert simlint.check_narrow_dtype(
+        {}, {"ops/gone.py": ("int8",)})
+
+
+def test_narrow_dtype_rule_on_repo_source():
+    """The in-tree device scope matches the committed manifest exactly
+    (the int8 delivery-plane pack in ops/pallas_delivery.py), and a
+    missing artifact is itself a violation, not a silent pass."""
+    assert simlint._rule_narrow_dtype(PKG) == []
+    vs = simlint._rule_narrow_dtype(os.path.join(PKG, "analysis"))
+    assert vs and "RANGE_AUDIT.json is missing" in vs[0].msg
+
+
 def test_allowlist_filters_by_qual(tmp_path):
     vs = lint("""
         def drain(state):

@@ -487,8 +487,10 @@ def test_analyze_json_umbrella_verdict_block():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert block["static"] == "PASS"
     assert set(block["passes"]) == {"simlint", "guards", "lift", "hlo",
-                                    "cost"}
+                                    "cost", "tune", "ranges"}
     for name, p in block["passes"].items():
         assert p["status"] == "PASS", name
         assert "artifacts" in p
     assert block["passes"]["cost"]["artifacts"] == ["COST_AUDIT.json"]
+    assert block["passes"]["ranges"]["artifacts"] == ["RANGE_AUDIT.json"]
+    assert block["passes"]["ranges"]["summary"]["artifact"] == "verified"

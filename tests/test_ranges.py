@@ -1,0 +1,275 @@
+"""Static range/overflow audit tests (analysis/ranges.py, `make
+range-audit`, docs/DESIGN.md §23).
+
+Three layers:
+
+* interpreter units — the interval domain walked over tiny hand-built
+  jaxprs (comparison folding, feasibility-aware select, scan widening,
+  the exact pinned-scatter path);
+* contract negatives — every hard contract tripped by a DOCTORED
+  input and the violation message checked to NAME the exact eqn/leaf
+  (the no-silent-pass property is itself under test);
+* artifact pins — the committed RANGE_AUDIT.json carries the proofs
+  the prose claims (the PR-11 int16 bound, the envelope NEEDS_I64
+  refutations, the per-EV horizons), and a doctored copy diverges by
+  NAME through costmodel.baseline_divergences.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.analysis import ranges as rg
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _walk(fn, in_ivals, *example_args):
+    """Trace fn, walk the jaxpr with the given input intervals."""
+    jpr = jax.make_jaxpr(fn)(*example_args)
+    rec = rg.Recorder()
+    outs = rg.interp_closed(jpr, list(in_ivals), rec)
+    return outs, rec
+
+
+# ---------------------------------------------------------------------------
+# interpreter units
+
+
+def test_interval_arithmetic_affine():
+    x = jnp.zeros(4, jnp.int32)
+    outs, _ = _walk(lambda x: x * 2 + 1, [rg._full((4,), 0, 10)], x)
+    lo, hi = outs[0]
+    assert float(lo.min()) == 1 and float(hi.max()) == 21
+
+
+def test_comparison_folding_feeds_select():
+    # the jnp.mod / negative-index fix-up shape: select_n(x < 0, x, x+7)
+    # with x proven non-negative must NOT union in the x+7 arm — that
+    # false widening is what used to break every gather bound proof
+    x = jnp.zeros(4, jnp.int32)
+    outs, _ = _walk(lambda x: jnp.where(x < 0, x + 7, x),
+                    [rg._full((4,), 0, 6)], x)
+    lo, hi = outs[0]
+    assert float(lo.min()) == 0 and float(hi.max()) == 6
+
+
+def test_scan_carry_widens_to_dtype_top():
+    # a growing carry cannot keep its seeded bounds across unknown
+    # iteration counts — soundness demands dtype-top, not [0, length]
+    def f(c):
+        out, _ = jax.lax.scan(lambda c, _: (c + 1, c), c,
+                              jnp.zeros(4, jnp.int32))
+        return out
+
+    outs, _ = _walk(f, [rg._full((), 0, 0)], jnp.int32(0))
+    assert float(outs[0][1].max()) == float(np.iinfo(np.int32).max)
+
+
+def test_gather_in_bounds_proven():
+    a = jnp.zeros(8, jnp.int32)
+    i = jnp.zeros(3, jnp.int32)
+    outs, rec = _walk(lambda a, i: a[i],
+                      [rg._full((8,), 0, 5), rg._full((3,), 0, 7)], a, i)
+    sites = [s for s in rec.index if s.primitive == "gather"]
+    assert sites and all(s.proven for s in sites)
+    assert float(outs[0][1].max()) == 5  # operand bounds flow through
+    # and the triage accepts it without any catalog entry
+    res = rg.check_index_bounds("unit", rec.index, {})
+    assert res["proven"] == len(rec.index) and not res["sanctioned"]
+
+
+def test_gather_oob_promise_is_violation():
+    # DOCTORED: index interval [0, 8] into an 8-slot operand under jnp's
+    # default PROMISE_IN_BOUNDS — must refuse with the exact eqn path,
+    # and a sanctioned-drop catalog entry must NOT rescue a promise mode
+    a = jnp.zeros(8, jnp.int32)
+    i = jnp.zeros(3, jnp.int32)
+    _, rec = _walk(lambda a, i: a[i],
+                   [rg._full((8,), 0, 5), rg._full((3,), 0, 8)], a, i)
+    bad = [s for s in rec.index if not s.proven]
+    assert bad, "the OOB gather site must be recorded as unproven"
+    with pytest.raises(rg.RangeContractViolation) as e:
+        rg.check_index_bounds("unit", rec.index,
+                              {"gather": "not a rescue for promise modes"})
+    assert e.value.contract == "index-bounds"
+    assert "eqns[" in str(e.value) and "undefined behavior" in str(e.value)
+
+
+def test_pinned_scatter_add_is_per_slot_exact():
+    # the counters.at[EV.X].add(n) shape: only the addressed slot moves
+    c = jnp.zeros(18, jnp.int32)
+    n = jnp.int32(0)
+    outs, rec = _walk(lambda c, n: c.at[3].add(n),
+                      [rg._full((18,), 0, 0), rg._full((), 0, 5)], c, n)
+    lo, hi = outs[0]
+    assert float(hi[3]) == 5
+    assert float(np.delete(np.asarray(hi), 3).max()) == 0
+    assert all(s.proven for s in rec.index)
+
+
+def test_narrow_nonwrap_negative_names_eqn():
+    # DOCTORED: int16 x + x seeded near the dtype ceiling wraps; the
+    # violation must name the eqn and the sub-i32 dtype
+    x = jnp.zeros(2, jnp.int16)
+    _, rec = _walk(lambda x: x + x, [rg._full((2,), 0, 30000)], x)
+    assert rec.narrow and not rec.narrow[-1].fits
+    with pytest.raises(rg.RangeContractViolation) as e:
+        rg.check_narrow_nonwrap("unit", rec.narrow)
+    assert e.value.contract == "narrow-nonwrap"
+    assert "eqns[" in str(e.value) and "int16" in str(e.value)
+
+    # in-range bounds prove clean through the same checker
+    _, rec2 = _walk(lambda x: x + x, [rg._full((2,), 0, 100)], x)
+    assert rec2.narrow and all(s.fits for s in rec2.narrow)
+    rg.check_narrow_nonwrap("unit", rec2.narrow)
+
+
+# ---------------------------------------------------------------------------
+# symbolic index-width leg
+
+
+def test_scale_leg_verdicts_explicit_everywhere():
+    leg = rg.scale_leg()
+    for geo in leg.values():
+        for row in geo["sites"].values():
+            for cell in row["by_n"].values():
+                assert cell["verdict"] in ("PROVEN_I32", "NEEDS_I64")
+    # audit geometry (k=16, m=64) holds i32 through 10M; the flood
+    # envelope (k=64, m=1024) is the honest refuter at 10M
+    refuted = rg.check_index_width(leg)
+    assert refuted and all(r.startswith("envelope.") for r in refuted)
+    assert "envelope.flat_ew.10000000" in refuted
+
+
+def test_index_width_missing_verdict_is_no_silent_pass():
+    leg = rg.scale_leg()
+    leg["audit"]["sites"]["col"]["by_n"]["100000"]["verdict"] = None
+    with pytest.raises(rg.RangeContractViolation) as e:
+        rg.check_index_width(leg)
+    assert "index_width.audit.sites.col.by_n.100000" in str(e.value)
+    assert "no silent pass" in str(e.value)
+
+
+def test_index_width_audit_refutation_needs_acknowledgment():
+    leg = rg.scale_leg()
+    leg["audit"]["sites"]["flat_ew"]["by_n"]["10000000"]["verdict"] = \
+        "NEEDS_I64"
+    with pytest.raises(rg.RangeContractViolation) as e:
+        rg.check_index_width(leg)  # I64_ACKNOWLEDGED is empty
+    assert "index_width.audit.sites.flat_ew.by_n.10000000" in str(e.value)
+    # the same doctored leg passes once the site is acknowledged
+    refuted = rg.check_index_width(leg, acknowledged=("flat_ew",))
+    assert "audit.flat_ew.10000000" in refuted
+
+
+def test_index_width_verdict_for_memstat():
+    assert rg.index_width_verdict(256) == "PROVEN_I32"
+    assert rg.index_width_verdict(10_000_000, "audit") == "PROVEN_I32"
+    assert rg.index_width_verdict(10_000_000, "envelope") == "NEEDS_I64"
+
+
+# ---------------------------------------------------------------------------
+# overflow horizons + narrow manifest
+
+
+def test_horizons_from_deltas():
+    h = rg.horizons_from_deltas({"QUIET": 0, "BUSY": 524288})
+    assert h["QUIET"]["i32_horizon_rounds"] is None
+    assert h["BUSY"]["i32_horizon_rounds"] == (2 ** 31 - 1) // 524288
+    assert h["BUSY"]["f32_exact_horizon_rounds"] == 2 ** 24 // 524288
+
+
+def test_horizon_below_floor_names_counter():
+    with pytest.raises(rg.RangeContractViolation) as e:
+        rg.horizons_from_deltas({"HOT": 2 ** 31})
+    assert e.value.contract == "overflow-horizon"
+    assert "horizons.events.HOT" in str(e.value)
+
+
+def test_narrow_manifest_mismatch_names_file():
+    found = dict(rg.NARROW_ASTYPE_MANIFEST)
+    rg.check_narrow_manifest(found)  # identity passes
+    found["models/doctored.py"] = ("int8",)
+    with pytest.raises(rg.RangeContractViolation) as e:
+        rg.check_narrow_manifest(found)
+    assert "narrow_astype_manifest.models/doctored.py" in str(e.value)
+
+    # and a declared-but-vanished site fails the other direction
+    with pytest.raises(rg.RangeContractViolation):
+        rg.check_narrow_manifest({}, manifest={"ops/x.py": ("int16",)})
+
+
+def test_narrow_astype_scan_matches_manifest():
+    found = {rel: tuple(dts)
+             for rel, dts in rg.narrow_astype_scan().items()}
+    assert found == dict(rg.NARROW_ASTYPE_MANIFEST)
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact
+
+
+def _committed():
+    with open(os.path.join(ROOT, rg.AUDIT_NAME)) as f:
+        return json.load(f)
+
+
+def test_committed_artifact_pins_the_proofs():
+    audit = _committed()
+    assert all(c["pass"] for c in audit["contracts"].values())
+    # the PR-11 narrow_counters int16 proof, machine-checked: exactly
+    # the peerhave/iasked accumulate sites, with REAL bounds (not top)
+    narrow = audit["builds"]["narrow"]["narrow"]
+    i16 = [s for s in narrow["sites"] if s["dtype"] == "int16"]
+    assert len(i16) == 4 and all(s["fits"] for s in i16)
+    assert max(s["hi"] for s in i16) <= 128
+    # index triage: every build fully triaged, no unproven-unsanctioned
+    for name, b in audit["builds"].items():
+        assert b["index"]["proven"] + len(b["index"]["sanctioned"]) \
+            == b["index"]["checked"], name
+    # envelope-only i64 refutations, audit geometry clean
+    assert audit["index_width"]["needs_i64"] == [
+        "envelope.dense_nkw.10000000",
+        "envelope.first_round_nm.10000000",
+        "envelope.flat_ew.10000000",
+    ]
+    floor = audit["horizons"]["floor_rounds"]
+    worst = audit["contracts"]["overflow_horizon"]["min_i32_horizon_rounds"]
+    assert worst >= floor
+    ev = audit["horizons"]["events"]
+    assert ev["DUPLICATE_MESSAGE"]["i32_horizon_rounds"] == worst
+
+
+def test_doctored_artifact_diverges_by_name():
+    # the byte-identity gate's mismatch report: a single doctored leaf
+    # is NAMED by its JSON key path (costmodel.baseline_divergences)
+    audit = _committed()
+    doctored = json.loads(json.dumps(audit))
+    doctored["builds"]["narrow"]["narrow"]["sites"][0]["hi"] = 99999
+    keys = rg.baseline_divergences(doctored, audit)
+    assert any("builds.narrow.narrow.sites" in k for k in keys)
+    assert not rg.baseline_divergences(audit, audit)
+
+
+@pytest.mark.slow
+def test_range_audit_script_reproduces_committed():
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "XLA_FLAGS" and not k.startswith("JAX_")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "range_audit.py")],
+        capture_output=True, text=True, cwd=ROOT, timeout=570, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")][-1]
+    summary = json.loads(line)
+    assert summary["range_audit"] == "PASS"
+    assert summary["artifact"] == "verified"
+    assert summary["min_i32_horizon_rounds"] >= 1000

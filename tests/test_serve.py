@@ -206,6 +206,43 @@ def test_supervised_clean_run_bitexact_vs_bare_window(cell, tmp_path):
     assert rep.fingerprint()["enabled"] is True
 
 
+def test_overflow_horizon_startup_note(tmp_path):
+    """The serve-side surfacing of the range audit's overflow-horizon
+    contract (analysis/ranges.py, docs/DESIGN.md §23): the committed
+    RANGE_AUDIT.json horizons become a one-line startup note comparing
+    the planned run length against the tightest counter horizon. A
+    missing or malformed artifact yields None — never blocks serving."""
+    from go_libp2p_pubsub_tpu.serve.supervisor import overflow_horizon_note
+
+    note = overflow_horizon_note(repo_root=_REPO)
+    assert note is not None and "int32 event counter" in note
+    # the audit's tightest horizon (DUPLICATE_MESSAGE under the flood
+    # envelope) appears by name with its round count
+    assert "DUPLICATE_MESSAGE" in note
+
+    fits = overflow_horizon_note(total_rounds=1, repo_root=_REPO)
+    assert "fits every horizon" in fits
+    over = overflow_horizon_note(total_rounds=10**12, repo_root=_REPO)
+    assert "EXCEEDS" in over and "counter_events" in over
+
+    # fresh checkout (no artifact) and a corrupt artifact: silent None
+    assert overflow_horizon_note(repo_root=str(tmp_path)) is None
+    (tmp_path / "RANGE_AUDIT.json").write_text("{not json")
+    assert overflow_horizon_note(repo_root=str(tmp_path)) is None
+
+
+def test_supervised_run_logs_horizon_note(cell, tmp_path, caplog):
+    import logging
+
+    step, make_args, template_fn, _net, _cfg = cell
+    sup = Supervisor(step, make_args, template_fn, str(tmp_path),
+                     _svc(health=None))
+    with caplog.at_level(logging.INFO,
+                         logger="go_libp2p_pubsub_tpu.serve.supervisor"):
+        sup.run()
+    assert any("range audit horizons" in r.message for r in caplog.records)
+
+
 def test_supervised_probes_off_still_bitexact(cell, tmp_path):
     step, make_args, template_fn, _net, _cfg = cell
     sup = Supervisor(step, make_args, template_fn, str(tmp_path),

@@ -315,6 +315,36 @@ def test_traced_run_accounting(tmp_path):
     assert len(adds) == n + 1
 
 
+def test_session_accounting_caveats_by_stride():
+    """The live-session form of the phase-cadence caveat (ADVICE round 5):
+    ``accounting_caveats()`` is empty while every observed stride is 1
+    and returns the shared ``PHASE_CADENCE_NOTE`` once any observe()
+    spans more than one round — same flag->prose shape as tracestat
+    --json's ``caveat_notes``."""
+    net, st, _ = _build(n=8)
+    sess = drain.TraceSession(net, [])
+    snap = drain.snapshot(st)
+
+    # per-round cadence: no caveat
+    sess.observe(snap, dataclasses.replace(snap, tick=snap.tick + 1),
+                 *no_publish(4))
+    assert sess.max_tick_stride == 1
+    assert sess.accounting_caveats() == {}
+
+    # one phase-cadence step flips the caveat on, permanently
+    sess.observe(snap, dataclasses.replace(snap, tick=snap.tick + 4),
+                 *no_publish(4))
+    assert sess.max_tick_stride == 4
+    caveats = sess.accounting_caveats()
+    assert caveats == {"phase_cadence": drain.PHASE_CADENCE_NOTE}
+    assert "undercount" in caveats["phase_cadence"]
+
+    # later per-round steps don't clear it (the stream already coarsened)
+    sess.observe(snap, dataclasses.replace(snap, tick=snap.tick + 1),
+                 *no_publish(4))
+    assert "phase_cadence" in sess.accounting_caveats()
+
+
 def test_tracestat_cli(tmp_path):
     # run a traced network, then the tracestat summarizer over both sink
     # formats — the analysis workflow the reference points its users at
