@@ -4,7 +4,7 @@
 # regressed the multi-chip halo-permute count from 96 to 144, which is
 # exactly what the paired audit now catches.
 
-.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit range-audit static tune-smoke tune-check fuse-smoke churn-smoke
+.PHONY: bench audit test quick perf-smoke chaos-smoke ensemble-smoke telemetry-smoke oracle-smoke attack-smoke scan-smoke mesh2d-audit analyze sweep native go-example mem-audit scale-smoke lift-audit hlo-audit service-smoke topo-smoke cost-audit range-audit static tune-smoke tune-check fuse-smoke churn-smoke choke-smoke
 
 # the driver's bench (one JSON line, real chip) + the GSPMD collective
 # audit pinned by tests/test_collectives.py (8 virtual CPU devices)
@@ -311,6 +311,7 @@ quick:
 	python scripts/fuse_smoke.py
 	python scripts/service_smoke.py --smoke
 	python scripts/churn_smoke.py --smoke
+	python scripts/choke_smoke.py
 
 # dynamic-overlay churn-storm gate (scripts/churn_smoke.py; docs/
 # DESIGN.md §22): a power-law cell whose edge pool MUTATES mid-window
@@ -328,6 +329,18 @@ quick:
 # CHURN_SMOKE_UPDATE=1 rewrites CHURN_SMOKE.json. ~3 min warm on CPU.
 churn-smoke:
 	python scripts/churn_smoke.py --smoke
+
+# router-plane protocol A/B gate (scripts/choke_smoke.py; docs/
+# DESIGN.md §24): GossipSub v1.1 / v1.2-IDONTWANT / latency-ring /
+# lazy-choke cells paired on ONE latency-classed power-law graph —
+# v1.2 cuts duplicates on EVERY sim at bit-exact delivery, choking
+# cuts the paired delivery-latency p95 tail with the choke-wf +
+# no-choke-below-dlo invariants armed and green, one compile per
+# cell, dense-vs-CSR counters bit-identical, and the router-off
+# census + v1.1 counter pin unmoved (the plane is opt-in).
+# CHOKE_SMOKE_UPDATE=1 rewrites CHOKE_SMOKE.json. ~4 min warm on CPU.
+choke-smoke:
+	python scripts/choke_smoke.py
 
 native:
 	$(MAKE) -C native

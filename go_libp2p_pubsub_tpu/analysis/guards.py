@@ -156,6 +156,25 @@ DYNAMIC_ENGINE = "dynamic"
 DYNAMIC_BASE = "gossipsub"
 _TOPO_PREFIX = ".core.topo"
 
+#: the router rows (round 24, docs/DESIGN.md §24): the bench-default
+#: gossipsub build with a RouterConfig armed. ``idontwant`` is the
+#: GossipSub v1.2 suppression row (§24a) — the state gains EXACTLY the
+#: ``.dontwant`` announce plane; ``choke`` is the episub lazy-choke row
+#: ON TOP of the §24c latency ring (a static link_delay plane drives
+#: the [N, K, L, W] delayed-commit ring through every guard) — the
+#: state gains ``.choked``/``.choke_ema``/``.inflight``. Neither schema
+#: is committed separately: the router leaves are pinned against the
+#: harness's RouterConfig/Net geometry and STRIPPING them must yield
+#: the committed ``gossipsub`` rows byte-equal — the router plane only
+#: ADDS state, so any other drift is a real state change hiding behind
+#: the config (the elision contract, from the schema side).
+IDONTWANT_ENGINE = "idontwant"
+IDONTWANT_BASE = "gossipsub"
+CHOKE_ENGINE = "choke"
+CHOKE_BASE = "gossipsub"
+CHOKE_RING_L = 2
+_ROUTER_LEAVES = (".dontwant", ".choked", ".choke_ema", ".inflight")
+
 #: StableHLO markers proving the state argument is donated
 _DONATION_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
 
@@ -434,19 +453,23 @@ def check_schema_equal(h: EngineHarness, out_tree, base_rows: list | None,
 
 def csr_variant_rows(base_rows: list, n_edges: int) -> list:
     """The CSR VARIANT of a dense engine's schema rows (round 18): the
-    five CSR-resident leaves (state.CSR_RESIDENT_SUFFIXES — the single
+    CSR-resident leaves (state.CSR_RESIDENT_SUFFIXES — the single
     source of the tier's membership) take their flat shapes ([E, W]
-    word planes, [E] counters); every other row must stay byte-equal to
-    the dense baseline — so the dense STATE_SCHEMA.json rows remain the
-    single committed source and the variant is derived, never
-    duplicated (the same pattern as the ensemble strip)."""
-    from ..state import CSR_RESIDENT_COUNTERS, CSR_RESIDENT_WORD_PLANES
+    word planes, [E] counters, [E, L, W] the router latency ring);
+    every other row must stay byte-equal to the dense baseline — so the
+    dense STATE_SCHEMA.json rows remain the single committed source and
+    the variant is derived, never duplicated (the same pattern as the
+    ensemble strip)."""
+    from ..state import (CSR_RESIDENT_COUNTERS, CSR_RESIDENT_RING_PLANES,
+                         CSR_RESIDENT_WORD_PLANES)
 
     out = []
     for r in base_rows:
         p = r["path"]
         if p.endswith(CSR_RESIDENT_WORD_PLANES):
             out.append({**r, "shape": [n_edges, list(r["shape"])[-1]]})
+        elif p.endswith(CSR_RESIDENT_RING_PLANES):
+            out.append({**r, "shape": [n_edges] + list(r["shape"])[-2:]})
         elif p.endswith(CSR_RESIDENT_COUNTERS):
             out.append({**r, "shape": [n_edges]})
         else:
@@ -592,6 +615,95 @@ def check_schema_dynamic(h: EngineHarness, out_tree,
                 f"{len(mism)} non-overlay leaf drift(s) vs the "
                 f"{DYNAMIC_BASE!r} baseline after stripping "
                 f"{_TOPO_PREFIX}.*: " + "; ".join(mism[:5]),
+            )
+    return stripped
+
+
+def build_router_harness(name: str, router, link_delay=None) -> EngineHarness:
+    """A router-row harness (round 24): the bench-default gossipsub
+    build — same topology, params, score plane, and tracer-detached
+    config as ``build_bench(config="default")``, so the stripped rows
+    anchor to the committed ``gossipsub`` baseline — with a
+    ``RouterConfig`` armed (and, for the ring, its static link_delay
+    plane)."""
+    import dataclasses as _dc
+
+    from .. import graph
+    from ..config import GossipSubParams, PeerScoreThresholds
+    from ..models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from ..perf.sweep import bench_score_params, bench_wire_coalesced
+    from ..state import Net
+
+    topo = graph.ring_lattice(GUARD_N, d=8)
+    subs = graph.subscribe_all(GUARD_N, 1)
+    net = Net.build(topo, subs)
+    params = _dc.replace(GossipSubParams(), flood_publish=False)
+    _tp, sp = bench_score_params("default", 1)
+    cfg = GossipSubConfig.build(
+        params, PeerScoreThresholds(), score_enabled=True,
+        validation_capacity=0, heartbeat_every=1,
+        wire_coalesced=bench_wire_coalesced(None),
+        router=router,
+    )
+    cfg = _dc.replace(cfg, count_events=False, fanout_slots=0)
+    st = GossipSubState.init(net, GUARD_M, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               link_delay=link_delay)
+    return EngineHarness(
+        name, step, st, lambda i: _pub_args((PUB_WIDTH,), i), {}
+    )
+
+
+def check_schema_router(h: EngineHarness, out_tree,
+                        base_rows: list | None) -> list:
+    """Schema guard for a router row: weak-type audit, pin every armed
+    router leaf (dtype + shape read off the HARNESS's initial state —
+    GossipSubState.init sizes them from the RouterConfig and the Net's
+    geometry, so a step that reshapes or retypes one fails here), then
+    the REMAINING rows must equal the base engine's committed rows —
+    the router plane only ADDS state leaves."""
+    rows = schema_of(out_tree)
+    weak = [r["path"] for r in rows if r["weak_type"]]
+    if weak:
+        raise GuardViolation(
+            h.name, "schema",
+            f"weak-typed state leaves {weak[:4]} in the {h.name} step",
+        )
+    want = {}
+    for path in _ROUTER_LEAVES:
+        leaf = getattr(h.state, path[1:], None)
+        if leaf is not None:
+            want[path] = {"dtype": str(leaf.dtype),
+                          "shape": list(leaf.shape)}
+    got = {r["path"]: r for r in rows if r["path"] in _ROUTER_LEAVES}
+    for path, w in want.items():
+        r = got.get(path)
+        if r is None or r["dtype"] != w["dtype"] or r["shape"] != w["shape"]:
+            raise GuardViolation(
+                h.name, "schema",
+                f"router leaf {path} expected {w['dtype']} {w['shape']}, "
+                f"got {r} — the plane does not match its RouterConfig/"
+                "Net geometry",
+            )
+    if set(got) != set(want):
+        raise GuardViolation(
+            h.name, "schema",
+            f"unexpected router leaves {sorted(set(got) - set(want))} — "
+            "a leaf the RouterConfig did not arm is in the state",
+        )
+    stripped = [r for r in rows if r["path"] not in _ROUTER_LEAVES]
+    if base_rows is not None:
+        mism = diff_schema(h.name, stripped, base_rows)
+        if mism:
+            raise GuardViolation(
+                h.name, "schema",
+                f"{len(mism)} non-router leaf drift(s) vs the "
+                f"{CHOKE_BASE!r} baseline after stripping the router "
+                "plane: " + "; ".join(mism[:5]),
             )
     return stripped
 
@@ -1027,6 +1139,49 @@ def run_dynamic_engine(base_rows: list | None) -> list:
     return rows
 
 
+def run_idontwant_engine(base_rows: list | None) -> list:
+    """All guards for the v1.2 IDONTWANT row (round 24): strict-dtype
+    trace of the suppression step (the announce plane is u32 word
+    algebra — a promotion here corrupts the mask), the ``.dontwant``
+    leaf pin + base-row comparison, buffer donation, and the
+    GUARD_ROUNDS one-compile/transfer-guard run."""
+    from ..routers import RouterConfig
+
+    h = build_router_harness(IDONTWANT_ENGINE, RouterConfig(idontwant=True))
+    out_tree = strict_trace(h)
+    rows = check_schema_router(h, out_tree, base_rows)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
+def run_choke_engine(base_rows: list | None) -> list:
+    """All guards for the lazy-choke row (round 24): the choke EMA +
+    decision machinery ON TOP of a depth-CHOKE_RING_L latency ring
+    (a deterministic [N, K] delay plane, classes 0..L) — strict-dtype
+    trace (f32 EMA next to u32 ring words), the choked/choke_ema/
+    inflight leaf pins + base-row comparison, donation (the ring must
+    ride the donated state, not copy), and the one-compile/transfer-
+    guard run — the ring shift and the heartbeat choke decisions
+    re-trace nothing."""
+    import numpy as np
+
+    from ..routers import RouterConfig
+
+    delay = (np.add.outer(np.arange(GUARD_N), np.arange(16))
+             % (CHOKE_RING_L + 1)).astype(np.int32)
+    h = build_router_harness(
+        CHOKE_ENGINE,
+        RouterConfig(choke=True, latency_rounds=CHOKE_RING_L),
+        link_delay=delay,
+    )
+    out_tree = strict_trace(h)
+    rows = check_schema_router(h, out_tree, base_rows)
+    check_donation(h)
+    run_rounds_guarded(h)
+    return rows
+
+
 @dataclasses.dataclass(frozen=True)
 class GuardRow:
     """One declarative harness row (round-16 dedup of the per-engine
@@ -1058,6 +1213,8 @@ DERIVED_ROWS = (
     GuardRow(LIFTED_FUSED_ENGINE, "run_lifted_fused_engine",
              LIFTED_FUSED_BASE),
     GuardRow(DYNAMIC_ENGINE, "run_dynamic_engine", DYNAMIC_BASE),
+    GuardRow(IDONTWANT_ENGINE, "run_idontwant_engine", IDONTWANT_BASE),
+    GuardRow(CHOKE_ENGINE, "run_choke_engine", CHOKE_BASE),
 )
 
 #: all row names, for reporting (scripts/analyze.py)

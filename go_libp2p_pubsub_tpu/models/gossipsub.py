@@ -63,6 +63,18 @@ from ..ops.select import (
     select_random_mask,
     select_topk_mask,
 )
+from ..routers import (
+    RouterConfig,
+    choke_decide,
+    choke_guard,
+    choke_lateness_update,
+    choke_suppression,
+    dontwant_announcements,
+    dontwant_suppression,
+    idontwant_sent_count,
+    ring_commit,
+    ring_keep,
+)
 from ..score.engine import (
     ScoreState,
     TopicParamsArrays,
@@ -227,6 +239,14 @@ class GossipSubConfig:
     # exact mode) instead of aggregate counters. Off by default: costs one
     # [N,K,W] store per round when on, zero when off
     trace_exact: bool = False
+    # router plane (routers/, docs/DESIGN.md §24): the post-v1.1
+    # protocol frontier — v1.2 IDONTWANT duplicate suppression, the
+    # episub-style lazy-choke router, and the per-edge latency ring
+    # that consumes topo.link_class_planes. None (the one spelling of
+    # v1.1 semantics) elides the plane STATICALLY: the traced program,
+    # kernel census and state tree are the pre-router ones, bit for bit
+    # (`make choke-smoke`'s router-off census gate).
+    router: "RouterConfig | None" = None
     # thresholds (v1.1; zeros for v1.0)
     gossip_threshold: float = 0.0
     publish_threshold: float = 0.0
@@ -253,9 +273,12 @@ class GossipSubConfig:
         edge_layout: str = "dense",
         narrow_counters: bool = False,
         fused: bool = False,
+        router: "RouterConfig | None" = None,
     ) -> "GossipSubConfig":
         p = params or GossipSubParams()
         p.validate()
+        if router is not None:
+            router.validate()
         if edge_layout not in ("dense", "csr"):
             raise ValueError(
                 f"edge_layout must be 'dense' or 'csr', got {edge_layout!r}"
@@ -330,6 +353,7 @@ class GossipSubConfig:
             edge_layout=edge_layout,
             narrow_counters=narrow_counters,
             fused=fused,
+            router=router,
             fanout_ttl_ticks=ticks_for(p.fanout_ttl, hb),
         )
         if chaos is not None:
@@ -428,6 +452,18 @@ class GossipSubState:
     # this round's arrivals beyond the first per (peer, msg), per edge —
     # the drain expands them to DuplicateMessage events (trace.go:186-194)
     dup_trans: jax.Array | None = None  # [N,K,W] u32
+    # router plane (cfg.router, routers/, docs/DESIGN.md §24) — every
+    # leaf None on v1.1 builds (the elision contract: the state TREE is
+    # the pre-router one, which is what the smoke's bit-exact census
+    # compares). dontwant ⊆ dlv.have by construction (fed from the
+    # round's post-throttle new receipts); choked ⊆ mesh with at least
+    # Dlo unchoked per slot (choke_guard, re-applied at every mesh
+    # mutation site); inflight is the delayed-commit ring — edge axes
+    # leading so it rides the CSR-resident tier flat as [E, L, W]
+    dontwant: jax.Array | None = None    # [N,W] u32 announced ids
+    choked: jax.Array | None = None      # [N,S,K] bool lazy-demoted mesh links
+    choke_ema: jax.Array | None = None   # [N,K] f32 lateness EMA
+    inflight: jax.Array | None = None    # [N,K,L,W] u32 ([E,L,W] flat)
 
     @classmethod
     def init(
@@ -517,6 +553,25 @@ class GossipSubState:
             congested_in=jnp.zeros((n, k), bool),
             dup_trans=(
                 jnp.zeros((n, k, w), jnp.uint32) if cfg.trace_exact else None
+            ),
+            dontwant=(
+                jnp.zeros((n, w), jnp.uint32)
+                if cfg.router is not None and cfg.router.idontwant else None
+            ),
+            choked=(
+                jnp.zeros((n, s, k), bool)
+                if cfg.router is not None and cfg.router.choke else None
+            ),
+            choke_ema=(
+                jnp.zeros((n, k), jnp.float32)
+                if cfg.router is not None and cfg.router.choke else None
+            ),
+            inflight=(
+                jnp.zeros(
+                    (((n, k) if e is None else (e,))
+                     + (cfg.router.latency_rounds, w)), jnp.uint32)
+                if cfg.router is not None and cfg.router.latency_rounds > 0
+                else None
             ),
         )
 
@@ -1312,6 +1367,28 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         fpeers = fpeers | masked_width_random(kf1, cand_f, ineed_f, k_dim,
                                               fused=cfg.fused)
 
+    # ---- choke/unchoke decision (routers/choke.py, DESIGN.md §24b) ------
+    # after mesh maintenance (the guard must see the post-maintenance
+    # mesh), before emitGossip (whose targets fold the choked links in).
+    # The sender learns it is choked via ONE extra edge gather — the
+    # choke annotation piggybacks the heartbeat's control batch (an
+    # instant-knowledge approximation of the one-RTT outbox model,
+    # documented in §24b; the suppression itself is receiver-local).
+    router = cfg.router
+    choked_by = None
+    if router is not None and router.choke:
+        choked_next = choke_guard(msh.Dlo, mesh, st.choked)
+        choked_next, n_choke, n_unchoke = choke_decide(
+            router, msh.Dlo, mesh, choked_next, st.choke_ema,
+            fused=cfg.fused,
+        )
+        choked_by = net.edge_gather(jnp.any(choked_next, axis=1)) & net.nbr_ok
+        if cfg.count_events:
+            events = (
+                events.at[EV.CHOKE].add(n_choke)
+                .at[EV.UNCHOKE].add(n_unchoke)
+            )
+
     # ---- emitGossip (gossipsub.go:1669-1723) ----------------------------
     gwin = bitset.word_or_reduce(st.mcache[:, : cfg.history_gossip, :], axis=1)  # [N,W]
     gossip_cand = connected & nbr_sub & ~mesh & ~net.direct[:, None, :]
@@ -1327,6 +1404,15 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
     )
     chosen = masked_width_random(k6, gossip_cand, target, k_dim,
                                  fused=cfg.fused)  # [N,S,K]
+    if choked_by is not None:
+        # a choked mesh link is IHAVE-only: the choked sender ALWAYS
+        # gossips to the choking neighbor (not a lottery entry — episub's
+        # lazy links carry every id), so ids keep flowing and IWANT
+        # service keeps working while eager data is suppressed
+        chosen = chosen | (
+            connected & nbr_sub & choked_by[:, None, :]
+            & ~net.direct[:, None, :]
+        )
 
     slot_tw = slot_topic_words(net, st.core.msgs.topic)  # [N,S,W]
     adv = jnp.where(
@@ -1438,6 +1524,8 @@ def heartbeat(cfg: GossipSubConfig, net: Net, st: GossipSubState, tp: dict,
         fanout_topic=ft,
         fanout_peers=fpeers,
         fanout_lastpub=flastpub,
+        **({"choked": choked_next}
+           if router is not None and router.choke else {}),
     )
 
 
@@ -1699,11 +1787,28 @@ def apply_peer_transitions(cfg: GossipSubConfig, net: Net, st: GossipSubState,
             .at[EV.REMOVE_PEER].add(jnp.sum(down_tr.astype(jnp.int32)))
             .at[EV.ADD_PEER].add(jnp.sum(up_tr.astype(jnp.int32)))
         )
+    # router plane cleanup (cfg.router builds): a crashing announcer
+    # forgets its IDONTWANT set with the rest of its soft state; choke
+    # state and in-flight delayed commits die with their edges (the
+    # guard re-establishes choked ⊆ mesh and the Dlo floor against the
+    # post-churn mesh — a death that took an unchoked link fails open)
+    router_clear = {}
+    if st.dontwant is not None:
+        router_clear["dontwant"] = jnp.where(
+            down_tr[:, None], jnp.uint32(0), st.dontwant)
+    if st.choked is not None:
+        router_clear["choked"] = choke_guard(
+            cfg.Dlo, st.mesh & ~de3, st.choked & ~de3)
+        router_clear["choke_ema"] = jnp.where(down_edge, 0.0, st.choke_ema)
+    if st.inflight is not None:
+        router_clear["inflight"] = jnp.where(
+            down_edge[:, :, None, None], jnp.uint32(0), st.inflight)
     st = st.replace(
         core=st.core.replace(dlv=dlv0, events=ev0),
         mcache=jnp.where(down_tr[:, None, None], jnp.uint32(0), st.mcache),
         mesh=st.mesh & ~de3,
         fanout_peers=st.fanout_peers & ~de3,
+        **router_clear,
         graft_out=st.graft_out & ~de3,
         prune_out=st.prune_out & ~de3,
         ihave_out=jnp.where(down_edge[:, :, None], jnp.uint32(0), st.ihave_out),
@@ -2047,8 +2152,16 @@ def make_gossipsub_step(
     adversary=None,
     lift_scores: bool = False,
     dynamic_topo: bool = False,
+    link_delay: np.ndarray | None = None,
 ):
     """Build the jitted per-round step for a fixed config + topology.
+
+    ``link_delay`` is the router plane's static [N, K] i32 per-edge
+    delay in rounds (docs/DESIGN.md §24c — ``topo.link_class_planes``
+    normalized so the fastest class is 0, ``topo.link_delay_plane``),
+    REQUIRED iff ``cfg.router.latency_rounds > 0``; values must lie in
+    [0, latency_rounds]. It is a jit constant like the topology — the
+    latency classes are as static as the graph they annotate.
 
     step(state, pub_origin[P], pub_topic[P], pub_valid[P]) -> state
 
@@ -2184,6 +2297,43 @@ def make_gossipsub_step(
                 "static slot identity; topology changes go through the "
                 "mutation schedule instead"
             )
+    router = cfg.router
+    if router is not None:
+        router.validate()
+        if dynamic_topo:
+            raise ValueError(
+                "cfg.router is incompatible with dynamic_topo — the "
+                "link_delay plane and the choke guard's edge views are "
+                "static over the build topology; mutate topology on a "
+                "v1.1 build or rebuild the router step"
+            )
+    if router is not None and router.latency_rounds > 0:
+        if link_delay is None:
+            raise ValueError(
+                "cfg.router.latency_rounds > 0 needs the static link_delay "
+                "plane (make_gossipsub_step(..., link_delay=...) — see "
+                "topo.link_delay_plane)"
+            )
+        link_delay = np.asarray(link_delay, np.int32)
+        if link_delay.shape != net.nbr.shape:
+            raise ValueError(
+                f"link_delay shape {link_delay.shape} does not match the "
+                f"topology's [N, K] = {net.nbr.shape}"
+            )
+        if link_delay.min() < 0 or link_delay.max() > router.latency_rounds:
+            raise ValueError(
+                "link_delay values must lie in [0, "
+                f"{router.latency_rounds}] (the ring depth); got "
+                f"[{link_delay.min()}, {link_delay.max()}]"
+            )
+        link_delay_c = jnp.asarray(link_delay)
+    else:
+        if link_delay is not None:
+            raise ValueError(
+                "link_delay given but cfg.router.latency_rounds == 0 — "
+                "the delay plane would be silently unread"
+            )
+        link_delay_c = None
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
         sub_knowledge_holes, adversary_no_forward, adversary,
@@ -2223,6 +2373,7 @@ def make_gossipsub_step(
         and not _old_pallas
         and chaos is None  # the fused halo kernel predates the chaos plane
         and adv is None    # ... and the adversary plane
+        and cfg.router is None  # ... and the router plane (§24)
         # lifted ScoreParams builds are eligible since round 21: the
         # kernel takes thresholds as a traced [1, 2] f32 row, so the
         # former float(threshold) SHAPE seam is closed (the lifted+fused
@@ -2380,6 +2531,13 @@ def make_gossipsub_step(
         if cfg.count_events:
             events = events.at[EV.GRAFT].add(n_graft).at[EV.PRUNE].add(n_prune)
 
+        # router choke guard at the GRAFT/PRUNE mutation site: the ingest
+        # may have pruned an unchoked link or grafted a fresh one, and the
+        # no-choke-below-Dlo invariant holds at every round boundary
+        # (oracle/invariants.py), so re-establish choked ⊆ mesh here
+        if router is not None and router.choke:
+            st2 = st2.replace(choked=choke_guard(msh.Dlo, st2.mesh, st2.choked))
+
         # 1b. PX connect (see px_connect)
         edge_live_next = px_connect(cfg, net, net_l, st, px_ok, dynamic_peers)
 
@@ -2534,11 +2692,76 @@ def make_gossipsub_step(
                         bitset.popcount(rem_mask & fwd_g, axis=None).sum()
                         + bitset.popcount(rem_resp, axis=None).sum()
                     ).astype(jnp.int32)
+            # ---- router plane (docs/DESIGN.md §24) ----------------------
+            # receiver-side data suppression: both IDONTWANT (§24a) and
+            # choke (§24b) land as ANDs on edge_mask BEFORE delivery_round,
+            # so the dense and the flat-[E] CSR layouts (which pack
+            # edge_mask internally) are covered identically, with zero
+            # extra halo permutes — the sender's view of "I was told not
+            # to" is receiver-indexed, exactly like the adversary masks
+            n_dup_sup = None
+            ring_tx = None
+            if router is not None:
+                mesh_edge = jnp.any(st2.mesh, axis=1)
+                suppress = jnp.zeros_like(edge_mask)
+                if router.idontwant_eligible:
+                    suppress = suppress | dontwant_suppression(
+                        st.dontwant, mesh_edge
+                    )
+                if router.choke:
+                    ch_edge = choke_suppression(st2.choked)
+                    suppress = suppress | jnp.where(
+                        ch_edge[:, :, None], jnp.uint32(0xFFFFFFFF),
+                        jnp.uint32(0),
+                    )
+                removed = edge_mask & suppress
+                edge_mask = edge_mask & ~suppress
+                if cfg.count_events:
+                    # suppressed-transmission attribution: withheld carry
+                    # bits ∩ the senders' forward sets — the n_adv_drop
+                    # convention above (same fwd gather delivery_round
+                    # performs; XLA CSE merges them)
+                    fwd_g = net_l.peer_gather(core.dlv.fwd)
+                    n_dup_sup = bitset.popcount(
+                        removed & fwd_g, axis=None
+                    ).sum().astype(jnp.int32)
+                if router.latency_rounds > 0:
+                    # §24c latency ring — store-and-forward: the sender's
+                    # fwd plane is a ONE-round window (this round's
+                    # validated cohort, models/common.py), so a commit
+                    # landing d rounds later would find it already empty.
+                    # The decision therefore resolves against the
+                    # sender's fwd window and the echo exclusion AT SEND
+                    # TIME (what's on the wire was valid when it left),
+                    # and the ring carries the resolved transmission
+                    # words; slot-0 pops commit below via merge_extra_tx,
+                    # the path built for transmissions outside senders'
+                    # current fwd sets (IWANT responses). Delay-0 edges
+                    # never enter the ring: they keep the v1.1
+                    # delivery_round path bit-for-bit.
+                    d0w = jnp.where(
+                        (link_delay_c == 0)[:, :, None],
+                        jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+                    eager = (edge_mask & net_l.peer_gather(core.dlv.fwd)
+                             & ~net_l.edge_gather(core.dlv.fe_words)
+                             & ~d0w)
+                    ring_tx, inflight_next = ring_commit(
+                        st.inflight, eager, link_delay_c
+                    )
+                    edge_mask = edge_mask & d0w
             dlv, info = delivery_round(
                 net_l, core.msgs, core.dlv, edge_mask, tick,
                 count_events=cfg.count_events, queue_cap=cfg.queue_cap,
                 val_delay_topic=cfg.validation_delay_topic,
             )
+            if ring_tx is not None:
+                # latency-ring arrivals land this round (merged before
+                # the IWANT responses so the recovery attribution below
+                # stays IWANT-only)
+                dlv, info = merge_extra_tx(
+                    net_l, core.msgs, dlv, info, ring_tx, tick,
+                    count_events=cfg.count_events, queue_cap=cfg.queue_cap,
+                    val_delay_topic=cfg.validation_delay_topic)
             iwant_resp = jnp.where(acc_msg[:, :, None], iwant_resp, jnp.uint32(0))
             have_pre_merge = dlv.have
             dlv, info = merge_extra_tx(net_l, core.msgs, dlv, info, iwant_resp, tick,
@@ -2567,6 +2790,16 @@ def make_gossipsub_step(
             )
         else:
             dup_plane = None
+
+        # router choke signal: fold this round's per-edge lateness into
+        # the EMA (arrival-based, pre-throttle — the same cohort the dup
+        # counter uses). Router builds never take the fused path, so
+        # info/dlv here are always the XLA delivery plane's.
+        if router is not None and router.choke:
+            choke_ema_next = choke_lateness_update(
+                router, st2.choke_ema, info.trans, dlv.fe_words,
+                info.new_words,
+            )
 
         # 4b. validation front-end throttle (validation.go:230-244)
         valid_words_all = bitset.pack(core.msgs.valid)
@@ -2669,10 +2902,37 @@ def make_gossipsub_step(
                 nbr_sub_words_l, thr=thr, msh=msh,
             )
 
+        # ---- router plane state roll (docs/DESIGN.md §24) ---------------
+        # announcements accumulate at round END from this round's
+        # post-throttle first receipts and are consumed NEXT round — the
+        # one-RTT control latency every other outbox pays. Every per-edge
+        # and per-id router plane gets the same keep-words recycle the
+        # mcache gets.
+        router_next = {}
+        if router is not None:
+            if router.idontwant_eligible:
+                ann = dontwant_announcements(
+                    router, info.recv_new_words, joined_words
+                )
+                router_next["dontwant"] = (
+                    (st.dontwant | ann) & keep_words[None, :]
+                )
+            if router.choke:
+                router_next["choke_ema"] = choke_ema_next
+            if router.latency_rounds > 0:
+                router_next["inflight"] = ring_keep(inflight_next, keep_words)
+
         if cfg.count_events:
             events = accumulate_round_events(
                 events, info, jnp.sum(is_pub.astype(jnp.int32))
             )
+            if router is not None:
+                if router.idontwant_eligible:
+                    events = events.at[EV.IDONTWANT_SENT].add(
+                        idontwant_sent_count(ann, mesh_edge)
+                    )
+                if n_dup_sup is not None:
+                    events = events.at[EV.DUP_SUPPRESSED].add(n_dup_sup)
             if chaos is not None:
                 events = events.at[EV.LINK_DOWN].add(
                     chaos_faults.count_links_down(net.nbr, net_l.nbr_ok,
@@ -2705,6 +2965,7 @@ def make_gossipsub_step(
             # arrivals in a message's own death round (which the device
             # counter also counted)
             dup_trans=dup_plane,
+            **router_next,
         )
 
         # congested links suppress next heartbeat's gossip toward them:

@@ -326,6 +326,13 @@ def make_gossipsub_phase_step(
             "lift_scores=True needs cfg.score_enabled — the lifted "
             "plane parameterizes the v1.1 score machinery"
         )
+    if cfg.router is not None:
+        raise ValueError(
+            "the phase engine predates the router plane (docs/DESIGN.md "
+            "§24) — IDONTWANT suppression, choking, and the latency ring "
+            "hook the per-round delivery composition; use "
+            "make_gossipsub_step for router builds"
+        )
     consts = prepare_step_consts(
         cfg, net, score_params, heartbeat_interval, gater_params,
         sub_knowledge_holes, adversary_no_forward, adversary,

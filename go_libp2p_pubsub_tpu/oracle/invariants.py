@@ -606,6 +606,35 @@ def _mesh_reform_after_heal(ctx) -> jax.Array:
     return ~active | ok
 
 
+@invariant(
+    "choke-wf", kind="safety", engines=GOSSIP_ENGINES,
+    doc="router choke well-formedness: choked ⊆ mesh — a choked link is "
+        "a DEMOTED mesh link, never a non-mesh edge (episub lazy links "
+        "keep mesh membership; arXiv:2312.06800 §3, routers/choke.py "
+        "guard, docs/DESIGN.md §24b); vacuously true off router builds")
+def _choke_wf(ctx) -> jax.Array:
+    gs = ctx.gs
+    if getattr(gs, "choked", None) is None:
+        return jnp.bool_(True)
+    return ~jnp.any(gs.choked & ~gs.mesh)
+
+
+@invariant(
+    "no-choke-below-dlo", kind="safety", engines=GOSSIP_ENGINES,
+    doc="choke degree floor: a topic slot holding any choked link keeps "
+        "at least Dlo unchoked mesh members — lazy demotion must never "
+        "starve a slot's eager delivery (the arXiv:2312.06800 safety "
+        "bound the choke budget + guard enforce at every mesh mutation "
+        "site, docs/DESIGN.md §24b); vacuously true off router builds")
+def _no_choke_below_dlo(ctx) -> jax.Array:
+    gs, cfg = ctx.gs, ctx.cfg
+    if getattr(gs, "choked", None) is None:
+        return jnp.bool_(True)
+    unchoked = jnp.sum((gs.mesh & ~gs.choked).astype(jnp.int32), axis=-1)
+    any_choked = jnp.any(gs.choked, axis=-1)
+    return ~jnp.any(any_choked & (unchoked < cfg.Dlo))
+
+
 # ---------------------------------------------------------------------------
 # the checker
 

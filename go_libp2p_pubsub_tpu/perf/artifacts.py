@@ -167,6 +167,21 @@ DYNAMICS_OFF = {"enabled": False, "mutation_dispatches": 0,
                 "rewires": 0, "schedule_hash": None}
 
 
+#: the router defaults every artifact WITHOUT a fingerprint["router"]
+#: block reads back as (round 24): the producing build ran plain
+#: GossipSub v1.1 semantics — no IDONTWANT suppression, no lazy
+#: choking, no latency ring — which is exactly what every pre-round-24
+#: build was (``router=None`` is the one spelling of v1.1; see
+#: routers/config.py). Explicit sentinel so readers can ask any
+#: artifact "which protocol generation cut this number, and was the
+#: latency plane load-bearing" without special-casing age.
+ROUTER_V11 = {"enabled": False, "protocol": "v1.1",
+              "idontwant": False, "idontwant_threshold": None,
+              "choke": False, "choke_ema_alpha": None,
+              "choke_threshold": None, "unchoke_threshold": None,
+              "choke_max_per_hb": None, "latency_rounds": 0}
+
+
 def dynamics_fingerprint(*, mutation_dispatches: int,
                          writes_per_dispatch: int, kills: int = 0,
                          joins: int = 0, rewires: int = 0,
@@ -190,6 +205,42 @@ def dynamics_fingerprint(*, mutation_dispatches: int,
         "rewires": int(rewires),
         "schedule_hash": (None if schedule_hash is None
                           else str(schedule_hash)),
+    }
+
+
+def router_fingerprint(router=None) -> dict:
+    """The schema-v3 ``fingerprint["router"]`` block (round 24): the
+    router plane's self-description — protocol generation ("v1.1" |
+    "v1.2", the latter iff IDONTWANT is armed per the spec's version
+    gate), every choke knob (EMA alpha, hysteresis pair, per-heartbeat
+    budget) so two choke cells can be matched on the exact decision
+    rule, and the latency ring depth L (0 = every edge commits
+    immediately, the v1.1 data plane). Duck-typed over
+    routers.RouterConfig so this module stays jax-free; ``None`` (the
+    one spelling of v1.1 semantics) returns the explicit off block new
+    router-less artifacts carry. Readers go through
+    :attr:`BenchRecord.router`, which defaults legacy lines to
+    :data:`ROUTER_V11`."""
+    if router is None:
+        return dict(ROUTER_V11)
+    idw = bool(getattr(router, "idontwant", False))
+    choke = bool(getattr(router, "choke", False))
+    return {
+        "enabled": True,
+        "protocol": "v1.2" if idw else "v1.1",
+        "idontwant": idw,
+        "idontwant_threshold": (float(router.idontwant_threshold)
+                                if idw else None),
+        "choke": choke,
+        "choke_ema_alpha": (float(router.choke_ema_alpha)
+                            if choke else None),
+        "choke_threshold": (float(router.choke_threshold)
+                            if choke else None),
+        "unchoke_threshold": (float(router.unchoke_threshold)
+                              if choke else None),
+        "choke_max_per_hb": (int(router.choke_max_per_hb)
+                             if choke else None),
+        "latency_rounds": int(getattr(router, "latency_rounds", 0)),
     }
 
 
@@ -636,6 +687,23 @@ class BenchRecord:
     @property
     def dynamics_on(self) -> bool:
         return bool(self.dynamics["enabled"])
+
+    @property
+    def router(self) -> dict:
+        """The router block of the fingerprint (round 24): which
+        protocol generation cut the number (v1.1 | v1.2-IDONTWANT),
+        the choke decision rule, and the latency ring depth. LEGACY
+        artifacts — every line that predates the router plane — read
+        back :data:`ROUTER_V11`: plain v1.1 semantics, which is
+        literally what every pre-round-24 build ran."""
+        fp = self.fingerprint or {}
+        out = dict(ROUTER_V11)
+        out.update(fp.get("router") or {})
+        return out
+
+    @property
+    def router_on(self) -> bool:
+        return bool(self.router["enabled"])
 
     @property
     def scanned(self) -> bool | None:

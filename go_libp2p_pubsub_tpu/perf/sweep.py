@@ -306,6 +306,15 @@ def _chaos_fingerprint():
     return chaos_fingerprint()
 
 
+def _router_fingerprint(router):
+    from .artifacts import router_fingerprint
+
+    # the bench matrix never arms a router (protocol A/B lives in the
+    # choke-smoke gate, scripts/choke_smoke.py); the explicit v1.1
+    # block keeps new artifacts self-describing (round 24)
+    return router_fingerprint(router)
+
+
 def _params_fingerprint(lift_scores: bool):
     from .artifacts import params_fingerprint
 
@@ -327,6 +336,7 @@ def workload_fingerprint(
     wire_coalesced: bool | None = None,
     edge_layout: str | None = None,
     lift_scores: bool = False,
+    router=None,
 ) -> dict:
     """The schema-v2 self-description of a bench cell: everything a
     future reader needs to know what the number measured, derived from
@@ -401,6 +411,7 @@ def workload_fingerprint(
         # traced ScoreParams plane; legacy lines read back the
         # PARAMS_STATIC sentinel via BenchRecord.params
         "params": _params_fingerprint(lift_scores),
+        "router": _router_fingerprint(router),
     }
     if seg_rounds is not None:
         fp["seg_rounds"] = int(seg_rounds)

@@ -1,0 +1,44 @@
+"""routers/ — the post-v1.1 protocol frontier (docs/DESIGN.md §24).
+
+Statically-selected engine variants layered on the v1.0/v1.1 gossipsub
+step: GossipSub v1.2 IDONTWANT duplicate suppression (libp2p specs
+gossipsub-v1.2.md; gossipsub.go post-v0.13 handleIDontWant), the
+episub-style lazy-choke router (Topiary / arXiv:2312.06800), and the
+latency plane that makes delivery order heterogeneous enough for
+choking to have something to learn (topo.link_class_planes consumed as
+a per-edge delayed-commit ring).
+
+Everything here is pure word/mask algebra over the existing state
+planes — a build with ``router=None`` traces the pre-router program
+bit for bit (the elision contract, pinned by `make choke-smoke`'s
+router-off census gate).
+"""
+
+from .config import RouterConfig, RouterConfigError
+from .idontwant import (
+    dontwant_announcements,
+    dontwant_suppression,
+    idontwant_sent_count,
+)
+from .choke import (
+    choke_decide,
+    choke_guard,
+    choke_lateness_update,
+    choke_suppression,
+)
+from .latency import ring_commit, ring_init, ring_keep
+
+__all__ = [
+    "RouterConfig",
+    "RouterConfigError",
+    "dontwant_announcements",
+    "dontwant_suppression",
+    "idontwant_sent_count",
+    "choke_decide",
+    "choke_guard",
+    "choke_lateness_update",
+    "choke_suppression",
+    "ring_commit",
+    "ring_init",
+    "ring_keep",
+]

@@ -1,0 +1,99 @@
+"""Episub-style lazy choking (Topiary, arXiv:2312.06800; docs/DESIGN.md
+§24b).
+
+A choked mesh link keeps its mesh membership (GRAFT/PRUNE math is
+untouched) but is demoted to lazy: the receiver suppresses the link's
+eager data push exactly like an IDONTWANT for every id, and the sender
+— who learns it is choked via one edge gather per heartbeat — folds
+the link into its IHAVE gossip targets, so the link still carries ids
+and can serve IWANT. Unchoking restores eager delivery.
+
+The decision signal is the per-edge lateness EMA: the fraction of an
+edge's arrivals that were NOT the first copy of a message, folded at
+``choke_ema_alpha`` on rounds where the edge carried traffic. The
+first-arrival edge isolation the score plane already computes
+(dlv.fe_words) provides the numerator for free.
+
+Safety: decisions are bounded so every topic slot keeps at least Dlo
+unchoked mesh links, and the guard (choked ⊆ mesh, plus clearing
+choke on any slot whose unchoked degree fell below Dlo) is re-applied
+at every mesh mutation site — GRAFT/PRUNE handling, the heartbeat's
+own maintenance, and peer churn — so the no-choke-below-Dlo invariant
+holds at every round boundary without a grace mechanism.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import bitset
+from ..ops.select import count_true, masked_width_topk
+from .config import RouterConfig
+
+
+def choke_lateness_update(router: RouterConfig, choke_ema: jax.Array,
+                          trans: jax.Array, fe_words: jax.Array,
+                          new_words: jax.Array) -> jax.Array:
+    """Fold this round's per-edge lateness into the EMA ([N, K] f32).
+
+    ``trans`` is the round's transmission plane, ``fe_words`` the
+    POST-round first-edge isolation, ``new_words`` the round's new
+    receipts — so ``fe & new`` is exactly the arrivals that won the
+    first-copy race this round; everything else the edge carried was
+    late (a duplicate, or a tied copy the isolation broke against).
+    Edges with no traffic this round keep their EMA unchanged.
+    """
+    arrivals = bitset.popcount(trans, axis=-1)                          # [N,K]
+    first = bitset.popcount(trans & fe_words & new_words[:, None, :],
+                            axis=-1)                                    # [N,K]
+    late = (arrivals - first).astype(jnp.float32)
+    frac = late / jnp.maximum(arrivals, 1).astype(jnp.float32)
+    a = jnp.float32(router.choke_ema_alpha)
+    folded = (1.0 - a) * choke_ema + a * frac
+    return jnp.where(arrivals > 0, folded, choke_ema)
+
+
+def choke_decide(router: RouterConfig, Dlo: int, mesh: jax.Array,
+                 choked: jax.Array, choke_ema: jax.Array,
+                 fused: bool = False):
+    """Heartbeat choke/unchoke decision.
+
+    Returns ``(choked, n_choke, n_unchoke)``. Unchoke first (EMA fell
+    below the hysteresis floor), then choke up to ``choke_max_per_hb``
+    worst-EMA eligible links per topic slot, budgeted so the slot's
+    unchoked mesh degree never drops below Dlo.
+    """
+    ema3 = choke_ema[:, None, :]                                       # [N,1,K]
+    unchoke = choked & mesh & (ema3 < router.unchoke_threshold)
+    choked = (choked & mesh) & ~unchoke
+
+    unchoked_deg = count_true(mesh & ~choked)                          # [N,S]
+    budget = jnp.clip(unchoked_deg - Dlo, 0, router.choke_max_per_hb)
+    cand = mesh & ~choked & (ema3 > router.choke_threshold)
+    newly = masked_width_topk(
+        jnp.broadcast_to(ema3, cand.shape), cand, budget,
+        cand.shape[-1], fused=fused,
+    )
+    choked = choked | newly
+    n_choke = jnp.sum(newly.astype(jnp.int32))
+    n_unchoke = jnp.sum(unchoke.astype(jnp.int32))
+    return choked, n_choke, n_unchoke
+
+
+def choke_guard(Dlo: int, mesh: jax.Array, choked: jax.Array) -> jax.Array:
+    """Re-establish the choke well-formedness contract after any mesh
+    mutation: choked ⊆ mesh, and any slot whose unchoked degree fell
+    below Dlo (a PRUNE or peer death took an unchoked link) drops ALL
+    its chokes — fail open, never starve a slot."""
+    choked = choked & mesh
+    unchoked_deg = count_true(mesh & ~choked)                          # [N,S]
+    ok = unchoked_deg >= Dlo
+    return choked & ok[:, :, None]
+
+
+def choke_suppression(choked: jax.Array) -> jax.Array:
+    """[N, K] edges whose eager push the receiver suppresses (any topic
+    slot choked the link — edge-granular like the announcement plane;
+    exact on single-topic builds)."""
+    return jnp.any(choked, axis=1)

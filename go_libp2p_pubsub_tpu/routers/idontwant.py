@@ -1,0 +1,68 @@
+"""GossipSub v1.2 IDONTWANT suppression (docs/DESIGN.md §24a).
+
+The reference (gossipsub.go handleIDontWant + the v1.2 spec): on first
+receipt of a message larger than IDontWantMessageThreshold, a peer
+sends IDONTWANT with the message id to its mesh peers; a peer holding
+an IDONTWANT for an id skips forwarding that message to the announcer.
+
+The vectorized form needs ZERO extra halo permutes: the announcement
+plane ``dontwant`` [N, W] lives at the RECEIVER, and the delivery
+edge mask is already receiver-indexed [N, K, W] — so "the sender was
+told" is a receiver-local word-AND, not a gather. The one-RTT control
+latency of the outbox model is preserved by updating ``dontwant`` at
+round end from that round's post-throttle new receipts and consuming
+it next round.
+
+Exactness anchor: ``dontwant`` ⊆ ``dlv.have`` by construction (it is
+fed from receipts that were OR'd into ``have`` the same round), so
+every suppressed transmission would have been a DUPLICATE — delivery,
+first_round, and fe_words are bit-identical to the v1.1 build; only
+n_rpc / n_duplicate drop. That is what makes the choke-smoke's
+equal-delivery duplicate-ratio gate an exact equality, not a band.
+
+Approximation vs the reference (documented, distributional): the
+suppression applies on every mesh edge of the announcer rather than
+only mesh edges in the message's topic (the announcement is sent to
+"mesh peers" per topic in the reference). Exact on single-topic
+builds — the smoke's shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import bitset
+from .config import RouterConfig
+
+
+def dontwant_announcements(router: RouterConfig, recv_new_words: jax.Array,
+                           joined_words: jax.Array) -> jax.Array:
+    """[N, W] message-id bits this round's first receipts announce.
+
+    ``recv_new_words`` is the round's post-throttle new-receipt plane
+    (RoundInfo.recv_new_words — first arrivals that passed the accept
+    gates), masked to joined topics; the size threshold is a static
+    Python branch over the unit-size message model.
+    """
+    if not router.idontwant_eligible:
+        return jnp.zeros_like(recv_new_words)
+    return recv_new_words & joined_words
+
+
+def dontwant_suppression(dontwant: jax.Array, mesh_edge: jax.Array) -> jax.Array:
+    """[N, K, W] words the sender on edge (i, k) withholds: ids receiver
+    i announced, on edges where the announcement was pushed (i's mesh).
+    Receiver-local — no gather."""
+    on_edge = jnp.where(mesh_edge[:, :, None], jnp.uint32(0xFFFFFFFF),
+                        jnp.uint32(0))
+    return dontwant[:, None, :] & on_edge
+
+
+def idontwant_sent_count(ann: jax.Array, mesh_edge: jax.Array) -> jax.Array:
+    """Scalar i32: announced-id pushes this round — popcount of the
+    announcement times the announcer's mesh degree (one IDONTWANT id
+    per (message, mesh neighbor) pair, the reference's per-RPC ids)."""
+    n_ids = bitset.popcount(ann, axis=-1)                     # [N]
+    deg = jnp.sum(mesh_edge.astype(jnp.int32), axis=-1)       # [N]
+    return jnp.sum(n_ids * deg).astype(jnp.int32)

@@ -1,0 +1,75 @@
+"""Per-edge link latency as a delayed-commit ring (docs/DESIGN.md §24c).
+
+``topo.link_class_planes`` becomes load-bearing: each edge carries a
+static integer delay in rounds (its latency class, normalized so the
+fastest class is 0 — the v1.1 one-round hop), and the data-plane
+commit of a send decision lands that many rounds later. The mechanism
+is the mcache ring pattern on the edge axis: ``inflight`` holds L
+pending edge-word planes, relative-indexed — slot 0 commits this
+round, slot d-1 receives decisions with delay d.
+
+Modeling note (deliberate, documented): store-and-forward. The whole
+transmission resolves at SEND time — mesh/fanout membership,
+suppression masks, the sender's fwd window (a ONE-round plane: the
+round's validated cohort, models/common.py) and the echo exclusion —
+and the ring carries the resolved transmission words; what's on the
+wire was valid when it left, like a real packet in flight. Arrivals
+commit through the extra-transmission merge (merge_extra_tx, the path
+built for IWANT responses — transmissions outside senders' current fwd
+sets), so the receiver dedups against its own then-current have plane:
+a receiver that obtained the message meanwhile simply sees one more
+duplicate. The ring is keep-masked at slot recycle, so a ride on a
+freed slot can't resurrect as the slot's next message; a link that
+flaps down drops its in-flight words (the step's down-edge clear).
+
+Shapes: dense ``[N, K, L, W]`` with delay ``[N, K]``; flat-[E] CSR
+``[E, L, W]`` with delay ``[E]`` — edge axes leading, like fe_words,
+so the ring is CSR-resident (state.CSR_RESIDENT_WORD_PLANES) and the
+same code serves both layouts via broadcasting.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_init(edge_shape: tuple, latency_rounds: int) -> jax.Array:
+    """Zero ring from an edge WORD-plane shape — (N, K, W) dense or
+    (E, W) flat; the L axis is inserted before the word axis."""
+    *lead, w = edge_shape
+    return jnp.zeros((*lead, latency_rounds, w), jnp.uint32)
+
+
+def _delay_words(delay: jax.Array, d: int) -> jax.Array:
+    """Full-word mask of edges whose delay equals d, broadcast-ready
+    against the edge word plane (one trailing word axis)."""
+    return jnp.where((delay == d)[..., None], jnp.uint32(0xFFFFFFFF),
+                     jnp.uint32(0))
+
+
+def ring_commit(inflight: jax.Array, edge_mask: jax.Array,
+                delay: jax.Array):
+    """Advance the ring one round.
+
+    ``edge_mask`` [..., W] is this round's send decision; edges with
+    delay 0 commit immediately, delay d > 0 lands in slot d-1. Returns
+    ``(arriving, inflight')`` — ``arriving`` replaces ``edge_mask`` as
+    the delivery engine's effective edge mask. The shift is a static
+    unrolled OR over the small L axis (the mcache pattern), no gather.
+    """
+    l_dim = inflight.shape[-2]
+    arriving = inflight[..., 0, :] | (edge_mask & _delay_words(delay, 0))
+    zeros = jnp.zeros_like(edge_mask)
+    slots = []
+    for i in range(l_dim):
+        nxt = inflight[..., i + 1, :] if i + 1 < l_dim else zeros
+        slots.append(nxt | (edge_mask & _delay_words(delay, i + 1)))
+    return arriving, jnp.stack(slots, axis=-2)
+
+
+def ring_keep(inflight: jax.Array, keep_words: jax.Array) -> jax.Array:
+    """Mask recycled message slots out of every pending plane (the same
+    keep-words recycle every other per-edge word plane gets) — a ride
+    on a freed slot must not resurrect as the slot's next message."""
+    return inflight & keep_words[..., None, :]

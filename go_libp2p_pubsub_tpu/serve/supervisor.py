@@ -107,6 +107,16 @@ class ServiceConfig:
     replay_check_every: int = 1
     degrade: bool = True
     report_name: str | None = "service"
+    #: drain the device EV counters into a host int64 accumulator at
+    #: every committed segment boundary and ZERO them on device, so the
+    #: i32 counters only ever hold ONE segment's growth no matter how
+    #: long the service runs — the range audit's overflow horizons
+    #: (RANGE_AUDIT.json: DUPLICATE_MESSAGE wraps i32 within ~4k rounds
+    #: at the dense shards) stop bounding service lifetime. The running
+    #: totals ride checkpoint meta and restore on resume, so a crash
+    #: loses nothing. OFF by default: draining trades the bare-window
+    #: bit-exactness contract (zeroed counters) for an unbounded horizon.
+    drain_event_counters: bool = False
 
     def __post_init__(self):
         if self.n_dispatches < 1 or self.segment_len < 1:
@@ -117,6 +127,11 @@ class ServiceConfig:
                 f"{self.n_dispatches}-dispatch run")
         if self.checkpoint_every_segments < 1:
             raise ValueError("checkpoint_every_segments must be >= 1")
+        if self.drain_event_counters and self.checkpoint_every_segments != 1:
+            raise ValueError(
+                "drain_event_counters needs checkpoint_every_segments=1 — "
+                "a fast-forward through undrained boundaries would double-"
+                "count the drained totals")
 
 
 @dataclasses.dataclass
@@ -147,6 +162,10 @@ class ServiceReport:
     #: COMMITTED dispatches, or None without an observer (rolled-back
     #: segments' observations are discarded with the segment)
     observations: object = None
+    #: [N_EVENTS] np.int64 drained EV totals over the whole run (the
+    #: counters a bare run would hold on device, summed on host past the
+    #: i32 horizon), or None when ``drain_event_counters`` is off
+    ev_totals: object = None
 
     def fingerprint(self) -> dict:
         """The schema-v3 ``fingerprint["service"]`` block
@@ -166,6 +185,13 @@ class ServiceReport:
 
 def _core_of(st):
     return st.core if hasattr(st, "core") else st
+
+
+def _with_events(st, ev):
+    """The state tree with its EV counter vector replaced (gossip trees
+    nest it under .core; bare SimStates hold it directly)."""
+    core = _core_of(st).replace(events=ev)
+    return st.replace(core=core) if hasattr(st, "core") else core
 
 
 def state_digest(state) -> str:
@@ -615,6 +641,9 @@ class Supervisor:
         t0 = time.perf_counter()
         resumed_from = None
         states, start = self.template_fn(), 0
+        ev_totals = (np.zeros_like(np.asarray(_core_of(states).events),
+                                   np.int64)
+                     if svc.drain_event_counters else None)
         if not fresh:
             st, entry = self.store.restore_latest(self.template_fn())
             if st is not None:
@@ -622,6 +651,16 @@ class Supervisor:
                 start = int(entry.get("meta", {}).get(
                     "dispatch", entry["tick"] // rps))
                 resumed_from = start
+                if ev_totals is not None:
+                    # drained totals ride checkpoint meta: a checkpoint's
+                    # device counters are zeroed AT its boundary, so the
+                    # pair (zeroed counters, meta totals) is the full
+                    # count — a legacy checkpoint without the key simply
+                    # resumes the accumulator from its own counters
+                    ev_totals = np.asarray(
+                        entry.get("meta", {}).get("ev_totals",
+                                                  ev_totals.tolist()),
+                        np.int64)
                 _log.info("resuming at dispatch %d (tick %d) from %s",
                           start, start * rps, entry["file"])
         prev_events = jnp.copy(_core_of(states).events)
@@ -709,10 +748,23 @@ class Supervisor:
             if ys and "obs" in ys:
                 obs_acc.append(ys["obs"])
             start += L
+            if ev_totals is not None:
+                # segment-boundary EV drain (the probe/invariant verdict
+                # above already validated this segment): the segment's
+                # i32 counter growth folds into the host i64 totals and
+                # the device counters zero, so no device counter ever
+                # holds more than ONE segment's growth — the overflow
+                # horizon becomes per-segment, not per-run
+                ev_totals += (np.asarray(_core_of(states).events, np.int64)
+                              - np.asarray(prev_events, np.int64))
+                states = _with_events(
+                    states, jnp.zeros_like(_core_of(states).events))
             if (self._segments_run % svc.checkpoint_every_segments == 0
                     or start >= total):
-                self.store.save(states, tick=start * rps,
-                                meta={"dispatch": start})
+                meta = {"dispatch": start}
+                if ev_totals is not None:
+                    meta["ev_totals"] = ev_totals.tolist()
+                self.store.save(states, tick=start * rps, meta=meta)
             prev_events = jnp.copy(_core_of(states).events)
             dt = time.perf_counter() - t_seg
             self._heartbeat(start, "running")
@@ -755,6 +807,7 @@ class Supervisor:
             retention=svc.retention,
             bundles=list(self._bundles),
             observations=observations,
+            ev_totals=ev_totals,
         )
 
 

@@ -696,7 +696,14 @@ class SimState:
 #: together (or trips them, which is the point).
 CSR_RESIDENT_WORD_PLANES = (".fe_words", ".served_lo", ".served_hi")
 CSR_RESIDENT_COUNTERS = (".peerhave", ".iasked")
-CSR_RESIDENT_SUFFIXES = CSR_RESIDENT_WORD_PLANES + CSR_RESIDENT_COUNTERS
+#: the router latency ring (routers/latency.py, docs/DESIGN.md §24c):
+#: an edge word plane with an interior L axis — [E, L, W] flat,
+#: [N, K, L, W] dense; priced as L word planes by memstat
+CSR_RESIDENT_RING_PLANES = (".inflight",)
+CSR_RESIDENT_SUFFIXES = (
+    CSR_RESIDENT_WORD_PLANES + CSR_RESIDENT_COUNTERS
+    + CSR_RESIDENT_RING_PLANES
+)
 
 
 def densify_edge_planes(net: "Net", st):
@@ -719,6 +726,10 @@ def densify_edge_planes(net: "Net", st):
             peerhave=net.unpack_edges(st.peerhave),
             iasked=net.unpack_edges(st.iasked),
         )
+    # the router latency ring carries its own ndim check: it exists on a
+    # different static branch (cfg.router) than the served planes
+    if getattr(st, "inflight", None) is not None and st.inflight.ndim == 3:
+        st = st.replace(inflight=net.unpack_edges(st.inflight))
     return st
 
 
@@ -741,6 +752,8 @@ def flatten_edge_planes(net: "Net", st):
             peerhave=net.pack_edges(st.peerhave),
             iasked=net.pack_edges(st.iasked),
         )
+    if getattr(st, "inflight", None) is not None and st.inflight.ndim == 4:
+        st = st.replace(inflight=net.pack_edges(st.inflight))
     return st
 
 

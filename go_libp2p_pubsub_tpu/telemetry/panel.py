@@ -60,6 +60,10 @@ EV_METRICS = (
     "ev_adv_drop",
     "ev_adv_ihave_lie",
     "ev_adv_graft_spam",
+    "ev_idontwant_sent",
+    "ev_dup_suppressed",
+    "ev_choke",
+    "ev_unchoke",
 )
 
 #: EV columns whose summed deltas must equal the end-of-run drained

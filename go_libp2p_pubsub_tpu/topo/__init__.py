@@ -20,8 +20,10 @@ from .dynamics import (
 )
 from .generators import (
     EdgeList,
+    attach_latency_classes,
     build_nets,
     geo_clusters,
+    link_delay_plane,
     powerlaw,
     small_world,
     to_topology,
@@ -32,9 +34,11 @@ __all__ = [
     "EdgeList",
     "MutationSchedule",
     "apply_mutation",
+    "attach_latency_classes",
     "build_nets",
     "churn_storm",
     "geo_clusters",
+    "link_delay_plane",
     "powerlaw",
     "small_world",
     "to_topology",

@@ -271,3 +271,41 @@ def link_class_planes(el: EdgeList, topo: graphlib.Topology
     for c, rounds in enumerate(latency):
         lat[cls == c] = rounds
     return cls, lat
+
+
+def attach_latency_classes(el: EdgeList, n_clusters: int = 8,
+                           class_latency: tuple = GEO_CLASS_LATENCY
+                           ) -> EdgeList:
+    """Geo latency classes for a class-less edge list (powerlaw /
+    small_world): peers get contiguous-id-block clusters — the same
+    relabeling geo_clusters bakes — and each edge classifies by cluster
+    adjacency (0 local, 1 adjacent-cluster, 2 long-haul). Deterministic
+    (no RNG): the classes are a pure function of (edges, n_clusters),
+    so the canonical form and the graph itself are untouched — this is
+    how the router plane's A/B cells put power-law GRAPHS on a
+    geo-latency FLOOR (docs/DESIGN.md §24c)."""
+    if n_clusters < 2:
+        raise ValueError("attach_latency_classes needs >= 2 clusters")
+    cluster = (np.arange(el.n, dtype=np.int64) * n_clusters) // el.n
+    ca = cluster[el.edges[:, 0]]
+    cb = cluster[el.edges[:, 1]]
+    adj = (np.minimum((ca - cb) % n_clusters, (cb - ca) % n_clusters) == 1)
+    link_class = np.where(
+        ca == cb, np.int8(0), np.where(adj, np.int8(1), np.int8(2)))
+    return EdgeList(n=el.n, edges=el.edges,
+                    link_class=link_class.astype(np.int8),
+                    class_latency=tuple(class_latency))
+
+
+def link_delay_plane(el: EdgeList, topo: graphlib.Topology
+                     ) -> tuple[np.ndarray, int]:
+    """The router plane's consumable: ``(delay[N, K] i32, L)`` — the
+    per-slot latency normalized so the FASTEST class is delay 0 (the
+    v1.1 one-round hop; routers/latency.py models delay as EXTRA rounds
+    on top of it), absent slots 0, and ``L = delay.max()`` the ring
+    depth to build ``RouterConfig(latency_rounds=L)`` with."""
+    _, lat = link_class_planes(el, topo)
+    present = np.asarray(topo.nbr_ok, bool)
+    base = int(lat[present].min()) if present.any() else 0
+    delay = np.where(present, lat - base, 0).astype(np.int32)
+    return delay, int(delay.max()) if present.any() else 0

@@ -61,13 +61,17 @@ PROTOCOL_NAMES = {0: "/floodsub/1.0.0", 1: "/meshsub/1.0.0", 2: "/meshsub/1.1.0"
 #: into per-event TraceEvents (not even in exact mode — the reference's
 #: event stream has no LinkDown/IwantRecover records, and its attackers
 #: are raw-wire test fakes its tracer never sees, so there are no
-#: AdvDrop/AdvIhaveLie/AdvGraftSpam records either), exposed
+#: AdvDrop/AdvIhaveLie/AdvGraftSpam records either — and its v1.1
+#: trace schema predates the v1.2 IDONTWANT / episub choke extensions,
+#: so the router counters have no record type by construction), exposed
 #: exclusively through ``counter_events()`` at phase-cadence resolution
-#: (docs/DESIGN.md §8, §13). Every other EV.* member maps 1:1 to a
+#: (docs/DESIGN.md §8, §13, §24). Every other EV.* member maps 1:1 to a
 #: TraceEvent emission below; the ``ev-drain`` simlint rule
 #: (analysis/simlint.py) pins both halves of that contract.
 COUNTER_ONLY_EVENTS = (EV.LINK_DOWN, EV.IWANT_RECOVER,
-                       EV.ADV_DROP, EV.ADV_IHAVE_LIE, EV.ADV_GRAFT_SPAM)
+                       EV.ADV_DROP, EV.ADV_IHAVE_LIE, EV.ADV_GRAFT_SPAM,
+                       EV.IDONTWANT_SENT, EV.DUP_SUPPRESSED,
+                       EV.CHOKE, EV.UNCHOKE)
 
 #: The r>1 accounting caveats, as one machine-surfaced note. This is the
 #: single source of truth: ``TraceSession.accounting_caveats()`` returns

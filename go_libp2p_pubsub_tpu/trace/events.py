@@ -53,6 +53,19 @@ class EV(enum.IntEnum):
     ADV_IHAVE_LIE = 16   # lying IHAVE advertisement bits emitted (ids the
                          # attacker never held) per heartbeat, summed
     ADV_GRAFT_SPAM = 17  # spam GRAFTs emitted ignoring PRUNE backoff
+    # --- sim-only router-plane counters (routers/, docs/DESIGN.md §24):
+    # the post-v1.1 protocol frontier — GossipSub v1.2 IDONTWANT and the
+    # episub-style lazy-choke router. No trace.proto counterpart (the
+    # reference's v1.1 trace schema predates both extensions), so they
+    # ride COUNTER_ONLY_EVENTS like the chaos/adversary planes.
+    # Statically elided unless a router-enabled build counts events.
+    IDONTWANT_SENT = 18  # IDONTWANT message-id bits pushed to mesh
+                         # neighbors on first receipt, summed per round
+    DUP_SUPPRESSED = 19  # duplicate transmissions a sender withheld
+                         # because the receiver announced IDONTWANT
+    CHOKE = 20           # mesh links demoted to lazy (IHAVE-only) by the
+                         # heartbeat choke decision
+    UNCHOKE = 21         # choked mesh links restored to eager delivery
 
 
 N_EVENTS = len(EV)

@@ -1,0 +1,497 @@
+"""`make choke-smoke`: the router-plane protocol A/B gate (round 24).
+
+Four paired cells on ONE latency-classed power-law graph (identical
+edge list, publish schedule, chaos and PRNG streams — the layout of
+`make topo-smoke`'s pairing, applied to protocol generations), all as
+S-sim ensemble runs so every gate is per-sim:
+
+  * **A** — GossipSub v1.1 (``router=None``, the elision baseline);
+  * **B** — v1.2 IDONTWANT suppression (docs/DESIGN.md §24a);
+  * **D** — v1.2 + the depth-L latency ring (§24c): per-edge integer
+    delays from ``topo.link_delay_plane`` make delivery order
+    heterogeneous — the cell choking has something to learn on;
+  * **C** — D plus the episub-style lazy-choke router (§24b), the
+    invariant hook armed (the round-24 ``choke-wf`` /
+    ``no-choke-below-dlo`` properties ride the standard catalog) —
+    plus a CSR arm of C (the ring rides the CSR-resident tier flat
+    as [E, L, W]).
+
+The gates:
+
+  1. **v1.2 exactness anchor** (B vs A, per sim): the delivery plane
+     is BIT-IDENTICAL (equal deliveries, equal first_round stamps) and
+     the duplicate count strictly drops on EVERY sim — suppression
+     removes exactly the traffic that was going to be thrown away
+     (``dontwant ⊆ have`` by construction). The committed
+     ``dup_cut_floor`` pins the suppression depth.
+  2. **choke latency-tail cut** (C vs D, per sim, at equal delivery):
+     both cells drain to >= 99% coverage and the delivery-latency p95,
+     pooled over the PAIRED common support (pairs both cells
+     delivered, so neither cell's rare protocol-faithful holes censor
+     the other's tail), drops — choking demotes consistently-late
+     (high-delay-class) mesh links to IHAVE-only, and the gossip
+     control path's fixed RTT beats the slow links' ring delay. The
+     committed ``tail_cut_floor`` pins the win.
+  3. **zero invariant violations** on the choked cell, with the two
+     choke properties registered and checked (they are seeded-negative
+     -tested in tests/test_invariants.py).
+  4. **one compile per cell** + **layout parity**: C's CSR arm counts
+     the same events bit-for-bit.
+  5. **router-off census**: the chaos-off compiled kernel census still
+     equals the on-image baseline (the chaos-report census leg,
+     reused) — the router plane is opt-in, kernel-for-kernel; and the
+     v1.1 cell's per-sim counters equal the COMMITTED pin bit-for-bit
+     (router growth must never move router-off behavior).
+
+CHOKE_SMOKE_UPDATE=1 rewrites CHOKE_SMOKE.json from a green run
+(floors committed at half the measured margin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+BASELINE_PATH = os.path.join(REPO, "CHOKE_SMOKE.json")
+
+N = int(os.environ.get("CHOKE_SMOKE_N", 256))
+MAX_DEGREE = int(os.environ.get("CHOKE_SMOKE_K", 16))
+D_MIN = 3
+CLUSTERS = 8
+MSG_SLOTS = 64
+ROUNDS = int(os.environ.get("CHOKE_SMOKE_ROUNDS", 84))
+PUB_WIDTH = 4
+#: sparse schedule: N_MSGS single publishes every 2 rounds from round
+#: 3, then a long drain tail — BOTH latency cells must reach full
+#: coverage (every live slot stamped at every peer) so the p95
+#: comparison is uncensored; slot count stays under MSG_SLOTS (no
+#: recycle, so first_round keeps every stamp)
+N_MSGS = int(os.environ.get("CHOKE_SMOKE_MSGS", 12))
+SIMS = int(os.environ.get("CHOKE_SMOKE_SIMS", 4))
+SEED = 0
+LOSS = 0.05
+
+#: update-mode margin: floors commit at half the measured margin
+MARGIN = 0.5
+
+CHOKE = None  # RouterConfig knobs, filled in main (needs the import)
+
+
+def _choke_knobs():
+    from go_libp2p_pubsub_tpu.routers import RouterConfig
+
+    return dict(choke_ema_alpha=0.4, choke_threshold=0.35,
+                unchoke_threshold=0.1, choke_max_per_hb=2)
+
+
+def _score_params():
+    from go_libp2p_pubsub_tpu.config import (
+        PeerScoreParams,
+        TopicScoreParams,
+    )
+
+    return PeerScoreParams(
+        topics={0: TopicScoreParams(mesh_message_deliveries_weight=0.0,
+                                    mesh_failure_penalty_weight=0.0)},
+        skip_app_specific=True,
+    )
+
+
+def run_cell(name: str, graphs, router=None, link_delay=None,
+             edge_layout="dense", invariants=False):
+    """One protocol cell: an S-sim ensemble run over the shared graph +
+    schedule. Returns per-sim events, the delivery-latency plane, the
+    compile sentinel and (optionally) the invariant report."""
+    import jax
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import ensemble
+    from go_libp2p_pubsub_tpu.chaos.faults import ChaosConfig
+    from go_libp2p_pubsub_tpu.config import (
+        GossipSubParams,
+        PeerScoreThresholds,
+    )
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSubConfig,
+        GossipSubState,
+        make_gossipsub_step,
+    )
+    from go_libp2p_pubsub_tpu.oracle import invariants as inv
+    from go_libp2p_pubsub_tpu.state import Net
+
+    topo_, subs, po, pt, pv = graphs
+    net = Net.build(topo_, subs, edge_layout=edge_layout)
+    sp = _score_params()
+    # widened mcache window: with ring delays up to L rounds plus 5%
+    # loss, a hole must still find a live IHAVE advertisement — the
+    # default 3-heartbeat gossip window can expire first, leaving a
+    # permanent (peer, msg) hole that would censor the p95 pairing
+    cfg = GossipSubConfig.build(
+        GossipSubParams(history_length=12, history_gossip=8),
+        PeerScoreThresholds(), score_enabled=True,
+        chaos=ChaosConfig(generator="iid", loss_rate=LOSS),
+        router=router, edge_layout=edge_layout)
+    st0 = GossipSubState.init(net, MSG_SLOTS, cfg, score_params=sp,
+                              seed=SEED)
+    step = make_gossipsub_step(cfg, net, score_params=sp,
+                               link_delay=link_delay)
+    ens = ensemble.lift_step(jax.jit(step, donate_argnums=0))
+    states = ensemble.batch_states(st0, SIMS)
+
+    hook = None
+    if invariants:
+        hook = inv.InvariantHook(
+            "gossipsub", net, cfg,
+            inv.InvariantConfig(check_every=8, delivery_window=48),
+            due_fn=lambda tick: inv.due_vector(quiet=(0, ROUNDS)))
+
+    xs_fn = lambda i: (ensemble.tile(po[i], SIMS),
+                       ensemble.tile(pt[i], SIMS),
+                       ensemble.tile(pv[i], SIMS))
+    t0 = time.perf_counter()
+    run = ensemble.run_rounds(ens, states, xs_fn, ROUNDS,
+                              invariants=hook)
+    wall = time.perf_counter() - t0
+
+    core = run.states.core
+    events = np.asarray(core.events)             # [S, N_EVENTS]
+    fr = np.asarray(core.dlv.first_round)        # [S, N, M]
+    birth = np.asarray(core.msgs.birth)          # [S, M]
+    lat = fr - birth[:, None, :]
+    lat_mask = (fr >= 0) & (birth[:, None, :] >= 0)
+    out = {
+        "name": name,
+        "events": events,
+        "lat": lat,
+        "lat_mask": lat_mask,
+        "first_round": fr,
+        "wall_s": round(wall, 3),
+        "compiles": int(run.compiles),
+    }
+    if hook is not None:
+        out["invariants"] = hook.report()
+    return out
+
+
+def _per_sim(events, ev):
+    return [int(x) for x in events[:, ev]]
+
+
+def _lat_p95(cell):
+    """Pooled delivery-latency p95 per sim (rounds from publish to
+    first receipt, over every delivered (peer, msg) pair)."""
+    import numpy as np
+
+    out = []
+    for s in range(cell["lat"].shape[0]):
+        v = cell["lat"][s][cell["lat_mask"][s]]
+        out.append(float(np.percentile(v, 95)) if v.size else -1.0)
+    return out
+
+
+def run_smoke() -> dict:
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu import graph, topo
+    from go_libp2p_pubsub_tpu.routers import RouterConfig
+    from go_libp2p_pubsub_tpu.trace.events import EV
+
+    el = topo.powerlaw(N, d_min=D_MIN, max_degree=MAX_DEGREE, seed=SEED)
+    el = topo.attach_latency_classes(el, n_clusters=CLUSTERS)
+    topo_ = topo.to_topology(el)
+    subs = graph.subscribe_all(N, 1)
+    delay, L = topo.link_delay_plane(el, topo_)
+    rng = np.random.default_rng(1)
+    po = np.full((ROUNDS, PUB_WIDTH), -1, np.int32)
+    pt = np.zeros((ROUNDS, PUB_WIDTH), np.int32)
+    pv = np.zeros((ROUNDS, PUB_WIDTH), bool)
+    for i in range(N_MSGS):
+        r = 3 + 2 * i
+        po[r, 0] = rng.integers(0, N)
+        pv[r, 0] = True
+    graphs = (topo_, subs, po, pt, pv)
+
+    knobs = _choke_knobs()
+    r_b = RouterConfig(idontwant=True)
+    r_d = RouterConfig(idontwant=True, latency_rounds=L)
+    r_c = RouterConfig(idontwant=True, latency_rounds=L, choke=True,
+                       **knobs)
+
+    a = run_cell("v1.1", graphs)
+    b = run_cell("v1.2_idontwant", graphs, router=r_b)
+    d = run_cell("v1.2_ring", graphs, router=r_d, link_delay=delay)
+    c = run_cell("v1.2_ring_choke", graphs, router=r_c, link_delay=delay,
+                 invariants=True)
+    c_csr = run_cell("v1.2_ring_choke_csr", graphs, router=r_c,
+                     link_delay=delay, edge_layout="csr")
+
+    dup_a = np.asarray(_per_sim(a["events"], EV.DUPLICATE_MESSAGE), float)
+    dup_b = np.asarray(_per_sim(b["events"], EV.DUPLICATE_MESSAGE), float)
+    dlv_a = np.asarray(_per_sim(a["events"], EV.DELIVER_MESSAGE), float)
+    dup_ratio_a = (dup_a / np.maximum(dlv_a, 1)).round(4)
+    dup_ratio_b = (dup_b / np.maximum(dlv_a, 1)).round(4)
+    # paired comparison over the COMMON delivered support: a (peer, msg)
+    # hole in one cell (all mesh pushes lost at a peer with no non-mesh
+    # in-edges — no IHAVE can reach it; protocol-faithful) must not
+    # censor the other cell's tail, so both p95s pool exactly the pairs
+    # both cells delivered, and the coverage floors below keep that
+    # support honest (>= 99% of every sim's (peer, msg) plane)
+    common = c["lat_mask"] & d["lat_mask"]
+    p95_c = _lat_p95({"lat": c["lat"], "lat_mask": common})
+    p95_d = _lat_p95({"lat": d["lat"], "lat_mask": common})
+
+    rep = c.pop("invariants")
+    res = {
+        "n_peers": N,
+        "max_degree": MAX_DEGREE,
+        "n_edges": int(len(el.edges)),
+        "latency_classes": [int(x)
+                            for x in np.bincount(el.link_class,
+                                                 minlength=3)],
+        "ring_depth": int(L),
+        "rounds": ROUNDS,
+        "n_sims": SIMS,
+        "workload": f"sparse_{N_MSGS}_publishes",
+        "loss_rate": LOSS,
+        "choke_knobs": knobs,
+        "cells": {},
+        "dup_ratio_v11_per_sim": dup_ratio_a.tolist(),
+        "dup_ratio_v12_per_sim": dup_ratio_b.tolist(),
+        "dup_cut_per_sim": [round(float(x), 4)
+                            for x in 1.0 - dup_b / np.maximum(dup_a, 1)],
+        "p95_latency_choke_per_sim": p95_c,
+        "p95_latency_nochoke_per_sim": p95_d,
+        "tail_cut": round(1.0 - (float(np.mean(p95_c))
+                                 / max(float(np.mean(p95_d)), 1e-9)), 4),
+        "coverage_choke_per_sim": [
+            round(float(m.sum()) / (N_MSGS * N), 4) for m in c["lat_mask"]],
+        "coverage_nochoke_per_sim": [
+            round(float(m.sum()) / (N_MSGS * N), 4) for m in d["lat_mask"]],
+        "common_support_per_sim": [
+            round(float(m.sum()) / (N_MSGS * N), 4) for m in common],
+        "first_round_exact_v12": bool(
+            np.array_equal(a["first_round"], b["first_round"])),
+        "csr_counters_exact": bool(
+            np.array_equal(c["events"], c_csr["events"])),
+        "invariants": {
+            "all_ok": bool(rep.all_ok),
+            "checked": int(rep.checked),
+            "violated": int(rep.violated),
+            "properties": list(rep.names),
+        },
+    }
+    for cell in (a, b, d, c, c_csr):
+        res["cells"][cell["name"]] = {
+            "wall_s": cell["wall_s"],
+            "compiles": cell["compiles"],
+            "delivered_per_sim": _per_sim(cell["events"],
+                                          EV.DELIVER_MESSAGE),
+            "duplicates_per_sim": _per_sim(cell["events"],
+                                           EV.DUPLICATE_MESSAGE),
+            "rpc_per_sim": _per_sim(cell["events"], EV.SEND_RPC),
+            "idontwant_per_sim": _per_sim(cell["events"],
+                                          EV.IDONTWANT_SENT),
+            "suppressed_per_sim": _per_sim(cell["events"],
+                                           EV.DUP_SUPPRESSED),
+            "chokes_per_sim": _per_sim(cell["events"], EV.CHOKE),
+            "unchokes_per_sim": _per_sim(cell["events"], EV.UNCHOKE),
+        }
+    return res
+
+
+def gate(res: dict) -> list[str]:
+    import numpy as np
+
+    failures = []
+    cells = res["cells"]
+    a = cells["v1.1"]
+    b = cells["v1.2_idontwant"]
+    c = cells["v1.2_ring_choke"]
+    d = cells["v1.2_ring"]
+
+    # 1. v1.2 exactness anchor, per sim
+    if a["delivered_per_sim"] != b["delivered_per_sim"]:
+        failures.append(
+            "v1.2 changed WHAT was delivered: per-sim deliveries "
+            f"{b['delivered_per_sim']} != v1.1 {a['delivered_per_sim']}")
+    if not res["first_round_exact_v12"]:
+        failures.append("v1.2 moved a first_round stamp — suppression "
+                        "must only remove duplicate traffic")
+    dup_pairs = list(zip(a["duplicates_per_sim"], b["duplicates_per_sim"]))
+    if not all(db < da for da, db in dup_pairs):
+        failures.append(
+            f"duplicate cut not strict on every sim: v1.1 vs v1.2 "
+            f"duplicates {dup_pairs}")
+    if not all(x > 0 for x in b["idontwant_per_sim"]):
+        failures.append("a v1.2 sim announced nothing (IDONTWANT_SENT=0)")
+    for da, db, sa, sb in zip(a["duplicates_per_sim"],
+                              b["duplicates_per_sim"],
+                              a["rpc_per_sim"], b["rpc_per_sim"]):
+        if sa - sb != da - db:
+            failures.append(
+                f"RPC drop {sa - sb} != duplicate drop {da - db} — "
+                "suppression removed non-duplicate traffic")
+            break
+
+    # 2. choke latency-tail cut at equal delivery
+    for tag in ("coverage_choke_per_sim", "coverage_nochoke_per_sim",
+                "common_support_per_sim"):
+        if min(res[tag]) < 0.99:
+            failures.append(
+                f"{tag} {res[tag]} below 0.99 — a latency cell did not "
+                "drain to (near-)full coverage; the paired p95 "
+                "comparison would be censored (grow ROUNDS)")
+    if not all(x > 0 for x in c["chokes_per_sim"]):
+        failures.append(
+            f"a sim choked nothing ({c['chokes_per_sim']}) — the "
+            "lateness EMA never crossed the threshold; vacuous cell")
+    if res["tail_cut"] <= 0.0:
+        failures.append(
+            f"choking did not cut the latency tail: p95 choke "
+            f"{res['p95_latency_choke_per_sim']} vs no-choke "
+            f"{res['p95_latency_nochoke_per_sim']}")
+
+    # 3. invariants (choke properties armed, zero violations)
+    iv = res["invariants"]
+    for prop in ("choke-wf", "no-choke-below-dlo"):
+        if prop not in iv["properties"]:
+            failures.append(f"invariant hook ran without {prop}")
+    if not iv["checked"]:
+        failures.append("invariant hook checked nothing (vacuous gate)")
+    if not iv["all_ok"]:
+        failures.append(f"invariant violations on the choked cell: "
+                        f"{iv['violated']}")
+
+    # 4. one compile per cell + layout parity
+    compiles = {k: v["compiles"] for k, v in cells.items()}
+    if -1 in compiles.values():
+        print("choke-smoke: one-compile sentinel UNAVAILABLE — gate "
+              "skipped")
+    elif any(v != 1 for v in compiles.values()):
+        failures.append(f"one-compile sentinel: {compiles}")
+    if not res["csr_counters_exact"]:
+        failures.append("CSR arm counters differ from dense — the "
+                        "layout changed WHAT, not just how")
+    if any(x <= 0 for x in a["delivered_per_sim"]):
+        failures.append("a v1.1 sim delivered nothing — dead wire")
+    return failures
+
+
+def check_census(failures: list) -> dict:
+    """Router-off structural leg: the chaos-off compiled kernel census
+    must still equal the on-image baseline (chaos_report leg, reused
+    like churn-smoke does) — the router plane is opt-in."""
+    from chaos_report import check_census as _chaos_census
+
+    census = _chaos_census()
+    if not census["equal"]:
+        failures.append(
+            f"census: router-off kernel census {census['total']} != "
+            f"on-image baseline {census['on_image']} — the router "
+            "plane leaked kernels into the off build")
+    return census
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # the census leg (and therefore every committed number here) is
+    # defined under the bench PRNG, like churn-smoke
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+
+    from go_libp2p_pubsub_tpu.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(os.path.join(REPO, ".jax_cache"))
+
+    res = run_smoke()
+    failures = gate(res)
+    if not os.environ.get("CHOKE_SMOKE_NO_CENSUS"):
+        res["census"] = check_census(failures)
+    print(json.dumps(res, indent=1, sort_keys=True))
+
+    update = bool(os.environ.get("CHOKE_SMOKE_UPDATE"))
+    shape_keys = ("n_peers", "max_degree", "rounds", "n_sims",
+                  "workload", "loss_rate")
+    if update or not os.path.exists(BASELINE_PATH):
+        if failures:
+            print("choke-smoke: FAIL (refusing to baseline a broken "
+                  "run):")
+            for f in failures:
+                print("  -", f)
+            return 1
+        dup_cut = min(res["dup_cut_per_sim"])
+        baseline = {
+            "note": ("choke-smoke baseline (scripts/choke_smoke.py; "
+                     "CHOKE_SMOKE_UPDATE=1 rewrites)"),
+            **{k: res[k] for k in shape_keys},
+            "ring_depth": res["ring_depth"],
+            # the committed floors: half the measured margin
+            "dup_cut_floor": round(dup_cut * MARGIN, 4),
+            "tail_cut_floor": round(res["tail_cut"] * MARGIN, 4),
+            # the v1.1 pin: router growth must never move router-off
+            # behavior (bit-exact per-sim counters)
+            "v11_pin": {k: res["cells"]["v1.1"][k]
+                        for k in ("delivered_per_sim",
+                                  "duplicates_per_sim", "rpc_per_sim")},
+            "measured": {
+                "dup_cut_per_sim": res["dup_cut_per_sim"],
+                "tail_cut": res["tail_cut"],
+                "p95_choke": res["p95_latency_choke_per_sim"],
+                "p95_nochoke": res["p95_latency_nochoke_per_sim"],
+            },
+        }
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baseline, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"choke-smoke: wrote {BASELINE_PATH}")
+        return 0
+
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    mismatched = [k for k in shape_keys if res[k] != base.get(k)]
+    if not mismatched:
+        if min(res["dup_cut_per_sim"]) < base["dup_cut_floor"]:
+            failures.append(
+                f"duplicate cut {min(res['dup_cut_per_sim'])} below the "
+                f"committed floor {base['dup_cut_floor']}")
+        if res["tail_cut"] < base["tail_cut_floor"]:
+            failures.append(
+                f"latency tail cut {res['tail_cut']} below the "
+                f"committed floor {base['tail_cut_floor']}")
+        pin = base.get("v11_pin") or {}
+        for k, v in pin.items():
+            if res["cells"]["v1.1"][k] != v:
+                failures.append(
+                    f"v1.1 pin broke: {k} {res['cells']['v1.1'][k]} != "
+                    f"committed {v} — router growth moved router-off "
+                    "behavior")
+    else:
+        print("choke-smoke: NOTE — run shape differs from the committed "
+              "baseline on %s; floor/pin gates SKIPPED (pairing + "
+              "invariant + census gates still apply)" % mismatched)
+
+    if failures:
+        print("choke-smoke: FAIL")
+        for f in failures:
+            print("  -", f)
+        return 1
+    print("choke-smoke: PASS — v1.2 dup cut per sim %s at bit-exact "
+          "delivery; choke p95 tail cut %.3f (%s -> %s); invariants "
+          "green (%d checks); per-cell compiles %s; CSR parity exact"
+          % (res["dup_cut_per_sim"], res["tail_cut"],
+             res["p95_latency_nochoke_per_sim"],
+             res["p95_latency_choke_per_sim"],
+             res["invariants"]["checked"],
+             {k: v["compiles"] for k, v in res["cells"].items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -282,6 +282,21 @@ def artifact_topology(path: str) -> dict:
     return recs[-1].topology
 
 
+def artifact_router(path: str) -> dict:
+    """The ``router`` fingerprint block (round 24: which protocol
+    generation cut the number — v1.1 | v1.2-IDONTWANT — plus the choke
+    decision rule and latency ring depth) of a bench artifact's last
+    metric line; legacy lines read back perf.artifacts.ROUTER_V11
+    (plain v1.1 semantics, which every pre-round-24 build ran)."""
+    from go_libp2p_pubsub_tpu.perf.artifacts import load_bench_lines
+
+    recs = load_bench_lines(path)
+    for rec in reversed(recs):
+        if rec.router_on:
+            return rec.router
+    return recs[-1].router
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("tracefile")
@@ -303,6 +318,7 @@ def main():
         stats["service"] = artifact_service(args.artifact)
         stats["topology"] = artifact_topology(args.artifact)
         stats["dynamics"] = artifact_dynamics(args.artifact)
+        stats["router"] = artifact_router(args.artifact)
     if args.json:
         print(json.dumps(stats))
         return
@@ -410,6 +426,23 @@ def main():
         else:
             print("dynamics: DYNAMICS_OFF (frozen overlay, or the "
                   "artifact predates the round-22 dynamic plane)")
+    if "router" in stats:
+        rt = stats["router"]
+        if rt.get("enabled"):
+            bits = [f"protocol {rt.get('protocol')}"]
+            if rt.get("idontwant"):
+                bits.append(f"idontwant<= {rt.get('idontwant_threshold')}")
+            if rt.get("choke"):
+                bits.append(
+                    f"choke ema={rt.get('choke_ema_alpha')} "
+                    f"[{rt.get('unchoke_threshold')}, "
+                    f"{rt.get('choke_threshold')}] "
+                    f"max/hb={rt.get('choke_max_per_hb')}")
+            bits.append(f"latency ring L={rt.get('latency_rounds')}")
+            print("router: " + ", ".join(bits))
+        else:
+            print("router: ROUTER_V11 (plain v1.1 semantics, or the "
+                  "artifact predates the round-24 router plane)")
     if "adversary" in stats:
         av = stats["adversary"]
         if av.get("enabled"):

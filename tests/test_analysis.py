@@ -832,7 +832,8 @@ def test_schema_engines_complete():
 def test_guard_registry_rows():
     names = [r.name for r in guards.DERIVED_ROWS]
     assert names == ["ensemble", "telemetry", "csr", "phase_csr", "lifted",
-                     "csr_fused", "lifted_fused", "dynamic"]
+                     "csr_fused", "lifted_fused", "dynamic",
+                     "idontwant", "choke"]
     for row in guards.DERIVED_ROWS:
         assert callable(getattr(guards, row.runner)), row.runner
         assert row.base in guards.ENGINES, row

@@ -667,3 +667,61 @@ def test_tracestat_reads_invariants_block(net, lived_in, tmp_path):
     p2.write_text('{"metric": "m", "value": 1.0}\n')
     off = tracestat.artifact_invariants(str(p2))
     assert off["enabled"] is False and off["checked"] == 0
+
+
+# ---------------------------------------------------------------------------
+# router choke properties ("choke-wf", "no-choke-below-dlo"): clean and
+# seeded-violation checks need a router-choke build — the v1.1 lived_in
+# tree carries choked=None and both properties are vacuously true there
+
+
+@pytest.fixture(scope="module")
+def choke_lived_in(net):
+    """A post-run gossipsub (cfg, state) with the lazy-choke router on
+    (docs/DESIGN.md §24b): the choke guard has been exercised through
+    GRAFT/PRUNE and heartbeat maintenance."""
+    from go_libp2p_pubsub_tpu.routers import RouterConfig
+
+    sp = _score_params()
+    cfg = GossipSubConfig.build(
+        _params(), PeerScoreThresholds(), score_enabled=True,
+        router=RouterConfig(choke=True, choke_threshold=0.3,
+                            unchoke_threshold=0.1))
+    st = GossipSubState.init(net, M, cfg, score_params=sp, seed=0)
+    step = make_gossipsub_step(cfg, net, score_params=sp)
+    po, pt, pv = _schedule(ROUNDS, 0)
+    for t in range(ROUNDS):
+        st = step(st, jnp.asarray(po[t]), jnp.asarray(pt[t]),
+                  jnp.asarray(pv[t]))
+    return cfg, st
+
+
+def test_clean_choke_run_passes_all(net, choke_lived_in):
+    cfg, st = choke_lived_in
+    res = _check(net, st, cfg, due=QUIET)
+    bad = [name for name, v in res.items() if not v]
+    assert not bad, bad
+
+
+def test_seeded_choke_outside_mesh_trips_choke_wf(net, choke_lived_in):
+    # a choked bit on a non-mesh edge trips exactly "choke-wf"
+    cfg, st = choke_lived_in
+    mesh = np.asarray(st.mesh)
+    i, s, k = map(int, np.argwhere(~mesh)[0])
+    st2 = st.replace(choked=st.choked.at[i, s, k].set(True))
+    res = _check(net, st2, cfg, due=QUIET)
+    failed = {name for name, v in res.items() if not v}
+    assert failed == {"choke-wf"}, sorted(failed)
+
+
+def test_seeded_choke_starvation_trips_dlo_floor(net, choke_lived_in):
+    # choke EVERY mesh link of one slot: unchoked degree 0 < Dlo trips
+    # exactly "no-choke-below-dlo" (choked stays ⊆ mesh, so choke-wf
+    # keeps holding — the two properties separate cleanly)
+    cfg, st = choke_lived_in
+    deg = np.asarray(st.mesh.sum(axis=-1))
+    i, s = map(int, np.argwhere(deg >= cfg.Dlo)[0])
+    st2 = st.replace(choked=st.choked.at[i, s].set(st.mesh[i, s]))
+    res = _check(net, st2, cfg, due=QUIET)
+    failed = {name for name, v in res.items() if not v}
+    assert failed == {"no-choke-below-dlo"}, sorted(failed)

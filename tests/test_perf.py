@@ -623,3 +623,63 @@ def test_service_off_sentinel_is_mutation_safe():
          "vs_baseline": 0.0}).service
     assert fresh["retention"] == {"keep_last": 0, "keep_every": 0}
     assert fresh["probes"] == []
+
+
+def test_router_block_round_trips_and_legacy_sentinel(tmp_path):
+    """Round 24: the `router` fingerprint block (which protocol
+    generation cut the number — v1.1 | v1.2-IDONTWANT — plus the choke
+    decision rule and latency ring depth) round-trips through the line
+    format; LEGACY lines read back the typed ROUTER_V11 sentinel (plain
+    v1.1 semantics — literally what every pre-round-24 build ran), and
+    tracestat's artifact reader surfaces the block."""
+    import sys
+
+    from go_libp2p_pubsub_tpu.routers import RouterConfig
+
+    rc = RouterConfig(idontwant=True, choke=True, latency_rounds=7,
+                      choke_threshold=0.35, unchoke_threshold=0.1)
+    block = artifacts.router_fingerprint(rc)
+    assert block["enabled"] and block["protocol"] == "v1.2"
+    assert block["idontwant"] and block["choke"]
+    assert block["latency_rounds"] == 7
+    assert block["choke_threshold"] == pytest.approx(0.35)
+    assert block["choke_max_per_hb"] == 1
+
+    rec = artifacts.BenchRecord(
+        metric="choke_dup_ratio", value=0.2, unit="dup/delivery",
+        vs_baseline=0.0, schema=3, fingerprint={"router": block})
+    back = artifacts.record_from_line(json.loads(artifacts.dump_record(rec)))
+    assert back.router_on
+    assert back.router == block
+
+    # router=None IS the explicit v1.1 block (what the sweep emits for
+    # every bench cell), and a latency-only build stays protocol v1.1
+    # with its choke knobs typed-None, not garbage defaults
+    assert artifacts.router_fingerprint(None) == artifacts.ROUTER_V11
+    lat = artifacts.router_fingerprint(RouterConfig(latency_rounds=3))
+    assert lat["enabled"] and lat["protocol"] == "v1.1"
+    assert lat["latency_rounds"] == 3 and lat["choke_ema_alpha"] is None
+    fp = sweep.workload_fingerprint("default", 100_000, 64, 8, 8)
+    assert fp["router"] == artifacts.ROUTER_V11
+
+    legacy = artifacts.record_from_line(
+        {"metric": "m", "value": 1.0, "unit": "x", "vs_baseline": 0.0})
+    assert legacy.router == artifacts.ROUTER_V11
+    assert not legacy.router_on
+    assert legacy.router["protocol"] == "v1.1"
+
+    # tracestat surfaces the block; every committed BENCH_r* line reads
+    # the sentinel without error
+    art = tmp_path / "router.json"
+    art.write_text(artifacts.dump_record(rec) + "\n")
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        from tracestat import artifact_router
+
+        got = artifact_router(str(art))
+    finally:
+        sys.path.pop(0)
+    assert got == block
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
+        r = artifacts.load_bench_artifact(p)
+        assert not r.router_on and r.router["protocol"] == "v1.1"

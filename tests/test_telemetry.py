@@ -521,3 +521,49 @@ def test_run_report_renders_legacy_artifact_as_stub():
                                "vs_baseline": 0.0})
     html = run_report.render_html([legacy])
     assert "TELEMETRY_OFF" in html
+
+
+def test_reconcile_router_counters_and_off_negative():
+    """Round 24: a router run reconciles exactly — the four new EV
+    columns (ev_idontwant_sent / ev_dup_suppressed / ev_choke /
+    ev_unchoke) telescope to the drained counters like every other
+    metric — and the seeded NEGATIVE: a router-off run of the same
+    schedule records those columns identically zero (the panel must
+    not invent router traffic a v1.1 build never generated)."""
+    from go_libp2p_pubsub_tpu.routers import RouterConfig
+
+    rounds = 24
+    router_cols = ("ev_idontwant_sent", "ev_dup_suppressed",
+                   "ev_choke", "ev_unchoke")
+    rc = RouterConfig(idontwant=True, choke=True, choke_ema_alpha=0.5,
+                      choke_threshold=0.25, unchoke_threshold=0.05)
+
+    def run(router):
+        tcfg = TelemetryConfig(rows=rounds)
+        net, cfg, sp, st = _build_gossip(seed=5, telemetry=tcfg,
+                                         router=router)
+        step = make_gossipsub_step(cfg, net, score_params=sp,
+                                   telemetry=tcfg)
+        po, pt, pv = _schedule(rounds, seed=5)
+        for i in range(rounds):
+            st = step(st, po[i], pt[i], pv[i])
+        return np.asarray(st.core.telem.panel), np.asarray(st.core.events)
+
+    panel, events = run(rc)
+    assert reconcile(panel, events) == []
+    totals = panel_ev_totals(panel)
+    assert totals[EV.IDONTWANT_SENT] > 0
+    assert totals[EV.DUP_SUPPRESSED] > 0
+    assert totals[EV.CHOKE] > 0
+    # the columns are the counters, positionally (catalog mirrors enum)
+    for col in router_cols:
+        e = EV[col[3:].upper()]
+        assert panel[:, metric_index(col)].sum() == pytest.approx(
+            float(events[e]))
+
+    # seeded negative: router=None — same schedule, zero router columns
+    panel0, events0 = run(None)
+    assert reconcile(panel0, events0) == []
+    for col in router_cols:
+        assert not panel0[:, metric_index(col)].any()
+        assert events0[EV[col[3:].upper()]] == 0

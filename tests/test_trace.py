@@ -451,3 +451,75 @@ def test_tracestat_cli_phase_cadence(tmp_path):
     # gates + run_report, prose mirrored in caveat_notes
     assert "phase_cadence" in stats["caveats"]
     assert stats["caveat_notes"]["phase_cadence"] == stats["cadence"]["note"]
+
+
+# ---------------------------------------------------------------------------
+# round 24: router counters are drain-counter-only (seeded negative)
+
+
+def test_router_counters_are_drain_counter_only():
+    """The four router counters (IDONTWANT_SENT / DUP_SUPPRESSED /
+    CHOKE / UNCHOKE) are sim-only: the reference's v1.1 trace schema
+    predates the v1.2/episub extensions, so the drain must surface them
+    EXCLUSIVELY through counter_events() — a v1.2 suppression run emits
+    a per-event stream bit-identical to the v1.1 run's (the delivery
+    plane is unchanged; only duplicate traffic disappears), and the
+    seeded negative pins every router counter at zero on the v1.1 run."""
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.routers import RouterConfig
+    from go_libp2p_pubsub_tpu.trace.events import EV
+
+    def run(router):
+        topo = graph.random_connect(24, d=4, seed=0)
+        net = Net.build(topo, graph.subscribe_all(24, 1))
+        params = dataclasses.replace(GossipSubParams(), flood_publish=True)
+        cfg = GossipSubConfig.build(params, PeerScoreThresholds(),
+                                    score_enabled=False, router=router)
+        st = GossipSubState.init(net, 32, cfg, seed=0)
+        step = make_gossipsub_step(cfg, net)
+        frames: list[bytes] = []
+        sess = drain.TraceSession(net, [sinks.RemoteTracer(frames.append)])
+        sess.emit_init(drain.snapshot(st))
+        rng = np.random.default_rng(0)
+        for r in range(12):
+            po, pt, pv = no_publish(4)
+            if r < 6:
+                o = rng.integers(0, 24, 2)
+                po = jnp.asarray(np.array([o[0], o[1], -1, -1], np.int32))
+                pt = jnp.asarray(np.zeros(4, np.int32))
+                pv = jnp.asarray(np.array([True, True, False, False]))
+            prev = drain.snapshot(st)
+            st = step(st, po, pt, pv)
+            sess.observe(prev, drain.snapshot(st), po, pt, pv)
+        final = drain.snapshot(st)
+        sess.close(final)
+        return sinks.decode_remote_stream(b"".join(frames)), \
+            sess.counter_events(final)
+
+    evs_a, cnt_a = run(None)
+    evs_b, cnt_b = run(RouterConfig(idontwant=True))
+
+    # parity audit stays green: no proto record type exists for any of
+    # the four, and all four are documented sim-only
+    for name in ("IDONTWANT_SENT", "DUP_SUPPRESSED", "CHOKE", "UNCHOKE"):
+        assert name not in trace_pb2.TraceEvent.Type.keys()
+        assert EV[name] in drain.COUNTER_ONLY_EVENTS
+
+    # suppression changed NO per-event record — the stream is the v1.1
+    # stream, bit for bit (delivery plane unchanged by the exactness
+    # anchor: dontwant ⊆ have)
+    assert evs_b == evs_a
+
+    # counters tell the suppression story exactly: the RPC drop IS the
+    # duplicate drop, and the lazy-choke counters never move without a
+    # choke-armed router
+    assert cnt_b["IDONTWANT_SENT"] > 0 and cnt_b["DUP_SUPPRESSED"] > 0
+    assert cnt_b["DELIVER_MESSAGE"] == cnt_a["DELIVER_MESSAGE"]
+    assert cnt_b["SEND_RPC"] < cnt_a["SEND_RPC"]
+    assert (cnt_a["SEND_RPC"] - cnt_b["SEND_RPC"]
+            == cnt_a["DUPLICATE_MESSAGE"] - cnt_b["DUPLICATE_MESSAGE"])
+    # seeded negative: the v1.1 run pins all four at zero
+    for name in ("IDONTWANT_SENT", "DUP_SUPPRESSED", "CHOKE", "UNCHOKE"):
+        assert cnt_a[name] == 0
+    assert cnt_b["CHOKE"] == 0 and cnt_b["UNCHOKE"] == 0
